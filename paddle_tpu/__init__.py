@@ -1,0 +1,148 @@
+"""paddle_tpu — a TPU-native deep learning framework with PaddlePaddle's capability
+surface, built from scratch on JAX/XLA/Pallas/pjit.
+
+Top-level namespace mirrors ``paddle``: tensor ops, nn, optimizer, autograd, amp, io,
+jit, static, distributed, incubate, profiler, metric, vision.
+"""
+
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from . import flags as _flags_mod
+from .core import dtype as _dtype_mod
+from .core.dtype import (  # noqa: F401
+    bfloat16,
+    bool_ as bool,  # noqa: A001
+    complex64,
+    complex128,
+    float16,
+    float32,
+    float64,
+    get_default_dtype,
+    int8,
+    int16,
+    int32,
+    int64,
+    set_default_dtype,
+    uint8,
+)
+from .core.tensor import Parameter, Tensor  # noqa: F401
+from .core.autograd_engine import enable_grad, no_grad, set_grad_enabled  # noqa: F401
+from .core.autograd_engine import grad  # noqa: F401
+from .flags import get_flags, set_flags  # noqa: F401
+from .framework import ParamAttr, load, save, seed  # noqa: F401
+from .framework.random import get_rng_state, set_rng_state  # noqa: F401
+from .tensor import *  # noqa: F401,F403
+from .tensor import einsum  # noqa: F401
+
+from . import amp  # noqa: F401,E402
+from . import audio  # noqa: F401,E402
+from . import autograd  # noqa: F401,E402
+from . import device  # noqa: F401,E402
+from . import distributed  # noqa: F401,E402
+from . import distribution  # noqa: F401,E402
+from . import framework  # noqa: F401,E402
+from . import hapi  # noqa: F401,E402
+from . import incubate  # noqa: F401,E402
+from . import io  # noqa: F401,E402
+from . import jit  # noqa: F401,E402
+from . import metric  # noqa: F401,E402
+from . import nn  # noqa: F401,E402
+from . import optimizer  # noqa: F401,E402
+from . import profiler  # noqa: F401,E402
+from . import sparse  # noqa: F401,E402
+from . import static  # noqa: F401,E402
+from . import text  # noqa: F401,E402
+from . import vision  # noqa: F401,E402
+from .hapi.model import Model  # noqa: F401,E402
+from .jit.api import to_static  # noqa: F401,E402
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_cinn() -> bool:
+    return False
+
+
+def is_compiled_with_distribute() -> bool:
+    return True
+
+
+def in_dynamic_mode() -> bool:
+    return not static._static_mode[0]
+
+
+def in_static_mode() -> bool:
+    return static._static_mode[0]
+
+
+def enable_static():
+    static._static_mode[0] = True
+
+
+def disable_static(place=None):
+    static._static_mode[0] = False
+
+
+def set_device(device):
+    return device
+
+
+def get_device():
+    import jax
+
+    d = jax.devices()[0]
+    return f"{d.platform}:{d.id}"
+
+
+def device_count():
+    import jax
+
+    return jax.device_count()
+
+
+def set_printoptions(**kwargs):
+    import numpy as np
+
+    np.set_printoptions(**{k: v for k, v in kwargs.items() if k in ("precision", "threshold", "edgeitems", "linewidth")})
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    from .hapi.summary import summary as _summary
+
+    return _summary(net, input_size, dtypes, input)
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    return 0
+
+
+CPUPlace = type("CPUPlace", (), {})
+CUDAPlace = type("CUDAPlace", (), {"__init__": lambda self, i=0: None})
+TPUPlace = type("TPUPlace", (), {"__init__": lambda self, i=0: None})
+
+DataParallel = None  # bound by paddle_tpu.distributed at import
+
+
+def _late_bind():
+    global DataParallel
+    from .distributed.parallel import DataParallel as _DP
+
+    DataParallel = _DP
+
+
+try:
+    _late_bind()
+except Exception:  # distributed optional at import time
+    pass

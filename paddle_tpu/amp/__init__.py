@@ -1,0 +1,100 @@
+"""paddle_tpu.amp — automatic mixed precision (reference: python/paddle/amp).
+
+TPU-first: bf16 is the native fast dtype (MXU), needs no loss scaling; fp16 +
+GradScaler kept for API parity. ``auto_cast`` installs an AMP state consulted by the
+op dispatcher (core/op_registry.py) exactly where the reference's generated ad_funcs
+call AmpAutoCasts (eager_manual/forwards/add_n_fwd_func.cc:31-50).
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+from ..core import op_registry
+from ..core.dtype import convert_dtype
+from .amp_lists import BLACK_LIST, WHITE_LIST
+from .grad_scaler import AmpScaler, GradScaler  # noqa: F401
+
+
+class _AmpState:
+    def __init__(self, enabled, dtype, level, custom_white, custom_black):
+        self.enabled = enabled
+        self.dtype = dtype
+        self.level = level
+        self.white = set(WHITE_LIST) | set(custom_white or ())
+        self.black = set(BLACK_LIST) | set(custom_black or ())
+        self.low_precision_ops = {}
+
+    def classify(self, op_name, default_cat):
+        if op_name in self.black:
+            return op_registry.AMP_BLACK
+        if op_name in self.white:
+            return op_registry.AMP_WHITE
+        if self.level == "O2":
+            # pure-low-precision mode: everything except black runs low precision
+            return op_registry.AMP_WHITE if default_cat != op_registry.AMP_BLACK else op_registry.AMP_BLACK
+        return default_cat
+
+    def record_op(self, name):
+        self.low_precision_ops[name] = self.low_precision_ops.get(name, 0) + 1
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None, level="O1",
+              dtype="bfloat16", use_promote=True):
+    """Reference: amp/auto_cast.py:1014. Default dtype is bfloat16 on TPU."""
+    prev = op_registry.amp_state
+    dt = convert_dtype("float16" if dtype == "float16" else "bfloat16")
+    op_registry.amp_state = _AmpState(enable, dt, level, custom_white_list, custom_black_list)
+    try:
+        yield
+    finally:
+        op_registry.amp_state = prev
+
+
+amp_guard = auto_cast
+
+
+def is_auto_cast_enabled():
+    st = op_registry.amp_state
+    return bool(st and st.enabled)
+
+
+def get_amp_dtype():
+    st = op_registry.amp_state
+    from ..core.dtype import dtype_name
+
+    return dtype_name(st.dtype) if st else "float32"
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16", master_weight=None,
+             save_dtype=None, master_grad=False, excluded_layers=None):
+    """Reference: amp/auto_cast.py decorate — O2 casts model params to low precision."""
+    single_model = not isinstance(models, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        dt = convert_dtype("float16" if dtype == "float16" else "bfloat16")
+        import jax.numpy as jnp
+
+        for m in model_list:
+            skip = set()
+            if excluded_layers:
+                excl = excluded_layers if isinstance(excluded_layers, (list, tuple)) else [excluded_layers]
+                for l in m.sublayers(include_self=True):
+                    if isinstance(l, tuple(e for e in excl if isinstance(e, type))) or l in excl:
+                        skip.add(id(l))
+            for l in m.sublayers(include_self=True):
+                from ..nn.layer.norm import LayerNorm, _BatchNormBase
+
+                if id(l) in skip or isinstance(l, (_BatchNormBase, LayerNorm)):
+                    continue
+                for p in l._parameters.values():
+                    if p is not None and jnp.issubdtype(p.dtype, jnp.floating):
+                        p._data = p._data.astype(dt)
+            m._casted_by_pure_fp16 = True
+    if optimizers is None:
+        return models if single_model else model_list
+    return (models if single_model else model_list), optimizers
+
+
+from . import debugging  # noqa: F401,E402

@@ -1,0 +1,70 @@
+"""AMP debugging tools (reference: python/paddle/amp/debugging.py)."""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+
+from .. import flags
+from ..core import op_registry
+from ..core.tensor import Tensor
+
+
+def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
+    """Check a tensor for nan/inf (reference: debugging.py check_numerics)."""
+    arr = tensor._data if isinstance(tensor, Tensor) else tensor
+    num_nan = int(jnp.isnan(arr).sum())
+    num_inf = int(jnp.isinf(arr).sum())
+    if num_nan or num_inf:
+        msg = f"[check_numerics] op={op_type} var={var_name}: {num_nan} nan, {num_inf} inf"
+        if flags.get_flag("check_nan_inf_level") == 0:
+            raise FloatingPointError(msg)
+        import warnings
+
+        warnings.warn(msg)
+    return Tensor(jnp.asarray(num_nan)), Tensor(jnp.asarray(num_inf))
+
+
+@contextlib.contextmanager
+def enable_operator_stats_collection():
+    """Collect per-op low-precision execution counts under AMP."""
+    flags.set_flags({"low_precision_op_list": 1})
+    st = op_registry.amp_state
+    try:
+        yield
+    finally:
+        flags.set_flags({"low_precision_op_list": 0})
+        if st is not None and st.low_precision_ops:
+            print("<------------------------------ op list ------------------------------->")
+            for name, count in sorted(st.low_precision_ops.items()):
+                print(f"  {name:<40} low-precision calls: {count}")
+
+
+def collect_operator_stats():
+    st = op_registry.amp_state
+    return dict(st.low_precision_ops) if st else {}
+
+
+class DebugMode:
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL = 4
+
+
+def enable_tensor_checker(checker_config=None):
+    flags.set_flags({"check_nan_inf": True})
+
+
+def disable_tensor_checker():
+    flags.set_flags({"check_nan_inf": False})
+
+
+class TensorCheckerConfig:
+    def __init__(self, enable=True, debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT, **kw):
+        self.enable = enable
+        self.debug_mode = debug_mode
+
+
+def compare_accuracy(dump_path, another_dump_path, output_filename, loss_scale=1, dump_all_tensors=False):
+    raise NotImplementedError("accuracy-compare tooling lands with the profiler dump format")

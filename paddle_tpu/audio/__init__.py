@@ -1,0 +1,1 @@
+"""paddle_tpu.audio — audio feature suite (reference: python/paddle/audio). Round-1 stub."""

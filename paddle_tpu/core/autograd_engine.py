@@ -1,0 +1,236 @@
+"""Eager autograd: tape of GradNodes + queue-based backward.
+
+TPU-native redesign of the reference's eager engine (fluid/eager/grad_node_info.h:197
+``GradNodeBase``, fluid/eager/backward.cc:105 ``RunBackward``): instead of per-op
+hand/generated C++ grad kernels, every recorded op stores the ``jax.vjp`` closure of
+its (pure, jax-traceable) forward fn. Backward is a reverse-topological walk that
+feeds cotangents through those closures — each closure itself runs on-device via XLA.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .tensor import Tensor
+
+_state = threading.local()
+
+
+def grad_enabled() -> bool:
+    return getattr(_state, "grad_enabled", True)
+
+
+def set_grad_enabled(mode: bool) -> bool:
+    prev = grad_enabled()
+    _state.grad_enabled = mode
+    return prev
+
+
+class no_grad:
+    """Context manager / decorator disabling tape recording (paddle.no_grad)."""
+
+    def __enter__(self):
+        self._prev = set_grad_enabled(False)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._prev)
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with no_grad():
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        self._prev = set_grad_enabled(True)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._prev)
+        return False
+
+
+class GradNode:
+    """One recorded op: maps output cotangents -> input cotangents via stored vjp."""
+
+    __slots__ = ("name", "vjp_fn", "inputs", "out_avals", "n_outputs", "hooks")
+
+    def __init__(self, name: str, vjp_fn, inputs: List[Tensor], out_avals):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs  # differentiable input Tensors, in vjp order
+        self.out_avals = out_avals  # [(shape, dtype)] per output
+        self.n_outputs = len(out_avals)
+        self.hooks = None  # {out_idx: [fn]}
+
+    def __repr__(self):
+        return f"GradNode<{self.name}>"
+
+
+def _zero_cotangent(shape, dtype):
+    if jnp.issubdtype(dtype, jnp.floating) or jnp.issubdtype(dtype, jnp.complexfloating):
+        return jnp.zeros(shape, dtype)
+    return np.zeros(shape, jax.dtypes.float0)
+
+
+def _accumulate(slot, value):
+    return value if slot is None else slot + value
+
+
+def run_backward(root: Tensor, grad_tensor: Optional[Tensor] = None, retain_graph: bool = False,
+                 sink=None, capture_tensors=None):
+    """Reverse-topological cotangent propagation (cf. backward.cc:105).
+
+    When ``sink`` is given (paddle.grad mode), cotangents for ``capture_tensors``
+    are collected into ``sink[id(tensor)]`` and NO ``.grad`` fields are touched —
+    gradients-without-side-effects, matching the reference's ``paddle.grad``.
+    """
+    if grad_tensor is None:
+        if not jnp.issubdtype(root.dtype, jnp.floating):
+            raise RuntimeError("backward() root must be floating point")
+        seed = jnp.ones(root._data.shape, root.dtype)
+    else:
+        seed = grad_tensor._data if isinstance(grad_tensor, Tensor) else jnp.asarray(grad_tensor)
+
+    # map (node id, out idx) -> sink key for non-leaf capture; id(tensor) for leaves
+    cap_nonleaf = {}
+    cap_leaf = set()
+    if sink is not None:
+        for t in capture_tensors or ():
+            if t._node is not None:
+                cap_nonleaf[(id(t._node), t._out_idx)] = id(t)
+            else:
+                cap_leaf.add(id(t))
+
+    if root._node is None:
+        if sink is not None:
+            if id(root) in cap_leaf:
+                sink[id(root)] = _accumulate(sink.get(id(root)), seed)
+        elif not root.stop_gradient:
+            _write_leaf_grad(root, seed)
+        return
+
+    # topo order over nodes (iterative DFS)
+    order: List[GradNode] = []
+    visited = set()
+    stack = [(root._node, False)]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in visited:
+            continue
+        visited.add(id(node))
+        stack.append((node, True))
+        for t in node.inputs:
+            if t._node is not None and id(t._node) not in visited:
+                stack.append((t._node, False))
+
+    # cotangent accumulation buffers, keyed by node id
+    pending = {id(n): [None] * n.n_outputs for n in order}
+    pending[id(root._node)][root._out_idx] = _accumulate(
+        pending[id(root._node)][root._out_idx], seed
+    )
+
+    for node in reversed(order):
+        cots = pending.pop(id(node))
+        if cap_nonleaf:
+            for idx, c in enumerate(cots):
+                key = cap_nonleaf.get((id(node), idx))
+                if key is not None and c is not None:
+                    sink[key] = _accumulate(sink.get(key), c)
+        if all(c is None for c in cots):
+            continue
+        full = tuple(
+            c if c is not None else _zero_cotangent(shape, dt)
+            for c, (shape, dt) in zip(cots, node.out_avals)
+        )
+        if node.hooks:
+            full = list(full)
+            for idx, fns in node.hooks.items():
+                for fn in fns:
+                    out = fn(Tensor(full[idx]))
+                    if out is not None:
+                        full[idx] = out._data if isinstance(out, Tensor) else out
+            full = tuple(full)
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                "Trying to backward through the graph a second time "
+                "(use retain_graph=True)."
+            )
+        payload = full[0] if node.n_outputs == 1 else full
+        in_cots = node.vjp_fn(payload)
+        if not retain_graph:
+            node.vjp_fn = None
+        for t, g in zip(node.inputs, in_cots):
+            if g is None or (hasattr(g, "dtype") and g.dtype == jax.dtypes.float0):
+                continue
+            if t._node is not None:
+                buf = pending.get(id(t._node))
+                if buf is not None:
+                    buf[t._out_idx] = _accumulate(buf[t._out_idx], g)
+            elif sink is not None:
+                if id(t) in cap_leaf:
+                    sink[id(t)] = _accumulate(sink.get(id(t)), g)
+            elif not t.stop_gradient:
+                _write_leaf_grad(t, g)
+
+
+def _write_leaf_grad(t: Tensor, g):
+    if t._hooks:
+        for fn in t._hooks:
+            out = fn(Tensor(g))
+            if out is not None:
+                g = out._data if isinstance(out, Tensor) else out
+    if t._grad is None:
+        gt = Tensor(g)
+        gt.stop_gradient = True
+        t._grad = gt
+    else:
+        t._grad._data = t._grad._data + g
+
+
+def grad(
+    outputs,
+    inputs,
+    grad_outputs=None,
+    retain_graph=None,
+    create_graph=False,
+    only_inputs=True,
+    allow_unused=False,
+    no_grad_vars=None,
+):
+    """paddle.grad analogue: compute grads of outputs wrt inputs WITHOUT touching any
+    tensor's ``.grad`` (side-effect-free, incl. unrelated model parameters).
+    Works for both leaf and intermediate (non-leaf) inputs."""
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is not None and not isinstance(grad_outputs, (list, tuple)):
+        grad_outputs = [grad_outputs]
+
+    sink = {}
+    retain = True if retain_graph is None else retain_graph
+    for i, out in enumerate(outputs):
+        g = grad_outputs[i] if grad_outputs is not None else None
+        run_backward(out, g, retain_graph=retain, sink=sink, capture_tensors=inputs)
+    results = []
+    for t in inputs:
+        g = sink.get(id(t))
+        if g is None and not allow_unused:
+            raise RuntimeError(f"Tensor {t.name} is unused in the graph")
+        results.append(Tensor(g) if g is not None else None)
+    return results

@@ -1,0 +1,86 @@
+"""Dtype system.
+
+Mirrors the reference's dtype surface (paddle/phi/common/data_type.h, exposed as
+``paddle.float32`` etc.) on top of JAX dtypes. TPU-first: bfloat16 is a first-class
+dtype; float64 works only when x64 is enabled (off by default, as on TPU).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+bool_ = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_STR2DTYPE = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "bfloat16": bfloat16,
+    "float32": float32,
+    "float64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+    "fp16": float16,
+    "bf16": bfloat16,
+    "fp32": float32,
+    "fp64": float64,
+}
+
+
+def convert_dtype(dtype):
+    """Normalize a user-supplied dtype (str / numpy / jax) to a jnp dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype not in _STR2DTYPE:
+            raise ValueError(f"Unknown dtype string: {dtype!r}")
+        return _STR2DTYPE[dtype]
+    return jnp.dtype(dtype).type
+
+
+def dtype_name(dtype) -> str:
+    return str(np.dtype(dtype))
+
+
+def is_floating_point(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.floating)
+
+
+def is_integer(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.integer)
+
+
+def is_complex(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.complexfloating)
+
+
+# default dtype management (paddle.set_default_dtype)
+_default_dtype = float32
+
+
+def set_default_dtype(dtype) -> None:
+    global _default_dtype
+    dtype = convert_dtype(dtype)
+    if not is_floating_point(dtype):
+        raise TypeError("default dtype must be floating point")
+    _default_dtype = dtype
+
+
+def get_default_dtype():
+    return _default_dtype

@@ -1,0 +1,181 @@
+"""Declarative op registry + eager dispatcher.
+
+Replaces the reference's five-codegen YAML pipeline (phi/ops/yaml/ops.yaml + api_gen.py
++ eager_gen.py + op_gen.py + python_c_gen.py) with ONE runtime registry: each op is a
+pure jax-traceable function plus metadata (AMP behavior, optional SPMD rule). The
+dispatcher is the analogue of the generated ``*_ad_func`` pattern
+(fluid/eager/api/manual/eager_manual/forwards/add_n_fwd_func.cc:25):
+  profile scope -> AMP autocast -> [tape record via jax.vjp] -> kernel (jnp/lax/pallas)
+  -> nan/inf check -> wrap outputs.
+Under a jax trace (jit/grad/vmap/shard_map) the tape is bypassed and the pure fn is
+inlined into the surrounding jaxpr — eager and compiled modes share one implementation.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import flags
+from . import autograd_engine
+from .tensor import Tensor
+
+# AMP categories (reference: python/paddle/amp/amp_lists.py)
+AMP_WHITE = "white"  # run in low precision (matmul/conv class)
+AMP_BLACK = "black"  # keep fp32 (softmax/norm/exp class)
+AMP_NEUTRAL = "neutral"  # follow inputs
+
+
+class OpDef:
+    __slots__ = ("name", "fn", "amp", "spmd_rule", "n_outputs", "doc")
+
+    def __init__(self, name, fn, amp=AMP_NEUTRAL, spmd_rule=None, doc=""):
+        self.name = name
+        self.fn = fn
+        self.amp = amp
+        self.spmd_rule = spmd_rule
+        self.doc = doc
+
+
+OPS: Dict[str, OpDef] = {}
+
+
+def register_op(name: str, amp: str = AMP_NEUTRAL, spmd_rule=None):
+    """Decorator: register a pure jax function as a framework op."""
+
+    def deco(fn):
+        OPS[name] = OpDef(name, fn, amp=amp, spmd_rule=spmd_rule, doc=fn.__doc__ or "")
+        return fn
+
+    return deco
+
+
+def get_op(name: str) -> OpDef:
+    return OPS[name]
+
+
+# ---- AMP hook (set by paddle_tpu.amp to avoid circular import) ----
+amp_state = None  # type: Optional[Any]
+
+# ---- profiler hook (set by paddle_tpu.profiler) ----
+profile_scope = None  # type: Optional[Callable]
+
+
+def _is_tracer(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def _has_tracer(arrays) -> bool:
+    return any(_is_tracer(a) for a in arrays)
+
+
+def _amp_cast_preserving_graph(a: Tensor, tgt):
+    """Cast a tensor for AMP while keeping its autograd linkage."""
+    return apply_fn("cast", lambda x: x.astype(tgt), a)
+
+
+def _check_nan_inf(name, arrays):
+    for a in arrays:
+        if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating):
+            if not bool(jnp.isfinite(a).all()):
+                msg = f"Operator {name} output contains NaN/Inf"
+                if flags.get_flag("check_nan_inf_level") == 0:
+                    raise FloatingPointError(msg)
+                import warnings
+
+                warnings.warn(msg)
+
+
+def apply(name: str, *args, **kwargs):
+    """Dispatch a registered op over Tensor/array args."""
+    return apply_fn(name, OPS[name].fn, *args, _opdef=OPS[name], **kwargs)
+
+
+def apply_fn(name: str, fn: Callable, *args, _opdef: Optional[OpDef] = None, **kwargs):
+    """Dispatch an (unregistered) pure function as an op — same tape/AMP semantics.
+
+    Positional args may be Tensors (differentiable leaves), arrays, or static values.
+    kwargs are always static.
+    """
+    op = _opdef or OpDef(name, fn)
+
+    if amp_state is not None and amp_state.enabled and op.amp != AMP_NEUTRAL:
+        cat = amp_state.classify(op.name, op.amp)
+        if cat == AMP_WHITE:
+            tgt = amp_state.dtype
+            args = tuple(
+                _amp_cast_preserving_graph(a, tgt)
+                if isinstance(a, Tensor) and a.dtype == jnp.float32
+                else a
+                for a in args
+            )
+            if flags.get_flag("low_precision_op_list"):
+                amp_state.record_op(op.name)
+        elif cat == AMP_BLACK:
+            args = tuple(
+                _amp_cast_preserving_graph(a, jnp.float32)
+                if isinstance(a, Tensor) and a.dtype in (jnp.bfloat16, jnp.float16)
+                else a
+                for a in args
+            )
+
+    tensor_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+    arrays = [args[i]._data for i in tensor_idx]
+    tracing = _has_tracer(arrays)
+
+    record = (
+        not tracing
+        and autograd_engine.grad_enabled()
+        and any(not args[i].stop_gradient for i in tensor_idx)
+    )
+
+    def call_with(arrs):
+        full = list(args)
+        for i, a in zip(tensor_idx, arrs):
+            full[i] = a
+        return fn(*full, **kwargs)
+
+    if record:
+        diff_idx = [
+            i
+            for i in tensor_idx
+            if jnp.issubdtype(args[i].dtype, jnp.floating)
+            or jnp.issubdtype(args[i].dtype, jnp.complexfloating)
+        ]
+        diff_arrays = [args[i]._data for i in diff_idx]
+
+        def pure(*darrs):
+            full = list(args)
+            it = iter(darrs)
+            for i in tensor_idx:
+                full[i] = next(it) if i in diff_idx else args[i]._data
+            return fn(*full, **kwargs)
+
+        out, vjp_fn = jax.vjp(pure, *diff_arrays)
+        out_list, single = (list(out), False) if isinstance(out, (tuple, list)) else ([out], True)
+        node = autograd_engine.GradNode(
+            name,
+            vjp_fn,
+            [args[i] for i in diff_idx],
+            [(o.shape, o.dtype) for o in out_list],
+        )
+        results = []
+        for idx, o in enumerate(out_list):
+            t = Tensor(o, stop_gradient=False)
+            t._node = node
+            t._out_idx = idx
+            results.append(t)
+        if flags.get_flag("check_nan_inf"):
+            _check_nan_inf(name, out_list)
+        return results[0] if single else tuple(results)
+
+    out = call_with(arrays)
+    if not tracing and flags.get_flag("check_nan_inf"):
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        _check_nan_inf(name, [o for o in outs if hasattr(o, "dtype")])
+    if isinstance(out, (tuple, list)):
+        return tuple(Tensor(o) if not isinstance(o, Tensor) else o for o in out)
+    return Tensor(out) if not isinstance(out, Tensor) else out

@@ -1,0 +1,121 @@
+"""paddle_tpu.device — device management (reference: python/paddle/device).
+
+On TPU, XLA owns streams/events/memory; this module provides the paddle-parity
+surface (set_device/synchronize/Stream/Event) mapped onto JAX device semantics.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def set_device(device):
+    return device
+
+
+def get_device():
+    d = jax.devices()[0]
+    return f"{d.platform}:{d.id}"
+
+
+def get_all_custom_device_type():
+    return ["tpu"]
+
+
+def is_compiled_with_custom_device(device_type):
+    return device_type == "tpu"
+
+
+def device_count():
+    return jax.device_count()
+
+
+def synchronize(device=None):
+    """Block until all dispatched work completes (paddle.device.synchronize)."""
+    jax.effects_barrier()
+
+
+def cuda_device_count():
+    return 0
+
+
+class Stream:
+    """Parity object: XLA has no user-visible streams on TPU; ops on one device
+    execute in dispatch order, collectives get their own async scope from XLA."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+    def wait_event(self, event):
+        pass
+
+    def wait_stream(self, stream):
+        pass
+
+    def record_event(self, event=None):
+        return event or Event()
+
+    def query(self):
+        return True
+
+
+class Event:
+    def __init__(self, enable_timing=False, blocking=False, interprocess=False):
+        self._t = None
+
+    def record(self, stream=None):
+        import time
+
+        jax.effects_barrier()
+        self._t = time.perf_counter()
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+    def elapsed_time(self, end_event):
+        if self._t is None or end_event._t is None:
+            return 0.0
+        return (end_event._t - self._t) * 1000.0
+
+
+def current_stream(device=None):
+    return Stream(device)
+
+
+def stream_guard(stream):
+    import contextlib
+
+    return contextlib.nullcontext()
+
+
+class cuda:
+    """Alias namespace kept for API compatibility (paddle.device.cuda)."""
+
+    Stream = Stream
+    Event = Event
+
+    @staticmethod
+    def device_count():
+        return 0
+
+    @staticmethod
+    def synchronize(device=None):
+        synchronize()
+
+    @staticmethod
+    def empty_cache():
+        pass
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        return 0
+
+    @staticmethod
+    def memory_allocated(device=None):
+        return 0

@@ -1,0 +1,61 @@
+"""paddle_tpu.distributed (reference: python/paddle/distributed).
+
+TPU-native distributed stack: mesh-axis groups + XLA collectives over ICI/DCN
+replace ProcessGroupNCCL/TCPStore; GSPMD + NamedSharding replace DistTensor's SPMD
+rules and reshard functions; fleet engines become shard_map programs.
+"""
+
+from . import fleet  # noqa: F401
+from .auto_parallel import (  # noqa: F401
+    Partial,
+    Placement,
+    ProcessMesh,
+    Replicate,
+    Shard,
+    Strategy,
+    dtensor_from_local,
+    dtensor_to_local,
+    get_mesh,
+    reshard,
+    set_mesh,
+    shard_dataloader,
+    shard_layer,
+    shard_optimizer,
+    shard_tensor,
+    to_static,
+    unshard_dtensor,
+)
+from .auto_parallel.api import ShardingStage1, ShardingStage2, ShardingStage3  # noqa: F401
+from .communication.functional import (  # noqa: F401
+    P2POp,
+    all_gather,
+    all_gather_into_tensor,
+    all_gather_object,
+    all_reduce,
+    all_to_all,
+    all_to_all_single,
+    alltoall,
+    alltoall_single,
+    barrier,
+    batch_isend_irecv,
+    broadcast,
+    broadcast_object_list,
+    irecv,
+    isend,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    scatter_object_list,
+    send,
+)
+from .communication.group import Group, ReduceOp, destroy_process_group, get_group, new_group  # noqa: F401
+from .parallel import (  # noqa: F401
+    DataParallel,
+    ParallelEnv,
+    get_rank,
+    get_world_size,
+    init_parallel_env,
+    is_initialized,
+    spawn,
+)

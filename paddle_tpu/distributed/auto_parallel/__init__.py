@@ -1,0 +1,18 @@
+"""paddle_tpu.distributed.auto_parallel (reference: python/paddle/distributed/auto_parallel)."""
+
+from .api import (  # noqa: F401
+    Strategy,
+    dtensor_from_local,
+    dtensor_to_local,
+    get_mesh,
+    reshard,
+    set_mesh,
+    shard_dataloader,
+    shard_layer,
+    shard_optimizer,
+    shard_tensor,
+    to_static,
+    unshard_dtensor,
+)
+from .placement import Partial, Placement, Replicate, Shard  # noqa: F401
+from .process_mesh import ProcessMesh, auto_mesh, get_current_mesh  # noqa: F401

@@ -1,0 +1,253 @@
+"""Semi-automatic parallel API (reference: python/paddle/distributed/auto_parallel/api.py).
+
+TPU-native: a "DistTensor" is a jax.Array carrying a NamedSharding — GSPMD replaces
+the reference's DistTensor + ~60 SPMD infer rules + 11 reshard functions
+(phi/core/distributed/auto_parallel/): sharding propagation happens in the XLA
+compiler; ``reshard`` is ``device_put``/``with_sharding_constraint`` (collective
+chosen by XLA: all-gather for s→r, dynamic-slice for r→s, reduce for partial, …).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ...core.tensor import Parameter, Tensor
+from ...nn.layer.layers import Layer
+from .placement import Partial, Placement, Replicate, Shard, placements_to_spec, spec_to_placements
+from .process_mesh import ProcessMesh
+
+
+def _named_sharding(mesh: ProcessMesh, placements: Sequence[Placement], ndim: int) -> NamedSharding:
+    spec = placements_to_spec(placements, mesh.dim_names, ndim)
+    return NamedSharding(mesh.to_jax(), spec)
+
+
+def shard_tensor(data, mesh: ProcessMesh, placements: Sequence[Placement], dtype=None, stop_gradient=None):
+    """Place a tensor onto a mesh with given placements (reference api.py:181)."""
+    t = data if isinstance(data, Tensor) else Tensor(data, dtype=dtype)
+    sharding = _named_sharding(mesh, placements, t._data.ndim)
+    arr = t._data
+    if isinstance(arr, jax.core.Tracer):
+        arr = jax.lax.with_sharding_constraint(arr, sharding)
+    else:
+        arr = jax.device_put(arr, sharding)
+    t._data = arr
+    t.process_mesh = mesh
+    t.placements = list(placements)
+    if stop_gradient is not None:
+        t.stop_gradient = stop_gradient
+    return t
+
+
+def dtensor_from_local(local_tensor, mesh, placements):
+    # single-controller: the local tensor IS the global view on 1 process
+    return shard_tensor(local_tensor, mesh, placements)
+
+
+def dtensor_to_local(dist_tensor, mesh=None, placements=None):
+    arr = dist_tensor._data
+    shards = getattr(arr, "addressable_shards", None)
+    if shards:
+        return Tensor(shards[0].data)
+    return dist_tensor
+
+
+def reshard(dist_tensor: Tensor, mesh: ProcessMesh, placements: Sequence[Placement]):
+    """Change placements (reference api.py:677) — XLA inserts the collective."""
+    # Partial -> Replicate needs an explicit reduction in eager single-controller mode
+    old = getattr(dist_tensor, "placements", None)
+    arr = dist_tensor._data
+    if old is not None and any(p.is_partial() for p in old):
+        # sum over the partial mesh axes: in SPMD global view the array already holds
+        # the partial contribution of each shard summed? No — partial only arises
+        # inside shard_map; at global view we materialize via psum there. Here it is
+        # a no-op annotation change.
+        pass
+    sharding = _named_sharding(mesh, placements, arr.ndim)
+    if isinstance(arr, jax.core.Tracer):
+        new = jax.lax.with_sharding_constraint(arr, sharding)
+    else:
+        new = jax.device_put(arr, sharding)
+    out = Tensor(new, stop_gradient=dist_tensor.stop_gradient)
+    out._node, out._out_idx = dist_tensor._node, dist_tensor._out_idx
+    out.process_mesh = mesh
+    out.placements = list(placements)
+    return out
+
+
+def shard_layer(layer: Layer, process_mesh: ProcessMesh, shard_fn=None, input_fn=None, output_fn=None) -> Layer:
+    """Shard a layer's parameters across a mesh (reference api.py:778)."""
+    if shard_fn is None:
+        def shard_fn(name, sublayer, mesh):
+            for pname, p in sublayer._parameters.items():
+                if p is not None:
+                    shard_tensor(p, mesh, [Replicate() for _ in mesh.dim_names])
+
+    for name, sub in layer.named_sublayers(include_self=True):
+        shard_fn(name, sub, process_mesh)
+    if input_fn is not None:
+        layer.register_forward_pre_hook(lambda l, inp: input_fn(inp, process_mesh))
+    if output_fn is not None:
+        layer.register_forward_post_hook(lambda l, inp, out: output_fn(out, process_mesh))
+    return layer
+
+
+def shard_optimizer(optimizer, shard_fn=None, gradient_accumulation_steps=1):
+    """ZeRO-style optimizer-state sharding (reference api.py:1486): accumulator
+    arrays inherit (or shard_fn overrides) the parameter's sharding; XLA keeps
+    update math local to each shard."""
+    orig_acc = optimizer._acc
+
+    def _acc(name, p, init=None, dtype=None):
+        arr = orig_acc(name, p, init, dtype)
+        sharding = getattr(p._data, "sharding", None)
+        if shard_fn is not None:
+            arr2 = shard_fn(name, p, Tensor(arr))
+            if arr2 is not None:
+                arr = arr2._data if isinstance(arr2, Tensor) else arr2
+                optimizer._accumulators[name][id(p)] = arr
+        elif sharding is not None and not isinstance(arr, jax.core.Tracer) and arr.ndim == p._data.ndim:
+            arr = jax.device_put(arr, sharding)
+            optimizer._accumulators[name][id(p)] = arr
+        return arr
+
+    optimizer._acc = _acc
+    return optimizer
+
+
+class ShardingStage1:
+    """Marker strategies matching reference paddle.distributed.ShardingStage* for
+    shard_optimizer(shard_fn=...)."""
+
+    def __init__(self, axis_name="dp", mesh=None):
+        self.axis_name = axis_name
+        self.mesh = mesh
+
+    def __call__(self, key, param, acc):
+        mesh = self.mesh or getattr(param, "process_mesh", None)
+        if mesh is None:
+            return acc
+        ndim = acc._data.ndim if isinstance(acc, Tensor) else acc.ndim
+        placements = [Shard(0) if n == self.axis_name and ndim > 0 else Replicate() for n in mesh.dim_names]
+        return shard_tensor(acc, mesh, placements)
+
+
+ShardingStage2 = ShardingStage1
+
+
+class ShardingStage3(ShardingStage1):
+    pass
+
+
+def unshard_dtensor(dist_tensor):
+    arr = dist_tensor._data
+    full_sharding = NamedSharding(
+        getattr(dist_tensor, "process_mesh").to_jax() if hasattr(dist_tensor, "process_mesh") else arr.sharding.mesh,
+        PartitionSpec(),
+    )
+    return Tensor(jax.device_put(arr, full_sharding))
+
+
+def get_mesh():
+    from .process_mesh import get_current_mesh
+
+    return get_current_mesh()
+
+
+def set_mesh(mesh):
+    from .process_mesh import _mesh_stack
+
+    _mesh_stack.clear()
+    _mesh_stack.append(mesh)
+
+
+# ---- distributed dataloader ----
+def shard_dataloader(dataloader, meshes, shard_dims=None, input_keys=None):
+    """Reference api.py:2990 — wrap a loader so each batch lands sharded on the mesh."""
+    mesh = meshes[0] if isinstance(meshes, (list, tuple)) else meshes
+    dim = shard_dims if isinstance(shard_dims, str) else (shard_dims[0] if shard_dims else None)
+
+    class _ShardedLoader:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def __iter__(self):
+            for batch in self._inner:
+                yield self._shard(batch)
+
+        def __len__(self):
+            return len(self._inner)
+
+        def _shard(self, item):
+            if isinstance(item, Tensor):
+                placements = [
+                    Shard(0) if (dim is None or n == dim) and item.ndim > 0 else Replicate()
+                    for n in mesh.dim_names
+                ]
+                if dim is not None:
+                    placements = [Shard(0) if n == dim else Replicate() for n in mesh.dim_names]
+                return shard_tensor(item, mesh, placements)
+            if isinstance(item, (list, tuple)):
+                return type(item)(self._shard(i) for i in item)
+            if isinstance(item, dict):
+                return {k: self._shard(v) for k, v in item.items()}
+            return item
+
+    return _ShardedLoader(dataloader)
+
+
+class Strategy:
+    """Reference: auto_parallel/strategy.py — config tree for to_static engine."""
+
+    class _Cfg:
+        def __init__(self, **kw):
+            self.__dict__.update(kw)
+
+    def __init__(self, config=None):
+        self.sharding = Strategy._Cfg(enable=False, degree=1, stage=1)
+        self.fused_passes = Strategy._Cfg(enable=False, fused_passes_list=[])
+        self.pipeline = Strategy._Cfg(enable=False, schedule_mode="1F1B", micro_batch_size=1, accumulate_steps=1)
+        self.amp = Strategy._Cfg(enable=False, dtype="bfloat16", level="O1")
+        self.recompute = Strategy._Cfg(enable=False)
+        if config:
+            for k, v in config.items():
+                if hasattr(self, k) and isinstance(v, dict):
+                    getattr(self, k).__dict__.update(v)
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None, input_spec=None):
+    """Reference api.py:2484 — returns a DistModel-style wrapper running the jitted step."""
+    from ...hapi.model import Model
+
+    m = Model(layer)
+    m.prepare(optimizer=optimizer, loss=loss, jit=True)
+
+    class DistModel:
+        def __init__(self):
+            self.network = layer
+            self._model = m
+            self._mode = "train"
+
+        def train(self):
+            self._mode = "train"
+            layer.train()
+
+        def eval(self):
+            self._mode = "eval"
+            layer.eval()
+
+        def __call__(self, *args):
+            if self._mode == "train":
+                inputs, labels = list(args[:-1]), [args[-1]]
+                losses, _ = self._model.train_batch(inputs, labels)
+                return Tensor(jnp.asarray(losses[0]))
+            return layer(*args)
+
+        def state_dict(self):
+            return layer.state_dict()
+
+    return DistModel()

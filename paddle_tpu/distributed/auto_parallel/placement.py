@@ -1,0 +1,103 @@
+"""Placements (reference: phi/core/distributed/auto_parallel/placement_types.h).
+
+Shard(d)/Replicate()/Partial() describe how a logical tensor maps onto mesh axes;
+they translate directly to a ``PartitionSpec``: the i-th placement names what the
+i-th MESH axis does (shard tensor dim d / replicate / hold partial sums).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+from jax.sharding import NamedSharding, PartitionSpec
+
+
+class Placement:
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicate(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+class Shard(Placement):
+    def __init__(self, dim: int):
+        self.dim = dim
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+    def get_dim(self):
+        return self.dim
+
+    def __eq__(self, o):
+        return isinstance(o, Shard) and o.dim == self.dim
+
+    def __hash__(self):
+        return hash(("shard", self.dim))
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+
+class Replicate(Placement):
+    def is_replicate(self):
+        return True
+
+    def __eq__(self, o):
+        return isinstance(o, Replicate)
+
+    def __hash__(self):
+        return hash("replicate")
+
+    def __repr__(self):
+        return "Replicate()"
+
+
+class Partial(Placement):
+    def __init__(self, reduce_type="sum"):
+        self.reduce_type = reduce_type
+
+    def is_partial(self):
+        return True
+
+    def __eq__(self, o):
+        return isinstance(o, Partial) and o.reduce_type == self.reduce_type
+
+    def __hash__(self):
+        return hash(("partial", self.reduce_type))
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+
+def placements_to_spec(placements: Sequence[Placement], mesh_dim_names: Sequence[str], ndim: int) -> PartitionSpec:
+    """Convert per-mesh-axis placements into a tensor-dim PartitionSpec."""
+    entries: List = [None] * ndim
+    for axis_name, p in zip(mesh_dim_names, placements):
+        if isinstance(p, Shard):
+            d = p.dim % ndim
+            if entries[d] is None:
+                entries[d] = axis_name
+            elif isinstance(entries[d], tuple):
+                entries[d] = entries[d] + (axis_name,)
+            else:
+                entries[d] = (entries[d], axis_name)
+    while entries and entries[-1] is None:
+        entries.pop()
+    return PartitionSpec(*entries)
+
+
+def spec_to_placements(spec: PartitionSpec, mesh_dim_names: Sequence[str], ndim: int) -> List[Placement]:
+    out: List[Placement] = [Replicate() for _ in mesh_dim_names]
+    entries = list(spec) if spec is not None else []
+    for tdim, e in enumerate(entries):
+        if e is None:
+            continue
+        axes = e if isinstance(e, tuple) else (e,)
+        for a in axes:
+            out[mesh_dim_names.index(a)] = Shard(tdim)
+    return out

@@ -1,0 +1,102 @@
+"""ProcessMesh (reference: phi/core/distributed/auto_parallel/process_mesh.h +
+python/paddle/distributed/auto_parallel/process_mesh.py).
+
+TPU-native: a ProcessMesh IS a ``jax.sharding.Mesh`` — an N-D array of devices with
+named axes. DistTensor placements map to ``PartitionSpec`` entries over those axes.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+
+
+class ProcessMesh:
+    def __init__(self, mesh=None, dim_names: Optional[Sequence[str]] = None, shape=None, process_ids=None):
+        if mesh is not None:
+            arr = np.asarray(mesh)
+        else:
+            arr = np.arange(int(np.prod(shape))).reshape(shape)
+        self._ids = arr
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        self._dim_names = list(dim_names)
+        self._jax_mesh = None
+
+    @property
+    def shape(self) -> List[int]:
+        return list(self._ids.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._ids.ndim
+
+    @property
+    def dim_names(self) -> List[str]:
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self) -> List[int]:
+        return self._ids.reshape(-1).tolist()
+
+    @property
+    def mesh(self):
+        return self._ids
+
+    def get_dim_size(self, name) -> int:
+        return self._ids.shape[self._dim_names.index(name)]
+
+    def get_mesh_with_dim(self, dim_name, index=None):
+        axis = self._dim_names.index(dim_name)
+        moved = np.moveaxis(self._ids, axis, 0)
+        names = [dim_name] + [n for n in self._dim_names if n != dim_name]
+        if index is not None:
+            return ProcessMesh(moved[index], names[1:])
+        return ProcessMesh(moved, names)
+
+    def to_jax(self) -> jax.sharding.Mesh:
+        """Materialize as a jax Mesh over real devices (cached)."""
+        if self._jax_mesh is None:
+            devices = jax.devices()
+            dev_map = {d.id: d for d in devices}
+            flat = self._ids.reshape(-1)
+            try:
+                dev_arr = np.array([dev_map[int(i)] for i in flat], dtype=object).reshape(self._ids.shape)
+            except KeyError:
+                # process ids beyond local devices (multi-host logical mesh): index order
+                dev_arr = np.array(devices[: flat.size], dtype=object).reshape(self._ids.shape)
+            self._jax_mesh = jax.sharding.Mesh(dev_arr, tuple(self._dim_names))
+        return self._jax_mesh
+
+    def __eq__(self, other):
+        return isinstance(other, ProcessMesh) and np.array_equal(self._ids, other._ids) and self._dim_names == other._dim_names
+
+    def __hash__(self):
+        return hash((self._ids.tobytes(), tuple(self._dim_names)))
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dim_names={self._dim_names})"
+
+    def __enter__(self):
+        _mesh_stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _mesh_stack.pop()
+        return False
+
+
+_mesh_stack: List[ProcessMesh] = []
+
+
+def get_current_mesh() -> Optional[ProcessMesh]:
+    return _mesh_stack[-1] if _mesh_stack else None
+
+
+def auto_mesh(dim_names: Sequence[str], shape: Sequence[int]) -> ProcessMesh:
+    """Build a mesh over all visible devices with the given logical shape."""
+    n = int(np.prod(shape))
+    assert n == jax.device_count(), f"mesh size {n} != device_count {jax.device_count()}"
+    return ProcessMesh(np.arange(n).reshape(shape), dim_names)

@@ -1,0 +1,2 @@
+from .functional import *  # noqa: F401,F403
+from .group import Group, ReduceOp, get_group, is_initialized, new_group  # noqa: F401

@@ -1,0 +1,101 @@
+"""Process groups over mesh axes.
+
+Reference: fluid/distributed/collective/process_group.h + python collective.py:195.
+TPU-native: a Group is a handle onto a named mesh axis (or a sub-mesh). Collectives
+on a Group lower to XLA collective HLOs (psum/all_gather/...) over ICI when traced
+under shard_map/pjit with that axis, and to shard_map-wrapped execution on global
+arrays in eager mode. There is no communicator bootstrap (no NCCL ids): XLA owns
+the fabric; TCPStore-style rendezvous exists only at jax.distributed init time.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import numpy as np
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    def __init__(self, ranks: List[int], gid: int = 0, axis_name: Optional[str] = None, mesh=None):
+        self.ranks = list(ranks)
+        self.id = gid
+        self.axis_name = axis_name or f"group_{gid}"
+        self.mesh = mesh
+        self.pg = self  # parity: group.process_group
+
+    @property
+    def nranks(self):
+        return len(self.ranks)
+
+    @property
+    def world_size(self):
+        return len(self.ranks)
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    @property
+    def rank(self):
+        from ..parallel import get_rank
+
+        return self.get_group_rank(get_rank())
+
+    def __repr__(self):
+        return f"Group(id={self.id}, axis={self.axis_name}, ranks={self.ranks})"
+
+
+_group_counter = [0]
+_groups = {}
+_default_group: Optional[Group] = None
+
+
+def _world_mesh():
+    """Lazily build the default 1-D world mesh over all devices."""
+    devs = jax.devices()
+    return jax.sharding.Mesh(np.array(devs), ("world",))
+
+
+def get_default_group() -> Group:
+    global _default_group
+    if _default_group is None:
+        n = jax.device_count()
+        _default_group = Group(list(range(n)), 0, axis_name="world", mesh=_world_mesh())
+        _groups[0] = _default_group
+    return _default_group
+
+
+def new_group(ranks=None, backend=None, timeout=None, axis_name=None, mesh=None) -> Group:
+    """Reference: collective.py:195. On TPU a group is a mesh-axis handle."""
+    _group_counter[0] += 1
+    gid = _group_counter[0]
+    if ranks is None:
+        ranks = list(range(jax.device_count()))
+    g = Group(sorted(ranks), gid, axis_name=axis_name, mesh=mesh)
+    _groups[gid] = g
+    return g
+
+
+def get_group(gid: int) -> Optional[Group]:
+    return _groups.get(gid)
+
+
+def destroy_process_group(group=None):
+    global _default_group
+    if group is None:
+        _groups.clear()
+        _default_group = None
+    else:
+        _groups.pop(group.id, None)
+
+
+def is_initialized():
+    return _default_group is not None

@@ -1,0 +1,11 @@
+"""paddle_tpu.distributed.fleet (reference: python/paddle/distributed/fleet)."""
+
+from .base.distributed_strategy import DistributedStrategy  # noqa: F401
+from .base.topology import CommunicateTopology, HybridCommunicateGroup, ParallelMode  # noqa: F401
+from .fleet import (  # noqa: F401
+    distributed_model,
+    distributed_optimizer,
+    get_hybrid_communicate_group,
+    init,
+)
+from .recompute import recompute, recompute_sequential  # noqa: F401

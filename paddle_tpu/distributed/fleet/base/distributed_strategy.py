@@ -1,0 +1,70 @@
+"""DistributedStrategy (reference: python/paddle/distributed/fleet/base/distributed_strategy.py,
+backed by fluid/framework/distributed_strategy.proto).
+
+Python-dict config tree instead of protobuf — same keys, TPU-relevant semantics.
+"""
+
+from __future__ import annotations
+
+import copy
+
+
+class DistributedStrategy:
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": 1,
+            "mp_degree": 1,
+            "pp_degree": 1,
+            "sharding_degree": 1,
+            "sep_degree": 1,
+            "order": ["dp", "pp", "sharding", "sep", "mp"],
+            "mp_configs": {},
+            "pp_configs": {},
+        }
+        self.sharding = False
+        self.sharding_configs = {
+            "sharding_degree": 1,
+            "stage": 1,
+            "offload": False,
+            "comm_overlap": True,
+        }
+        self.pipeline = False
+        self.pipeline_configs = {
+            "accumulate_steps": 1,
+            "micro_batch_size": 1,
+            "schedule_mode": "1F1B",
+            "enable_partial_send_recv": True,
+        }
+        self.amp = False
+        self.amp_configs = {
+            "init_loss_scaling": 32768.0,
+            "use_pure_fp16": False,
+            "use_bf16": True,
+            "custom_white_list": [],
+            "custom_black_list": [],
+        }
+        self.recompute = False
+        self.recompute_configs = {"checkpoints": []}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1, "avg": True}
+        self.lamb = False
+        self.lars = False
+        self.dgc = False
+        self.localsgd = False
+        self.heter_ccl_mode = False
+        self.find_unused_parameters = False
+        self.fuse_grad_size_in_MB = 32
+        self.nccl_comm_num = 1
+        self.gradient_scale_configs = {"scale_strategy": "avg"}
+
+    def __deepcopy__(self, memo):
+        new = DistributedStrategy()
+        for k, v in self.__dict__.items():
+            setattr(new, k, copy.deepcopy(v, memo))
+        return new
+
+    def __repr__(self):
+        lines = ["DistributedStrategy:"]
+        for k, v in self.__dict__.items():
+            lines.append(f"  {k}: {v}")
+        return "\n".join(lines)

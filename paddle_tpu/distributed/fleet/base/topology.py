@@ -1,0 +1,211 @@
+"""5-D hybrid topology (reference: python/paddle/distributed/fleet/base/topology.py:70,189).
+
+Axes order matches the reference: [data, pipe, sharding, sep, model]. TPU-native: the
+topology materializes as ONE jax Mesh with named axes; per-axis "comm groups" are
+Group handles bound to those axis names (collectives over them ride ICI). The
+reference's careful axis ordering (model innermost = fastest-varying ranks) maps to
+mesh axis order so that 'mp'/'sep' land on the innermost ICI torus dimension.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+from functools import reduce
+from typing import List
+
+import jax
+import numpy as np
+
+from ...communication.group import Group, new_group
+
+_HYBRID_PARALLEL_ORDER = ["data", "pipe", "sharding", "sep", "model"]
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=None, dims=None):
+        self._parallel_names = list(hybrid_group_names or _HYBRID_PARALLEL_ORDER)
+        self._dims = list(dims or [1] * len(self._parallel_names))
+        self.coordinate = collections.namedtuple("Coordinate", self._parallel_names)
+        self.world_size = int(np.prod(self._dims))
+        ranges = [range(d) for d in self._dims]
+        all_coords = [self.coordinate(*c) for c in itertools.product(*ranges)]
+        self._coord2rank = dict(zip(all_coords, range(len(all_coords))))
+        self._rank2coord = dict(zip(self._coord2rank.values(), self._coord2rank.keys()))
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def get_rank(self, **args):
+        assert len(args) == len(self._dims)
+        return self._coord2rank[self.coordinate(**args)]
+
+    def get_coord(self, rank):
+        return self._rank2coord[rank]
+
+    def get_axis_list(self, axis_name, index):
+        axis = self._parallel_names.index(axis_name)
+        return sorted(r for c, r in self._coord2rank.items() if c[axis] == index)
+
+    def get_comm_list(self, axis_name):
+        """All rank-groups along axis_name (reference topology.py get_comm_list)."""
+        axis = self._parallel_names.index(axis_name)
+        other_ranges = [range(d) for i, d in enumerate(self._dims) if i != axis]
+        comm_list = []
+        for other in itertools.product(*other_ranges):
+            ranks = []
+            for v in range(self._dims[axis]):
+                coord = list(other)
+                coord.insert(axis, v)
+                ranks.append(self._coord2rank[self.coordinate(*coord)])
+            comm_list.append(ranks)
+        return comm_list
+
+    def get_rank_from_stage(self, global_rank, **kwargs):
+        coord = self.get_coord(global_rank)
+        tf = coord._replace(**kwargs)._asdict()
+        return self.get_rank(**tf)
+
+
+class HybridCommunicateGroup:
+    """Reference: topology.py:189. Holds per-axis Group handles + the global Mesh."""
+
+    def __init__(self, topology: CommunicateTopology):
+        self._topo = topology
+        self.nranks = topology.world_size
+        self.global_rank = 0  # single-controller SPMD; multihost uses process_index
+        self._dp_degree = topology.get_dim("data")
+        self._pp_degree = topology.get_dim("pipe")
+        self._sharding_degree = topology.get_dim("sharding")
+        self._sep_degree = topology.get_dim("sep") if "sep" in topology.get_hybrid_group_names() else 1
+        self._mp_degree = topology.get_dim("model")
+
+        # one global mesh with named axes, in topology order
+        names = topology.get_hybrid_group_names()
+        dims = [topology.get_dim(n) for n in names]
+        self._axis_names = {"data": "dp", "pipe": "pp", "sharding": "sharding", "sep": "sep", "model": "mp"}
+        n_needed = int(np.prod(dims))
+        devs = jax.devices()
+        assert n_needed <= len(devs), f"topology needs {n_needed} devices, have {len(devs)}"
+        mesh_axes = tuple(self._axis_names[n] for n in names)
+        self.mesh = jax.sharding.Mesh(np.array(devs[:n_needed]).reshape(dims), mesh_axes)
+
+        def make_group(axis):
+            ranks = self._topo.get_comm_list(axis)[0]
+            return new_group(ranks, axis_name=self._axis_names[axis], mesh=self.mesh)
+
+        self._dp_group = make_group("data")
+        self._pp_group = make_group("pipe")
+        self._sharding_group = make_group("sharding")
+        self._sep_group = make_group("sep") if self._sep_degree > 1 or "sep" in names else None
+        self._mp_group = make_group("model")
+        # fused dp+sharding group (reference: dp_sharding fused axes)
+        self._dp_sharding_group = self._dp_group
+
+    # ---- degrees ----
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    # ---- ranks (single-controller: rank 0 views) ----
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    def get_sharding_parallel_rank(self):
+        return 0
+
+    def get_sep_parallel_rank(self):
+        return 0
+
+    # ---- groups ----
+    def get_data_parallel_group(self):
+        return self._dp_group
+
+    def get_model_parallel_group(self):
+        return self._mp_group
+
+    def get_pipe_parallel_group(self):
+        return self._pp_group
+
+    def get_sharding_parallel_group(self):
+        return self._sharding_group
+
+    def get_sep_parallel_group(self):
+        return self._sep_group
+
+    def get_check_parallel_group(self, sharding=False):
+        return self._mp_group
+
+    def get_data_parallel_group_src_rank(self):
+        return self._dp_group.ranks[0]
+
+    def get_model_parallel_group_src_rank(self):
+        return self._mp_group.ranks[0]
+
+    # ---- pipeline helpers ----
+    def is_first_stage(self):
+        return self.get_stage_id() == 0
+
+    def is_last_stage(self):
+        return self.get_stage_id() == self._pp_degree - 1
+
+    def get_p2p_groups(self):
+        return None
+
+    def topology(self):
+        return self._topo
+
+    def get_parallel_mode(self):
+        from . import topology as _t
+
+        if self._mp_degree == 1 and self._pp_degree == 1 and self._sharding_degree == 1 and self._dp_degree > 1:
+            return ParallelMode.DATA_PARALLEL
+        if self._sharding_degree > 1 and self._mp_degree == 1 and self._pp_degree == 1:
+            return ParallelMode.SHARDING_PARALLEL
+        if self._pp_degree > 1:
+            return ParallelMode.PIPELINE_PARALLEL
+        if self._sep_degree > 1:
+            return ParallelMode.SEGMENT_PARALLEL
+        return ParallelMode.TENSOR_PARALLEL
+
+
+class ParallelMode:
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+    SEGMENT_PARALLEL = 4
+
+
+_hcg: List[HybridCommunicateGroup] = []
+
+
+def set_hcg(hcg):
+    _hcg.clear()
+    _hcg.append(hcg)
+
+
+def get_hcg():
+    return _hcg[0] if _hcg else None

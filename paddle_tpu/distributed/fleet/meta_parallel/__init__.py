@@ -1,0 +1,14 @@
+"""meta_parallel engines (reference: fleet/meta_parallel)."""
+
+from .parallel_layers.mp_layers import (  # noqa: F401
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from .parallel_layers.pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc  # noqa: F401
+from .parallel_layers.random import RNGStatesTracker, get_rng_state_tracker, model_parallel_random_seed  # noqa: F401
+from .pipeline_parallel import PipelineParallel, gpipe_spmd  # noqa: F401
+from .segment_parallel import SegmentParallel  # noqa: F401
+from .sharding_parallel import ShardingParallel  # noqa: F401
+from .tensor_parallel import TensorParallel  # noqa: F401

@@ -1,0 +1,148 @@
+"""Pipeline layer description (reference: fleet/meta_parallel/parallel_layers/pp_layers.py —
+LayerDesc, SharedLayerDesc, PipelineLayer:257).
+
+TPU-native: the stage partition is a *logical* split. In single-controller SPMD all
+stages live in one program; the PipelineParallel engine (pipeline_parallel.py) builds
+a shard_map over the 'pp' mesh axis where each device executes only its stage's
+layers and activations move along ppermute edges.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, List, Optional
+
+from .....nn.layer.layers import Layer, LayerList
+
+
+class LayerDesc:
+    def __init__(self, layer_class, *inputs, **kwargs):
+        self.layer_class = layer_class
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_class, Layer):
+            raise TypeError("LayerDesc expects an nn.Layer subclass")
+
+    def build_layer(self):
+        return self.layer_class(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_class.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    def __init__(self, key, layer_class, forward_func=None, shared_weight_attr="weight", *inputs, **kwargs):
+        super().__init__(layer_class, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class SegmentLayers:
+    def __init__(self, layers_desc, num_parts, method="uniform", num_virtual_pipeline_stage=None):
+        self.layers_desc = layers_desc
+        self.num_parts = num_parts
+        self.method = method
+
+    def do_segment(self) -> List[int]:
+        n = len(self.layers_desc)
+        if self.method == "uniform":
+            base = n // self.num_parts
+            extra = n % self.num_parts
+            bounds = [0]
+            for i in range(self.num_parts):
+                bounds.append(bounds[-1] + base + (1 if i < extra else 0))
+            return bounds
+        if self.method.startswith("layer:"):
+            # put every layer whose class name matches evenly; others attach to stages
+            name = self.method.split(":", 1)[1]
+            marks = [i for i, d in enumerate(self.layers_desc)
+                     if (isinstance(d, LayerDesc) and d.layer_class.__name__ == name)]
+            per = len(marks) // self.num_parts
+            bounds = [0]
+            for i in range(1, self.num_parts):
+                bounds.append(marks[i * per])
+            bounds.append(len(self.layers_desc))
+            return bounds
+        raise ValueError(f"unknown segment method {self.method}")
+
+
+class PipelineLayer(Layer):
+    """Declarative stage-partitioned model (reference pp_layers.py:257)."""
+
+    def __init__(self, layers, num_stages=None, topology=None, loss_fn=None,
+                 seg_method="uniform", recompute_interval=0, num_virtual_pipeline_stages=None, **kwargs):
+        super().__init__()
+        self._layers_desc = list(layers)
+        self._loss_fn = loss_fn
+        self._recompute_interval = recompute_interval
+        from ...base.topology import get_hcg
+
+        hcg = get_hcg()
+        if num_stages is None and hcg is not None:
+            num_stages = hcg.get_pipe_parallel_world_size()
+        self._num_stages = num_stages or 1
+        seg = SegmentLayers(self._layers_desc, self._num_stages, seg_method)
+        self.segment_parts = seg.do_segment()
+        # single-controller: build ALL layers; the pipeline engine selects per stage
+        self.run_function: List = []
+        self._shared = {}
+        built = []
+        for i, d in enumerate(self._layers_desc):
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name in self._shared:
+                    base = self._shared[d.layer_name]
+                    fwd = d.forward_func
+                    layer = _SharedForward(base, fwd)
+                else:
+                    layer = d.build_layer()
+                    self._shared[d.layer_name] = layer
+                built.append(layer)
+            elif isinstance(d, LayerDesc):
+                built.append(d.build_layer())
+            elif isinstance(d, Layer):
+                built.append(d)
+            elif callable(d):
+                built.append(_FnLayer(d))
+            else:
+                raise TypeError(f"bad layer desc: {d}")
+        self.layers_holder = LayerList([l for l in built if isinstance(l, Layer)])
+        self.run_function = built
+
+    def get_stage_layers(self, stage_id: int):
+        lo, hi = self.segment_parts[stage_id], self.segment_parts[stage_id + 1]
+        return self.run_function[lo:hi]
+
+    def forward(self, x):
+        for fn in self.run_function:
+            x = fn(x)
+        return x
+
+    def loss(self, output, label):
+        return self._loss_fn(output, label) if self._loss_fn else output
+
+    @property
+    def parameters_in_stage(self):
+        return {s: [p for l in self.get_stage_layers(s) if isinstance(l, Layer) for p in l.parameters()]
+                for s in range(self._num_stages)}
+
+
+class _FnLayer(Layer):
+    def __init__(self, fn: Callable):
+        super().__init__()
+        self._fn = fn
+
+    def forward(self, *args, **kwargs):
+        return self._fn(*args, **kwargs)
+
+
+class _SharedForward(Layer):
+    def __init__(self, base: Layer, fwd: Optional[Callable]):
+        super().__init__()
+        self._base = base  # NOTE: registered as sublayer -> weights shared by identity
+        self._fwd = fwd
+
+    def forward(self, x):
+        if self._fwd is not None:
+            return self._fwd(self._base, x)
+        return self._base(x)

@@ -1,0 +1,58 @@
+"""TP-aware RNG (reference: fleet/layers/mpu/random.py:34 RNGStatesTracker).
+
+On TPU, per-mesh-axis decorrelated randomness is achieved by folding the mesh
+coordinates into the PRNG key rather than tracking per-rank cuRAND states.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+from .....framework.random import get_rng_state, rng_guard
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_ = {}
+
+    def reset(self):
+        self.states_.clear()
+
+    def add(self, name, seed):
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.states_[name] = jax.random.key(seed)
+
+    @contextlib.contextmanager
+    def rng_state(self, name=MODEL_PARALLEL_RNG):
+        if name not in self.states_:
+            # derive deterministically from the global key + name hash
+            self.states_[name] = jax.random.fold_in(get_rng_state(), abs(hash(name)) % (2**31))
+        key = self.states_[name]
+        k1, k2 = jax.random.split(key)
+        self.states_[name] = k1
+        with rng_guard(k2):
+            yield
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _tracker
+
+
+def model_parallel_random_seed(seed=None):
+    import numpy as np
+
+    from ...base.topology import get_hcg
+
+    hcg = get_hcg()
+    mp_rank = hcg.get_model_parallel_rank() if hcg else 0
+    base = seed if seed is not None else np.random.randint(0, 2**20)
+    _tracker.reset()
+    _tracker.add(MODEL_PARALLEL_RNG, base + 1024 + mp_rank)

@@ -1,0 +1,25 @@
+"""SegmentParallel / SEP engine (reference: fleet/meta_parallel/segment_parallel.py:26).
+
+Ulysses-class sequence sharding: activations sharded over the 'sep' mesh axis on the
+sequence dim; attention does head<->sequence all_to_all (see
+fleet/utils/sequence_parallel_utils.sep_all_to_all). Param broadcast across sep is
+moot in single-controller SPMD.
+"""
+
+from ....nn.layer.layers import Layer
+
+
+class SegmentParallel(Layer):
+    def __init__(self, layers, hcg, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, sd, *args, **kwargs):
+        return self._layers.set_state_dict(sd, *args, **kwargs)

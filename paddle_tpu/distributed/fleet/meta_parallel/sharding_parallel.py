@@ -1,0 +1,24 @@
+"""ShardingParallel wrapper (reference: fleet/meta_parallel/sharding_parallel.py).
+
+ZeRO sharding on TPU = parameter/grad/opt-state NamedSharding over the 'sharding'
+mesh axis (see meta_optimizers.dygraph_optimizer.DygraphShardingOptimizer); the
+model wrapper itself is pass-through.
+"""
+
+from ....nn.layer.layers import Layer
+
+
+class ShardingParallel(Layer):
+    def __init__(self, layers, hcg, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, sd, *args, **kwargs):
+        return self._layers.set_state_dict(sd, *args, **kwargs)

@@ -1,0 +1,23 @@
+"""TensorParallel wrapper (reference: fleet/meta_parallel/tensor_parallel.py).
+
+Single-controller SPMD: parameter "broadcast within mp group" is moot (one copy of
+the global array); the wrapper's job is sharding-layout sanity + input broadcast.
+"""
+
+from ....nn.layer.layers import Layer
+
+
+class TensorParallel(Layer):
+    def __init__(self, layers, hcg, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, sd, *args, **kwargs):
+        return self._layers.set_state_dict(sd, *args, **kwargs)

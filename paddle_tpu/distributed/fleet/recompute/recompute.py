@@ -1,0 +1,58 @@
+"""Activation recompute (reference: fleet/recompute/recompute.py:455).
+
+TPU-native: ``jax.checkpoint`` (remat) IS activation checkpointing — the backward
+pass recomputes the segment instead of storing activations, trading FLOPs for HBM.
+In eager-tape mode we wrap the segment so the recorded vjp closure holds only the
+segment *inputs* (not its internals): jax.vjp over jax.checkpoint(fn).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+
+from ....core.op_registry import apply_fn
+from ....core.tensor import Tensor
+
+
+def recompute(function: Callable, *args, **kwargs):
+    """Run function now; recompute it during backward (reference recompute():455)."""
+    use_reentrant = kwargs.pop("use_reentrant", True)
+    preserve_rng_state = kwargs.pop("preserve_rng_state", True)
+
+    static_kwargs = {k: v for k, v in kwargs.items() if not isinstance(v, Tensor)}
+
+    fn = function.forward if hasattr(function, "forward") and not callable(function) else function
+
+    def pure(*arrs):
+        wrapped = [Tensor(a) for a in arrs]
+        from ....core import autograd_engine
+
+        with autograd_engine.no_grad():
+            out = fn(*wrapped, **static_kwargs)
+        if isinstance(out, (tuple, list)):
+            return tuple(o._data if isinstance(o, Tensor) else o for o in out)
+        return out._data if isinstance(out, Tensor) else out
+
+    ckpt = jax.checkpoint(pure)
+    return apply_fn("recompute", ckpt, *args)
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """Reference: recompute_sequential — chunk a Sequential into recomputed segments."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    layers = list(functions)
+    n = len(layers)
+    per = max(n // segments, 1)
+    out = args[0] if len(args) == 1 else args
+    for i in range(0, n, per):
+        seg = layers[i:i + per]
+
+        def seg_fn(x, _seg=seg):
+            for l in _seg:
+                x = l(x)
+            return x
+
+        out = recompute(seg_fn, out)
+    return out

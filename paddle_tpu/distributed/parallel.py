@@ -1,0 +1,142 @@
+"""Process bootstrap + DataParallel (reference: python/paddle/distributed/parallel.py).
+
+TPU-native bootstrap: ``init_parallel_env`` maps to ``jax.distributed.initialize``
+(coordination service = the TCPStore analogue, phi/core/distributed/store/tcp_store.h);
+single-host SPMD needs no bootstrap at all — all local chips are visible to one
+controller and collectives ride ICI via XLA.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from .communication.group import Group, get_default_group, new_group
+
+_parallel_env_initialized = [False]
+
+
+class ParallelEnv:
+    """Reference: parallel.py ParallelEnv — env-var view of the launch contract."""
+
+    @property
+    def rank(self):
+        return int(os.environ.get("PADDLE_TRAINER_ID", jax.process_index()))
+
+    @property
+    def world_size(self):
+        return int(os.environ.get("PADDLE_TRAINERS_NUM", jax.process_count()))
+
+    @property
+    def local_rank(self):
+        return int(os.environ.get("PADDLE_RANK_IN_NODE", 0))
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:0")
+
+    @property
+    def trainer_endpoints(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else []
+
+
+def init_parallel_env():
+    """Initialize multi-host JAX if the launch env asks for it; idempotent."""
+    if _parallel_env_initialized[0]:
+        return get_default_group()
+    env = ParallelEnv()
+    coord = os.environ.get("MASTER_ADDR"), os.environ.get("MASTER_PORT")
+    if env.world_size > 1 and jax.process_count() == 1 and all(coord):
+        jax.distributed.initialize(
+            coordinator_address=f"{coord[0]}:{coord[1]}",
+            num_processes=env.world_size,
+            process_id=env.rank,
+        )
+    _parallel_env_initialized[0] = True
+    return get_default_group()
+
+
+def get_rank(group: Optional[Group] = None) -> int:
+    """Controller rank (multi-host) — in single-controller SPMD there is one process."""
+    if group is not None:
+        return group.get_group_rank(jax.process_index())
+    return jax.process_index()
+
+
+def get_world_size(group: Optional[Group] = None) -> int:
+    if group is not None:
+        return group.nranks
+    return jax.process_count() if jax.process_count() > 1 else 1
+
+
+def is_initialized() -> bool:
+    return _parallel_env_initialized[0]
+
+
+class DataParallel(Layer):
+    """Reference: parallel.py:219. TPU-native DP = shard the batch over a mesh axis
+    and let GSPMD insert the gradient all-reduce — the EagerReducer's bucketing +
+    overlapped NCCL allreduce (collective/reducer.h:88) is subsumed by the XLA
+    latency-hiding scheduler, which overlaps the reduce-scatter/all-gather with
+    backward compute automatically."""
+
+    def __init__(self, layers, strategy=None, comm_buffer_size=25, last_comm_buffer_size=1,
+                 find_unused_parameters=False, group=None):
+        super().__init__()
+        self._layers = layers
+        self.group = group
+        self.find_unused_parameters = find_unused_parameters
+        n = jax.device_count()
+        if n > 1:
+            mesh = jax.sharding.Mesh(np.array(jax.devices()), ("dp",))
+            self._dp_sharding = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec("dp"))
+            self._rep_sharding = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+            # params replicated across dp
+            for p in layers.parameters():
+                if not isinstance(p._data, jax.core.Tracer):
+                    p._data = jax.device_put(p._data, self._rep_sharding)
+        else:
+            self._dp_sharding = None
+
+    def forward(self, *inputs, **kwargs):
+        if self._dp_sharding is not None:
+            new_inputs = []
+            for x in inputs:
+                if isinstance(x, Tensor) and x.ndim > 0 and x.shape[0] % jax.device_count() == 0 \
+                        and not isinstance(x._data, jax.core.Tracer):
+                    x = Tensor(jax.device_put(x._data, self._dp_sharding), stop_gradient=x.stop_gradient)
+                new_inputs.append(x)
+            inputs = tuple(new_inputs)
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    @classmethod
+    def no_sync(cls):
+        import contextlib
+
+        return contextlib.nullcontext()
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """Reference: spawn.py:463. On TPU SPMD one controller drives all local chips, so
+    spawn degenerates to a direct call (multi-host uses the launch CLI instead)."""
+    func(*args)
+    return None

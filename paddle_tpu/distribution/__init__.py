@@ -1,0 +1,293 @@
+"""paddle_tpu.distribution (reference: python/paddle/distribution) — core distributions."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, unwrap
+from ..framework.random import next_key
+
+
+def _t(x):
+    return Tensor(x) if not isinstance(x, Tensor) else x
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return list(self._batch_shape)
+
+    @property
+    def event_shape(self):
+        return list(self._event_shape)
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return _t(jnp.exp(unwrap(self.log_prob(value))))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = jnp.asarray(unwrap(loc), jnp.float32)
+        self.scale = jnp.asarray(unwrap(scale), jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return _t(jnp.broadcast_to(self.loc, self._batch_shape))
+
+    @property
+    def variance(self):
+        return _t(jnp.broadcast_to(self.scale**2, self._batch_shape))
+
+    @property
+    def stddev(self):
+        return _t(jnp.broadcast_to(self.scale, self._batch_shape))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        return _t(self.loc + self.scale * jax.random.normal(next_key(), shp))
+
+    def log_prob(self, value):
+        v = unwrap(value)
+        var = self.scale**2
+        return _t(-((v - self.loc) ** 2) / (2 * var) - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return _t(jnp.broadcast_to(0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale), self._batch_shape))
+
+    def cdf(self, value):
+        v = unwrap(value)
+        return _t(0.5 * (1 + jax.scipy.special.erf((v - self.loc) / (self.scale * math.sqrt(2)))))
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = jnp.asarray(unwrap(low), jnp.float32)
+        self.high = jnp.asarray(unwrap(high), jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.low.shape, self.high.shape))
+
+    @property
+    def mean(self):
+        return _t((self.low + self.high) / 2)
+
+    @property
+    def variance(self):
+        return _t((self.high - self.low) ** 2 / 12)
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        return _t(jax.random.uniform(next_key(), shp) * (self.high - self.low) + self.low)
+
+    def log_prob(self, value):
+        v = unwrap(value)
+        inside = (v >= self.low) & (v < self.high)
+        return _t(jnp.where(inside, -jnp.log(self.high - self.low), -jnp.inf))
+
+    def entropy(self):
+        return _t(jnp.log(self.high - self.low))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = jnp.asarray(unwrap(logits), jnp.float32)
+        super().__init__(self.logits.shape[:-1])
+
+    @property
+    def probs(self):
+        return _t(jax.nn.softmax(self.logits, -1))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        return _t(jax.random.categorical(next_key(), self.logits, shape=shp))
+
+    def log_prob(self, value):
+        lp = jax.nn.log_softmax(self.logits, -1)
+        v = unwrap(value).astype(jnp.int32)
+        return _t(jnp.take_along_axis(lp, v[..., None], -1)[..., 0])
+
+    def entropy(self):
+        lp = jax.nn.log_softmax(self.logits, -1)
+        return _t(-(jnp.exp(lp) * lp).sum(-1))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_arr = jnp.asarray(unwrap(probs), jnp.float32)
+        super().__init__(self.probs_arr.shape)
+
+    @property
+    def mean(self):
+        return _t(self.probs_arr)
+
+    @property
+    def variance(self):
+        return _t(self.probs_arr * (1 - self.probs_arr))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        return _t(jax.random.bernoulli(next_key(), self.probs_arr, shp).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = unwrap(value)
+        p = self.probs_arr
+        return _t(v * jnp.log(jnp.maximum(p, 1e-12)) + (1 - v) * jnp.log(jnp.maximum(1 - p, 1e-12)))
+
+    def entropy(self):
+        p = self.probs_arr
+        return _t(-(p * jnp.log(jnp.maximum(p, 1e-12)) + (1 - p) * jnp.log(jnp.maximum(1 - p, 1e-12))))
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = jnp.asarray(unwrap(rate), jnp.float32)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return _t(1.0 / self.rate)
+
+    @property
+    def variance(self):
+        return _t(self.rate**-2)
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        return _t(jax.random.exponential(next_key(), shp) / self.rate)
+
+    def log_prob(self, value):
+        v = unwrap(value)
+        return _t(jnp.log(self.rate) - self.rate * v)
+
+    def entropy(self):
+        return _t(1 - jnp.log(self.rate))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = jnp.asarray(unwrap(alpha), jnp.float32)
+        self.beta = jnp.asarray(unwrap(beta), jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape, self.beta.shape))
+
+    @property
+    def mean(self):
+        return _t(self.alpha / (self.alpha + self.beta))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        return _t(jax.random.beta(next_key(), self.alpha, self.beta, shp))
+
+    def log_prob(self, value):
+        from jax.scipy.special import betaln
+
+        v = unwrap(value)
+        return _t((self.alpha - 1) * jnp.log(v) + (self.beta - 1) * jnp.log1p(-v) - betaln(self.alpha, self.beta))
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = jnp.asarray(unwrap(concentration), jnp.float32)
+        self.rate = jnp.asarray(unwrap(rate), jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape, self.rate.shape))
+
+    @property
+    def mean(self):
+        return _t(self.concentration / self.rate)
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        return _t(jax.random.gamma(next_key(), self.concentration, shp) / self.rate)
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+
+        v = unwrap(value)
+        a, r = self.concentration, self.rate
+        return _t(a * jnp.log(r) + (a - 1) * jnp.log(v) - r * v - gammaln(a))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = jnp.asarray(unwrap(concentration), jnp.float32)
+        super().__init__(self.concentration.shape[:-1], self.concentration.shape[-1:])
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        return _t(jax.random.dirichlet(next_key(), self.concentration, shp))
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+
+        v = unwrap(value)
+        a = self.concentration
+        return _t(((a - 1) * jnp.log(v)).sum(-1) + gammaln(a.sum(-1)) - gammaln(a).sum(-1))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = total_count
+        self.probs_arr = jnp.asarray(unwrap(probs), jnp.float32)
+        super().__init__(self.probs_arr.shape[:-1], self.probs_arr.shape[-1:])
+
+    def sample(self, shape=()):
+        n = self.probs_arr.shape[-1]
+        draws = jax.random.categorical(
+            next_key(), jnp.log(self.probs_arr), shape=tuple(shape) + (self.total_count,) + self._batch_shape
+        )
+        return _t(jax.nn.one_hot(draws, n).sum(axis=len(shape)))
+
+
+def kl_divergence(p, q):
+    if isinstance(p, Normal) and isinstance(q, Normal):
+        var_ratio = (p.scale / q.scale) ** 2
+        t1 = ((p.loc - q.loc) / q.scale) ** 2
+        return _t(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+    if isinstance(p, Categorical) and isinstance(q, Categorical):
+        lp = jax.nn.log_softmax(p.logits, -1)
+        lq = jax.nn.log_softmax(q.logits, -1)
+        return _t((jnp.exp(lp) * (lp - lq)).sum(-1))
+    if isinstance(p, Uniform) and isinstance(q, Uniform):
+        return _t(jnp.log((q.high - q.low) / (p.high - p.low)))
+    raise NotImplementedError(f"kl_divergence({type(p).__name__}, {type(q).__name__})")
+
+
+class TransformedDistribution(Distribution):
+    def __init__(self, base, transforms):
+        self.base = base
+        self.transforms = transforms if isinstance(transforms, (list, tuple)) else [transforms]
+        super().__init__(base._batch_shape, base._event_shape)
+
+    def sample(self, shape=()):
+        x = unwrap(self.base.sample(shape))
+        for t in self.transforms:
+            x = t.forward(x)
+        return _t(x)

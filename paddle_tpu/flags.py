@@ -1,0 +1,81 @@
+"""Global flag registry.
+
+TPU-native analogue of the reference's flag system
+(paddle/common/flags.cc:31 ``PHI_DEFINE_EXPORTED_*`` + python/paddle/base/framework.py:132
+``set_flags``): a single process-wide registry, env-overridable via ``FLAGS_<name>``,
+settable at runtime from Python.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict
+
+_REGISTRY: Dict[str, "_Flag"] = {}
+
+
+class _Flag:
+    __slots__ = ("name", "default", "value", "doc", "parser")
+
+    def __init__(self, name: str, default: Any, doc: str, parser: Callable[[str], Any]):
+        self.name = name
+        self.default = default
+        self.doc = doc
+        self.parser = parser
+        env = os.environ.get("FLAGS_" + name)
+        self.value = parser(env) if env is not None else default
+
+
+def _parse_bool(s: str) -> bool:
+    return str(s).strip().lower() in ("1", "true", "yes", "on")
+
+
+def define_flag(name: str, default: Any, doc: str = "") -> None:
+    if name in _REGISTRY:
+        return
+    if isinstance(default, bool):
+        parser: Callable[[str], Any] = _parse_bool
+    elif isinstance(default, int):
+        parser = int
+    elif isinstance(default, float):
+        parser = float
+    else:
+        parser = str
+    _REGISTRY[name] = _Flag(name, default, doc, parser)
+
+
+def get_flags(names=None) -> Dict[str, Any]:
+    """Return current flag values (all flags, or the requested subset)."""
+    if names is None:
+        return {k: f.value for k, f in _REGISTRY.items()}
+    if isinstance(names, str):
+        names = [names]
+    return {n: _REGISTRY[n].value for n in names}
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    """Set flags at runtime, mirroring ``paddle.set_flags``."""
+    for name, value in flags.items():
+        name = name[len("FLAGS_"):] if name.startswith("FLAGS_") else name
+        if name not in _REGISTRY:
+            define_flag(name, value)
+        else:
+            f = _REGISTRY[name]
+            f.value = f.parser(value) if isinstance(value, str) and not isinstance(f.default, str) else value
+
+
+def get_flag(name: str) -> Any:
+    return _REGISTRY[name].value
+
+
+# ---- core flags (subset of paddle/common/flags.cc relevant to the TPU build) ----
+define_flag("check_nan_inf", False, "Check every op output for NaN/Inf in eager mode.")
+define_flag("check_nan_inf_level", 0, "0: raise on nan/inf; >=1: warn only.")
+define_flag("low_precision_op_list", 0, "Collect ops executed in low precision under AMP.")
+define_flag("use_pallas_attention", True, "Use the Pallas flash-attention kernel when on TPU.")
+define_flag("eager_delete_tensor_gb", 0.0, "Kept for API parity; XLA owns memory on TPU.")
+define_flag("benchmark", False, "Synchronize after each op (eager) for timing.")
+define_flag("paddle_tpu_log_level", 0, "Framework verbose log level (VLOG analogue).")
+define_flag("cudnn_deterministic", False, "Parity alias: request deterministic XLA reductions.")
+define_flag("embedding_deterministic", 0, "Parity alias for deterministic embedding grads.")
+define_flag("use_autotune", True, "Let XLA autotune (latency-hiding scheduler etc.).")

@@ -1,0 +1,26 @@
+"""paddle_tpu.framework — ParamAttr, RNG state, save/load (reference: python/paddle/framework)."""
+
+from __future__ import annotations
+
+from .io import load, save  # noqa: F401
+from .random import get_rng_state, next_key, rng_guard, seed, set_rng_state  # noqa: F401
+
+
+class ParamAttr:
+    """Reference: python/paddle/base/param_attr.py — parameter configuration bundle."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0, regularizer=None,
+                 trainable=True, do_model_average=True, need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.do_model_average = do_model_average
+        self.need_clip = need_clip
+
+
+def set_grad_enabled(mode):
+    from ..core.autograd_engine import set_grad_enabled as _s
+
+    return _s(mode)

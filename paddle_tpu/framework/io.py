@@ -1,0 +1,59 @@
+"""paddle.save / paddle.load (reference: python/paddle/framework/io.py:773,1020).
+
+Serialization format: pickle of a pytree where every Tensor is replaced by a numpy
+array (host transfer) — compatible across devices and loadable without TPU access.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def _to_saveable(obj: Any):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(np.asarray(obj._data), str(np.dtype(obj.dtype)))
+    if isinstance(obj, dict):
+        return {k: _to_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_to_saveable(v) for v in obj)
+    return obj
+
+
+class _TensorPayload:
+    def __init__(self, array, dtype):
+        self.array = array
+        self.dtype = dtype
+
+
+def _from_saveable(obj: Any, return_numpy=False):
+    if isinstance(obj, _TensorPayload):
+        if return_numpy:
+            return obj.array
+        return Tensor(obj.array, dtype=obj.dtype)
+    if isinstance(obj, dict):
+        return {k: _from_saveable(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_from_saveable(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _from_saveable(obj, return_numpy)

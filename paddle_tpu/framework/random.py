@@ -1,0 +1,62 @@
+"""Global RNG state bridging Paddle's implicit-seed model onto JAX PRNG keys.
+
+Reference: python/paddle/framework/random.py (global generator seeded by
+``paddle.seed``). TPU-native: a process-global PRNG key that random ops split from.
+Inside traced code (jit / shard_map), an explicit key context should be pushed with
+``rng_guard(key)`` so randomness is a function of traced inputs, not trace-time state
+— this is what the Trainer/DataLoader integration does per step.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+
+_state = threading.local()
+_global = {"key": jax.random.key(0), "seed": 0}
+
+
+def seed(s: int):
+    """Set the global RNG seed (paddle.seed)."""
+    _global["key"] = jax.random.key(int(s))
+    _global["seed"] = int(s)
+    return _global["seed"]
+
+
+def get_rng_state():
+    return _global["key"]
+
+
+def set_rng_state(key):
+    _global["key"] = key
+
+
+def _ctx_stack():
+    if not hasattr(_state, "stack"):
+        _state.stack = []
+    return _state.stack
+
+
+@contextlib.contextmanager
+def rng_guard(key):
+    """Push an explicit PRNG key; random ops inside split from it deterministically."""
+    stack = _ctx_stack()
+    stack.append({"key": key, "count": 0})
+    try:
+        yield
+    finally:
+        stack.pop()
+
+
+def next_key():
+    """Produce a fresh PRNG key (splitting the active context or the global state)."""
+    stack = _ctx_stack()
+    if stack:
+        top = stack[-1]
+        top["count"] += 1
+        return jax.random.fold_in(top["key"], top["count"])
+    k1, k2 = jax.random.split(_global["key"])
+    _global["key"] = k1
+    return k2

@@ -1,0 +1,5 @@
+"""paddle_tpu.hapi (reference: python/paddle/hapi)."""
+
+from . import callbacks  # noqa: F401
+from .model import Model  # noqa: F401
+from .summary import summary  # noqa: F401

@@ -1,0 +1,207 @@
+"""hapi callbacks (reference: python/paddle/hapi/callbacks.py)."""
+
+from __future__ import annotations
+
+import numbers
+import time
+
+
+class Callback:
+    def __init__(self):
+        self.model = None
+        self.params = {}
+
+    def set_params(self, params):
+        self.params = params or {}
+
+    def set_model(self, model):
+        self.model = model
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_eval_begin(self, logs=None):
+        pass
+
+    def on_eval_end(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_train_batch_begin(self, step, logs=None):
+        pass
+
+    def on_train_batch_end(self, step, logs=None):
+        pass
+
+    def on_eval_batch_begin(self, step, logs=None):
+        pass
+
+    def on_eval_batch_end(self, step, logs=None):
+        pass
+
+
+class CallbackList:
+    def __init__(self, callbacks=None, model=None, verbose=2, metrics=None, log_freq=10):
+        cbs = list(callbacks) if callbacks else []
+        if verbose and not any(isinstance(c, ProgBarLogger) for c in cbs):
+            cbs.insert(0, ProgBarLogger(log_freq, verbose=verbose))
+        self.callbacks = cbs
+        for c in self.callbacks:
+            c.set_model(model)
+            c.set_params({"verbose": verbose, "metrics": metrics or []})
+
+    def on_begin(self, mode, logs=None):
+        for c in self.callbacks:
+            getattr(c, f"on_{mode}_begin")(logs)
+
+    def on_end(self, mode, logs=None):
+        for c in self.callbacks:
+            getattr(c, f"on_{mode}_end")(logs)
+
+    def on_epoch_begin(self, epoch, logs=None):
+        for c in self.callbacks:
+            c.on_epoch_begin(epoch, logs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        for c in self.callbacks:
+            c.on_epoch_end(epoch, logs)
+
+    def on_batch_begin(self, mode, step, logs=None):
+        for c in self.callbacks:
+            getattr(c, f"on_{mode}_batch_begin")(step, logs)
+
+    def on_batch_end(self, mode, step, logs=None):
+        for c in self.callbacks:
+            getattr(c, f"on_{mode}_batch_end")(step, logs)
+
+
+class ProgBarLogger(Callback):
+    def __init__(self, log_freq=1, verbose=2):
+        super().__init__()
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.epoch = epoch
+        self.steps = (logs or {}).get("steps")
+        self._start = time.time()
+        if self.verbose:
+            print(f"Epoch {epoch + 1}")
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.verbose and step % self.log_freq == 0:
+            msg = " - ".join(
+                f"{k}: {v:.4f}" if isinstance(v, numbers.Number) else f"{k}: {v}"
+                for k, v in (logs or {}).items()
+            )
+            total = f"/{self.steps}" if self.steps else ""
+            print(f"step {step}{total} - {msg}")
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            dt = time.time() - self._start
+            msg = " - ".join(
+                f"{k}: {v:.4f}" if isinstance(v, numbers.Number) else f"{k}: {v}"
+                for k, v in (logs or {}).items()
+            )
+            print(f"Epoch {epoch + 1} done in {dt:.1f}s - {msg}")
+
+
+class ModelCheckpoint(Callback):
+    def __init__(self, save_freq=1, save_dir=None):
+        super().__init__()
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.save_dir and (epoch + 1) % self.save_freq == 0:
+            self.model.save(f"{self.save_dir}/{epoch}")
+
+    def on_train_end(self, logs=None):
+        if self.save_dir:
+            self.model.save(f"{self.save_dir}/final")
+
+
+class EarlyStopping(Callback):
+    def __init__(self, monitor="loss", mode="auto", patience=0, verbose=1,
+                 min_delta=0, baseline=None, save_best_model=True):
+        super().__init__()
+        self.monitor = monitor
+        self.patience = patience
+        self.min_delta = abs(min_delta)
+        self.baseline = baseline
+        self.save_best_model = save_best_model
+        if mode == "auto":
+            mode = "max" if "acc" in monitor else "min"
+        self.mode = mode
+        self.best = None
+        self.wait = 0
+
+    def _better(self, cur):
+        if self.best is None:
+            return True
+        if self.mode == "min":
+            return cur < self.best - self.min_delta
+        return cur > self.best + self.min_delta
+
+    def on_epoch_end(self, epoch, logs=None):
+        cur = (logs or {}).get(self.monitor)
+        if cur is None:
+            return
+        if isinstance(cur, (list, tuple)):
+            cur = cur[0]
+        if self._better(cur):
+            self.best = cur
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.model.stop_training = True
+
+
+class LRScheduler(Callback):
+    def __init__(self, by_step=True, by_epoch=False):
+        super().__init__()
+        self.by_step = by_step
+        self.by_epoch = by_epoch
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.by_step:
+            self.model._optimizer._lr_step()
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.by_epoch:
+            self.model._optimizer._lr_step()
+
+
+class VisualDL(Callback):
+    """Reference logs to VisualDL; here: CSV/JSONL scalars for TensorBoard-free envs."""
+
+    def __init__(self, log_dir="./vdl_log"):
+        super().__init__()
+        self.log_dir = log_dir
+        self._f = None
+
+    def on_train_begin(self, logs=None):
+        import os
+
+        os.makedirs(self.log_dir, exist_ok=True)
+        self._f = open(f"{self.log_dir}/scalars.jsonl", "a")
+
+    def on_train_batch_end(self, step, logs=None):
+        import json
+
+        if self._f:
+            self._f.write(json.dumps({"step": step, **{k: float(v) if isinstance(v, numbers.Number) else str(v) for k, v in (logs or {}).items()}}) + "\n")
+
+    def on_train_end(self, logs=None):
+        if self._f:
+            self._f.close()

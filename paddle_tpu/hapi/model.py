@@ -1,0 +1,316 @@
+"""hapi Model — Keras-style fit/evaluate/predict (reference: python/paddle/hapi/model.py:1082).
+
+TPU-native: ``prepare(jit=True)`` (default) compiles the whole train step — forward,
+loss, backward, optimizer update — into ONE XLA executable over the parameter pytree
+(functionalized via paddle_tpu.jit), with buffer donation on params/opt-state. This is
+the redesign of the reference's dygraph train loop + _ExecutorCache static path.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import autograd_engine
+from ..core.tensor import Tensor
+from ..framework.random import next_key, rng_guard
+from ..jit.api import _collect_state, _Swap
+from ..metric import Metric
+from ..nn.layer.layers import Layer
+from .callbacks import CallbackList, ProgBarLogger
+
+
+class Model:
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self._jit = True
+        self._train_step_fn = None
+        self._eval_fn = None
+        self.stop_training = False
+
+    # ---- configuration ----
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None, jit=True):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is not None:
+            self._metrics = metrics if isinstance(metrics, (list, tuple)) else [metrics]
+        self._jit = jit
+        self._train_step_fn = None
+        self._eval_fn = None
+
+    # ---- jitted train step ----
+    def _build_train_step(self):
+        layer = self.network
+        loss_fn = self._loss
+        opt = self._optimizer
+        names, tensors = _collect_state(layer)
+        param_mask = [n.startswith("P:") and getattr(t, "trainable", True) and not t.stop_gradient
+                      for n, t in zip(names, tensors)]
+
+        def forward_loss(state_arrays, x_arrays, y_arrays, key):
+            with autograd_engine.no_grad(), _Swap(tensors, state_arrays), rng_guard(key):
+                xs = [Tensor(a) for a in x_arrays]
+                ys = [Tensor(a) for a in y_arrays]
+                out = layer(*xs)
+                outs = out if isinstance(out, (list, tuple)) else [out]
+                loss = loss_fn(*outs, *ys)
+                if isinstance(loss, (list, tuple)):
+                    loss = loss[0]
+                preds = [o._data for o in outs]
+                # buffer updates staged during the traced forward (e.g. BN stats)
+                buf_updates = {}
+                for i, t in enumerate(tensors):
+                    upd = t.__dict__.pop("_pending_update", None)
+                    if upd is not None:
+                        buf_updates[i] = upd
+                return loss._data, (preds, buf_updates)
+
+        grad_fn = jax.value_and_grad(forward_loss, argnums=0, has_aux=True)
+        clip = opt._grad_clip
+
+        def train_step(state_arrays, opt_state, x_arrays, y_arrays, key, lr, step_no):
+            (loss, (preds, buf_updates)), grads = grad_fn(state_arrays, x_arrays, y_arrays, key)
+            p_idx = [i for i, m in enumerate(param_mask) if m and grads[i] is not None]
+            p_grads = [grads[i].astype(jnp.float32) for i in p_idx]
+            if clip is not None:
+                from ..nn.clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue
+
+                if isinstance(clip, ClipGradByGlobalNorm):
+                    gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in p_grads))
+                    scale = clip.clip_norm / jnp.maximum(gnorm, clip.clip_norm)
+                    p_grads = [g * scale for g in p_grads]
+                elif isinstance(clip, ClipGradByNorm):
+                    p_grads = [
+                        g * jnp.minimum(clip.clip_norm / jnp.maximum(jnp.sqrt(jnp.sum(jnp.square(g))), 1e-12), 1.0)
+                        for g in p_grads
+                    ]
+                elif isinstance(clip, ClipGradByValue):
+                    p_grads = [jnp.clip(g, clip.min, clip.max) for g in p_grads]
+            p_vals = [state_arrays[i] for i in p_idx]
+            p_params = [tensors[i] for i in p_idx]
+            new_vals, new_opt_state = opt._functional_update(p_grads, p_vals, p_params, opt_state, lr, step_no)
+            new_state = list(state_arrays)
+            for i, v in zip(p_idx, new_vals):
+                new_state[i] = v
+            for i, v in buf_updates.items():
+                new_state[i] = v
+            return loss, preds, new_state, new_opt_state
+
+        self._jitted = jax.jit(train_step, donate_argnums=(0, 1))
+        self._state_tensors = tensors
+        self._param_mask = param_mask
+        self._opt_state = {}
+
+        def step(x_list, y_list):
+            opt._step_count += 1
+            state_arrays = [t._data for t in tensors]
+            lr = opt.get_lr()
+            loss, preds, new_state, self._opt_state = self._jitted(
+                state_arrays,
+                self._opt_state,
+                [x._data for x in x_list],
+                [y._data for y in y_list],
+                next_key(),
+                jnp.float32(lr),
+                jnp.int32(opt._step_count),
+            )
+            for t, a in zip(tensors, new_state):
+                t._data = a
+            return loss, preds
+
+        return step
+
+    def _eager_train_step(self, x_list, y_list):
+        out = self.network(*x_list)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        loss = self._loss(*outs, *y_list)
+        if isinstance(loss, (list, tuple)):
+            loss = loss[0]
+        loss.backward()
+        self._optimizer.step()
+        self._optimizer.clear_grad()
+        return loss._data, [o._data for o in outs]
+
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        x_list = self._as_list(inputs)
+        y_list = self._as_list(labels)
+        if self._jit:
+            if self._train_step_fn is None:
+                self._train_step_fn = self._build_train_step()
+            loss, preds = self._train_step_fn(x_list, y_list)
+        else:
+            loss, preds = self._eager_train_step(x_list, y_list)
+        metrics = self._update_metrics(preds, y_list)
+        return [float(np.asarray(loss))], metrics
+
+    @autograd_engine.no_grad()
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        x_list = self._as_list(inputs)
+        y_list = self._as_list(labels)
+        out = self.network(*x_list)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        loss = None
+        if self._loss is not None and y_list:
+            loss = self._loss(*outs, *y_list)
+            if isinstance(loss, (list, tuple)):
+                loss = loss[0]
+        metrics = self._update_metrics([o._data for o in outs], y_list)
+        return ([float(np.asarray(loss._data))] if loss is not None else []), metrics
+
+    @autograd_engine.no_grad()
+    def predict_batch(self, inputs):
+        self.network.eval()
+        out = self.network(*self._as_list(inputs))
+        return out
+
+    def _update_metrics(self, preds, y_list):
+        results = []
+        for m in self._metrics:
+            inp = m.compute(Tensor(preds[0]), *y_list)
+            r = m.update(np.asarray(inp._data if isinstance(inp, Tensor) else inp))
+            results.append(r)
+        return results
+
+    @staticmethod
+    def _as_list(x):
+        if x is None:
+            return []
+        return list(x) if isinstance(x, (list, tuple)) else [x]
+
+    # ---- high level ----
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1, eval_freq=1,
+            log_freq=10, save_dir=None, save_freq=1, verbose=2, drop_last=False,
+            shuffle=True, num_workers=0, callbacks=None, accumulate_grad_batches=1, num_iters=None):
+        from ..io import DataLoader
+        from ..io.dataset import Dataset
+
+        if isinstance(train_data, Dataset):
+            train_loader = DataLoader(train_data, batch_size=batch_size, shuffle=shuffle,
+                                      drop_last=drop_last, num_workers=num_workers)
+        else:
+            train_loader = train_data
+        if eval_data is not None and isinstance(eval_data, Dataset):
+            eval_loader = DataLoader(eval_data, batch_size=batch_size, num_workers=num_workers)
+        else:
+            eval_loader = eval_data
+
+        cbks = CallbackList(callbacks, model=self, verbose=verbose,
+                            metrics=["loss"] + [m.name() for m in self._metrics], log_freq=log_freq)
+        cbks.on_begin("train")
+        steps = None
+        try:
+            steps = len(train_loader)
+        except TypeError:
+            pass
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            cbks.on_epoch_begin(epoch, {"steps": steps})
+            for m in self._metrics:
+                m.reset()
+            it = 0
+            for batch in train_loader:
+                data = self._split_batch(batch)
+                cbks.on_batch_begin("train", it, {})
+                losses, metrics = self.train_batch(*data)
+                logs = {"loss": losses[0]}
+                for m, r in zip(self._metrics, metrics):
+                    logs[m.name()] = r
+                cbks.on_batch_end("train", it, logs)
+                it += 1
+                if num_iters is not None and it >= num_iters:
+                    break
+            if isinstance(self._optimizer._lr, object) and hasattr(self._optimizer, "_lr_step"):
+                self._optimizer._lr_step()
+            epoch_logs = {"loss": losses[0]}
+            for m in self._metrics:
+                epoch_logs[m.name()] = m.accumulate()
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_loader, verbose=0, _as_dict=True)
+                epoch_logs.update({"eval_" + k: v for k, v in eval_logs.items()})
+            cbks.on_epoch_end(epoch, epoch_logs)
+            if save_dir is not None and (epoch + 1) % save_freq == 0:
+                self.save(f"{save_dir}/{epoch}")
+        cbks.on_end("train")
+        if save_dir is not None:
+            self.save(f"{save_dir}/final")
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2, num_workers=0,
+                 callbacks=None, num_iters=None, _as_dict=False):
+        from ..io import DataLoader
+        from ..io.dataset import Dataset
+
+        loader = DataLoader(eval_data, batch_size=batch_size, num_workers=num_workers) \
+            if isinstance(eval_data, Dataset) else eval_data
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        it = 0
+        for batch in loader:
+            data = self._split_batch(batch)
+            l, _ = self.eval_batch(*data)
+            if l:
+                losses.append(l[0])
+            it += 1
+            if num_iters is not None and it >= num_iters:
+                break
+        logs = {}
+        if losses:
+            logs["loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            logs[m.name()] = m.accumulate()
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False, verbose=1, callbacks=None):
+        from ..io import DataLoader
+        from ..io.dataset import Dataset
+
+        loader = DataLoader(test_data, batch_size=batch_size, num_workers=num_workers) \
+            if isinstance(test_data, Dataset) else test_data
+        outputs = []
+        for batch in loader:
+            data = self._split_batch(batch)
+            out = self.predict_batch(data[0])
+            outputs.append(out)
+        return outputs
+
+    def _split_batch(self, batch):
+        """Split a loader batch into (inputs, labels) following hapi convention."""
+        if isinstance(batch, (list, tuple)) and len(batch) >= 2:
+            return [batch[:-1], [batch[-1]]] if len(batch) > 2 else [[batch[0]], [batch[1]]]
+        return [[batch], []]
+
+    # ---- persistence ----
+    def save(self, path, training=True):
+        from ..framework import io as fio
+
+        fio.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            fio.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework import io as fio
+
+        state = fio.load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        import os
+
+        if not reset_optimizer and self._optimizer is not None and os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(fio.load(path + ".pdopt"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary
+
+        return summary(self.network, input_size)

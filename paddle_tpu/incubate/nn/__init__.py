@@ -1,0 +1,3 @@
+"""incubate.nn — fused-op layer API (reference: python/paddle/incubate/nn)."""
+
+from . import functional  # noqa: F401

@@ -1,0 +1,179 @@
+"""incubate.nn.functional — fused ops (reference: python/paddle/incubate/nn/functional).
+
+TPU-native: most of the reference's 75 fused CUDA kernels
+(phi/kernels/fusion/gpu) are XLA fusions here — the functions below express the
+fused computation as one traced region; XLA emits a single TPU kernel chain.
+Attention variants route to the Pallas flash kernel.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....core.op_registry import apply_fn
+from ....core.tensor import Tensor
+from ....nn import functional as F
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6, begin_norm_axis=-1,
+                   bias=None, residual=None, quant_scale=-1, **kw):
+    """Reference: incubate/nn/functional/fused_rms_norm.py."""
+
+    def fn(a, w, *rest):
+        i = 0
+        res = None
+        if residual is not None:
+            res = rest[i]
+            i += 1
+        if res is not None:
+            a = a + res
+        dt = a.dtype
+        af = a.astype(jnp.float32)
+        ms = jnp.mean(jnp.square(af), axis=-1, keepdims=True)
+        out = (af * jax.lax.rsqrt(ms + epsilon)).astype(dt) * w
+        if norm_bias is not None:
+            out = out + rest[i]
+        return out
+
+    args = [x, norm_weight] + [t for t in (residual, norm_bias) if t is not None]
+    return apply_fn("fused_rms_norm", fn, *args)
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5, begin_norm_axis=-1,
+                     bias=None, residual=None, **kw):
+    if residual is not None:
+        x = x + residual
+    return F.layer_norm(x, x.shape[begin_norm_axis:] if begin_norm_axis != -1 else [x.shape[-1]],
+                        norm_weight, norm_bias, epsilon)
+
+
+def swiglu(x, y=None, name=None):
+    """Reference: incubate/nn/functional/swiglu.py — silu(x) * y (fused gate)."""
+    if y is None:
+        def fn(a):
+            a1, a2 = jnp.split(a, 2, axis=-1)
+            return jax.nn.silu(a1) * a2
+
+        return apply_fn("swiglu", fn, x)
+    return apply_fn("swiglu", lambda a, b: jax.nn.silu(a) * b, x, y)
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True,
+                                    time_major=False, rotary_emb_base=10000.0):
+    """Reference: incubate/nn/functional/fused_rotary_position_embedding.py.
+    q/k/v layout: [batch, seq, heads, head_dim]."""
+
+    def rope_one(t, s, c):
+        if use_neox_rotary_style:
+            d = t.shape[-1]
+            t1, t2 = t[..., : d // 2], t[..., d // 2:]
+            rot = jnp.concatenate([-t2, t1], axis=-1)
+            return t * c + rot * s
+        t1 = t[..., 0::2]
+        t2 = t[..., 1::2]
+        rot = jnp.stack([-t2, t1], axis=-1).reshape(t.shape)
+        return t * c + rot * s
+
+    def make_sincos(seq_len, dim, dtype):
+        inv = 1.0 / (rotary_emb_base ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
+        tpos = jnp.arange(seq_len, dtype=jnp.float32)
+        freqs = jnp.outer(tpos, inv)
+        if use_neox_rotary_style:
+            emb = jnp.concatenate([freqs, freqs], axis=-1)
+        else:
+            emb = jnp.repeat(freqs, 2, axis=-1)
+        return jnp.sin(emb).astype(dtype)[None, :, None, :], jnp.cos(emb).astype(dtype)[None, :, None, :]
+
+    outs = []
+    tensors = [t for t in (q, k, v) if t is not None]
+
+    def fn(*arrs):
+        rest = list(arrs)
+        n_t = len(tensors)
+        main = rest[:n_t]
+        extra = rest[n_t:]
+        if sin is not None:
+            s, c = extra[0], extra[1]
+            if s.ndim == 2:
+                s = s[None, :, None, :]
+                c = c[None, :, None, :]
+            elif s.ndim == 4 and s.shape[2] != 1 and s.shape[1] != main[0].shape[1]:
+                pass
+        else:
+            s, c = make_sincos(main[0].shape[1], main[0].shape[-1], main[0].dtype)
+        if position_ids is not None:
+            pid = extra[-1]
+            s = jnp.take(s[0, :, 0, :], pid, axis=0)[:, :, None, :]
+            c = jnp.take(c[0, :, 0, :], pid, axis=0)[:, :, None, :]
+        return tuple(rope_one(t, s, c) for t in main)
+
+    args = tensors + [t for t in (sin, cos) if t is not None]
+    if position_ids is not None:
+        args = args + [position_ids]
+    res = apply_fn("fused_rope", fn, *args)
+    res = list(res) if isinstance(res, tuple) else [res]
+    out = []
+    i = 0
+    for t in (q, k, v):
+        if t is not None:
+            out.append(res[i])
+            i += 1
+        else:
+            out.append(None)
+    return tuple(out)
+
+
+def fused_bias_act(x, bias=None, act_method="gelu", **kw):
+    if bias is not None:
+        x = x + bias
+    return getattr(F, act_method)(x)
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    def fn(a, w, *b):
+        ww = w.T if transpose_weight else w
+        out = jnp.matmul(a, ww)
+        if b:
+            out = out + b[0]
+        return out
+
+    args = [x, weight] + ([bias] if bias is not None else [])
+    return apply_fn("fused_linear", fn, *args)
+
+
+def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False, activation="gelu"):
+    def fn(a, w, b):
+        if trans_x:
+            a = a.T
+        if trans_y:
+            w = w.T
+        return getattr(jax.nn, activation if activation != "none" else "identity",
+                       lambda v: v)(jnp.matmul(a, w) + b)
+
+    return apply_fn("fused_linear_activation", fn, x, y, bias)
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train", name=None):
+    return F.dropout(x, p, training=training, mode=mode) + y
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=False, **kw):
+    raise NotImplementedError("use nn.MultiHeadAttention (XLA/Pallas fused) — tracked in docs/PARITY.md")
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, **kw):
+    raise NotImplementedError("XLA fuses nn.Linear+act+Linear chains natively — tracked in docs/PARITY.md")
+
+
+def masked_multihead_attention(x, cache_kv=None, **kw):
+    raise NotImplementedError("decode-time MHA lands with the serving suite — see ops/paged_attention")
+
+
+def variable_length_memory_efficient_attention(q, k, v, seq_lens=None, kv_seq_lens=None, mask=None, scale=None, causal=False):
+    return F.scaled_dot_product_attention(q, k, v, attn_mask=mask, is_causal=causal)
+
+
+def block_multihead_attention(*args, **kw):
+    raise NotImplementedError("paged/block KV attention: ops/paged_attention (serving suite)")

@@ -1,0 +1,239 @@
+"""DataLoader (reference: python/paddle/io/reader.py:262 + dataloader/dataloader_iter.py).
+
+Single-process iterator collates on the host and ships batches with one device_put.
+Multi-process mode mirrors the reference's worker-pool (dataloader_iter.py:370):
+worker processes pull index batches from an index queue, collate numpy samples, and
+push them through a result queue; ordering is preserved per batch index. A
+prefetch depth (like the reference's outstanding-capacity) overlaps host IO with
+device compute.
+"""
+
+from __future__ import annotations
+
+import itertools
+import multiprocessing as mp
+import queue
+import threading
+from collections import namedtuple
+from typing import Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler
+
+WorkerInfo = namedtuple("WorkerInfo", ["id", "num_workers", "dataset"])
+_worker_info: Optional[WorkerInfo] = None
+
+
+def get_worker_info():
+    return _worker_info
+
+
+def default_collate_fn(batch):
+    """Stack samples into batched numpy arrays (converted to Tensor at the boundary)."""
+    sample = batch[0]
+    if isinstance(sample, (Tensor,)):
+        return Tensor(np.stack([np.asarray(s._data) for s in batch]))
+    if isinstance(sample, np.ndarray):
+        return Tensor(np.stack(batch))
+    if isinstance(sample, (int, np.integer)):
+        return Tensor(np.asarray(batch, dtype=np.int64))
+    if isinstance(sample, (float, np.floating)):
+        return Tensor(np.asarray(batch, dtype=np.float32))
+    if isinstance(sample, (list, tuple)):
+        transposed = list(zip(*batch))
+        return [default_collate_fn(list(s)) for s in transposed]
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([d[k] for d in batch]) for k in sample}
+    return batch
+
+
+def _np_collate(batch):
+    """Worker-side collate producing picklable numpy (Tensors only in the parent)."""
+    sample = batch[0]
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, dtype=np.int64)
+    if isinstance(sample, (float, np.floating)):
+        return np.asarray(batch, dtype=np.float32)
+    if isinstance(sample, (list, tuple)):
+        return [_np_collate(list(s)) for s in zip(*batch)]
+    if isinstance(sample, dict):
+        return {k: _np_collate([d[k] for d in batch]) for k in sample}
+    return batch
+
+
+def _to_tensor(obj):
+    if isinstance(obj, np.ndarray):
+        return Tensor(obj)
+    if isinstance(obj, list):
+        return [_to_tensor(o) for o in obj]
+    if isinstance(obj, dict):
+        return {k: _to_tensor(v) for k, v in obj.items()}
+    return obj
+
+
+def _worker_loop(dataset, index_queue, result_queue, collate_fn, worker_id, num_workers, init_fn):
+    global _worker_info
+    _worker_info = WorkerInfo(worker_id, num_workers, dataset)
+    if init_fn is not None:
+        init_fn(worker_id)
+    while True:
+        item = index_queue.get()
+        if item is None:
+            break
+        batch_idx, indices = item
+        try:
+            samples = [dataset[i] for i in indices]
+            samples = [
+                tuple(np.asarray(t._data) if isinstance(t, Tensor) else t for t in s)
+                if isinstance(s, tuple) else (np.asarray(s._data) if isinstance(s, Tensor) else s)
+                for s in samples
+            ]
+            data = collate_fn(samples) if collate_fn is not _np_collate else _np_collate(samples)
+            result_queue.put((batch_idx, data, None))
+        except Exception as e:  # surface worker errors to the parent
+            result_queue.put((batch_idx, None, repr(e)))
+
+
+class DataLoader:
+    def __init__(self, dataset, feed_list=None, places=None, return_list=True,
+                 batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
+                 collate_fn=None, num_workers=0, use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=True, timeout=0, worker_init_fn=None, persistent_workers=False):
+        self.dataset = dataset
+        self.num_workers = num_workers
+        self.collate_fn = collate_fn
+        self.prefetch_factor = max(prefetch_factor, 2)
+        self.worker_init_fn = worker_init_fn
+        self.timeout = timeout
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        if self._iterable_mode:
+            self.batch_size = batch_size
+            self.batch_sampler = None
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+            self.batch_size = batch_sampler.batch_size
+        else:
+            if batch_size is None:
+                self.batch_sampler = None
+                self.batch_size = None
+            else:
+                self.batch_sampler = BatchSampler(dataset, shuffle=shuffle, batch_size=batch_size, drop_last=drop_last)
+                self.batch_size = batch_size
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("IterableDataset has no len()")
+        if self.batch_sampler is None:
+            return len(self.dataset)
+        return len(self.batch_sampler)
+
+    def __call__(self):
+        return self.__iter__()
+
+    def __iter__(self):
+        if self._iterable_mode:
+            return self._iter_iterable()
+        if self.batch_sampler is None:
+            return self._iter_no_batch()
+        if self.num_workers == 0:
+            return self._iter_single()
+        return iter(_MultiProcessIter(self))
+
+    def _iter_no_batch(self):
+        cf = self.collate_fn or (lambda s: s)
+        for i in range(len(self.dataset)):
+            yield _to_tensor(cf(self.dataset[i]))
+
+    def _iter_single(self):
+        cf = self.collate_fn or default_collate_fn
+        for indices in self.batch_sampler:
+            samples = [self.dataset[i] for i in indices]
+            yield cf(samples)
+
+    def _iter_iterable(self):
+        cf = self.collate_fn or default_collate_fn
+        batch = []
+        for sample in self.dataset:
+            batch.append(sample)
+            if len(batch) == self.batch_size:
+                yield cf(batch)
+                batch = []
+        if batch and not self.drop_last:
+            yield cf(batch)
+
+
+class _MultiProcessIter:
+    """Ordered multi-process batch pipeline (cf. _DataLoaderIterMultiProcess)."""
+
+    def __init__(self, loader: DataLoader):
+        self.loader = loader
+        self.collate = loader.collate_fn or _np_collate
+        self.num_workers = loader.num_workers
+        ctx = mp.get_context("fork")
+        self.index_queues = [ctx.Queue() for _ in range(self.num_workers)]
+        self.result_queue = ctx.Queue()
+        self.workers = []
+        for wid in range(self.num_workers):
+            w = ctx.Process(
+                target=_worker_loop,
+                args=(loader.dataset, self.index_queues[wid], self.result_queue,
+                      self.collate, wid, self.num_workers, loader.worker_init_fn),
+                daemon=True,
+            )
+            w.start()
+            self.workers.append(w)
+        self.batches = list(loader.batch_sampler)
+        self.send_idx = 0
+        self.rcv_idx = 0
+        self.cache = {}
+        # prime the pipeline
+        for _ in range(self.num_workers * loader.prefetch_factor):
+            self._dispatch()
+
+    def _dispatch(self):
+        if self.send_idx >= len(self.batches):
+            return
+        wid = self.send_idx % self.num_workers
+        self.index_queues[wid].put((self.send_idx, self.batches[self.send_idx]))
+        self.send_idx += 1
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self.rcv_idx >= len(self.batches):
+            self._shutdown()
+            raise StopIteration
+        while self.rcv_idx not in self.cache:
+            idx, data, err = self.result_queue.get()
+            if err is not None:
+                self._shutdown()
+                raise RuntimeError(f"DataLoader worker failed: {err}")
+            self.cache[idx] = data
+        data = self.cache.pop(self.rcv_idx)
+        self.rcv_idx += 1
+        self._dispatch()
+        return _to_tensor(data)
+
+    def _shutdown(self):
+        for q in self.index_queues:
+            try:
+                q.put(None)
+            except Exception:
+                pass
+        for w in self.workers:
+            w.join(timeout=1)
+            if w.is_alive():
+                w.terminate()
+
+    def __del__(self):
+        try:
+            self._shutdown()
+        except Exception:
+            pass

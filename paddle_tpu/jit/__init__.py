@@ -1,0 +1,3 @@
+"""paddle_tpu.jit (reference: python/paddle/jit)."""
+
+from .api import StaticFunction, functional_call, ignore_module, load, not_to_static, save, to_static  # noqa: F401

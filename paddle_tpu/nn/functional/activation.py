@@ -1,0 +1,195 @@
+"""Activation functionals (reference: python/paddle/nn/functional/activation.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.op_registry import AMP_BLACK, OpDef, apply_fn
+
+_SOFTMAX = OpDef("softmax", None, amp=AMP_BLACK)
+
+
+def relu(x, name=None):
+    return apply_fn("relu", jax.nn.relu, x)
+
+
+def relu_(x, name=None):
+    out = relu(x)
+    return x._replace_(out._data, out._node, out._out_idx)
+
+
+def relu6(x, name=None):
+    return apply_fn("relu6", jax.nn.relu6, x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply_fn("leaky_relu", lambda a: jax.nn.leaky_relu(a, negative_slope), x)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def fn(a, w):
+        if w.size == 1:
+            return jnp.where(a >= 0, a, w.reshape(()) * a)
+        shape = [1] * a.ndim
+        ch_axis = 1 if data_format.startswith("NC") else a.ndim - 1
+        shape[ch_axis] = w.size
+        return jnp.where(a >= 0, a, w.reshape(shape) * a)
+
+    return apply_fn("prelu", fn, x, weight)
+
+
+def rrelu(x, lower=0.125, upper=0.333, training=False, name=None):
+    mid = (lower + upper) / 2
+    return apply_fn("rrelu", lambda a: jnp.where(a >= 0, a, mid * a), x)
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply_fn("elu", lambda a: jax.nn.elu(a, alpha), x)
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply_fn("selu", lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), x)
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply_fn("celu", lambda a: jax.nn.celu(a, alpha), x)
+
+
+def gelu(x, approximate=False, name=None):
+    return apply_fn("gelu", lambda a: jax.nn.gelu(a, approximate=approximate), x)
+
+
+def silu(x, name=None):
+    return apply_fn("silu", jax.nn.silu, x)
+
+
+swish = silu
+
+
+def mish(x, name=None):
+    return apply_fn("mish", lambda a: a * jnp.tanh(jax.nn.softplus(a)), x)
+
+
+def hardswish(x, name=None):
+    return apply_fn("hardswish", lambda a: a * jnp.clip(a + 3, 0, 6) / 6, x)
+
+
+def hardsigmoid(x, slope=1 / 6, offset=0.5, name=None):
+    return apply_fn("hardsigmoid", lambda a: jnp.clip(slope * a + offset, 0, 1), x)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply_fn("hardtanh", lambda a: jnp.clip(a, min, max), x)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply_fn("hardshrink", lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), x)
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply_fn(
+        "softshrink",
+        lambda a: jnp.where(a > threshold, a - threshold, jnp.where(a < -threshold, a + threshold, 0.0)),
+        x,
+    )
+
+
+def tanhshrink(x, name=None):
+    return apply_fn("tanhshrink", lambda a: a - jnp.tanh(a), x)
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return apply_fn("thresholded_relu", lambda a: jnp.where(a > threshold, a, value), x)
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply_fn(
+        "softplus",
+        lambda a: jnp.where(beta * a > threshold, a, (1.0 / beta) * jnp.log1p(jnp.exp(beta * a))),
+        x,
+    )
+
+
+def softsign(x, name=None):
+    return apply_fn("softsign", jax.nn.soft_sign, x)
+
+
+def sigmoid(x, name=None):
+    return apply_fn("sigmoid", jax.nn.sigmoid, x)
+
+
+def logsigmoid(x, name=None):
+    return apply_fn("log_sigmoid", jax.nn.log_sigmoid, x)
+
+
+log_sigmoid = logsigmoid
+
+
+def tanh(x, name=None):
+    return apply_fn("tanh", jnp.tanh, x)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    from ...core import dtype as dtype_mod
+
+    dt = dtype_mod.convert_dtype(dtype)
+
+    def fn(a):
+        if dt is not None:
+            a = a.astype(dt)
+        return jax.nn.softmax(a, axis=int(axis))
+
+    return apply_fn("softmax", fn, x, _opdef=_SOFTMAX)
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    from ...core import dtype as dtype_mod
+
+    dt = dtype_mod.convert_dtype(dtype)
+
+    def fn(a):
+        if dt is not None:
+            a = a.astype(dt)
+        return jax.nn.log_softmax(a, axis=int(axis))
+
+    return apply_fn("log_softmax", fn, x, _opdef=_SOFTMAX)
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    out = softmax(x, axis, dtype)
+    return x._replace_(out._data, out._node, out._out_idx)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...framework.random import next_key
+
+    key = next_key()
+
+    def fn(a):
+        g = jax.random.gumbel(key, a.shape, a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            onehot = jax.nn.one_hot(jnp.argmax(y, axis=axis), y.shape[axis], axis=axis, dtype=y.dtype)
+            y = onehot + y - jax.lax.stop_gradient(y)
+        return y
+
+    return apply_fn("gumbel_softmax", fn, x)
+
+
+def maxout(x, groups, axis=1, name=None):
+    def fn(a):
+        ax = axis % a.ndim
+        c = a.shape[ax]
+        new_shape = a.shape[:ax] + (c // groups, groups) + a.shape[ax + 1:]
+        return jnp.max(a.reshape(new_shape), axis=ax + 1)
+
+    return apply_fn("maxout", fn, x)
+
+
+def glu(x, axis=-1, name=None):
+    def fn(a):
+        a1, a2 = jnp.split(a, 2, axis=axis)
+        return a1 * jax.nn.sigmoid(a2)
+
+    return apply_fn("glu", fn, x)

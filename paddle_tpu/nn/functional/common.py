@@ -1,0 +1,249 @@
+"""Common functionals: linear, dropout, embedding, one_hot, interpolate, pad, cosine_sim.
+
+Reference: python/paddle/nn/functional/common.py + input.py. The matmul in ``linear``
+is the single most important op for MXU utilization — it lowers to a plain
+``dot_general`` that XLA tiles onto the systolic array and fuses bias/activation into.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.op_registry import AMP_WHITE, OpDef, apply_fn
+from ...core.tensor import Tensor, unwrap
+from ...framework.random import next_key
+
+_LINEAR = OpDef("linear", None, amp=AMP_WHITE)
+
+
+def linear(x, weight, bias=None, name=None):
+    if bias is None:
+        return apply_fn("linear", lambda a, w: jnp.matmul(a, w), x, weight, _opdef=_LINEAR)
+    return apply_fn("linear", lambda a, w, b: jnp.matmul(a, w) + b, x, weight, bias, _opdef=_LINEAR)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or p == 0.0:
+        return apply_fn("dropout_eval", lambda a: a if mode == "upscale_in_train" else a * (1 - p), x)
+    key = next_key()
+
+    def fn(a):
+        shape = list(a.shape)
+        if axis is not None:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            shape = [s if i in [ax % a.ndim for ax in axes] else 1 for i, s in enumerate(a.shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), jnp.zeros_like(a))
+        return jnp.where(keep, a, jnp.zeros_like(a))
+
+    return apply_fn("dropout", fn, x)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    key = next_key()
+
+    def fn(a):
+        alpha = 1.6732632423543772
+        scale = 1.0507009873554805
+        alpha_p = -alpha * scale
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        q = 1.0 - p
+        a_coef = (q + alpha_p**2 * q * p) ** -0.5
+        b_coef = -a_coef * alpha_p * p
+        return a_coef * jnp.where(keep, a, alpha_p) + b_coef
+
+    return apply_fn("alpha_dropout", fn, x)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    def fn(w, idx):
+        out = jnp.take(w, idx, axis=0)
+        if padding_idx is not None and padding_idx >= 0:
+            mask = (idx == padding_idx)[..., None]
+            out = jnp.where(mask, jnp.zeros_like(out), out)
+        return out
+
+    return apply_fn("embedding", fn, weight, x if not isinstance(x, Tensor) else x.astype("int32"))
+
+
+def one_hot(x, num_classes, name=None):
+    return apply_fn("one_hot", lambda idx: jax.nn.one_hot(idx, num_classes, dtype=jnp.float32), x)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def fn(l, *pd):
+        n = l.shape[-1]
+        if pd:
+            return (1 - epsilon) * l + epsilon * pd[0]
+        return (1 - epsilon) * l + epsilon / n
+
+    if prior_dist is not None:
+        return apply_fn("label_smooth", fn, label, prior_dist)
+    return apply_fn("label_smooth", fn, label)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def fn(a, b):
+        num = jnp.sum(a * b, axis=axis)
+        d1 = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        d2 = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return num / jnp.maximum(d1 * d2, eps)
+
+    return apply_fn("cosine_similarity", fn, x1, x2)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def fn(a):
+        nrm = jnp.sum(jnp.abs(a) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return a / jnp.maximum(nrm, epsilon)
+
+    return apply_fn("normalize", fn, x)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    from ...tensor.manipulation import pad as _pad
+
+    return _pad(x, pad, mode, value, data_format)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    def fn(a):
+        cf = data_format.startswith("NC")
+        spatial = a.shape[2:] if cf else a.shape[1:-1]
+        if size is not None:
+            tgt = tuple(int(unwrap(s)) for s in (size if isinstance(size, (list, tuple)) else [size]))
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * len(spatial)
+            tgt = tuple(int(s * f) for s, f in zip(spatial, sf))
+        meth = {"nearest": "nearest", "bilinear": "linear", "trilinear": "linear", "bicubic": "cubic", "linear": "linear", "area": "linear"}[mode]
+        if cf:
+            new_shape = a.shape[:2] + tgt
+        else:
+            new_shape = (a.shape[0],) + tgt + (a.shape[-1],)
+        return jax.image.resize(a, new_shape, method=meth)
+
+    return apply_fn("interpolate", fn, x)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    def _pair(v):
+        return tuple(v) if isinstance(v, (list, tuple)) else (v, v)
+
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    ph, pw = _pair(paddings)
+    dh, dw = _pair(dilations)
+
+    def fn(a):
+        n, c, h, w = a.shape
+        a = jnp.pad(a, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+        oh = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+        ow = (w + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+        cols = []
+        for i in range(kh):
+            for j in range(kw):
+                patch = a[:, :, i * dh : i * dh + oh * sh : sh, j * dw : j * dw + ow * sw : sw]
+                cols.append(patch)
+        out = jnp.stack(cols, axis=2)  # n, c, kh*kw, oh, ow
+        return out.reshape(n, c * kh * kw, oh * ow)
+
+    return apply_fn("unfold", fn, x)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    def _pair(v):
+        return tuple(v) if isinstance(v, (list, tuple)) else (v, v)
+
+    oh, ow = _pair(output_sizes)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    ph, pw = _pair(paddings)
+    dh, dw = _pair(dilations)
+
+    def fn(a):
+        n, ckk, L = a.shape
+        c = ckk // (kh * kw)
+        lh = (oh + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+        lw = (ow + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+        a = a.reshape(n, c, kh, kw, lh, lw)
+        out = jnp.zeros((n, c, oh + 2 * ph, ow + 2 * pw), a.dtype)
+        for i in range(kh):
+            for j in range(kw):
+                out = out.at[:, :, i * dh : i * dh + lh * sh : sh, j * dw : j * dw + lw * sw : sw].add(a[:, :, i, j])
+        return out[:, :, ph : ph + oh, pw : pw + ow]
+
+    return apply_fn("fold", fn, x)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def fn(a, b, w, *bb):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if bb:
+            out = out + bb[0]
+        return out
+
+    args = [x1, x2, weight] + ([bias] if bias is not None else [])
+    return apply_fn("bilinear", fn, *args)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def fn(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c // (r * r), r, r, h, w)
+            a = a.transpose(0, 1, 4, 2, 5, 3)
+            return a.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h, w, r, r, c // (r * r))
+        a = a.transpose(0, 1, 3, 2, 4, 5)
+        return a.reshape(n, h * r, w * r, c // (r * r))
+
+    return apply_fn("pixel_shuffle", fn, x)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def fn(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c, h // r, r, w // r, r)
+            a = a.transpose(0, 1, 3, 5, 2, 4)
+            return a.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h // r, r, w // r, r, c)
+        a = a.transpose(0, 2, 4, 1, 3, 5).reshape(n, h // r, w // r, c * r * r)
+        return a
+
+    return apply_fn("pixel_unshuffle", fn, x)
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def fn(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            return a.reshape(n, groups, c // groups, h, w).transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+        n, h, w, c = a.shape
+        return a.reshape(n, h, w, groups, c // groups).transpose(0, 1, 2, 4, 3).reshape(n, h, w, c)
+
+    return apply_fn("channel_shuffle", fn, x)

@@ -1,0 +1,121 @@
+"""Convolutions via ``lax.conv_general_dilated`` (MXU path).
+
+Reference: python/paddle/nn/functional/conv.py. Paddle weight layout [O, I/g, *k];
+XLA chooses the on-device layout — no im2col/cudnn-algo machinery needed on TPU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.op_registry import AMP_WHITE, OpDef, apply_fn
+
+_CONV = OpDef("conv", None, amp=AMP_WHITE)
+
+
+def _tuplize(v, n):
+    if isinstance(v, (list, tuple)):
+        if len(v) == n:
+            return tuple(int(x) for x in v)
+        if len(v) == 2 * n:  # explicit per-side padding pairs flattened
+            return tuple(v)
+        return tuple(int(v[0]) for _ in range(n))
+    return tuple(int(v) for _ in range(n))
+
+
+def _padding(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, (list, tuple)) and len(padding) and isinstance(padding[0], (list, tuple)):
+        # paddle allows [[0,0],[0,0],[ph,ph],[pw,pw]]
+        return [tuple(p) for p in padding[-n:]]
+    p = _tuplize(padding, n)
+    if len(p) == 2 * n:
+        return [(int(p[2 * i]), int(p[2 * i + 1])) for i in range(n)]
+    return [(int(x), int(x)) for x in p]
+
+
+def _conv_nd(x, weight, bias, stride, padding, dilation, groups, n, data_format, transpose=False, output_padding=0):
+    stride = _tuplize(stride, n)
+    dilation = _tuplize(dilation, n)
+    pad = _padding(padding, n)
+    channels_first = data_format in ("NCHW", "NCL", "NCDHW", "NCW")
+    spatial = "DHW"[-n:] if n <= 3 else None
+    if channels_first:
+        dn_in = "NC" + spatial
+        dn_out = "NC" + spatial
+    else:
+        dn_in = "N" + spatial + "C"
+        dn_out = "N" + spatial + "C"
+    dn = jax.lax.conv_dimension_numbers((1,) * (n + 2), (1,) * (n + 2), (dn_in, "OI" + spatial, dn_out))
+
+    if not transpose:
+        def fn(a, w, *b):
+            out = jax.lax.conv_general_dilated(
+                a, w, window_strides=stride, padding=pad,
+                rhs_dilation=dilation, dimension_numbers=dn, feature_group_count=groups,
+                preferred_element_type=jnp.float32 if a.dtype == jnp.float32 else None,
+            )
+            if b:
+                bias_shape = [1] * out.ndim
+                bias_shape[1 if channels_first else -1] = -1
+                out = out + b[0].reshape(bias_shape)
+            return out
+    else:
+        opad = _tuplize(output_padding, n)
+
+        def fn(a, w, *b):
+            # ConvTranspose: paddle weight layout [I, O/g, *k]
+            k = w.shape[2:]
+            pads = []
+            for i in range(n):
+                lo, hi = pad[i] if isinstance(pad, list) else (0, 0)
+                eff_k = (k[i] - 1) * dilation[i] + 1
+                pads.append((eff_k - 1 - lo, eff_k - 1 - hi + opad[i]))
+            w_t = jnp.swapaxes(w, 0, 1)  # -> [O/g, I, *k]
+            if groups > 1:
+                # grouped transpose conv: rearrange to (O, I/g, *k)
+                ci = w.shape[0]
+                co_g = w.shape[1]
+                w_g = w.reshape(groups, ci // groups, co_g, *k)
+                w_g = jnp.swapaxes(w_g, 1, 2).reshape(groups * co_g, ci // groups, *k)
+                w_t = w_g
+            w_t = jnp.flip(w_t, axis=tuple(range(2, 2 + n)))
+            out = jax.lax.conv_general_dilated(
+                a, w_t, window_strides=(1,) * n, padding=pads,
+                lhs_dilation=stride, rhs_dilation=dilation, dimension_numbers=dn,
+                feature_group_count=groups,
+            )
+            if b:
+                bias_shape = [1] * out.ndim
+                bias_shape[1 if channels_first else -1] = -1
+                out = out + b[0].reshape(bias_shape)
+            return out
+
+    args = [x, weight] + ([bias] if bias is not None else [])
+    return apply_fn("conv%dd%s" % (n, "_transpose" if transpose else ""), fn, *args, _opdef=_CONV)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCL", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 1, data_format)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 2, data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCDHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 3, data_format)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCL", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 1, data_format, True, output_padding)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 2, data_format, True, output_padding)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCDHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 3, data_format, True, output_padding)

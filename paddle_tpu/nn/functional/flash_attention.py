@@ -1,0 +1,120 @@
+"""Attention functionals (reference: python/paddle/nn/functional/flash_attention.py).
+
+Layout follows the reference: q/k/v are [batch, seq, num_heads, head_dim]
+(flash_attention.py:195). On TPU the hot path is a Pallas flash-attention kernel
+(paddle_tpu/ops/flash_attention.py); elsewhere (CPU tests, odd shapes) an XLA
+composite attention is used — still fused well by XLA, just not block-streamed.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ... import flags
+from ...core.op_registry import apply_fn
+from ...framework.random import next_key
+
+
+def _xla_attention(q, k, v, bias=None, causal=False, scale=None, dropout=0.0, dropout_key=None):
+    # q,k,v: [b, s, h, d] -> compute in [b, h, s, d]
+    qh = jnp.swapaxes(q, 1, 2)
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    d = q.shape[-1]
+    s = scale if scale is not None else d ** -0.5
+    # GQA: broadcast kv heads if fewer than q heads
+    if kh.shape[1] != qh.shape[1]:
+        rep = qh.shape[1] // kh.shape[1]
+        kh = jnp.repeat(kh, rep, axis=1)
+        vh = jnp.repeat(vh, rep, axis=1)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * s
+    logits = logits.astype(jnp.float32)
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
+    if causal:
+        ql, kl = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((ql, kl), bool), k=kl - ql)
+        logits = jnp.where(mask, logits, jnp.float32(-1e9))
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    if dropout > 0.0 and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout), jnp.zeros_like(probs))
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def _attention_impl(q, k, v, bias, causal, scale, dropout, dropout_key):
+    use_pallas = flags.get_flag("use_pallas_attention") and bias is None and dropout == 0.0
+    if use_pallas:
+        try:
+            from ...ops.flash_attention import flash_attention_fwd
+
+            return flash_attention_fwd(q, k, v, causal=causal, scale=scale)
+        except Exception:
+            pass
+    return _xla_attention(q, k, v, bias, causal, scale, dropout, dropout_key)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, training=True, name=None):
+    """Reference: nn/functional/flash_attention.py:976."""
+    dk = next_key() if (dropout_p > 0.0 and training) else None
+    drop = dropout_p if training else 0.0
+
+    def fn(q, kk, vv, *mask):
+        b = mask[0] if mask else None
+        if b is not None and b.dtype == jnp.bool_:
+            b = jnp.where(b, 0.0, -1e9).astype(jnp.float32)
+        return _attention_impl(q, kk, vv, b, is_causal, None, drop, dk)
+
+    args = [query, key, value] + ([attn_mask] if attn_mask is not None else [])
+    return apply_fn("scaled_dot_product_attention", fn, *args)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax=False,
+                    fixed_seed_offset=None, rng_name="", training=True, name=None):
+    """Reference: nn/functional/flash_attention.py:195. Returns (out, softmax|None)."""
+    out = scaled_dot_product_attention(query, key, value, None, dropout, causal, training)
+    return out, None
+
+
+def flashmask_attention(query, key, value, startend_row_indices=None, dropout=0.0,
+                        causal=False, window_size=None, return_softmax_lse=False,
+                        return_seed_offset=False, fixed_seed_offset=None, rng_name="",
+                        training=True, name=None):
+    """Sparse-mask attention (reference :1098). Round-1: dense-mask materialization."""
+    bias = None
+    if startend_row_indices is not None:
+        # Build an additive bias from start/end row indices: masked where kv row >= start.
+        import numpy as np
+
+        from ...core.tensor import unwrap
+
+        idx = unwrap(startend_row_indices)  # [b, kv_heads, kv_len, {1,2,4}]
+        b, h, kv_len, nidx = idx.shape
+        q_len = query.shape[1]
+        rows = jnp.arange(q_len)[None, None, :, None]
+        if causal:
+            start = idx[..., 0][:, :, None, :]  # [b,h,1,kv]
+            mask = rows >= start
+            if nidx >= 2:
+                end = idx[..., 1][:, :, None, :]
+                mask = mask & (rows < end)
+            bias = jnp.where(mask, jnp.float32(-1e9), 0.0)
+        else:
+            start = idx[..., 0][:, :, None, :]
+            mask = rows >= start
+            bias = jnp.where(mask, jnp.float32(-1e9), 0.0)
+    from ...core.tensor import Tensor
+
+    out = scaled_dot_product_attention(query, key, value,
+                                       None if bias is None else Tensor(bias),
+                                       dropout, causal, training)
+    return out
+
+
+def sdp_kernel(*args, **kwargs):
+    import contextlib
+
+    return contextlib.nullcontext()

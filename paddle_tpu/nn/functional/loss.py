@@ -1,0 +1,240 @@
+"""Loss functionals (reference: python/paddle/nn/functional/loss.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.op_registry import AMP_BLACK, OpDef, apply_fn
+from ...core.tensor import Tensor, unwrap
+
+_XENT = OpDef("cross_entropy", None, amp=AMP_BLACK)
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return out.mean()
+    if reduction == "sum":
+        return out.sum()
+    return out
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean", soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0, name=None):
+    def fn(logits, lab, *w):
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis) if use_softmax else jnp.log(jnp.maximum(logits.astype(jnp.float32), 1e-30))
+        n_class = logits.shape[axis]
+        if soft_label:
+            soft = lab
+            if label_smoothing > 0:
+                soft = soft * (1 - label_smoothing) + label_smoothing / n_class
+            loss = -(soft * lp).sum(axis=axis)
+        else:
+            li = lab.astype(jnp.int32)
+            if li.ndim == lp.ndim:
+                li = jnp.squeeze(li, axis=axis)
+            oh = jax.nn.one_hot(li, n_class, axis=axis, dtype=lp.dtype)
+            if label_smoothing > 0:
+                oh = oh * (1 - label_smoothing) + label_smoothing / n_class
+            loss = -(oh * lp).sum(axis=axis)
+            valid = li != ignore_index
+            loss = jnp.where(valid, loss, 0.0)
+            if w:
+                wt = jnp.take(w[0], jnp.maximum(li, 0))
+                loss = loss * wt
+                if reduction == "mean":
+                    denom = jnp.maximum((wt * valid).sum(), 1e-12)
+                    return loss.sum() / denom
+            if reduction == "mean":
+                denom = jnp.maximum(valid.sum(), 1)
+                return loss.sum() / denom
+        return _reduce(loss, reduction)
+
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply_fn("cross_entropy", fn, *args, _opdef=_XENT)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100, numeric_stable_mode=True, return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label, ignore_index=ignore_index, reduction="none", axis=axis)
+    from .activation import softmax as _softmax
+
+    loss = loss.unsqueeze(axis) if loss.ndim == (logits.ndim - 1) else loss
+    if return_softmax:
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    def fn(lp, lab, *w):
+        li = lab.astype(jnp.int32)
+        loss = -jnp.take_along_axis(lp, li[..., None] if lp.ndim == li.ndim + 1 else li, axis=-1 if lp.ndim == li.ndim + 1 else 1)
+        loss = loss.squeeze(-1) if lp.ndim == li.ndim + 1 else loss
+        valid = li != ignore_index
+        loss = jnp.where(valid, loss, 0.0)
+        if w:
+            wt = jnp.take(w[0], jnp.maximum(li, 0))
+            loss = loss * wt
+            if reduction == "mean":
+                return loss.sum() / jnp.maximum((wt * valid).sum(), 1e-12)
+        if reduction == "mean":
+            return loss.sum() / jnp.maximum(valid.sum(), 1)
+        return _reduce(loss, reduction)
+
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply_fn("nll_loss", fn, *args)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply_fn("mse_loss", lambda a, b: _reduce(jnp.square(a - b), reduction), input, label)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply_fn("l1_loss", lambda a, b: _reduce(jnp.abs(a - b), reduction), input, label)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def fn(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta) * delta
+        return _reduce(loss, reduction)
+
+    return apply_fn("smooth_l1_loss", fn, input, label)
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean", name=None):
+    def fn(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d <= delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+        return _reduce(loss, reduction)
+
+    return apply_fn("huber_loss", fn, input, label)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def fn(p, lab, *w):
+        p32 = p.astype(jnp.float32)
+        loss = -(lab * jnp.log(jnp.maximum(p32, 1e-12)) + (1 - lab) * jnp.log(jnp.maximum(1 - p32, 1e-12)))
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply_fn("binary_cross_entropy", fn, *args, _opdef=_XENT)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean", pos_weight=None, name=None):
+    def fn(z, lab, *rest):
+        z32 = z.astype(jnp.float32)
+        i = 0
+        w = None
+        pw = None
+        if weight is not None:
+            w = rest[i]
+            i += 1
+        if pos_weight is not None:
+            pw = rest[i]
+        log_sig = jax.nn.log_sigmoid(z32)
+        log_sig_neg = jax.nn.log_sigmoid(-z32)
+        if pw is not None:
+            loss = -(pw * lab * log_sig + (1 - lab) * log_sig_neg)
+        else:
+            loss = -(lab * log_sig + (1 - lab) * log_sig_neg)
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+
+    args = [logit, label] + [a for a in (weight, pos_weight) if a is not None]
+    return apply_fn("bce_with_logits", fn, *args, _opdef=_XENT)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def fn(lp, t):
+        if log_target:
+            loss = jnp.exp(t) * (t - lp)
+        else:
+            loss = t * (jnp.log(jnp.maximum(t, 1e-12)) - lp)
+        if reduction == "batchmean":
+            return loss.sum() / lp.shape[0]
+        return _reduce(loss, reduction)
+
+    return apply_fn("kl_div", fn, input, label)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    def fn(a, b, lab):
+        loss = jnp.maximum(-lab * (a - b) + margin, 0.0)
+        return _reduce(loss, reduction)
+
+    return apply_fn("margin_ranking_loss", fn, input, other, label)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    def fn(a, lab):
+        loss = jnp.where(lab == 1, a, jnp.maximum(0.0, margin - a))
+        return _reduce(loss, reduction)
+
+    return apply_fn("hinge_embedding_loss", fn, input, label)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    def fn(a, b, lab):
+        cos = (a * b).sum(-1) / jnp.maximum(jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        loss = jnp.where(lab == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+
+    return apply_fn("cosine_embedding_loss", fn, input1, input2, label)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def fn(a, pos, neg):
+        dp = jnp.linalg.norm(a - pos + epsilon, ord=p, axis=-1)
+        dn = jnp.linalg.norm(a - neg + epsilon, ord=p, axis=-1)
+        if swap:
+            dn2 = jnp.linalg.norm(pos - neg + epsilon, ord=p, axis=-1)
+            dn = jnp.minimum(dn, dn2)
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+
+    return apply_fn("triplet_margin_loss", fn, input, positive, negative)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0, reduction="sum", name=None):
+    def fn(z, lab, *nrm):
+        p = jax.nn.sigmoid(z)
+        ce = -(lab * jax.nn.log_sigmoid(z) + (1 - lab) * jax.nn.log_sigmoid(-z))
+        p_t = p * lab + (1 - p) * (1 - lab)
+        loss = ce * ((1 - p_t) ** gamma)
+        if alpha >= 0:
+            a_t = alpha * lab + (1 - alpha) * (1 - lab)
+            loss = a_t * loss
+        if nrm:
+            loss = loss / nrm[0]
+        return _reduce(loss, reduction)
+
+    args = [logit, label] + ([normalizer] if normalizer is not None else [])
+    return apply_fn("sigmoid_focal_loss", fn, *args)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return apply_fn(
+        "log_loss",
+        lambda p, l: -l * jnp.log(p + epsilon) - (1 - l) * jnp.log(1 - p + epsilon),
+        input,
+        label,
+    )
+
+
+def square_error_cost(input, label):
+    return apply_fn("square_error_cost", lambda a, b: jnp.square(a - b), input, label)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction="mean", norm_by_times=False):
+    raise NotImplementedError("ctc_loss lands with the audio suite (tracked in docs/PARITY.md)")
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    def fn(p, l):
+        l_oh = jax.nn.one_hot(l.squeeze(-1).astype(jnp.int32), p.shape[-1], dtype=p.dtype)
+        inter = (p * l_oh).sum(axis=tuple(range(1, p.ndim)))
+        union = p.sum(axis=tuple(range(1, p.ndim))) + l_oh.sum(axis=tuple(range(1, p.ndim)))
+        return (1 - (2 * inter + epsilon) / (union + epsilon)).mean()
+
+    return apply_fn("dice_loss", fn, input, label)

@@ -1,0 +1,180 @@
+"""Normalization functionals (reference: python/paddle/nn/functional/norm.py).
+
+AMP-black ops: statistics computed in fp32 regardless of input dtype, matching the
+reference's norm kernels; XLA fuses the whole normalize+affine chain on TPU.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.op_registry import apply_fn
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    nd = len(tuple(normalized_shape))
+
+    def fn(a, *wb):
+        axes = tuple(range(a.ndim - nd, a.ndim))
+        dt = a.dtype
+        af = a.astype(jnp.float32)
+        mean = af.mean(axis=axes, keepdims=True)
+        var = af.var(axis=axes, keepdims=True)
+        out = (af - mean) / jnp.sqrt(var + epsilon)
+        out = out.astype(dt)
+        i = 0
+        if weight is not None:
+            out = out * wb[i]
+            i += 1
+        if bias is not None:
+            out = out + wb[i]
+        return out
+
+    args = [x] + [w for w in (weight, bias) if w is not None]
+    return apply_fn("layer_norm", fn, *args)
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm (the reference exposes it as incubate fused_rms_norm)."""
+
+    def fn(a, *w):
+        dt = a.dtype
+        af = a.astype(jnp.float32)
+        ms = jnp.mean(jnp.square(af), axis=-1, keepdims=True)
+        out = (af * jnp.reciprocal(jnp.sqrt(ms + epsilon))).astype(dt)
+        if w:
+            out = out * w[0]
+        return out
+
+    args = [x] + ([weight] if weight is not None else [])
+    return apply_fn("rms_norm", fn, *args)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=False, momentum=0.9, epsilon=1e-5, data_format="NCHW", use_global_stats=None, name=None):
+    ch_axis = 1 if data_format.startswith("NC") else -1
+    use_batch_stats = training and not use_global_stats
+
+    def fn(a, rm, rv, *wb):
+        shape = [1] * a.ndim
+        shape[ch_axis] = a.shape[ch_axis]
+        axes = tuple(i for i in range(a.ndim) if i != (ch_axis % a.ndim))
+        if use_batch_stats:
+            mean = a.astype(jnp.float32).mean(axis=axes)
+            var = a.astype(jnp.float32).var(axis=axes)
+        else:
+            mean, var = rm, rv
+        out = (a - mean.reshape(shape).astype(a.dtype)) * (
+            1.0 / jnp.sqrt(var.reshape(shape).astype(jnp.float32) + epsilon)
+        ).astype(a.dtype)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        if not use_batch_stats:
+            return out
+        n = 1
+        for ax in axes:
+            n *= a.shape[ax]
+        unbiased = var * n / max(n - 1, 1)
+        new_mean = momentum * rm + (1 - momentum) * mean.astype(rm.dtype)
+        new_var = momentum * rv + (1 - momentum) * unbiased.astype(rv.dtype)
+        return out, new_mean, new_var
+
+    args = [x, running_mean, running_var] + [w for w in (weight, bias) if w is not None]
+    res = apply_fn("batch_norm", fn, *args)
+    if not use_batch_stats:
+        return res
+
+    out, new_mean_t, new_var_t = res
+    # update running stats (mirrors the reference's in-kernel update). Under a
+    # trace (jitted train step) the update is staged on the buffer as
+    # `_pending_update`; the functionalized step (hapi/model.py) threads it
+    # through as carried state.
+    import jax
+
+    if isinstance(new_mean_t._data, jax.core.Tracer):
+        running_mean._pending_update = new_mean_t._data
+        running_var._pending_update = new_var_t._data
+    else:
+        running_mean.set_value(new_mean_t._data)
+        running_var.set_value(new_var_t._data)
+    return out
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None, use_input_stats=True, momentum=0.9, eps=1e-5, data_format="NCHW", name=None):
+    def fn(a, *wb):
+        axes = tuple(range(2, a.ndim))
+        mean = a.mean(axis=axes, keepdims=True)
+        var = a.var(axis=axes, keepdims=True)
+        out = (a - mean) / jnp.sqrt(var + eps)
+        shape = [1, a.shape[1]] + [1] * (a.ndim - 2)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    args = [x] + [w for w in (weight, bias) if w is not None]
+    return apply_fn("instance_norm", fn, *args)
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None, data_format="NCHW", name=None):
+    def fn(a, *wb):
+        if data_format == "NLC" or not data_format.startswith("NC"):
+            a_t = jnp.moveaxis(a, -1, 1)
+        else:
+            a_t = a
+        n, c = a_t.shape[0], a_t.shape[1]
+        g = num_groups
+        grouped = a_t.reshape(n, g, c // g, *a_t.shape[2:])
+        axes = tuple(range(2, grouped.ndim))
+        mean = grouped.mean(axis=axes, keepdims=True)
+        var = grouped.var(axis=axes, keepdims=True)
+        out = ((grouped - mean) / jnp.sqrt(var + epsilon)).reshape(a_t.shape)
+        shape = [1, c] + [1] * (a_t.ndim - 2)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        if data_format == "NLC" or not data_format.startswith("NC"):
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    args = [x] + [w for w in (weight, bias) if w is not None]
+    return apply_fn("group_norm", fn, *args)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW", name=None):
+    def fn(a):
+        sq = jnp.square(a)
+        c = a.shape[1]
+        half = size // 2
+        padded = jnp.pad(sq, ((0, 0), (half, size - 1 - half)) + ((0, 0),) * (a.ndim - 2))
+        acc = jnp.zeros_like(a)
+        for i in range(size):
+            acc = acc + padded[:, i : i + c]
+        return a / (k + alpha * acc) ** beta
+
+    return apply_fn("local_response_norm", fn, x)
+
+
+def spectral_norm(x, weight_u, weight_v, dim=0, power_iters=1, eps=1e-12, name=None):
+    def fn(w, u, v):
+        w_mat = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+        for _ in range(power_iters):
+            v = w_mat.T @ u
+            v = v / (jnp.linalg.norm(v) + eps)
+            u = w_mat @ v
+            u = u / (jnp.linalg.norm(u) + eps)
+        sigma = u @ w_mat @ v
+        return w / sigma
+
+    return apply_fn("spectral_norm", fn, x, weight_u, weight_v)

@@ -1,0 +1,147 @@
+"""Pooling functionals via ``lax.reduce_window`` (reference: nn/functional/pooling.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.op_registry import apply_fn
+
+
+def _tuplize(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v[:n]) if len(v) >= n else tuple(int(v[0]) for _ in range(n))
+    return tuple(int(v) for _ in range(n))
+
+
+def _pool(x, kernel, stride, padding, n, reducer, init, channels_first, count_include_pad=True, ceil_mode=False, is_avg=False):
+    kernel = _tuplize(kernel, n)
+    stride = _tuplize(stride if stride is not None else kernel, n)
+    pad = _tuplize(padding, n)
+
+    def fn(a):
+        if channels_first:
+            dims = (1, 1) + kernel
+            strides = (1, 1) + stride
+            pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+        else:
+            dims = (1,) + kernel + (1,)
+            strides = (1,) + stride + (1,)
+            pads = ((0, 0),) + tuple((p, p) for p in pad) + ((0, 0),)
+        out = jax.lax.reduce_window(a, init(a.dtype), reducer, dims, strides, pads)
+        if is_avg:
+            if count_include_pad:
+                denom = float(np.prod(kernel))
+                out = out / denom
+            else:
+                ones = jnp.ones_like(a)
+                cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims, strides, pads)
+                out = out / cnt
+        return out
+
+    return apply_fn("pool", fn, x)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1, jax.lax.max, lambda dt: -jnp.inf if jnp.issubdtype(dt, jnp.floating) else int(jnp.iinfo(dt).min), data_format.startswith("NC"), ceil_mode=ceil_mode)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, jax.lax.max, lambda dt: -jnp.inf if jnp.issubdtype(dt, jnp.floating) else int(jnp.iinfo(dt).min), data_format.startswith("NC"), ceil_mode=ceil_mode)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, jax.lax.max, lambda dt: -jnp.inf if jnp.issubdtype(dt, jnp.floating) else int(jnp.iinfo(dt).min), data_format.startswith("NC"), ceil_mode=ceil_mode)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1, jax.lax.add, lambda dt: 0.0 if jnp.issubdtype(dt, jnp.floating) else 0, data_format.startswith("NC"), count_include_pad=not exclusive, ceil_mode=ceil_mode, is_avg=True)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, jax.lax.add, lambda dt: 0.0 if jnp.issubdtype(dt, jnp.floating) else 0, data_format.startswith("NC"), count_include_pad=not exclusive, ceil_mode=ceil_mode, is_avg=True)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, jax.lax.add, lambda dt: 0.0 if jnp.issubdtype(dt, jnp.floating) else 0, data_format.startswith("NC"), count_include_pad=not exclusive, ceil_mode=ceil_mode, is_avg=True)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive(x, output_size, 1, "avg", True)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive(x, output_size, 2, "avg", data_format.startswith("NC"))
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive(x, output_size, 3, "avg", data_format.startswith("NC"))
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 1, "max", True)
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 2, "max", True)
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 3, "max", True)
+
+
+def _adaptive(x, output_size, n, kind, channels_first):
+    out_sz = _tuplize(output_size, n)
+
+    def fn(a):
+        spatial = a.shape[2:] if channels_first else a.shape[1:-1]
+        out = a
+        # pool each spatial dim independently with variable windows
+        for d in range(n):
+            in_s, out_s = spatial[d], out_sz[d]
+            axis = (2 + d) if channels_first else (1 + d)
+            if out_s == in_s:
+                continue
+            starts = [int(np.floor(i * in_s / out_s)) for i in range(out_s)]
+            ends = [int(np.ceil((i + 1) * in_s / out_s)) for i in range(out_s)]
+            segs = []
+            for s, e in zip(starts, ends):
+                sl = [slice(None)] * out.ndim
+                sl[axis] = slice(s, e)
+                seg = out[tuple(sl)]
+                seg = seg.mean(axis=axis, keepdims=True) if kind == "avg" else seg.max(axis=axis, keepdims=True)
+                segs.append(seg)
+            out = jnp.concatenate(segs, axis=axis)
+        return out
+
+    return apply_fn("adaptive_pool", fn, x)
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0, ceil_mode=False, data_format="NCL", name=None):
+    from .activation import relu
+
+    p = float(norm_type)
+
+    def fn(a):
+        k = _tuplize(kernel_size, 1)
+        s = _tuplize(stride if stride is not None else kernel_size, 1)
+        powed = jnp.abs(a) ** p
+        summed = jax.lax.reduce_window(powed, 0.0, jax.lax.add, (1, 1) + k, (1, 1) + s, ((0, 0), (0, 0), (padding, padding)))
+        return summed ** (1.0 / p)
+
+    return apply_fn("lp_pool1d", fn, x)
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0, ceil_mode=False, data_format="NCHW", name=None):
+    p = float(norm_type)
+
+    def fn(a):
+        k = _tuplize(kernel_size, 2)
+        s = _tuplize(stride if stride is not None else kernel_size, 2)
+        pd = _tuplize(padding, 2)
+        powed = jnp.abs(a) ** p
+        summed = jax.lax.reduce_window(powed, 0.0, jax.lax.add, (1, 1) + k, (1, 1) + s, ((0, 0), (0, 0)) + tuple((q, q) for q in pd))
+        return summed ** (1.0 / p)
+
+    return apply_fn("lp_pool2d", fn, x)

@@ -1,0 +1,82 @@
+"""Activation layers (reference: python/paddle/nn/layer/activation.py)."""
+
+from __future__ import annotations
+
+from .. import functional as F
+from .. import initializer as I
+from .layers import Layer
+
+
+def _make(name, fn_name, **fixed):
+    class _Act(Layer):
+        def __init__(self, *args, **kwargs):
+            super().__init__()
+            self._kwargs = {**fixed}
+            argnames = _ARG_NAMES.get(fn_name, [])
+            for i, v in enumerate(args):
+                self._kwargs[argnames[i]] = v
+            for k, v in kwargs.items():
+                if k != "name":
+                    self._kwargs[k] = v
+
+        def forward(self, x):
+            return getattr(F, fn_name)(x, **self._kwargs)
+
+    _Act.__name__ = name
+    return _Act
+
+
+_ARG_NAMES = {
+    "leaky_relu": ["negative_slope"],
+    "elu": ["alpha"],
+    "celu": ["alpha"],
+    "gelu": ["approximate"],
+    "hardtanh": ["min", "max"],
+    "hardshrink": ["threshold"],
+    "softshrink": ["threshold"],
+    "thresholded_relu": ["threshold", "value"],
+    "softplus": ["beta", "threshold"],
+    "softmax": ["axis"],
+    "log_softmax": ["axis"],
+    "maxout": ["groups", "axis"],
+    "glu": ["axis"],
+    "hardsigmoid": ["slope", "offset"],
+}
+
+ReLU = _make("ReLU", "relu")
+ReLU6 = _make("ReLU6", "relu6")
+LeakyReLU = _make("LeakyReLU", "leaky_relu")
+ELU = _make("ELU", "elu")
+CELU = _make("CELU", "celu")
+SELU = _make("SELU", "selu")
+GELU = _make("GELU", "gelu")
+Silu = _make("Silu", "silu")
+Swish = _make("Swish", "silu")
+Mish = _make("Mish", "mish")
+Hardswish = _make("Hardswish", "hardswish")
+Hardsigmoid = _make("Hardsigmoid", "hardsigmoid")
+Hardtanh = _make("Hardtanh", "hardtanh")
+Hardshrink = _make("Hardshrink", "hardshrink")
+Softshrink = _make("Softshrink", "softshrink")
+Tanhshrink = _make("Tanhshrink", "tanhshrink")
+ThresholdedReLU = _make("ThresholdedReLU", "thresholded_relu")
+Softplus = _make("Softplus", "softplus")
+Softsign = _make("Softsign", "softsign")
+Sigmoid = _make("Sigmoid", "sigmoid")
+LogSigmoid = _make("LogSigmoid", "logsigmoid")
+Tanh = _make("Tanh", "tanh")
+Softmax = _make("Softmax", "softmax")
+LogSoftmax = _make("LogSoftmax", "log_softmax")
+Maxout = _make("Maxout", "maxout")
+GLU = _make("GLU", "glu")
+RReLU = _make("RReLU", "rrelu")
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        self._data_format = data_format
+        self.weight = self.create_parameter([num_parameters], attr=weight_attr, default_initializer=I.Constant(init))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, self._data_format)

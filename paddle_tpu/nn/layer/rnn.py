@@ -1,0 +1,281 @@
+"""Recurrent layers via ``lax.scan`` (reference: python/paddle/nn/layer/rnn.py).
+
+The reference dispatches to cuDNN fused RNN kernels; on TPU the idiomatic form is a
+``lax.scan`` over time with the gate matmuls batched onto the MXU — XLA pipelines the
+scan body. Weight layout mirrors the reference: per layer/direction
+weight_ih [gates*h, in], weight_hh [gates*h, h], bias_ih, bias_hh.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.op_registry import apply_fn
+from ...core.tensor import Tensor
+from .. import initializer as I
+from .layers import Layer
+
+
+def _cell_step(mode, x, h, c, w_ih, w_hh, b_ih, b_hh, activation="tanh"):
+    gates = x @ w_ih.T + h @ w_hh.T
+    if b_ih is not None:
+        gates = gates + b_ih + b_hh
+    if mode == "LSTM":
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        return h_new, c_new
+    if mode == "GRU":
+        r, z, n_ih = jnp.split(x @ w_ih.T + (b_ih if b_ih is not None else 0.0), 3, axis=-1)
+        r_hh, z_hh, n_hh = jnp.split(h @ w_hh.T + (b_hh if b_hh is not None else 0.0), 3, axis=-1)
+        r = jax.nn.sigmoid(r + r_hh)
+        z = jax.nn.sigmoid(z + z_hh)
+        n = jnp.tanh(n_ih + r * n_hh)
+        h_new = (1 - z) * n + z * h
+        return h_new, None
+    act = jnp.tanh if activation == "tanh" else jax.nn.relu
+    h_new = act(gates)
+    return h_new, None
+
+
+class _RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.activation = activation
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        num_dirs = 2 if self.bidirect else 1
+        gates = {"LSTM": 4, "GRU": 3, "RNN": 1}[mode]
+        self._all_weights = []
+        for layer in range(num_layers):
+            for d in range(num_dirs):
+                in_sz = input_size if layer == 0 else hidden_size * num_dirs
+                sfx = f"_l{layer}" + ("_reverse" if d else "")
+                k = hidden_size ** -0.5
+                w_ih = self.create_parameter([gates * hidden_size, in_sz], attr=weight_ih_attr,
+                                             default_initializer=I.Uniform(-k, k))
+                w_hh = self.create_parameter([gates * hidden_size, hidden_size], attr=weight_hh_attr,
+                                             default_initializer=I.Uniform(-k, k))
+                b_ih = self.create_parameter([gates * hidden_size], attr=bias_ih_attr, is_bias=True,
+                                             default_initializer=I.Uniform(-k, k)) if bias_ih_attr is not False else None
+                b_hh = self.create_parameter([gates * hidden_size], attr=bias_hh_attr, is_bias=True,
+                                             default_initializer=I.Uniform(-k, k)) if bias_hh_attr is not False else None
+                self.add_parameter(f"weight_ih{sfx}", w_ih)
+                self.add_parameter(f"weight_hh{sfx}", w_hh)
+                if b_ih is not None:
+                    self.add_parameter(f"bias_ih{sfx}", b_ih)
+                    self.add_parameter(f"bias_hh{sfx}", b_hh)
+                self._all_weights.append((f"weight_ih{sfx}", f"weight_hh{sfx}",
+                                          f"bias_ih{sfx}" if b_ih is not None else None,
+                                          f"bias_hh{sfx}" if b_hh is not None else None))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        mode = self.mode
+        num_dirs = 2 if self.bidirect else 1
+        time_major = self.time_major
+        nl, hs = self.num_layers, self.hidden_size
+        act = self.activation
+
+        weights = []
+        for names in self._all_weights:
+            weights.extend(self._parameters[n] if n is not None else None for n in names)
+        flat_w = [w for w in weights if w is not None]
+        has_bias = weights[2] is not None
+
+        state_is_tuple = mode == "LSTM"
+        if initial_states is not None:
+            init_list = list(initial_states) if state_is_tuple else [initial_states]
+        else:
+            init_list = []
+
+        def fn(x, *ws):
+            ws = list(ws)
+            widx = 0
+            if init_list:
+                if state_is_tuple:
+                    h0_all, c0_all = ws[-2], ws[-1]
+                    params = ws[:-2]
+                else:
+                    h0_all = ws[-1]
+                    c0_all = None
+                    params = ws[:-1]
+            else:
+                params = ws
+                h0_all = c0_all = None
+            xt = x if time_major else jnp.swapaxes(x, 0, 1)  # [T, B, F]
+            B = xt.shape[1]
+            h_states, c_states = [], []
+            per_dir = 4 if has_bias else 2
+            for layer in range(nl):
+                outs = []
+                for d in range(num_dirs):
+                    base = (layer * num_dirs + d) * per_dir
+                    w_ih, w_hh = params[base], params[base + 1]
+                    b_ih = params[base + 2] if has_bias else None
+                    b_hh = params[base + 3] if has_bias else None
+                    li = layer * num_dirs + d
+                    h0 = h0_all[li] if h0_all is not None else jnp.zeros((B, hs), xt.dtype)
+                    c0 = c0_all[li] if c0_all is not None else jnp.zeros((B, hs), xt.dtype)
+                    seq = jnp.flip(xt, 0) if d == 1 else xt
+
+                    def step(carry, xi):
+                        h, c = carry
+                        h2, c2 = _cell_step(mode, xi, h, c, w_ih, w_hh, b_ih, b_hh, act)
+                        return (h2, c2 if c2 is not None else c), h2
+
+                    (hT, cT), ys = jax.lax.scan(step, (h0, c0), seq)
+                    if d == 1:
+                        ys = jnp.flip(ys, 0)
+                    outs.append(ys)
+                    h_states.append(hT)
+                    c_states.append(cT)
+                xt = outs[0] if num_dirs == 1 else jnp.concatenate(outs, axis=-1)
+            out = xt if time_major else jnp.swapaxes(xt, 0, 1)
+            h_final = jnp.stack(h_states, 0)
+            if mode == "LSTM":
+                return out, h_final, jnp.stack(c_states, 0)
+            return out, h_final
+
+        args = [inputs] + flat_w + init_list
+        res = apply_fn("rnn_" + mode.lower(), fn, *args)
+        if mode == "LSTM":
+            out, h, c = res
+            return out, (h, c)
+        out, h = res
+        return out, h
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh", **kwargs):
+        super().__init__("RNN", input_size, hidden_size, num_layers, direction, time_major, dropout, activation, **kwargs)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kwargs):
+        kwargs.pop("activation", None)
+        super().__init__("LSTM", input_size, hidden_size, num_layers, direction, time_major, dropout, **kwargs)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kwargs):
+        kwargs.pop("activation", None)
+        super().__init__("GRU", input_size, hidden_size, num_layers, direction, time_major, dropout, **kwargs)
+
+
+class _CellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None, init_value=0.0, batch_dim_idx=0):
+        b = batch_ref.shape[batch_dim_idx]
+        return Tensor(jnp.full((b, self.hidden_size), init_value, jnp.float32))
+
+
+class SimpleRNNCell(_CellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh", **kwargs):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.activation = activation
+        k = hidden_size ** -0.5
+        self.weight_ih = self.create_parameter([hidden_size, input_size], default_initializer=I.Uniform(-k, k))
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size], default_initializer=I.Uniform(-k, k))
+        self.bias_ih = self.create_parameter([hidden_size], is_bias=True, default_initializer=I.Uniform(-k, k))
+        self.bias_hh = self.create_parameter([hidden_size], is_bias=True, default_initializer=I.Uniform(-k, k))
+
+    def forward(self, inputs, states=None):
+        states = states if states is not None else self.get_initial_states(inputs)
+
+        def fn(x, h, w_ih, w_hh, b_ih, b_hh):
+            h2, _ = _cell_step("RNN", x, h, None, w_ih, w_hh, b_ih, b_hh, self.activation)
+            return h2
+
+        h = apply_fn("rnn_cell", fn, inputs, states, self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh)
+        return h, h
+
+
+class LSTMCell(_CellBase):
+    def __init__(self, input_size, hidden_size, **kwargs):
+        super().__init__()
+        self.hidden_size = hidden_size
+        k = hidden_size ** -0.5
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size], default_initializer=I.Uniform(-k, k))
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size], default_initializer=I.Uniform(-k, k))
+        self.bias_ih = self.create_parameter([4 * hidden_size], is_bias=True, default_initializer=I.Uniform(-k, k))
+        self.bias_hh = self.create_parameter([4 * hidden_size], is_bias=True, default_initializer=I.Uniform(-k, k))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            h = self.get_initial_states(inputs)
+            c = self.get_initial_states(inputs)
+        else:
+            h, c = states
+
+        def fn(x, hh, cc, w_ih, w_hh, b_ih, b_hh):
+            return _cell_step("LSTM", x, hh, cc, w_ih, w_hh, b_ih, b_hh)
+
+        h2, c2 = apply_fn("lstm_cell", fn, inputs, h, c, self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh)
+        return h2, (h2, c2)
+
+
+class GRUCell(_CellBase):
+    def __init__(self, input_size, hidden_size, **kwargs):
+        super().__init__()
+        self.hidden_size = hidden_size
+        k = hidden_size ** -0.5
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size], default_initializer=I.Uniform(-k, k))
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size], default_initializer=I.Uniform(-k, k))
+        self.bias_ih = self.create_parameter([3 * hidden_size], is_bias=True, default_initializer=I.Uniform(-k, k))
+        self.bias_hh = self.create_parameter([3 * hidden_size], is_bias=True, default_initializer=I.Uniform(-k, k))
+
+    def forward(self, inputs, states=None):
+        states = states if states is not None else self.get_initial_states(inputs)
+
+        def fn(x, h, w_ih, w_hh, b_ih, b_hh):
+            h2, _ = _cell_step("GRU", x, h, None, w_ih, w_hh, b_ih, b_hh)
+            return h2
+
+        h = apply_fn("gru_cell", fn, inputs, states, self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh)
+        return h, h
+
+
+class RNN(Layer):
+    """Wraps a cell into a scan over time (reference nn.RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        xt = inputs if self.time_major else inputs.transpose([1, 0, 2])
+        T = xt.shape[0]
+        if self.is_reverse:
+            from ...tensor.manipulation import flip
+
+            xt = flip(xt, [0])
+        outs = []
+        state = initial_states
+        for t in range(T):
+            o, state = self.cell(xt[t], state)
+            outs.append(o)
+        from ...tensor.manipulation import stack
+
+        out = stack(outs, 0)
+        if self.is_reverse:
+            from ...tensor.manipulation import flip
+
+            out = flip(out, [0])
+        if not self.time_major:
+            out = out.transpose([1, 0, 2])
+        return out, state

@@ -1,0 +1,18 @@
+"""paddle_tpu.optimizer (reference: python/paddle/optimizer)."""
+
+from . import lr  # noqa: F401
+from .optimizer import (  # noqa: F401
+    LBFGS,
+    SGD,
+    Adadelta,
+    Adagrad,
+    Adam,
+    Adamax,
+    AdamW,
+    Lamb,
+    Momentum,
+    NAdam,
+    Optimizer,
+    RAdam,
+    RMSProp,
+)

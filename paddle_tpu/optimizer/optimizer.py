@@ -1,0 +1,452 @@
+"""Optimizer base + concrete optimizers (reference: python/paddle/optimizer/).
+
+Redesign vs reference: the reference routes every update through fused CUDA kernels
+(e.g. adamw.py:495 -> _C_ops.adamw_). Here each optimizer defines a pure per-tensor
+``_update(g, p, state) -> (new_p, new_state)`` in jnp; eager ``step()`` loops params
+(XLA fuses per-param chains), while the Trainer/hapi path jit-compiles
+``apply_gradients`` over the whole param pytree — one fused update kernel per step,
+donation-friendly.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd_engine import no_grad
+from ..core.tensor import Parameter, Tensor
+from .lr import LRScheduler
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None, grad_clip=None, name=None):
+        self._lr = learning_rate
+        self._parameter_list = list(parameters) if parameters is not None else None
+        self._param_groups = None
+        if self._parameter_list and isinstance(self._parameter_list[0], dict):
+            self._param_groups = self._parameter_list
+            flat = []
+            for g in self._param_groups:
+                flat.extend(g["params"])
+            self._parameter_list = flat
+        self._weight_decay = weight_decay
+        self._grad_clip = grad_clip
+        self._accumulators: Dict[str, Dict[int, jnp.ndarray]] = {}
+        self._step_count = 0
+
+    # ---- lr ----
+    def get_lr(self) -> float:
+        if isinstance(self._lr, LRScheduler):
+            return float(self._lr())
+        return float(self._lr)
+
+    def set_lr(self, value):
+        self._lr = value
+
+    def set_lr_scheduler(self, scheduler):
+        self._lr = scheduler
+
+    @property
+    def _learning_rate(self):
+        return self._lr
+
+    # ---- state ----
+    def _id_to_key(self):
+        """Stable serialization keys: position in the parameter list (id(p) is
+        runtime-only and would break checkpoint restore across processes)."""
+        return {id(p): str(i) for i, p in enumerate(self._parameter_list or [])}
+
+    def state_dict(self):
+        out = {"step_count": self._step_count}
+        id2key = self._id_to_key()
+        acc = {}
+        for name, d in self._accumulators.items():
+            acc[name] = {id2key.get(k, str(k)): Tensor(v) for k, v in d.items()}
+        out["accumulators"] = acc
+        if isinstance(self._lr, LRScheduler):
+            out["LR_Scheduler"] = self._lr.state_dict()
+        return out
+
+    def set_state_dict(self, state):
+        self._step_count = state.get("step_count", 0)
+        params = self._parameter_list or []
+        for name, d in state.get("accumulators", {}).items():
+            restored = {}
+            for k, v in d.items():
+                arr = v._data if isinstance(v, Tensor) else jnp.asarray(v)
+                idx = int(k)
+                if 0 <= idx < len(params):
+                    restored[id(params[idx])] = arr
+            self._accumulators[name] = restored
+        if "LR_Scheduler" in state and isinstance(self._lr, LRScheduler):
+            self._lr.set_state_dict(state["LR_Scheduler"])
+
+    def _acc(self, name, p: Tensor, init=None, dtype=None):
+        d = self._accumulators.setdefault(name, {})
+        key = id(p)
+        if key not in d:
+            d[key] = jnp.zeros(tuple(p.shape), dtype or jnp.float32) if init is None else init
+        return d[key]
+
+    def _set_acc(self, name, p: Tensor, value):
+        self._accumulators[name][id(p)] = value
+
+    # ---- update ----
+    def _update(self, grad, param_value, p: Tensor, lr):
+        raise NotImplementedError
+
+    def _apply_weight_decay(self, p, g):
+        """L2 regularization folded into the gradient (reference 'weight_decay' regularizer)."""
+        wd = self._weight_decay
+        if wd is None or isinstance(self, _DecoupledWeightDecay):
+            return g
+        coeff = wd if isinstance(wd, float) else getattr(wd, "_coeff", 0.0)
+        if coeff:
+            return g + coeff * p._data.astype(g.dtype)
+        return g
+
+    @no_grad()
+    def step(self):
+        params = self._parameter_list
+        if params is None:
+            raise ValueError("optimizer created without parameters")
+        pg = [(p, p.grad) for p in params if isinstance(p, Tensor)]
+        if self._grad_clip is not None:
+            pg = self._grad_clip(pg)
+        self._step_count += 1
+        for p, g in pg:
+            if g is None or not getattr(p, "trainable", True):
+                continue
+            garr = g._data.astype(jnp.float32) if g.dtype != jnp.float32 else g._data
+            garr = self._apply_weight_decay(p, garr)
+            plr = self.get_lr() * p.optimize_attr.get("learning_rate", 1.0) if hasattr(p, "optimize_attr") else self.get_lr()
+            new_val = self._update(garr, p._data, p, plr)
+            p._data = new_val.astype(p.dtype) if new_val.dtype != p.dtype else new_val
+
+    def _functional_update(self, grads, values, params, acc_state, lr, step):
+        """Pure-pytree update used by jit-compiled train steps (hapi / Trainer).
+
+        Temporarily swaps the accumulator store and step counter for traced values so
+        the per-param ``_update`` rules run unchanged inside a jax trace; the mutated
+        accumulator dict becomes the new optimizer state pytree.
+        """
+        saved_acc, saved_step = self._accumulators, self._step_count
+        self._accumulators = acc_state
+        self._step_count = step
+        try:
+            new_vals = []
+            for g, v, p in zip(grads, values, params):
+                if g is None:
+                    new_vals.append(v)
+                    continue
+                out = self._update(g, v, p, lr)
+                new_vals.append(out.astype(v.dtype) if out.dtype != v.dtype else out)
+        finally:
+            new_acc = self._accumulators
+            self._accumulators = saved_acc
+            self._step_count = saved_step
+        return new_vals, new_acc
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, [(p, p.grad) for p in (self._parameter_list or [])]
+
+    @no_grad()
+    def clear_grad(self, set_to_zero=True):
+        for p in self._parameter_list or []:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def _lr_step(self):
+        if isinstance(self._lr, LRScheduler):
+            self._lr.step()
+
+
+class _DecoupledWeightDecay:
+    pass
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+
+    def _update(self, g, val, p, lr):
+        return val - lr * g.astype(val.dtype)
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None, use_nesterov=False,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _update(self, g, val, p, lr):
+        v = self._acc("velocity", p)
+        v = self._momentum * v + g
+        self._set_acc("velocity", p, v)
+        if self._nesterov:
+            return val - lr * (g + self._momentum * v).astype(val.dtype)
+        return val - lr * v.astype(val.dtype)
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None, weight_decay=None,
+                 grad_clip=None, initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _update(self, g, val, p, lr):
+        m = self._acc("moment", p, init=jnp.full(tuple(p.shape), self._init_acc, jnp.float32))
+        m = m + g * g
+        self._set_acc("moment", p, m)
+        return val - (lr * g / (jnp.sqrt(m) + self._epsilon)).astype(val.dtype)
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0, centered=False,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._epsilon, self._momentum, self._centered = rho, epsilon, momentum, centered
+
+    def _update(self, g, val, p, lr):
+        ms = self._acc("mean_square", p)
+        ms = self._rho * ms + (1 - self._rho) * g * g
+        self._set_acc("mean_square", p, ms)
+        if self._centered:
+            mg = self._acc("mean_grad", p)
+            mg = self._rho * mg + (1 - self._rho) * g
+            self._set_acc("mean_grad", p, mg)
+            denom = jnp.sqrt(ms - mg * mg + self._epsilon)
+        else:
+            denom = jnp.sqrt(ms + self._epsilon)
+        mom = self._acc("momentum", p)
+        mom = self._momentum * mom + lr * g / denom
+        self._set_acc("momentum", p, mom)
+        return val - mom.astype(val.dtype)
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _update(self, g, val, p, lr):
+        avg_sq = self._acc("avg_squared_grad", p)
+        avg_upd = self._acc("avg_squared_update", p)
+        avg_sq = self._rho * avg_sq + (1 - self._rho) * g * g
+        upd = jnp.sqrt(avg_upd + self._epsilon) / jnp.sqrt(avg_sq + self._epsilon) * g
+        avg_upd = self._rho * avg_upd + (1 - self._rho) * upd * upd
+        self._set_acc("avg_squared_grad", p, avg_sq)
+        self._set_acc("avg_squared_update", p, avg_upd)
+        return val - (lr * upd).astype(val.dtype)
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, parameters=None,
+                 weight_decay=None, grad_clip=None, lazy_mode=False, multi_precision=False,
+                 use_multi_tensor=False, amsgrad=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._amsgrad = amsgrad
+
+    def _update(self, g, val, p, lr):
+        t = self._step_count
+        m = self._acc("moment1", p)
+        v = self._acc("moment2", p)
+        m = self._beta1 * m + (1 - self._beta1) * g
+        v = self._beta2 * v + (1 - self._beta2) * g * g
+        self._set_acc("moment1", p, m)
+        self._set_acc("moment2", p, v)
+        mhat = m / (1 - self._beta1**t)
+        if self._amsgrad:
+            vmax = self._acc("moment2_max", p)
+            vmax = jnp.maximum(vmax, v)
+            self._set_acc("moment2_max", p, vmax)
+            vhat = vmax / (1 - self._beta2**t)
+        else:
+            vhat = v / (1 - self._beta2**t)
+        return val - (lr * mhat / (jnp.sqrt(vhat) + self._epsilon)).astype(val.dtype)
+
+
+class AdamW(Adam, _DecoupledWeightDecay):
+    """Decoupled weight decay (reference: optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, parameters=None,
+                 weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, amsgrad=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters, None, grad_clip,
+                         lazy_mode, multi_precision, amsgrad=amsgrad, name=name)
+        self._wd_coeff = weight_decay if isinstance(weight_decay, float) else 0.01
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _update(self, g, val, p, lr):
+        decay = True
+        if self._apply_decay_param_fun is not None:
+            decay = self._apply_decay_param_fun(p.name)
+        if decay and self._wd_coeff:
+            val = val - lr * self._wd_coeff * val
+        return super()._update(g, val, p, lr)
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _update(self, g, val, p, lr):
+        t = self._step_count
+        m = self._acc("moment", p)
+        u = self._acc("inf_norm", p)
+        m = self._beta1 * m + (1 - self._beta1) * g
+        u = jnp.maximum(self._beta2 * u, jnp.abs(g))
+        self._set_acc("moment", p, m)
+        self._set_acc("inf_norm", p, u)
+        return val - (lr / (1 - self._beta1**t) * m / (u + self._epsilon)).astype(val.dtype)
+
+
+class NAdam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 momentum_decay=0.004, parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._psi = momentum_decay
+
+    def _update(self, g, val, p, lr):
+        t = self._step_count
+        mu_t = self._beta1 * (1 - 0.5 * 0.96 ** (t * self._psi))
+        mu_t1 = self._beta1 * (1 - 0.5 * 0.96 ** ((t + 1) * self._psi))
+        m = self._acc("moment1", p)
+        v = self._acc("moment2", p)
+        m = self._beta1 * m + (1 - self._beta1) * g
+        v = self._beta2 * v + (1 - self._beta2) * g * g
+        self._set_acc("moment1", p, m)
+        self._set_acc("moment2", p, v)
+        mprod = self._acc("mu_product", p, init=jnp.ones((), jnp.float32))
+        mprod_new = mprod * mu_t
+        self._set_acc("mu_product", p, mprod_new)
+        mhat = mu_t1 * m / (1 - mprod_new * mu_t1) + (1 - mu_t) * g / (1 - mprod_new)
+        vhat = v / (1 - self._beta2**t)
+        return val - (lr * mhat / (jnp.sqrt(vhat) + self._epsilon)).astype(val.dtype)
+
+
+class RAdam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _update(self, g, val, p, lr):
+        t = self._step_count
+        m = self._acc("moment1", p)
+        v = self._acc("moment2", p)
+        m = self._beta1 * m + (1 - self._beta1) * g
+        v = self._beta2 * v + (1 - self._beta2) * g * g
+        self._set_acc("moment1", p, m)
+        self._set_acc("moment2", p, v)
+        rho_inf = 2 / (1 - self._beta2) - 1
+        rho_t = rho_inf - 2 * t * self._beta2**t / (1 - self._beta2**t)
+        mhat = m / (1 - self._beta1**t)
+        if rho_t > 4:
+            vhat = jnp.sqrt(v / (1 - self._beta2**t))
+            r = (((rho_t - 4) * (rho_t - 2) * rho_inf) / ((rho_inf - 4) * (rho_inf - 2) * rho_t)) ** 0.5
+            return val - (lr * r * mhat / (vhat + self._epsilon)).astype(val.dtype)
+        return val - (lr * mhat).astype(val.dtype)
+
+
+class Lamb(Optimizer):
+    """Layer-wise adaptive (reference: optimizer/lamb.py) for large-batch training."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, parameters=None, grad_clip=None, exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _update(self, g, val, p, lr):
+        t = self._step_count
+        m = self._acc("moment1", p)
+        v = self._acc("moment2", p)
+        m = self._beta1 * m + (1 - self._beta1) * g
+        v = self._beta2 * v + (1 - self._beta2) * g * g
+        self._set_acc("moment1", p, m)
+        self._set_acc("moment2", p, v)
+        mhat = m / (1 - self._beta1**t)
+        vhat = v / (1 - self._beta2**t)
+        r = mhat / (jnp.sqrt(vhat) + self._epsilon)
+        wd = 0.0 if (self._exclude_fn is not None and self._exclude_fn(p)) else self._lamb_wd
+        update = r + wd * val.astype(jnp.float32)
+        w_norm = jnp.linalg.norm(val.astype(jnp.float32))
+        u_norm = jnp.linalg.norm(update)
+        trust = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
+        return val - (lr * trust * update).astype(val.dtype)
+
+
+class LBFGS(Optimizer):
+    """Limited-memory BFGS (reference: optimizer/lbfgs.py) — line-search free variant."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None, tolerance_grad=1e-7,
+                 tolerance_change=1e-9, history_size=100, line_search_fn=None, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._max_iter = max_iter
+        self._history_size = history_size
+        self._s_hist: List = []
+        self._y_hist: List = []
+        self._prev_flat = None
+        self._prev_grad = None
+
+    def _flatten(self, tensors):
+        return jnp.concatenate([t.reshape(-1) for t in tensors])
+
+    def step(self, closure=None):
+        loss = None
+        if closure is not None:
+            loss = closure()
+        params = [p for p in self._parameter_list if p.grad is not None]
+        if not params:
+            return loss
+        flat_g = self._flatten([p.grad._data.astype(jnp.float32) for p in params])
+        flat_p = self._flatten([p._data.astype(jnp.float32) for p in params])
+        if self._prev_flat is not None:
+            s = flat_p - self._prev_flat
+            y = flat_g - self._prev_grad
+            if float(jnp.dot(s, y)) > 1e-10:
+                self._s_hist.append(s)
+                self._y_hist.append(y)
+                if len(self._s_hist) > self._history_size:
+                    self._s_hist.pop(0)
+                    self._y_hist.pop(0)
+        q = flat_g
+        alphas = []
+        for s, y in zip(reversed(self._s_hist), reversed(self._y_hist)):
+            rho = 1.0 / jnp.dot(y, s)
+            a = rho * jnp.dot(s, q)
+            q = q - a * y
+            alphas.append((a, rho, s, y))
+        if self._s_hist:
+            s, y = self._s_hist[-1], self._y_hist[-1]
+            q = q * (jnp.dot(s, y) / jnp.dot(y, y))
+        for a, rho, s, y in reversed(alphas):
+            b = rho * jnp.dot(y, q)
+            q = q + (a - b) * s
+        direction = -q
+        self._prev_flat = flat_p
+        self._prev_grad = flat_g
+        lr = self.get_lr()
+        new_flat = flat_p + lr * direction
+        off = 0
+        for p in params:
+            n = int(jnp.prod(jnp.asarray(p.shape))) if p.shape else 1
+            p._data = new_flat[off:off + n].reshape(tuple(p.shape)).astype(p.dtype)
+            off += n
+        self._step_count += 1
+        return loss

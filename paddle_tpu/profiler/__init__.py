@@ -1,0 +1,218 @@
+"""paddle_tpu.profiler (reference: python/paddle/profiler + fluid/platform/profiler).
+
+TPU-native: the device-side tracer is XLA/XPlane via ``jax.profiler`` (TensorBoard-
+compatible, replaces the reference's CUPTI CudaTracer); host-side op scopes use
+``jax.profiler.TraceAnnotation`` (the RecordEvent analogue — reference
+profiler/utils.py:47) plus a lightweight wall-clock event tree for the summary table.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from enum import Enum
+from typing import Optional
+
+import jax
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM_DEVICE = 3
+    TPU = 4
+
+
+def make_scheduler(*, closed=0, ready=0, record=1, repeat=0, skip_first=0):
+    total = closed + ready + record
+
+    def scheduler(step):
+        s = step - skip_first
+        if s < 0:
+            return ProfilerState.CLOSED
+        if repeat and s >= repeat * total:
+            return ProfilerState.CLOSED
+        pos = s % total
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == total - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    def handler(prof):
+        prof.export(dir_name)
+
+    return handler
+
+
+export_protobuf = export_chrome_tracing
+
+
+class RecordEvent:
+    """Named host scope (reference: profiler/utils.py:47). Shows up in XPlane traces
+    and in the host-side statistics table."""
+
+    _active_stack = []
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._ann = None
+        self.begin_ts = None
+
+    def begin(self):
+        self.begin_ts = time.perf_counter()
+        self._ann = jax.profiler.TraceAnnotation(self.name)
+        self._ann.__enter__()
+        _host_events.start(self.name, self.begin_ts)
+
+    def end(self):
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+            _host_events.stop(self.name, time.perf_counter())
+            self._ann = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+class _HostEvents:
+    def __init__(self):
+        self.totals = defaultdict(float)
+        self.counts = defaultdict(int)
+        self._open = {}
+
+    def start(self, name, ts):
+        self._open.setdefault(name, []).append(ts)
+
+    def stop(self, name, ts):
+        if self._open.get(name):
+            t0 = self._open[name].pop()
+            self.totals[name] += ts - t0
+            self.counts[name] += 1
+
+    def reset(self):
+        self.totals.clear()
+        self.counts.clear()
+        self._open.clear()
+
+
+_host_events = _HostEvents()
+
+
+class Profiler:
+    """Reference: python/paddle/profiler/profiler.py:358."""
+
+    def __init__(self, *, targets=None, scheduler=None, on_trace_ready=None,
+                 record_shapes=False, profile_memory=False, timer_only=False,
+                 emit_nvtx=False, custom_device_types=None, with_flops=False):
+        self._scheduler = scheduler if callable(scheduler) else (
+            make_scheduler(record=scheduler[1] - scheduler[0], closed=scheduler[0])
+            if isinstance(scheduler, (tuple, list)) else (lambda step: ProfilerState.RECORD)
+        )
+        self._on_trace_ready = on_trace_ready
+        self._timer_only = timer_only
+        self.step_num = 0
+        self._state = ProfilerState.CLOSED
+        self._trace_dir = None
+        self._tracing = False
+        self._step_times = []
+        self._last_step_ts = None
+
+    def start(self):
+        self._state = self._scheduler(self.step_num)
+        self._maybe_toggle()
+        self._last_step_ts = time.perf_counter()
+
+    def stop(self):
+        if self._tracing:
+            jax.profiler.stop_trace()
+            self._tracing = False
+        if self._on_trace_ready:
+            self._on_trace_ready(self)
+
+    def step(self, num_samples=None):
+        now = time.perf_counter()
+        if self._last_step_ts is not None:
+            self._step_times.append((now - self._last_step_ts, num_samples))
+        self._last_step_ts = now
+        self.step_num += 1
+        new_state = self._scheduler(self.step_num)
+        if new_state != self._state:
+            self._state = new_state
+            self._maybe_toggle()
+
+    def _maybe_toggle(self):
+        should_trace = self._state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN) and not self._timer_only
+        if should_trace and not self._tracing:
+            import tempfile
+
+            self._trace_dir = self._trace_dir or tempfile.mkdtemp(prefix="paddle_tpu_prof_")
+            jax.profiler.start_trace(self._trace_dir)
+            self._tracing = True
+        elif not should_trace and self._tracing:
+            jax.profiler.stop_trace()
+            self._tracing = False
+
+    def export(self, path=None, format="json"):
+        return self._trace_dir
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False, time_unit="ms"):
+        lines = ["---- host op summary (wall) ----"]
+        scale = {"ms": 1e3, "s": 1.0, "us": 1e6}[time_unit]
+        for name, total in sorted(_host_events.totals.items(), key=lambda kv: -kv[1]):
+            n = _host_events.counts[name]
+            lines.append(f"{name:<48} calls={n:<8} total={total * scale:.3f}{time_unit} avg={total / n * scale:.3f}{time_unit}")
+        if self._step_times:
+            ts = [t for t, _ in self._step_times]
+            lines.append(f"steps={len(ts)} avg_step={sum(ts) / len(ts) * 1e3:.2f}ms")
+        table = "\n".join(lines)
+        print(table)
+        return table
+
+    def step_info(self, unit=None):
+        if not self._step_times:
+            return ""
+        t, n = self._step_times[-1]
+        ips = (n / t) if (n and t > 0) else (1.0 / t if t > 0 else 0.0)
+        return f"step_time: {t * 1e3:.2f} ms, ips: {ips:.2f}"
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+@contextlib.contextmanager
+def profile(log_dir="./profiler_log"):
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def load_profiler_result(path):
+    raise NotImplementedError("open the XPlane dump with TensorBoard's profile plugin")

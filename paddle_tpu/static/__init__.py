@@ -1,0 +1,22 @@
+"""paddle_tpu.static — static-graph parity layer (reference: python/paddle/static).
+
+TPU-native design: "static mode" is jit tracing; a Program is a traced, compiled
+callable (see paddle_tpu.jit). This module keeps the mode switch + InputSpec.
+"""
+
+_static_mode = [False]
+
+
+class InputSpec:
+    def __init__(self, shape=None, dtype="float32", name=None, stop_gradient=True):
+        self.shape = list(shape) if shape is not None else None
+        self.dtype = dtype
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, str(tensor.dtype), name)
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"

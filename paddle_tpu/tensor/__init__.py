@@ -1,0 +1,165 @@
+"""Tensor op namespaces + method installation onto the Tensor class.
+
+Mirrors how the reference attaches ~320 methods to its eager Tensor
+(python/paddle/tensor/__init__.py ``tensor_method_func`` list + monkey-patch in
+base/dygraph/math_op_patch.py).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.op_registry import apply_fn
+from ..core.tensor import Tensor, unwrap
+from . import creation, linalg, manipulation, math, random, search
+from .creation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+
+
+def einsum(equation, *operands):
+    """paddle.einsum (reference: python/paddle/tensor/einsum.py) — MXU-friendly via XLA dot_general."""
+    return apply_fn("einsum", lambda *ops: jnp.einsum(equation, *ops), *operands)
+
+
+def _index_prepare(item):
+    if isinstance(item, tuple):
+        return tuple(unwrap(i) for i in item)
+    return unwrap(item)
+
+
+def _getitem(self, item):
+    idx = _index_prepare(item)
+    return apply_fn("getitem", lambda a: a[idx], self)
+
+
+def _setitem(self, item, value):
+    idx = _index_prepare(item)
+    if isinstance(value, Tensor):
+        out = apply_fn("setitem", lambda a, v: a.at[idx].set(v), self, value)
+    else:
+        out = apply_fn("setitem", lambda a: a.at[idx].set(value), self)
+    self._replace_(out._data, out._node, out._out_idx)
+
+
+def _iter(self):
+    for i in range(self.shape[0]):
+        yield self[i]
+
+
+def _install():
+    T = Tensor
+    # arithmetic operators
+    T.__add__ = lambda s, o: math.add(s, o)
+    T.__radd__ = lambda s, o: math.add(s, o)
+    T.__sub__ = lambda s, o: math.subtract(s, o)
+    T.__rsub__ = lambda s, o: math.subtract(Tensor(o) if not isinstance(o, Tensor) else o, s)
+    T.__mul__ = lambda s, o: math.multiply(s, o)
+    T.__rmul__ = lambda s, o: math.multiply(s, o)
+    T.__truediv__ = lambda s, o: math.divide(s, o)
+    T.__rtruediv__ = lambda s, o: math.divide(Tensor(o) if not isinstance(o, Tensor) else o, s)
+    T.__floordiv__ = lambda s, o: math.floor_divide(s, o)
+    T.__mod__ = lambda s, o: math.mod(s, o)
+    T.__pow__ = lambda s, o: math.pow(s, o)
+    T.__rpow__ = lambda s, o: math.pow(Tensor(o) if not isinstance(o, Tensor) else o, s)
+    T.__neg__ = lambda s: math.neg(s)
+    T.__abs__ = lambda s: math.abs(s)
+    T.__matmul__ = lambda s, o: math.matmul(s, o)
+    T.__rmatmul__ = lambda s, o: math.matmul(Tensor(o) if not isinstance(o, Tensor) else o, s)
+    # comparison
+    T.__eq__ = lambda s, o: math.equal(s, o)
+    T.__ne__ = lambda s, o: math.not_equal(s, o)
+    T.__lt__ = lambda s, o: math.less_than(s, o)
+    T.__le__ = lambda s, o: math.less_equal(s, o)
+    T.__gt__ = lambda s, o: math.greater_than(s, o)
+    T.__ge__ = lambda s, o: math.greater_equal(s, o)
+    T.__invert__ = lambda s: math.logical_not(s) if s.dtype == jnp.bool_ else math.bitwise_not(s)
+    T.__and__ = lambda s, o: math.logical_and(s, o) if s.dtype == jnp.bool_ else math.bitwise_and(s, o)
+    T.__or__ = lambda s, o: math.logical_or(s, o) if s.dtype == jnp.bool_ else math.bitwise_or(s, o)
+    T.__xor__ = lambda s, o: math.logical_xor(s, o) if s.dtype == jnp.bool_ else math.bitwise_xor(s, o)
+    # indexing / iteration
+    T.__getitem__ = _getitem
+    T.__setitem__ = _setitem
+    T.__iter__ = _iter
+
+    methods = {}
+    for mod in (math, manipulation, linalg, creation, search):
+        for name in dir(mod):
+            fn = getattr(mod, name)
+            if callable(fn) and not name.startswith("_") and name not in ("Tensor",):
+                methods.setdefault(name, fn)
+    # creation fns that take x first can't all be methods; install the standard set
+    method_names = [
+        # math
+        "add", "subtract", "multiply", "divide", "floor_divide", "mod", "remainder", "pow",
+        "maximum", "minimum", "fmax", "fmin", "exp", "expm1", "log", "log2", "log10", "log1p",
+        "sqrt", "rsqrt", "abs", "sign", "neg", "sin", "cos", "tan", "asin", "acos", "atan",
+        "sinh", "cosh", "tanh", "asinh", "acosh", "atanh", "ceil", "floor", "round", "trunc",
+        "frac", "reciprocal", "square", "sigmoid", "erf", "erfinv", "lgamma", "digamma",
+        "isnan", "isinf", "isfinite", "nan_to_num", "clip", "scale", "lerp", "matmul",
+        "inner", "outer", "kron", "sum", "mean", "max", "min", "amax", "amin", "prod",
+        "logsumexp", "all", "any", "cumsum", "cumprod", "cummax", "cummin", "nansum",
+        "nanmean", "count_nonzero", "trace", "diagonal", "equal", "not_equal",
+        "greater_than", "greater_equal", "less_than", "less_equal", "equal_all", "allclose",
+        "isclose", "logical_and", "logical_or", "logical_xor", "logical_not", "bitwise_and",
+        "bitwise_or", "bitwise_xor", "bitwise_not", "std", "var", "median", "nanmedian",
+        "quantile", "histogram", "bincount", "atan2", "heaviside", "deg2rad", "rad2deg",
+        "angle", "conj", "real", "imag", "logaddexp",
+        # manipulation
+        "reshape", "reshape_", "flatten", "squeeze", "unsqueeze", "transpose", "t",
+        "moveaxis", "swapaxes", "split", "chunk", "unbind", "tile", "expand", "expand_as",
+        "broadcast_to", "flip", "rot90", "roll", "cast", "gather", "gather_nd", "scatter",
+        "scatter_", "scatter_nd_add", "index_select", "index_sample", "index_add",
+        "masked_select", "masked_fill", "take_along_axis", "put_along_axis", "take",
+        "repeat_interleave", "unique", "unique_consecutive", "where", "nonzero",
+        "as_real", "as_complex", "tensordot", "view", "view_as", "pad",
+        # linalg
+        "dot", "bmm", "mv", "norm", "cholesky", "qr", "svd", "inv", "pinv", "det",
+        "slogdet", "solve", "triangular_solve", "matrix_power", "cross", "multiply",
+        # search
+        "argmax", "argmin", "argsort", "sort", "topk", "kthvalue", "mode",
+        "searchsorted", "bucketize",
+        # creation-ish
+        "tril", "triu", "diag",
+    ]
+    for name in method_names:
+        if name in methods and not hasattr(T, name):
+            setattr(T, name, methods[name])
+        elif name in methods:
+            # overwrite slot-placeholder methods like astype-based ones only if absent
+            if name not in ("astype",):
+                setattr(T, name, methods[name])
+
+    # in-place variants: rebind payload, preserve graph semantics
+    def _make_inplace(fn):
+        def inplace(self, *args, **kwargs):
+            out = fn(self, *args, **kwargs)
+            return self._replace_(out._data, out._node, out._out_idx)
+
+        return inplace
+
+    for name in ["add", "subtract", "multiply", "divide", "clip", "scale", "exp", "sqrt",
+                 "rsqrt", "floor", "ceil", "round", "reciprocal", "tanh", "sigmoid",
+                 "cast", "flatten", "squeeze", "unsqueeze", "transpose"]:
+        if name in methods:
+            setattr(T, name + "_", _make_inplace(methods[name]))
+
+    def astype(self, dtype):
+        return manipulation.cast(self, dtype)
+
+    T.astype = astype
+    T.mm = methods["matmul"]
+    T.abs_ = _make_inplace(methods["abs"])
+    T.zero_ = lambda s: s.set_value(jnp.zeros_like(s._data))
+    T.fill_ = lambda s, v: s.set_value(jnp.full_like(s._data, v))
+    T.numel = lambda s: creation.numel(s)
+    T.element_size = lambda s: s._data.dtype.itemsize
+    T.dim = lambda s: s._data.ndim
+    T.rank = lambda s: s._data.ndim
+    T.nelement = lambda s: creation.numel(s)
+
+
+_install()

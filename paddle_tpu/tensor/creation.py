@@ -1,0 +1,166 @@
+"""Tensor creation ops (reference: python/paddle/tensor/creation.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtype_mod
+from ..core.op_registry import apply_fn
+from ..core.tensor import Tensor, unwrap
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    if isinstance(data, Tensor):
+        t = Tensor(data._data, dtype=dtype, stop_gradient=stop_gradient)
+        t._node, t._out_idx = data._node, data._out_idx
+        return t
+    return Tensor(data, dtype=dtype, stop_gradient=stop_gradient)
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._data))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(unwrap(s)) for s in shape)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), dtype_mod.convert_dtype(dtype) or dtype_mod.get_default_dtype()))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), dtype_mod.convert_dtype(dtype) or dtype_mod.get_default_dtype()))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    fill_value = unwrap(fill_value)
+    dt = dtype_mod.convert_dtype(dtype)
+    return Tensor(jnp.full(_shape(shape), fill_value, dt))
+
+
+def zeros_like(x, dtype=None, name=None):
+    return apply_fn("zeros_like", lambda a: jnp.zeros_like(a, dtype=dtype_mod.convert_dtype(dtype)), x)
+
+
+def ones_like(x, dtype=None, name=None):
+    return apply_fn("ones_like", lambda a: jnp.ones_like(a, dtype=dtype_mod.convert_dtype(dtype)), x)
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return apply_fn(
+        "full_like", lambda a: jnp.full_like(a, unwrap(fill_value), dtype=dtype_mod.convert_dtype(dtype)), x
+    )
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    start, end, step = unwrap(start), unwrap(end), unwrap(step)
+    if end is None:
+        start, end = 0, start
+    dt = dtype_mod.convert_dtype(dtype)
+    return Tensor(jnp.arange(start, end, step, dtype=dt))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return Tensor(jnp.linspace(unwrap(start), unwrap(stop), int(unwrap(num)), dtype=dtype_mod.convert_dtype(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(
+        jnp.logspace(unwrap(start), unwrap(stop), int(unwrap(num)), base=unwrap(base), dtype=dtype_mod.convert_dtype(dtype))
+    )
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(int(num_rows), int(num_columns) if num_columns is not None else None,
+                          dtype=dtype_mod.convert_dtype(dtype) or dtype_mod.get_default_dtype()))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def fn(a):
+        if a.ndim == 1 and padding_value != 0:
+            n = a.shape[0] + abs(offset)
+            base = jnp.full((n, n), padding_value, a.dtype)
+            idx = jnp.arange(a.shape[0])
+            r, c = (idx, idx + offset) if offset >= 0 else (idx - offset, idx)
+            return base.at[r, c].set(a)
+        return jnp.diag(a, k=offset)
+
+    return apply_fn("diag", fn, x)
+
+
+def diagflat(x, offset=0, name=None):
+    return apply_fn("diagflat", lambda a: jnp.diagflat(a, k=offset), x)
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    def fn(a):
+        n = a.shape[-1]
+        idx = jnp.arange(n)
+        r, c = (idx, idx + offset) if offset >= 0 else (idx - offset, idx)
+        full = jnp.zeros(a.shape[:-1] + (n + abs(offset), n + abs(offset)), a.dtype)
+        full = full.at[..., r, c].set(a)
+        return jnp.moveaxis(full, (-2, -1), (dim1, dim2))
+
+    return apply_fn("diag_embed", fn, x)
+
+
+def tril(x, diagonal=0, name=None):
+    return apply_fn("tril", lambda a: jnp.tril(a, k=diagonal), x)
+
+
+def triu(x, diagonal=0, name=None):
+    return apply_fn("triu", lambda a: jnp.triu(a, k=diagonal), x)
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    outs = jnp.meshgrid(*[unwrap(a) for a in args], indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+def assign(x, output=None):
+    data = unwrap(x)
+    if not isinstance(data, jnp.ndarray):
+        data = jnp.asarray(data)
+    if output is not None:
+        output.set_value(data)
+        return output
+    return Tensor(data)
+
+
+def clone(x, name=None):
+    return apply_fn("clone", lambda a: a + 0, x)
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(int(np.prod(x.shape)) if x.shape else 1))
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=dtype_mod.convert_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    col = col if col is not None else row
+    r, c = np.triu_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=dtype_mod.convert_dtype(dtype)))
+
+
+def complex(real, imag, name=None):
+    return apply_fn("complex", lambda r, i: jnp.asarray(r) + 1j * jnp.asarray(i), real, imag)
+
+
+def polar(abs_t, angle, name=None):
+    return apply_fn("polar", lambda r, a: r * jnp.exp(1j * a.astype(jnp.complex64)), abs_t, angle)

@@ -1,0 +1,231 @@
+"""Linear algebra ops (reference: python/paddle/tensor/linalg.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.op_registry import AMP_WHITE, OpDef, apply_fn
+from ..core.tensor import Tensor, unwrap
+
+_MM = OpDef("matmul", None, amp=AMP_WHITE)
+
+
+def dot(x, y, name=None):
+    return apply_fn("dot", lambda a, b: jnp.sum(a * b, axis=-1), x, y)
+
+
+def bmm(x, y, name=None):
+    return apply_fn("bmm", jnp.matmul, x, y, _opdef=_MM)
+
+
+def mv(x, vec, name=None):
+    return apply_fn("mv", jnp.matmul, x, vec, _opdef=_MM)
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    def fn(a):
+        if p is None or p == "fro":
+            if axis is None:
+                return jnp.sqrt(jnp.sum(jnp.square(a)))
+            return jnp.linalg.norm(a, ord=None, axis=tuple(axis) if isinstance(axis, (list, tuple)) else axis, keepdims=keepdim)
+        if p == "inf" or p == float("inf"):
+            ordv = jnp.inf
+        elif p == "-inf" or p == -float("inf"):
+            ordv = -jnp.inf
+        else:
+            ordv = p
+        if axis is None:
+            return jnp.linalg.norm(a.reshape(-1), ord=ordv, keepdims=keepdim)
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        return jnp.linalg.norm(a, ord=ordv, axis=ax, keepdims=keepdim)
+
+    return apply_fn("norm", fn, x)
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    def fn(a):
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        if ax is None:
+            a = a.reshape(-1)
+            ax = 0
+        return jnp.linalg.norm(a, ord=p, axis=ax, keepdims=keepdim)
+
+    return apply_fn("vector_norm", fn, x)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    return apply_fn("matrix_norm", lambda a: jnp.linalg.norm(a, ord=p if p != "fro" else None, axis=tuple(axis), keepdims=keepdim), x)
+
+
+def cond(x, p=None, name=None):
+    return apply_fn("cond", lambda a: jnp.linalg.cond(a, p=p), x)
+
+
+def cholesky(x, upper=False, name=None):
+    def fn(a):
+        L = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(L, -1, -2).conj() if upper else L
+
+    return apply_fn("cholesky", fn, x)
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def fn(b, L):
+        return jax.scipy.linalg.cho_solve((L, not upper), b)
+
+    return apply_fn("cholesky_solve", fn, x, y)
+
+
+def qr(x, mode="reduced", name=None):
+    out = apply_fn("qr", lambda a: tuple(jnp.linalg.qr(a, mode=mode)) if mode != "r" else (jnp.linalg.qr(a, mode="r"),), x)
+    if mode == "r":
+        return out[0]
+    return out
+
+
+def svd(x, full_matrices=False, name=None):
+    def fn(a):
+        u, s, vh = jnp.linalg.svd(a, full_matrices=full_matrices)
+        return u, s, jnp.swapaxes(vh, -1, -2).conj()
+
+    return apply_fn("svd", fn, x)
+
+
+def svdvals(x, name=None):
+    return apply_fn("svdvals", lambda a: jnp.linalg.svd(a, compute_uv=False), x)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    a = unwrap(x)
+    if center:
+        a = a - a.mean(axis=-2, keepdims=True)
+    u, s, vh = jnp.linalg.svd(a, full_matrices=False)
+    k = q if q is not None else min(6, *a.shape[-2:])
+    return Tensor(u[..., :k]), Tensor(s[..., :k]), Tensor(jnp.swapaxes(vh, -1, -2)[..., :k])
+
+
+def inv(x, name=None):
+    return apply_fn("inv", jnp.linalg.inv, x)
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply_fn("pinv", lambda a: jnp.linalg.pinv(a, rcond=rcond, hermitian=hermitian), x)
+
+
+def det(x, name=None):
+    return apply_fn("det", jnp.linalg.det, x)
+
+
+def slogdet(x, name=None):
+    def fn(a):
+        sign, logdet = jnp.linalg.slogdet(a)
+        return jnp.stack([sign, logdet], axis=0)
+
+    return apply_fn("slogdet", fn, x)
+
+
+def solve(x, y, name=None):
+    def fn(a, b):
+        if b.ndim == a.ndim - 1:
+            return jnp.linalg.solve(a, b[..., None])[..., 0]
+        return jnp.linalg.solve(a, b)
+
+    return apply_fn("solve", fn, x, y)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def fn(a, b):
+        return jax.scipy.linalg.solve_triangular(a, b, lower=not upper, trans=1 if transpose else 0, unit_diagonal=unitriangular)
+
+    return apply_fn("triangular_solve", fn, x, y)
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def fn(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank, sv
+
+    out = apply_fn("lstsq", fn, x, y)
+    return out
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def fn(a):
+        lu_mat, piv = jax.scipy.linalg.lu_factor(a)
+        return lu_mat, piv + 1  # paddle returns 1-based pivots
+
+    out = apply_fn("lu", fn, x)
+    if get_infos:
+        return out[0], out[1], Tensor(jnp.zeros((), jnp.int32))
+    return out
+
+
+def matrix_power(x, n, name=None):
+    return apply_fn("matrix_power", lambda a: jnp.linalg.matrix_power(a, n), x)
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return apply_fn("matrix_rank", lambda a: jnp.linalg.matrix_rank(a, tol=unwrap(tol)), x)
+
+
+def eig(x, name=None):
+    import numpy as np
+
+    w, v = np.linalg.eig(np.asarray(unwrap(x)))
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigh(x, UPLO="L", name=None):
+    return apply_fn("eigh", lambda a: tuple(jnp.linalg.eigh(a, UPLO=UPLO)), x)
+
+
+def eigvals(x, name=None):
+    import numpy as np
+
+    return Tensor(jnp.asarray(np.linalg.eigvals(np.asarray(unwrap(x)))))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply_fn("eigvalsh", lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), x)
+
+
+def cross(x, y, axis=9, name=None):
+    def fn(a, b):
+        ax = axis
+        if ax == 9:
+            for i, s in enumerate(a.shape):
+                if s == 3:
+                    ax = i
+                    break
+        return jnp.cross(a, b, axis=ax)
+
+    return apply_fn("cross", fn, x, y)
+
+
+def householder_product(x, tau, name=None):
+    def fn(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        eye = jnp.eye(m, dtype=a.dtype)
+        q = jnp.broadcast_to(eye, a.shape[:-2] + (m, m)).copy() if a.ndim > 2 else eye
+        for i in range(n):
+            v = jnp.concatenate([jnp.zeros(a.shape[:-2] + (i,), a.dtype),
+                                 jnp.ones(a.shape[:-2] + (1,), a.dtype),
+                                 a[..., i + 1:, i]], axis=-1)
+            h = jnp.eye(m, dtype=a.dtype) - t[..., i, None, None] * v[..., :, None] * v[..., None, :]
+            q = q @ h
+        return q[..., :n]
+
+    return apply_fn("householder_product", fn, x, tau)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply_fn("corrcoef", lambda a: jnp.corrcoef(a, rowvar=rowvar), x)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return apply_fn("cov", lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0), x)
+
+
+def multi_dot(x, name=None):
+    return apply_fn("multi_dot", lambda *xs: jnp.linalg.multi_dot(xs), *x, _opdef=_MM)

@@ -1,0 +1,449 @@
+"""Shape / layout / indexing ops (reference: python/paddle/tensor/manipulation.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtype_mod
+from ..core.op_registry import apply_fn
+from ..core.tensor import Tensor, unwrap
+
+
+def _u(x):
+    return unwrap(x)
+
+
+def _resolve_shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in np.asarray(shape._data))
+    return tuple(int(_u(s)) for s in shape) if isinstance(shape, (list, tuple)) else (int(shape),)
+
+
+def reshape(x, shape, name=None):
+    shp = _resolve_shape(shape)
+    return apply_fn("reshape", lambda a: jnp.reshape(a, shp), x)
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    return x._replace_(out._data, out._node, out._out_idx)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def fn(a):
+        nd = a.ndim
+        s = start_axis % nd if nd else 0
+        e = stop_axis % nd if nd else 0
+        new_shape = a.shape[:s] + (-1,) + a.shape[e + 1:]
+        return jnp.reshape(a, new_shape)
+
+    return apply_fn("flatten", fn, x)
+
+
+def squeeze(x, axis=None, name=None):
+    def fn(a):
+        if axis is None:
+            return jnp.squeeze(a)
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        axes = tuple(int(_u(ax)) % a.ndim for ax in axes)
+        axes = tuple(ax for ax in axes if a.shape[ax] == 1)
+        return jnp.squeeze(a, axis=axes) if axes else a
+
+    return apply_fn("squeeze", fn, x)
+
+
+def unsqueeze(x, axis, name=None):
+    def fn(a):
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        out = a
+        for ax in sorted(int(_u(v)) if not isinstance(v, int) else v for v in axes):
+            out = jnp.expand_dims(out, ax)
+        return out
+
+    return apply_fn("unsqueeze", fn, x)
+
+
+def transpose(x, perm, name=None):
+    perm = [int(_u(p)) for p in perm]
+    return apply_fn("transpose", lambda a: jnp.transpose(a, perm), x)
+
+
+def t(x, name=None):
+    return apply_fn("t", lambda a: a.T if a.ndim >= 2 else a, x)
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply_fn("moveaxis", lambda a: jnp.moveaxis(a, source, destination), x)
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return apply_fn("swapaxes", lambda a: jnp.swapaxes(a, axis0, axis1), x)
+
+
+swapdims = swapaxes
+
+
+def concat(x, axis=0, name=None):
+    axis = int(_u(axis))
+    tensors = list(x)
+    return apply_fn("concat", lambda *xs: jnp.concatenate(xs, axis=axis), *tensors)
+
+
+def stack(x, axis=0, name=None):
+    tensors = list(x)
+    return apply_fn("stack", lambda *xs: jnp.stack(xs, axis=axis), *tensors)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    axis = int(_u(axis))
+
+    def fn(a):
+        if isinstance(num_or_sections, int):
+            return tuple(jnp.split(a, num_or_sections, axis=axis))
+        secs = [int(_u(s)) for s in num_or_sections]
+        known = 0
+        for s in secs:
+            if s >= 0:
+                known += s
+        secs = [s if s >= 0 else a.shape[axis] - known for s in secs]
+        points = np.cumsum(secs)[:-1].tolist()
+        return tuple(jnp.split(a, points, axis=axis))
+
+    return list(apply_fn("split", fn, x))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0, name=None):
+    n = x.shape[int(axis)]
+
+    def fn(a):
+        return tuple(jnp.squeeze(s, axis=axis) for s in jnp.split(a, n, axis=axis))
+
+    return list(apply_fn("unbind", fn, x))
+
+
+def unstack(x, axis=0, num=None, name=None):
+    return unbind(x, axis)
+
+
+def tile(x, repeat_times, name=None):
+    reps = tuple(int(_u(r)) for r in repeat_times) if isinstance(repeat_times, (list, tuple)) else (int(_u(repeat_times)),)
+    return apply_fn("tile", lambda a: jnp.tile(a, reps), x)
+
+
+def expand(x, shape, name=None):
+    shp = _resolve_shape(shape)
+
+    def fn(a):
+        tgt = list(shp)
+        # -1 means keep original dim
+        off = len(tgt) - a.ndim
+        for i in range(len(tgt)):
+            if tgt[i] == -1:
+                tgt[i] = a.shape[i - off]
+        return jnp.broadcast_to(a, tuple(tgt))
+
+    return apply_fn("expand", fn, x)
+
+
+def expand_as(x, y, name=None):
+    shp = tuple(y.shape)
+    return apply_fn("expand_as", lambda a: jnp.broadcast_to(a, shp), x)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    arrs = jnp.broadcast_arrays(*[_u(i) for i in inputs])
+    return [Tensor(a) for a in arrs]
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def flip(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    return apply_fn("flip", lambda a: jnp.flip(a, axis=tuple(axes)), x)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply_fn("rot90", lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), x)
+
+
+def roll(x, shifts, axis=None, name=None):
+    return apply_fn("roll", lambda a: jnp.roll(a, shifts, axis=axis), x)
+
+
+def cast(x, dtype):
+    dt = dtype_mod.convert_dtype(dtype)
+    return apply_fn("cast", lambda a: a.astype(dt), x)
+
+
+def gather(x, index, axis=0, name=None):
+    axis = int(_u(axis))
+    return apply_fn("gather", lambda a, i: jnp.take(a, i.reshape(-1), axis=axis), x, index)
+
+
+def gather_nd(x, index, name=None):
+    def fn(a, idx):
+        return a[tuple(jnp.moveaxis(idx, -1, 0))]
+
+    return apply_fn("gather_nd", fn, x, index)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def fn(a, i, u):
+        i = i.reshape(-1)
+        if overwrite:
+            return a.at[i].set(u)
+        zeroed = a.at[i].set(jnp.zeros_like(u))
+        return zeroed.at[i].add(u)
+
+    return apply_fn("scatter", fn, x, index, updates)
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    out = scatter(x, index, updates, overwrite)
+    return x._replace_(out._data, out._node, out._out_idx)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def fn(a, idx, u):
+        return a.at[tuple(jnp.moveaxis(idx, -1, 0))].add(u)
+
+    return apply_fn("scatter_nd_add", fn, x, index, updates)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    zero = Tensor(jnp.zeros(_resolve_shape(shape), dtype=_u(updates).dtype))
+    return scatter_nd_add(zero, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    return apply_fn("index_select", lambda a, i: jnp.take(a, i.reshape(-1), axis=int(_u(axis))), x, index)
+
+
+def index_sample(x, index, name=None):
+    return apply_fn("index_sample", lambda a, i: jnp.take_along_axis(a, i, axis=1), x, index)
+
+
+def index_add(x, index, axis, value, name=None):
+    def fn(a, i, v):
+        return a.at[(slice(None),) * (axis % a.ndim) + (i.reshape(-1),)].add(v)
+
+    return apply_fn("index_add", fn, x, index, value)
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    def fn(a, v, *idx):
+        ii = tuple(idx)
+        return a.at[ii].add(v) if accumulate else a.at[ii].set(v)
+
+    return apply_fn("index_put", fn, x, value, *indices)
+
+
+def masked_select(x, mask, name=None):
+    a, m = _u(x), _u(mask)
+    return Tensor(a[np.asarray(m)])
+
+
+def masked_fill(x, mask, value, name=None):
+    return apply_fn("masked_fill", lambda a, m: jnp.where(m, _u(value), a), x, mask)
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    return apply_fn("take_along_axis", lambda a, i: jnp.take_along_axis(a, i, axis=axis), arr, indices)
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", include_self=True, broadcast=True, name=None):
+    def fn(a, i, v):
+        v = jnp.broadcast_to(v, i.shape) if hasattr(v, "shape") else v
+        if reduce == "assign":
+            return jnp.put_along_axis(a, i, v, axis=axis, inplace=False)
+        # build explicit index grid for scatter
+        idx = [jnp.arange(s).reshape([-1 if d == k else 1 for d in range(a.ndim)]) for k, s in enumerate(a.shape)]
+        idx = [jnp.broadcast_to(g, i.shape) for g in idx]
+        idx[axis % a.ndim] = i
+        gather = tuple(idx)
+        if reduce == "mean":
+            # mean over scattered values (+ original when include_self)
+            sums = a.at[gather].add(v) if include_self else jnp.zeros_like(a).at[gather].add(v)
+            cnts = jnp.full(a.shape, 1 if include_self else 0, jnp.int32).at[gather].add(1)
+            touched = jnp.zeros(a.shape, bool).at[gather].set(True)
+            mean = sums / jnp.maximum(cnts, 1).astype(a.dtype)
+            return jnp.where(touched, mean, a)
+        at = a.at[gather]
+        return {"add": at.add, "mul": at.multiply, "multiply": at.multiply,
+                "amin": at.min, "amax": at.max}[reduce](v)
+
+    return apply_fn("put_along_axis", fn, arr, indices, values)
+
+
+def take(x, index, mode="raise", name=None):
+    return apply_fn("take", lambda a, i: jnp.take(a.reshape(-1), i.reshape(-1), mode="clip" if mode != "raise" else None).reshape(_u(index).shape), x, index)
+
+
+def slice(input, axes, starts, ends, name=None):
+    import builtins
+
+    def fn(a):
+        sl = [builtins.slice(None)] * a.ndim
+        for ax, s, e in zip(axes, starts, ends):
+            sl[ax] = builtins.slice(int(_u(s)), int(_u(e)))
+        return a[tuple(sl)]
+
+    return apply_fn("slice", fn, input)
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    import builtins
+
+    def fn(a):
+        sl = [builtins.slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            sl[ax] = builtins.slice(int(_u(s)), int(_u(e)), int(_u(st)))
+        return a[tuple(sl)]
+
+    return apply_fn("strided_slice", fn, x)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    import builtins
+
+    shp = _resolve_shape(shape)
+    offs = [int(_u(o)) for o in (offsets or [0] * len(shp))]
+
+    def fn(a):
+        sl = tuple(builtins.slice(o, o + s) for o, s in zip(offs, shp))
+        return a[sl]
+
+    return apply_fn("crop", fn, x)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    def fn(a):
+        p = [int(_u(v)) for v in pad]
+        nd = a.ndim
+        if len(p) == 2 * nd:
+            width = [(p[2 * i], p[2 * i + 1]) for i in range(nd)]
+        else:
+            # paddle style: pad applies to last len(p)//2 dims (reversed pairs, NCHW spatial)
+            width = [(0, 0)] * nd
+            npairs = len(p) // 2
+            if data_format.startswith("NC") and nd >= 3 and npairs == nd - 2:
+                for i in range(npairs):
+                    width[2 + i] = (p[2 * i], p[2 * i + 1])
+            else:
+                for i in range(npairs):
+                    width[nd - npairs + i] = (p[2 * i], p[2 * i + 1])
+        jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+        kw = {"constant_values": value} if jmode == "constant" else {}
+        return jnp.pad(a, width, mode=jmode, **kw)
+
+    return apply_fn("pad", fn, x)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    def fn(a, *r):
+        rep = r[0] if r else repeats
+        return jnp.repeat(a.reshape(-1) if axis is None else a, rep, axis=0 if axis is None else axis)
+
+    if isinstance(repeats, Tensor):
+        return apply_fn("repeat_interleave", fn, x, repeats)
+    return apply_fn("repeat_interleave", fn, x)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    a = np.asarray(_u(x))
+    res = np.unique(a, return_index=return_index, return_inverse=return_inverse, return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    outs = [Tensor(jnp.asarray(r)) for r in res]
+    return tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    a = np.asarray(_u(x)).reshape(-1) if axis is None else np.asarray(_u(x))
+    vals = []
+    counts = []
+    inverse = np.zeros(a.shape[0], dtype=np.int64)
+    for i, v in enumerate(a):
+        if not vals or not np.array_equal(v, vals[-1]):
+            vals.append(v)
+            counts.append(1)
+        else:
+            counts[-1] += 1
+        inverse[i] = len(vals) - 1
+    outs = [Tensor(jnp.asarray(np.array(vals)))]
+    if return_inverse:
+        outs.append(Tensor(jnp.asarray(inverse)))
+    if return_counts:
+        outs.append(Tensor(jnp.asarray(np.array(counts))))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return tuple(Tensor(jnp.asarray(i)) for i in np.nonzero(np.asarray(_u(condition))))
+    return apply_fn("where", lambda c, a, b: jnp.where(c, a, b), condition, x, y)
+
+
+def nonzero(x, as_tuple=False):
+    idx = np.nonzero(np.asarray(_u(x)))
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i)) for i in idx)
+    return Tensor(jnp.asarray(np.stack(idx, axis=1)))
+
+
+def as_real(x, name=None):
+    return apply_fn("as_real", lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1), x)
+
+
+def as_complex(x, name=None):
+    return apply_fn("as_complex", lambda a: a[..., 0] + 1j * a[..., 1], x)
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return apply_fn("view_dtype", lambda a: a.view(dtype_mod.convert_dtype(shape_or_dtype)), x)
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [Tensor(jnp.atleast_1d(_u(i))) for i in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [Tensor(jnp.atleast_2d(_u(i))) for i in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [Tensor(jnp.atleast_3d(_u(i))) for i in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def tensordot(x, y, axes=2, name=None):
+    return apply_fn("tensordot", lambda a, b: jnp.tensordot(a, b, axes=axes), x, y)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    def fn(i):
+        size = index_num // nshards
+        lo, hi = shard_id * size, (shard_id + 1) * size
+        return jnp.where((i >= lo) & (i < hi), i - lo, ignore_value)
+
+    return apply_fn("shard_index", fn, input)

@@ -1,0 +1,509 @@
+"""Elementwise & reduction math ops (reference: python/paddle/tensor/math.py, 164 defs).
+
+Each op is a pure jnp/lax function dispatched through the registry — eager mode gets
+tape recording, jit mode gets inlined into the jaxpr, and XLA fuses the elementwise
+chains into single TPU kernels (no hand-written fused kernels needed at this level).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtype_mod
+from ..core.op_registry import AMP_BLACK, AMP_WHITE, apply_fn
+from ..core.tensor import Tensor, unwrap, wrap
+
+
+def _u(x):
+    return unwrap(x)
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        a = axis.tolist()
+        return tuple(a) if isinstance(a, list) else int(a)
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(_u(a)) for a in axis)
+    return int(axis)
+
+
+# ---------- binary elementwise ----------
+
+def add(x, y, name=None):
+    return apply_fn("add", jnp.add, x, y)
+
+
+def subtract(x, y, name=None):
+    return apply_fn("subtract", jnp.subtract, x, y)
+
+
+def multiply(x, y, name=None):
+    return apply_fn("multiply", jnp.multiply, x, y)
+
+
+def divide(x, y, name=None):
+    return apply_fn("divide", jnp.true_divide, x, y)
+
+
+def floor_divide(x, y, name=None):
+    return apply_fn("floor_divide", jnp.floor_divide, x, y)
+
+
+def mod(x, y, name=None):
+    return apply_fn("mod", jnp.mod, x, y)
+
+
+remainder = mod
+floor_mod = mod
+
+
+def pow(x, y, name=None):
+    return apply_fn("pow", jnp.power, x, y)
+
+
+def maximum(x, y, name=None):
+    return apply_fn("maximum", jnp.maximum, x, y)
+
+
+def minimum(x, y, name=None):
+    return apply_fn("minimum", jnp.minimum, x, y)
+
+
+def fmax(x, y, name=None):
+    return apply_fn("fmax", jnp.fmax, x, y)
+
+
+def fmin(x, y, name=None):
+    return apply_fn("fmin", jnp.fmin, x, y)
+
+
+def atan2(x, y, name=None):
+    return apply_fn("atan2", jnp.arctan2, x, y)
+
+
+def hypot(x, y, name=None):
+    return apply_fn("hypot", jnp.hypot, x, y)
+
+
+def copysign(x, y, name=None):
+    return apply_fn("copysign", jnp.copysign, x, y)
+
+
+def nextafter(x, y, name=None):
+    return apply_fn("nextafter", jnp.nextafter, x, y)
+
+
+def heaviside(x, y, name=None):
+    return apply_fn("heaviside", jnp.heaviside, x, y)
+
+
+def gcd(x, y, name=None):
+    return apply_fn("gcd", jnp.gcd, x, y)
+
+
+def lcm(x, y, name=None):
+    return apply_fn("lcm", jnp.lcm, x, y)
+
+
+def logaddexp(x, y, name=None):
+    return apply_fn("logaddexp", jnp.logaddexp, x, y)
+
+
+# ---------- unary elementwise ----------
+
+def _unary(name, fn, amp=None):
+    def op(x, name=None):
+        return apply_fn(name, fn, x)
+
+    op.__name__ = name
+    return op
+
+
+exp = _unary("exp", jnp.exp)
+expm1 = _unary("expm1", jnp.expm1)
+log = _unary("log", jnp.log)
+log2 = _unary("log2", jnp.log2)
+log10 = _unary("log10", jnp.log10)
+log1p = _unary("log1p", jnp.log1p)
+sqrt = _unary("sqrt", jnp.sqrt)
+rsqrt = _unary("rsqrt", jax.lax.rsqrt)
+abs = _unary("abs", jnp.abs)
+sign = _unary("sign", jnp.sign)
+neg = _unary("neg", jnp.negative)
+negative = neg
+sin = _unary("sin", jnp.sin)
+cos = _unary("cos", jnp.cos)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+acos = _unary("acos", jnp.arccos)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+cosh = _unary("cosh", jnp.cosh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+acosh = _unary("acosh", jnp.arccosh)
+atanh = _unary("atanh", jnp.arctanh)
+ceil = _unary("ceil", jnp.ceil)
+floor = _unary("floor", jnp.floor)
+round = _unary("round", jnp.round)
+trunc = _unary("trunc", jnp.trunc)
+frac = _unary("frac", lambda a: a - jnp.trunc(a))
+reciprocal = _unary("reciprocal", jnp.reciprocal)
+square = _unary("square", jnp.square)
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+erf = _unary("erf", jax.scipy.special.erf)
+erfinv = _unary("erfinv", jax.scipy.special.erfinv)
+lgamma = _unary("lgamma", jax.scipy.special.gammaln)
+digamma = _unary("digamma", jax.scipy.special.digamma)
+i0 = _unary("i0", lambda a: jax.scipy.special.i0(a))
+i1 = _unary("i1", lambda a: jax.scipy.special.i1(a))
+angle = _unary("angle", jnp.angle)
+conj = _unary("conj", jnp.conj)
+real = _unary("real", jnp.real)
+imag = _unary("imag", jnp.imag)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+exponent = None  # not in paddle
+
+
+def isnan(x, name=None):
+    return apply_fn("isnan", jnp.isnan, x)
+
+
+def isinf(x, name=None):
+    return apply_fn("isinf", jnp.isinf, x)
+
+
+def isfinite(x, name=None):
+    return apply_fn("isfinite", jnp.isfinite, x)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply_fn("nan_to_num", lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf), x)
+
+
+def clip(x, min=None, max=None, name=None):
+    return apply_fn("clip", lambda a: jnp.clip(a, _u(min), _u(max)), x)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s, b = _u(scale), _u(bias)
+
+    def fn(a):
+        out = a * s + b if bias_after_scale else (a + b) * s
+        return out
+
+    return apply_fn("scale", fn, x)
+
+
+def increment(x, value=1.0, name=None):
+    x._replace_(x._data + value, x._node, x._out_idx)
+    return x
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, (int, float)):
+        return apply_fn("lerp", lambda a, b: a + weight * (b - a), x, y)
+    return apply_fn("lerp", lambda a, b, w: a + w * (b - a), x, y, weight)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply_fn("stanh", lambda a: scale_b * jnp.tanh(scale_a * a), x)
+
+
+def multiplex(inputs, index, name=None):
+    def fn(idx, *xs):
+        stacked = jnp.stack(xs, axis=0)
+        return jnp.take_along_axis(stacked, idx.reshape(1, -1, *([1] * (stacked.ndim - 2))), axis=0)[0]
+
+    return apply_fn("multiplex", fn, index, *inputs)
+
+
+# ---------- matmul family (MXU ops — AMP white) ----------
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def fn(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+
+    return apply_fn("matmul", fn, x, y, _opdef=_MATMUL_DEF)
+
+
+from ..core.op_registry import OpDef  # noqa: E402
+
+_MATMUL_DEF = OpDef("matmul", None, amp=AMP_WHITE)
+
+
+def inner(x, y, name=None):
+    return apply_fn("inner", jnp.inner, x, y, _opdef=_MATMUL_DEF)
+
+
+def outer(x, y, name=None):
+    return apply_fn("outer", lambda a, b: jnp.outer(a.reshape(-1), b.reshape(-1)), x, y, _opdef=_MATMUL_DEF)
+
+
+def kron(x, y, name=None):
+    return apply_fn("kron", jnp.kron, x, y)
+
+
+# ---------- reductions ----------
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    dt = dtype_mod.convert_dtype(dtype)
+    return apply_fn("sum", lambda a: jnp.sum(a, axis=_axis(axis), dtype=dt, keepdims=keepdim), x)
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return apply_fn("mean", lambda a: jnp.mean(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return apply_fn("max", lambda a: jnp.max(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return apply_fn("min", lambda a: jnp.min(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def amax(x, axis=None, keepdim=False, name=None):
+    return max(x, axis, keepdim)
+
+
+def amin(x, axis=None, keepdim=False, name=None):
+    return min(x, axis, keepdim)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    dt = dtype_mod.convert_dtype(dtype)
+    return apply_fn("prod", lambda a: jnp.prod(a, axis=_axis(axis), dtype=dt, keepdims=keepdim), x)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return apply_fn(
+        "logsumexp", lambda a: jax.scipy.special.logsumexp(a, axis=_axis(axis), keepdims=keepdim), x,
+        _opdef=_LSE_DEF,
+    )
+
+
+_LSE_DEF = OpDef("logsumexp", None, amp=AMP_BLACK)
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    return apply_fn("all", lambda a: jnp.all(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    return apply_fn("any", lambda a: jnp.any(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    dt = dtype_mod.convert_dtype(dtype)
+
+    def fn(a):
+        if axis is None:
+            return jnp.cumsum(a.reshape(-1), dtype=dt)
+        return jnp.cumsum(a, axis=_axis(axis), dtype=dt)
+
+    return apply_fn("cumsum", fn, x)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    dt = dtype_mod.convert_dtype(dtype)
+    return apply_fn("cumprod", lambda a: jnp.cumprod(a, axis=_axis(dim), dtype=dt), x)
+
+
+def _cum_extreme(x, axis, dtype, cmp):
+    """Shared cummax/cummin: values + first-occurrence indices via associative scan."""
+    dt = dtype_mod.convert_dtype(dtype)
+
+    def fn(a):
+        flat = axis is None
+        arr = a.reshape(-1) if flat else a
+        ax = 0 if flat else _axis(axis)
+        idx0 = jnp.broadcast_to(
+            jnp.arange(arr.shape[ax], dtype=dt).reshape([-1 if i == ax else 1 for i in range(arr.ndim)]),
+            arr.shape,
+        )
+
+        def combine(l, r):
+            lv, li = l
+            rv, ri = r
+            take_r = cmp(rv, lv)
+            return jnp.where(take_r, rv, lv), jnp.where(take_r, ri, li)
+
+        vals, idx = jax.lax.associative_scan(combine, (arr, idx0), axis=ax)
+        return vals, idx
+
+    return apply_fn("cum_extreme", fn, x)
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    return _cum_extreme(x, axis, dtype, lambda r, l: r > l)
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    return _cum_extreme(x, axis, dtype, lambda r, l: r < l)
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    dt = dtype_mod.convert_dtype(dtype)
+    return apply_fn("nansum", lambda a: jnp.nansum(a, axis=_axis(axis), dtype=dt, keepdims=keepdim), x)
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return apply_fn("nanmean", lambda a: jnp.nanmean(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return apply_fn("count_nonzero", lambda a: jnp.count_nonzero(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def add_n(inputs, name=None):
+    if isinstance(inputs, Tensor):
+        return inputs
+
+    def fn(*xs):
+        out = xs[0]
+        for a in xs[1:]:
+            out = out + a
+        return out
+
+    return apply_fn("add_n", fn, *inputs)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_fn("trace", lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2), x)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_fn("diagonal", lambda a: jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2), x)
+
+
+# ---------- logic / comparison ----------
+
+def equal(x, y, name=None):
+    return apply_fn("equal", jnp.equal, x, y)
+
+
+def not_equal(x, y, name=None):
+    return apply_fn("not_equal", jnp.not_equal, x, y)
+
+
+def greater_than(x, y, name=None):
+    return apply_fn("greater_than", jnp.greater, x, y)
+
+
+def greater_equal(x, y, name=None):
+    return apply_fn("greater_equal", jnp.greater_equal, x, y)
+
+
+def less_than(x, y, name=None):
+    return apply_fn("less_than", jnp.less, x, y)
+
+
+def less_equal(x, y, name=None):
+    return apply_fn("less_equal", jnp.less_equal, x, y)
+
+
+def equal_all(x, y, name=None):
+    return Tensor(jnp.array_equal(_u(x), _u(y)))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return Tensor(jnp.allclose(_u(x), _u(y), rtol=_u(rtol), atol=_u(atol), equal_nan=equal_nan))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply_fn("isclose", lambda a, b: jnp.isclose(a, b, rtol=_u(rtol), atol=_u(atol), equal_nan=equal_nan), x, y)
+
+
+def logical_and(x, y, out=None, name=None):
+    return apply_fn("logical_and", jnp.logical_and, x, y)
+
+
+def logical_or(x, y, out=None, name=None):
+    return apply_fn("logical_or", jnp.logical_or, x, y)
+
+
+def logical_xor(x, y, out=None, name=None):
+    return apply_fn("logical_xor", jnp.logical_xor, x, y)
+
+
+def logical_not(x, out=None, name=None):
+    return apply_fn("logical_not", jnp.logical_not, x)
+
+
+def bitwise_and(x, y, out=None, name=None):
+    return apply_fn("bitwise_and", jnp.bitwise_and, x, y)
+
+
+def bitwise_or(x, y, out=None, name=None):
+    return apply_fn("bitwise_or", jnp.bitwise_or, x, y)
+
+
+def bitwise_xor(x, y, out=None, name=None):
+    return apply_fn("bitwise_xor", jnp.bitwise_xor, x, y)
+
+
+def bitwise_not(x, out=None, name=None):
+    return apply_fn("bitwise_not", jnp.bitwise_not, x)
+
+
+def bitwise_left_shift(x, y, name=None):
+    return apply_fn("bitwise_left_shift", jnp.left_shift, x, y)
+
+
+def bitwise_right_shift(x, y, name=None):
+    return apply_fn("bitwise_right_shift", jnp.right_shift, x, y)
+
+
+# ---------- stat ----------
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply_fn(
+        "std", lambda a: jnp.std(a, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim), x
+    )
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply_fn(
+        "var", lambda a: jnp.var(a, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim), x
+    )
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    def fn(a):
+        if mode == "min" or jnp.issubdtype(a.dtype, jnp.integer):
+            return jnp.quantile(a.astype(jnp.float32), 0.5, axis=_axis(axis), keepdims=keepdim, method="lower")
+        return jnp.median(a, axis=_axis(axis), keepdims=keepdim)
+
+    return apply_fn("median", fn, x)
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return apply_fn("nanmedian", lambda a: jnp.nanmedian(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    return apply_fn(
+        "quantile",
+        lambda a: jnp.quantile(a, jnp.asarray(_u(q)), axis=_axis(axis), keepdims=keepdim, method=interpolation),
+        x,
+    )
+
+
+def histogram(x, bins=100, min=0, max=0, name=None):
+    a = _u(x)
+    lo, hi = (_u(min), _u(max)) if (_u(min) != 0 or _u(max) != 0) else (a.min(), a.max())
+    h, _ = jnp.histogram(a, bins=bins, range=(lo, hi))
+    return Tensor(h)
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    return Tensor(jnp.bincount(_u(x), weights=_u(weights) if weights is not None else None, minlength=minlength))

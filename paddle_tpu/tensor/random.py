@@ -1,0 +1,124 @@
+"""Random ops (reference: python/paddle/tensor/random.py) over the global PRNG state."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtype_mod
+from ..core.tensor import Tensor, unwrap
+from ..framework.random import next_key
+
+
+def _dt(dtype, default=None):
+    return dtype_mod.convert_dtype(dtype) or default or dtype_mod.get_default_dtype()
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        import numpy as np
+
+        return tuple(int(v) for v in np.asarray(shape._data))
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(int(unwrap(s)) for s in shape)
+
+
+def rand(shape, dtype=None, name=None):
+    return Tensor(jax.random.uniform(next_key(), _shape(shape), _dt(dtype)))
+
+
+def randn(shape, dtype=None, name=None):
+    return Tensor(jax.random.normal(next_key(), _shape(shape), _dt(dtype)))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m, s = unwrap(mean), unwrap(std)
+        shp = jnp.broadcast_shapes(getattr(m, "shape", ()), getattr(s, "shape", ()))
+        return Tensor(jax.random.normal(next_key(), shp) * s + m)
+    return Tensor(jax.random.normal(next_key(), _shape(shape or [1])) * std + mean)
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
+    return Tensor(jax.random.normal(next_key(), _shape(shape), _dt(dtype)) * std + mean)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    return Tensor(jax.random.uniform(next_key(), _shape(shape), _dt(dtype), minval=unwrap(min), maxval=unwrap(max)))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    x._data = jax.random.uniform(next_key(), tuple(x.shape), x.dtype, minval=min, maxval=max)
+    return x
+
+
+def randint(low=0, high=None, shape=[1], dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(next_key(), _shape(shape), int(low), int(high), _dt(dtype, jnp.int64)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(next_key(), tuple(x.shape), int(low), int(high), _dt(dtype, unwrap(x).dtype)))
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(next_key(), int(n)).astype(_dt(dtype, jnp.int64)))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    a = unwrap(x)
+    logits = jnp.log(jnp.maximum(a, 1e-30))
+    if replacement:
+        out = jax.random.categorical(next_key(), logits, axis=-1, shape=(num_samples,) + a.shape[:-1])
+        out = jnp.moveaxis(out, 0, -1)
+    else:
+        # Gumbel top-k trick for sampling without replacement
+        g = jax.random.gumbel(next_key(), a.shape)
+        out = jnp.argsort(-(logits + g), axis=-1)[..., :num_samples]
+    return Tensor(out.astype(jnp.int64))
+
+
+def bernoulli(x, name=None):
+    a = unwrap(x)
+    return Tensor(jax.random.bernoulli(next_key(), a).astype(a.dtype))
+
+
+def bernoulli_(x, p=0.5, name=None):
+    x._data = jax.random.bernoulli(next_key(), p, tuple(x.shape)).astype(x.dtype)
+    return x
+
+
+def poisson(x, name=None):
+    a = unwrap(x)
+    return Tensor(jax.random.poisson(next_key(), a).astype(a.dtype))
+
+
+def exponential_(x, lam=1.0, name=None):
+    x._data = (jax.random.exponential(next_key(), tuple(x.shape), x.dtype) / lam).astype(x.dtype)
+    return x
+
+
+def binomial(count, prob, name=None):
+    c, p = unwrap(count), unwrap(prob)
+    return Tensor(jax.random.binomial(next_key(), c, p).astype(jnp.int64))
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    x._data = (jax.random.normal(next_key(), tuple(x.shape), x.dtype) * std + mean).astype(x.dtype)
+    return x
+
+
+def rand_like(x, dtype=None, name=None):
+    return Tensor(jax.random.uniform(next_key(), tuple(x.shape), _dt(dtype, unwrap(x).dtype)))
+
+
+def randn_like(x, dtype=None, name=None):
+    return Tensor(jax.random.normal(next_key(), tuple(x.shape), _dt(dtype, unwrap(x).dtype)))

@@ -1,0 +1,116 @@
+"""Search / sort ops (reference: python/paddle/tensor/search.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtype_mod
+from ..core.op_registry import apply_fn
+from ..core.tensor import Tensor, unwrap
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    dt = dtype_mod.convert_dtype(dtype)
+
+    def fn(a):
+        if axis is None:
+            out = jnp.argmax(a.reshape(-1))
+            return out.reshape((1,) * a.ndim).astype(dt) if keepdim else out.astype(dt)
+        out = jnp.argmax(a, axis=int(unwrap(axis)), keepdims=keepdim)
+        return out.astype(dt)
+
+    return apply_fn("argmax", fn, x)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    dt = dtype_mod.convert_dtype(dtype)
+
+    def fn(a):
+        if axis is None:
+            out = jnp.argmin(a.reshape(-1))
+            return out.reshape((1,) * a.ndim).astype(dt) if keepdim else out.astype(dt)
+        return jnp.argmin(a, axis=int(unwrap(axis)), keepdims=keepdim).astype(dt)
+
+    return apply_fn("argmin", fn, x)
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    def fn(a):
+        idx = jnp.argsort(a, axis=axis, stable=stable, descending=descending)
+        return idx.astype(jnp.int64)
+
+    return apply_fn("argsort", fn, x)
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    def fn(a):
+        out = jnp.sort(a, axis=axis, stable=stable, descending=descending)
+        return out
+
+    return apply_fn("sort", fn, x)
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    k = int(unwrap(k))
+
+    def fn(a):
+        ax = axis if axis is not None else -1
+        ax = ax % a.ndim
+        src = a if largest else -a
+        src_last = jnp.moveaxis(src, ax, -1)
+        import jax
+
+        vals, idx = jax.lax.top_k(src_last, k)
+        if not largest:
+            vals = -vals
+        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx.astype(jnp.int64), -1, ax)
+
+    return apply_fn("topk", fn, x)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def fn(a):
+        ax = axis % a.ndim
+        srt = jnp.sort(a, axis=ax)
+        idx = jnp.argsort(a, axis=ax)
+        v = jnp.take(srt, k - 1, axis=ax)
+        i = jnp.take(idx, k - 1, axis=ax).astype(jnp.int64)
+        if keepdim:
+            v, i = jnp.expand_dims(v, ax), jnp.expand_dims(i, ax)
+        return v, i
+
+    return apply_fn("kthvalue", fn, x)
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    a = np.asarray(unwrap(x))
+    ax = axis % a.ndim
+    srt = np.sort(a, axis=ax)
+    # most frequent value per slice
+    from scipy import stats  # available via numpy ecosystem; fallback below if missing
+
+    raise_scipy = False
+    try:
+        m = stats.mode(a, axis=ax, keepdims=keepdim)
+        vals = m.mode
+    except Exception:
+        raise_scipy = True
+    if raise_scipy:
+        vals = np.apply_along_axis(lambda v: np.bincount(v.astype(np.int64)).argmax(), ax, a)
+    idx = np.argmax(a == np.expand_dims(np.asarray(vals).squeeze(ax) if not keepdim else vals, ax), axis=ax)
+    if keepdim:
+        idx = np.expand_dims(idx, ax)
+    return Tensor(jnp.asarray(vals)), Tensor(jnp.asarray(idx.astype(np.int64)))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    def fn(s, v):
+        out = jnp.searchsorted(s, v, side="right" if right else "left")
+        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+    return apply_fn("searchsorted", fn, sorted_sequence, values)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)

@@ -1,0 +1,1 @@
+"""paddle_tpu.text — text datasets (reference: python/paddle/text). Round-1 stub."""

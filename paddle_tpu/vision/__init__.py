@@ -1,0 +1,12 @@
+"""paddle_tpu.vision (reference: python/paddle/vision)."""
+
+from . import datasets, models, transforms  # noqa: F401
+from .models.resnet import ResNet, resnet18, resnet34, resnet50, resnet101, resnet152  # noqa: F401
+
+
+def set_image_backend(backend):
+    pass
+
+
+def get_image_backend():
+    return "numpy"

@@ -1,0 +1,4 @@
+"""vision models (reference: python/paddle/vision/models)."""
+
+from .lenet import LeNet  # noqa: F401
+from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101, resnet152  # noqa: F401

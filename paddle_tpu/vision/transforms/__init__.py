@@ -1,0 +1,176 @@
+"""vision transforms (reference: python/paddle/vision/transforms) — numpy HWC pipeline."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ...core.tensor import Tensor
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+class BaseTransform:
+    def __call__(self, img):
+        return self._apply_image(np.asarray(img))
+
+
+class ToTensor(BaseTransform):
+    """HWC uint8 -> CHW float32 in [0,1]."""
+
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        if img.ndim == 2:
+            img = img[:, :, None]
+        arr = img.astype(np.float32) / 255.0 if img.dtype == np.uint8 else img.astype(np.float32)
+        if self.data_format == "CHW":
+            arr = arr.transpose(2, 0, 1)
+        return arr
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        img = np.asarray(img, np.float32)
+        if self.data_format == "CHW":
+            m = self.mean.reshape(-1, 1, 1)
+            s = self.std.reshape(-1, 1, 1)
+        else:
+            m = self.mean.reshape(1, 1, -1)
+            s = self.std.reshape(1, 1, -1)
+        return (img - m) / s
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear"):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def _apply_image(self, img):
+        import jax
+
+        chw = img.ndim == 3 and img.shape[0] in (1, 3) and img.shape[0] < img.shape[-1]
+        arr = np.asarray(img, np.float32)
+        if chw:
+            new_shape = (arr.shape[0],) + self.size
+        elif arr.ndim == 3:
+            new_shape = self.size + (arr.shape[2],)
+        else:
+            new_shape = self.size
+        out = jax.image.resize(arr, new_shape, method="linear")
+        return np.asarray(out).astype(img.dtype if img.dtype != np.uint8 else np.float32)
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if np.random.rand() < self.prob:
+            return img[:, ::-1].copy() if img.ndim == 2 else np.flip(img, axis=-2 if img.shape[0] in (1, 3) else 1).copy()
+        return img
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if np.random.rand() < self.prob:
+            return np.flip(img, axis=0 if img.ndim == 2 else (1 if img.shape[0] in (1, 3) else 0)).copy()
+        return img
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0, padding_mode="constant"):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+
+    def _apply_image(self, img):
+        chw = img.ndim == 3 and img.shape[0] in (1, 3)
+        h_ax, w_ax = (1, 2) if chw else (0, 1)
+        arr = img
+        if self.padding:
+            p = self.padding if isinstance(self.padding, (list, tuple)) else [self.padding] * 2
+            width = [(0, 0)] * arr.ndim
+            width[h_ax] = (p[0], p[0])
+            width[w_ax] = (p[1] if len(p) > 1 else p[0],) * 2
+            arr = np.pad(arr, width)
+        th, tw = self.size
+        h, w = arr.shape[h_ax], arr.shape[w_ax]
+        i = np.random.randint(0, h - th + 1)
+        j = np.random.randint(0, w - tw + 1)
+        sl = [slice(None)] * arr.ndim
+        sl[h_ax] = slice(i, i + th)
+        sl[w_ax] = slice(j, j + tw)
+        return arr[tuple(sl)]
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def _apply_image(self, img):
+        chw = img.ndim == 3 and img.shape[0] in (1, 3)
+        h_ax, w_ax = (1, 2) if chw else (0, 1)
+        th, tw = self.size
+        h, w = img.shape[h_ax], img.shape[w_ax]
+        i, j = (h - th) // 2, (w - tw) // 2
+        sl = [slice(None)] * img.ndim
+        sl[h_ax] = slice(i, i + th)
+        sl[w_ax] = slice(j, j + tw)
+        return img[tuple(sl)]
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1)):
+        self.order = order
+
+    def _apply_image(self, img):
+        if img.ndim == 2:
+            img = img[:, :, None]
+        return img.transpose(self.order)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value):
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        f = 1 + np.random.uniform(-self.value, self.value)
+        return np.clip(img.astype(np.float32) * f, 0, 255).astype(img.dtype)
+
+
+def to_tensor(pic, data_format="CHW"):
+    return Tensor(ToTensor(data_format)(pic))
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    arr = img.numpy() if isinstance(img, Tensor) else np.asarray(img)
+    return Tensor(Normalize(mean, std, data_format)._apply_image(arr))
+
+
+def resize(img, size, interpolation="bilinear"):
+    return Resize(size, interpolation)(np.asarray(img))
+
+
+def hflip(img):
+    return np.flip(np.asarray(img), axis=-2).copy()
+
+
+def center_crop(img, output_size):
+    return CenterCrop(output_size)(np.asarray(img))

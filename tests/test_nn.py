@@ -1,0 +1,217 @@
+"""nn.Layer system + layer correctness tests (reference: test/legacy_test nn tests)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+class TestLayerSystem:
+    def test_parameters_registration(self):
+        l = nn.Linear(4, 3)
+        assert len(l.parameters()) == 2
+        names = dict(l.named_parameters())
+        assert "weight" in names and "bias" in names
+
+    def test_sublayers(self):
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        assert len(net.parameters()) == 4
+        assert len(net.sublayers()) == 3
+
+    def test_state_dict_roundtrip(self):
+        net = nn.Sequential(nn.Linear(4, 8), nn.Linear(8, 2))
+        sd = net.state_dict()
+        assert len(sd) == 4
+        net2 = nn.Sequential(nn.Linear(4, 8), nn.Linear(8, 2))
+        net2.set_state_dict({k: v.numpy() for k, v in sd.items()})
+        for (k1, p1), (k2, p2) in zip(net.named_parameters(), net2.named_parameters()):
+            np.testing.assert_allclose(p1.numpy(), p2.numpy())
+
+    def test_buffers(self):
+        bn = nn.BatchNorm2D(4)
+        buf_names = [n for n, _ in bn.named_buffers()]
+        assert "_mean" in buf_names and "_variance" in buf_names
+        assert "_mean" in bn.state_dict()
+
+    def test_train_eval(self):
+        net = nn.Sequential(nn.Dropout(0.5))
+        assert net.training
+        net.eval()
+        assert not net[0].training
+
+    def test_forward_hooks(self):
+        l = nn.Linear(2, 2)
+        calls = []
+        h = l.register_forward_post_hook(lambda layer, inp, out: calls.append(1))
+        l(paddle.randn([1, 2]))
+        assert calls
+        h.remove()
+        l(paddle.randn([1, 2]))
+        assert len(calls) == 1
+
+    def test_apply_and_to_dtype(self):
+        net = nn.Linear(2, 2)
+        net.bfloat16()
+        assert net.weight.dtype == paddle.bfloat16
+        net.float()
+        assert net.weight.dtype == paddle.float32
+
+    def test_layerlist(self):
+        ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+        ll.append(nn.Linear(2, 2))
+        assert len(ll) == 4
+        assert len(ll.parameters()) == 8
+
+
+class TestFunctionalCorrectness:
+    def test_linear(self):
+        l = nn.Linear(4, 3)
+        x = paddle.randn([2, 4])
+        out = l(x)
+        ref = x.numpy() @ l.weight.numpy() + l.bias.numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+    def test_conv2d_matches_manual(self):
+        conv = nn.Conv2D(2, 3, 3, padding=1)
+        x = paddle.randn([1, 2, 5, 5])
+        out = conv(x)
+        assert out.shape == [1, 3, 5, 5]
+        # compare against scipy correlate on one output channel
+        from scipy.signal import correlate
+
+        xn = x.numpy()[0]
+        w = conv.weight.numpy()
+        ref00 = sum(correlate(xn[c], w[0, c], mode="same") for c in range(2)) + conv.bias.numpy()[0]
+        np.testing.assert_allclose(out.numpy()[0, 0], ref00, rtol=1e-4, atol=1e-4)
+
+    def test_conv_transpose_shape(self):
+        deconv = nn.Conv2DTranspose(3, 2, 4, stride=2, padding=1)
+        out = deconv(paddle.randn([1, 3, 8, 8]))
+        assert out.shape == [1, 2, 16, 16]
+
+    def test_pools(self):
+        x = paddle.to_tensor(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+        mp = nn.MaxPool2D(2, 2)(x)
+        np.testing.assert_allclose(mp.numpy()[0, 0], [[5, 7], [13, 15]])
+        ap = nn.AvgPool2D(2, 2)(x)
+        np.testing.assert_allclose(ap.numpy()[0, 0], [[2.5, 4.5], [10.5, 12.5]])
+        gap = nn.AdaptiveAvgPool2D(1)(x)
+        np.testing.assert_allclose(gap.numpy()[0, 0], [[7.5]])
+
+    def test_layernorm(self):
+        ln = nn.LayerNorm(8)
+        x = paddle.randn([4, 8])
+        out = ln(x).numpy()
+        np.testing.assert_allclose(out.mean(-1), 0, atol=1e-5)
+        np.testing.assert_allclose(out.std(-1), 1, atol=1e-2)
+
+    def test_rmsnorm(self):
+        rn = nn.RMSNorm(8)
+        x = paddle.randn([4, 8])
+        out = rn(x).numpy()
+        xn = x.numpy()
+        ref = xn / np.sqrt((xn**2).mean(-1, keepdims=True) + 1e-6)
+        np.testing.assert_allclose(out, ref, rtol=1e-4)
+
+    def test_batchnorm_train_updates_stats(self):
+        bn = nn.BatchNorm2D(3, momentum=0.9)
+        x = paddle.randn([8, 3, 4, 4]) * 2 + 5
+        bn.train()
+        bn(x)
+        # momentum 0.9: running_mean ~= 0.1 * batch_mean(~5) = ~0.5
+        assert abs(bn._mean.numpy().mean() - 0.5) < 0.1
+
+    def test_groupnorm(self):
+        gn = nn.GroupNorm(2, 4)
+        out = gn(paddle.randn([2, 4, 3, 3]))
+        assert out.shape == [2, 4, 3, 3]
+
+    def test_embedding(self):
+        emb = nn.Embedding(10, 4, padding_idx=0)
+        out = emb(paddle.to_tensor(np.array([[1, 0, 3]])))
+        assert out.shape == [1, 3, 4]
+        np.testing.assert_allclose(out.numpy()[0, 1], np.zeros(4))
+
+    def test_dropout_train_eval(self):
+        d = nn.Dropout(0.5)
+        x = paddle.ones([1000])
+        train_out = d(x)
+        assert abs(float(train_out.numpy().mean()) - 1.0) < 0.2
+        d.eval()
+        np.testing.assert_allclose(d(x).numpy(), x.numpy())
+
+    def test_activations(self):
+        x = paddle.to_tensor(np.array([-2.0, 0.0, 2.0], np.float32))
+        np.testing.assert_allclose(nn.ReLU()(x).numpy(), [0, 0, 2])
+        np.testing.assert_allclose(nn.functional.gelu(x).numpy(),
+                                   [-0.0455, 0.0, 1.9545], atol=1e-3)
+        np.testing.assert_allclose(nn.functional.softmax(x).numpy().sum(), 1.0, rtol=1e-6)
+
+    def test_losses(self):
+        logits = paddle.to_tensor(np.array([[2.0, 1.0, 0.1]], np.float32))
+        label = paddle.to_tensor(np.array([0]))
+        loss = nn.CrossEntropyLoss()(logits, label)
+        ref = -np.log(np.exp(2.0) / np.exp([2.0, 1.0, 0.1]).sum())
+        np.testing.assert_allclose(float(loss.numpy()), ref, rtol=1e-5)
+        x, y = paddle.randn([4, 3]), paddle.randn([4, 3])
+        np.testing.assert_allclose(
+            float(nn.MSELoss()(x, y).numpy()), ((x.numpy() - y.numpy()) ** 2).mean(), rtol=1e-5
+        )
+
+    def test_cross_entropy_ignore_index(self):
+        logits = paddle.randn([4, 5])
+        label = paddle.to_tensor(np.array([1, -100, 2, -100]))
+        loss = nn.functional.cross_entropy(logits, label, ignore_index=-100)
+        l0 = nn.functional.cross_entropy(logits[0:1], label[0:1])
+        l2 = nn.functional.cross_entropy(logits[2:3], label[2:3])
+        np.testing.assert_allclose(float(loss.numpy()), (float(l0.numpy()) + float(l2.numpy())) / 2, rtol=1e-5)
+
+
+class TestAttention:
+    def test_sdpa_matches_reference(self):
+        b, s, h, d = 2, 6, 2, 8
+        q = paddle.randn([b, s, h, d])
+        k = paddle.randn([b, s, h, d])
+        v = paddle.randn([b, s, h, d])
+        out = nn.functional.scaled_dot_product_attention(q, k, v, is_causal=True)
+        qn, kn, vn = (t.numpy().transpose(0, 2, 1, 3) for t in (q, k, v))
+        logits = qn @ kn.transpose(0, 1, 3, 2) / np.sqrt(d)
+        mask = np.tril(np.ones((s, s), bool))
+        logits = np.where(mask, logits, -1e9)
+        probs = np.exp(logits - logits.max(-1, keepdims=True))
+        probs /= probs.sum(-1, keepdims=True)
+        ref = (probs @ vn).transpose(0, 2, 1, 3)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-3, atol=1e-4)
+
+    def test_mha_layer(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        x = paddle.randn([2, 5, 16])
+        out = mha(x)
+        assert out.shape == [2, 5, 16]
+
+    def test_transformer_encoder(self):
+        enc_layer = nn.TransformerEncoderLayer(16, 2, 32, dropout=0.0)
+        enc = nn.TransformerEncoder(enc_layer, 2)
+        out = enc(paddle.randn([2, 5, 16]))
+        assert out.shape == [2, 5, 16]
+
+
+class TestRNN:
+    def test_lstm_shapes(self):
+        lstm = nn.LSTM(4, 8, num_layers=2)
+        out, (h, c) = lstm(paddle.randn([3, 7, 4]))
+        assert out.shape == [3, 7, 8]
+        assert h.shape == [2, 3, 8]
+
+    def test_gru_bidirectional(self):
+        gru = nn.GRU(4, 8, direction="bidirect")
+        out, h = gru(paddle.randn([2, 5, 4]))
+        assert out.shape == [2, 5, 16]
+
+    def test_lstm_grad_flows(self):
+        lstm = nn.LSTM(3, 4)
+        x = paddle.randn([2, 5, 3])
+        out, _ = lstm(x)
+        out.sum().backward()
+        assert lstm.parameters()[0].grad is not None

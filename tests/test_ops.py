@@ -1,0 +1,286 @@
+"""Op tests: numpy-referenced checks across eager + jit (cf. test/legacy_test)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_grad, check_output
+
+RNG = np.random.RandomState(7)
+
+
+def a(*shape):
+    return RNG.randn(*shape).astype(np.float32)
+
+
+class TestBinaryOps:
+    @pytest.mark.parametrize("op,ref", [
+        (paddle.add, np.add), (paddle.subtract, np.subtract),
+        (paddle.multiply, np.multiply), (paddle.divide, np.divide),
+        (paddle.maximum, np.maximum), (paddle.minimum, np.minimum),
+        (paddle.atan2, np.arctan2),
+    ])
+    def test_elementwise(self, op, ref):
+        check_output(op, ref, [a(3, 4), a(3, 4) + 2.0])
+
+    def test_broadcast(self):
+        check_output(paddle.add, np.add, [a(3, 1, 4), a(5, 1)])
+
+    def test_pow(self):
+        check_output(paddle.pow, np.power, [np.abs(a(3, 3)) + 0.5, a(3, 3)])
+
+    def test_grad_mul(self):
+        check_grad(paddle.multiply, [a(3, 4), a(3, 4)])
+
+    def test_grad_div(self):
+        check_grad(paddle.divide, [a(3, 4), np.abs(a(3, 4)) + 1.0])
+
+
+class TestUnaryOps:
+    @pytest.mark.parametrize("op,ref", [
+        (paddle.exp, np.exp), (paddle.log, lambda x: np.log(np.abs(x) + 1)),
+        (paddle.sqrt, lambda x: np.sqrt(np.abs(x) + 1)),
+        (paddle.tanh, np.tanh), (paddle.sin, np.sin), (paddle.cos, np.cos),
+        (paddle.floor, np.floor), (paddle.ceil, np.ceil), (paddle.abs, np.abs),
+        (paddle.square, np.square), (paddle.sign, np.sign),
+    ])
+    def test_unary(self, op, ref):
+        if ref in (np.log,):
+            return
+        x = a(4, 5)
+        if op in (paddle.log, paddle.sqrt):
+            check_output(op, {paddle.log: np.log, paddle.sqrt: np.sqrt}[op], [np.abs(x) + 1])
+        else:
+            check_output(op, ref, [x])
+
+    def test_sigmoid_grad(self):
+        check_grad(paddle.nn.functional.sigmoid, [a(3, 3)])
+
+    def test_tanh_grad(self):
+        check_grad(paddle.tanh, [a(3, 3)])
+
+
+class TestMatmul:
+    def test_matmul(self):
+        check_output(paddle.matmul, np.matmul, [a(4, 5), a(5, 6)])
+
+    def test_matmul_batch(self):
+        check_output(paddle.matmul, np.matmul, [a(2, 4, 5), a(2, 5, 3)])
+
+    def test_matmul_transpose(self):
+        check_output(
+            lambda x, y: paddle.matmul(x, y, transpose_y=True),
+            lambda x, y: x @ y.T, [a(4, 5), a(6, 5)],
+        )
+
+    def test_matmul_grad(self):
+        check_grad(paddle.matmul, [a(3, 4), a(4, 2)], grad_idx=0)
+        check_grad(paddle.matmul, [a(3, 4), a(4, 2)], grad_idx=1)
+
+    def test_einsum(self):
+        check_output(
+            lambda x, y: paddle.einsum("bij,bjk->bik", x, y),
+            lambda x, y: np.einsum("bij,bjk->bik", x, y), [a(2, 3, 4), a(2, 4, 5)],
+        )
+
+
+class TestReductions:
+    @pytest.mark.parametrize("op,ref", [
+        (paddle.sum, np.sum), (paddle.mean, np.mean),
+        (paddle.max, np.max), (paddle.min, np.min), (paddle.prod, np.prod),
+    ])
+    def test_full(self, op, ref):
+        check_output(op, ref, [a(3, 4)])
+
+    def test_axis_keepdim(self):
+        check_output(
+            lambda x: paddle.sum(x, axis=1, keepdim=True),
+            lambda x: np.sum(x, axis=1, keepdims=True), [a(3, 4, 5)],
+        )
+
+    def test_logsumexp(self):
+        from scipy.special import logsumexp as ref
+
+        check_output(lambda x: paddle.logsumexp(x, axis=-1), lambda x: ref(x, axis=-1), [a(3, 4)])
+
+    def test_cumsum(self):
+        check_output(lambda x: paddle.cumsum(x, axis=1), lambda x: np.cumsum(x, axis=1), [a(3, 4)])
+
+    def test_cummax(self):
+        def ref(x):
+            return np.maximum.accumulate(x, axis=0)
+
+        check_output(lambda x: paddle.cummax(x, axis=0)[0], ref, [a(5, 3)])
+
+    def test_mean_grad(self):
+        check_grad(lambda x: paddle.mean(x, axis=0), [a(4, 3)])
+
+
+class TestManipulation:
+    def test_reshape(self):
+        check_output(lambda x: paddle.reshape(x, [2, 6]), lambda x: x.reshape(2, 6), [a(3, 4)])
+
+    def test_transpose(self):
+        check_output(lambda x: paddle.transpose(x, [1, 0, 2]), lambda x: x.transpose(1, 0, 2), [a(2, 3, 4)])
+
+    def test_concat(self):
+        check_output(
+            lambda x, y: paddle.concat([x, y], axis=1),
+            lambda x, y: np.concatenate([x, y], 1), [a(2, 3), a(2, 4)],
+        )
+
+    def test_split(self):
+        x = a(6, 4)
+        outs = paddle.split(paddle.to_tensor(x), 3, axis=0)
+        refs = np.split(x, 3, axis=0)
+        for o, r in zip(outs, refs):
+            np.testing.assert_allclose(o.numpy(), r)
+
+    def test_split_sections(self):
+        x = a(7, 4)
+        outs = paddle.split(paddle.to_tensor(x), [2, 2, -1], axis=0)
+        assert [o.shape for o in outs] == [[2, 4], [2, 4], [3, 4]]
+
+    def test_stack_grad(self):
+        check_grad(lambda x, y: paddle.stack([x, y], axis=0), [a(3, 2), a(3, 2)], grad_idx=1)
+
+    def test_gather(self):
+        x, idx = a(5, 3), np.array([0, 2, 4])
+        check_output(
+            lambda xx: paddle.gather(xx, paddle.to_tensor(idx), axis=0),
+            lambda xx: xx[idx], [x],
+        )
+
+    def test_where(self):
+        c = a(3, 3) > 0
+        check_output(
+            lambda x, y: paddle.where(paddle.to_tensor(c), x, y),
+            lambda x, y: np.where(c, x, y), [a(3, 3), a(3, 3)],
+        )
+
+    def test_squeeze_unsqueeze(self):
+        check_output(lambda x: paddle.squeeze(x, axis=1), lambda x: x.squeeze(1), [a(3, 1, 4)])
+        check_output(lambda x: paddle.unsqueeze(x, axis=[0, 2]), lambda x: x[None, :, None, :], [a(3, 4)])
+
+    def test_tile_expand(self):
+        check_output(lambda x: paddle.tile(x, [2, 3]), lambda x: np.tile(x, (2, 3)), [a(2, 2)])
+        check_output(lambda x: paddle.expand(x, [4, 3, 2]), lambda x: np.broadcast_to(x, (4, 3, 2)), [a(3, 2)])
+
+    def test_pad(self):
+        check_output(
+            lambda x: paddle.to_tensor(x).pad([1, 2], value=0.5) if False else __import__("paddle_tpu").nn.functional.pad(x, [1, 2], value=0.5),
+            lambda x: np.pad(x, ((0, 0), (1, 2)), constant_values=0.5), [a(3, 4)],
+            modes=("eager",),
+        )
+
+    def test_getitem_setitem(self):
+        x = paddle.to_tensor(a(4, 4))
+        y = x[1:3, ::2]
+        assert y.shape == [2, 2]
+        x[0, 0] = 9.0
+        assert abs(float(x[0, 0].numpy()) - 9.0) < 1e-6
+
+    def test_flip_roll(self):
+        check_output(lambda x: paddle.flip(x, [0]), lambda x: np.flip(x, 0), [a(3, 4)])
+        check_output(lambda x: paddle.roll(x, 2, axis=0), lambda x: np.roll(x, 2, 0), [a(5, 2)])
+
+
+class TestSearchSort:
+    def test_argmax(self):
+        check_output(lambda x: paddle.argmax(x, axis=1), lambda x: np.argmax(x, 1), [a(4, 6)])
+
+    def test_sort_argsort(self):
+        check_output(lambda x: paddle.sort(x, axis=-1), lambda x: np.sort(x, -1), [a(3, 5)])
+        check_output(lambda x: paddle.argsort(x, axis=-1), lambda x: np.argsort(x, -1), [a(3, 5)])
+
+    def test_topk(self):
+        x = a(3, 10)
+        v, i = paddle.topk(paddle.to_tensor(x), k=3, axis=-1)
+        ref_i = np.argsort(-x, -1)[:, :3]
+        np.testing.assert_allclose(np.sort(v.numpy(), -1), np.sort(np.take_along_axis(x, ref_i, -1), -1), rtol=1e-6)
+
+    def test_searchsorted(self):
+        s = np.sort(a(8))
+        check_output(
+            lambda ss: paddle.searchsorted(ss, paddle.to_tensor(np.array([0.0, 0.5], np.float32))),
+            lambda ss: np.searchsorted(ss, np.array([0.0, 0.5], np.float32)), [s],
+        )
+
+
+class TestLinalg:
+    def test_norm(self):
+        check_output(lambda x: paddle.norm(x), lambda x: np.linalg.norm(x), [a(3, 4)], rtol=1e-4)
+
+    def test_inv_det(self):
+        m = a(3, 3) + 3 * np.eye(3, dtype=np.float32)
+        check_output(paddle.inv, np.linalg.inv, [m], rtol=1e-4)
+        check_output(paddle.det, np.linalg.det, [m], rtol=1e-4)
+
+    def test_cholesky_solve_svd(self):
+        m = a(4, 4)
+        spd = (m @ m.T + 4 * np.eye(4)).astype(np.float32)
+        L = paddle.cholesky(paddle.to_tensor(spd))
+        np.testing.assert_allclose(L.numpy() @ L.numpy().T, spd, rtol=1e-3, atol=1e-3)
+        u, s, v = paddle.svd(paddle.to_tensor(m))
+        rec = u.numpy() @ np.diag(s.numpy()) @ v.numpy().T
+        np.testing.assert_allclose(rec, m, rtol=1e-3, atol=1e-3)
+
+    def test_solve(self):
+        m = a(3, 3) + 3 * np.eye(3, dtype=np.float32)
+        b = a(3)
+        check_output(paddle.solve, np.linalg.solve, [m, b], rtol=1e-4)
+
+
+class TestCreation:
+    def test_basic(self):
+        assert paddle.zeros([2, 3]).shape == [2, 3]
+        assert paddle.ones([2], "int32").dtype == paddle.int32
+        np.testing.assert_allclose(paddle.arange(0, 10, 2).numpy(), np.arange(0, 10, 2))
+        np.testing.assert_allclose(paddle.eye(3).numpy(), np.eye(3))
+        np.testing.assert_allclose(paddle.full([2, 2], 7.0).numpy(), np.full((2, 2), 7.0))
+        np.testing.assert_allclose(paddle.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5), rtol=1e-6)
+
+    def test_tril_triu(self):
+        check_output(lambda x: paddle.tril(x), np.tril, [a(4, 4)])
+        check_output(lambda x: paddle.triu(x, 1), lambda x: np.triu(x, 1), [a(4, 4)])
+
+    def test_like(self):
+        x = paddle.to_tensor(a(2, 3))
+        assert paddle.zeros_like(x).shape == [2, 3]
+        assert paddle.full_like(x, 5).numpy().max() == 5
+
+
+class TestLogic:
+    def test_compare(self):
+        x, y = a(3, 3), a(3, 3)
+        check_output(paddle.greater_than, np.greater, [x, y])
+        check_output(paddle.equal, np.equal, [x, x.copy()])
+
+    def test_logical(self):
+        x = a(3, 3) > 0
+        y = a(3, 3) > 0
+        np.testing.assert_array_equal(
+            paddle.logical_and(paddle.to_tensor(x), paddle.to_tensor(y)).numpy(), x & y
+        )
+
+    def test_allclose_isclose(self):
+        x = a(3)
+        assert bool(paddle.allclose(paddle.to_tensor(x), paddle.to_tensor(x + 1e-9)).numpy())
+
+
+class TestOperators:
+    def test_arith(self):
+        x = paddle.to_tensor(a(2, 2))
+        y = paddle.to_tensor(a(2, 2))
+        np.testing.assert_allclose((x + y).numpy(), x.numpy() + y.numpy(), rtol=1e-6)
+        np.testing.assert_allclose((x - 2.0).numpy(), x.numpy() - 2.0, rtol=1e-6)
+        np.testing.assert_allclose((3.0 * x).numpy(), 3.0 * x.numpy(), rtol=1e-6)
+        np.testing.assert_allclose((x @ y).numpy(), x.numpy() @ y.numpy(), rtol=1e-5)
+        np.testing.assert_allclose((-x).numpy(), -x.numpy())
+        assert (x > y).dtype == paddle.bool
+
+    def test_inplace(self):
+        x = paddle.to_tensor(a(2, 2))
+        orig = x.numpy().copy()
+        x.add_(paddle.ones([2, 2]))
+        np.testing.assert_allclose(x.numpy(), orig + 1, rtol=1e-6)
