@@ -1,0 +1,101 @@
+"""Benchmark: Llama pretrain step throughput on the attached device.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Metric = tokens/sec through a full fused train step (fwd + bwd + clip + AdamW),
+bf16 params, remat on. vs_baseline = achieved MFU / 0.40 (the BASELINE.json
+north-star: Llama-2 pretrain ≥ 40% MFU @ seq 4096).
+
+Model-FLOPs use the PaLM appendix formula: 6*N per token + 12*L*H*Q*T attention
+(causal halves it).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+# bf16 peak FLOP/s per chip by TPU generation (order matters: most specific first)
+PEAK_FLOPS = (
+    ("v6e", 918e12),
+    ("v6", 918e12),
+    ("v5 lite", 197e12),
+    ("v5litepod", 197e12),
+    ("v5e", 197e12),
+    ("v5p", 459e12),
+    ("v5", 459e12),
+    ("v4", 275e12),
+)
+
+
+def _device_peak(dev) -> float:
+    kind = getattr(dev, "device_kind", "").lower()
+    for key, val in PEAK_FLOPS:
+        if key in kind:
+            return val
+    if dev.platform == "tpu":
+        return 459e12  # assume v5p class
+    return 2e12  # CPU-ish nominal, keeps the math defined
+
+
+def main():
+    import jax
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+
+    from paddle_tpu.distributed.auto_parallel import Engine
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_hidden_layers=24, num_attention_heads=16, num_key_value_heads=16,
+            max_position_embeddings=2048, dtype="bfloat16", recompute=True)
+        batch, seq, iters = 8, 2048, 10
+    else:
+        cfg = LlamaConfig.tiny(recompute=True)
+        batch, seq, iters = 4, 128, 3
+
+    model = LlamaForCausalLM(cfg)
+    eng = Engine(model, mesh=None, lr=1e-4, clip_norm=1.0)
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    lbl = ids
+
+    # warmup (compile). NOTE: block_until_ready does not synchronize through the
+    # axon TPU tunnel — a host transfer (device_get) is the only reliable fence.
+    loss = eng.step(ids, lbl)
+    jax.device_get(loss)
+    loss = eng.step(ids, lbl)
+    jax.device_get(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = eng.step(ids, lbl)
+    # params of step i feed step i+1, so fetching the last loss fences the chain
+    jax.device_get(loss)
+    dt = time.perf_counter() - t0
+
+    tokens = batch * seq * iters
+    tok_per_sec = tokens / dt
+
+    n_params = cfg.num_params()
+    L, H, Q = cfg.num_hidden_layers, cfg.num_attention_heads, cfg.head_dim
+    # fwd+bwd model flops per token: 6N + causal attention 12*L*(H*Q)*seq/2
+    flops_per_token = 6.0 * n_params + 6.0 * L * (H * Q) * seq
+    mfu = tok_per_sec * flops_per_token / _device_peak(dev)
+
+    print(json.dumps({
+        "metric": "llama_pretrain_tokens_per_sec_per_chip",
+        "value": round(tok_per_sec, 2),
+        "unit": f"tokens/s ({'llama-460M bf16 seq2048' if on_tpu else 'tiny cpu'}, "
+                f"loss {float(loss):.3f}, mfu {mfu:.3f})",
+        "vs_baseline": round(mfu / 0.40, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

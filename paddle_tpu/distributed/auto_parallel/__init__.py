@@ -14,5 +14,17 @@ from .api import (  # noqa: F401
     to_static,
     unshard_dtensor,
 )
+from .engine import Engine, ShardedTrainer  # noqa: F401
+from .logical_sharding import (  # noqa: F401
+    DEFAULT_RULES,
+    annotate,
+    axis_rules,
+    constrain,
+    current_mesh,
+    logical_to_spec,
+    make_mesh,
+    param_sharding,
+    shard_params,
+)
 from .placement import Partial, Placement, Replicate, Shard  # noqa: F401
 from .process_mesh import ProcessMesh, auto_mesh, get_current_mesh  # noqa: F401

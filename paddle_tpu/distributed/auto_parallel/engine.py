@@ -1,0 +1,224 @@
+"""Distributed training Engine — one jitted SPMD train step over a device mesh.
+
+Parity anchor: the reference's auto-parallel Engine
+(/root/reference/python/paddle/distributed/auto_parallel/static/engine.py:98 —
+completion → partition → reshard-insertion passes) and the Fleet hybrid optimizer
+(fleet/meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer.py:258).
+
+TPU-native collapse: there are no passes. The whole train step
+(forward → loss → backward → global-norm clip → AdamW) is ONE jitted function;
+parameters, grads, and optimizer state carry NamedShardings derived from logical
+axis rules, and GSPMD inserts every collective:
+  - dp/fsdp grad reduction  ≙ reference EagerReducer allreduce (collective/reducer.cc)
+  - fsdp param gather       ≙ ZeRO-3 on-demand allgather (group_sharded_stage3.py:85)
+  - fsdp opt-state sharding ≙ ZeRO-1 (dygraph_sharding_optimizer.py:48)
+  - tp activations          ≙ mp_layers.py column/row parallel collectives
+Buffers are donated so params/opt-state update in-place in HBM.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Tensor
+from ...nn.layer.layers import Layer
+from .logical_sharding import (
+    DEFAULT_RULES,
+    axis_rules,
+    current_mesh,
+    logical_to_spec,
+    param_sharding,
+    shard_params,
+)
+
+
+def _batch_sharding(mesh: Mesh, ndim: int = 2) -> NamedSharding:
+    # [batch, seq] inputs: batch over dp+fsdp, seq over sep
+    axes = ["batch", "seq"] + [None] * (ndim - 2)
+    return NamedSharding(mesh, logical_to_spec(axes[:ndim], mesh))
+
+
+class Engine:
+    """Jitted SPMD trainer for a Layer with a ``loss_fn(input_ids, labels)``.
+
+    Usage::
+
+        mesh = make_mesh({"dp": 1, "fsdp": 2, "sep": 1, "tp": 2})
+        with axis_rules(mesh):
+            model = LlamaForCausalLM(cfg)       # params created sharded
+        eng = Engine(model, mesh, lr=3e-4)
+        loss = eng.step(input_ids, labels)       # one fused XLA program
+    """
+
+    def __init__(
+        self,
+        model: Layer,
+        mesh: Optional[Mesh] = None,
+        *,
+        lr: Union[float, Callable[[jax.Array], jax.Array]] = 1e-3,
+        beta1: float = 0.9,
+        beta2: float = 0.95,
+        epsilon: float = 1e-8,
+        weight_decay: float = 0.1,
+        apply_decay_param_fun: Optional[Callable[[str], bool]] = None,
+        clip_norm: Optional[float] = 1.0,
+        rules=None,
+        loss_fn: Optional[Callable] = None,
+        donate: bool = True,
+    ):
+        self.model = model
+        self.mesh = mesh if mesh is not None else current_mesh()
+        self.rules = tuple(rules) if rules is not None else DEFAULT_RULES
+        self.lr = lr
+        self.beta1, self.beta2 = beta1, beta2
+        self.epsilon = epsilon
+        self.weight_decay = weight_decay
+        self.clip_norm = clip_norm
+        self._loss_fn = loss_fn
+        self._donate = donate
+
+        # --- functionalize: ordered trainable params ---
+        self._param_tensors = [p for _, p in model.named_parameters() if not p.stop_gradient]
+        self._param_names = [n for n, p in model.named_parameters() if not p.stop_gradient]
+        # weight-decay mask: like the reference recipes (apply_decay_param_fun),
+        # norm gains and biases (ndim <= 1) are excluded by default
+        if apply_decay_param_fun is not None:
+            self._decay_mask = [bool(apply_decay_param_fun(n)) for n in self._param_names]
+        else:
+            self._decay_mask = [p._data.ndim >= 2 for p in self._param_tensors]
+        if self.mesh is not None:
+            with axis_rules(self.mesh, self.rules):
+                shard_params(model, self.mesh)
+        self.params = [p._data for p in self._param_tensors]
+
+        # optimizer state, sharded like the params (ZeRO: fsdp axis shards them)
+        self._shardings = None
+        if self.mesh is not None:
+            with axis_rules(self.mesh, self.rules):
+                self._shardings = [param_sharding(p, self.mesh) for p in self._param_tensors]
+            zeros = lambda a, s: jax.device_put(jnp.zeros(a.shape, jnp.float32), s)
+            self.m = [zeros(a, s) for a, s in zip(self.params, self._shardings)]
+            self.v = [zeros(a, s) for a, s in zip(self.params, self._shardings)]
+        else:
+            self.m = [jnp.zeros(a.shape, jnp.float32) for a in self.params]
+            self.v = [jnp.zeros(a.shape, jnp.float32) for a in self.params]
+        self.step_count = jnp.zeros((), jnp.int32)
+        self._jit_step = None
+        self._jit_loss = None
+
+    # ---- pure functions ----
+    def _pure_loss(self, param_arrays, input_ids, labels):
+        from ...jit.api import _Swap
+        from ...core import autograd_engine
+
+        model = self.model
+        fn = self._loss_fn or (lambda ids, lb: model.loss_fn(ids, lb))
+        with autograd_engine.no_grad(), _Swap(self._param_tensors, param_arrays), \
+                axis_rules(self.mesh, self.rules):
+            out = fn(input_ids, labels)
+        return out._data if isinstance(out, Tensor) else out
+
+    def _adamw(self, params, m, v, grads, step):
+        b1, b2, eps, wd = self.beta1, self.beta2, self.epsilon, self.weight_decay
+        lr = self.lr(step) if callable(self.lr) else self.lr
+        stepf = step.astype(jnp.float32)
+        bc1 = 1.0 - b1 ** stepf
+        bc2 = 1.0 - b2 ** stepf
+
+        if self.clip_norm is not None:
+            # global-norm clip across ALL params — the reference clips across
+            # MP/PP groups too (hybrid_parallel_optimizer.py); here the grads are
+            # global (GSPMD), so a plain global norm is already group-correct.
+            gsq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in grads)
+            gnorm = jnp.sqrt(gsq)
+            scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(gnorm, 1e-6))
+            grads = [g * scale.astype(g.dtype) for g in grads]
+
+        new_p, new_m, new_v = [], [], []
+        for p, mm, vv, g, decay in zip(params, m, v, grads, self._decay_mask):
+            gf = g.astype(jnp.float32)
+            mm2 = b1 * mm + (1.0 - b1) * gf
+            vv2 = b2 * vv + (1.0 - b2) * gf * gf
+            update = (mm2 / bc1) / (jnp.sqrt(vv2 / bc2) + eps)
+            pf = p.astype(jnp.float32)
+            pf = pf - lr * (update + (wd * pf if decay else 0.0))
+            new_p.append(pf.astype(p.dtype))
+            new_m.append(mm2)
+            new_v.append(vv2)
+        return new_p, new_m, new_v
+
+    def _build_step(self):
+        def train_step(params, m, v, step, input_ids, labels):
+            step = step + 1
+            loss, grads = jax.value_and_grad(self._pure_loss)(params, input_ids, labels)
+            new_p, new_m, new_v = self._adamw(params, m, v, grads, step)
+            return new_p, new_m, new_v, step, loss
+
+        kw = {}
+        if self.mesh is not None:
+            sh = self._shardings
+            bsh = _batch_sharding(self.mesh)
+            rep = NamedSharding(self.mesh, P())
+            kw["in_shardings"] = (sh, sh, sh, rep, bsh, bsh)
+            kw["out_shardings"] = (sh, sh, sh, rep, rep)
+        if self._donate:
+            kw["donate_argnums"] = (0, 1, 2, 3)
+        return jax.jit(train_step, **kw)
+
+    # ---- public API ----
+    def shard_batch(self, *arrays):
+        """device_put host batches onto the mesh (dp×fsdp batch, sep seq)."""
+        if self.mesh is None:
+            return tuple(jnp.asarray(a) for a in arrays) if len(arrays) > 1 else jnp.asarray(arrays[0])
+        out = tuple(jax.device_put(jnp.asarray(a), _batch_sharding(self.mesh, jnp.ndim(a)))
+                    for a in arrays)
+        return out if len(out) > 1 else out[0]
+
+    def step(self, input_ids, labels):
+        """Run one fused train step; returns the (device) scalar loss."""
+        if self._jit_step is None:
+            self._jit_step = self._build_step()
+        ids = input_ids._data if isinstance(input_ids, Tensor) else jnp.asarray(input_ids)
+        lbl = labels._data if isinstance(labels, Tensor) else jnp.asarray(labels)
+        self.params, self.m, self.v, self.step_count, loss = self._jit_step(
+            self.params, self.m, self.v, self.step_count, ids, lbl)
+        return loss
+
+    def eval_loss(self, input_ids, labels):
+        if self._jit_loss is None:
+            kw = {}
+            if self.mesh is not None:
+                bsh = _batch_sharding(self.mesh)
+                kw["in_shardings"] = (self._shardings, bsh, bsh)
+            self._jit_loss = jax.jit(self._pure_loss, **kw)
+        ids = input_ids._data if isinstance(input_ids, Tensor) else jnp.asarray(input_ids)
+        lbl = labels._data if isinstance(labels, Tensor) else jnp.asarray(labels)
+        return self._jit_loss(self.params, ids, lbl)
+
+    def sync_model(self):
+        """Write the (updated) param arrays back into the Layer tensors.
+
+        Copies, not aliases: the step() jit donates its param buffers, so handing
+        out the live arrays would leave the Layer pointing at deleted memory
+        after the next step (donation is a no-op on CPU but real on TPU).
+        """
+        for t, a in zip(self._param_tensors, self.params):
+            t._data = jnp.copy(a)
+        return self.model
+
+    def state_dict(self):
+        self.sync_model()
+        return {
+            "model": self.model.state_dict(),
+            "m": {n: jnp.copy(a) for n, a in zip(self._param_names, self.m)},
+            "v": {n: jnp.copy(a) for n, a in zip(self._param_names, self.v)},
+            "step": jnp.copy(self.step_count),
+        }
+
+
+ShardedTrainer = Engine

@@ -1,0 +1,164 @@
+"""Logical-axis sharding rules — the TPU-native replacement for the reference's
+per-op SPMD rules (`/root/reference/paddle/phi/infermeta/spmd_rules/*`, ~60 files).
+
+Instead of propagating placements op-by-op in C++, model code names each parameter
+and activation dimension with a *logical* axis ("embed", "heads", "mlp", "vocab",
+"batch", "seq"); a rules table maps logical axes onto physical mesh axes
+("dp", "fsdp", "sep", "tp", "pp", "ep"). GSPMD then propagates shardings through
+the whole jitted program and inserts the collectives (the job of the reference's
+reshard functions, `phi/core/distributed/auto_parallel/reshard/*`).
+
+This is the scaling-book recipe: pick a mesh, annotate, let XLA insert collectives.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Default rules: logical axis -> mesh axis (or tuple of mesh axes).
+# Mirrors the reference's hybrid topology axes [data, pipe, sharding, sep, model]
+# (fleet/base/topology.py:70) mapped to a TPU mesh ("dp","fsdp","sep","tp") + "ep".
+DEFAULT_RULES = (
+    ("batch", ("dp", "fsdp")),
+    ("seq", "sep"),
+    ("vocab", "tp"),
+    # input-embedding vocab dim: left unsharded — a tp-sharded lookup table turns
+    # jnp.take into a full-rematerialization gather under GSPMD; the table is
+    # still fsdp-sharded along "embed".
+    ("vocab_in", None),
+    ("embed", "fsdp"),
+    ("heads", "tp"),
+    ("kv_heads", "tp"),
+    ("mlp", "tp"),
+    ("expert", "ep"),
+    ("expert_mlp", "tp"),
+    ("head_dim", None),
+    ("norm", None),
+)
+
+_state = threading.local()
+
+
+def current_mesh() -> Optional[Mesh]:
+    return getattr(_state, "mesh", None)
+
+
+def current_rules():
+    return getattr(_state, "rules", DEFAULT_RULES)
+
+
+@contextlib.contextmanager
+def axis_rules(mesh: Optional[Mesh], rules=None):
+    """Activate a mesh + logical->physical rules for model building / tracing."""
+    prev = (current_mesh(), current_rules())
+    _state.mesh = mesh
+    _state.rules = tuple(rules) if rules is not None else DEFAULT_RULES
+    try:
+        yield
+    finally:
+        _state.mesh, _state.rules = prev
+
+
+def logical_to_spec(axes: Sequence[Optional[str]], mesh: Optional[Mesh] = None,
+                    rules=None) -> P:
+    """Map logical axis names to a PartitionSpec valid on ``mesh``.
+
+    Logical axes with no rule, a rule to None, or a rule naming a mesh axis that
+    doesn't exist on this mesh become unsharded (None) — so the same model code
+    runs on any mesh shape, incl. single device.
+    """
+    mesh = mesh if mesh is not None else current_mesh()
+    rules = rules if rules is not None else current_rules()
+    mesh_axes = set(mesh.axis_names) if mesh is not None else set()
+    table = dict(rules)
+    entries = []
+    used = set()
+    for ax in axes:
+        phys = table.get(ax) if ax is not None else None
+        if phys is None:
+            entries.append(None)
+            continue
+        if isinstance(phys, str):
+            phys = (phys,)
+        keep = tuple(p for p in phys if p in mesh_axes and p not in used)
+        used.update(keep)
+        if not keep:
+            entries.append(None)
+        elif len(keep) == 1:
+            entries.append(keep[0])
+        else:
+            entries.append(keep)
+    return P(*entries)
+
+
+def annotate(param, *axes: Optional[str]):
+    """Attach logical axis names to a Tensor/Parameter (one per dim)."""
+    param.logical_axes = tuple(axes)
+    return param
+
+
+def param_sharding(param, mesh: Optional[Mesh] = None) -> Optional[NamedSharding]:
+    mesh = mesh if mesh is not None else current_mesh()
+    if mesh is None:
+        return None
+    axes = getattr(param, "logical_axes", None)
+    ndim = param._data.ndim if hasattr(param, "_data") else param.ndim
+    if axes is None:
+        axes = (None,) * ndim
+    return NamedSharding(mesh, logical_to_spec(axes, mesh))
+
+
+def shard_params(layer, mesh: Optional[Mesh] = None):
+    """device_put every parameter/buffer of a Layer per its logical axes."""
+    mesh = mesh if mesh is not None else current_mesh()
+    if mesh is None:
+        return layer
+    for _, p in layer.named_parameters():
+        s = param_sharding(p, mesh)
+        if s is not None and not isinstance(p._data, jax.core.Tracer):
+            p._data = jax.device_put(p._data, s)
+    for _, b in layer.named_buffers():
+        s = param_sharding(b, mesh)
+        if s is not None and not isinstance(b._data, jax.core.Tracer):
+            b._data = jax.device_put(b._data, s)
+    return layer
+
+
+def constrain(x, *axes: Optional[str]):
+    """with_sharding_constraint by logical axes; no-op when no mesh is active.
+
+    Accepts a jax.Array or framework Tensor; returns the same kind.
+    """
+    mesh = current_mesh()
+    if mesh is None:
+        return x
+    from ...core.tensor import Tensor
+
+    spec = logical_to_spec(axes, mesh)
+    if isinstance(x, Tensor):
+        x._data = jax.lax.with_sharding_constraint(x._data, NamedSharding(mesh, spec))
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def make_mesh(shape_by_axis, devices=None) -> Mesh:
+    """Build a Mesh from {"dp": 2, "fsdp": 2, "tp": 2, ...} (axes with size 1 kept).
+
+    Device order follows jax.devices(); ICI-friendly: innermost axes ("tp") get
+    neighboring devices so tensor-parallel collectives ride the fastest links.
+    """
+    import numpy as np
+
+    devices = devices if devices is not None else jax.devices()
+    names = [n for n, s in shape_by_axis.items()]
+    sizes = [int(s) for _, s in shape_by_axis.items()]
+    n = int(np.prod(sizes))
+    if n > len(devices):
+        raise ValueError(f"mesh needs {n} devices, have {len(devices)}")
+    arr = np.asarray(devices[:n]).reshape(sizes)
+    return Mesh(arr, tuple(names))

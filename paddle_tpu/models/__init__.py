@@ -1,0 +1,12 @@
+"""paddle_tpu.models — flagship model families.
+
+The reference ships model zoos via PaddleNLP/vision; in-tree it exercises Llama/GPT
+through distributed tests (/root/reference/test/auto_parallel/hybrid_strategy/
+semi_auto_llama.py:33, test/auto_parallel GPT tests). Here the model families are
+first-class: mesh-aware (logical-axis sharding), remat-capable, jit-first.
+"""
+
+from . import llama  # noqa: F401
+from . import gpt  # noqa: F401
+from .llama import LlamaConfig, LlamaForCausalLM, LlamaModel  # noqa: F401
+from .gpt import GPTConfig, GPTForCausalLM  # noqa: F401

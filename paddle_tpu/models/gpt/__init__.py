@@ -1,0 +1,1 @@
+from .modeling import GPTConfig, GPTDecoderLayer, GPTForCausalLM, GPTModel  # noqa: F401

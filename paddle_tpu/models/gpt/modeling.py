@@ -1,0 +1,185 @@
+"""GPT family (parity anchor: the reference's 3D-hybrid GPT tests,
+/root/reference/test/auto_parallel/ GPT cases; architecture = pre-LN GPT-2/3:
+learned positions, LayerNorm, GELU MLP, MHA).
+
+Same mesh-aware design as Llama: logical-axis-annotated params, GSPMD sharding.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...distributed.auto_parallel.logical_sharding import annotate, constrain
+from ...nn import initializer as I
+from ...nn.layer.layers import Layer, LayerList
+from ..llama.modeling import _attention, _raw
+
+
+class GPTConfig:
+    def __init__(self, vocab_size=50304, hidden_size=768, intermediate_size=None,
+                 num_hidden_layers=12, num_attention_heads=12,
+                 max_position_embeddings=1024, layer_norm_eps=1e-5,
+                 initializer_range=0.02, dtype="float32", recompute=False,
+                 use_flash_attention=True):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.intermediate_size = intermediate_size or 4 * hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.num_key_value_heads = num_attention_heads
+        self.max_position_embeddings = max_position_embeddings
+        self.layer_norm_eps = layer_norm_eps
+        self.initializer_range = initializer_range
+        self.dtype = dtype
+        self.recompute = recompute
+        self.use_flash_attention = use_flash_attention
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+    @classmethod
+    def tiny(cls, **over):
+        d = dict(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                 num_attention_heads=4, max_position_embeddings=128)
+        d.update(over)
+        return cls(**d)
+
+
+class GPTLayerNorm(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.eps = config.layer_norm_eps
+        self.weight = annotate(self.create_parameter(
+            [config.hidden_size], dtype=config.dtype,
+            default_initializer=I.Constant(1.0)), "norm")
+        self.bias = annotate(self.create_parameter(
+            [config.hidden_size], dtype=config.dtype, is_bias=True), "norm")
+
+    def forward(self, x):
+        x = _raw(x)
+        xf = x.astype(jnp.float32)
+        mu = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.var(xf, axis=-1, keepdims=True)
+        out = ((xf - mu) * jax.lax.rsqrt(var + self.eps)).astype(x.dtype)
+        return out * self.weight._data + self.bias._data
+
+
+class GPTAttention(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        h, hd = config.hidden_size, config.head_dim
+        self.num_heads = config.num_attention_heads
+        init = I.Normal(std=config.initializer_range)
+        mk = lambda shape, axes: annotate(self.create_parameter(
+            shape, dtype=config.dtype, default_initializer=init), *axes)
+        self.qkv_weight = mk([h, 3 * h], ("embed", "heads"))
+        self.qkv_bias = annotate(self.create_parameter(
+            [3 * h], dtype=config.dtype, is_bias=True), "heads")
+        self.out_weight = mk([h, h], ("heads", "embed"))
+        self.out_bias = annotate(self.create_parameter(
+            [h], dtype=config.dtype, is_bias=True), "norm")
+
+    def forward(self, hidden):
+        x = _raw(hidden)
+        b, s, h = x.shape
+        hd = self.config.head_dim
+        qkv = jnp.matmul(x, self.qkv_weight._data) + self.qkv_bias._data
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, s, self.num_heads, hd)
+        k = k.reshape(b, s, self.num_heads, hd)
+        v = v.reshape(b, s, self.num_heads, hd)
+        q = constrain(q, "batch", "seq", "heads", "head_dim")
+        out = _attention(q, k, v, self.config)
+        out = out.reshape(b, s, h)
+        return jnp.matmul(out, self.out_weight._data) + self.out_bias._data
+
+
+class GPTMLP(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        h, m = config.hidden_size, config.intermediate_size
+        init = I.Normal(std=config.initializer_range)
+        self.fc_weight = annotate(self.create_parameter(
+            [h, m], dtype=config.dtype, default_initializer=init), "embed", "mlp")
+        self.fc_bias = annotate(self.create_parameter(
+            [m], dtype=config.dtype, is_bias=True), "mlp")
+        self.proj_weight = annotate(self.create_parameter(
+            [m, h], dtype=config.dtype, default_initializer=init), "mlp", "embed")
+        self.proj_bias = annotate(self.create_parameter(
+            [h], dtype=config.dtype, is_bias=True), "norm")
+
+    def forward(self, x):
+        x = _raw(x)
+        a = jax.nn.gelu(jnp.matmul(x, self.fc_weight._data) + self.fc_bias._data)
+        a = constrain(a, "batch", "seq", "mlp")
+        return jnp.matmul(a, self.proj_weight._data) + self.proj_bias._data
+
+
+class GPTDecoderLayer(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.ln_1 = GPTLayerNorm(config)
+        self.attn = GPTAttention(config)
+        self.ln_2 = GPTLayerNorm(config)
+        self.mlp = GPTMLP(config)
+
+    def forward(self, hidden):
+        x = _raw(hidden)
+        x = x + self.attn(self.ln_1(x))
+        x = x + self.mlp(self.ln_2(x))
+        return constrain(x, "batch", "seq", "embed")
+
+
+class GPTModel(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        init = I.Normal(std=config.initializer_range)
+        self.wte = annotate(self.create_parameter(
+            [config.vocab_size, config.hidden_size], dtype=config.dtype,
+            default_initializer=init), "vocab_in", "embed")
+        self.wpe = annotate(self.create_parameter(
+            [config.max_position_embeddings, config.hidden_size],
+            dtype=config.dtype, default_initializer=init), "seq", "embed")
+        self.layers = LayerList([GPTDecoderLayer(config)
+                                 for _ in range(config.num_hidden_layers)])
+        self.ln_f = GPTLayerNorm(config)
+
+    def forward(self, input_ids):
+        ids = _raw(input_ids)
+        table = constrain(self.wte._data, None, None)
+        x = jnp.take(table, ids, axis=0) + self.wpe._data[: ids.shape[1]]
+        x = constrain(x, "batch", "seq", "embed")
+        remat = self.config.recompute and isinstance(x, jax.core.Tracer)
+        for layer in self.layers:
+            if remat:
+                x = jax.checkpoint(lambda h, lyr=layer: lyr(h))(x)
+            else:
+                x = layer(x)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.gpt = GPTModel(config)
+
+    def forward(self, input_ids, labels=None):
+        from ..llama.modeling import LlamaPretrainingCriterion
+
+        hidden = self.gpt(input_ids)
+        logits = jnp.matmul(hidden, self.gpt.wte._data.T)
+        logits = constrain(logits, "batch", "seq", "vocab")
+        if labels is None:
+            return Tensor(logits) if not isinstance(logits, jax.core.Tracer) else logits
+        return LlamaPretrainingCriterion.compute(logits, _raw(labels))
+
+    def loss_fn(self, input_ids, labels):
+        return self.forward(input_ids, labels)

@@ -1,0 +1,7 @@
+from .modeling import (  # noqa: F401
+    LlamaConfig,
+    LlamaDecoderLayer,
+    LlamaForCausalLM,
+    LlamaModel,
+    LlamaPretrainingCriterion,
+)

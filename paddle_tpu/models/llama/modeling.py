@@ -1,0 +1,334 @@
+"""Llama family — the flagship pretraining model.
+
+Parity anchor: the reference trains this architecture in its hybrid-strategy tests
+(/root/reference/test/auto_parallel/hybrid_strategy/semi_auto_llama.py:33 — hidden
+4096, GQA, RoPE, RMSNorm, SwiGLU) using ColumnParallelLinear/RowParallelLinear
+(fleet/layers/mpu/mp_layers.py:334,541) + flash attention
+(nn/functional/flash_attention.py:195).
+
+TPU-native design: one set of plain Layers whose parameters carry *logical axis*
+names; sharding (tp / fsdp / sep / dp) is applied by rules at the mesh boundary
+(distributed/auto_parallel/logical_sharding.py) and GSPMD inserts the collectives.
+The same model class is therefore the single-chip model, the TP model, and the
+FSDP model — no per-strategy layer forks like the reference's mpu vs plain nn.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...distributed.auto_parallel.logical_sharding import annotate, constrain, current_mesh
+from ...nn import functional as F
+from ...nn import initializer as I
+from ...nn.layer.layers import Layer, LayerList
+
+
+class LlamaConfig:
+    def __init__(
+        self,
+        vocab_size: int = 32000,
+        hidden_size: int = 4096,
+        intermediate_size: int = 11008,
+        num_hidden_layers: int = 32,
+        num_attention_heads: int = 32,
+        num_key_value_heads: Optional[int] = None,
+        max_position_embeddings: int = 4096,
+        rms_norm_eps: float = 1e-6,
+        rope_theta: float = 10000.0,
+        initializer_range: float = 0.02,
+        tie_word_embeddings: bool = False,
+        dtype: str = "float32",
+        recompute: bool = False,
+        use_flash_attention: bool = True,
+        sequence_parallel: bool = False,
+    ):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.intermediate_size = intermediate_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.num_key_value_heads = num_key_value_heads or num_attention_heads
+        self.max_position_embeddings = max_position_embeddings
+        self.rms_norm_eps = rms_norm_eps
+        self.rope_theta = rope_theta
+        self.initializer_range = initializer_range
+        self.tie_word_embeddings = tie_word_embeddings
+        self.dtype = dtype
+        self.recompute = recompute
+        self.use_flash_attention = use_flash_attention
+        self.sequence_parallel = sequence_parallel
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    def num_params(self) -> int:
+        """Parameter count (for MFU math)."""
+        h, v, m = self.hidden_size, self.vocab_size, self.intermediate_size
+        kvh = self.num_key_value_heads * self.head_dim
+        per_layer = (
+            h * h + 2 * h * kvh + h * h  # q, k, v, o
+            + 3 * h * m                   # gate, up, down
+            + 2 * h                       # two rmsnorms
+        )
+        total = v * h + self.num_hidden_layers * per_layer + h
+        if not self.tie_word_embeddings:
+            total += h * v
+        return total
+
+    @classmethod
+    def tiny(cls, **over):
+        """Small config for tests / multichip dry-runs. Dims divide tp/fsdp/sep=2."""
+        d = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                 num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+                 max_position_embeddings=128)
+        d.update(over)
+        return cls(**d)
+
+
+def _rope_cos_sin(seq_len: int, head_dim: int, theta: float, dtype):
+    """Rotary tables [seq, head_dim] (half-rotated layout, GPT-NeoX style — matches
+    reference fused_rotary_position_embedding use_neox_rotary_style=True)."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)                       # [s, d/2]
+    emb = jnp.concatenate([freqs, freqs], axis=-1)       # [s, d]
+    return jnp.cos(emb).astype(dtype), jnp.sin(emb).astype(dtype)
+
+
+def _rotate_half(x):
+    half = x.shape[-1] // 2
+    return jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)
+
+
+def apply_rotary_pos_emb(q, k, cos, sin):
+    """q,k: [b, s, h, d]; cos/sin: [s, d] broadcast over batch/heads."""
+    cos = cos[None, :, None, :]
+    sin = sin[None, :, None, :]
+    return q * cos + _rotate_half(q) * sin, k * cos + _rotate_half(k) * sin
+
+
+class LlamaAttention(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        h = config.hidden_size
+        hd = config.head_dim
+        self.num_heads = config.num_attention_heads
+        self.num_kv_heads = config.num_key_value_heads
+        init = I.Normal(std=config.initializer_range)
+        mk = lambda din, dout: self.create_parameter(
+            [din, dout], dtype=config.dtype, default_initializer=init)
+        self.q_proj_weight = annotate(mk(h, self.num_heads * hd), "embed", "heads")
+        self.k_proj_weight = annotate(mk(h, self.num_kv_heads * hd), "embed", "heads")
+        self.v_proj_weight = annotate(mk(h, self.num_kv_heads * hd), "embed", "heads")
+        self.o_proj_weight = annotate(mk(self.num_heads * hd, h), "heads", "embed")
+
+    def forward(self, hidden, cos, sin, attn_bias=None):
+        b, s, h = hidden.shape if isinstance(hidden, Tensor) else hidden.shape
+        hd = self.config.head_dim
+        x = hidden._data if isinstance(hidden, Tensor) else hidden
+        q = jnp.matmul(x, self.q_proj_weight._data).reshape(b, s, self.num_heads, hd)
+        k = jnp.matmul(x, self.k_proj_weight._data).reshape(b, s, self.num_kv_heads, hd)
+        v = jnp.matmul(x, self.v_proj_weight._data).reshape(b, s, self.num_kv_heads, hd)
+        q = constrain(q, "batch", "seq", "heads", "head_dim")
+        k = constrain(k, "batch", "seq", "kv_heads", "head_dim")
+        v = constrain(v, "batch", "seq", "kv_heads", "head_dim")
+        q, k = apply_rotary_pos_emb(q, k, cos, sin)
+        out = _attention(q, k, v, self.config, attn_bias)
+        out = out.reshape(b, s, self.num_heads * hd)
+        out = jnp.matmul(out, self.o_proj_weight._data)
+        out = constrain(out, "batch", "seq", "embed")
+        return out
+
+
+def _attention(q, k, v, config, attn_bias=None):
+    """Causal attention on raw arrays; routes to the Pallas kernel on TPU.
+
+    Routing under a mesh:
+      - no mesh / 1-device mesh → direct Pallas flash attention
+      - sep (context-parallel) axis sharded → ring attention (ppermute over ICI)
+      - dp/fsdp/tp sharded, seq whole → shard_map over (batch, heads), Pallas
+        flash attention per shard (batched GQA kept in the index_map)
+    """
+    if config.use_flash_attention and attn_bias is None:
+        from ...ops.flash_attention import flash_attention as fa
+
+        mesh = current_mesh()
+        if mesh is None or mesh.size == 1:
+            return fa(q, k, v, causal=True)
+        sep = mesh.shape.get("sep", 1)
+        if sep > 1:
+            from ...ops.ring_attention import ring_attention
+
+            return ring_attention(q, k, v, mesh, axis_name="sep", causal=True)
+        from jax import shard_map
+        from ...distributed.auto_parallel.logical_sharding import logical_to_spec
+
+        tp = mesh.shape.get("tp", 1)
+        dbatch = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+        if q.shape[0] % dbatch == 0 and q.shape[2] % tp == 0 and k.shape[2] % tp == 0:
+            qspec = logical_to_spec(("batch", None, "heads", None), mesh)
+            kspec = logical_to_spec(("batch", None, "kv_heads", None), mesh)
+            f = shard_map(
+                lambda a, b, c: fa(a, b, c, causal=True),
+                mesh=mesh,
+                in_specs=(qspec, kspec, kspec),
+                out_specs=qspec,
+                check_vma=False,
+            )
+            return f(q, k, v)
+    from ...nn.functional.flash_attention import _xla_attention
+
+    return _xla_attention(q, k, v, bias=attn_bias, causal=True)
+
+
+class LlamaMLP(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        h, m = config.hidden_size, config.intermediate_size
+        init = I.Normal(std=config.initializer_range)
+        mk = lambda din, dout: self.create_parameter(
+            [din, dout], dtype=config.dtype, default_initializer=init)
+        self.gate_proj_weight = annotate(mk(h, m), "embed", "mlp")
+        self.up_proj_weight = annotate(mk(h, m), "embed", "mlp")
+        self.down_proj_weight = annotate(mk(m, h), "mlp", "embed")
+
+    def forward(self, x):
+        x = x._data if isinstance(x, Tensor) else x
+        g = jnp.matmul(x, self.gate_proj_weight._data)
+        u = jnp.matmul(x, self.up_proj_weight._data)
+        act = jax.nn.silu(g) * u   # swiglu — XLA fuses this into the matmuls
+        act = constrain(act, "batch", "seq", "mlp")
+        out = jnp.matmul(act, self.down_proj_weight._data)
+        return constrain(out, "batch", "seq", "embed")
+
+
+class LlamaRMSNorm(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.eps = config.rms_norm_eps
+        self.weight = annotate(
+            self.create_parameter([config.hidden_size], dtype=config.dtype,
+                                  default_initializer=I.Constant(1.0)),
+            "norm")
+
+    def forward(self, x):
+        x = x._data if isinstance(x, Tensor) else x
+        dt = x.dtype
+        xf = x.astype(jnp.float32)
+        var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+        out = (xf * jax.lax.rsqrt(var + self.eps)).astype(dt)
+        return out * self.weight._data
+
+
+class LlamaDecoderLayer(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.input_layernorm = LlamaRMSNorm(config)
+        self.self_attn = LlamaAttention(config)
+        self.post_attention_layernorm = LlamaRMSNorm(config)
+        self.mlp = LlamaMLP(config)
+
+    def forward(self, hidden, cos, sin, attn_bias=None):
+        x = hidden._data if isinstance(hidden, Tensor) else hidden
+        x = x + self.self_attn(self.input_layernorm(x), cos, sin, attn_bias)
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return constrain(x, "batch", "seq", "embed")
+
+
+class LlamaModel(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        init = I.Normal(std=config.initializer_range)
+        self.embed_tokens_weight = annotate(
+            self.create_parameter([config.vocab_size, config.hidden_size],
+                                  dtype=config.dtype, default_initializer=init),
+            "vocab_in", "embed")
+        self.layers = LayerList([LlamaDecoderLayer(config)
+                                 for _ in range(config.num_hidden_layers)])
+        self.norm = LlamaRMSNorm(config)
+
+    def forward(self, input_ids, attn_bias=None):
+        ids = input_ids._data if isinstance(input_ids, Tensor) else jnp.asarray(input_ids)
+        cfg = self.config
+        # FSDP-style: all-gather the (embed-sharded) table before the lookup so
+        # the gather is local — otherwise GSPMD falls back to full remat.
+        table = constrain(self.embed_tokens_weight._data, None, None)
+        x = jnp.take(table, ids, axis=0)
+        x = constrain(x, "batch", "seq", "embed")
+        cos, sin = _rope_cos_sin(ids.shape[1], cfg.head_dim, cfg.rope_theta, x.dtype)
+        remat = cfg.recompute and isinstance(x, jax.core.Tracer)
+        for layer in self.layers:
+            if remat:
+                # closure holds the params (inputs, not recomputed); activations
+                # inside the layer are rematerialized in backward — the TPU
+                # analogue of fleet/recompute/recompute.py:455.
+                x = jax.checkpoint(
+                    lambda h, c, s, lyr=layer: lyr(h, c, s, attn_bias))(x, cos, sin)
+            else:
+                x = layer(x, cos, sin, attn_bias)
+        return self.norm(x)
+
+
+class LlamaForCausalLM(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.model = LlamaModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head_weight = None
+        else:
+            init = I.Normal(std=config.initializer_range)
+            self.lm_head_weight = annotate(
+                self.create_parameter([config.hidden_size, config.vocab_size],
+                                      dtype=config.dtype, default_initializer=init),
+                "embed", "vocab")
+
+    def logits(self, hidden):
+        w = (self.model.embed_tokens_weight._data.T
+             if self.lm_head_weight is None else self.lm_head_weight._data)
+        out = jnp.matmul(hidden, w)
+        return constrain(out, "batch", "seq", "vocab")
+
+    def forward(self, input_ids, labels=None, attn_bias=None):
+        hidden = self.model(input_ids, attn_bias)
+        logits = self.logits(hidden)
+        if labels is None:
+            return Tensor(logits) if not isinstance(logits, jax.core.Tracer) else logits
+        loss = LlamaPretrainingCriterion.compute(logits, _raw(labels))
+        return loss
+
+    def loss_fn(self, input_ids, labels):
+        """Raw-array loss for jit'ed training steps."""
+        hidden = self.model(input_ids)
+        return LlamaPretrainingCriterion.compute(self.logits(hidden), _raw(labels))
+
+
+def _raw(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class LlamaPretrainingCriterion(Layer):
+    """Shifted causal-LM cross entropy, fp32 softmax (bf16-safe)."""
+
+    @staticmethod
+    def compute(logits, labels, ignore_index: int = -100):
+        lg = logits[:, :-1, :].astype(jnp.float32)
+        lb = labels[:, 1:]
+        logz = jax.scipy.special.logsumexp(lg, axis=-1)
+        picked = jnp.take_along_axis(lg, lb[..., None].astype(jnp.int32), axis=-1)[..., 0]
+        nll = logz - picked
+        mask = (lb != ignore_index)
+        nll = jnp.where(mask, nll, 0.0)
+        return nll.sum() / jnp.maximum(mask.sum().astype(jnp.float32), 1.0)
+
+    def forward(self, prediction_scores, masked_lm_labels):
+        return Tensor(self.compute(_raw(prediction_scores), _raw(masked_lm_labels)))

@@ -1,0 +1,11 @@
+"""paddle_tpu.ops — Pallas TPU kernels for the ops XLA can't fuse optimally.
+
+The TPU-native analogue of the reference's hand-written CUDA fusion library
+(/root/reference/paddle/phi/kernels/fusion/gpu/, 75 files): most fusions
+(bias+act, rmsnorm, rope, swiglu) are left to XLA; Pallas is reserved for
+block-streamed attention (flash / ring / paged-KV) where XLA's fusion model
+can't express the online-softmax streaming pattern.
+"""
+
+from .flash_attention import flash_attention  # noqa: F401
+from .ring_attention import ring_attention  # noqa: F401

@@ -1,0 +1,162 @@
+"""Flash attention — Pallas TPU kernel (online softmax, block-streamed K/V).
+
+Replaces the reference's vendored CUDA flashattn (dynload wrapper
+/root/reference/paddle/phi/backends/dynload/flashattn.cc, python surface
+nn/functional/flash_attention.py:195). TPU design:
+  - grid (batch, q_heads, q_blocks); K/V stream through VMEM in BLOCK_K chunks
+  - fp32 running max/sum (online softmax), bf16 MXU matmuls
+  - causal grids skip fully-masked K blocks (upper bound on the fori_loop)
+  - GQA: q-head → kv-head mapping folded into the BlockSpec index_map, so
+    K/V are never materialized per-q-head (the XLA fallback repeats them)
+Backward: rematerialized XLA attention VJP (correct, XLA-fused); a dedicated
+Pallas backward kernel is a later optimization.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30
+
+
+def _xla_reference(q, k, v, causal, scale):
+    """Plain-XLA attention used as fallback and as the VJP recompute path."""
+    qh = jnp.swapaxes(q, 1, 2).astype(jnp.float32)
+    kh = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
+    vh = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
+    if kh.shape[1] != qh.shape[1]:
+        rep = qh.shape[1] // kh.shape[1]
+        kh = jnp.repeat(kh, rep, axis=1)
+        vh = jnp.repeat(vh, rep, axis=1)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
+    if causal:
+        ql, kl = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((ql, kl), bool), k=kl - ql)
+        logits = jnp.where(mask, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q, block_k,
+               kv_len, q_len):
+    """One (batch, head, q-block) program; streams K/V in block_k chunks."""
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32) * scale          # [BQ, d]
+    d = q.shape[-1]
+
+    # End-aligned causal offset: q row i attends k cols <= i + (kv_len - q_len),
+    # matching _xla_reference's tril(k=kl-ql) (kv-cache style when kv > q).
+    offset = kv_len - q_len
+    num_kv = kv_len // block_k
+    if causal:
+        # blocks entirely in the future are skipped (dynamic trip count)
+        last_k = qi * block_q + block_q - 1 + offset
+        num_kv = jnp.clip((last_k + block_k) // block_k, 0, num_kv)
+
+    def body(j, carry):
+        acc, m, l = carry
+        kb = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vb = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # [BQ, BK]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos + offset >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        return acc_new, m_new, l_new
+
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, num_kv, body, (acc0, m0, l0))
+    o_ref[0, 0] = (acc / l[:, None]).astype(o_ref.dtype)
+
+
+def _pallas_attention(q, k, v, causal, scale, block_q, block_k, interpret):
+    b, s_q, hq, d = q.shape
+    _, s_kv, hkv, _ = k.shape
+    group = hq // hkv
+    # [b, h, s, d] layout for blocking
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+
+    grid = (b, hq, s_q // block_q)
+    kernel = functools.partial(
+        _fa_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, kv_len=s_kv, q_len=s_q)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, s_kv, d), lambda bi, hi, qi: (bi, hi // group, 0, 0)),
+            pl.BlockSpec((1, 1, s_kv, d), lambda bi, hi, qi: (bi, hi // group, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        interpret=interpret,
+    )(qt, kt, vt)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def _use_pallas(q, k, block_q, block_k, interpret):
+    # shape guards apply in interpret mode too — a non-divisible seq would leave
+    # output rows unwritten / drop kv tokens silently
+    s_q, s_kv = q.shape[1], k.shape[1]
+    shapes_ok = s_q % block_q == 0 and s_kv % block_k == 0
+    if interpret:
+        return shapes_ok
+    if jax.default_backend() != "tpu":
+        return False
+    return shapes_ok and q.shape[3] in (64, 128, 256)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    if _use_pallas(q, k, block_q, block_k, interpret):
+        return _pallas_attention(q, k, v, causal, scale, block_q, block_k, interpret)
+    return _xla_reference(q, k, v, causal, scale)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    return _flash(q, k, v, causal, scale, block_q, block_k, interpret), (q, k, v)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda a, b, c: _xla_reference(a, b, c, causal, scale), q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = False, scale=None,
+                    block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K,
+                    interpret: bool = False):
+    """q,k,v: [batch, seq, heads, head_dim] (reference layout,
+    nn/functional/flash_attention.py:195). Returns same layout/dtype as q."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    bq = min(block_q, q.shape[1])
+    bk = min(block_k, k.shape[1])
+    return _flash(q, k, v, causal, float(scale), bq, bk, interpret)
+
+
+# Back-compat name used by nn.functional
+flash_attention_fwd = flash_attention

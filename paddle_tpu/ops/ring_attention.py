@@ -1,0 +1,115 @@
+"""Ring attention — context-parallel causal attention over the ``sep`` mesh axis.
+
+The reference's long-context story is SP/SEP activation sharding + flash-attention
+kernels only — it has NO ring attention (SURVEY.md §5.7, grep-verified). This
+exceeds it: Q stays local, K/V blocks rotate around the ring via
+``lax.ppermute`` over ICI while each step's partial attention is merged with an
+online-softmax (flash-style) accumulator, so attention over sequence length
+n_dev × local_len never materializes on one chip.
+
+Causality is handled at block granularity: a K block strictly in the future is
+masked entirely; the diagonal block gets the triangular mask.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, mask, scale):
+    """q:[b,sq,h,d] k/v:[b,sk,hkv,d] mask:[sq,sk] bool (True=keep) or None.
+    Returns (out fp32 [b,sq,h,d], m fp32 [b,sq,h], l fp32 [b,sq,h])."""
+    hq, hkv = q.shape[2], k.shape[2]
+    if hq != hkv:
+        rep = hq // hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
+    if mask is not None:
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1)                      # [b,h,q]
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)                      # [b,h,q]
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    # transpose stats to [b,q,h]
+    return out, jnp.swapaxes(m, 1, 2), jnp.swapaxes(l, 1, 2)
+
+
+def _ring_body(q, k, v, axis_name: str, causal: bool, scale: float, n: int):
+    # n is static (mesh axis size) so the fori_loop lowers to a reverse-mode
+    # differentiable scan.
+    idx = jax.lax.axis_index(axis_name)
+    b, sq, h, d = q.shape
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    acc0 = jnp.zeros((b, sq, h, d), jnp.float32)
+    m0 = jnp.full((b, sq, h), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, sq, h), jnp.float32)
+
+    tri = jnp.tril(jnp.ones((sq, k.shape[1]), bool)) if causal else None
+
+    def body(j, carry):
+        acc, m, l, kc, vc = carry
+        src = (idx - j) % n                      # global block id of kc
+
+        def compute(args):
+            acc, m, l, kc, vc = args
+            if causal:
+                # diagonal block → triangular mask; past block → full
+                mask = jnp.where(src == idx, tri, jnp.ones_like(tri))
+            else:
+                mask = None
+            out_j, m_j, l_j = _block_attn(q, kc, vc, mask, scale)
+            m_new = jnp.maximum(m, m_j)
+            a1 = jnp.exp(m - m_new)
+            a2 = jnp.exp(m_j - m_new)
+            return (acc * a1[..., None] + out_j * a2[..., None],
+                    m_new, l * a1 + l_j * a2)
+
+        def skip(args):
+            acc, m, l, _, _ = args
+            return acc, m, l
+
+        if causal:
+            # a fully-future block contributes exactly nothing (its masked
+            # max is NEG_INF → zero softmax weight) — skip its FLOPs entirely
+            acc, m, l = jax.lax.cond(src > idx, skip, compute, (acc, m, l, kc, vc))
+        else:
+            acc, m, l = compute((acc, m, l, kc, vc))
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        return acc, m, l, kc, vc
+
+    acc, m, l, _, _ = jax.lax.fori_loop(0, n, body, (acc0, m0, l0, k, v))
+    # fully-masked rows (can't happen with causal self-attn) guard:
+    l = jnp.maximum(l, 1e-30)
+    return (acc / l[..., None]).astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh, axis_name: str = "sep", causal: bool = True,
+                   scale: Optional[float] = None):
+    """Global-view entry: q,k,v [batch, seq, heads, head_dim] sharded along seq
+    on ``axis_name``; batch may be sharded on dp/fsdp, heads on tp."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    from ..distributed.auto_parallel.logical_sharding import logical_to_spec
+
+    qspec = logical_to_spec(("batch", "seq", "heads", None), mesh)
+    kspec = logical_to_spec(("batch", "seq", "kv_heads", None), mesh)
+    n = int(mesh.shape[axis_name])
+    f = shard_map(
+        lambda a, b, c: _ring_body(a, b, c, axis_name, causal, float(scale), n),
+        mesh=mesh,
+        in_specs=(qspec, kspec, kspec),
+        out_specs=qspec,
+        check_vma=False,
+    )
+    return f(q, k, v)
