@@ -70,6 +70,8 @@ class Engine:
         rules=None,
         loss_fn: Optional[Callable] = None,
         donate: bool = True,
+        n_micro: Optional[int] = None,
+        pp_remat: Optional[bool] = None,
     ):
         self.model = model
         self.mesh = mesh if mesh is not None else current_mesh()
@@ -82,9 +84,23 @@ class Engine:
         self._loss_fn = loss_fn
         self._donate = donate
 
-        # --- functionalize: ordered trainable params ---
-        self._param_tensors = [p for _, p in model.named_parameters() if not p.stop_gradient]
-        self._param_names = [n for n, p in model.named_parameters() if not p.stop_gradient]
+        # --- pipeline parallelism: peel block params off for pp-stacking ---
+        pp_size = self.mesh.shape.get("pp", 1) if self.mesh is not None else 1
+        self._pp = pp_size > 1 and hasattr(model, "pipeline_blocks")
+        self._blocks = model.pipeline_blocks() if self._pp else []
+        if self._pp and len(self._blocks) % pp_size != 0:
+            raise ValueError(
+                f"num blocks {len(self._blocks)} not divisible by pp={pp_size}")
+        self._n_micro = n_micro if n_micro is not None else max(pp_size, 1)
+        self._pp_remat = (pp_remat if pp_remat is not None
+                          else bool(getattr(getattr(model, "config", None), "recompute", False)))
+        block_param_ids = {id(t) for b in self._blocks for _, t in b.named_parameters()}
+
+        # --- functionalize: ordered trainable params (non-block "rest" first) ---
+        self._param_tensors = [p for _, p in model.named_parameters()
+                               if not p.stop_gradient and id(p) not in block_param_ids]
+        self._param_names = [n for n, p in model.named_parameters()
+                             if not p.stop_gradient and id(p) not in block_param_ids]
         # weight-decay mask: like the reference recipes (apply_decay_param_fun),
         # norm gains and biases (ndim <= 1) are excluded by default
         if apply_decay_param_fun is not None:
@@ -96,11 +112,41 @@ class Engine:
                 shard_params(model, self.mesh)
         self.params = [p._data for p in self._param_tensors]
 
+        # pipeline: stack block params [n_layers, ...] sharded P("pp", <block axes>)
+        self._n_rest = len(self.params)
+        self._block_shardings = []
+        if self._pp:
+            from .pipeline import stack_block_params
+
+            if self._loss_fn is not None:
+                raise ValueError(
+                    "custom loss_fn is not supported with pipeline parallelism "
+                    "(pp > 1) — the pp path runs model.pipeline_loss")
+            with axis_rules(self.mesh, self.rules):
+                stacked, bshard, bnames, bdecay = stack_block_params(
+                    self._blocks, self.mesh)
+            self.params = self.params + stacked
+            if apply_decay_param_fun is not None:
+                # per-layer decay decisions collapse to the block-level name
+                # (all layers of a stack share one stacked param)
+                bdecay = [bool(apply_decay_param_fun(n)) for n in bnames]
+            self._param_names = self._param_names + [f"blocks.{n}" for n in bnames]
+            self._decay_mask = self._decay_mask + bdecay
+            self._block_shardings = bshard
+            self._block_fn = type(self.model).pipeline_block_fn(self._blocks[0])
+            # free the unstacked per-layer originals — otherwise the Layer
+            # tensors pin a second full copy of the decoder weights in HBM.
+            # sync_model() restores them by slicing the stacked arrays.
+            for b in self._blocks:
+                for _, t in b.named_parameters():
+                    t._data = None
+
         # optimizer state, sharded like the params (ZeRO: fsdp axis shards them)
         self._shardings = None
         if self.mesh is not None:
             with axis_rules(self.mesh, self.rules):
                 self._shardings = [param_sharding(p, self.mesh) for p in self._param_tensors]
+            self._shardings = self._shardings + self._block_shardings
             zeros = lambda a, s: jax.device_put(jnp.zeros(a.shape, jnp.float32), s)
             self.m = [zeros(a, s) for a, s in zip(self.params, self._shardings)]
             self.v = [zeros(a, s) for a, s in zip(self.params, self._shardings)]
@@ -117,6 +163,22 @@ class Engine:
         from ...core import autograd_engine
 
         model = self.model
+        if self._pp:
+            from .pipeline import pipeline_call
+
+            rest = param_arrays[: self._n_rest]
+            stacked = param_arrays[self._n_rest:]
+
+            def run_blocks(x, cos, sin):
+                return pipeline_call(
+                    self._block_fn, stacked, x, cos, sin,
+                    mesh=self.mesh, n_micro=self._n_micro,
+                    remat=self._pp_remat)
+
+            with autograd_engine.no_grad(), _Swap(self._param_tensors, rest), \
+                    axis_rules(self.mesh, self.rules):
+                out = model.pipeline_loss(input_ids, labels, run_blocks)
+            return out._data if isinstance(out, Tensor) else out
         fn = self._loss_fn or (lambda ids, lb: model.loss_fn(ids, lb))
         with autograd_engine.no_grad(), _Swap(self._param_tensors, param_arrays), \
                 axis_rules(self.mesh, self.rules):
@@ -207,8 +269,13 @@ class Engine:
         out the live arrays would leave the Layer pointing at deleted memory
         after the next step (donation is a no-op on CPU but real on TPU).
         """
-        for t, a in zip(self._param_tensors, self.params):
+        for t, a in zip(self._param_tensors, self.params[: self._n_rest]):
             t._data = jnp.copy(a)
+        if self._pp:
+            per_block = [[t for _, t in b.named_parameters()] for b in self._blocks]
+            for i, st in enumerate(self.params[self._n_rest:]):
+                for li in range(len(per_block)):
+                    per_block[li][i]._data = jnp.copy(st[li])
         return self.model
 
     def state_dict(self):

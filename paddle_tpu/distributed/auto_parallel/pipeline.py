@@ -1,0 +1,199 @@
+"""SPMD pipeline parallelism over a ``pp`` mesh axis.
+
+Parity anchor: the reference's dygraph pipeline engine
+(/root/reference/python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py:231,
+forward_backward_pipeline 1F1B at :547, interleaved VPP at :1143) and its P2P layer
+(pp_utils/p2p_communication.py:51 SendRecvMeta shape negotiation).
+
+TPU-native redesign: no per-rank Python schedule, no NCCL P2P, no shape
+negotiation. The whole pipeline is ONE jitted SPMD program:
+
+  - layer weights are STACKED along a leading axis sharded over the ``pp`` mesh
+    axis — each device materialises only its stage's layers;
+  - ``jax.shard_map`` with ``axis_names={"pp"}`` makes only the pp axis manual;
+    every other mesh axis (dp/fsdp/tp/sep) stays in GSPMD "auto" mode, so the
+    in-stage compute is still sharded by the usual logical-axis rules;
+  - activations move between stages with ``lax.ppermute`` (compiles to
+    collective-permute riding ICI);
+  - the schedule is a ``lax.scan`` over ``n_micro + n_stages - 1`` ticks — the
+    GPipe fill/drain pattern. Backward needs no hand-written 1F1B state machine:
+    the transpose of ppermute is the reverse rotation, so ``jax.grad`` through
+    the scan IS the reverse pipeline schedule. XLA's scheduler overlaps the
+    collective-permute with compute (the job NCCL streams did in the reference).
+
+Memory note: GPipe-style stashing of all microbatch activations is avoided by
+``remat=True`` (per-block rematerialisation), which is how 1F1B's memory benefit
+is obtained in the XLA world.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+_state = threading.local()
+
+
+def in_manual_pipeline() -> bool:
+    """True while tracing inside the shard_map(pp) body.
+
+    Layer code that opens its own shard_map (flash attention, ring attention)
+    must take the plain auto-sharded path instead — nested manual meshes over
+    the same axes are not composable.
+    """
+    return getattr(_state, "manual", False)
+
+
+class _ManualCtx:
+    def __enter__(self):
+        self._prev = in_manual_pipeline()
+        _state.manual = True
+
+    def __exit__(self, *exc):
+        _state.manual = self._prev
+        return False
+
+
+def gpipe_schedule(stage_fn: Callable, n_stages: int, axis_name: str = "pp"):
+    """The GPipe tick schedule, to run INSIDE shard_map where ``axis_name`` is
+    manual. ``stage_fn(stage_params, x, *bargs) -> y`` computes one stage.
+    Returns ``pipeline(params, micro_inputs, *bargs) -> micro_outputs`` where
+    ``micro_inputs`` is ``[n_micro, ...]`` (replicated over the pp axis) and the
+    result is psum-replicated from the last stage.
+    """
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def pipeline(params, micro_in, *bargs):
+        n_micro = micro_in.shape[0]
+        stage = jax.lax.axis_index(axis_name)
+        total_ticks = n_micro + n_stages - 1
+
+        def tick(carry, t):
+            buf, outs = carry
+            mb_idx = jnp.clip(t, 0, n_micro - 1)
+            inject = jax.lax.dynamic_index_in_dim(micro_in, mb_idx, 0, keepdims=False)
+            h = jnp.where(stage == 0, inject, buf)
+            with _ManualCtx():
+                y = stage_fn(params, h, *bargs)
+            out_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
+            is_out = (stage == n_stages - 1) & (t >= n_stages - 1)
+            prev = jax.lax.dynamic_index_in_dim(outs, out_idx, 0, keepdims=False)
+            outs = jax.lax.dynamic_update_index_in_dim(
+                outs, jnp.where(is_out, y, prev), out_idx, 0)
+            nxt = jax.lax.ppermute(y, axis_name, perm)
+            return (nxt, outs), None
+
+        buf0 = jnp.zeros(micro_in.shape[1:], micro_in.dtype)
+        outs0 = jnp.zeros(micro_in.shape, micro_in.dtype)
+        (_, outs), _ = jax.lax.scan(tick, (buf0, outs0), jnp.arange(total_ticks))
+        # results live on the last stage; zero elsewhere + psum replicates them
+        outs = jnp.where(stage == n_stages - 1, outs, jnp.zeros_like(outs))
+        return jax.lax.psum(outs, axis_name)
+
+    return pipeline
+
+
+def pipeline_call(
+    block_fn: Callable,
+    stacked_params: Sequence[jax.Array],
+    x: jax.Array,
+    *broadcast_args,
+    mesh: Mesh,
+    n_micro: int,
+    axis_name: str = "pp",
+    remat: bool = False,
+):
+    """Run ``x`` through ``n_layers`` stacked blocks, pipelined over ``axis_name``.
+
+    Args:
+      block_fn: ``block_fn(per_layer_params, x, *broadcast_args) -> y`` runs ONE
+        block; ``per_layer_params`` is a list of arrays without the stacking dim.
+      stacked_params: arrays of shape ``[n_layers, ...]``; the leading dim must be
+        divisible by the pp axis size (layers are assigned contiguously).
+      x: global activations ``[batch, ...]``; batch must divide ``n_micro``.
+      broadcast_args: extra per-call inputs replicated to every stage (e.g. rope
+        tables).
+      n_micro: number of microbatches (the reference's ``accumulate_steps``).
+      remat: rematerialise each block in backward (fleet/recompute parity).
+
+    Returns global activations with the same shape as ``x``.
+    """
+    n_stages = mesh.shape[axis_name]
+    blk = jax.checkpoint(block_fn) if remat else block_fn
+
+    def stage_fn(local_params, h, *bargs):
+        # local_params: [layers_per_stage, ...] slices of this stage
+        def body(h, i):
+            wl = [w[i] for w in local_params]
+            return blk(wl, h, *bargs), None
+        h, _ = jax.lax.scan(body, h, jnp.arange(local_params[0].shape[0]))
+        return h
+
+    if n_stages == 1:
+        return stage_fn(list(stacked_params), x, *broadcast_args)
+
+    batch = x.shape[0]
+    if batch % n_micro != 0:
+        raise ValueError(f"batch {batch} not divisible by n_micro {n_micro}")
+    mb = batch // n_micro
+    micro = x.reshape((n_micro, mb) + x.shape[1:])
+
+    pipeline = gpipe_schedule(stage_fn, n_stages, axis_name)
+    n_params = len(stacked_params)
+    smapped = jax.shard_map(
+        pipeline,
+        mesh=mesh,
+        in_specs=(tuple(P(axis_name) for _ in range(n_params)), P())
+        + tuple(P() for _ in broadcast_args),
+        out_specs=P(),
+        axis_names=frozenset({axis_name}),
+        check_vma=False,
+    )
+    out = smapped(tuple(stacked_params), micro, *broadcast_args)
+    return out.reshape(x.shape)
+
+
+def stack_block_params(blocks, mesh=None, axis_name: str = "pp"):
+    """Stack per-block parameter Tensors into ``[n_layers, ...]`` arrays.
+
+    Returns (stacked_arrays, shardings, names, decay_mask). All blocks must have
+    identical parameter structure (true for transformer decoder stacks). The
+    leading dim is sharded over ``axis_name``; trailing dims follow each param's
+    logical axes — so pp composes with fsdp/tp sharding of the weights
+    (the reference's PP×sharding×MP hybrid, fleet/base/topology.py:70).
+    """
+    from jax.sharding import NamedSharding
+    from .logical_sharding import logical_to_spec
+
+    per_block = [[t for _, t in b.named_parameters()] for b in blocks]
+    names = [n for n, _ in blocks[0].named_parameters()]
+    n_params = len(per_block[0])
+    for pb in per_block:
+        if len(pb) != n_params:
+            raise ValueError("pipeline blocks have differing parameter structure")
+    frozen = [n for n, t in blocks[0].named_parameters() if t.stop_gradient]
+    if frozen:
+        raise NotImplementedError(
+            f"pipeline blocks with frozen (stop_gradient) params not supported: {frozen}")
+    stacked, shardings, decay = [], [], []
+    for i in range(n_params):
+        arrs = [pb[i]._data for pb in per_block]
+        if mesh is not None:
+            axes = getattr(per_block[0][i], "logical_axes", None) or (None,) * arrs[0].ndim
+            spec = logical_to_spec((None,) + tuple(axes), mesh)
+            spec = P(axis_name, *tuple(spec)[1:])
+            sh = NamedSharding(mesh, spec)
+            # stack under jit with out_shardings so no replicated [L, ...]
+            # intermediate is ever materialised in HBM
+            st = jax.jit(lambda *a: jnp.stack(a), out_shardings=sh)(*arrs)
+            shardings.append(sh)
+        else:
+            st = jnp.stack(arrs)
+            shardings.append(None)
+        decay.append(arrs[0].ndim >= 2)
+        stacked.append(st)
+    return stacked, shardings, names, decay

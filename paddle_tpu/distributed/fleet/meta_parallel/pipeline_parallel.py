@@ -83,49 +83,12 @@ class PipelineParallel(Layer):
 
 
 def gpipe_spmd(stage_fn: Callable, n_stages: int, axis_name: str = "pp"):
-    """Build a jit-able GPipe executor over a mesh axis.
-
-    stage_fn(stage_params, x) -> y runs ONE stage's computation. Returns
-    ``pipeline(stacked_params, micro_inputs) -> micro_outputs`` to be called INSIDE
-    shard_map where `axis_name` is bound: stacked_params has a leading stage axis
-    sharded over `axis_name`; micro_inputs is [n_micro, ...] (replicated).
-
-    Ticks: t in [0, n_micro + n_stages - 1). Stage 0 injects microbatch t; stage
-    s>0 consumes its neighbor's previous output via ppermute; outputs drain from the
-    last stage. Differentiable end-to-end (scan + ppermute transpose).
+    """Build a jit-able GPipe executor over a mesh axis (to call INSIDE shard_map
+    where `axis_name` is manual). Thin alias of the shared schedule in
+    ``paddle_tpu.distributed.auto_parallel.pipeline.gpipe_schedule``; the
+    full-featured path (stacked per-layer params, remat, auto axes) is
+    ``pipeline_call`` in the same module.
     """
+    from ...auto_parallel.pipeline import gpipe_schedule
 
-    def pipeline(params, micro_inputs):
-        n_micro = micro_inputs.shape[0]
-        stage = jax.lax.axis_index(axis_name)
-        total_ticks = n_micro + n_stages - 1
-        x_shape = micro_inputs.shape[1:]
-        dtype = micro_inputs.dtype
-        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
-
-        def tick(carry, t):
-            buf_in, outputs = carry
-            # stage 0 reads microbatch t (or zeros in drain phase)
-            mb_idx = jnp.clip(t, 0, n_micro - 1)
-            inject = jax.lax.dynamic_index_in_dim(micro_inputs, mb_idx, 0, keepdims=False)
-            x = jnp.where(stage == 0, inject, buf_in)
-            active = (t - stage >= 0) & (t - stage < n_micro)
-            y = stage_fn(params, x)
-            y = jnp.where(active, y, jnp.zeros_like(y))
-            # last stage writes its result into the output slot for microbatch t-stage
-            out_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
-            is_out = (stage == n_stages - 1) & (t >= n_stages - 1)
-            outputs = jax.lax.dynamic_update_index_in_dim(
-                outputs,
-                jnp.where(is_out, y, jax.lax.dynamic_index_in_dim(outputs, out_idx, 0, keepdims=False)),
-                out_idx, 0,
-            )
-            nxt = jax.lax.ppermute(y, axis_name, perm)
-            return (nxt, outputs), None
-
-        buf0 = jnp.zeros(x_shape, dtype)
-        outs0 = jnp.zeros((n_micro,) + x_shape, dtype)
-        (_, outputs), _ = jax.lax.scan(tick, (buf0, outs0), jnp.arange(total_ticks))
-        return outputs
-
-    return pipeline
+    return gpipe_schedule(stage_fn, n_stages, axis_name)

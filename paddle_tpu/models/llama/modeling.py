@@ -158,8 +158,15 @@ def _attention(q, k, v, config, attn_bias=None):
     """
     if config.use_flash_attention and attn_bias is None:
         from ...ops.flash_attention import flash_attention as fa
+        from ...distributed.auto_parallel.pipeline import in_manual_pipeline
 
         mesh = current_mesh()
+        if in_manual_pipeline():
+            # inside shard_map(pp): no nested manual meshes — plain attention,
+            # GSPMD still shards batch/heads over the auto axes
+            from ...nn.functional.flash_attention import _xla_attention
+
+            return _xla_attention(q, k, v, bias=attn_bias, causal=True)
         if mesh is None or mesh.size == 1:
             return fa(q, k, v, causal=True)
         sep = mesh.shape.get("sep", 1)
@@ -256,7 +263,8 @@ class LlamaModel(Layer):
                                  for _ in range(config.num_hidden_layers)])
         self.norm = LlamaRMSNorm(config)
 
-    def forward(self, input_ids, attn_bias=None):
+    def embed_and_rope(self, input_ids):
+        """Token embedding + rope tables (shared by the plain and pp paths)."""
         ids = input_ids._data if isinstance(input_ids, Tensor) else jnp.asarray(input_ids)
         cfg = self.config
         # FSDP-style: all-gather the (embed-sharded) table before the lookup so
@@ -265,6 +273,11 @@ class LlamaModel(Layer):
         x = jnp.take(table, ids, axis=0)
         x = constrain(x, "batch", "seq", "embed")
         cos, sin = _rope_cos_sin(ids.shape[1], cfg.head_dim, cfg.rope_theta, x.dtype)
+        return x, cos, sin
+
+    def forward(self, input_ids, attn_bias=None):
+        cfg = self.config
+        x, cos, sin = self.embed_and_rope(input_ids)
         remat = cfg.recompute and isinstance(x, jax.core.Tracer)
         for layer in self.layers:
             if remat:
@@ -310,6 +323,37 @@ class LlamaForCausalLM(Layer):
         """Raw-array loss for jit'ed training steps."""
         hidden = self.model(input_ids)
         return LlamaPretrainingCriterion.compute(self.logits(hidden), _raw(labels))
+
+    # ---- pipeline-parallel protocol (used by Engine when mesh has pp > 1) ----
+    def pipeline_blocks(self):
+        """The homogeneous block stack to be sharded over the pp axis."""
+        return list(self.model.layers)
+
+    def pipeline_loss(self, input_ids, labels, run_blocks):
+        """Loss with the decoder stack replaced by ``run_blocks(x, cos, sin)``.
+
+        Embedding / final norm / lm-head run outside the pipeline (replicated
+        over pp, sharded over the other axes) — the analogue of the reference
+        putting embedding+head on first/last stages (pp_layers.py SharedLayerDesc),
+        collapsed here because GSPMD dedupes replicated compute.
+        """
+        x, cos, sin = self.model.embed_and_rope(input_ids)
+        x = run_blocks(x, cos, sin)
+        x = self.model.norm(x)
+        return LlamaPretrainingCriterion.compute(self.logits(x), _raw(labels))
+
+    @staticmethod
+    def pipeline_block_fn(block):
+        """Functional single-block forward for stacked-param execution."""
+        tensors = [t for _, t in block.named_parameters()]
+
+        def fn(param_arrays, x, cos, sin):
+            from ...jit.api import _Swap
+
+            with _Swap(tensors, param_arrays):
+                return block(x, cos, sin)
+
+        return fn
 
 
 def _raw(x):
