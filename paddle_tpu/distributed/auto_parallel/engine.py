@@ -133,7 +133,8 @@ class Engine:
             self._param_names = self._param_names + [f"blocks.{n}" for n in bnames]
             self._decay_mask = self._decay_mask + bdecay
             self._block_shardings = bshard
-            self._block_fn = type(self.model).pipeline_block_fn(self._blocks[0])
+            self._block_fn = self.model.pipeline_block_fn(self._blocks[0])
+            self._pp_with_aux = bool(getattr(self.model, "pipeline_with_aux", False))
             # free the unstacked per-layer originals — otherwise the Layer
             # tensors pin a second full copy of the decoder weights in HBM.
             # sync_model() restores them by slicing the stacked arrays.
@@ -170,10 +171,16 @@ class Engine:
             stacked = param_arrays[self._n_rest:]
 
             def run_blocks(x, cos, sin):
-                return pipeline_call(
+                res = pipeline_call(
                     self._block_fn, stacked, x, cos, sin,
                     mesh=self.mesh, n_micro=self._n_micro,
-                    remat=self._pp_remat)
+                    remat=self._pp_remat, with_aux=self._pp_with_aux)
+                if self._pp_with_aux:
+                    # aux is summed per microbatch; average to match the
+                    # whole-batch scale of the non-pp path
+                    x_out, aux = res
+                    return x_out, aux / float(self._n_micro)
+                return res
 
             with autograd_engine.no_grad(), _Swap(self._param_tensors, rest), \
                     axis_rules(self.mesh, self.rules):

@@ -58,12 +58,16 @@ class _ManualCtx:
         return False
 
 
-def gpipe_schedule(stage_fn: Callable, n_stages: int, axis_name: str = "pp"):
+def gpipe_schedule(stage_fn: Callable, n_stages: int, axis_name: str = "pp",
+                   with_aux: bool = False):
     """The GPipe tick schedule, to run INSIDE shard_map where ``axis_name`` is
-    manual. ``stage_fn(stage_params, x, *bargs) -> y`` computes one stage.
-    Returns ``pipeline(params, micro_inputs, *bargs) -> micro_outputs`` where
-    ``micro_inputs`` is ``[n_micro, ...]`` (replicated over the pp axis) and the
-    result is psum-replicated from the last stage.
+    manual. ``stage_fn(stage_params, x, *bargs) -> y`` computes one stage
+    (``-> (y, aux)`` when ``with_aux``; aux is a scalar summed over active
+    ticks and psum'd over stages — MoE load-balance losses ride this).
+    Returns ``pipeline(params, micro_inputs, *bargs) -> micro_outputs`` (or
+    ``(micro_outputs, aux_total)``) where ``micro_inputs`` is ``[n_micro, ...]``
+    (replicated over the pp axis) and the result is psum-replicated from the
+    last stage.
     """
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
@@ -73,26 +77,36 @@ def gpipe_schedule(stage_fn: Callable, n_stages: int, axis_name: str = "pp"):
         total_ticks = n_micro + n_stages - 1
 
         def tick(carry, t):
-            buf, outs = carry
+            buf, outs, aux_acc = carry
             mb_idx = jnp.clip(t, 0, n_micro - 1)
             inject = jax.lax.dynamic_index_in_dim(micro_in, mb_idx, 0, keepdims=False)
             h = jnp.where(stage == 0, inject, buf)
             with _ManualCtx():
-                y = stage_fn(params, h, *bargs)
+                res = stage_fn(params, h, *bargs)
+            y, aux = res if with_aux else (res, None)
+            if with_aux:
+                # bubble ticks run on garbage activations — mask their aux
+                active = (t - stage >= 0) & (t - stage < n_micro)
+                aux_acc = aux_acc + jnp.where(active, aux, 0.0)
             out_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
             is_out = (stage == n_stages - 1) & (t >= n_stages - 1)
             prev = jax.lax.dynamic_index_in_dim(outs, out_idx, 0, keepdims=False)
             outs = jax.lax.dynamic_update_index_in_dim(
                 outs, jnp.where(is_out, y, prev), out_idx, 0)
             nxt = jax.lax.ppermute(y, axis_name, perm)
-            return (nxt, outs), None
+            return (nxt, outs, aux_acc), None
 
         buf0 = jnp.zeros(micro_in.shape[1:], micro_in.dtype)
         outs0 = jnp.zeros(micro_in.shape, micro_in.dtype)
-        (_, outs), _ = jax.lax.scan(tick, (buf0, outs0), jnp.arange(total_ticks))
+        aux0 = jnp.zeros((), jnp.float32)
+        (_, outs, aux_acc), _ = jax.lax.scan(
+            tick, (buf0, outs0, aux0), jnp.arange(total_ticks))
         # results live on the last stage; zero elsewhere + psum replicates them
         outs = jnp.where(stage == n_stages - 1, outs, jnp.zeros_like(outs))
-        return jax.lax.psum(outs, axis_name)
+        outs = jax.lax.psum(outs, axis_name)
+        if with_aux:
+            return outs, jax.lax.psum(aux_acc, axis_name)
+        return outs
 
     return pipeline
 
@@ -106,12 +120,14 @@ def pipeline_call(
     n_micro: int,
     axis_name: str = "pp",
     remat: bool = False,
+    with_aux: bool = False,
 ):
     """Run ``x`` through ``n_layers`` stacked blocks, pipelined over ``axis_name``.
 
     Args:
       block_fn: ``block_fn(per_layer_params, x, *broadcast_args) -> y`` runs ONE
-        block; ``per_layer_params`` is a list of arrays without the stacking dim.
+        block (``-> (y, aux_scalar)`` when ``with_aux`` — e.g. MoE gate losses);
+        ``per_layer_params`` is a list of arrays without the stacking dim.
       stacked_params: arrays of shape ``[n_layers, ...]``; the leading dim must be
         divisible by the pp axis size (layers are assigned contiguously).
       x: global activations ``[batch, ...]``; batch must divide ``n_micro``.
@@ -120,18 +136,27 @@ def pipeline_call(
       n_micro: number of microbatches (the reference's ``accumulate_steps``).
       remat: rematerialise each block in backward (fleet/recompute parity).
 
-    Returns global activations with the same shape as ``x``.
+    Returns global activations with the same shape as ``x`` (plus the aux sum
+    over all layers and microbatches when ``with_aux``).
     """
     n_stages = mesh.shape[axis_name]
     blk = jax.checkpoint(block_fn) if remat else block_fn
 
     def stage_fn(local_params, h, *bargs):
         # local_params: [layers_per_stage, ...] slices of this stage
-        def body(h, i):
+        def body(carry, i):
+            h, aux = carry
             wl = [w[i] for w in local_params]
-            return blk(wl, h, *bargs), None
-        h, _ = jax.lax.scan(body, h, jnp.arange(local_params[0].shape[0]))
-        return h
+            res = blk(wl, h, *bargs)
+            if with_aux:
+                y, a = res
+                return (y, aux + a), None
+            return (res, aux), None
+
+        (h, aux), _ = jax.lax.scan(
+            body, (h, jnp.zeros((), jnp.float32)),
+            jnp.arange(local_params[0].shape[0]))
+        return (h, aux) if with_aux else h
 
     if n_stages == 1:
         return stage_fn(list(stacked_params), x, *broadcast_args)
@@ -142,19 +167,23 @@ def pipeline_call(
     mb = batch // n_micro
     micro = x.reshape((n_micro, mb) + x.shape[1:])
 
-    pipeline = gpipe_schedule(stage_fn, n_stages, axis_name)
+    pipeline = gpipe_schedule(stage_fn, n_stages, axis_name, with_aux=with_aux)
     n_params = len(stacked_params)
+    out_specs = (P(), P()) if with_aux else P()
     smapped = jax.shard_map(
         pipeline,
         mesh=mesh,
         in_specs=(tuple(P(axis_name) for _ in range(n_params)), P())
         + tuple(P() for _ in broadcast_args),
-        out_specs=P(),
+        out_specs=out_specs,
         axis_names=frozenset({axis_name}),
         check_vma=False,
     )
-    out = smapped(tuple(stacked_params), micro, *broadcast_args)
-    return out.reshape(x.shape)
+    res = smapped(tuple(stacked_params), micro, *broadcast_args)
+    if with_aux:
+        out, aux = res
+        return out.reshape(x.shape), aux
+    return res.reshape(x.shape)
 
 
 def stack_block_params(blocks, mesh=None, axis_name: str = "pp"):

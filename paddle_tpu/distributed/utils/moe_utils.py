@@ -1,0 +1,53 @@
+"""MoE dispatch utilities (reference: python/paddle/distributed/utils/moe_utils.py
+``global_scatter``/``global_gather`` — NCCL alltoall of variable token counts).
+
+TPU-native: inside shard_map with the ``ep`` axis bound these are
+``lax.all_to_all``; in single-controller eager mode a jax.Array is already the
+global tensor, so they reduce to static reshapes. The MoELayer does NOT need
+them — its dense einsum dispatch lets GSPMD insert the all_to_all — they exist
+for users porting reference code that calls them directly.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.tensor import Tensor
+
+
+def _raw(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _axis_bound(axis_name) -> bool:
+    try:
+        lax.axis_index(axis_name)
+        return True
+    except NameError:
+        return False
+    except Exception:
+        return False
+
+
+def global_scatter(x, local_count=None, global_count=None, group=None,
+                   axis_name: str = "ep"):
+    """Send token slices to their expert's rank (reference moe_utils.py:32).
+
+    x: [world * tokens_per_rank, d] laid out expert-major. Inside shard_map the
+    leading dim is all_to_all'ed over ``axis_name``; eagerly it is a no-op
+    (the array is already global).
+    """
+    x = _raw(x)
+    if _axis_bound(axis_name):
+        n = lax.axis_size(axis_name)
+        parts = x.reshape((n, x.shape[0] // n) + x.shape[1:])
+        return lax.all_to_all(parts, axis_name, 0, 0, tiled=False).reshape(x.shape)
+    return x
+
+
+def global_gather(x, local_count=None, global_count=None, group=None,
+                  axis_name: str = "ep"):
+    """Inverse of :func:`global_scatter` (reference moe_utils.py:151)."""
+    return global_scatter(x, local_count, global_count, group, axis_name)

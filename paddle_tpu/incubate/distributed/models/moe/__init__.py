@@ -1,0 +1,2 @@
+from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate, topk_dispatch  # noqa: F401
+from .moe_layer import ExpertFFN, MoELayer, SwiGLUExpertFFN  # noqa: F401

@@ -1,0 +1,200 @@
+"""Mixture-of-Experts layer with expert parallelism over the ``ep`` mesh axis.
+
+Parity anchor: /root/reference/python/paddle/incubate/distributed/models/moe/
+moe_layer.py:263 ``MoELayer`` (gates gshard/switch/naive, alltoall dispatch via
+``global_scatter``/``global_gather`` utils.py:32, MoE grad clip).
+
+TPU-native redesign: the reference scatters tokens with index_select + NCCL
+alltoall (dynamic shapes). Here routing is the GShard dense-einsum formulation —
+dispatch/combine one-hot tensors with a static per-expert ``capacity`` — and the
+expert FFN is ONE batched computation over stacked weights ``[E, ...]`` sharded
+over the ``ep`` mesh axis ("expert" logical axis). When tokens are sharded over
+dp/fsdp and experts over ep, GSPMD lowers the dispatch einsum to exactly the
+all_to_all the reference issues by hand, and it rides ICI.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .....core.tensor import Tensor
+from .....distributed.auto_parallel.logical_sharding import annotate, constrain
+from .....nn import initializer as I
+from .....nn.layer.layers import Layer
+from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate
+
+
+def _raw(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def routed_ffn(tokens, probs, expert_fn, k: int, capacity: int,
+               renormalize: bool = True):
+    """Shared dispatch → expert_fn → combine pipeline on raw arrays.
+
+    tokens: [n, d]; probs: [n, E]; expert_fn: [E, C, d] -> [E, C, d'].
+    Returns (out [n, d'], aux_loss). Used by MoELayer and fused_moe so the
+    routing/capacity semantics exist exactly once.
+    """
+    from .gate import topk_dispatch
+
+    combine, dispatch, aux = topk_dispatch(probs, k, capacity, renormalize)
+    expert_in = jnp.einsum("nec,nd->ecd", dispatch.astype(tokens.dtype), tokens)
+    expert_in = constrain(expert_in, "expert", None, "embed")
+    expert_out = _raw(expert_fn(expert_in))
+    out = jnp.einsum("nec,ecd->nd", combine.astype(tokens.dtype), expert_out)
+    return out, aux
+
+
+class ExpertFFN(Layer):
+    """Stacked per-expert FFN: weights carry a leading "expert" logical axis."""
+
+    def __init__(self, num_experts: int, d_model: int, d_hidden: int,
+                 activation: str = "gelu", dtype: str = "float32",
+                 initializer_range: float = 0.02):
+        super().__init__()
+        self.num_experts = num_experts
+        self.activation = activation
+        init = I.Normal(std=initializer_range)
+        self.w1 = annotate(
+            self.create_parameter([num_experts, d_model, d_hidden], dtype=dtype,
+                                  default_initializer=init),
+            "expert", "embed", "expert_mlp")
+        self.b1 = annotate(
+            self.create_parameter([num_experts, d_hidden], dtype=dtype, is_bias=True),
+            "expert", "expert_mlp")
+        self.w2 = annotate(
+            self.create_parameter([num_experts, d_hidden, d_model], dtype=dtype,
+                                  default_initializer=init),
+            "expert", "expert_mlp", "embed")
+        self.b2 = annotate(
+            self.create_parameter([num_experts, d_model], dtype=dtype, is_bias=True),
+            "expert", "embed")
+
+    def forward(self, x):
+        """x: [E, C, d_model] — batched over the (ep-sharded) expert dim."""
+        x = _raw(x)
+        h = jnp.einsum("ecd,edm->ecm", x, self.w1._data) + self.b1._data[:, None, :]
+        h = constrain(h, "expert", None, "expert_mlp")
+        if self.activation == "gelu":
+            h = jax.nn.gelu(h)
+        elif self.activation == "relu":
+            h = jax.nn.relu(h)
+        elif self.activation == "silu":
+            h = jax.nn.silu(h)
+        else:
+            raise ValueError(f"unknown activation {self.activation}")
+        out = jnp.einsum("ecm,emd->ecd", h, self.w2._data) + self.b2._data[:, None, :]
+        return constrain(out, "expert", None, "embed")
+
+
+class SwiGLUExpertFFN(Layer):
+    """Llama/Mixtral-style gated experts (swiglu), stacked over the expert axis."""
+
+    def __init__(self, num_experts: int, d_model: int, d_hidden: int,
+                 dtype: str = "float32", initializer_range: float = 0.02):
+        super().__init__()
+        self.num_experts = num_experts
+        init = I.Normal(std=initializer_range)
+        mk = lambda shape: self.create_parameter(shape, dtype=dtype,
+                                                 default_initializer=init)
+        self.w_gate = annotate(mk([num_experts, d_model, d_hidden]),
+                               "expert", "embed", "expert_mlp")
+        self.w_up = annotate(mk([num_experts, d_model, d_hidden]),
+                             "expert", "embed", "expert_mlp")
+        self.w_down = annotate(mk([num_experts, d_hidden, d_model]),
+                               "expert", "expert_mlp", "embed")
+
+    def forward(self, x):
+        x = _raw(x)
+        g = jnp.einsum("ecd,edm->ecm", x, self.w_gate._data)
+        u = jnp.einsum("ecd,edm->ecm", x, self.w_up._data)
+        h = constrain(jax.nn.silu(g) * u, "expert", None, "expert_mlp")
+        out = jnp.einsum("ecm,emd->ecd", h, self.w_down._data)
+        return constrain(out, "expert", None, "embed")
+
+
+class MoELayer(Layer):
+    """Mixture of Experts (reference moe_layer.py:263).
+
+    Args:
+        d_model: hidden size.
+        num_experts: total number of experts (the reference's
+            ``num_expert * world_size`` — one global count here; the ep mesh
+            axis shards them).
+        experts: optional stacked expert Layer (``[E, C, d] -> [E, C, d]``);
+            default builds :class:`ExpertFFN` with ``d_hidden``.
+        gate: "gshard" | "switch" | "naive" or a BaseGate instance.
+        top_k: experts per token (gshard=2, switch=1).
+        capacity_factor: per-expert capacity = ceil(tokens * k * cf / E).
+    """
+
+    def __init__(self, d_model: int, num_experts: int, d_hidden: Optional[int] = None,
+                 experts: Optional[Layer] = None, gate: str = "gshard",
+                 top_k: Optional[int] = None, capacity_factor: float = 1.25,
+                 activation: str = "gelu", dtype: str = "float32",
+                 recompute_interval: int = 0, group=None):
+        super().__init__()
+        self.d_model = d_model
+        self.num_experts = num_experts
+        self.capacity_factor = capacity_factor
+        self.experts = experts if experts is not None else ExpertFFN(
+            num_experts, d_model, d_hidden or 4 * d_model, activation, dtype)
+        if isinstance(gate, BaseGate):
+            self.gate = gate
+            self.top_k = getattr(gate, "top_k", top_k or 2)
+        elif gate == "gshard":
+            self.top_k = top_k or 2
+            self.gate = GShardGate(d_model, num_experts, topk=self.top_k)
+        elif gate == "switch":
+            self.top_k = 1
+            self.gate = SwitchGate(d_model, num_experts)
+        elif gate in ("naive", "topk"):
+            self.top_k = top_k or 2
+            self.gate = NaiveGate(d_model, num_experts, topk=self.top_k)
+        else:
+            raise ValueError(f"unknown gate {gate!r}")
+
+    def capacity(self, num_tokens: int) -> int:
+        cap = int(math.ceil(num_tokens * self.top_k * self.capacity_factor
+                            / self.num_experts))
+        return max(cap, self.top_k)
+
+    def _routed_forward(self, x, *param_arrays):
+        """The whole MoE computation as one pure fn (one taped op in eager)."""
+        from .....jit.api import _Swap
+
+        tensors = [t for _, t in self.named_parameters()]
+        with _Swap(tensors, param_arrays):
+            x = jnp.asarray(x)
+            orig_shape = x.shape
+            tokens = x.reshape(-1, orig_shape[-1])
+            cap = self.capacity(tokens.shape[0])
+            p = self.gate.probs(tokens)
+            out, aux = routed_ffn(tokens, p, self.experts, self.top_k, cap,
+                                  getattr(self.gate, "renormalize", True))
+            if not getattr(self.gate, "use_aux", True):
+                aux = jnp.zeros((), jnp.float32)
+            out = out.reshape(orig_shape)
+            if out.ndim == 3:
+                out = constrain(out, "batch", "seq", "embed")
+        return out, aux
+
+    def forward(self, x):
+        """x: [batch, seq, d_model] (or [tokens, d_model]). Returns the same
+        kind as the input (Tensor in -> Tensor out, raw array in -> raw out)."""
+        from .....core.op_registry import apply_fn
+
+        was_tensor = isinstance(x, Tensor)
+        tensors = [t for _, t in self.named_parameters()]
+        out, aux = apply_fn("moe", self._routed_forward, x, *tensors)
+        self.gate.set_loss(aux if was_tensor else _raw(aux))
+        return out if was_tensor else _raw(out)
+
+    def get_loss(self, clear=True):
+        """The gate's aux (load-balance) loss for this forward."""
+        return self.gate.get_loss(clear=clear)

@@ -155,6 +155,66 @@ def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False, activation
     return apply_fn("fused_linear_activation", fn, x, y, bias)
 
 
+def fused_moe(x, gate_weight, ffn1_weight, ffn2_weight, ffn1_bias=None,
+              ffn1_scale=None, ffn2_bias=None, ffn2_scale=None,
+              quant_method="None", moe_topk=2, norm_topk_prob=True,
+              capacity_factor=None):
+    """Reference: incubate/nn/functional/fused_moe.py:22 — same signature.
+
+    x: [bsz, seq, d_model]; gate_weight: per-token gate logits
+    [bsz, seq, num_experts]; ffn1_weight: [E, d_model, d_ff*2] (gated — swiglu
+    split) or [E, d_model, d_ff] (plain gelu); ffn2_weight: [E, d_ff, d_model];
+    biases [E, 1, d] or [E, d]. quant_method/scales unsupported (as in the
+    reference's CPU path).
+
+    One traced region: topk dispatch + batched expert FFN + combine (shared
+    routing in incubate.distributed.models.moe). Deviation from the CUDA
+    kernel: a dense-dispatch capacity bounds expert buffers; the default
+    ``capacity_factor=None`` sets capacity = num tokens (NO token drops, exact
+    reference semantics) — pass e.g. 1.25 to bound memory on long sequences.
+    """
+    import math
+
+    if quant_method != "None" or ffn1_scale is not None or ffn2_scale is not None:
+        raise NotImplementedError("fused_moe quantization is not supported")
+
+    from ...distributed.models.moe.moe_layer import routed_ffn
+
+    def fn(x, gate_logits, w1, w2, b1, b2):
+        orig = x.shape
+        d_model = orig[-1]
+        tokens = x.reshape(-1, d_model)
+        n, e = tokens.shape[0], w1.shape[0]
+        probs = jax.nn.softmax(
+            gate_logits.reshape(-1, e).astype(jnp.float32), axis=-1)
+        if capacity_factor is None:
+            cap = n
+        else:
+            cap = max(int(math.ceil(n * moe_topk * capacity_factor / e)), moe_topk)
+        gated = w1.shape[-1] == 2 * w2.shape[-2]
+
+        def expert_fn(expert_in):
+            h = jnp.einsum("ecd,edm->ecm", expert_in, w1)
+            if b1 is not None:
+                h = h + b1.reshape(e, 1, -1)
+            if gated:
+                half = h.shape[-1] // 2
+                h = jax.nn.silu(h[..., :half]) * h[..., half:]
+            else:
+                h = jax.nn.gelu(h)
+            out = jnp.einsum("ecm,emd->ecd", h, w2)
+            if b2 is not None:
+                out = out + b2.reshape(e, 1, -1)
+            return out
+
+        y, _ = routed_ffn(tokens, probs, expert_fn, moe_topk, cap,
+                          renormalize=norm_topk_prob)
+        return y.astype(x.dtype).reshape(orig)
+
+    return apply_fn("fused_moe", fn, x, gate_weight, ffn1_weight, ffn2_weight,
+                    ffn1_bias, ffn2_bias)
+
+
 def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train", name=None):
     return F.dropout(x, p, training=training, mode=mode) + y
 

@@ -46,6 +46,11 @@ class LlamaConfig:
         recompute: bool = False,
         use_flash_attention: bool = True,
         sequence_parallel: bool = False,
+        num_experts: int = 1,
+        moe_topk: int = 2,
+        moe_gate: str = "gshard",
+        moe_aux_weight: float = 0.01,
+        moe_capacity_factor: float = 1.25,
     ):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
@@ -62,6 +67,11 @@ class LlamaConfig:
         self.recompute = recompute
         self.use_flash_attention = use_flash_attention
         self.sequence_parallel = sequence_parallel
+        self.num_experts = num_experts
+        self.moe_topk = moe_topk
+        self.moe_gate = moe_gate
+        self.moe_aux_weight = moe_aux_weight
+        self.moe_capacity_factor = moe_capacity_factor
 
     @property
     def head_dim(self) -> int:
@@ -241,12 +251,26 @@ class LlamaDecoderLayer(Layer):
         self.input_layernorm = LlamaRMSNorm(config)
         self.self_attn = LlamaAttention(config)
         self.post_attention_layernorm = LlamaRMSNorm(config)
-        self.mlp = LlamaMLP(config)
+        if config.num_experts > 1:
+            # Mixtral-class MoE FFN: swiglu experts over the ep mesh axis
+            from ...incubate.distributed.models.moe import MoELayer, SwiGLUExpertFFN
+
+            self.mlp = MoELayer(
+                config.hidden_size, config.num_experts,
+                experts=SwiGLUExpertFFN(config.num_experts, config.hidden_size,
+                                        config.intermediate_size,
+                                        dtype=config.dtype,
+                                        initializer_range=config.initializer_range),
+                gate=config.moe_gate, top_k=config.moe_topk,
+                capacity_factor=config.moe_capacity_factor)
+        else:
+            self.mlp = LlamaMLP(config)
 
     def forward(self, hidden, cos, sin, attn_bias=None):
         x = hidden._data if isinstance(hidden, Tensor) else hidden
         x = x + self.self_attn(self.input_layernorm(x), cos, sin, attn_bias)
-        x = x + self.mlp(self.post_attention_layernorm(x))
+        y = self.mlp(self.post_attention_layernorm(x))
+        x = x + (y._data if isinstance(y, Tensor) else y)
         return constrain(x, "batch", "seq", "embed")
 
 
@@ -279,15 +303,28 @@ class LlamaModel(Layer):
         cfg = self.config
         x, cos, sin = self.embed_and_rope(input_ids)
         remat = cfg.recompute and isinstance(x, jax.core.Tracer)
+        moe = cfg.num_experts > 1
+        aux_total = jnp.zeros((), jnp.float32) if moe else 0.0
         for layer in self.layers:
             if remat:
                 # closure holds the params (inputs, not recomputed); activations
                 # inside the layer are rematerialized in backward — the TPU
-                # analogue of fleet/recompute/recompute.py:455.
-                x = jax.checkpoint(
-                    lambda h, c, s, lyr=layer: lyr(h, c, s, attn_bias))(x, cos, sin)
+                # analogue of fleet/recompute/recompute.py:455. The MoE aux loss
+                # must be a checkpoint OUTPUT (reading the gate's side channel
+                # outside the remat region would leak a tracer).
+                def blk(h, c, s, lyr=layer):
+                    y = lyr(h, c, s, attn_bias)
+                    a = (_raw(lyr.mlp.get_loss()) if moe
+                         else jnp.zeros((), jnp.float32))
+                    return y, a
+
+                x, aux = jax.checkpoint(blk)(x, cos, sin)
             else:
                 x = layer(x, cos, sin, attn_bias)
+                aux = _raw(layer.mlp.get_loss()) if moe else 0.0
+            if moe:
+                aux_total = aux_total + aux
+        self._moe_aux = aux_total
         return self.norm(x)
 
 
@@ -319,12 +356,30 @@ class LlamaForCausalLM(Layer):
         loss = LlamaPretrainingCriterion.compute(logits, _raw(labels))
         return loss
 
+    def moe_aux_loss(self):
+        """Sum of gate load-balance losses from the last forward (0 if dense).
+
+        Collected as checkpoint outputs during LlamaModel.forward — safe under
+        recompute (reading gate side channels here would leak remat tracers).
+        """
+        if self.config.num_experts <= 1:
+            return 0.0
+        return getattr(self.model, "_moe_aux", 0.0)
+
     def loss_fn(self, input_ids, labels):
         """Raw-array loss for jit'ed training steps."""
         hidden = self.model(input_ids)
-        return LlamaPretrainingCriterion.compute(self.logits(hidden), _raw(labels))
+        loss = LlamaPretrainingCriterion.compute(self.logits(hidden), _raw(labels))
+        if self.config.num_experts > 1:
+            loss = loss + self.config.moe_aux_weight * self.moe_aux_loss()
+        return loss
 
     # ---- pipeline-parallel protocol (used by Engine when mesh has pp > 1) ----
+    @property
+    def pipeline_with_aux(self) -> bool:
+        """Blocks emit a scalar aux output (MoE gate load-balance loss)."""
+        return self.config.num_experts > 1
+
     def pipeline_blocks(self):
         """The homogeneous block stack to be sharded over the pp axis."""
         return list(self.model.layers)
@@ -335,23 +390,31 @@ class LlamaForCausalLM(Layer):
         Embedding / final norm / lm-head run outside the pipeline (replicated
         over pp, sharded over the other axes) — the analogue of the reference
         putting embedding+head on first/last stages (pp_layers.py SharedLayerDesc),
-        collapsed here because GSPMD dedupes replicated compute.
+        collapsed here because GSPMD dedupes replicated compute. ``run_blocks``
+        may return ``(x, aux)`` — the per-microbatch-averaged MoE gate loss.
         """
         x, cos, sin = self.model.embed_and_rope(input_ids)
-        x = run_blocks(x, cos, sin)
+        res = run_blocks(x, cos, sin)
+        x, aux = res if isinstance(res, tuple) else (res, None)
         x = self.model.norm(x)
-        return LlamaPretrainingCriterion.compute(self.logits(x), _raw(labels))
+        loss = LlamaPretrainingCriterion.compute(self.logits(x), _raw(labels))
+        if aux is not None:
+            loss = loss + self.config.moe_aux_weight * aux
+        return loss
 
-    @staticmethod
-    def pipeline_block_fn(block):
+    def pipeline_block_fn(self, block):
         """Functional single-block forward for stacked-param execution."""
         tensors = [t for _, t in block.named_parameters()]
+        with_aux = self.pipeline_with_aux
 
         def fn(param_arrays, x, cos, sin):
             from ...jit.api import _Swap
 
             with _Swap(tensors, param_arrays):
-                return block(x, cos, sin)
+                y = block(x, cos, sin)
+                if with_aux:
+                    return y, _raw(block.mlp.get_loss())
+                return y
 
         return fn
 
