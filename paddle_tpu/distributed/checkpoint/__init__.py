@@ -1,0 +1,6 @@
+"""paddle.distributed.checkpoint parity — sharded save/load with
+reshard-on-load (reference: python/paddle/distributed/checkpoint/)."""
+
+from .load_state_dict import get_state_dict_shapes, load_state_dict  # noqa: F401
+from .metadata import ChunkRecord, Metadata, TensorMetadata  # noqa: F401
+from .save_state_dict import save_state_dict  # noqa: F401

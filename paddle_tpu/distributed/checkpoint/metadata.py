@@ -1,0 +1,79 @@
+"""Checkpoint metadata (reference:
+/root/reference/python/paddle/distributed/checkpoint/metadata.py —
+LocalTensorMetadata/LocalTensorIndex/Metadata describing, for every saved
+tensor, the global shape and which file holds which global-offset chunk).
+
+TPU-native: a shard is identified by its global index (tuple of
+(start, stop) per dim) taken from ``jax.Array.addressable_shards[i].index``;
+the metadata records, per tensor name: global shape, dtype, and the list of
+(chunk_index → file, key) mappings. JSON-serialised alongside the data files.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass
+class ChunkRecord:
+    offsets: List[int]          # global start per dim
+    lengths: List[int]          # chunk extent per dim
+    file: str                   # data file holding this chunk
+    key: str                    # key inside the file
+
+
+@dataclasses.dataclass
+class TensorMetadata:
+    global_shape: List[int]
+    dtype: str
+    chunks: List[ChunkRecord]
+
+
+@dataclasses.dataclass
+class Metadata:
+    tensors: Dict[str, TensorMetadata]
+    flat_mapping: Optional[Dict[str, str]] = None  # user key -> storage key
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "tensors": {
+                    name: {
+                        "global_shape": tm.global_shape,
+                        "dtype": tm.dtype,
+                        "chunks": [dataclasses.asdict(c) for c in tm.chunks],
+                    }
+                    for name, tm in self.tensors.items()
+                },
+                "flat_mapping": self.flat_mapping,
+            },
+            indent=1,
+        )
+
+    @classmethod
+    def from_json(cls, text: str) -> "Metadata":
+        obj = json.loads(text)
+        tensors = {
+            name: TensorMetadata(
+                global_shape=t["global_shape"],
+                dtype=t["dtype"],
+                chunks=[ChunkRecord(**c) for c in t["chunks"]],
+            )
+            for name, t in obj["tensors"].items()
+        }
+        return cls(tensors=tensors, flat_mapping=obj.get("flat_mapping"))
+
+
+def index_to_offsets(index: Tuple, shape: Tuple[int, ...]) -> Tuple[List[int], List[int]]:
+    """Convert an addressable-shard index (tuple of slices) to offsets/lengths."""
+    offsets, lengths = [], []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        offsets.append(start)
+        lengths.append(stop - start)
+    if not index:  # scalar
+        return [], []
+    return offsets, lengths

@@ -1,0 +1,116 @@
+"""Distributed checkpoint save (reference:
+/root/reference/python/paddle/distributed/checkpoint/save_state_dict.py:145 —
+each rank writes its local shards to ``<rank>_0.distcp`` plus a coordinator-
+written ``0.metadata`` of global shapes/offsets).
+
+TPU-native: shards are ``jax.Array.addressable_shards`` — on multi-host each
+process saves exactly the chunks it owns (deduped by replica id) to its own
+``<process_index>_0.distcp`` (an .npz); process 0 writes ``0.metadata`` after a
+metadata all-gather via jax.experimental.multihost_utils when running
+multi-process, or directly in single-controller mode.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict
+
+import jax
+import numpy as np
+
+from ...core.tensor import Tensor
+from .metadata import ChunkRecord, Metadata, TensorMetadata, index_to_offsets
+
+
+def _raw(v):
+    if isinstance(v, Tensor):
+        return v._data
+    return v
+
+
+def _flatten_state_dict(state_dict, prefix=""):
+    flat = {}
+    for k, v in state_dict.items():
+        key = f"{prefix}.{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            flat.update(_flatten_state_dict(v, key))
+        else:
+            flat[key] = v
+    return flat
+
+
+def save_state_dict(state_dict: Dict, path: str, process_group=None,
+                    coordinator_rank: int = 0, unique_id=None,
+                    async_save: bool = False) -> None:
+    """Save a (possibly sharded) state_dict to ``path``.
+
+    Every value may be a Tensor/jax.Array with any NamedSharding; only locally
+    addressable, first-replica chunks are written by this process, so the total
+    bytes across hosts equal one copy of the model.
+    """
+    flat = _flatten_state_dict(state_dict)
+    proc = jax.process_index()
+    os.makedirs(path, exist_ok=True)
+    fname = f"{proc}_0.distcp"
+    chunks_out = {}
+    meta_tensors: Dict[str, TensorMetadata] = {}
+    for name, v in flat.items():
+        arr = _raw(v)
+        if arr is None:
+            continue
+        if not isinstance(arr, jax.Array):
+            arr = np.asarray(arr)
+            key = f"{name}|full"
+            chunks_out[key] = arr
+            meta_tensors[name] = TensorMetadata(
+                global_shape=list(arr.shape), dtype=str(arr.dtype),
+                chunks=[ChunkRecord(offsets=[0] * arr.ndim,
+                                    lengths=list(arr.shape), file=fname, key=key)])
+            continue
+        records = []
+        seen = set()
+        for shard in arr.addressable_shards:
+            if shard.replica_id != 0:
+                continue  # only one replica writes a given chunk
+            offsets, lengths = index_to_offsets(shard.index, arr.shape)
+            tag = tuple(offsets)
+            if tag in seen:
+                continue
+            seen.add(tag)
+            key = f"{name}|{','.join(map(str, offsets)) or 'scalar'}"
+            data = np.asarray(shard.data)
+            if data.dtype == jax.numpy.bfloat16:
+                chunks_out[key] = data.view(np.uint16)
+            else:
+                chunks_out[key] = data
+            records.append(ChunkRecord(offsets=offsets, lengths=lengths,
+                                       file=fname, key=key))
+        meta_tensors[name] = TensorMetadata(
+            global_shape=list(arr.shape), dtype=str(arr.dtype), chunks=records)
+    with open(os.path.join(path, fname), "wb") as f:
+        np.savez(f, **chunks_out)
+
+    if jax.process_count() > 1:
+        # shared-FS protocol (like the reference): every process writes a
+        # partial metadata file, barrier, coordinator merges them
+        with open(os.path.join(path, f"{proc}.metadata.part"), "w") as f:
+            f.write(Metadata(meta_tensors).to_json())
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("ckpt_meta_parts")
+        if proc == coordinator_rank:
+            merged: Dict[str, TensorMetadata] = {}
+            for p in range(jax.process_count()):
+                with open(os.path.join(path, f"{p}.metadata.part")) as f:
+                    m = Metadata.from_json(f.read())
+                for name, tm in m.tensors.items():
+                    if name in merged:
+                        merged[name].chunks.extend(tm.chunks)
+                    else:
+                        merged[name] = tm
+            with open(os.path.join(path, "0.metadata"), "w") as f:
+                f.write(Metadata(merged).to_json())
+        multihost_utils.sync_global_devices("ckpt_meta_merged")
+    else:
+        with open(os.path.join(path, "0.metadata"), "w") as f:
+            f.write(Metadata(meta_tensors).to_json())

@@ -10,7 +10,6 @@ params where consumers need them.
 from __future__ import annotations
 
 import jax
-from jax.sharding import NamedSharding, PartitionSpec as P
 
 
 class DygraphShardingOptimizer:
@@ -21,20 +20,9 @@ class DygraphShardingOptimizer:
             self._install_sharded_accumulators()
 
     def _install_sharded_accumulators(self):
-        opt = self._inner_opt
-        mesh = self._hcg.mesh
-        ws = self._hcg.get_sharding_parallel_world_size()
-        orig_acc = opt._acc
+        from ....sharding.group_sharded import install_sharded_accumulators
 
-        def _acc(name, p, init=None, dtype=None):
-            arr = orig_acc(name, p, init, dtype)
-            if not isinstance(arr, jax.core.Tracer) and arr.ndim > 0 and arr.shape[0] % ws == 0:
-                spec = P(*(["sharding"] + [None] * (arr.ndim - 1)))
-                arr = jax.device_put(arr, NamedSharding(mesh, spec))
-                opt._accumulators[name][id(p)] = arr
-            return arr
-
-        opt._acc = _acc
+        install_sharded_accumulators(self._inner_opt, self._hcg.mesh, "sharding")
 
     def __getattr__(self, item):
         return getattr(self._inner_opt, item)

@@ -1,0 +1,10 @@
+"""Import-path parity with the reference's
+fleet/meta_parallel/sharding/group_sharded_stage{2,3}.py and
+group_sharded_optimizer_stage2.py — implementations live in
+paddle_tpu.distributed.sharding.group_sharded (sharding-spec semantics)."""
+
+from ....sharding.group_sharded import (  # noqa: F401
+    GroupShardedStage2,
+    GroupShardedStage3,
+    _ShardedOptimizer as GroupShardedOptimizerStage2,
+)

@@ -135,13 +135,17 @@ class MoELayer(Layer):
 
     def __init__(self, d_model: int, num_experts: int, d_hidden: Optional[int] = None,
                  experts: Optional[Layer] = None, gate: str = "gshard",
-                 top_k: Optional[int] = None, capacity_factor: float = 1.25,
+                 top_k: Optional[int] = None, capacity_factor: Optional[float] = None,
                  activation: str = "gelu", dtype: str = "float32",
                  recompute_interval: int = 0, group=None):
         super().__init__()
         self.d_model = d_model
         self.num_experts = num_experts
-        self.capacity_factor = capacity_factor
+        # capacity precedence: explicit arg > the gate's capacity (reference
+        # GShardGate(capacity=...) API) > 1.25 default
+        if capacity_factor is None and isinstance(gate, BaseGate):
+            capacity_factor = getattr(gate, "capacity_factor", None)
+        self.capacity_factor = 1.25 if capacity_factor is None else capacity_factor
         self.experts = experts if experts is not None else ExpertFFN(
             num_experts, d_model, d_hidden or 4 * d_model, activation, dtype)
         if isinstance(gate, BaseGate):

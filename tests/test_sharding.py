@@ -1,0 +1,112 @@
+"""ZeRO group-sharded API tests (reference strategy: test/collective/fleet
+dygraph_group_sharded_stage{2,3} tests — train with and without sharding, same
+result; here additionally assert the placement specs)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed.auto_parallel import axis_rules, make_mesh
+from paddle_tpu.distributed.sharding import (
+    group_sharded_parallel,
+    save_group_sharded_model,
+)
+
+
+def _model_and_opt(lr=0.1):
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 8))
+    opt = paddle.optimizer.AdamW(learning_rate=lr, parameters=model.parameters())
+    return model, opt
+
+
+def _spec_of(arr):
+    sh = arr.sharding
+    return tuple(sh.spec) if isinstance(sh, NamedSharding) else None
+
+
+class TestGroupSharded:
+    def test_os_shards_optimizer_state(self):
+        mesh = make_mesh({"fsdp": 8})
+        with axis_rules(mesh):
+            model, opt = _model_and_opt()
+            model, opt, _ = group_sharded_parallel(model, opt, level="os")
+            x = paddle.to_tensor(np.random.default_rng(0)
+                                 .standard_normal((4, 16)).astype(np.float32))
+            loss = (model(x) ** 2).mean()
+            loss.backward()
+            opt.step()
+        # moment accumulators of the [16,32] weight must be sharded over fsdp
+        accs = opt._inner_opt._accumulators
+        assert "moment1" in accs or len(accs) > 0
+        name = next(iter(accs))
+        arrs = [a for a in accs[name].values() if a.ndim == 2]
+        assert arrs, "no 2-D accumulators found"
+        assert any(_spec_of(a) and _spec_of(a)[0] == "fsdp" for a in arrs)
+
+    def test_p_g_os_shards_params(self):
+        mesh = make_mesh({"fsdp": 8})
+        with axis_rules(mesh):
+            model, opt = _model_and_opt()
+            model, opt, _ = group_sharded_parallel(model, opt, level="p_g_os")
+        w = model._layers[0].weight
+        assert _spec_of(w._data)[0] == "fsdp"
+
+    def test_sharded_training_matches_unsharded(self):
+        """ZeRO is an implementation detail: loss trajectory must be identical."""
+        def run(level):
+            mesh = make_mesh({"fsdp": 8})
+            with axis_rules(mesh):
+                model, opt = _model_and_opt()
+                if level is not None:
+                    model, opt, _ = group_sharded_parallel(model, opt, level=level)
+                rng = np.random.default_rng(1)
+                x = paddle.to_tensor(rng.standard_normal((8, 16)).astype(np.float32))
+                y = paddle.to_tensor(rng.standard_normal((8, 8)).astype(np.float32))
+                losses = []
+                for _ in range(5):
+                    loss = ((model(x) - y) ** 2).mean()
+                    loss.backward()
+                    opt.step()
+                    opt.clear_grad()
+                    losses.append(float(loss.numpy()))
+            return losses
+
+        base = run(None)
+        for level in ("os", "os_g", "p_g_os"):
+            np.testing.assert_allclose(run(level), base, rtol=2e-5,
+                                       err_msg=f"level={level} diverged")
+
+    def test_save_group_sharded_model(self, tmp_path):
+        mesh = make_mesh({"fsdp": 8})
+        with axis_rules(mesh):
+            model, opt = _model_and_opt()
+            model, opt, _ = group_sharded_parallel(model, opt, level="p_g_os")
+            x = paddle.to_tensor(np.random.default_rng(2)
+                                 .standard_normal((4, 16)).astype(np.float32))
+            (model(x) ** 2).mean().backward()
+            opt.step()
+        out = str(tmp_path / "gs")
+        save_group_sharded_model(model, out, optimizer=opt)
+        sd = paddle.load(out + "/model.pdmodel")
+        assert any(k.endswith("weight") for k in sd)
+
+    def test_single_device_passthrough(self):
+        model, opt = _model_and_opt()
+        m2, o2, s2 = group_sharded_parallel(model, opt, level="p_g_os")
+        assert m2 is model and o2 is opt
+
+    def test_import_path_parity(self):
+        from paddle_tpu.distributed.fleet.meta_parallel.sharding import (
+            GroupShardedOptimizerStage2,
+            GroupShardedStage2,
+            GroupShardedStage3,
+        )
+
+        assert GroupShardedStage3 is not None
+        assert GroupShardedOptimizerStage2 is not None
