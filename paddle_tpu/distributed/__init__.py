@@ -51,6 +51,8 @@ from .communication.functional import (  # noqa: F401
     send,
 )
 from .communication.group import Group, ReduceOp, destroy_process_group, get_group, new_group  # noqa: F401
+from .communication.store import TCPStore  # noqa: F401
+from .communication.watchdog import CommTaskManager, get_comm_task_manager  # noqa: F401
 from .parallel import (  # noqa: F401
     DataParallel,
     ParallelEnv,

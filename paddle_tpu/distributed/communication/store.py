@@ -1,0 +1,370 @@
+"""TCPStore — rank rendezvous key/value store.
+
+Reference: paddle/phi/core/distributed/store/tcp_store.h:121 (MasterDaemon +
+TCPStore client over sockets; used by ProcessGroup bootstrap at
+python/paddle/distributed/parallel.py:1134 create_or_get_global_tcp_store).
+
+TPU-native role: XLA owns the collective fabric, so the store is not needed to
+exchange NCCL ids — it bootstraps the *job*: rendezvous for launch/elastic
+(controllers), barriers for multi-host tests, and cross-process coordination
+for the DataLoader and checkpoint writers. Backed by the C++ daemon in
+paddle_tpu/native/src/tcp_store.cc; a pure-Python server/client fallback keeps
+the API alive when no toolchain exists (PT_DISABLE_NATIVE=1).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+import time
+from typing import Optional
+
+from ... import native
+
+__all__ = ["TCPStore", "MasterDaemon"]
+
+_CMD = {"set": 1, "get": 2, "add": 3, "check": 4, "delete": 5, "wait": 6,
+        "num_keys": 7, "ping": 8, "wait_ge": 9, "compare_set": 10}
+_OK, _NOTFOUND, _TIMEOUT, _ERROR = 0, 1, 2, 3
+
+
+def _resolve(host: str) -> str:
+    try:
+        return socket.gethostbyname(host)
+    except OSError:
+        return host
+
+
+# ---------------------------------------------------------------------------
+# Pure-Python fallback (same wire protocol as the native daemon)
+# ---------------------------------------------------------------------------
+
+class _PyState:
+    def __init__(self):
+        self.data = {}
+        self.cond = threading.Condition()
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def _read(self, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = self.request.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError
+            buf += chunk
+        return buf
+
+    def _read_blob(self):
+        (n,) = struct.unpack("<I", self._read(4))
+        return self._read(n) if n else b""
+
+    def _resp(self, status, payload=b"", num=0):
+        self.request.sendall(
+            struct.pack("<BI", status, len(payload)) + payload + struct.pack("<q", num))
+
+    def handle(self):
+        st: _PyState = self.server.state  # type: ignore[attr-defined]
+        try:
+            while True:
+                (cmd,) = struct.unpack("<B", self._read(1))
+                key = self._read_blob().decode()
+                val = self._read_blob()
+                (arg,) = struct.unpack("<q", self._read(8))
+                with st.cond:
+                    if cmd == _CMD["set"]:
+                        st.data[key] = val
+                        st.cond.notify_all()
+                        self._resp(_OK)
+                    elif cmd == _CMD["get"]:
+                        if key in st.data:
+                            self._resp(_OK, st.data[key])
+                        else:
+                            self._resp(_NOTFOUND)
+                    elif cmd == _CMD["add"]:
+                        cur = _decode_i64(st.data.get(key, b"")) + arg
+                        st.data[key] = struct.pack("<q", cur)
+                        st.cond.notify_all()
+                        self._resp(_OK, num=cur)
+                    elif cmd == _CMD["check"]:
+                        self._resp(_OK, num=int(key in st.data))
+                    elif cmd == _CMD["delete"]:
+                        self._resp(_OK, num=int(st.data.pop(key, None) is not None))
+                    elif cmd == _CMD["wait"]:
+                        ok = _cond_wait(st, arg, lambda: key in st.data)
+                        self._resp(_OK if ok else _TIMEOUT)
+                    elif cmd == _CMD["wait_ge"]:
+                        timeout_ms = _decode_i64(val) if val else -1
+                        ok = _cond_wait(
+                            st, timeout_ms,
+                            lambda: _decode_i64(st.data.get(key, b"")) >= arg)
+                        self._resp(_OK if ok else _TIMEOUT,
+                                   num=_decode_i64(st.data.get(key, b"")))
+                    elif cmd == _CMD["num_keys"]:
+                        self._resp(_OK, num=len(st.data))
+                    elif cmd == _CMD["ping"]:
+                        self._resp(_OK, num=arg)
+                    elif cmd == _CMD["compare_set"]:
+                        sep = val.find(b"\x00")
+                        expected, desired = val[:sep], val[sep + 1:]
+                        cur = st.data.get(key)
+                        matched = (cur is None and expected == b"") or cur == expected
+                        if matched:
+                            st.data[key] = desired
+                            st.cond.notify_all()
+                        self._resp(_OK if matched else _ERROR,
+                                   st.data.get(key, b""), int(matched))
+                    else:
+                        self._resp(_ERROR)
+        except (ConnectionError, OSError):
+            pass
+
+
+def _decode_i64(v: bytes) -> int:
+    if len(v) == 8:
+        return struct.unpack("<q", v)[0]
+    try:
+        return int(v.decode())
+    except Exception:
+        return 0
+
+
+def _cond_wait(st: _PyState, timeout_ms: int, pred) -> bool:
+    deadline = None if timeout_ms < 0 else time.monotonic() + timeout_ms / 1000
+    while not pred():
+        remain = None if deadline is None else deadline - time.monotonic()
+        if remain is not None and remain <= 0:
+            return False
+        st.cond.wait(remain if remain is None or remain < 0.2 else 0.2)
+    return True
+
+
+class MasterDaemon:
+    """Store server. Native C++ daemon when available, threaded Python otherwise."""
+
+    def __init__(self, port: int = 0):
+        self._lib = native.load()
+        if self._lib is not None:
+            self._handle = self._lib.pt_store_master_start(port)
+            if not self._handle:
+                raise RuntimeError(f"TCPStore master failed to bind port {port}")
+            self.port = self._lib.pt_store_master_port(self._handle)
+            self._server = None
+        else:
+            self._handle = None
+            srv = socketserver.ThreadingTCPServer(("0.0.0.0", port), _Handler,
+                                                  bind_and_activate=False)
+            srv.allow_reuse_address = True
+            srv.daemon_threads = True
+            srv.server_bind()
+            srv.server_activate()
+            srv.state = _PyState()  # type: ignore[attr-defined]
+            self._server = srv
+            self.port = srv.server_address[1]
+            threading.Thread(target=srv.serve_forever, daemon=True).start()
+
+    def stop(self):
+        if self._handle is not None:
+            self._lib.pt_store_master_stop(self._handle)
+            self._handle = None
+        elif self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:
+            pass
+
+
+class _PyClient:
+    def __init__(self, host, port, timeout_ms):
+        deadline = time.monotonic() + timeout_ms / 1000
+        while True:
+            try:
+                self.sock = socket.create_connection((host, port), timeout=5)
+                break
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.05)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.sock.settimeout(None)
+        self._lock = threading.Lock()
+
+    def request(self, cmd, key=b"", val=b"", arg=0):
+        with self._lock:
+            msg = (struct.pack("<B", cmd) + struct.pack("<I", len(key)) + key +
+                   struct.pack("<I", len(val)) + val + struct.pack("<q", arg))
+            self.sock.sendall(msg)
+            status = self._read(1)[0]
+            (n,) = struct.unpack("<I", self._read(4))
+            payload = self._read(n) if n else b""
+            (num,) = struct.unpack("<q", self._read(8))
+            return status, payload, num
+
+    def _read(self, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("store connection closed")
+            buf += chunk
+        return buf
+
+    def close(self):
+        self.sock.close()
+
+
+class TCPStore:
+    """Client (optionally hosting the master) — mirrors paddle's TCPStore API.
+
+    >>> store = TCPStore("127.0.0.1", 0, is_master=True, world_size=1)
+    >>> store.set("k", b"v"); store.get("k")
+    b'v'
+    """
+
+    def __init__(self, host: str, port: int, is_master: bool = False,
+                 world_size: int = 1, timeout: float = 300.0):
+        self.host = _resolve(host)
+        self.world_size = world_size
+        self.timeout = timeout
+        self._daemon: Optional[MasterDaemon] = MasterDaemon(port) if is_master else None
+        self.port = self._daemon.port if self._daemon else port
+        self._lib = native.load()
+        if self._lib is not None:
+            self._client = self._lib.pt_store_client_new(
+                self.host.encode(), self.port, int(timeout * 1000))
+            if not self._client:
+                raise RuntimeError(
+                    f"TCPStore could not connect to {self.host}:{self.port}")
+            self._py = None
+        else:
+            self._client = None
+            self._py = _PyClient(self.host, self.port, int(timeout * 1000))
+
+    # -- core ops ----------------------------------------------------------
+    def set(self, key: str, value) -> None:
+        v = value if isinstance(value, (bytes, bytearray)) else pickle.dumps(value)
+        if self._client:
+            rc = self._lib.pt_store_set(self._client, key.encode(), bytes(v), len(v))
+            if rc != 0:
+                raise RuntimeError(f"store set({key}) failed rc={rc}")
+        else:
+            self._py.request(_CMD["set"], key.encode(), bytes(v))
+
+    def get(self, key: str, wait: bool = True) -> Optional[bytes]:
+        if wait and not self.wait([key]):
+            raise TimeoutError(f"store get({key}) timed out after {self.timeout}s")
+        if self._client:
+            p = ctypes.POINTER(ctypes.c_uint8)()
+            n = ctypes.c_int()
+            st = self._lib.pt_store_get(self._client, key.encode(),
+                                        ctypes.byref(p), ctypes.byref(n))
+            data = native.take_bytes(self._lib, p, n)
+            return data if st == _OK else None
+        st, payload, _ = self._py.request(_CMD["get"], key.encode())
+        return payload if st == _OK else None
+
+    def add(self, key: str, amount: int = 1) -> int:
+        if self._client:
+            return int(self._lib.pt_store_add(self._client, key.encode(), amount))
+        _, _, num = self._py.request(_CMD["add"], key.encode(), arg=amount)
+        return num
+
+    def check(self, keys) -> bool:
+        keys = [keys] if isinstance(keys, str) else keys
+        for k in keys:
+            if self._client:
+                if self._lib.pt_store_check(self._client, k.encode()) != 1:
+                    return False
+            else:
+                _, _, num = self._py.request(_CMD["check"], k.encode())
+                if not num:
+                    return False
+        return True
+
+    def delete_key(self, key: str) -> bool:
+        if self._client:
+            return self._lib.pt_store_delete(self._client, key.encode()) == 1
+        _, _, num = self._py.request(_CMD["delete"], key.encode())
+        return bool(num)
+
+    def wait(self, keys, timeout: Optional[float] = None) -> bool:
+        keys = [keys] if isinstance(keys, str) else keys
+        tmo = int((self.timeout if timeout is None else timeout) * 1000)
+        for k in keys:
+            if self._client:
+                if self._lib.pt_store_wait(self._client, k.encode(), tmo) != _OK:
+                    return False
+            else:
+                st, _, _ = self._py.request(_CMD["wait"], k.encode(), arg=tmo)
+                if st != _OK:
+                    return False
+        return True
+
+    def wait_ge(self, key: str, target: int, timeout: Optional[float] = None) -> int:
+        """Block until int(store[key]) >= target; returns the value seen."""
+        tmo = int((self.timeout if timeout is None else timeout) * 1000)
+        if self._client:
+            v = int(self._lib.pt_store_wait_ge(self._client, key.encode(), target, tmo))
+            if v == -2:
+                raise TimeoutError(f"wait_ge({key}, {target}) timed out")
+            if v < 0:
+                raise RuntimeError(f"wait_ge({key}) io error")
+            return v
+        st, _, num = self._py.request(_CMD["wait_ge"], key.encode(),
+                                      struct.pack("<q", tmo), target)
+        if st == _TIMEOUT:
+            raise TimeoutError(f"wait_ge({key}, {target}) timed out")
+        return num
+
+    def compare_set(self, key: str, expected: bytes, desired: bytes) -> bool:
+        if self._client:
+            p = ctypes.POINTER(ctypes.c_uint8)()
+            n = ctypes.c_int()
+            rc = self._lib.pt_store_compare_set(
+                self._client, key.encode(), expected, len(expected),
+                desired, len(desired), ctypes.byref(p), ctypes.byref(n))
+            native.take_bytes(self._lib, p, n)
+            return rc == 1
+        st, _, num = self._py.request(_CMD["compare_set"], key.encode(),
+                                      expected + b"\x00" + desired)
+        return bool(num)
+
+    def num_keys(self) -> int:
+        if self._client:
+            return int(self._lib.pt_store_num_keys(self._client))
+        _, _, num = self._py.request(_CMD["num_keys"])
+        return num
+
+    # -- composite ---------------------------------------------------------
+    def barrier(self, name: str = "default", world_size: Optional[int] = None,
+                timeout: Optional[float] = None) -> None:
+        """All `world_size` callers block until everyone arrives."""
+        ws = world_size or self.world_size
+        self.add(f"__barrier__/{name}", 1)
+        self.wait_ge(f"__barrier__/{name}", ws, timeout)
+
+    def close(self):
+        if self._client:
+            self._lib.pt_store_client_free(self._client)
+            self._client = None
+        if self._py:
+            self._py.close()
+            self._py = None
+        if self._daemon:
+            self._daemon.stop()
+            self._daemon = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

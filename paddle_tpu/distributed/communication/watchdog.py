@@ -1,0 +1,156 @@
+"""Comm watchdog — hang/timeout detection for blocking distributed regions.
+
+Reference: paddle/phi/core/distributed/comm_task_manager.h:37 (CommTaskManager
+background thread + CommTask::IsTimeout at comm_task.h:127, stack dump on
+timeout). TPU-native: there are no NCCL streams to poll; the watchdog brackets
+blocking host regions (collective fences, store barriers, pipeline steps,
+checkpoint IO). Backed by the C++ monitor thread in
+paddle_tpu/native/src/watchdog.cc with a Python-thread fallback.
+
+Usage::
+
+    mgr = CommTaskManager(report_path="hang.jsonl")
+    with mgr.task("allreduce/grads", timeout=120.0):
+        jax.device_get(loss)   # fenced region
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+from ... import native
+
+__all__ = ["CommTaskManager", "get_comm_task_manager"]
+
+
+class _PyWatchdog:
+    def __init__(self, interval_ms: int, report_path: str):
+        self.interval = interval_ms / 1000
+        self.report_path = report_path
+        self.tasks = {}
+        self.next_id = 1
+        self.timeouts = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def begin(self, name, timeout_ms):
+        with self._lock:
+            tid = self.next_id
+            self.next_id += 1
+            deadline = None if timeout_ms < 0 else time.monotonic() + timeout_ms / 1000
+            self.tasks[tid] = [name, time.monotonic(), deadline, False]
+            return tid
+
+    def end(self, tid):
+        with self._lock:
+            self.tasks.pop(tid, None)
+
+    def _loop(self):
+        while not self._stop.wait(self.interval):
+            now = time.monotonic()
+            with self._lock:
+                for rec in self.tasks.values():
+                    name, start, deadline, reported = rec
+                    if reported or deadline is None or now < deadline:
+                        continue
+                    rec[3] = True
+                    self.timeouts += 1
+                    try:
+                        with open(self.report_path, "a") as f:
+                            f.write(json.dumps({
+                                "event": "watchdog_timeout", "task": name,
+                                "pid": os.getpid(),
+                                "elapsed_ms": int((now - start) * 1000),
+                                "active_tasks": len(self.tasks)}) + "\n")
+                    except OSError:
+                        pass
+                    if os.environ.get("PT_WATCHDOG_FATAL") == "1":
+                        os._exit(99)
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=2)
+
+
+class CommTaskManager:
+    """Tracks blocking tasks; a monitor thread reports any that exceed their deadline."""
+
+    def __init__(self, interval_ms: int = 1000,
+                 report_path: Optional[str] = None,
+                 default_timeout: float = 1800.0):
+        self.report_path = report_path or os.environ.get(
+            "PT_WATCHDOG_REPORT", "paddle_tpu_watchdog.jsonl")
+        self.default_timeout = default_timeout
+        self._lib = native.load()
+        if self._lib is not None:
+            self._handle = self._lib.pt_watchdog_start(
+                interval_ms, self.report_path.encode())
+            self._py = None
+        else:
+            self._handle = None
+            self._py = _PyWatchdog(interval_ms, self.report_path)
+
+    def begin(self, name: str, timeout: Optional[float] = None) -> int:
+        tmo_ms = int((self.default_timeout if timeout is None else timeout) * 1000)
+        if self._handle is not None:
+            return int(self._lib.pt_watchdog_begin(self._handle, name.encode(), tmo_ms))
+        return self._py.begin(name, tmo_ms)
+
+    def end(self, task_id: int) -> None:
+        if self._handle is not None:
+            self._lib.pt_watchdog_end(self._handle, task_id)
+        else:
+            self._py.end(task_id)
+
+    @contextlib.contextmanager
+    def task(self, name: str, timeout: Optional[float] = None):
+        tid = self.begin(name, timeout)
+        try:
+            yield
+        finally:
+            self.end(tid)
+
+    @property
+    def timeout_count(self) -> int:
+        if self._handle is not None:
+            return int(self._lib.pt_watchdog_timeout_count(self._handle))
+        return self._py.timeouts
+
+    @property
+    def active_count(self) -> int:
+        if self._handle is not None:
+            return int(self._lib.pt_watchdog_active_count(self._handle))
+        return len(self._py.tasks)
+
+    def shutdown(self):
+        if self._handle is not None:
+            self._lib.pt_watchdog_stop(self._handle)
+            self._handle = None
+        elif self._py is not None:
+            self._py.stop()
+            self._py = None
+
+    def __del__(self):
+        try:
+            self.shutdown()
+        except Exception:
+            pass
+
+
+_global_mgr: Optional[CommTaskManager] = None
+_global_lock = threading.Lock()
+
+
+def get_comm_task_manager() -> CommTaskManager:
+    global _global_mgr
+    with _global_lock:
+        if _global_mgr is None:
+            _global_mgr = CommTaskManager()
+        return _global_mgr

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import itertools
 import multiprocessing as mp
+import os
 import queue
 import threading
 from collections import namedtuple
@@ -76,11 +77,32 @@ def _to_tensor(obj):
     return obj
 
 
-def _worker_loop(dataset, index_queue, result_queue, collate_fn, worker_id, num_workers, init_fn):
+def _worker_loop(dataset, index_queue, result_queue, collate_fn, worker_id, num_workers,
+                 init_fn, shm_name=None):
     global _worker_info
     _worker_info = WorkerInfo(worker_id, num_workers, dataset)
     if init_fn is not None:
         init_fn(worker_id)
+    shm = None
+    if shm_name is not None:
+        from .shm_channel import ShmChannel
+
+        try:
+            shm = ShmChannel(shm_name, create=False)
+        except Exception:
+            shm = None  # fall back to the queue transport
+
+    def emit(batch_idx, data, err):
+        if shm is not None:
+            try:
+                shm.put((batch_idx, data, err))
+                return
+            except ValueError:
+                pass  # batch larger than the ring — use the pickle queue
+            except (EOFError, TimeoutError):
+                return  # parent closed the channel; shutting down
+        result_queue.put((batch_idx, data, err))
+
     while True:
         item = index_queue.get()
         if item is None:
@@ -94,9 +116,11 @@ def _worker_loop(dataset, index_queue, result_queue, collate_fn, worker_id, num_
                 for s in samples
             ]
             data = collate_fn(samples) if collate_fn is not _np_collate else _np_collate(samples)
-            result_queue.put((batch_idx, data, None))
+            emit(batch_idx, data, None)
         except Exception as e:  # surface worker errors to the parent
-            result_queue.put((batch_idx, None, repr(e)))
+            emit(batch_idx, None, repr(e))
+    if shm is not None:
+        shm.detach()
 
 
 class DataLoader:
@@ -107,6 +131,7 @@ class DataLoader:
         self.dataset = dataset
         self.num_workers = num_workers
         self.collate_fn = collate_fn
+        self.use_shared_memory = use_shared_memory
         self.prefetch_factor = max(prefetch_factor, 2)
         self.worker_init_fn = worker_init_fn
         self.timeout = timeout
@@ -178,12 +203,27 @@ class _MultiProcessIter:
         ctx = mp.get_context("fork")
         self.index_queues = [ctx.Queue() for _ in range(self.num_workers)]
         self.result_queue = ctx.Queue()
+        # Shared-memory ring transport (native shm_ring.cc) keeps bulk array
+        # bytes out of the pickle pipe — reference dataloader_iter.py:370's
+        # LoDTensorBlockingQueue role.
+        self.shm = None
+        shm_name = None
+        if loader.use_shared_memory:
+            from .shm_channel import ShmChannel
+
+            if ShmChannel.available():
+                shm_name = f"/pt_dl_{os.getpid()}_{id(self) & 0xFFFFFF:x}"
+                try:
+                    self.shm = ShmChannel(shm_name, capacity=64 << 20, create=True)
+                except RuntimeError:
+                    self.shm, shm_name = None, None
         self.workers = []
         for wid in range(self.num_workers):
             w = ctx.Process(
                 target=_worker_loop,
                 args=(loader.dataset, self.index_queues[wid], self.result_queue,
-                      self.collate, wid, self.num_workers, loader.worker_init_fn),
+                      self.collate, wid, self.num_workers, loader.worker_init_fn,
+                      shm_name),
                 daemon=True,
             )
             w.start()
@@ -211,7 +251,7 @@ class _MultiProcessIter:
             self._shutdown()
             raise StopIteration
         while self.rcv_idx not in self.cache:
-            idx, data, err = self.result_queue.get()
+            idx, data, err = self._recv()
             if err is not None:
                 self._shutdown()
                 raise RuntimeError(f"DataLoader worker failed: {err}")
@@ -221,12 +261,36 @@ class _MultiProcessIter:
         self._dispatch()
         return _to_tensor(data)
 
+    def _recv(self):
+        """Next (idx, data, err) from the shm ring or, failing that, the queue."""
+        if self.shm is None:
+            return self.result_queue.get()
+        stale = 0.0
+        while True:
+            # Queue first (non-blocking): oversized batches and attach-failed
+            # workers use it, and it must not pay the shm wait per batch.
+            try:
+                return self.result_queue.get_nowait()
+            except queue.Empty:
+                pass
+            try:
+                return self.shm.get(timeout=0.1)
+            except TimeoutError:
+                stale += 0.1
+            if stale > 5.0 and not any(w.is_alive() for w in self.workers):
+                raise RuntimeError("all DataLoader workers exited unexpectedly")
+
     def _shutdown(self):
         for q in self.index_queues:
             try:
                 q.put(None)
             except Exception:
                 pass
+        # Close the ring BEFORE joining: workers parked in a blocking push wake
+        # on close (push returns closed) and can then see the None sentinel.
+        if self.shm is not None:
+            self.shm.close()
+            self.shm = None
         for w in self.workers:
             w.join(timeout=1)
             if w.is_alive():

@@ -1,0 +1,142 @@
+"""Shared-memory batch channel for DataLoader worker→trainer transport.
+
+Reference: the worker pool in python/paddle/io/dataloader/dataloader_iter.py:370
+ships batches through core.LoDTensorBlockingQueue with mmap-backed tensors (C++
+blocking queue + shared memory) so bulk array bytes never pass through a pickle
+pipe. TPU-native equivalent: a POSIX shared-memory MPMC ring
+(paddle_tpu/native/src/shm_ring.cc). Batch structure (nesting, dtypes, shapes)
+is pickled; ndarray payloads are written raw into the ring.
+
+Message layout: [u32 manifest_len][pickle(manifest)][array0 bytes][array1 ...].
+The manifest is the batch structure with each ndarray replaced by
+("__nd__", i, dtype_str, shape); oversized batches (> ring capacity) raise and
+the caller falls back to the queue transport.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import pickle
+import struct
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from .. import native
+
+__all__ = ["ShmChannel", "pack_batch", "unpack_batch"]
+
+
+def _extract(obj: Any, arrays: List[np.ndarray]) -> Any:
+    if isinstance(obj, np.ndarray):
+        idx = len(arrays)
+        arrays.append(np.ascontiguousarray(obj))
+        return ("__nd__", idx, obj.dtype.str, obj.shape)
+    if isinstance(obj, tuple):
+        return tuple(_extract(o, arrays) for o in obj)
+    if isinstance(obj, list):
+        return [_extract(o, arrays) for o in obj]
+    if isinstance(obj, dict):
+        return {k: _extract(v, arrays) for k, v in obj.items()}
+    return obj
+
+
+def _rebuild(obj: Any, buf: memoryview, offsets: List[Tuple[int, int]]) -> Any:
+    if isinstance(obj, tuple):
+        if len(obj) == 4 and obj[0] == "__nd__":
+            _, idx, dtype, shape = obj
+            start, nbytes = offsets[idx]
+            return np.frombuffer(buf[start:start + nbytes], dtype=np.dtype(dtype)).reshape(shape)
+        return tuple(_rebuild(o, buf, offsets) for o in obj)
+    if isinstance(obj, list):
+        return [_rebuild(o, buf, offsets) for o in obj]
+    if isinstance(obj, dict):
+        return {k: _rebuild(v, buf, offsets) for k, v in obj.items()}
+    return obj
+
+
+def pack_batch(payload: Any) -> bytes:
+    arrays: List[np.ndarray] = []
+    manifest = _extract(payload, arrays)
+    head = pickle.dumps((manifest, [(a.dtype.str, a.shape, a.nbytes) for a in arrays]),
+                        protocol=pickle.HIGHEST_PROTOCOL)
+    parts = [struct.pack("<I", len(head)), head]
+    parts.extend(a.tobytes() for a in arrays)
+    return b"".join(parts)
+
+
+def unpack_batch(data: bytes) -> Any:
+    (hlen,) = struct.unpack_from("<I", data, 0)
+    manifest, metas = pickle.loads(data[4:4 + hlen])
+    buf = memoryview(data)
+    offsets = []
+    pos = 4 + hlen
+    for _, _, nbytes in metas:
+        offsets.append((pos, nbytes))
+        pos += nbytes
+    return _rebuild(manifest, buf, offsets)
+
+
+class ShmChannel:
+    """MPMC byte-record channel over a named POSIX shm ring."""
+
+    def __init__(self, name: str, capacity: int = 64 << 20, create: bool = True):
+        self._lib = native.load()
+        if self._lib is None:
+            raise RuntimeError(f"native library unavailable: {native.load_error()}")
+        self.name = name
+        self.capacity = capacity
+        if create:
+            self._handle = self._lib.pt_shmring_create(name.encode(), capacity)
+        else:
+            self._handle = self._lib.pt_shmring_attach(name.encode())
+        if not self._handle:
+            raise RuntimeError(f"shm ring {'create' if create else 'attach'}({name}) failed")
+        self._owner = create
+
+    @classmethod
+    def available(cls) -> bool:
+        return native.available()
+
+    def put(self, payload: Any, timeout: Optional[float] = None) -> None:
+        data = pack_batch(payload)
+        tmo = -1 if timeout is None else int(timeout * 1000)
+        rc = self._lib.pt_shmring_push(self._handle, data, len(data), tmo)
+        if rc == -2:
+            raise ValueError(
+                f"record of {len(data)} bytes exceeds ring capacity {self.capacity}")
+        if rc != 0:
+            raise TimeoutError("shm ring push timed out or channel closed")
+
+    def get(self, timeout: Optional[float] = None) -> Any:
+        tmo = -1 if timeout is None else int(timeout * 1000)
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        n = self._lib.pt_shmring_pop(self._handle, ctypes.byref(out), tmo)
+        if n == -3:
+            raise EOFError("shm ring closed")
+        if n < 0:
+            raise TimeoutError("shm ring pop timed out")
+        length = ctypes.c_int(int(n))
+        data = native.take_bytes(self._lib, out, length)
+        return unpack_batch(data)
+
+    def qsize_bytes(self) -> int:
+        return int(self._lib.pt_shmring_size(self._handle))
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.pt_shmring_close(self._handle)
+            self._handle = None
+            if self._owner:
+                self._lib.pt_shmring_unlink(self.name.encode())
+
+    def detach(self) -> None:
+        if self._handle:
+            self._lib.pt_shmring_detach(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close() if self._owner else self.detach()
+        except Exception:
+            pass

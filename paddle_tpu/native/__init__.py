@@ -1,0 +1,132 @@
+"""paddle_tpu.native — C++ host-runtime services behind a ctypes C ABI.
+
+Native-parity layer for the runtime pieces the reference implements in C++
+(SURVEY §2 #24 TCPStore, #26 comm watchdog, #35 profiler host tracer, #41's
+C++ blocking-queue transport). The TPU *compute* path stays JAX/XLA; these are
+the host-side services around it.
+
+Import is safe everywhere: if compilation is impossible the module degrades to
+``available() == False`` and the Python fallbacks in each subsystem take over.
+Set ``PT_DISABLE_NATIVE=1`` to force the fallbacks (used in tests).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Optional
+
+_lib = None
+_lib_err: Optional[str] = None
+_lock = threading.Lock()
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    c = ctypes
+    sigs = {
+        # tcp_store.cc
+        "pt_store_master_start": (c.c_void_p, [c.c_int]),
+        "pt_store_master_port": (c.c_int, [c.c_void_p]),
+        "pt_store_master_stop": (None, [c.c_void_p]),
+        "pt_store_client_new": (c.c_void_p, [c.c_char_p, c.c_int, c.c_int]),
+        "pt_store_client_free": (None, [c.c_void_p]),
+        "pt_store_set": (c.c_int, [c.c_void_p, c.c_char_p, c.c_char_p, c.c_int]),
+        "pt_store_get": (c.c_int, [c.c_void_p, c.c_char_p,
+                                   c.POINTER(c.POINTER(c.c_uint8)), c.POINTER(c.c_int)]),
+        "pt_store_add": (c.c_longlong, [c.c_void_p, c.c_char_p, c.c_longlong]),
+        "pt_store_check": (c.c_int, [c.c_void_p, c.c_char_p]),
+        "pt_store_delete": (c.c_int, [c.c_void_p, c.c_char_p]),
+        "pt_store_wait": (c.c_int, [c.c_void_p, c.c_char_p, c.c_longlong]),
+        "pt_store_wait_ge": (c.c_longlong,
+                             [c.c_void_p, c.c_char_p, c.c_longlong, c.c_longlong]),
+        "pt_store_num_keys": (c.c_longlong, [c.c_void_p]),
+        "pt_store_compare_set": (c.c_int, [c.c_void_p, c.c_char_p, c.c_char_p, c.c_int,
+                                           c.c_char_p, c.c_int,
+                                           c.POINTER(c.POINTER(c.c_uint8)),
+                                           c.POINTER(c.c_int)]),
+        "pt_free": (None, [c.c_void_p]),
+        # shm_ring.cc
+        "pt_shmring_create": (c.c_void_p, [c.c_char_p, c.c_longlong]),
+        "pt_shmring_attach": (c.c_void_p, [c.c_char_p]),
+        "pt_shmring_push": (c.c_int, [c.c_void_p, c.c_char_p, c.c_longlong, c.c_int]),
+        "pt_shmring_pop": (c.c_longlong,
+                           [c.c_void_p, c.POINTER(c.POINTER(c.c_uint8)), c.c_int]),
+        "pt_shmring_size": (c.c_longlong, [c.c_void_p]),
+        "pt_shmring_close": (None, [c.c_void_p]),
+        "pt_shmring_detach": (None, [c.c_void_p]),
+        "pt_shmring_unlink": (None, [c.c_char_p]),
+        # trace.cc
+        "pt_trace_start": (None, []),
+        "pt_trace_stop": (None, []),
+        "pt_trace_enabled": (c.c_int, []),
+        "pt_trace_generation": (c.c_longlong, []),
+        "pt_trace_begin": (None, [c.c_char_p]),
+        "pt_trace_end": (None, []),
+        "pt_trace_instant": (None, [c.c_char_p]),
+        "pt_trace_counter": (None, [c.c_char_p, c.c_double]),
+        "pt_trace_event_count": (c.c_longlong, []),
+        "pt_trace_dump": (c.c_int, [c.c_char_p, c.c_char_p]),
+        # watchdog.cc
+        "pt_watchdog_start": (c.c_void_p, [c.c_longlong, c.c_char_p]),
+        "pt_watchdog_stop": (None, [c.c_void_p]),
+        "pt_watchdog_begin": (c.c_longlong, [c.c_void_p, c.c_char_p, c.c_longlong]),
+        "pt_watchdog_end": (None, [c.c_void_p, c.c_longlong]),
+        "pt_watchdog_timeout_count": (c.c_longlong, [c.c_void_p]),
+        "pt_watchdog_active_count": (c.c_longlong, [c.c_void_p]),
+    }
+    for name, (restype, argtypes) in sigs.items():
+        fn = getattr(lib, name)
+        fn.restype = restype
+        fn.argtypes = argtypes
+    return lib
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Build-if-needed and dlopen the native library; None when unavailable."""
+    global _lib, _lib_err
+    if _lib is not None or _lib_err is not None:
+        return _lib
+    with _lock:
+        if _lib is not None or _lib_err is not None:
+            return _lib
+        if os.environ.get("PT_DISABLE_NATIVE") == "1":
+            _lib_err = "disabled via PT_DISABLE_NATIVE"
+            return None
+        try:
+            from .build import build
+
+            _lib = _bind(ctypes.CDLL(build()))
+        except Exception as e:  # noqa: BLE001 — any failure → Python fallback
+            _lib_err = str(e)
+            return None
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def peek() -> Optional[ctypes.CDLL]:
+    """The library if it is ALREADY loaded — never triggers a build.
+
+    Hot paths (RecordEvent) use this so untraced runs never pay the first-call
+    g++ compile; the Profiler's start() performs the real load().
+    """
+    return _lib
+
+
+def load_error() -> Optional[str]:
+    load()
+    return _lib_err
+
+
+def take_bytes(lib, out_ptr, out_len) -> bytes:
+    """Copy a malloc'd (ptr,len) result into Python bytes and free it."""
+    try:
+        if not out_ptr or out_len.value <= 0:
+            return b""
+        return ctypes.string_at(out_ptr, out_len.value)
+    finally:
+        if out_ptr:
+            lib.pt_free(out_ptr)

@@ -1,0 +1,84 @@
+"""Lazy builder for the paddle_tpu native runtime library.
+
+Compiles ``src/*.cc`` into one shared object with g++ the first time it is
+needed, keyed by a hash of the sources + compiler version, cached under
+``~/.cache/paddle_tpu`` (or ``PT_NATIVE_CACHE``). This mirrors the reference's
+"native core + Python shell" split (paddle/CMakeLists.txt superbuild) without
+requiring a build step at install time: the toolchain requirement is just g++.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+import tempfile
+from pathlib import Path
+
+_SRC_DIR = Path(__file__).resolve().parent / "src"
+_LIB_BASENAME = "libptnative"
+
+
+def _cache_dir() -> Path:
+    d = os.environ.get("PT_NATIVE_CACHE")
+    if d:
+        return Path(d)
+    return Path(os.path.expanduser("~")) / ".cache" / "paddle_tpu"
+
+
+def _source_files():
+    return sorted(_SRC_DIR.glob("*.cc")) + sorted(_SRC_DIR.glob("*.h"))
+
+
+def _build_key(cxx: str) -> str:
+    h = hashlib.sha256()
+    for f in _source_files():
+        h.update(f.name.encode())
+        h.update(f.read_bytes())
+    try:
+        ver = subprocess.run([cxx, "--version"], capture_output=True, text=True,
+                             timeout=30).stdout.splitlines()[:1]
+        h.update("".join(ver).encode())
+    except Exception:
+        pass
+    return h.hexdigest()[:16]
+
+
+def build(verbose: bool = False) -> str:
+    """Compile (or reuse cached) libptnative.so; returns its path.
+
+    Raises RuntimeError when no working C++ toolchain is available — callers
+    fall back to pure-Python implementations.
+    """
+    cxx = os.environ.get("CXX", "g++")
+    key = _build_key(cxx)
+    cache = _cache_dir()
+    out = cache / f"{_LIB_BASENAME}-{key}.so"
+    if out.exists():
+        return str(out)
+    cache.mkdir(parents=True, exist_ok=True)
+
+    sources = [str(f) for f in _source_files() if f.suffix == ".cc"]
+    # Build into a temp file then atomic-rename so concurrent builders are safe.
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=str(cache))
+    os.close(fd)
+    cmd = [
+        cxx, "-O2", "-g", "-fPIC", "-shared", "-std=c++17", "-pthread",
+        "-fvisibility=hidden", "-o", tmp, *sources, "-lrt",
+    ]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        os.unlink(tmp)
+        raise RuntimeError(f"native build failed to run {cxx}: {e}") from e
+    if proc.returncode != 0:
+        os.unlink(tmp)
+        raise RuntimeError(f"native build failed:\n{proc.stderr[-4000:]}")
+    os.replace(tmp, out)
+    if verbose:
+        print(f"[paddle_tpu.native] built {out}")
+    return str(out)
+
+
+if __name__ == "__main__":
+    print(build(verbose=True))

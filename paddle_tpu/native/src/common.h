@@ -1,0 +1,26 @@
+// Common helpers for the paddle_tpu native runtime library.
+//
+// Native-runtime parity layer (reference: paddle/phi/core/distributed/store/
+// tcp_store.h, fluid/platform/profiler, phi/core/distributed/comm_task_manager.h).
+// The TPU compute path is JAX/XLA; this library provides the host-side runtime
+// services that the reference implements in C++: rendezvous KV store, shared
+// memory batch transport for the DataLoader, a chrome-trace event collector,
+// and a hang watchdog. Exposed via a C ABI consumed from Python with ctypes.
+#pragma once
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+#define PT_EXPORT extern "C" __attribute__((visibility("default")))
+
+namespace ptnative {
+
+inline int64_t now_us() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<int64_t>(ts.tv_sec) * 1000000 + ts.tv_nsec / 1000;
+}
+
+}  // namespace ptnative

@@ -1,0 +1,442 @@
+// TCPStore: rank-rendezvous key/value store over TCP sockets.
+//
+// Functional equivalent of the reference's TCPStore
+// (paddle/phi/core/distributed/store/tcp_store.h:121, socket.cpp): a master
+// daemon owns an in-memory map; clients connect and issue SET/GET/ADD/WAIT/
+// CHECK/DELETE. WAIT and WAIT_GE block server-side on a condition variable, so
+// barriers need no client polling. Thread-per-connection — rendezvous traffic
+// is tiny (tens of clients, few hundred ops per job).
+//
+// Wire format (little-endian):
+//   request:  u8 cmd | u32 klen | key | u32 vlen | val | i64 arg
+//   response: u8 status | u32 len | payload | i64 num
+// status: 0 ok, 1 not-found, 2 timeout, 3 error.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdio>
+#include <map>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+#include "common.h"
+
+namespace ptnative {
+namespace {
+
+enum Cmd : uint8_t {
+  kSet = 1,
+  kGet = 2,
+  kAdd = 3,
+  kCheck = 4,
+  kDelete = 5,
+  kWait = 6,     // block until key exists; arg = timeout ms (<0 = forever)
+  kNumKeys = 7,
+  kPing = 8,
+  kWaitGe = 9,   // block until int64-decoded value >= arg (timeout via i64 in val)
+  kCompareSet = 10,  // val = expected \x00 desired; sets iff current == expected
+};
+
+enum Status : uint8_t { kOk = 0, kNotFound = 1, kTimeout = 2, kError = 3 };
+
+bool read_full(int fd, void* buf, size_t n) {
+  auto* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  auto* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool read_blob(int fd, std::string* out) {
+  uint32_t len;
+  if (!read_full(fd, &len, 4)) return false;
+  if (len > (256u << 20)) return false;  // 256 MB sanity cap
+  out->resize(len);
+  return len == 0 || read_full(fd, &(*out)[0], len);
+}
+
+bool write_resp(int fd, uint8_t status, const std::string& payload, int64_t num) {
+  uint32_t len = static_cast<uint32_t>(payload.size());
+  std::string buf;
+  buf.reserve(13 + payload.size());
+  buf.push_back(static_cast<char>(status));
+  buf.append(reinterpret_cast<char*>(&len), 4);
+  buf.append(payload);
+  buf.append(reinterpret_cast<char*>(&num), 8);
+  return write_full(fd, buf.data(), buf.size());
+}
+
+int64_t decode_i64(const std::string& v) {
+  if (v.size() == 8) {
+    int64_t x;
+    std::memcpy(&x, v.data(), 8);
+    return x;
+  }
+  // Also accept ASCII ints (reference stores counters as strings).
+  try {
+    return std::stoll(v);
+  } catch (...) {
+    return 0;
+  }
+}
+
+std::string encode_i64(int64_t x) {
+  return std::string(reinterpret_cast<char*>(&x), 8);
+}
+
+class MasterDaemon {
+ public:
+  explicit MasterDaemon(int port) {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+        ::listen(listen_fd_, 128) != 0) {
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+      return;
+    }
+    socklen_t alen = sizeof(addr);
+    ::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &alen);
+    port_ = ntohs(addr.sin_port);
+    accept_thread_ = std::thread([this] { AcceptLoop(); });
+  }
+
+  ~MasterDaemon() { Stop(); }
+
+  int port() const { return port_; }
+  bool ok() const { return listen_fd_ >= 0; }
+
+  void Stop() {
+    bool expected = false;
+    if (!stopped_.compare_exchange_strong(expected, true)) return;
+    ::shutdown(listen_fd_, SHUT_RDWR);
+    ::close(listen_fd_);
+    cv_.notify_all();
+    if (accept_thread_.joinable()) accept_thread_.join();
+    std::vector<std::thread> workers;
+    {
+      std::lock_guard<std::mutex> lk(workers_mu_);
+      workers.swap(workers_);
+    }
+    for (auto& t : workers)
+      if (t.joinable()) t.join();
+  }
+
+ private:
+  void AcceptLoop() {
+    while (!stopped_) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) break;
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::lock_guard<std::mutex> lk(workers_mu_);
+      workers_.emplace_back([this, fd] { Serve(fd); });
+    }
+  }
+
+  void Serve(int fd) {
+    while (!stopped_) {
+      uint8_t cmd;
+      if (!read_full(fd, &cmd, 1)) break;
+      std::string key, val;
+      int64_t arg;
+      if (!read_blob(fd, &key) || !read_blob(fd, &val) || !read_full(fd, &arg, 8)) break;
+      if (!Dispatch(fd, cmd, key, val, arg)) break;
+    }
+    ::close(fd);
+  }
+
+  bool Dispatch(int fd, uint8_t cmd, const std::string& key, const std::string& val,
+                int64_t arg) {
+    std::unique_lock<std::mutex> lk(mu_);
+    switch (cmd) {
+      case kSet:
+        data_[key] = val;
+        cv_.notify_all();
+        return Unlocked(&lk), write_resp(fd, kOk, "", 0);
+      case kGet: {
+        auto it = data_.find(key);
+        if (it == data_.end()) return Unlocked(&lk), write_resp(fd, kNotFound, "", 0);
+        std::string v = it->second;
+        return Unlocked(&lk), write_resp(fd, kOk, v, 0);
+      }
+      case kAdd: {
+        int64_t cur = 0;
+        auto it = data_.find(key);
+        if (it != data_.end()) cur = decode_i64(it->second);
+        cur += arg;
+        data_[key] = encode_i64(cur);
+        cv_.notify_all();
+        return Unlocked(&lk), write_resp(fd, kOk, "", cur);
+      }
+      case kCheck:
+        return Unlocked(&lk), write_resp(fd, kOk, "", data_.count(key) ? 1 : 0);
+      case kDelete: {
+        int64_t n = static_cast<int64_t>(data_.erase(key));
+        return Unlocked(&lk), write_resp(fd, kOk, "", n);
+      }
+      case kWait: {
+        if (!WaitFor(lk, arg, [&] { return data_.count(key) > 0; }))
+          return Unlocked(&lk), write_resp(fd, kTimeout, "", 0);
+        return Unlocked(&lk), write_resp(fd, kOk, "", 0);
+      }
+      case kWaitGe: {
+        int64_t timeout_ms = val.empty() ? -1 : decode_i64(val);
+        auto pred = [&] {
+          auto it = data_.find(key);
+          return it != data_.end() && decode_i64(it->second) >= arg;
+        };
+        if (!WaitFor(lk, timeout_ms, pred))
+          return Unlocked(&lk), write_resp(fd, kTimeout, "", 0);
+        int64_t cur = decode_i64(data_[key]);
+        return Unlocked(&lk), write_resp(fd, kOk, "", cur);
+      }
+      case kNumKeys:
+        return Unlocked(&lk), write_resp(fd, kOk, "", static_cast<int64_t>(data_.size()));
+      case kPing:
+        return Unlocked(&lk), write_resp(fd, kOk, "", arg);
+      case kCompareSet: {
+        size_t sep = val.find('\0');
+        std::string expected = sep == std::string::npos ? val : val.substr(0, sep);
+        std::string desired = sep == std::string::npos ? "" : val.substr(sep + 1);
+        auto it = data_.find(key);
+        bool matched = (it == data_.end() && expected.empty()) ||
+                       (it != data_.end() && it->second == expected);
+        if (matched) {
+          data_[key] = desired;
+          cv_.notify_all();
+        }
+        std::string cur = data_.count(key) ? data_[key] : "";
+        return Unlocked(&lk), write_resp(fd, matched ? kOk : kError, cur, matched);
+      }
+      default:
+        return Unlocked(&lk), write_resp(fd, kError, "", 0);
+    }
+  }
+
+  template <typename Pred>
+  bool WaitFor(std::unique_lock<std::mutex>& lk, int64_t timeout_ms, Pred pred) {
+    if (timeout_ms < 0) {
+      cv_.wait(lk, [&] { return stopped_ || pred(); });
+      return pred();
+    }
+    return cv_.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                        [&] { return stopped_ || pred(); }) &&
+           pred();
+  }
+
+  // Release the map lock before socket IO so a slow client can't block the store.
+  static void Unlocked(std::unique_lock<std::mutex>* lk) { lk->unlock(); }
+
+  int listen_fd_ = -1;
+  int port_ = -1;
+  std::atomic<bool> stopped_{false};
+  std::thread accept_thread_;
+  std::mutex workers_mu_;
+  std::vector<std::thread> workers_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::map<std::string, std::string> data_;
+};
+
+class Client {
+ public:
+  Client(const char* host, int port, int timeout_ms) {
+    int64_t deadline = now_us() + static_cast<int64_t>(timeout_ms) * 1000;
+    // Retry connect until the daemon is up (ranks race the master at bootstrap).
+    while (fd_ < 0) {
+      fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+      sockaddr_in addr{};
+      addr.sin_family = AF_INET;
+      addr.sin_port = htons(static_cast<uint16_t>(port));
+      if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+        ::close(fd_);
+        fd_ = -1;
+        return;  // caller resolves hostnames to IPs in Python
+      }
+      if (::connect(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) break;
+      ::close(fd_);
+      fd_ = -1;
+      if (now_us() > deadline) return;
+      ::usleep(50 * 1000);
+    }
+    int one = 1;
+    ::setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  }
+
+  ~Client() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+
+  bool ok() const { return fd_ >= 0; }
+
+  bool Request(uint8_t cmd, const std::string& key, const std::string& val, int64_t arg,
+               uint8_t* status, std::string* payload, int64_t* num) {
+    std::lock_guard<std::mutex> lk(mu_);
+    uint32_t klen = static_cast<uint32_t>(key.size());
+    uint32_t vlen = static_cast<uint32_t>(val.size());
+    std::string buf;
+    buf.reserve(17 + key.size() + val.size());
+    buf.push_back(static_cast<char>(cmd));
+    buf.append(reinterpret_cast<char*>(&klen), 4);
+    buf.append(key);
+    buf.append(reinterpret_cast<char*>(&vlen), 4);
+    buf.append(val);
+    buf.append(reinterpret_cast<char*>(&arg), 8);
+    if (!write_full(fd_, buf.data(), buf.size())) return false;
+    if (!read_full(fd_, status, 1)) return false;
+    if (!read_blob(fd_, payload)) return false;
+    return read_full(fd_, num, 8);
+  }
+
+ private:
+  int fd_ = -1;
+  std::mutex mu_;
+};
+
+}  // namespace
+}  // namespace ptnative
+
+using ptnative::Client;
+using ptnative::MasterDaemon;
+
+PT_EXPORT void* pt_store_master_start(int port) {
+  auto* d = new MasterDaemon(port);
+  if (!d->ok()) {
+    delete d;
+    return nullptr;
+  }
+  return d;
+}
+
+PT_EXPORT int pt_store_master_port(void* d) {
+  return static_cast<MasterDaemon*>(d)->port();
+}
+
+PT_EXPORT void pt_store_master_stop(void* d) {
+  auto* m = static_cast<MasterDaemon*>(d);
+  m->Stop();
+  delete m;
+}
+
+PT_EXPORT void* pt_store_client_new(const char* host, int port, int timeout_ms) {
+  auto* c = new Client(host, port, timeout_ms);
+  if (!c->ok()) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+
+PT_EXPORT void pt_store_client_free(void* c) { delete static_cast<Client*>(c); }
+
+PT_EXPORT void pt_free(void* p) { std::free(p); }
+
+namespace {
+// -1 io error, else server status (0 ok / 1 notfound / 2 timeout / 3 error).
+int do_req(void* c, uint8_t cmd, const char* key, const uint8_t* val, int vlen,
+           int64_t arg, uint8_t** out, int* out_len, int64_t* num) {
+  uint8_t status;
+  std::string payload;
+  int64_t n = 0;
+  std::string v(reinterpret_cast<const char*>(val), val ? vlen : 0);
+  if (!static_cast<Client*>(c)->Request(cmd, key ? key : "", v, arg, &status, &payload, &n))
+    return -1;
+  if (out) {
+    *out = static_cast<uint8_t*>(std::malloc(payload.size() ? payload.size() : 1));
+    std::memcpy(*out, payload.data(), payload.size());
+    *out_len = static_cast<int>(payload.size());
+  }
+  if (num) *num = n;
+  return status;
+}
+}  // namespace
+
+PT_EXPORT int pt_store_set(void* c, const char* key, const uint8_t* val, int len) {
+  return do_req(c, ptnative::kSet, key, val, len, 0, nullptr, nullptr, nullptr);
+}
+
+PT_EXPORT int pt_store_get(void* c, const char* key, uint8_t** out, int* out_len) {
+  return do_req(c, ptnative::kGet, key, nullptr, 0, 0, out, out_len, nullptr);
+}
+
+PT_EXPORT long long pt_store_add(void* c, const char* key, long long delta) {
+  int64_t num = 0;
+  int st = do_req(c, ptnative::kAdd, key, nullptr, 0, delta, nullptr, nullptr, &num);
+  return st == 0 ? num : -1;
+}
+
+PT_EXPORT int pt_store_check(void* c, const char* key) {
+  int64_t num = 0;
+  int st = do_req(c, ptnative::kCheck, key, nullptr, 0, 0, nullptr, nullptr, &num);
+  return st == 0 ? static_cast<int>(num) : -1;
+}
+
+PT_EXPORT int pt_store_delete(void* c, const char* key) {
+  int64_t num = 0;
+  int st = do_req(c, ptnative::kDelete, key, nullptr, 0, 0, nullptr, nullptr, &num);
+  return st == 0 ? static_cast<int>(num) : -1;
+}
+
+PT_EXPORT int pt_store_wait(void* c, const char* key, long long timeout_ms) {
+  return do_req(c, ptnative::kWait, key, nullptr, 0, timeout_ms, nullptr, nullptr, nullptr);
+}
+
+// Blocks until int64(value[key]) >= target; returns current value or -1/-2.
+PT_EXPORT long long pt_store_wait_ge(void* c, const char* key, long long target,
+                                     long long timeout_ms) {
+  int64_t num = 0;
+  std::string t = ptnative::encode_i64(timeout_ms);
+  int st = do_req(c, ptnative::kWaitGe, key,
+                  reinterpret_cast<const uint8_t*>(t.data()), 8, target, nullptr,
+                  nullptr, &num);
+  if (st == 0) return num;
+  return st == ptnative::kTimeout ? -2 : -1;
+}
+
+PT_EXPORT long long pt_store_num_keys(void* c) {
+  int64_t num = 0;
+  int st = do_req(c, ptnative::kNumKeys, "", nullptr, 0, 0, nullptr, nullptr, &num);
+  return st == 0 ? num : -1;
+}
+
+PT_EXPORT int pt_store_compare_set(void* c, const char* key, const uint8_t* expected,
+                                   int elen, const uint8_t* desired, int dlen,
+                                   uint8_t** cur, int* cur_len) {
+  std::string v(reinterpret_cast<const char*>(expected), elen);
+  v.push_back('\0');
+  v.append(reinterpret_cast<const char*>(desired), dlen);
+  int64_t num = 0;
+  int st = do_req(c, ptnative::kCompareSet, key,
+                  reinterpret_cast<const uint8_t*>(v.data()),
+                  static_cast<int>(v.size()), 0, cur, cur_len, &num);
+  if (st < 0) return -1;
+  return static_cast<int>(num);  // 1 = swapped, 0 = mismatch
+}

@@ -1,0 +1,146 @@
+// Host-side profiler event collector with chrome://tracing JSON export.
+//
+// Native equivalent of the reference's HostTracer + ChromeTracingLogger
+// (paddle/fluid/platform/profiler/host_tracer.cc, chrometracing_logger.cc).
+// Device-side tracing on TPU is XLA/XPlane via jax.profiler; this collector
+// records host op scopes (RecordEvent), instants, and counters with
+// near-zero overhead (per-thread buffers, lock only on registration/flush).
+
+#include <sys/syscall.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdio>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "common.h"
+
+namespace ptnative {
+namespace {
+
+struct Event {
+  std::string name;
+  char ph;  // 'X' complete, 'i' instant, 'C' counter
+  int64_t ts_us;
+  int64_t dur_us;
+  double value;
+  int tid;
+};
+
+struct ThreadBuf {
+  std::vector<Event> events;
+  std::vector<std::pair<std::string, int64_t>> open;  // begin() stack
+  int tid;
+};
+
+std::mutex g_mu;
+std::vector<ThreadBuf*> g_bufs;
+std::atomic<bool> g_enabled{false};
+std::atomic<int64_t> g_generation{0};  // bumps on every start; stale scopes skip end
+int64_t g_epoch_us = 0;
+
+ThreadBuf* tls() {
+  thread_local ThreadBuf* buf = [] {
+    auto* b = new ThreadBuf();
+    b->tid = static_cast<int>(::syscall(SYS_gettid));
+    std::lock_guard<std::mutex> lk(g_mu);
+    g_bufs.push_back(b);
+    return b;
+  }();
+  return buf;
+}
+
+void json_escape(FILE* f, const std::string& s) {
+  for (char c : s) {
+    if (c == '"' || c == '\\')
+      std::fprintf(f, "\\%c", c);
+    else if (static_cast<unsigned char>(c) < 0x20)
+      std::fprintf(f, "\\u%04x", c);
+    else
+      std::fputc(c, f);
+  }
+}
+
+}  // namespace
+}  // namespace ptnative
+
+using namespace ptnative;
+
+PT_EXPORT void pt_trace_start() {
+  std::lock_guard<std::mutex> lk(g_mu);
+  for (auto* b : g_bufs) {
+    b->events.clear();
+    b->open.clear();
+  }
+  g_epoch_us = now_us();
+  g_generation.fetch_add(1);
+  g_enabled = true;
+}
+
+PT_EXPORT void pt_trace_stop() { g_enabled = false; }
+
+PT_EXPORT int pt_trace_enabled() { return g_enabled ? 1 : 0; }
+
+PT_EXPORT long long pt_trace_generation() { return g_generation.load(); }
+
+PT_EXPORT void pt_trace_begin(const char* name) {
+  if (!g_enabled) return;
+  tls()->open.emplace_back(name, now_us());
+}
+
+PT_EXPORT void pt_trace_end() {
+  if (!g_enabled) return;
+  auto* b = tls();
+  if (b->open.empty()) return;
+  auto [name, t0] = std::move(b->open.back());
+  b->open.pop_back();
+  b->events.push_back({std::move(name), 'X', t0 - g_epoch_us, now_us() - t0, 0.0, b->tid});
+}
+
+PT_EXPORT void pt_trace_instant(const char* name) {
+  if (!g_enabled) return;
+  auto* b = tls();
+  b->events.push_back({name, 'i', now_us() - g_epoch_us, 0, 0.0, b->tid});
+}
+
+PT_EXPORT void pt_trace_counter(const char* name, double value) {
+  if (!g_enabled) return;
+  auto* b = tls();
+  b->events.push_back({name, 'C', now_us() - g_epoch_us, 0, value, b->tid});
+}
+
+PT_EXPORT long long pt_trace_event_count() {
+  std::lock_guard<std::mutex> lk(g_mu);
+  long long n = 0;
+  for (auto* b : g_bufs) n += static_cast<long long>(b->events.size());
+  return n;
+}
+
+PT_EXPORT int pt_trace_dump(const char* path, const char* process_name) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  FILE* f = std::fopen(path, "w");
+  if (!f) return -1;
+  std::fprintf(f, "{\"traceEvents\":[\n");
+  std::fprintf(f,
+               "{\"ph\":\"M\",\"pid\":%d,\"name\":\"process_name\",\"args\":{\"name\":\"",
+               ::getpid());
+  json_escape(f, process_name ? process_name : "paddle_tpu");
+  std::fprintf(f, "\"}}");
+  for (auto* b : g_bufs) {
+    for (const auto& e : b->events) {
+      std::fprintf(f, ",\n{\"ph\":\"%c\",\"pid\":%d,\"tid\":%d,\"ts\":%lld,\"name\":\"",
+                   e.ph, ::getpid(), e.tid, static_cast<long long>(e.ts_us));
+      json_escape(f, e.name);
+      std::fprintf(f, "\"");
+      if (e.ph == 'X') std::fprintf(f, ",\"dur\":%lld", static_cast<long long>(e.dur_us));
+      if (e.ph == 'C') std::fprintf(f, ",\"args\":{\"value\":%g}", e.value);
+      if (e.ph == 'i') std::fprintf(f, ",\"s\":\"t\"");
+      std::fprintf(f, "}");
+    }
+  }
+  std::fprintf(f, "\n]}\n");
+  std::fclose(f);
+  return 0;
+}

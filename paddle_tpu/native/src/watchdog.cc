@@ -1,0 +1,147 @@
+// Hang watchdog: background monitor for collective/compute tasks.
+//
+// Native equivalent of the reference's comm watchdog
+// (paddle/phi/core/distributed/comm_task_manager.h:37, comm_task.h:127
+// CommTask::IsTimeout + trace dump on timeout). On TPU there are no NCCL
+// streams to poll; instead the framework registers a task around each blocking
+// region (collective barrier, device_get fence, pipeline step) and the monitor
+// thread reports tasks that outlive their deadline to a report file and an
+// atomic counter Python can poll. PT_WATCHDOG_FATAL=1 aborts the process on
+// timeout (matching FLAGS_enable_async_trace_wait hard-failure behavior).
+
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdio>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+
+#include "common.h"
+
+namespace ptnative {
+namespace {
+
+struct Task {
+  std::string name;
+  int64_t start_us;
+  int64_t deadline_us;  // <0: no timeout
+  bool reported;
+};
+
+class Watchdog {
+ public:
+  Watchdog(int64_t interval_ms, const std::string& report_path)
+      : interval_ms_(interval_ms), report_path_(report_path) {
+    thread_ = std::thread([this] { Loop(); });
+  }
+
+  ~Watchdog() { Stop(); }
+
+  void Stop() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (stopped_) return;
+      stopped_ = true;
+    }
+    cv_.notify_all();
+    if (thread_.joinable()) thread_.join();
+  }
+
+  int64_t Begin(const char* name, int64_t timeout_ms) {
+    std::lock_guard<std::mutex> lk(mu_);
+    int64_t id = next_id_++;
+    int64_t now = now_us();
+    tasks_[id] = {name, now, timeout_ms < 0 ? -1 : now + timeout_ms * 1000, false};
+    return id;
+  }
+
+  void End(int64_t id) {
+    std::lock_guard<std::mutex> lk(mu_);
+    tasks_.erase(id);
+  }
+
+  int64_t TimeoutCount() { return timeout_count_.load(); }
+
+  int64_t ActiveCount() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return static_cast<int64_t>(tasks_.size());
+  }
+
+ private:
+  void Loop() {
+    std::unique_lock<std::mutex> lk(mu_);
+    while (!stopped_) {
+      cv_.wait_for(lk, std::chrono::milliseconds(interval_ms_),
+                   [this] { return stopped_; });
+      if (stopped_) break;
+      int64_t now = now_us();
+      for (auto& [id, t] : tasks_) {
+        if (t.reported || t.deadline_us < 0 || now < t.deadline_us) continue;
+        t.reported = true;
+        timeout_count_.fetch_add(1);
+        Report(t, now);
+        if (const char* fatal = ::getenv("PT_WATCHDOG_FATAL");
+            fatal && fatal[0] == '1') {
+          std::fprintf(stderr, "[paddle_tpu watchdog] FATAL: task '%s' timed out\n",
+                       t.name.c_str());
+          std::abort();
+        }
+      }
+    }
+  }
+
+  void Report(const Task& t, int64_t now) {
+    FILE* f = std::fopen(report_path_.c_str(), "a");
+    if (!f) return;
+    std::fprintf(f,
+                 "{\"event\":\"watchdog_timeout\",\"task\":\"%s\",\"pid\":%d,"
+                 "\"elapsed_ms\":%lld,\"active_tasks\":%zu}\n",
+                 t.name.c_str(), ::getpid(),
+                 static_cast<long long>((now - t.start_us) / 1000), tasks_.size());
+    std::fclose(f);
+  }
+
+  int64_t interval_ms_;
+  std::string report_path_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::map<int64_t, Task> tasks_;
+  int64_t next_id_ = 1;
+  std::atomic<int64_t> timeout_count_{0};
+  bool stopped_ = false;
+  std::thread thread_;
+};
+
+}  // namespace
+}  // namespace ptnative
+
+using ptnative::Watchdog;
+
+PT_EXPORT void* pt_watchdog_start(long long interval_ms, const char* report_path) {
+  return new Watchdog(interval_ms, report_path ? report_path : "/dev/null");
+}
+
+PT_EXPORT void pt_watchdog_stop(void* w) {
+  auto* wd = static_cast<Watchdog*>(w);
+  wd->Stop();
+  delete wd;
+}
+
+PT_EXPORT long long pt_watchdog_begin(void* w, const char* name, long long timeout_ms) {
+  return static_cast<Watchdog*>(w)->Begin(name, timeout_ms);
+}
+
+PT_EXPORT void pt_watchdog_end(void* w, long long id) {
+  static_cast<Watchdog*>(w)->End(id);
+}
+
+PT_EXPORT long long pt_watchdog_timeout_count(void* w) {
+  return static_cast<Watchdog*>(w)->TimeoutCount();
+}
+
+PT_EXPORT long long pt_watchdog_active_count(void* w) {
+  return static_cast<Watchdog*>(w)->ActiveCount();
+}

@@ -4,6 +4,10 @@ TPU-native: the device-side tracer is XLA/XPlane via ``jax.profiler`` (TensorBoa
 compatible, replaces the reference's CUPTI CudaTracer); host-side op scopes use
 ``jax.profiler.TraceAnnotation`` (the RecordEvent analogue — reference
 profiler/utils.py:47) plus a lightweight wall-clock event tree for the summary table.
+The host tracer itself is native: a C++ per-thread event collector with
+chrome://tracing export (paddle_tpu/native/src/trace.cc — the HostTracer +
+ChromeTracingLogger equivalent, reference chrometracing_logger.cc), used
+whenever the native library is available.
 """
 
 from __future__ import annotations
@@ -15,6 +19,8 @@ from enum import Enum
 from typing import Optional
 
 import jax
+
+from .. import native as _native
 
 
 class ProfilerState(Enum):
@@ -60,6 +66,14 @@ def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
     return handler
 
 
+def export_host_chrome_trace(path: str, process_name: str = "paddle_tpu") -> bool:
+    """Dump the native host-tracer events as a chrome://tracing JSON file."""
+    lib = _native.load()
+    if lib is None:
+        return False
+    return lib.pt_trace_dump(path.encode(), process_name.encode()) == 0
+
+
 export_protobuf = export_chrome_tracing
 
 
@@ -79,12 +93,25 @@ class RecordEvent:
         self._ann = jax.profiler.TraceAnnotation(self.name)
         self._ann.__enter__()
         _host_events.start(self.name, self.begin_ts)
+        lib = _native.peek()  # never builds; Profiler.start() does the load
+        if lib is not None and lib.pt_trace_enabled():
+            lib.pt_trace_begin(self.name.encode())
+            self._native_gen = lib.pt_trace_generation()
 
     def end(self):
         if self._ann is not None:
             self._ann.__exit__(None, None, None)
             _host_events.stop(self.name, time.perf_counter())
             self._ann = None
+            gen = getattr(self, "_native_gen", None)
+            if gen is not None:
+                lib = _native.peek()
+                # Skip the pop if tracing restarted mid-scope — the begin-stack
+                # was cleared and popping would close someone else's scope.
+                if lib is not None and lib.pt_trace_enabled() and \
+                        lib.pt_trace_generation() == gen:
+                    lib.pt_trace_end()
+                self._native_gen = None
 
     def __enter__(self):
         self.begin()
@@ -144,9 +171,8 @@ class Profiler:
         self._last_step_ts = time.perf_counter()
 
     def stop(self):
-        if self._tracing:
-            jax.profiler.stop_trace()
-            self._tracing = False
+        self._state = ProfilerState.CLOSED
+        self._maybe_toggle()
         if self._on_trace_ready:
             self._on_trace_ready(self)
 
@@ -168,12 +194,23 @@ class Profiler:
 
             self._trace_dir = self._trace_dir or tempfile.mkdtemp(prefix="paddle_tpu_prof_")
             jax.profiler.start_trace(self._trace_dir)
+            lib = _native.load()
+            if lib is not None:
+                lib.pt_trace_start()
             self._tracing = True
         elif not should_trace and self._tracing:
             jax.profiler.stop_trace()
+            lib = _native.load()
+            if lib is not None:
+                lib.pt_trace_stop()
             self._tracing = False
 
     def export(self, path=None, format="json"):
+        if path and format == "json":
+            import os
+
+            os.makedirs(path, exist_ok=True)
+            export_host_chrome_trace(os.path.join(path, "host_trace.json"))
         return self._trace_dir
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False, time_unit="ms"):

@@ -1,0 +1,262 @@
+"""Tests for the native C++ runtime layer (paddle_tpu/native).
+
+Covers: TCPStore rendezvous (single + multi-process + pure-Python fallback),
+shared-memory ring channel (roundtrip, multiprocess, DataLoader integration),
+host trace collector (chrome JSON), and the hang watchdog.
+"""
+
+import json
+import multiprocessing as mp
+import os
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu import native
+from paddle_tpu.distributed.communication.store import TCPStore
+from paddle_tpu.distributed.communication.watchdog import CommTaskManager
+
+
+def test_native_builds():
+    assert native.available(), f"native build failed: {native.load_error()}"
+
+
+# ---------------------------------------------------------------------------
+# TCPStore
+# ---------------------------------------------------------------------------
+
+def test_store_basic_ops():
+    store = TCPStore("127.0.0.1", 0, is_master=True, world_size=1, timeout=10)
+    try:
+        store.set("alpha", b"beta")
+        assert store.get("alpha") == b"beta"
+        assert store.check("alpha")
+        assert not store.check("missing")
+        assert store.add("cnt", 5) == 5
+        assert store.add("cnt", -2) == 3
+        assert store.wait_ge("cnt", 3, timeout=2) == 3
+        assert store.num_keys() == 2
+        assert store.delete_key("alpha")
+        assert not store.check("alpha")
+        assert store.get("gone", wait=False) is None
+    finally:
+        store.close()
+
+
+def test_store_wait_timeout():
+    store = TCPStore("127.0.0.1", 0, is_master=True, world_size=1, timeout=0.3)
+    try:
+        assert not store.wait(["nope"], timeout=0.2)
+    finally:
+        store.close()
+
+
+def test_store_compare_set():
+    store = TCPStore("127.0.0.1", 0, is_master=True, world_size=1, timeout=10)
+    try:
+        assert store.compare_set("lock", b"", b"rank0")      # empty-expected: create
+        assert not store.compare_set("lock", b"rank1", b"x")  # wrong expected
+        assert store.compare_set("lock", b"rank0", b"rank1")
+        assert store.get("lock") == b"rank1"
+    finally:
+        store.close()
+
+
+def _store_worker(port, rank, world, q):
+    try:
+        s = TCPStore("127.0.0.1", port, is_master=False, world_size=world, timeout=20)
+        s.set(f"rank{rank}", str(rank).encode())
+        s.barrier("b1", world_size=world, timeout=20)
+        vals = [int(s.get(f"rank{r}")) for r in range(world)]
+        q.put((rank, vals))
+        s.close()
+    except Exception as e:  # pragma: no cover
+        q.put((rank, repr(e)))
+
+
+def test_store_multiprocess_barrier():
+    world = 3
+    master = TCPStore("127.0.0.1", 0, is_master=True, world_size=world, timeout=20)
+    q = mp.get_context("fork").Queue()
+    procs = [mp.get_context("fork").Process(
+        target=_store_worker, args=(master.port, r, world, q)) for r in range(world)]
+    for p in procs:
+        p.start()
+    results = [q.get(timeout=30) for _ in range(world)]
+    for p in procs:
+        p.join(timeout=10)
+    master.close()
+    for _, vals in results:
+        assert vals == [0, 1, 2]
+
+
+def test_store_python_fallback(monkeypatch):
+    monkeypatch.setenv("PT_DISABLE_NATIVE", "1")
+    # force re-evaluation of the disable flag in a fresh state
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_lib_err", None)
+    assert not native.available()
+    store = TCPStore("127.0.0.1", 0, is_master=True, world_size=1, timeout=10)
+    try:
+        store.set("k", b"v")
+        assert store.get("k") == b"v"
+        assert store.add("n", 7) == 7
+        assert store.wait_ge("n", 7, timeout=2) == 7
+        assert store.compare_set("k", b"v", b"w")
+        assert store.get("k") == b"w"
+    finally:
+        store.close()
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_lib_err", None)
+
+
+# ---------------------------------------------------------------------------
+# Shared-memory channel
+# ---------------------------------------------------------------------------
+
+def test_shm_channel_roundtrip():
+    from paddle_tpu.io.shm_channel import ShmChannel
+
+    ch = ShmChannel(f"/pt_test_{os.getpid()}", capacity=1 << 20, create=True)
+    try:
+        batch = (np.arange(12, dtype=np.float32).reshape(3, 4),
+                 {"ids": np.array([1, 2, 3], dtype=np.int64), "meta": "hello"},
+                 [np.float64(2.5), 7])
+        ch.put((0, batch, None))
+        idx, out, err = ch.get(timeout=2)
+        assert idx == 0 and err is None
+        np.testing.assert_array_equal(out[0], batch[0])
+        np.testing.assert_array_equal(out[1]["ids"], batch[1]["ids"])
+        assert out[1]["meta"] == "hello"
+        assert out[2][0] == 2.5 and out[2][1] == 7
+    finally:
+        ch.close()
+
+
+def test_shm_channel_oversize_raises():
+    from paddle_tpu.io.shm_channel import ShmChannel
+
+    ch = ShmChannel(f"/pt_big_{os.getpid()}", capacity=4096, create=True)
+    try:
+        with pytest.raises(ValueError):
+            ch.put(np.zeros(8192, dtype=np.float32))
+    finally:
+        ch.close()
+
+
+def _shm_producer(name, n):
+    from paddle_tpu.io.shm_channel import ShmChannel
+
+    ch = ShmChannel(name, create=False)
+    for i in range(n):
+        ch.put((i, np.full((64,), i, dtype=np.int32)))
+    ch.detach()
+
+
+def test_shm_channel_multiprocess():
+    from paddle_tpu.io.shm_channel import ShmChannel
+
+    name = f"/pt_mp_{os.getpid()}"
+    ch = ShmChannel(name, capacity=1 << 20, create=True)
+    try:
+        p = mp.get_context("fork").Process(target=_shm_producer, args=(name, 10))
+        p.start()
+        got = sorted(ch.get(timeout=10)[0] for _ in range(10))
+        p.join(timeout=10)
+        assert got == list(range(10))
+    finally:
+        ch.close()
+
+
+class _SqDataset:
+    def __len__(self):
+        return 32
+
+    def __getitem__(self, i):
+        return np.full((8,), i, dtype=np.float32), np.int64(i)
+
+
+def test_dataloader_shm_transport():
+    from paddle_tpu.io import DataLoader
+
+    dl = DataLoader(_SqDataset(), batch_size=4, num_workers=2, shuffle=False,
+                    use_shared_memory=True)
+    seen = []
+    for x, y in dl:
+        assert tuple(x.shape) == (4, 8)
+        seen.extend(np.asarray(y._data).tolist())
+    assert sorted(seen) == list(range(32))
+
+
+# ---------------------------------------------------------------------------
+# Trace collector
+# ---------------------------------------------------------------------------
+
+def test_trace_chrome_dump(tmp_path):
+    lib = native.load()
+    assert lib is not None
+    lib.pt_trace_start()
+    lib.pt_trace_begin(b"outer")
+    lib.pt_trace_begin(b"inner")
+    time.sleep(0.002)
+    lib.pt_trace_end()
+    lib.pt_trace_end()
+    lib.pt_trace_counter(b"loss", 1.25)
+    lib.pt_trace_instant(b"checkpoint")
+    lib.pt_trace_stop()
+    path = str(tmp_path / "trace.json")
+    assert lib.pt_trace_dump(path.encode(), b"utest") == 0
+    doc = json.load(open(path))
+    names = [e.get("name") for e in doc["traceEvents"]]
+    assert "outer" in names and "inner" in names and "loss" in names
+    complete = [e for e in doc["traceEvents"] if e.get("ph") == "X" and e["name"] == "inner"]
+    assert complete and complete[0]["dur"] >= 1000  # >= 1ms in us
+
+
+def test_record_event_feeds_native_trace(tmp_path):
+    import paddle_tpu.profiler as prof
+
+    lib = native.load()
+    lib.pt_trace_start()
+    with prof.RecordEvent("scope.test"):
+        time.sleep(0.001)
+    lib.pt_trace_stop()
+    path = str(tmp_path / "host.json")
+    assert prof.export_host_chrome_trace(path)
+    names = [e.get("name") for e in json.load(open(path))["traceEvents"]]
+    assert "scope.test" in names
+
+
+# ---------------------------------------------------------------------------
+# Watchdog
+# ---------------------------------------------------------------------------
+
+def test_watchdog_detects_timeout(tmp_path):
+    report = str(tmp_path / "wd.jsonl")
+    mgr = CommTaskManager(interval_ms=20, report_path=report, default_timeout=0.05)
+    try:
+        with mgr.task("slow_collective"):
+            time.sleep(0.3)
+        deadline = time.time() + 2
+        while mgr.timeout_count == 0 and time.time() < deadline:
+            time.sleep(0.02)
+        assert mgr.timeout_count >= 1
+        rec = json.loads(open(report).read().splitlines()[0])
+        assert rec["task"] == "slow_collective"
+        assert rec["event"] == "watchdog_timeout"
+    finally:
+        mgr.shutdown()
+
+
+def test_watchdog_no_false_positive(tmp_path):
+    mgr = CommTaskManager(interval_ms=20, report_path=str(tmp_path / "wd2.jsonl"),
+                          default_timeout=10.0)
+    try:
+        with mgr.task("fast_op"):
+            pass
+        time.sleep(0.1)
+        assert mgr.timeout_count == 0
+        assert mgr.active_count == 0
+    finally:
+        mgr.shutdown()
