@@ -1,0 +1,7 @@
+"""Fleet elastic training (reference: python/paddle/distributed/fleet/elastic).
+
+TCPStore-backed instead of etcd (zero extra deps): nodes heartbeat into the
+store with TTL semantics; the manager watches peers and reports scale events.
+"""
+
+from .manager import ElasticManager, ElasticStatus, enable_elastic, launch_elastic  # noqa: F401

@@ -1,0 +1,109 @@
+"""ElasticManager — node liveness + scale events over the TCPStore.
+
+Reference: python/paddle/distributed/fleet/elastic/manager.py:125 — ranks
+register in etcd with TTL leases (manager.py:248-293), watch callbacks detect
+node join/loss, and the job relaunches between min/max nranks (fault tolerance
+= restart from checkpoint). TPU-native: the lease is a heartbeat key
+``elastic/{job}/beat/{node_id}`` holding a wall-clock stamp refreshed by a
+daemon thread; peers whose stamp goes stale past ``ttl`` are dead. No etcd —
+the native TCPStore daemon is the registry.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from enum import Enum
+from typing import List, Optional, Sequence
+
+
+class ElasticStatus(Enum):
+    COMPLETED = "completed"
+    RESTART = "restart"
+    HOLD = "hold"
+    EXIT = "exit"
+    ERROR = "error"
+
+
+class ElasticManager:
+    def __init__(self, store, job_id: str, node_id: str,
+                 expected: Sequence[str], heartbeat_interval: float = 3.0,
+                 ttl: float = 9.0):
+        self.store = store
+        self.job_id = job_id
+        self.node_id = node_id
+        self.expected = list(expected)
+        self.interval = heartbeat_interval
+        self.ttl = ttl
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lease -------------------------------------------------------------
+    def _beat_key(self, node_id: str) -> str:
+        return f"elastic/{self.job_id}/beat/{node_id}"
+
+    def _beat(self) -> None:
+        self.store.set(self._beat_key(self.node_id), repr(time.time()).encode())
+
+    def start(self) -> None:
+        if self.store is None:
+            return
+        self._beat()
+
+        def loop():
+            while not self._stop.wait(self.interval):
+                try:
+                    self._beat()
+                except Exception:
+                    return  # store gone — controller is shutting down
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+    # -- watch -------------------------------------------------------------
+    def alive_peers(self) -> List[str]:
+        if self.store is None:
+            return [self.node_id]
+        now = time.time()
+        alive = []
+        for nid in self.expected:
+            raw = self.store.get(self._beat_key(nid), wait=False)
+            if raw is None:
+                continue
+            try:
+                stamp = float(raw.decode())
+            except ValueError:
+                continue
+            if now - stamp <= self.ttl:
+                alive.append(nid)
+        return alive
+
+    def peers_changed(self) -> bool:
+        """True when a registered peer died (scale-in signal). Scale-out is
+        noticed at the next rendezvous generation, not here."""
+        if self.store is None:
+            return False
+        return len(self.alive_peers()) < len(self.expected)
+
+
+def enable_elastic(args=None, distribute_mode=None) -> bool:
+    """Reference manager.py: elastic is on when a min:max node range is given."""
+    import os
+
+    rng = os.environ.get("PADDLE_ELASTIC_NNODES", "")
+    return ":" in rng
+
+
+def launch_elastic(args, distribute_mode=None):
+    """Entry used by fleet tooling; delegates to the elastic controller."""
+    from ...launch.controllers import CollectiveElasticController, Context, LaunchArgs
+
+    if not isinstance(args, LaunchArgs):
+        raise TypeError("launch_elastic expects LaunchArgs")
+    return CollectiveElasticController(Context(args)).run()

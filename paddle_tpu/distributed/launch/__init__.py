@@ -1,0 +1,19 @@
+"""paddle_tpu.distributed.launch — multi-process / multi-host job launcher.
+
+Reference: python/paddle/distributed/launch (main.py:23, controllers/collective.py:22,
+controllers/master.py:73,186). Usage::
+
+    python -m paddle_tpu.distributed.launch --nproc_per_node 4 train.py --lr 1e-3
+    python -m paddle_tpu.distributed.launch --master 10.0.0.1:6170 --nnodes 2 train.py
+    python -m paddle_tpu.distributed.launch --master 10.0.0.1:6170 --nnodes 2:4 \
+        --elastic_level 1 train.py          # elastic: min 2, max 4 nodes
+
+TPU-native notes: on TPU pods one process per host drives all local chips
+(SPMD), so ``--nproc_per_node`` defaults to 1 there; the rendezvous master is
+the native TCPStore daemon (paddle_tpu/native/src/tcp_store.cc) rather than
+etcd, and workers get the standard env contract (PADDLE_TRAINER_ID /
+PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ENDPOINTS / MASTER_ADDR / MASTER_PORT)
+consumed by ``init_parallel_env`` → ``jax.distributed.initialize``.
+"""
+
+from .main import launch, main  # noqa: F401

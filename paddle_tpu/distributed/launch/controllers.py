@@ -1,0 +1,292 @@
+"""Launch controllers: collective rendezvous + worker lifecycle (+ elastic).
+
+Reference: python/paddle/distributed/launch/controllers/collective.py:22
+(CollectiveController/CollectiveElasticController) and controllers/master.py
+(HTTPMaster/ETCDMaster). The master here is the native TCPStore; rendezvous is
+add/wait_ge on job keys, peer liveness is heartbeat keys scanned by the
+watcher (launch/job/watcher in the reference).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ..communication.store import TCPStore
+
+
+def _local_ip() -> str:
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("8.8.8.8", 53))
+        ip = s.getsockname()[0]
+        s.close()
+        return ip
+    except OSError:
+        return "127.0.0.1"
+
+
+@dataclass
+class LaunchArgs:
+    script: str = ""
+    script_args: List[str] = field(default_factory=list)
+    master: Optional[str] = None          # "host:port" of the rendezvous store
+    nnodes: str = "1"                     # "N" or "min:max" (elastic)
+    nproc_per_node: Optional[int] = None
+    job_id: str = "default"
+    log_dir: str = "log"
+    elastic_level: int = 0                # 0 off, >0 max restarts
+    elastic_timeout: float = 30.0
+    heartbeat_interval: float = 3.0
+    run_module: bool = False              # script is a module (python -m)
+    devices: Optional[str] = None
+
+    @property
+    def min_nodes(self) -> int:
+        return int(self.nnodes.split(":")[0])
+
+    @property
+    def max_nodes(self) -> int:
+        parts = self.nnodes.split(":")
+        return int(parts[-1])
+
+
+class Context:
+    """Runtime view of one launch invocation on this node."""
+
+    def __init__(self, args: LaunchArgs):
+        self.args = args
+        self.node_ip = _local_ip()
+        if args.nproc_per_node is None:
+            # One process drives all local chips on TPU (SPMD); CPU debug runs
+            # honor PADDLE_NPROC_PER_NODE.
+            args.nproc_per_node = int(os.environ.get("PADDLE_NPROC_PER_NODE", "1"))
+        self.node_id = f"{self.node_ip}-{os.getpid()}-{uuid.uuid4().hex[:6]}"
+
+
+class Procs:
+    """Local worker process set with per-rank log files."""
+
+    def __init__(self, log_dir: str):
+        self.procs: List[subprocess.Popen] = []
+        self.log_dir = log_dir
+        os.makedirs(log_dir, exist_ok=True)
+
+    def start(self, cmd: List[str], env: dict, rank: int) -> None:
+        log_path = os.path.join(self.log_dir, f"workerlog.{rank}")
+        out = open(log_path, "ab")
+        p = subprocess.Popen(cmd, env=env, stdout=out if rank != 0 else None,
+                             stderr=subprocess.STDOUT if rank != 0 else None)
+        p._pt_log = log_path  # type: ignore[attr-defined]
+        p._pt_rank = rank  # type: ignore[attr-defined]
+        self.procs.append(p)
+
+    def poll(self) -> Optional[int]:
+        """First non-zero exit code, 0 when all exited cleanly, None if running."""
+        codes = [p.poll() for p in self.procs]
+        for c in codes:
+            if c is not None and c != 0:
+                return c
+        if all(c == 0 for c in codes):
+            return 0
+        return None
+
+    def terminate(self, grace: float = 10.0) -> None:
+        for p in self.procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        deadline = time.time() + grace
+        for p in self.procs:
+            remain = max(0.1, deadline - time.time())
+            try:
+                p.wait(timeout=remain)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        self.procs.clear()
+
+
+class CollectiveController:
+    """Rendezvous all nodes at the master store, launch local workers, watch them.
+
+    Store schema (per generation g):
+      {job}/gen            — int, incremented on every (re)rendezvous
+      {job}/g{g}/nodes     — arrival counter (add)
+      {job}/g{g}/node/{i}  — json {ip, nproc, node_id}
+      {job}/beat/{node_id} — heartbeat wall-clock (elastic only)
+    """
+
+    def __init__(self, ctx: Context):
+        self.ctx = ctx
+        self.args = ctx.args
+        self.store: Optional[TCPStore] = None
+        self.procs = Procs(self.args.log_dir)
+        self.restarts = 0
+
+    # -- rendezvous --------------------------------------------------------
+    def _connect_store(self) -> Optional[TCPStore]:
+        if self.args.master is None:
+            return None
+        host, port = self.args.master.rsplit(":", 1)
+        # The node whose IP matches the master address hosts the daemon; binding
+        # races are resolved by "bind wins, everyone else connects".
+        is_master_host = host in ("127.0.0.1", "localhost", self.ctx.node_ip)
+        store = None
+        if is_master_host:
+            try:
+                store = TCPStore(host, int(port), is_master=True,
+                                 world_size=self.args.min_nodes, timeout=300)
+            except (RuntimeError, OSError):
+                store = None  # someone else bound it first
+        if store is None:
+            store = TCPStore(host, int(port), is_master=False,
+                             world_size=self.args.min_nodes, timeout=300)
+        return store
+
+    def rendezvous(self) -> dict:
+        """Returns the job layout: ranks/endpoints for this generation."""
+        args = self.args
+        if self.store is None:
+            self.store = self._connect_store()
+        if self.store is None:  # single node, no master
+            return {
+                "gen": 0, "node_rank": 0, "nnodes": 1,
+                "nodes": [{"ip": self.ctx.node_ip, "nproc": args.nproc_per_node,
+                           "node_id": self.ctx.node_id}],
+            }
+        job = f"job/{args.job_id}"
+        gen = self.store.add(f"{job}/gen_probe", 0)  # current value
+        seq = self.store.add(f"{job}/g{gen}/nodes", 1) - 1
+        self.store.set(f"{job}/g{gen}/node/{seq}", json.dumps({
+            "ip": self.ctx.node_ip, "nproc": args.nproc_per_node,
+            "node_id": self.ctx.node_id}).encode())
+        self.store.wait_ge(f"{job}/g{gen}/nodes", args.min_nodes,
+                           timeout=self.args.elastic_timeout if args.elastic_level
+                           else 600.0)
+        n = int(self.store.add(f"{job}/g{gen}/nodes", 0))
+        n = min(n, args.max_nodes)
+        nodes = [json.loads(self.store.get(f"{job}/g{gen}/node/{i}"))
+                 for i in range(n)]
+        return {"gen": gen, "node_rank": seq, "nnodes": n, "nodes": nodes}
+
+    # -- workers -----------------------------------------------------------
+    def launch_workers(self, layout: dict) -> None:
+        args = self.args
+        nodes = layout["nodes"]
+        node_rank = layout["node_rank"]
+        world = sum(nd["nproc"] for nd in nodes)
+        base_rank = sum(nd["nproc"] for nd in nodes[:node_rank])
+        endpoints = ",".join(
+            f"{nd['ip']}:{61000 + i}" for nd in nodes for i in range(nd["nproc"]))
+        master_ip = nodes[0]["ip"]
+        coord_port = 62000 + (layout["gen"] % 1000)
+
+        for local_rank in range(args.nproc_per_node):
+            rank = base_rank + local_rank
+            env = dict(os.environ)
+            env.update({
+                "PADDLE_TRAINER_ID": str(rank),
+                "PADDLE_TRAINERS_NUM": str(world),
+                "PADDLE_TRAINER_ENDPOINTS": endpoints,
+                "PADDLE_CURRENT_ENDPOINT": endpoints.split(",")[rank],
+                "PADDLE_RANK_IN_NODE": str(local_rank),
+                "PADDLE_LOCAL_SIZE": str(args.nproc_per_node),
+                "PADDLE_NNODES": str(layout["nnodes"]),
+                "PADDLE_JOB_ID": args.job_id,
+                "PADDLE_RESTART_NUM": str(self.restarts),
+                "MASTER_ADDR": master_ip,
+                "MASTER_PORT": str(coord_port),
+            })
+            if args.devices is not None:
+                env["PADDLE_DEVICES"] = args.devices
+            cmd = [sys.executable]
+            if args.run_module:
+                cmd += ["-m", args.script]
+            else:
+                cmd += [args.script]
+            cmd += args.script_args
+            self.procs.start(cmd, env, rank)
+
+    # -- lifecycle ---------------------------------------------------------
+    def run(self) -> int:
+        layout = self.rendezvous()
+        self.launch_workers(layout)
+        return self.watch(layout)
+
+    def watch(self, layout: dict) -> int:
+        while True:
+            code = self.procs.poll()
+            if code is not None:
+                if code != 0:
+                    self.procs.terminate()
+                self.stop()
+                return code
+            time.sleep(1.0)
+
+    def stop(self):
+        if self.store is not None:
+            self.store.close()
+            self.store = None
+
+
+class CollectiveElasticController(CollectiveController):
+    """Adds heartbeat + peer watch + relaunch-on-change (reference
+    controllers/collective.py:262 + fleet/elastic/manager.py:125).
+
+    Fault model: recovery = re-rendezvous + restart workers (training resumes
+    from the last checkpoint); no in-run state migration — matching the
+    reference's elastic semantics.
+    """
+
+    def run(self) -> int:
+        from ..fleet.elastic import ElasticManager, ElasticStatus
+
+        while True:
+            layout = self.rendezvous()
+            mgr = ElasticManager(
+                store=self.store, job_id=self.args.job_id,
+                node_id=self.ctx.node_id,
+                expected=[nd["node_id"] for nd in layout["nodes"]],
+                heartbeat_interval=self.args.heartbeat_interval,
+                ttl=self.args.heartbeat_interval * 3)
+            mgr.start()
+            self.launch_workers(layout)
+            try:
+                status = self._watch_elastic(mgr)
+            finally:
+                mgr.stop()
+            if status == ElasticStatus.COMPLETED:
+                self.stop()
+                return 0
+            if status == ElasticStatus.ERROR or \
+                    self.restarts >= max(self.args.elastic_level, 1):
+                self.procs.terminate()
+                self.stop()
+                return 1
+            # peer change → restart generation
+            self.procs.terminate()
+            self.restarts += 1
+            if self.store is not None:
+                self.store.add(f"job/{self.args.job_id}/gen_probe", 1)
+            time.sleep(1.0)
+
+    def _watch_elastic(self, mgr) -> "ElasticStatus":  # noqa: F821
+        from ..fleet.elastic import ElasticStatus
+
+        while True:
+            code = self.procs.poll()
+            if code == 0:
+                return ElasticStatus.COMPLETED
+            if code is not None:
+                # local worker died → treat as restartable fault
+                return ElasticStatus.RESTART
+            if mgr.peers_changed():
+                return ElasticStatus.RESTART
+            time.sleep(1.0)

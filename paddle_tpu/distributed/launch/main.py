@@ -1,0 +1,58 @@
+"""CLI entry: ``python -m paddle_tpu.distributed.launch`` (reference launch/main.py:23)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from .controllers import (CollectiveController, CollectiveElasticController,
+                          Context, LaunchArgs)
+
+
+def _parse(argv: List[str]) -> LaunchArgs:
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="Launch a distributed paddle_tpu job.")
+    p.add_argument("--master", default=os.environ.get("PADDLE_MASTER"),
+                   help="rendezvous store endpoint host:port (TCPStore)")
+    p.add_argument("--nnodes", default=os.environ.get("PADDLE_NNODES", "1"),
+                   help="node count N, or min:max for elastic jobs")
+    p.add_argument("--nproc_per_node", type=int,
+                   default=None, help="worker processes per node (TPU default: 1)")
+    p.add_argument("--job_id", default=os.environ.get("PADDLE_JOB_ID", "default"))
+    p.add_argument("--log_dir", default="log")
+    p.add_argument("--devices", default=None,
+                   help="visible device ids for workers (PADDLE_DEVICES)")
+    p.add_argument("--elastic_level", type=int,
+                   default=int(os.environ.get("PADDLE_ELASTIC_LEVEL", "0")),
+                   help="max elastic restarts (0 = elastic off)")
+    p.add_argument("--elastic_timeout", type=float, default=30.0)
+    p.add_argument("-m", "--module", action="store_true", dest="run_module",
+                   help="run script as a python module")
+    p.add_argument("script", help="training script (or module with -m)")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    ns = p.parse_args(argv)
+    return LaunchArgs(
+        script=ns.script, script_args=ns.script_args, master=ns.master,
+        nnodes=str(ns.nnodes), nproc_per_node=ns.nproc_per_node,
+        job_id=ns.job_id, log_dir=ns.log_dir, devices=ns.devices,
+        elastic_level=ns.elastic_level, elastic_timeout=ns.elastic_timeout,
+        run_module=ns.run_module)
+
+
+def launch(args: LaunchArgs) -> int:
+    """Programmatic entry; returns the job exit code."""
+    ctx = Context(args)
+    elastic = args.elastic_level > 0 or ":" in args.nnodes
+    ctrl = CollectiveElasticController(ctx) if elastic else CollectiveController(ctx)
+    return ctrl.run()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    return launch(_parse(sys.argv[1:] if argv is None else argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

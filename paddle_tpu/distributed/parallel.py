@@ -135,8 +135,42 @@ class DataParallel(Layer):
         return contextlib.nullcontext()
 
 
-def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
-    """Reference: spawn.py:463. On TPU SPMD one controller drives all local chips, so
-    spawn degenerates to a direct call (multi-host uses the launch CLI instead)."""
+def _spawn_worker(func, args, rank, nprocs, master_port):
+    os.environ.update({
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(nprocs),
+        "PADDLE_RANK_IN_NODE": str(rank),
+        "MASTER_ADDR": "127.0.0.1",
+        "MASTER_PORT": str(master_port),
+    })
     func(*args)
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """Reference: spawn.py:463. On TPU SPMD one controller drives all local
+    chips, so ``nprocs<=1`` is a direct call (the common case). ``nprocs>1``
+    forks real worker processes with the trainer env contract — used by
+    CPU-backend multi-process tests and by per-host multi-controller setups
+    (each worker must then select a disjoint device set)."""
+    if nprocs in (-1, 0, 1):
+        func(*args)
+        return None
+    import multiprocessing as mp
+
+    ctx = mp.get_context("spawn")
+    master_port = options.get("master_port", 61700)
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=_spawn_worker,
+                        args=(func, args, rank, nprocs, master_port),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+    if not join:
+        return procs
+    for p in procs:
+        p.join()
+    bad = [p.exitcode for p in procs if p.exitcode != 0]
+    if bad:
+        raise RuntimeError(f"spawn workers failed with exit codes {bad}")
     return None

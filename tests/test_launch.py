@@ -1,0 +1,131 @@
+"""Tests for distributed.launch (controllers, env contract, elastic manager).
+
+Mirrors the reference's single-host multi-process launch tests
+(test/legacy_test/test_parallel_dygraph_dataparallel.py start_local_trainers):
+real subprocesses on one host, CPU backend, results checked via files.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from paddle_tpu.distributed.communication.store import TCPStore
+from paddle_tpu.distributed.fleet.elastic import ElasticManager, ElasticStatus
+from paddle_tpu.distributed.launch.controllers import (
+    CollectiveController, Context, LaunchArgs)
+
+WORKER = """
+import json, os, sys
+out = sys.argv[1]
+rec = {k: os.environ.get(k) for k in (
+    "PADDLE_TRAINER_ID", "PADDLE_TRAINERS_NUM", "PADDLE_TRAINER_ENDPOINTS",
+    "PADDLE_RANK_IN_NODE", "PADDLE_NNODES", "MASTER_ADDR", "MASTER_PORT")}
+with open(os.path.join(out, f"rank{os.environ['PADDLE_TRAINER_ID']}.json"), "w") as f:
+    json.dump(rec, f)
+"""
+
+
+def test_single_node_launch(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    args = LaunchArgs(script=str(script), script_args=[str(tmp_path)],
+                      nproc_per_node=3, log_dir=str(tmp_path / "log"))
+    code = CollectiveController(Context(args)).run()
+    assert code == 0
+    recs = {}
+    for r in range(3):
+        recs[r] = json.load(open(tmp_path / f"rank{r}.json"))
+    assert recs[0]["PADDLE_TRAINERS_NUM"] == "3"
+    assert recs[2]["PADDLE_TRAINER_ID"] == "2"
+    assert len(recs[0]["PADDLE_TRAINER_ENDPOINTS"].split(",")) == 3
+    assert recs[1]["PADDLE_RANK_IN_NODE"] == "1"
+
+
+def test_launch_nonzero_exit(tmp_path):
+    script = tmp_path / "bad.py"
+    script.write_text("import sys; sys.exit(3)")
+    args = LaunchArgs(script=str(script), nproc_per_node=2,
+                      log_dir=str(tmp_path / "log"))
+    code = CollectiveController(Context(args)).run()
+    assert code == 3
+
+
+def test_launch_cli_module(tmp_path):
+    env = dict(os.environ)
+    env["PT_LAUNCH_OUT"] = str(tmp_path)
+    script = tmp_path / "w.py"
+    script.write_text(WORKER)
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--log_dir", str(tmp_path / "log"),
+         str(script), str(tmp_path)],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert (tmp_path / "rank0.json").exists()
+    assert (tmp_path / "rank1.json").exists()
+
+
+def test_multinode_rendezvous_via_store(tmp_path):
+    """Two 'nodes' (threads driving controllers) rendezvous over one store."""
+    import threading
+
+    master = TCPStore("127.0.0.1", 0, is_master=True, world_size=2, timeout=30)
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    codes = {}
+
+    def run_node(idx):
+        args = LaunchArgs(script=str(script), script_args=[str(tmp_path)],
+                          master=f"127.0.0.1:{master.port}", nnodes="2",
+                          nproc_per_node=1, job_id="t2",
+                          log_dir=str(tmp_path / f"log{idx}"))
+        codes[idx] = CollectiveController(Context(args)).run()
+
+    ts = [threading.Thread(target=run_node, args=(i,)) for i in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=120)
+    master.close()
+    assert codes == {0: 0, 1: 0}
+    recs = [json.load(open(tmp_path / f"rank{r}.json")) for r in range(2)]
+    assert {r["PADDLE_TRAINER_ID"] for r in recs} == {"0", "1"}
+    assert all(r["PADDLE_TRAINERS_NUM"] == "2" for r in recs)
+    assert all(r["PADDLE_NNODES"] == "2" for r in recs)
+
+
+def test_elastic_manager_detects_dead_peer():
+    master = TCPStore("127.0.0.1", 0, is_master=True, world_size=2, timeout=10)
+    a = ElasticManager(master, "job", "nodeA", ["nodeA", "nodeB"],
+                       heartbeat_interval=0.1, ttl=0.5)
+    b = ElasticManager(master, "job", "nodeB", ["nodeA", "nodeB"],
+                       heartbeat_interval=0.1, ttl=0.5)
+    a.start()
+    b.start()
+    try:
+        time.sleep(0.3)
+        assert sorted(a.alive_peers()) == ["nodeA", "nodeB"]
+        assert not a.peers_changed()
+        b.stop()  # nodeB dies
+        deadline = time.time() + 5
+        while not a.peers_changed() and time.time() < deadline:
+            time.sleep(0.1)
+        assert a.peers_changed()
+        assert a.alive_peers() == ["nodeA"]
+    finally:
+        a.stop()
+        b.stop()
+        master.close()
+
+
+def test_enable_elastic_env(monkeypatch):
+    from paddle_tpu.distributed.fleet.elastic import enable_elastic
+
+    monkeypatch.setenv("PADDLE_ELASTIC_NNODES", "2:4")
+    assert enable_elastic()
+    monkeypatch.setenv("PADDLE_ELASTIC_NNODES", "4")
+    assert not enable_elastic()
