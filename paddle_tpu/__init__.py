@@ -80,19 +80,27 @@ def is_compiled_with_distribute() -> bool:
 
 
 def in_dynamic_mode() -> bool:
-    return not static._static_mode[0]
+    from .core import static_graph
+
+    return not static_graph.static_mode_enabled()
 
 
 def in_static_mode() -> bool:
-    return static._static_mode[0]
+    from .core import static_graph
+
+    return static_graph.static_mode_enabled()
 
 
 def enable_static():
-    static._static_mode[0] = True
+    from .core import static_graph
+
+    static_graph.enable_static_mode()
 
 
 def disable_static(place=None):
-    static._static_mode[0] = False
+    from .core import static_graph
+
+    static_graph.disable_static_mode()
 
 
 def set_device(device):

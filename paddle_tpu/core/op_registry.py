@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import flags
-from . import autograd_engine
+from . import autograd_engine, static_graph
 from .tensor import Tensor
 
 # AMP categories (reference: python/paddle/amp/amp_lists.py)
@@ -121,6 +121,17 @@ def apply_fn(name: str, fn: Callable, *args, _opdef: Optional[OpDef] = None, **k
                 else a
                 for a in args
             )
+
+    # static-graph interception: any symbolic Variable input routes the call
+    # into the current Program as a recorded Operation; creation ops (no tensor
+    # inputs) also record while a program_guard is open in static mode, so
+    # feed-independent subgraphs exist in the IR for constant folding
+    # (core/static_graph.py)
+    if any(isinstance(a, static_graph.Variable) for a in args) or (
+        static_graph.recording_constants()
+        and not any(isinstance(a, Tensor) for a in args)
+    ):
+        return static_graph.record_op(name, fn, args, kwargs)
 
     tensor_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
     arrays = [args[i]._data for i in tensor_idx]

@@ -1,0 +1,329 @@
+"""Static-graph IR builder — the TPU-native Program/Block/Operation layer.
+
+Parity anchors: the reference's two graph IRs — legacy ``ProgramDesc/BlockDesc/OpDesc``
+(/root/reference/paddle/fluid/framework/framework.proto) and the PIR ``Program/Block/
+Operation`` SSA IR (/root/reference/paddle/pir/include/core/operation.h:66,
+program.h, block.h) — plus the op-building path used by static mode
+(/root/reference/python/paddle/base/framework.py append_op).
+
+TPU-native redesign: the IR is *lazy op recording* over the one runtime op registry
+(core/op_registry.py). Calling any framework op on a symbolic ``Variable`` appends an
+``Operation`` holding the op's pure jax function; shape/dtype inference (the
+reference's InferMeta, phi/infermeta/*) is ``jax.eval_shape`` over that same function
+— one source of truth, no YAML codegen, no separate infer-meta library. Execution
+(static/executor.py) replays the recorded ops inside ``jax.jit``, so the "graph
+compiler" is XLA itself: the reference's PIR passes + CINN lowering collapse into
+XLA's fusion pipeline, and static/passes.py keeps only the graph-level passes that
+matter pre-XLA (DCE / constant-fold / CSE — cf.
+fluid/pir/transforms/general/{dead_code_elimination,constant_folding,cse}).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from .tensor import Tensor
+
+__all__ = [
+    "Variable", "Operation", "Block", "Program", "program_guard",
+    "default_main_program", "default_startup_program", "building",
+    "record_op", "enable_static_mode", "disable_static_mode", "static_mode_enabled",
+]
+
+
+class Variable(Tensor):
+    """A symbolic tensor inside a Program. ``_data`` holds a jax.ShapeDtypeStruct
+    (advisory shapes; -1/None dims are inferred at run time from real feeds)."""
+
+    def __init__(self):  # pragma: no cover - use Variable.create
+        raise TypeError("use Variable.create()")
+
+    @classmethod
+    def create(cls, shape, dtype, name: str, block: "Block",
+               op: Optional["Operation"] = None, out_idx: int = 0,
+               is_feed: bool = False):
+        v = cls.__new__(cls)
+        shape = tuple(-1 if s is None else int(s) for s in shape)
+        adv = tuple(1 if s == -1 else s for s in shape)
+        v._data = jax.ShapeDtypeStruct(adv, jax.numpy.dtype(dtype))
+        v.stop_gradient = True
+        v._grad = None
+        v._node = None
+        v._out_idx = out_idx
+        v.name = name
+        v.persistable = False
+        v._hooks = None
+        v.is_parameter = False
+        v.block = block
+        v.op = op
+        v.is_feed = is_feed
+        v.decl_shape = shape  # may contain -1
+        return v
+
+    @property
+    def shape(self):
+        return list(self.decl_shape)
+
+    def numpy(self):
+        raise RuntimeError(
+            f"Variable '{self.name}' is symbolic — fetch it through Executor.run()")
+
+    item = numpy
+
+    def __bool__(self):
+        raise RuntimeError(
+            "cannot branch on a symbolic Variable; static graphs require "
+            "value-free Python control flow (use lax.cond-style ops)")
+
+    def __repr__(self):
+        return (f"Variable(name={self.name}, shape={self.decl_shape}, "
+                f"dtype={self._data.dtype})")
+
+    __str__ = __repr__
+
+
+class Operation:
+    """One recorded op: a pure jax function + its argument template.
+
+    ``args`` entries may be Variable (symbolic input), Tensor (captured eager
+    value, late-bound at replay so parameter updates are visible), or plain
+    python literals. Cf. pir::Operation (operation.h:66) — here the "opcode" is
+    the python callable itself.
+    """
+
+    __slots__ = ("idx", "type", "fn", "args", "kwargs", "inputs", "captured",
+                 "outputs")
+
+    def __init__(self, idx, type, fn, args, kwargs):
+        self.idx = idx
+        self.type = type
+        self.fn = fn
+        self.args = args
+        self.kwargs = kwargs
+        self.inputs: List[Variable] = [a for a in args if isinstance(a, Variable)]
+        self.captured: List[Tensor] = [
+            a for a in args if isinstance(a, Tensor) and not isinstance(a, Variable)]
+        self.outputs: List[Variable] = []
+
+    def to_string(self):
+        ins = ", ".join(v.name for v in self.inputs)
+        caps = ", ".join(t.name for t in self.captured)
+        outs = ", ".join(f"{v.name}:{v._data.dtype}{list(v._data.shape)}"
+                         for v in self.outputs)
+        extra = f" captured=[{caps}]" if caps else ""
+        return f"  ({outs}) = {self.type}({ins}){extra}"
+
+
+class Block:
+    """A straight-line list of operations + declared variables
+    (cf. pir/include/core/block.h; control flow stays inside ops as lax
+    primitives, so nested blocks are not needed)."""
+
+    def __init__(self, program: "Program", idx: int = 0):
+        self.program = program
+        self.idx = idx
+        self.ops: List[Operation] = []
+        self.vars: Dict[str, Variable] = {}
+
+    def var(self, name: str) -> Variable:
+        return self.vars[name]
+
+    def create_var(self, shape, dtype, name=None, is_feed=False, op=None, out_idx=0):
+        if name is None:
+            name = self.program._next_name("tmp")
+        v = Variable.create(shape, dtype, name, self, op=op, out_idx=out_idx,
+                            is_feed=is_feed)
+        self.vars[name] = v
+        return v
+
+
+class Program:
+    """A recorded computation graph (cf. pir/include/core/program.h and the
+    legacy ProgramDesc)."""
+
+    def __init__(self):
+        self.blocks = [Block(self, 0)]
+        self.random_seed = 0
+        self._name_counter = 0
+        self._version = 0
+        self._loss: Optional[Variable] = None
+        self._optimizer = None
+        self._grad_vars: Dict[int, Variable] = {}  # id(param Tensor) -> grad Variable
+        self._is_test = False
+
+    def global_block(self) -> Block:
+        return self.blocks[0]
+
+    def current_block(self) -> Block:
+        return self.blocks[-1]
+
+    def _next_name(self, prefix: str) -> str:
+        self._name_counter += 1
+        return f"{prefix}_{self._name_counter}"
+
+    def list_vars(self):
+        for b in self.blocks:
+            yield from b.vars.values()
+
+    @property
+    def num_ops(self):
+        return sum(len(b.ops) for b in self.blocks)
+
+    def clone(self, for_test: bool = False) -> "Program":
+        import copy
+
+        p = Program()
+        p.random_seed = self.random_seed
+        p._name_counter = self._name_counter
+        p._is_test = for_test
+        blk, src = p.global_block(), self.global_block()
+        blk.vars = dict(src.vars)
+        blk.ops = list(src.ops)
+        if for_test:
+            # test clone: drop train-only stochastic ops where possible
+            blk.ops = [op for op in blk.ops if op.type not in ("dropout_train",)]
+        return p
+
+    def to_string(self, throw_on_error=False, with_details=False) -> str:
+        lines = [f"{{ // block 0 (ops={self.num_ops})"]
+        feeds = [v.name for v in self.list_vars() if getattr(v, "is_feed", False)]
+        if feeds:
+            lines.append(f"  feed: {', '.join(feeds)}")
+        for op in self.global_block().ops:
+            lines.append(op.to_string())
+        lines.append("}")
+        return "\n".join(lines)
+
+    __str__ = to_string
+
+    def all_parameters(self):
+        seen, out = set(), []
+        for op in self.global_block().ops:
+            for t in op.captured:
+                if t.is_parameter and id(t) not in seen:
+                    seen.add(id(t))
+                    out.append(t)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# builder state
+# ---------------------------------------------------------------------------
+
+_program_stack: List[Program] = []
+_default_main = [None]
+_default_startup = [None]
+_static_mode = [False]
+
+
+def default_main_program() -> Program:
+    if _default_main[0] is None:
+        _default_main[0] = Program()
+    return _default_main[0]
+
+
+def default_startup_program() -> Program:
+    if _default_startup[0] is None:
+        _default_startup[0] = Program()
+    return _default_startup[0]
+
+
+def enable_static_mode():
+    _static_mode[0] = True
+
+
+def disable_static_mode():
+    _static_mode[0] = False
+    _program_stack.clear()
+
+
+def static_mode_enabled() -> bool:
+    return _static_mode[0]
+
+
+def current_program() -> Program:
+    if _program_stack:
+        return _program_stack[-1]
+    return default_main_program()
+
+
+class program_guard:
+    """``with program_guard(main, startup):`` — record into ``main``
+    (reference: python/paddle/static/__init__.py program_guard)."""
+
+    def __init__(self, main_program: Program, startup_program: Optional[Program] = None):
+        self.main = main_program
+        self.startup = startup_program
+
+    def __enter__(self):
+        _program_stack.append(self.main)
+        return self.main
+
+    def __exit__(self, *exc):
+        _program_stack.pop()
+        return False
+
+
+def building() -> bool:
+    """Is at least one program open for recording? (op_registry fast-path check
+    is on symbolic args, not this — see record_op caller.)"""
+    return bool(_program_stack) or _static_mode[0]
+
+
+def recording_constants() -> bool:
+    """Record tensor-input-free (creation) ops too? Only inside an explicit
+    program_guard in static mode — library internals outside a guard (layer
+    init, buffers) stay eager."""
+    return _static_mode[0] and bool(_program_stack)
+
+
+# ---------------------------------------------------------------------------
+# op recording (called from core/op_registry.apply_fn)
+# ---------------------------------------------------------------------------
+
+def _adv_struct(a):
+    """Argument as seen by jax.eval_shape."""
+    if isinstance(a, Variable):
+        return a._data
+    if isinstance(a, Tensor):
+        return jax.ShapeDtypeStruct(tuple(a._data.shape), a._data.dtype)
+    return a
+
+
+def record_op(name: str, fn, args, kwargs):
+    """Append an Operation to the current program; return symbolic outputs."""
+    prog = None
+    for a in args:
+        if isinstance(a, Variable):
+            prog = a.block.program
+            break
+    if prog is None:
+        prog = current_program()
+    blk = prog.current_block()
+    op = Operation(len(blk.ops), name, fn, list(args), dict(kwargs))
+    blk.ops.append(op)
+    prog._version += 1
+
+    # advisory shape/dtype inference == InferMeta, via the op's own function
+    def pure(*sym_args):
+        full = list(args)
+        it = iter(sym_args)
+        for i, a in enumerate(full):
+            if isinstance(a, (Variable, Tensor)):
+                full[i] = next(it)
+        return fn(*full, **kwargs)
+
+    structs = [_adv_struct(a) for a in args if isinstance(a, (Variable, Tensor))]
+    out_struct = jax.eval_shape(pure, *structs)
+    single = not isinstance(out_struct, (tuple, list))
+    out_list = [out_struct] if single else list(out_struct)
+    outs = []
+    for i, s in enumerate(out_list):
+        v = blk.create_var(s.shape, s.dtype, name=prog._next_name(name),
+                           op=op, out_idx=i)
+        op.outputs.append(v)
+        outs.append(v)
+    return outs[0] if single else tuple(outs)

@@ -150,6 +150,25 @@ class Optimizer:
         return new_vals, new_acc
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        from ..core import static_graph
+
+        if isinstance(loss, static_graph.Variable):
+            # static mode: mark the program for training — the Executor computes
+            # grads via value_and_grad over the replay trace and applies this
+            # optimizer each run() (cf. reference appended backward + opt ops)
+            prog = loss.block.program
+            params = list(parameters or self._parameter_list
+                          or prog.all_parameters())
+            skip = set(map(id, no_grad_set or []))
+            params = [p for p in params
+                      if getattr(p, "trainable", True) and id(p) not in skip]
+            if not self._parameter_list:
+                self._parameter_list = params
+            self._static_params = params
+            prog._loss = loss
+            prog._optimizer = self
+            return None, [(p, None) for p in params]
+
         loss.backward()
         self.step()
         return None, [(p, p.grad) for p in (self._parameter_list or [])]

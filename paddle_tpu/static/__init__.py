@@ -1,10 +1,42 @@
-"""paddle_tpu.static — static-graph parity layer (reference: python/paddle/static).
+"""paddle_tpu.static — static-graph parity layer.
 
-TPU-native design: "static mode" is jit tracing; a Program is a traced, compiled
-callable (see paddle_tpu.jit). This module keeps the mode switch + InputSpec.
+Parity anchors: python/paddle/static/__init__.py (Program/Executor/program_guard/
+data/nn), python/paddle/base/executor.py:1285 (Executor.run),
+paddle/fluid/framework/new_executor/standalone_executor.h:34.
+
+TPU-native design: a Program is a lazily-recorded op list over the single runtime
+op registry (core/static_graph.py); the Executor replays it under jax.jit so XLA
+is the graph compiler. See executor.py / passes.py module docs.
 """
 
-_static_mode = [False]
+from __future__ import annotations
+
+from ..core import static_graph as _sg
+from ..core.static_graph import (  # noqa: F401
+    Program,
+    Variable,
+    default_main_program,
+    default_startup_program,
+    program_guard,
+)
+from .executor import Executor, Scope, global_scope  # noqa: F401
+from .passes import (  # noqa: F401
+    CommonSubexpressionEliminationPass,
+    ConstantFoldingPass,
+    DeadCodeEliminationPass,
+    Pass,
+    PassManager,
+    apply_default_passes,
+)
+from . import nn  # noqa: F401
+
+__all__ = [
+    "InputSpec", "Program", "Variable", "Executor", "Scope", "global_scope",
+    "program_guard", "default_main_program", "default_startup_program",
+    "data", "CompiledProgram", "BuildStrategy", "ExecutionStrategy",
+    "append_backward", "name_scope", "PassManager", "apply_default_passes",
+    "nn",
+]
 
 
 class InputSpec:
@@ -20,3 +52,105 @@ class InputSpec:
 
     def __repr__(self):
         return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+
+def data(name: str, shape, dtype="float32", lod_level=0) -> Variable:
+    """Declare a feed variable in the current program
+    (reference: python/paddle/static/input.py data)."""
+    from ..core import dtype as dtype_mod
+
+    prog = _sg.current_program()
+    return prog.global_block().create_var(
+        shape, dtype_mod.convert_dtype(dtype), name=name, is_feed=True)
+
+
+class BuildStrategy:
+    """Pass-selection knobs (reference: BuildStrategy pybind). Fields map onto
+    static/passes.py passes instead of ParallelExecutor graph passes."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.fuse_elewise_add_act_ops = True  # XLA fuses; kept for API parity
+        self.constant_folding = True
+        self.cse = True
+        self.dead_code_elimination = True
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+
+
+class CompiledProgram:
+    """Program + optimization pipeline (reference: python/paddle/static/
+    compiler.py CompiledProgram). Passes run once, lazily, on first use."""
+
+    def __init__(self, program: Program, build_strategy: BuildStrategy = None):
+        self._program = program
+        self._build_strategy = build_strategy or BuildStrategy()
+        self._optimized = False
+
+    def _ensure_optimized(self, targets=None):
+        """Run the strategy-selected passes once (invoked by Executor.run).
+        DCE is skipped without explicit targets — the Executor's replay builder
+        applies run-time liveness pruning per fetch set anyway."""
+        if self._optimized:
+            return
+        from .passes import (CommonSubexpressionEliminationPass,
+                             ConstantFoldingPass, DeadCodeEliminationPass,
+                             PassManager)
+
+        bs = self._build_strategy
+        pm = PassManager()
+        if bs.cse:
+            pm.add_pass(CommonSubexpressionEliminationPass())
+        if bs.constant_folding:
+            pm.add_pass(ConstantFoldingPass())
+        if bs.dead_code_elimination and targets:
+            pm.add_pass(DeadCodeEliminationPass(targets))
+        pm.run(self._program)
+        self._optimized = True
+
+
+def append_backward(loss: Variable, parameter_list=None, no_grad_set=None):
+    """Static-mode backward marker (reference: python/paddle/base/backward.py
+    append_backward). Gradients are computed by the Executor via value_and_grad
+    over the replay trace; this records which parameters require them and
+    returns (param, grad_handle) pairs whose grads appear as ``param.grad``
+    after each ``Executor.run``."""
+    prog = loss.block.program
+    prog._loss = loss
+    params = list(parameter_list or prog.all_parameters())
+    skip = set(map(id, no_grad_set or []))
+    params = [p for p in params if getattr(p, "trainable", True)
+              and id(p) not in skip]
+    if prog._optimizer is None:
+        class _GradOnly:
+            """Sentinel optimizer: compute grads, apply no update."""
+
+            _static_params = params
+
+            @staticmethod
+            def step():
+                pass
+
+            @staticmethod
+            def clear_grad():
+                pass
+
+        prog._optimizer = _GradOnly()
+    return [(p, None) for p in params]
+
+
+class name_scope:
+    """API-parity no-op scoping (names feed profiler annotations only)."""
+
+    def __init__(self, prefix=""):
+        self.prefix = prefix
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False

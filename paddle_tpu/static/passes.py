@@ -1,0 +1,230 @@
+"""Graph-level passes over a recorded Program.
+
+Parity anchors: the reference's PIR pass infrastructure
+(/root/reference/paddle/pir/include/pass/pass_manager.h:35) and the general
+transforms it ships (fluid/pir/transforms/general/: dead_code_elimination_pass.cc,
+constant_folding_pass.cc, common_subexpression_elimination_pass.cc).
+
+TPU-native scope note: XLA already performs fusion, layout assignment, scheduling
+and most algebraic simplification after jit tracing — the passes kept here are the
+ones with value *before* tracing: shrinking the recorded op list (DCE), hoisting
+feed-independent subgraphs out of the per-step program (constant folding — the
+analogue of the reference folding weights through transformations), and merging
+duplicate recorded calls (CSE) so the jit trace itself is smaller.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..core.static_graph import Operation, Program, Variable
+from ..core.tensor import Tensor
+
+__all__ = ["Pass", "PassManager", "DeadCodeEliminationPass",
+           "ConstantFoldingPass", "CommonSubexpressionEliminationPass",
+           "apply_default_passes"]
+
+_RANDOM_OPS = ("rand", "normal", "uniform", "dropout", "bernoulli", "poisson",
+               "multinomial", "exponential", "randint", "randperm", "shuffle")
+
+
+def _is_stochastic(op: Operation) -> bool:
+    return any(k in (op.type or "") for k in _RANDOM_OPS)
+
+
+def live_ops(ops, target_ids, aliases=None):
+    """Reverse liveness sweep: the subsequence of ``ops`` whose outputs reach
+    ``target_ids`` (ids pre-resolved through ``aliases``). Shared by the DCE
+    pass and the Executor's replay builder."""
+    aliases = aliases or {}
+    needed = {aliases.get(t, t) for t in target_ids}
+    keep = []
+    for op in reversed(ops):
+        if any(id(o) in needed for o in op.outputs):
+            keep.append(op)
+            needed.update(aliases.get(id(v), id(v)) for v in op.inputs)
+    keep.reverse()
+    return keep
+
+
+class Pass:
+    name = "pass"
+
+    def apply(self, program: Program) -> int:
+        """Mutate program; return number of changes."""
+        raise NotImplementedError
+
+
+class PassManager:
+    """Ordered pass pipeline (cf. pir::PassManager::Run)."""
+
+    def __init__(self, passes: Optional[Sequence[Pass]] = None):
+        self.passes: List[Pass] = list(passes or [])
+
+    def add_pass(self, p: Pass):
+        self.passes.append(p)
+        return self
+
+    def run(self, program: Program) -> Dict[str, int]:
+        stats = {}
+        for p in self.passes:
+            stats[p.name] = p.apply(program)
+            program._version += 1
+        return stats
+
+
+class DeadCodeEliminationPass(Pass):
+    """Drop ops whose outputs never reach ``targets`` (or any later op)."""
+
+    name = "dead_code_elimination"
+
+    def __init__(self, targets: Optional[Sequence[Variable]] = None):
+        self.targets = targets
+
+    def apply(self, program: Program) -> int:
+        blk = program.global_block()
+        if self.targets is None:
+            return 0  # without targets every terminal op is live
+        targets = [id(v) for v in self.targets]
+        if program._loss is not None:
+            targets.append(id(program._loss))
+        keep = live_ops(blk.ops, targets, getattr(program, "_aliases", None))
+        removed = len(blk.ops) - len(keep)
+        blk.ops = keep
+        return removed
+
+
+class ConstantFoldingPass(Pass):
+    """Evaluate feed-independent, non-stochastic ops once; replace their outputs
+    with captured constants (reference: constant_folding_pass.cc)."""
+
+    name = "constant_folding"
+
+    def apply(self, program: Program) -> int:
+        blk = program.global_block()
+        folded: Dict[int, Tensor] = getattr(program, "_folded", {})
+        kept, n = [], 0
+        for op in blk.ops:
+            # foldable: deterministic, every symbolic input already folded
+            # (feeds are never folded, so feed-derived ops stay), and no
+            # captured eager Tensor at all — captures are late-bound by
+            # contract (Operation docstring) and folding would snapshot them
+            foldable = (
+                not _is_stochastic(op)
+                and all(id(v) in folded for v in op.inputs)
+                and not op.captured
+            )
+            if foldable:
+                def resolve(a):
+                    if isinstance(a, Variable):
+                        return folded[id(a)]._data
+                    if isinstance(a, Tensor):
+                        return a._data
+                    return a
+
+                out = op.fn(*[resolve(a) for a in op.args], **op.kwargs)
+                outs = out if isinstance(out, (tuple, list)) else [out]
+                for v, o in zip(op.outputs, outs):
+                    folded[id(v)] = Tensor(o)
+                n += 1
+            else:
+                kept.append(op)
+        blk.ops = kept
+        program._folded = folded
+        return n
+
+
+def _closure_fingerprint(fn):
+    """Hashable description of a python closure, or None if unfingerprintable.
+
+    Recorded op fns are often per-call lambdas (e.g. ``lambda x: x.astype(dt)``);
+    two recordings of the same source op are mergeable only when their captured
+    cells hold equal simple values.
+    """
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        # closure-free shared callable (jnp ufunc, PjitFunction): identity is
+        # the fingerprint — same object + same inputs => same value
+        return ("id", id(fn))
+    cells = ()
+    if fn.__closure__:
+        vals = []
+        for c in fn.__closure__:
+            v = c.cell_contents
+            if isinstance(v, (int, float, bool, str, bytes, tuple, type(None))):
+                vals.append(v)
+            elif isinstance(v, np.dtype) or type(v).__module__ == "jax.numpy":
+                vals.append(str(v))
+            else:
+                return None
+        cells = tuple(vals)
+    return (code.co_code, code.co_consts if all(
+        isinstance(c, (int, float, bool, str, bytes, type(None), tuple))
+        for c in code.co_consts) else None, cells)
+
+
+class CommonSubexpressionEliminationPass(Pass):
+    """Merge duplicate recorded ops (same fn fingerprint, same inputs, same
+    kwargs) — reference: common_subexpression_elimination_pass.cc. Duplicate
+    outputs become aliases resolved by the Executor."""
+
+    name = "cse"
+
+    def apply(self, program: Program) -> int:
+        blk = program.global_block()
+        aliases: Dict[int, int] = getattr(program, "_aliases", {})
+        seen: Dict[tuple, Operation] = {}
+        kept, n = [], 0
+        for op in blk.ops:
+            if _is_stochastic(op):
+                kept.append(op)
+                continue
+            fp = _closure_fingerprint(op.fn)
+            if fp is None:
+                kept.append(op)
+                continue
+            try:
+                kw = tuple(sorted((k, repr(v)) for k, v in op.kwargs.items()))
+            except Exception:
+                kept.append(op)
+                continue
+            in_key = []
+            for a in op.args:
+                if isinstance(a, Variable):
+                    in_key.append(("v", aliases.get(id(a), id(a))))
+                elif isinstance(a, Tensor):
+                    in_key.append(("c", id(a)))
+                elif isinstance(a, (int, float, bool, str, bytes, type(None))):
+                    in_key.append(("l", a))
+                else:
+                    # repr() of arrays/objects can truncate ("...") and collide
+                    # across different values — never CSE on it
+                    in_key = None
+                    break
+            if in_key is None:
+                kept.append(op)
+                continue
+            in_key = tuple(in_key)
+            key = (op.type, fp, in_key, kw)
+            prev = seen.get(key)
+            if prev is not None and len(prev.outputs) == len(op.outputs):
+                for dup, canon in zip(op.outputs, prev.outputs):
+                    aliases[id(dup)] = aliases.get(id(canon), id(canon))
+                n += 1
+            else:
+                seen[key] = op
+                kept.append(op)
+        blk.ops = kept
+        program._aliases = aliases
+        return n
+
+
+def apply_default_passes(program: Program, targets=None) -> Dict[str, int]:
+    pm = PassManager([
+        CommonSubexpressionEliminationPass(),
+        ConstantFoldingPass(),
+        DeadCodeEliminationPass(targets),
+    ])
+    return pm.run(program)

@@ -27,17 +27,22 @@ def _shape(shape):
 
 
 def zeros(shape, dtype=None, name=None):
-    return Tensor(jnp.zeros(_shape(shape), dtype_mod.convert_dtype(dtype) or dtype_mod.get_default_dtype()))
+    dt = dtype_mod.convert_dtype(dtype) or dtype_mod.get_default_dtype()
+    shape = _shape(shape)
+    return apply_fn("zeros", lambda: jnp.zeros(shape, dt))
 
 
 def ones(shape, dtype=None, name=None):
-    return Tensor(jnp.ones(_shape(shape), dtype_mod.convert_dtype(dtype) or dtype_mod.get_default_dtype()))
+    dt = dtype_mod.convert_dtype(dtype) or dtype_mod.get_default_dtype()
+    shape = _shape(shape)
+    return apply_fn("ones", lambda: jnp.ones(shape, dt))
 
 
 def full(shape, fill_value, dtype=None, name=None):
     fill_value = unwrap(fill_value)
     dt = dtype_mod.convert_dtype(dtype)
-    return Tensor(jnp.full(_shape(shape), fill_value, dt))
+    shape = _shape(shape)
+    return apply_fn("full", lambda: jnp.full(shape, fill_value, dt))
 
 
 def zeros_like(x, dtype=None, name=None):

@@ -115,10 +115,12 @@ def logaddexp(x, y, name=None):
 # ---------- unary elementwise ----------
 
 def _unary(name, fn, amp=None):
-    def op(x, name=None):
-        return apply_fn(name, fn, x)
+    op_name = name
 
-    op.__name__ = name
+    def op(x, name=None):
+        return apply_fn(op_name, fn, x)
+
+    op.__name__ = op_name
     return op
 
 

@@ -1,0 +1,270 @@
+"""Static-graph layer tests: Program recording, Executor replay, passes,
+static training via Optimizer.minimize.
+
+Reference test model: test/legacy_test (static-mode OpTest runs) and
+python/paddle/static usage patterns.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+from paddle_tpu.static import Executor, PassManager, program_guard
+from paddle_tpu.static.passes import (
+    CommonSubexpressionEliminationPass,
+    ConstantFoldingPass,
+    DeadCodeEliminationPass,
+)
+
+
+@pytest.fixture(autouse=True)
+def _static_mode():
+    paddle.enable_static()
+    yield
+    paddle.disable_static()
+
+
+def test_program_record_and_run():
+    main = static.Program()
+    with program_guard(main):
+        x = static.data("x", [None, 4], "float32")
+        y = paddle.matmul(x, paddle.ones([4, 3]))
+        z = y + 1.0
+    assert main.num_ops >= 2
+    assert "matmul" in main.to_string()
+
+    exe = Executor()
+    xv = np.random.rand(2, 4).astype(np.float32)
+    (out,) = exe.run(main, feed={"x": xv}, fetch_list=[z])
+    np.testing.assert_allclose(out, xv @ np.ones((4, 3)) + 1.0, rtol=1e-6)
+
+
+def test_shape_inference():
+    main = static.Program()
+    with program_guard(main):
+        x = static.data("x", [8, 16], "float32")
+        y = paddle.nn.functional.relu(x)
+        assert y.shape == [8, 16]
+        assert str(y._data.dtype) == "float32"
+        m = paddle.matmul(x, paddle.zeros([16, 32]))
+        assert m.shape == [8, 32]
+
+
+def test_dynamic_batch_dim():
+    main = static.Program()
+    with program_guard(main):
+        x = static.data("x", [None, 3], "float32")
+        y = x * 2.0
+    exe = Executor()
+    for b in (2, 5):
+        (out,) = exe.run(main, feed={"x": np.ones((b, 3), np.float32)}, fetch_list=[y])
+        assert out.shape == (b, 3)
+        np.testing.assert_allclose(out, 2.0)
+
+
+def test_symbolic_bool_raises():
+    main = static.Program()
+    with program_guard(main):
+        x = static.data("x", [2], "float32")
+        with pytest.raises(RuntimeError):
+            bool(x > 0)
+        with pytest.raises(RuntimeError):
+            (x + 1).numpy()
+
+
+def test_layer_in_static_graph():
+    main = static.Program()
+    with program_guard(main):
+        x = static.data("x", [4, 8], "float32")
+        lin = paddle.nn.Linear(8, 2)
+        out = lin(x)
+    exe = Executor()
+    xv = np.random.rand(4, 8).astype(np.float32)
+    (o,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+    ref = xv @ lin.weight.numpy() + lin.bias.numpy()
+    np.testing.assert_allclose(o, ref, rtol=1e-5)
+
+
+def test_static_training_minimize():
+    main = static.Program()
+    with program_guard(main):
+        x = static.data("x", [16, 4], "float32")
+        label = static.data("label", [16, 1], "float32")
+        lin = paddle.nn.Linear(4, 1)
+        pred = lin(x)
+        loss = paddle.mean((pred - label) ** 2)
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=list(lin.parameters()))
+        opt.minimize(loss)
+
+    exe = Executor()
+    rng = np.random.default_rng(0)
+    w_true = np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+    losses = []
+    for _ in range(60):
+        xv = rng.standard_normal((16, 4)).astype(np.float32)
+        yv = xv @ w_true
+        (lv,) = exe.run(main, feed={"x": xv, "label": yv}, fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.1, losses[::10]
+
+
+def test_append_backward_grads():
+    main = static.Program()
+    with program_guard(main):
+        x = static.data("x", [3, 2], "float32")
+        lin = paddle.nn.Linear(2, 1)
+        loss = paddle.sum(lin(x))
+        static.append_backward(loss)
+    exe = Executor()
+    xv = np.ones((3, 2), np.float32)
+    exe.run(main, feed={"x": xv}, fetch_list=[loss])
+    assert lin.weight.grad is not None
+    np.testing.assert_allclose(lin.weight.grad.numpy(),
+                               np.full((2, 1), 3.0), rtol=1e-6)
+
+
+def test_dce_pass():
+    main = static.Program()
+    with program_guard(main):
+        x = static.data("x", [2, 2], "float32")
+        used = x + 1.0
+        _unused = paddle.exp(x) * 5.0  # dead
+    n_before = main.num_ops
+    removed = DeadCodeEliminationPass([used]).apply(main)
+    assert removed >= 2
+    assert main.num_ops < n_before
+    exe = Executor()
+    (o,) = exe.run(main, feed={"x": np.zeros((2, 2), np.float32)}, fetch_list=[used])
+    np.testing.assert_allclose(o, 1.0)
+
+
+def test_constant_folding_pass():
+    main = static.Program()
+    with program_guard(main):
+        x = static.data("x", [2], "float32")
+        c = paddle.ones([2]) * 3.0 + 1.0  # feed-independent subgraph
+        y = x + c
+    n_before = main.num_ops
+    folded = ConstantFoldingPass().apply(main)
+    assert folded >= 1
+    assert main.num_ops < n_before
+    exe = Executor()
+    (o,) = exe.run(main, feed={"x": np.zeros(2, np.float32)}, fetch_list=[y])
+    np.testing.assert_allclose(o, 4.0)
+
+
+def test_cse_pass():
+    main = static.Program()
+    with program_guard(main):
+        x = static.data("x", [4], "float32")
+        a = paddle.exp(x)
+        b = paddle.exp(x)  # duplicate
+        y = a + b
+    merged = CommonSubexpressionEliminationPass().apply(main)
+    assert merged >= 1
+    exe = Executor()
+    xv = np.random.rand(4).astype(np.float32)
+    (o,) = exe.run(main, feed={"x": xv}, fetch_list=[y])
+    np.testing.assert_allclose(o, 2 * np.exp(xv), rtol=1e-6)
+
+
+def test_compiled_program():
+    main = static.Program()
+    with program_guard(main):
+        x = static.data("x", [2], "float32")
+        y = x + (paddle.ones([2]) + paddle.ones([2]))
+    cp = static.CompiledProgram(main)
+    cp._ensure_optimized()
+    exe = Executor()
+    (o,) = exe.run(cp, feed={"x": np.zeros(2, np.float32)}, fetch_list=[y])
+    np.testing.assert_allclose(o, 2.0)
+
+
+def test_program_clone_and_startup():
+    main = static.Program()
+    with program_guard(main, static.default_startup_program()):
+        x = static.data("x", [2], "float32")
+        y = x * 2.0
+    test_prog = main.clone(for_test=True)
+    exe = Executor()
+    exe.run(static.default_startup_program())  # eager init: no-op, must not raise
+    (o,) = exe.run(test_prog, feed={"x": np.ones(2, np.float32)}, fetch_list=[y])
+    np.testing.assert_allclose(o, 2.0)
+
+
+def test_fetch_cse_aliased_var():
+    main = static.Program()
+    with program_guard(main):
+        x = static.data("x", [4], "float32")
+        _a = paddle.exp(x)
+        b = paddle.exp(x)  # becomes an alias of _a after CSE
+    assert CommonSubexpressionEliminationPass().apply(main) == 1
+    exe = Executor()
+    xv = np.random.rand(4).astype(np.float32)
+    (o,) = exe.run(main, feed={"x": xv}, fetch_list=[b])
+    np.testing.assert_allclose(o, np.exp(xv), rtol=1e-6)
+
+
+def test_fetch_folded_var():
+    main = static.Program()
+    with program_guard(main):
+        x = static.data("x", [2], "float32")
+        c = paddle.ones([2]) * 3.0 + 1.0
+        _y = x + c
+    ConstantFoldingPass().apply(main)
+    exe = Executor()
+    (o,) = exe.run(main, feed={"x": np.zeros(2, np.float32)}, fetch_list=[c])
+    np.testing.assert_allclose(o, 4.0)
+
+
+def test_cse_no_merge_on_distinct_array_literals():
+    # repr() of large arrays truncates — CSE must not key on it
+    main = static.Program()
+    with program_guard(main):
+        x = static.data("x", [2000], "float32")
+        a1 = np.zeros(2000, np.float32)
+        a2 = a1.copy()
+        a2[1000] = 7.0
+        z1 = paddle.add(x, paddle.to_tensor(a1))
+        z2 = paddle.add(x, paddle.to_tensor(a2))
+        s = z1 + z2
+    CommonSubexpressionEliminationPass().apply(main)
+    exe = Executor()
+    (o,) = exe.run(main, feed={"x": np.zeros(2000, np.float32)}, fetch_list=[s])
+    assert o[1000] == 7.0
+
+
+def test_compiled_program_optimizes_via_run():
+    main = static.Program()
+    with program_guard(main):
+        x = static.data("x", [2], "float32")
+        y = x + (paddle.ones([2]) + paddle.ones([2]))
+    cp = static.CompiledProgram(main)
+    exe = Executor()
+    (o,) = exe.run(cp, feed={"x": np.zeros(2, np.float32)}, fetch_list=[y])
+    assert cp._optimized  # run() triggered the pass pipeline
+    np.testing.assert_allclose(o, 2.0)
+
+
+def test_fc_rejects_dynamic_feature_dim():
+    main = static.Program()
+    with program_guard(main):
+        x = static.data("x", [None, None], "float32")
+        with pytest.raises(ValueError, match="must be static"):
+            static.nn.fc(x, 10)
+
+
+def test_executor_cache_reuse_after_param_update():
+    main = static.Program()
+    with program_guard(main):
+        x = static.data("x", [1, 2], "float32")
+        lin = paddle.nn.Linear(2, 1)
+        out = lin(x)
+    exe = Executor()
+    xv = np.ones((1, 2), np.float32)
+    (o1,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+    lin.weight.set_value(lin.weight.numpy() + 1.0)  # late binding must see this
+    (o2,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+    np.testing.assert_allclose(o2 - o1, 2.0, rtol=1e-6)
+    assert len(exe._cache) == 1  # same program+signature: one compiled plan
