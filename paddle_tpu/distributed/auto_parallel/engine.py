@@ -72,6 +72,7 @@ class Engine:
         donate: bool = True,
         n_micro: Optional[int] = None,
         pp_remat: Optional[bool] = None,
+        pp_interleave: int = 1,
     ):
         self.model = model
         self.mesh = mesh if mesh is not None else current_mesh()
@@ -88,10 +89,15 @@ class Engine:
         pp_size = self.mesh.shape.get("pp", 1) if self.mesh is not None else 1
         self._pp = pp_size > 1 and hasattr(model, "pipeline_blocks")
         self._blocks = model.pipeline_blocks() if self._pp else []
-        if self._pp and len(self._blocks) % pp_size != 0:
+        self._pp_interleave = pp_interleave if self._pp else 1
+        if self._pp and len(self._blocks) % (pp_size * self._pp_interleave) != 0:
             raise ValueError(
-                f"num blocks {len(self._blocks)} not divisible by pp={pp_size}")
+                f"num blocks {len(self._blocks)} not divisible by "
+                f"pp*interleave={pp_size}*{self._pp_interleave}")
         self._n_micro = n_micro if n_micro is not None else max(pp_size, 1)
+        if self._pp and self._pp_interleave > 1 and self._n_micro % pp_size != 0:
+            raise ValueError(
+                f"VPP needs n_micro % pp == 0, got {self._n_micro} % {pp_size}")
         self._pp_remat = (pp_remat if pp_remat is not None
                           else bool(getattr(getattr(model, "config", None), "recompute", False)))
         block_param_ids = {id(t) for b in self._blocks for _, t in b.named_parameters()}
@@ -123,8 +129,9 @@ class Engine:
                     "custom loss_fn is not supported with pipeline parallelism "
                     "(pp > 1) — the pp path runs model.pipeline_loss")
             with axis_rules(self.mesh, self.rules):
-                stacked, bshard, bnames, bdecay = stack_block_params(
-                    self._blocks, self.mesh)
+                stacked, bshard, bnames, bdecay, self._pp_order = \
+                    stack_block_params(self._blocks, self.mesh,
+                                       interleave=self._pp_interleave)
             self.params = self.params + stacked
             if apply_decay_param_fun is not None:
                 # per-layer decay decisions collapse to the block-level name
@@ -174,7 +181,8 @@ class Engine:
                 res = pipeline_call(
                     self._block_fn, stacked, x, cos, sin,
                     mesh=self.mesh, n_micro=self._n_micro,
-                    remat=self._pp_remat, with_aux=self._pp_with_aux)
+                    remat=self._pp_remat, with_aux=self._pp_with_aux,
+                    interleave=self._pp_interleave)
                 if self._pp_with_aux:
                     # aux is summed per microbatch; average to match the
                     # whole-batch scale of the non-pp path
@@ -280,9 +288,10 @@ class Engine:
             t._data = jnp.copy(a)
         if self._pp:
             per_block = [[t for _, t in b.named_parameters()] for b in self._blocks]
+            # stacked row r holds layer self._pp_order[r] (VPP reordering)
             for i, st in enumerate(self.params[self._n_rest:]):
-                for li in range(len(per_block)):
-                    per_block[li][i]._data = jnp.copy(st[li])
+                for r, li in enumerate(self._pp_order):
+                    per_block[li][i]._data = jnp.copy(st[r])
         return self.model
 
     def state_dict(self):

@@ -111,6 +111,100 @@ def gpipe_schedule(stage_fn: Callable, n_stages: int, axis_name: str = "pp",
     return pipeline
 
 
+def interleaved_schedule(stage_fn: Callable, n_stages: int, interleave: int,
+                         axis_name: str = "pp", with_aux: bool = False):
+    """Interleaved virtual-pipeline (VPP) schedule, run INSIDE shard_map.
+
+    Parity anchor: the reference's dygraph interleaved 1F1B
+    (fleet/meta_parallel/pipeline_parallel.py:1143 PipelineParallelWithInterleave,
+    pp_layers.py get_stage_from_index for the round-robin chunk placement) and
+    the static VPP scheduler pass (distributed/passes/pipeline_scheduler_pass).
+
+    TPU-native redesign: each device holds ``v = interleave`` non-adjacent layer
+    chunks; every microbatch circulates the pp ring v times, one chunk-hop per
+    scan tick. Device d at tick t applies its local chunk
+    ``c = ((t - d) mod v*p) // p`` — a traced per-device index into the chunk-
+    stacked local params — so the whole interleave is still ONE lax.scan +
+    ppermute program and ``jax.grad`` through it is the reverse interleaved
+    schedule. Ticks = v*M + p - 1 of chunk-size work (vs GPipe's M + p - 1 of
+    stage-size work): bubble fraction drops from (p-1)/(M+p-1) to
+    (p-1)/(vM+p-1) — the Megatron-interleave bubble, without a hand-written
+    per-rank state machine. Requires M % p == 0 (same constraint as the
+    reference: accumulate_steps % pp degree == 0).
+
+    Zero-bubble schedules (ZBH1/ZBVPP, pipeline_scheduler_pass/__init__.py:32)
+    split weight-grad from activation-grad compute to fill the drain bubble;
+    that decomposition is not expressible through grad-of-scan — XLA's
+    latency-hiding scheduler instead overlaps the collective-permutes with
+    compute. Documented as intentionally out of scope.
+
+    ``stage_fn(local_params, chunk_idx, h, *bargs)`` must apply chunk
+    ``chunk_idx`` (local params carry a leading [v] chunk dim).
+    """
+    p, v = n_stages, interleave
+    vp = v * p
+    perm = [(i, (i + 1) % p) for i in range(p)]
+
+    def pipeline(params, micro_in, *bargs):
+        n_micro = micro_in.shape[0]
+        d = jax.lax.axis_index(axis_name)
+        total_ticks = v * n_micro + p - 1
+
+        def tick(carry, t):
+            buf, outs, aux_acc = carry
+            cyc = jnp.mod(t - d, vp)
+            c = jnp.clip(cyc // p, 0, v - 1)  # local chunk index this tick
+            # device 0, chunk 0: inject microbatch j = (t//vp)*p + t%p
+            inj_idx = jnp.clip((t // vp) * p + jnp.mod(t, vp), 0, n_micro - 1)
+            inject = jax.lax.dynamic_index_in_dim(micro_in, inj_idx, 0,
+                                                  keepdims=False)
+            h = jnp.where((d == 0) & (cyc < p), inject, buf)
+            with _ManualCtx():
+                res = stage_fn(params, c, h, *bargs)
+            y, aux = res if with_aux else (res, None)
+            # activity mask: entry tick e = t - (c*p + d); real microbatch iff
+            # e lands in an injection window and maps to a valid index
+            e = t - (c * p + d)
+            er = jnp.mod(e, vp)
+            mb = (e // vp) * p + er
+            active = (e >= 0) & (er < p) & (mb < n_micro)
+            if with_aux:
+                aux_acc = aux_acc + jnp.where(active, aux, 0.0)
+            # device p-1, chunk v-1: final output of microbatch mb
+            is_out = (d == p - 1) & (c == v - 1) & active
+            out_idx = jnp.clip(mb, 0, n_micro - 1)
+            prev = jax.lax.dynamic_index_in_dim(outs, out_idx, 0, keepdims=False)
+            outs = jax.lax.dynamic_update_index_in_dim(
+                outs, jnp.where(is_out, y, prev), out_idx, 0)
+            nxt = jax.lax.ppermute(y, axis_name, perm)
+            return (nxt, outs, aux_acc), None
+
+        buf0 = jnp.zeros(micro_in.shape[1:], micro_in.dtype)
+        outs0 = jnp.zeros(micro_in.shape, micro_in.dtype)
+        aux0 = jnp.zeros((), jnp.float32)
+        (_, outs, aux_acc), _ = jax.lax.scan(
+            tick, (buf0, outs0, aux0), jnp.arange(total_ticks))
+        outs = jnp.where(d == p - 1, outs, jnp.zeros_like(outs))
+        outs = jax.lax.psum(outs, axis_name)
+        if with_aux:
+            return outs, jax.lax.psum(aux_acc, axis_name)
+        return outs
+
+    return pipeline
+
+
+def vpp_layer_order(n_layers: int, p: int, v: int):
+    """Layer permutation so a contiguous [L/p] slice per device holds its v
+    round-robin chunks: device d gets virtual stages {c*p + d}."""
+    lc = n_layers // (v * p)
+    order = []
+    for d in range(p):
+        for c in range(v):
+            k = c * p + d
+            order.extend(range(k * lc, (k + 1) * lc))
+    return order
+
+
 def pipeline_call(
     block_fn: Callable,
     stacked_params: Sequence[jax.Array],
@@ -121,6 +215,7 @@ def pipeline_call(
     axis_name: str = "pp",
     remat: bool = False,
     with_aux: bool = False,
+    interleave: int = 1,
 ):
     """Run ``x`` through ``n_layers`` stacked blocks, pipelined over ``axis_name``.
 
@@ -142,11 +237,11 @@ def pipeline_call(
     n_stages = mesh.shape[axis_name]
     blk = jax.checkpoint(block_fn) if remat else block_fn
 
-    def stage_fn(local_params, h, *bargs):
-        # local_params: [layers_per_stage, ...] slices of this stage
+    def _run_layers(wls, h, *bargs):
+        # wls: [n_local_layers, ...] arrays; scan blocks over the leading dim
         def body(carry, i):
             h, aux = carry
-            wl = [w[i] for w in local_params]
+            wl = [w[i] for w in wls]
             res = blk(wl, h, *bargs)
             if with_aux:
                 y, a = res
@@ -154,9 +249,11 @@ def pipeline_call(
             return (res, aux), None
 
         (h, aux), _ = jax.lax.scan(
-            body, (h, jnp.zeros((), jnp.float32)),
-            jnp.arange(local_params[0].shape[0]))
+            body, (h, jnp.zeros((), jnp.float32)), jnp.arange(wls[0].shape[0]))
         return (h, aux) if with_aux else h
+
+    def stage_fn(local_params, h, *bargs):
+        return _run_layers(local_params, h, *bargs)
 
     if n_stages == 1:
         return stage_fn(list(stacked_params), x, *broadcast_args)
@@ -167,7 +264,28 @@ def pipeline_call(
     mb = batch // n_micro
     micro = x.reshape((n_micro, mb) + x.shape[1:])
 
-    pipeline = gpipe_schedule(stage_fn, n_stages, axis_name, with_aux=with_aux)
+    if interleave > 1:
+        n_layers = stacked_params[0].shape[0]
+        if n_layers % (interleave * n_stages) != 0:
+            raise ValueError(
+                f"n_layers {n_layers} not divisible by interleave*pp "
+                f"{interleave}*{n_stages}")
+        if n_micro % n_stages != 0:
+            raise ValueError(
+                f"VPP requires n_micro % pp == 0, got {n_micro} % {n_stages} "
+                f"(reference: accumulate_steps % pp_degree == 0)")
+        lc = n_layers // (interleave * n_stages)
+
+        def chunk_stage_fn(local_params, c, h, *bargs):
+            # local [v*lc, ...] -> select chunk c's [lc, ...] slice
+            wls = [jax.lax.dynamic_slice_in_dim(w, c * lc, lc, 0)
+                   for w in local_params]
+            return _run_layers(wls, h, *bargs)
+
+        pipeline = interleaved_schedule(
+            chunk_stage_fn, n_stages, interleave, axis_name, with_aux=with_aux)
+    else:
+        pipeline = gpipe_schedule(stage_fn, n_stages, axis_name, with_aux=with_aux)
     n_params = len(stacked_params)
     out_specs = (P(), P()) if with_aux else P()
     smapped = jax.shard_map(
@@ -186,7 +304,8 @@ def pipeline_call(
     return res.reshape(x.shape)
 
 
-def stack_block_params(blocks, mesh=None, axis_name: str = "pp"):
+def stack_block_params(blocks, mesh=None, axis_name: str = "pp",
+                       interleave: int = 1):
     """Stack per-block parameter Tensors into ``[n_layers, ...]`` arrays.
 
     Returns (stacked_arrays, shardings, names, decay_mask). All blocks must have
@@ -194,10 +313,19 @@ def stack_block_params(blocks, mesh=None, axis_name: str = "pp"):
     leading dim is sharded over ``axis_name``; trailing dims follow each param's
     logical axes — so pp composes with fsdp/tp sharding of the weights
     (the reference's PP×sharding×MP hybrid, fleet/base/topology.py:70).
+
+    With ``interleave=v > 1`` the layers are stacked in ``vpp_layer_order`` so
+    each device's contiguous slice holds its v round-robin virtual-stage chunks
+    (cf. pp_layers.py get_stage_from_index interleaved placement).
     """
     from jax.sharding import NamedSharding
     from .logical_sharding import logical_to_spec
 
+    if interleave > 1 and mesh is not None:
+        order = vpp_layer_order(len(blocks), mesh.shape[axis_name], interleave)
+        blocks = [blocks[i] for i in order]
+    else:
+        order = list(range(len(blocks)))
     per_block = [[t for _, t in b.named_parameters()] for b in blocks]
     names = [n for n, _ in blocks[0].named_parameters()]
     n_params = len(per_block[0])
@@ -225,4 +353,4 @@ def stack_block_params(blocks, mesh=None, axis_name: str = "pp"):
             shardings.append(None)
         decay.append(arrs[0].ndim >= 2)
         stacked.append(st)
-    return stacked, shardings, names, decay
+    return stacked, shardings, names, decay, order
