@@ -34,6 +34,13 @@ __all__ = [
 ]
 
 
+# op-type keywords marking nondeterministic ops (never folded/CSEd; replayed
+# under a per-run rng_guard by the Executor)
+STOCHASTIC_KEYWORDS = ("rand", "normal", "uniform", "dropout", "bernoulli",
+                       "poisson", "multinomial", "exponential", "randint",
+                       "randperm", "shuffle")
+
+
 class Variable(Tensor):
     """A symbolic tensor inside a Program. ``_data`` holds a jax.ShapeDtypeStruct
     (advisory shapes; -1/None dims are inferred at run time from real feeds)."""
@@ -108,6 +115,21 @@ class Operation:
             a for a in args if isinstance(a, Tensor) and not isinstance(a, Variable)]
         self.outputs: List[Variable] = []
 
+    def _with_fn(self, type: str, fn) -> "Operation":
+        """A copy of this op with a substituted kernel (same args/kwargs/
+        outputs) — used by Program.clone(for_test=True) to swap train-mode
+        kernels for their eval counterparts."""
+        op = Operation.__new__(Operation)
+        op.idx = self.idx
+        op.type = type
+        op.fn = fn
+        op.args = self.args
+        op.kwargs = self.kwargs
+        op.inputs = self.inputs
+        op.captured = self.captured
+        op.outputs = self.outputs
+        return op
+
     def to_string(self):
         ins = ", ".join(v.name for v in self.inputs)
         caps = ", ".join(t.name for t in self.captured)
@@ -173,18 +195,29 @@ class Program:
         return sum(len(b.ops) for b in self.blocks)
 
     def clone(self, for_test: bool = False) -> "Program":
-        import copy
-
         p = Program()
         p.random_seed = self.random_seed
         p._name_counter = self._name_counter
         p._is_test = for_test
+        # pass/training state travels with the clone (aliases/folded constants
+        # keep CSE'd and folded programs executable; loss/optimizer keep a
+        # minimize()d program training)
+        p._aliases = dict(getattr(self, "_aliases", {}))
+        p._folded = dict(getattr(self, "_folded", {}))
+        p._loss = self._loss
+        p._optimizer = self._optimizer
+        p._grad_vars = dict(self._grad_vars)
         blk, src = p.global_block(), self.global_block()
         blk.vars = dict(src.vars)
         blk.ops = list(src.ops)
         if for_test:
-            # test clone: drop train-only stochastic ops where possible
-            blk.ops = [op for op in blk.ops if op.type not in ("dropout_train",)]
+            # test clone: training dropout swaps to its eval kernel (cf.
+            # reference clone(for_test=True) switching op test-mode attrs);
+            # the op stays in place so its output Variables remain defined
+            from ..nn.functional.common import dropout_eval_kernel
+
+            blk.ops = [op._with_fn("dropout_eval", dropout_eval_kernel)
+                       if op.type == "dropout" else op for op in blk.ops]
         return p
 
     def to_string(self, throw_on_error=False, with_details=False) -> str:

@@ -50,6 +50,16 @@ def rng_guard(key):
         stack.pop()
 
 
+_host_counter = [0]
+
+
+def next_host_seed() -> int:
+    """A fresh uint32 host-side seed, reproducible under ``paddle.seed``.
+    Used by the static Executor to parameterize per-run randomness."""
+    _host_counter[0] += 1
+    return (hash((_global["seed"], _host_counter[0]))) & 0xFFFFFFFF
+
+
 def next_key():
     """Produce a fresh PRNG key (splitting the active context or the global state)."""
     stack = _ctx_stack()

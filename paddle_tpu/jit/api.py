@@ -88,6 +88,11 @@ class StaticFunction:
         self._fwd_cache: Dict[Any, Callable] = {}
         self._bwd_cache: Dict[Any, Callable] = {}
         self._last_concrete = None
+        # graph-break state (SOT parity, jit/sot translate.py fallback): when
+        # full_graph=False and tracing fails on value-dependent Python control
+        # flow, the function permanently falls back to eager execution
+        self._full_graph = full_graph
+        self._fallback_eager = False
         functools.update_wrapper(self, self._orig_fn)
 
     @property
@@ -114,6 +119,31 @@ class StaticFunction:
         return pure
 
     def __call__(self, *args, **kwargs):
+        if self._fallback_eager:
+            return self._orig_fn(*args, **kwargs)
+        try:
+            return self._compiled_call(*args, **kwargs)
+        except (jax.errors.TracerBoolConversionError,
+                jax.errors.ConcretizationTypeError,
+                jax.errors.TracerArrayConversionError,
+                jax.errors.TracerIntegerConversionError) as e:
+            # graph break: value-dependent Python control flow inside the
+            # traced region. The reference's SOT splits the bytecode at the
+            # break (sot/opcode_translator); the jax-native equivalent is
+            # whole-function eager fallback — correctness preserved, speed
+            # reverts to op-by-op dispatch.
+            if self._full_graph:
+                raise
+            import warnings
+
+            warnings.warn(
+                f"to_static: graph break in {getattr(self._orig_fn, '__name__', '?')} "
+                f"({type(e).__name__}) — falling back to eager execution. "
+                f"Use paddle.where / lax-style control flow to stay compiled.")
+            self._fallback_eager = True
+            return self._orig_fn(*args, **kwargs)
+
+    def _compiled_call(self, *args, **kwargs):
         layer = self._layer
         state_tensors: List[Tensor] = []
         if layer is not None:

@@ -23,22 +23,32 @@ def linear(x, weight, bias=None, name=None):
     return apply_fn("linear", lambda a, w, b: jnp.matmul(a, w) + b, x, weight, bias, _opdef=_LINEAR)
 
 
-def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
-    if not training or p == 0.0:
-        return apply_fn("dropout_eval", lambda a: a if mode == "upscale_in_train" else a * (1 - p), x)
+def dropout_eval_kernel(a, p=0.5, axis=None, mode="upscale_in_train"):
+    """Test-mode dropout (also substituted in by Program.clone(for_test=True))."""
+    return a if mode == "upscale_in_train" else a * (1 - p)
+
+
+def dropout_train_kernel(a, p=0.5, axis=None, mode="upscale_in_train"):
+    # key drawn INSIDE the kernel: under the static Executor's per-run
+    # rng_guard (traced key) this yields fresh masks every run; eagerly it
+    # advances the global stream exactly as before
     key = next_key()
+    shape = list(a.shape)
+    if axis is not None:
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        shape = [s if i in [ax % a.ndim for ax in axes] else 1
+                 for i, s in enumerate(a.shape)]
+    keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+    if mode == "upscale_in_train":
+        return jnp.where(keep, a / (1.0 - p), jnp.zeros_like(a))
+    return jnp.where(keep, a, jnp.zeros_like(a))
 
-    def fn(a):
-        shape = list(a.shape)
-        if axis is not None:
-            axes = axis if isinstance(axis, (list, tuple)) else [axis]
-            shape = [s if i in [ax % a.ndim for ax in axes] else 1 for i, s in enumerate(a.shape)]
-        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
-        if mode == "upscale_in_train":
-            return jnp.where(keep, a / (1.0 - p), jnp.zeros_like(a))
-        return jnp.where(keep, a, jnp.zeros_like(a))
 
-    return apply_fn("dropout", fn, x)
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    axis = list(axis) if isinstance(axis, (list, tuple)) else axis
+    if not training or p == 0.0:
+        return apply_fn("dropout_eval", dropout_eval_kernel, x, p=p, mode=mode)
+    return apply_fn("dropout", dropout_train_kernel, x, p=p, axis=axis, mode=mode)
 
 
 def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):

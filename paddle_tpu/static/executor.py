@@ -52,6 +52,10 @@ def global_scope() -> Scope:
     return _global_scope
 
 
+def _is_stochastic_type(op_type) -> bool:
+    return any(k in (op_type or "") for k in static_graph.STOCHASTIC_KEYWORDS)
+
+
 class Executor:
     """``Executor(place).run(program, feed, fetch_list)``."""
 
@@ -89,6 +93,7 @@ class Executor:
                     diff_pos[id(p)] = len(diff_params)
                     diff_params.append(p)
 
+        has_stochastic = any(_is_stochastic_type(op.type) for op in ops)
         feed_ids = [id(v) for v in feed_vars]
         fetch_ids = [aliases.get(id(v), id(v)) for v in fetch_vars]
 
@@ -99,7 +104,14 @@ class Executor:
                 return folded[vid]._data
             raise KeyError(f"fetch target {vid} was never computed")
 
-        def replay(feed_arrs, cap_arrs, diff_arrs):
+        def replay(feed_arrs, cap_arrs, diff_arrs, seed):
+            """seed: traced scalar; stochastic ops draw keys from it through
+            the rng_guard context, so every Executor.run gets fresh randomness
+            (dropout masks etc.) without retracing."""
+            import contextlib
+
+            from ..framework.random import rng_guard
+
             env: Dict[int, Any] = dict(zip(feed_ids, feed_arrs))
 
             def resolve(a):
@@ -113,38 +125,40 @@ class Executor:
                         f"Variable '{a.name}' has no value — is it a feed you "
                         f"forgot to pass?")
                 if isinstance(a, Tensor):
-                    i = cap_pos[id(a)]
                     if id(a) in diff_pos:
                         return diff_arrs[diff_pos[id(a)]]
-                    return cap_arrs[i]
+                    return cap_arrs[cap_pos[id(a)]]
                 return a
 
-            for op in ops:
-                out = op.fn(*[resolve(a) for a in op.args], **op.kwargs)
-                if isinstance(out, (tuple, list)):
-                    for v, o in zip(op.outputs, out):
-                        env[id(v)] = o
-                else:
-                    env[id(op.outputs[0])] = out
+            guard = (rng_guard(jax.random.key(seed)) if has_stochastic
+                     else contextlib.nullcontext())
+            with guard:
+                for op in ops:
+                    out = op.fn(*[resolve(a) for a in op.args], **op.kwargs)
+                    if isinstance(out, (tuple, list)):
+                        for v, o in zip(op.outputs, out):
+                            env[id(v)] = o
+                    else:
+                        env[id(op.outputs[0])] = out
             return env
 
         if not train:
-            def fwd(feed_arrs, cap_arrs):
-                env = replay(feed_arrs, cap_arrs, [])
+            def fwd(feed_arrs, cap_arrs, seed):
+                env = replay(feed_arrs, cap_arrs, [], seed)
                 return [lookup(env, i) for i in fetch_ids]
 
             return jax.jit(fwd), caps, diff_params
 
         loss_id = aliases.get(id(program._loss), id(program._loss))
 
-        def loss_and_fetch(diff_arrs, feed_arrs, cap_arrs):
-            env = replay(feed_arrs, cap_arrs, diff_arrs)
+        def loss_and_fetch(diff_arrs, feed_arrs, cap_arrs, seed):
+            env = replay(feed_arrs, cap_arrs, diff_arrs, seed)
             return lookup(env, loss_id), [lookup(env, i) for i in fetch_ids]
 
         vg = jax.value_and_grad(loss_and_fetch, has_aux=True)
 
-        def train_fn(feed_arrs, cap_arrs, diff_arrs):
-            (loss, fetches), grads = vg(diff_arrs, feed_arrs, cap_arrs)
+        def train_fn(feed_arrs, cap_arrs, diff_arrs, seed):
+            (loss, fetches), grads = vg(diff_arrs, feed_arrs, cap_arrs, seed)
             return fetches, grads
 
         return jax.jit(train_fn), caps, diff_params
@@ -162,9 +176,11 @@ class Executor:
             program = static_graph.default_main_program()
         feed = feed or {}
         fetch_list = list(fetch_list or [])
-        if program.num_ops == 0:
+        if program.num_ops == 0 and not fetch_list:
             # startup program: parameter init already ran eagerly (dygraph-style
             # initializers) — nothing to execute. Cf. reference startup programs.
+            # (With a fetch_list the normal path still serves folded constants
+            # and feed variables out of an op-free program.)
             return []
 
         by_name = {v.name: v for v in program.list_vars()}
@@ -187,17 +203,20 @@ class Executor:
             self._cache[key] = self._build(program, feed_vars, fetch_vars, train)
         fn, caps, diff_params = self._cache[key]
         cap_arrs = [t._data for t in caps]
+        from ..framework.random import next_host_seed
+
+        seed = np.uint32(next_host_seed())  # fresh per run, paddle.seed-reproducible
 
         if train:
             fetches, grads = fn(feed_arrs, cap_arrs,
-                                [p._data for p in diff_params])
+                                [p._data for p in diff_params], seed)
             for p, g in zip(diff_params, grads):
                 p._grad = Tensor(g)
             opt = program._optimizer
             opt.step()
             opt.clear_grad()
         else:
-            fetches = fn(feed_arrs, cap_arrs)
+            fetches = fn(feed_arrs, cap_arrs, seed)
 
         sc = scope or _global_scope
         for v, a in zip(fetch_vars, fetches):

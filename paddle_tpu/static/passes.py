@@ -26,12 +26,11 @@ __all__ = ["Pass", "PassManager", "DeadCodeEliminationPass",
            "ConstantFoldingPass", "CommonSubexpressionEliminationPass",
            "apply_default_passes"]
 
-_RANDOM_OPS = ("rand", "normal", "uniform", "dropout", "bernoulli", "poisson",
-               "multinomial", "exponential", "randint", "randperm", "shuffle")
+from ..core.static_graph import STOCHASTIC_KEYWORDS
 
 
 def _is_stochastic(op: Operation) -> bool:
-    return any(k in (op.type or "") for k in _RANDOM_OPS)
+    return any(k in (op.type or "") for k in STOCHASTIC_KEYWORDS)
 
 
 def live_ops(ops, target_ids, aliases=None):

@@ -1,0 +1,81 @@
+"""to_static frontend tests: compile caching, graph-break fallback, save/load.
+
+Reference model: test/dygraph_to_static + test/sot (graph-break behavior,
+jit/sot/translate.py fallback semantics).
+"""
+
+import warnings
+
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+_BREAK_ERRORS = (jax.errors.TracerBoolConversionError,
+                 jax.errors.ConcretizationTypeError)
+
+
+def test_graph_break_falls_back_to_eager():
+    @paddle.jit.to_static(full_graph=False)
+    def f(x):
+        if float(x.sum()) > 0:  # value-dependent python branch
+            return x * 2
+        return x - 1
+
+    x = paddle.to_tensor(np.ones(3, np.float32))
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        out = f(x)
+    assert any("graph break" in str(i.message) for i in w)
+    np.testing.assert_allclose(out.numpy(), 2.0)
+    # both branches work after fallback
+    out2 = f(paddle.to_tensor(-np.ones(3, np.float32)))
+    np.testing.assert_allclose(out2.numpy(), -2.0)
+
+
+def test_full_graph_raises_on_break():
+    @paddle.jit.to_static(full_graph=True)
+    def g(x):
+        if float(x.sum()) > 0:
+            return x
+        return -x
+
+    with pytest.raises(_BREAK_ERRORS):
+        g(paddle.to_tensor(np.ones(3, np.float32)))
+
+
+def test_compiled_layer_trains():
+    lin = paddle.nn.Linear(4, 2)
+    sf = paddle.jit.to_static(lin)
+    out = sf(paddle.to_tensor(np.ones((2, 4), np.float32)))
+    loss = paddle.mean(out ** 2)
+    loss.backward()
+    assert lin.weight.grad is not None
+    assert lin.weight.grad.shape == [4, 2]
+
+
+def test_shape_guard_recompiles():
+    calls = []
+
+    def f(x):
+        calls.append(1)
+        return x * 2
+
+    sf = paddle.jit.to_static(f, full_graph=True)
+    a = sf(paddle.to_tensor(np.ones((2, 3), np.float32)))
+    b = sf(paddle.to_tensor(np.ones((4, 3), np.float32)))  # new shape: retrace
+    assert a.shape == [2, 3] and b.shape == [4, 3]
+    assert len(calls) == 2  # one python trace per signature (jax.jit guard)
+    sf(paddle.to_tensor(np.ones((2, 3), np.float32)))
+    assert len(calls) == 2  # cached
+
+
+def test_jit_save_load(tmp_path):
+    lin = paddle.nn.Linear(3, 2)
+    path = str(tmp_path / "model")
+    paddle.jit.save(lin, path,
+                    input_spec=[paddle.static.InputSpec([4, 3], "float32")])
+    loaded = paddle.jit.load(path)
+    x = paddle.to_tensor(np.random.rand(4, 3).astype(np.float32))
+    np.testing.assert_allclose(loaded(x).numpy(), lin(x).numpy(), rtol=1e-6)

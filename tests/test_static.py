@@ -255,6 +255,59 @@ def test_fc_rejects_dynamic_feature_dim():
             static.nn.fc(x, 10)
 
 
+def test_static_dropout_fresh_mask_per_run():
+    main = static.Program()
+    with program_guard(main):
+        x = static.data("x", [64], "float32")
+        y = paddle.nn.functional.dropout(x, p=0.5, training=True)
+    exe = Executor()
+    xv = np.ones(64, np.float32)
+    (o1,) = exe.run(main, feed={"x": xv}, fetch_list=[y])
+    (o2,) = exe.run(main, feed={"x": xv}, fetch_list=[y])
+    assert not np.array_equal(o1, o2), "dropout mask frozen across runs"
+    # upscale_in_train keeps the expectation ~1
+    assert 0.3 < o1.mean() < 2.0
+
+
+def test_clone_for_test_disables_dropout():
+    main = static.Program()
+    with program_guard(main):
+        x = static.data("x", [32], "float32")
+        y = paddle.nn.functional.dropout(x, p=0.5, training=True) + 1.0
+    test_prog = main.clone(for_test=True)
+    exe = Executor()
+    xv = np.ones(32, np.float32)
+    (o,) = exe.run(test_prog, feed={"x": xv}, fetch_list=[y])
+    np.testing.assert_allclose(o, 2.0)  # identity + 1, no masking
+    # original program still stochastic
+    (t,) = exe.run(main, feed={"x": xv}, fetch_list=[y])
+    assert (t == 1.0).any()
+
+
+def test_clone_preserves_pass_state():
+    main = static.Program()
+    with program_guard(main):
+        x = static.data("x", [2], "float32")
+        c = paddle.ones([2]) * 3.0
+        y = x + c
+    ConstantFoldingPass().apply(main)
+    t = main.clone(for_test=True)
+    exe = Executor()
+    (o,) = exe.run(t, feed={"x": np.zeros(2, np.float32)}, fetch_list=[y])
+    np.testing.assert_allclose(o, 3.0)
+
+
+def test_fetch_from_fully_folded_program():
+    main = static.Program()
+    with program_guard(main):
+        c = paddle.ones([2]) * 3.0
+    ConstantFoldingPass().apply(main)
+    assert main.num_ops == 0
+    exe = Executor()
+    (o,) = exe.run(main, fetch_list=[c])
+    np.testing.assert_allclose(o, 3.0)
+
+
 def test_executor_cache_reuse_after_param_update():
     main = static.Program()
     with program_guard(main):
