@@ -204,20 +204,31 @@ class Program:
         # minimize()d program training)
         p._aliases = dict(getattr(self, "_aliases", {}))
         p._folded = dict(getattr(self, "_folded", {}))
-        p._loss = self._loss
-        p._optimizer = self._optimizer
-        p._grad_vars = dict(self._grad_vars)
+        if not for_test:
+            # a test clone must never train: leaving loss/optimizer behind
+            # keeps Executor.run on the inference path (no grads, no step())
+            p._loss = self._loss
+            p._optimizer = self._optimizer
+            p._grad_vars = dict(self._grad_vars)
         blk, src = p.global_block(), self.global_block()
         blk.vars = dict(src.vars)
         blk.ops = list(src.ops)
         if for_test:
-            # test clone: training dropout swaps to its eval kernel (cf.
-            # reference clone(for_test=True) switching op test-mode attrs);
-            # the op stays in place so its output Variables remain defined
+            # test clone: train-only stochastic ops swap to their eval kernels
+            # (cf. reference clone(for_test=True) switching op test-mode
+            # attrs); ops stay in place so their output Variables remain
+            # defined. alpha_dropout's eval form is identity.
             from ..nn.functional.common import dropout_eval_kernel
 
-            blk.ops = [op._with_fn("dropout_eval", dropout_eval_kernel)
-                       if op.type == "dropout" else op for op in blk.ops]
+            eval_kernels = {
+                "dropout": dropout_eval_kernel,
+                "alpha_dropout": lambda a, **k: a,
+            }
+            blk.ops = [
+                op._with_fn(op.type + "_eval", eval_kernels[op.type])
+                if op.type in eval_kernels else op
+                for op in blk.ops
+            ]
         return p
 
     def to_string(self, throw_on_error=False, with_details=False) -> str:

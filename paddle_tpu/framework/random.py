@@ -16,12 +16,14 @@ import jax
 
 _state = threading.local()
 _global = {"key": jax.random.key(0), "seed": 0}
+_host_counter = [0]
 
 
 def seed(s: int):
     """Set the global RNG seed (paddle.seed)."""
     _global["key"] = jax.random.key(int(s))
     _global["seed"] = int(s)
+    _host_counter[0] = 0  # next_host_seed() restarts: re-seeding reproduces runs
     return _global["seed"]
 
 
@@ -48,9 +50,6 @@ def rng_guard(key):
         yield
     finally:
         stack.pop()
-
-
-_host_counter = [0]
 
 
 def next_host_seed() -> int:

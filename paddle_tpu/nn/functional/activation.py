@@ -164,9 +164,8 @@ def softmax_(x, axis=-1, dtype=None, name=None):
 def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
     from ...framework.random import next_key
 
-    key = next_key()
-
     def fn(a):
+        key = next_key()  # inside the kernel: fresh under static rng_guard
         g = jax.random.gumbel(key, a.shape, a.dtype)
         y = jax.nn.softmax((a + g) / temperature, axis=axis)
         if hard:

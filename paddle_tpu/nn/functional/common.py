@@ -64,9 +64,9 @@ def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
 def alpha_dropout(x, p=0.5, training=True, name=None):
     if not training or p == 0.0:
         return x
-    key = next_key()
 
     def fn(a):
+        key = next_key()  # inside the kernel: fresh under static rng_guard
         alpha = 1.6732632423543772
         scale = 1.0507009873554805
         alpha_p = -alpha * scale

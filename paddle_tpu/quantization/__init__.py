@@ -1,0 +1,259 @@
+"""paddle_tpu.quantization — QAT / PTQ.
+
+Parity anchors: python/paddle/quantization (QuantConfig quanter mapping, QAT
+`qat.py`, PTQ `ptq.py`, observers under quantization/observers, quanted layers
+under nn/quant) in the reference.
+
+TPU-native design:
+  - fake-quant in QAT uses the straight-through estimator expressed as
+    ``x + stop_gradient(q(x) - x)`` — jax autodiff gives the STE gradient for
+    free, no custom backward registration.
+  - converted (deployment) linears run a REAL int8×int8→int32 matmul via
+    ``lax.dot_general(..., preferred_element_type=int32)``, which XLA maps to
+    the MXU's low-precision path, then dequantize by the per-channel scales.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.op_registry import apply_fn
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from .. import nn
+
+__all__ = [
+    "AbsmaxObserver", "MovingAverageAbsmaxObserver", "PerChannelAbsmaxObserver",
+    "QuantConfig", "QAT", "PTQ", "QuantedLinear", "ConvertedLinear",
+    "fake_quant",
+]
+
+
+# ---------------------------------------------------------------------------
+# observers (reference: python/paddle/quantization/observers/abs_max.py)
+# ---------------------------------------------------------------------------
+
+class AbsmaxObserver:
+    """Running max of |x| — per-tensor scale."""
+
+    def __init__(self, quant_bits: int = 8):
+        self.quant_bits = quant_bits
+        self._absmax = 0.0
+
+    def sample(self, x):
+        v = float(jnp.max(jnp.abs(x._data if isinstance(x, Tensor) else x)))
+        self._absmax = max(self._absmax, v)
+
+    def scale(self) -> float:
+        return self._absmax if self._absmax > 0 else 1.0
+
+    def qmax(self) -> int:
+        return 2 ** (self.quant_bits - 1) - 1
+
+
+class MovingAverageAbsmaxObserver(AbsmaxObserver):
+    """EMA of per-batch absmax (reference: moving_average_abs_max quanter)."""
+
+    def __init__(self, quant_bits: int = 8, moving_rate: float = 0.9):
+        super().__init__(quant_bits)
+        self.moving_rate = moving_rate
+        self._initialized = False
+
+    def sample(self, x):
+        v = float(jnp.max(jnp.abs(x._data if isinstance(x, Tensor) else x)))
+        if not self._initialized:
+            self._absmax = v
+            self._initialized = True
+        else:
+            self._absmax = self.moving_rate * self._absmax + (1 - self.moving_rate) * v
+
+
+class PerChannelAbsmaxObserver:
+    """Per-output-channel absmax — for weights (reference: channel_wise_abs_max)."""
+
+    def __init__(self, quant_bits: int = 8, channel_axis: int = -1):
+        self.quant_bits = quant_bits
+        self.channel_axis = channel_axis
+        self._absmax = None
+
+    def sample(self, x):
+        a = jnp.abs(x._data if isinstance(x, Tensor) else x)
+        axes = tuple(i for i in range(a.ndim)
+                     if i != self.channel_axis % a.ndim)
+        v = jnp.max(a, axis=axes)
+        self._absmax = v if self._absmax is None else jnp.maximum(self._absmax, v)
+
+    def scale(self):
+        if self._absmax is None:
+            return jnp.ones((1,), jnp.float32)
+        return jnp.maximum(self._absmax, 1e-8)
+
+    def qmax(self) -> int:
+        return 2 ** (self.quant_bits - 1) - 1
+
+
+# ---------------------------------------------------------------------------
+# fake quant (STE)
+# ---------------------------------------------------------------------------
+
+def _fake_quant_kernel(a, scale, qmax):
+    s = scale / qmax
+    q = jnp.clip(jnp.round(a / s), -qmax, qmax) * s
+    # straight-through estimator: forward q, backward identity
+    return a + jax.lax.stop_gradient(q - a)
+
+
+def fake_quant(x, scale, quant_bits: int = 8):
+    """Simulated quantization with STE gradient."""
+    qmax = 2 ** (quant_bits - 1) - 1
+    scale = jnp.asarray(scale, jnp.float32)
+    return apply_fn("fake_quantize", _fake_quant_kernel, x, scale=scale, qmax=qmax)
+
+
+# ---------------------------------------------------------------------------
+# quanted layers
+# ---------------------------------------------------------------------------
+
+class QuantedLinear(Layer):
+    """Linear with fake-quantized weight (per-channel) and activation
+    (per-tensor, observer-tracked). Reference: nn/quant/qat/linear.py."""
+
+    def __init__(self, linear, activation_observer=None, weight_bits: int = 8,
+                 act_bits: int = 8):
+        super().__init__()
+        self.weight = linear.weight
+        self.bias = linear.bias
+        self._w_obs = PerChannelAbsmaxObserver(weight_bits, channel_axis=-1)
+        self._a_obs = activation_observer or MovingAverageAbsmaxObserver(act_bits)
+        self._act_bits = act_bits
+        self._weight_bits = weight_bits
+        self._calibrating = False  # PTQ: sample observers while model is eval()
+
+    def forward(self, x):
+        if self.training or self._calibrating:
+            self._a_obs.sample(x)
+        xq = fake_quant(x, self._a_obs.scale(), self._act_bits)
+        self._w_obs.sample(self.weight)
+        wq = fake_quant(self.weight, self._w_obs.scale(), self._weight_bits)
+        out = nn.functional.linear(xq, wq, self.bias)
+        return out
+
+
+class ConvertedLinear(Layer):
+    """Deployment linear: int8 weights + real int8 matmul
+    (reference: the converted inference program after PTQ/QAT convert)."""
+
+    def __init__(self, linear, w_scale, a_scale: float, act_bits: int = 8,
+                 weight_bits: int = 8):
+        super().__init__()
+        w = linear.weight._data.astype(jnp.float32)
+        self._w_qmax = 2 ** (weight_bits - 1) - 1
+        self._a_qmax = 2 ** (act_bits - 1) - 1
+        self._w_scale = jnp.asarray(w_scale, jnp.float32)  # [out_features]
+        self._a_scale = float(a_scale)
+        wq = jnp.clip(jnp.round(w / (self._w_scale / self._w_qmax)),
+                      -self._w_qmax, self._w_qmax).astype(jnp.int8)
+        self.register_buffer("qweight", Tensor(wq))
+        self.bias = linear.bias
+
+    def forward(self, x):
+        def fn(a, qw, b=None):
+            a_s = self._a_scale / self._a_qmax
+            aq = jnp.clip(jnp.round(a.astype(jnp.float32) / a_s),
+                          -self._a_qmax, self._a_qmax).astype(jnp.int8)
+            # int8 x int8 -> int32 on the MXU
+            acc = jax.lax.dot_general(
+                aq, qw, (((aq.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            out = acc.astype(jnp.float32) * (a_s * (self._w_scale / self._w_qmax))
+            if b is not None:
+                out = out + b
+            return out.astype(a.dtype)
+
+        if self.bias is not None:
+            return apply_fn("quantized_linear", fn, x, self.qweight, self.bias)
+        return apply_fn("quantized_linear", fn, x, self.qweight)
+
+
+# ---------------------------------------------------------------------------
+# QuantConfig / QAT / PTQ drivers
+# ---------------------------------------------------------------------------
+
+class QuantConfig:
+    """Which layers to quantize, with which observers
+    (reference: python/paddle/quantization/config.py)."""
+
+    def __init__(self, activation=None, weight=None, quant_bits: int = 8):
+        self.activation_factory = activation or (
+            lambda: MovingAverageAbsmaxObserver(quant_bits))
+        self.weight_bits = quant_bits
+        self.act_bits = quant_bits
+        self._types = (nn.Linear,)
+
+    def add_type_config(self, layer_types, activation=None, weight=None):
+        if not isinstance(layer_types, (list, tuple)):
+            layer_types = [layer_types]
+        self._types = tuple(set(self._types) | set(layer_types))
+
+
+def _replace_layers(model: Layer, predicate, build):
+    for name, child in list(model.named_children()):
+        if predicate(child):
+            setattr(model, name, build(child))
+        else:
+            _replace_layers(child, predicate, build)
+    return model
+
+
+class QAT:
+    """Quantization-aware training driver (reference: quantization/qat.py)."""
+
+    def __init__(self, config: Optional[QuantConfig] = None):
+        self.config = config or QuantConfig()
+
+    def quantize(self, model: Layer, inplace: bool = True) -> Layer:
+        cfg = self.config
+        return _replace_layers(
+            model,
+            lambda l: isinstance(l, cfg._types),
+            lambda l: QuantedLinear(l, cfg.activation_factory(),
+                                    cfg.weight_bits, cfg.act_bits))
+
+    def convert(self, model: Layer, inplace: bool = True) -> Layer:
+        cfg = self.config
+        return _replace_layers(
+            model,
+            lambda l: isinstance(l, QuantedLinear),
+            lambda l: ConvertedLinear(l, l._w_obs.scale(), l._a_obs.scale(),
+                                      cfg.act_bits, cfg.weight_bits))
+
+
+class PTQ:
+    """Post-training quantization: insert observers, run calibration batches,
+    convert (reference: quantization/ptq.py)."""
+
+    def __init__(self, config: Optional[QuantConfig] = None):
+        self.config = config or QuantConfig(
+            activation=lambda: AbsmaxObserver(8))
+        self._qat = QAT(self.config)
+
+    def quantize(self, model: Layer, inplace: bool = True) -> Layer:
+        # calibration happens in eval() mode (dropout etc. must be OFF so the
+        # observers see inference-time activations — reference ptq.py does the
+        # same); only the quanted layers' observers are switched on
+        q = self._qat.quantize(model, inplace)
+        q.eval()
+        for _, layer in q.named_sublayers(include_self=True):
+            if isinstance(layer, QuantedLinear):
+                layer._calibrating = True
+        return q
+
+    def convert(self, model: Layer, inplace: bool = True) -> Layer:
+        model.eval()
+        for _, layer in model.named_sublayers(include_self=True):
+            if isinstance(layer, QuantedLinear):
+                layer._calibrating = False
+        return self._qat.convert(model, inplace)

@@ -1,43 +1,158 @@
-"""paddle_tpu.sparse (reference: python/paddle/sparse).
+"""paddle_tpu.sparse (reference: python/paddle/sparse — SparseCooTensor /
+SparseCsrTensor over phi/core/sparse_coo_tensor.h, unary.py ~30 value-wise ops,
+binary.py add/subtract/multiply/divide + matmul/masked_matmul, nn/ ReLU etc.).
 
-TPU-native note: XLA has no native sparse tensors; the reference's SparseCooTensor /
-SparseCsrTensor (phi/core/sparse_coo_tensor.h) are represented here as
-(indices, values, shape) triples with ops implemented via scatter/gather — dense on
-the MXU where it matters (sparse @ dense lowers to a gather + dense matmul).
+TPU-native design: COO tensors are backed by ``jax.experimental.sparse.BCOO``
+— XLA lowers sparse@dense to gather + dense dot (MXU) and keeps everything
+jit-compatible. Value-wise ops that preserve the zero pattern (sin, relu, …)
+run on the values buffer only, like the reference's sparse unary kernels
+(phi/kernels/sparse/unary_kernel.h). CSR is stored as (crows, cols, values)
+and converts through COO for compute, mirroring the reference's
+SparseCsrTensor -> SparseCooTensor casts.
 """
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.experimental import sparse as jsparse
 
 from ..core.tensor import Tensor, unwrap
 
+__all__ = [
+    "SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
+    "sparse_csr_tensor", "matmul", "masked_matmul", "add", "subtract",
+    "multiply", "divide", "is_same_shape", "transpose", "coalesce",
+    "sin", "tan", "asin", "atan", "sinh", "tanh", "asinh", "atanh",
+    "sqrt", "square", "log1p", "abs", "pow", "neg", "expm1", "relu",
+    "relu6", "leaky_relu", "softmax", "cast", "nn",
+]
+
 
 class SparseCooTensor:
+    """COO sparse tensor (reference: phi/core/sparse_coo_tensor.h).
+    ``indices``: [sparse_ndim, nnz]; ``values``: [nnz, ...dense dims]."""
+
     def __init__(self, indices, values, shape):
-        self.indices = indices  # [ndim, nnz]
-        self.values = values  # [nnz, ...]
-        self._shape = list(shape)
+        self.indices = indices if isinstance(indices, Tensor) else Tensor(indices)
+        self.values = values if isinstance(values, Tensor) else Tensor(values)
+        self._shape = [int(s) for s in shape]
+
+    # -- properties --
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    def nnz(self):
+        return int(unwrap(self.values).shape[0])
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    # -- converters --
+    def _bcoo(self) -> jsparse.BCOO:
+        idx = unwrap(self.indices).T  # BCOO wants [nnz, ndim]
+        return jsparse.BCOO((unwrap(self.values), idx),
+                            shape=tuple(self._shape))
+
+    @classmethod
+    def _from_bcoo(cls, m: jsparse.BCOO) -> "SparseCooTensor":
+        return cls(Tensor(m.indices.T), Tensor(m.data), m.shape)
+
+    def to_dense(self) -> Tensor:
+        from ..core.op_registry import apply_fn
+
+        shape = tuple(self._shape)
+        sparse_nd = unwrap(self.indices).shape[0]
+
+        def fn(idx, vals):
+            dense = jnp.zeros(shape[:sparse_nd] + vals.shape[1:], vals.dtype)
+            return dense.at[tuple(idx)].add(vals)
+
+        return apply_fn("sparse_to_dense", fn, self.indices, self.values)
+
+    def to_sparse_csr(self) -> "SparseCsrTensor":
+        if len(self._shape) != 2:
+            raise ValueError("to_sparse_csr supports 2-D tensors")
+        t = coalesce(self)
+        idx = np.asarray(unwrap(t.indices))
+        vals = unwrap(t.values)
+        n_rows = self._shape[0]
+        crows = np.zeros(n_rows + 1, np.int64)
+        np.add.at(crows[1:], idx[0], 1)
+        crows = np.cumsum(crows)
+        return SparseCsrTensor(Tensor(crows), Tensor(idx[1]), Tensor(vals),
+                               self._shape)
+
+    def values_tensor(self):
+        return self.values
+
+    def _replace_values(self, new_values) -> "SparseCooTensor":
+        return SparseCooTensor(self.indices, new_values, self._shape)
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self._shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+class SparseCsrTensor:
+    """CSR sparse tensor (reference: phi/core/sparse_csr_tensor.h)."""
+
+    def __init__(self, crows, cols, values, shape):
+        self.crows = crows if isinstance(crows, Tensor) else Tensor(crows)
+        self.cols = cols if isinstance(cols, Tensor) else Tensor(cols)
+        self.values = values if isinstance(values, Tensor) else Tensor(values)
+        self._shape = [int(s) for s in shape]
 
     @property
     def shape(self):
         return list(self._shape)
 
-    def to_dense(self):
-        idx = unwrap(self.indices)
-        vals = unwrap(self.values)
-        dense = jnp.zeros(tuple(self._shape[: idx.shape[0]]) + tuple(vals.shape[1:]), vals.dtype)
-        return Tensor(dense.at[tuple(idx)].add(vals))
-
-    def values_tensor(self):
-        return self.values
+    @property
+    def dtype(self):
+        return self.values.dtype
 
     def nnz(self):
-        return unwrap(self.values).shape[0]
+        return int(unwrap(self.values).shape[0])
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+    def to_sparse_coo(self, sparse_dim=2) -> SparseCooTensor:
+        crows = np.asarray(unwrap(self.crows))
+        rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
+        indices = Tensor(np.stack([rows, np.asarray(unwrap(self.cols))]))
+        return SparseCooTensor(indices, self.values, self._shape)
+
+    def to_dense(self) -> Tensor:
+        return self.to_sparse_coo().to_dense()
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self._shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
 
 
-def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None, stop_gradient=True):
+# ---------------------------------------------------------------------------
+# constructors
+# ---------------------------------------------------------------------------
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
     indices = indices if isinstance(indices, Tensor) else Tensor(np.asarray(indices))
     values = values if isinstance(values, Tensor) else Tensor(np.asarray(values), dtype=dtype)
     if shape is None:
@@ -46,27 +161,262 @@ def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None, stop_
     return SparseCooTensor(indices, values, shape)
 
 
-def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None, stop_gradient=True):
-    crows_np = np.asarray(unwrap(crows) if isinstance(crows, Tensor) else crows)
-    cols_np = np.asarray(unwrap(cols) if isinstance(cols, Tensor) else cols)
-    rows = np.repeat(np.arange(len(crows_np) - 1), np.diff(crows_np))
-    indices = Tensor(np.stack([rows, cols_np]))
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
     vals = values if isinstance(values, Tensor) else Tensor(np.asarray(values), dtype=dtype)
-    return SparseCooTensor(indices, vals, shape)
+    return SparseCsrTensor(crows, cols, vals, shape)
 
+
+def _coo(x) -> SparseCooTensor:
+    if isinstance(x, SparseCsrTensor):
+        return x.to_sparse_coo()
+    return x
+
+
+# ---------------------------------------------------------------------------
+# structure ops
+# ---------------------------------------------------------------------------
+
+def coalesce(x: SparseCooTensor) -> SparseCooTensor:
+    """Merge duplicate indices (reference: sparse/coalesce kernel)."""
+    x = _coo(x)
+    m = x._bcoo().sum_duplicates(remove_zeros=False)
+    return SparseCooTensor._from_bcoo(m)
+
+
+def transpose(x, perm):
+    x = _coo(x)
+    idx = unwrap(x.indices)
+    new_idx = jnp.stack([idx[p] for p in perm])
+    new_shape = [x.shape[p] for p in perm]
+    return SparseCooTensor(Tensor(new_idx), x.values, new_shape)
+
+
+def is_same_shape(x, y) -> bool:
+    return list(x.shape) == list(y.shape)
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    x = _coo(x)
+    idx = x.indices if index_dtype is None else Tensor(unwrap(x.indices).astype(index_dtype))
+    vals = x.values if value_dtype is None else x.values.astype(value_dtype)
+    return SparseCooTensor(idx, vals, x.shape)
+
+
+# ---------------------------------------------------------------------------
+# matmul
+# ---------------------------------------------------------------------------
 
 def matmul(x, y):
-    """sparse @ dense -> dense (values-gather + segment-sum)."""
-    if isinstance(x, SparseCooTensor):
-        return x.to_dense().matmul(y)
+    """sparse @ dense -> dense via BCOO dot_general (XLA: gather + MXU dot);
+    dense @ sparse and sparse @ sparse supported through the same path."""
+    from ..core.op_registry import apply_fn
+
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        xc = _coo(x)
+        shape = tuple(xc.shape)
+
+        def fn(idx, vals, d):
+            m = jsparse.BCOO((vals, idx.T), shape=shape)
+            return jsparse.bcoo_dot_general(
+                m, d, dimension_numbers=(((1,), (0,)), ((), ())))
+
+        yv = y.to_dense() if isinstance(y, (SparseCooTensor, SparseCsrTensor)) else y
+        return apply_fn("sparse_matmul", fn, xc.indices, xc.values, yv)
+    if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        # dense @ sparse == (sparse.T @ dense.T).T
+        yt = transpose(_coo(y), [1, 0])
+        xt = x.t() if hasattr(x, "t") else Tensor(unwrap(x).T)
+        return matmul(yt, xt).t()
     return x.matmul(y)
 
 
-def add(x, y):
-    xd = x.to_dense() if isinstance(x, SparseCooTensor) else x
-    yd = y.to_dense() if isinstance(y, SparseCooTensor) else y
-    return xd + yd
+def masked_matmul(x, y, mask):
+    """(dense @ dense) sampled at mask's sparsity pattern
+    (reference: sparse/binary.py masked_matmul — the SDDMM kernel)."""
+    from ..core.op_registry import apply_fn
+
+    mask = _coo(mask)
+
+    def fn(idx, xd, yd):
+        rows, cols = idx[0], idx[1]
+        # gather the needed rows/cols, contract feature dim: one fused gather+dot
+        vals = jnp.einsum("nk,nk->n", xd[rows], yd[:, cols].T)
+        return vals
+
+    vals = apply_fn("masked_matmul", fn, mask.indices, x, y)
+    return SparseCooTensor(mask.indices, vals, mask.shape)
+
+
+# ---------------------------------------------------------------------------
+# binary value ops
+# ---------------------------------------------------------------------------
+
+def _union_binary(name, negate):
+    """add/subtract: pattern union via BCOO sum_duplicates. The result keeps a
+    fixed nse = nnz(x)+nnz(y) (duplicates padded with out-of-range indices,
+    which scatter drops in to_dense); ``coalesce()`` compacts eagerly.
+    Autograd flows through the values (apply_fn tape)."""
+
+    def f(x, y):
+        from ..core.op_registry import apply_fn
+
+        if isinstance(x, (SparseCooTensor, SparseCsrTensor)) and isinstance(
+                y, (SparseCooTensor, SparseCsrTensor)):
+            xc, yc = _coo(x), _coo(y)
+            shape = tuple(xc.shape)
+
+            def fn(xi, xv, yi, yv):
+                sv = -yv if negate else yv
+                idx = jnp.concatenate([xi, yi], axis=1)
+                vals = jnp.concatenate([xv, sv], axis=0)
+                m = jsparse.BCOO((vals, idx.T), shape=shape).sum_duplicates(
+                    nse=xv.shape[0] + yv.shape[0])
+                return m.indices.T, m.data
+
+            idx_t, vals_t = apply_fn(f"sparse_{name}", fn, xc.indices,
+                                     xc.values, yc.indices, yc.values)
+            return SparseCooTensor(idx_t, vals_t, shape)
+        xd = x.to_dense() if isinstance(x, (SparseCooTensor, SparseCsrTensor)) else x
+        yd = y.to_dense() if isinstance(y, (SparseCooTensor, SparseCsrTensor)) else y
+        from ..core.op_registry import apply_fn as af
+
+        return af(name, (lambda a, b: a - b) if negate else (lambda a, b: a + b),
+                  xd, yd)
+
+    f.__name__ = name
+    return f
+
+
+def _pattern_binary(name, op):
+    """multiply/divide: evaluated on x's sparsity pattern (the intersection
+    semantics of the reference's sparse elementwise kernels — positions outside
+    x's pattern are structural zeros of the result). y is gathered at x's
+    indices, so no NaN/Inf appears at structural zeros."""
+
+    def f(x, y):
+        from ..core.op_registry import apply_fn
+
+        if isinstance(x, (SparseCooTensor, SparseCsrTensor)) and isinstance(
+                y, (SparseCooTensor, SparseCsrTensor)):
+            xc, yc = _coo(x), _coo(y)
+            shape = tuple(xc.shape)
+
+            def fn(xi, xv, yi, yv):
+                yd = jsparse.BCOO((yv, yi.T), shape=shape).todense()
+                return op(xv, yd[tuple(xi)])
+
+            vals = apply_fn(f"sparse_{name}", fn, xc.indices, xc.values,
+                            yc.indices, yc.values)
+            return SparseCooTensor(xc.indices, vals, shape)
+        xd = x.to_dense() if isinstance(x, (SparseCooTensor, SparseCsrTensor)) else x
+        yd = y.to_dense() if isinstance(y, (SparseCooTensor, SparseCsrTensor)) else y
+        from ..core.op_registry import apply_fn as af
+
+        return af(name, op, xd, yd)
+
+    f.__name__ = name
+    return f
+
+
+add = _union_binary("add", negate=False)
+subtract = _union_binary("subtract", negate=True)
+multiply = _pattern_binary("multiply", lambda a, b: a * b)
+divide = _pattern_binary("divide", lambda a, b: a / b)
+
+
+# ---------------------------------------------------------------------------
+# unary value ops (zero-preserving => operate on values only)
+# ---------------------------------------------------------------------------
+
+def _unary(name, fn):
+    def f(x):
+        from ..core.op_registry import apply_fn
+
+        if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+            target = _coo(x) if not isinstance(x, SparseCsrTensor) else x
+            new_vals = apply_fn(f"sparse_{name}", fn, target.values)
+            if isinstance(target, SparseCsrTensor):
+                return SparseCsrTensor(target.crows, target.cols, new_vals,
+                                       target.shape)
+            return target._replace_values(new_vals)
+        return apply_fn(name, fn, x)
+
+    f.__name__ = name
+    return f
+
+
+sin = _unary("sin", jnp.sin)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+atanh = _unary("atanh", jnp.arctanh)
+sqrt = _unary("sqrt", jnp.sqrt)
+square = _unary("square", jnp.square)
+log1p = _unary("log1p", jnp.log1p)
+abs = _unary("abs", jnp.abs)
+neg = _unary("neg", jnp.negative)
+expm1 = _unary("expm1", jnp.expm1)
+relu = _unary("relu", jax.nn.relu)
+relu6 = _unary("relu6", lambda a: jnp.clip(a, 0, 6))
+
+
+def leaky_relu(x, negative_slope=0.01):
+    return _unary("leaky_relu",
+                  lambda a: jnp.where(a >= 0, a, negative_slope * a))(x)
+
+
+def pow(x, factor):
+    return _unary("pow", lambda a: jnp.power(a, factor))(x)
+
+
+def softmax(x, axis=-1):
+    """Row-wise softmax over the sparsity pattern (reference:
+    sparse/nn/functional softmax — used for sparse attention)."""
+    from ..core.op_registry import apply_fn
+
+    xc = _coo(x) if isinstance(x, (SparseCooTensor, SparseCsrTensor)) else None
+    if xc is None:
+        from ..nn import functional as F
+
+        return F.softmax(x, axis=axis)
+    if len(xc.shape) != 2 or axis not in (-1, 1):
+        raise ValueError("sparse softmax supports 2-D tensors over the last axis")
+    n_rows = xc.shape[0]
+
+    def fn(idx, vals):
+        rows = idx[0]
+        row_max = jax.ops.segment_max(vals, rows, num_segments=n_rows)
+        e = jnp.exp(vals - row_max[rows])
+        denom = jax.ops.segment_sum(e, rows, num_segments=n_rows)
+        return e / denom[rows]
+
+    return xc._replace_values(apply_fn("sparse_softmax", fn, xc.indices, xc.values))
 
 
 class nn:
-    """Sparse NN layers land with the GNN suite; conv3d/subm_conv3d tracked in docs/PARITY.md."""
+    """sparse.nn layers (reference: python/paddle/sparse/nn)."""
+
+    class ReLU:
+        def __call__(self, x):
+            return relu(x)
+
+    class LeakyReLU:
+        def __init__(self, negative_slope=0.01):
+            self.negative_slope = negative_slope
+
+        def __call__(self, x):
+            return leaky_relu(x, self.negative_slope)
+
+        # conv3d/subm_conv3d (point-cloud path) intentionally not implemented:
+        # no MXU-friendly lowering without a gather-scatter conv engine.
+
+    class Softmax:
+        def __init__(self, axis=-1):
+            self.axis = axis
+
+        def __call__(self, x):
+            return softmax(x, self.axis)

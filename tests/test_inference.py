@@ -1,0 +1,61 @@
+"""Inference Predictor tests (reference: test/inference API tests over
+AnalysisPredictor; here: jit.save artifact -> Config -> create_predictor ->
+handle API -> outputs match eager)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import inference
+
+
+@pytest.fixture(scope="module")
+def saved_model(tmp_path_factory):
+    paddle.seed(0)
+    net = paddle.nn.Sequential(
+        paddle.nn.Linear(8, 16), paddle.nn.ReLU(), paddle.nn.Linear(16, 4))
+    path = str(tmp_path_factory.mktemp("infer") / "net")
+    paddle.jit.save(net, path,
+                    input_spec=[paddle.static.InputSpec([2, 8], "float32")])
+    return net, path
+
+
+def test_predictor_handle_api(saved_model):
+    net, path = saved_model
+    config = inference.Config(path)
+    pred = inference.create_predictor(config)
+
+    assert pred.get_input_names() == ["x0"]
+    x = np.random.rand(2, 8).astype(np.float32)
+    h = pred.get_input_handle("x0")
+    h.copy_from_cpu(x)
+    pred.run()
+    out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    ref = net(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_predictor_direct_run(saved_model):
+    net, path = saved_model
+    pred = inference.create_predictor(inference.Config(path))
+    x = np.random.rand(2, 8).astype(np.float32)
+    (out,) = pred.run([x])
+    np.testing.assert_allclose(out, net(paddle.to_tensor(x)).numpy(), rtol=1e-5)
+
+
+def test_predictor_shape_mismatch_raises(saved_model):
+    _, path = saved_model
+    pred = inference.create_predictor(inference.Config(path))
+    with pytest.raises(ValueError, match="exported"):
+        pred.run([np.zeros((3, 8), np.float32)])
+
+
+def test_predictor_bf16(saved_model):
+    net, path = saved_model
+    config = inference.Config(path)
+    config.enable_bf16()
+    pred = inference.create_predictor(config)
+    x = np.random.rand(2, 8).astype(np.float32)
+    (out,) = pred.run([x])
+    ref = net(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(out, ref, rtol=3e-2, atol=3e-2)

@@ -1,0 +1,103 @@
+"""Quantization tests (reference: test/quantization — QAT/PTQ flows).
+
+Strategy: fake-quant error bounds, STE gradient flow, QAT training
+convergence, PTQ calibrate->convert int8 accuracy, real int8 matmul output.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import quantization as Q
+
+
+def test_fake_quant_roundtrip_error():
+    x = paddle.to_tensor(np.linspace(-1, 1, 101).astype(np.float32))
+    y = Q.fake_quant(x, scale=1.0, quant_bits=8)
+    err = np.abs(y.numpy() - x.numpy()).max()
+    assert err <= 0.5 / 127 + 1e-7  # half a quantization step
+
+
+def test_fake_quant_ste_gradient():
+    x = paddle.to_tensor(np.array([0.3, -0.7], np.float32), stop_gradient=False)
+    y = Q.fake_quant(x, scale=1.0)
+    loss = paddle.sum(y * paddle.to_tensor(np.array([1.0, 2.0], np.float32)))
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1.0, 2.0])  # identity STE
+
+
+def test_qat_quantize_replaces_linears():
+    net = paddle.nn.Sequential(
+        paddle.nn.Linear(4, 8), paddle.nn.ReLU(), paddle.nn.Linear(8, 2))
+    q = Q.QAT().quantize(net)
+    kinds = [type(l).__name__ for l in q.children()]
+    assert kinds.count("QuantedLinear") == 2
+
+
+def test_qat_training_converges():
+    rng = np.random.default_rng(0)
+    w_true = rng.standard_normal((8, 1)).astype(np.float32)
+    net = paddle.nn.Linear(8, 1)
+    qat = Q.QAT()
+    qnet = qat.quantize(net)
+    opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                parameters=list(qnet.parameters()))
+    first = last = None
+    for _ in range(100):
+        x = paddle.to_tensor(rng.standard_normal((32, 8)).astype(np.float32))
+        yt = paddle.to_tensor(x.numpy() @ w_true)
+        loss = paddle.mean((qnet(x) - yt) ** 2)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if first is None:
+            first = float(loss)
+        last = float(loss)
+    assert last < first * 0.1, (first, last)
+
+
+def test_ptq_calibrate_convert_accuracy():
+    paddle.seed(1)
+    rng = np.random.default_rng(1)
+    net = paddle.nn.Sequential(
+        paddle.nn.Linear(16, 32), paddle.nn.ReLU(), paddle.nn.Linear(32, 4))
+    ref_in = [rng.standard_normal((8, 16)).astype(np.float32) for _ in range(4)]
+    ref_out = [net(paddle.to_tensor(x)).numpy() for x in ref_in]
+
+    ptq = Q.PTQ()
+    qnet = ptq.quantize(net)
+    for x in ref_in:  # calibration
+        qnet(paddle.to_tensor(x))
+    deployed = ptq.convert(qnet)
+    kinds = [type(l).__name__ for l in deployed.children()]
+    assert kinds.count("ConvertedLinear") == 2
+
+    for x, r in zip(ref_in, ref_out):
+        got = deployed(paddle.to_tensor(x)).numpy()
+        denom = np.abs(r).max() + 1e-6
+        assert np.abs(got - r).max() / denom < 0.05, "int8 error > 5%"
+
+
+def test_ptq_calibrates_in_eval_mode():
+    net = paddle.nn.Sequential(
+        paddle.nn.Linear(4, 8), paddle.nn.Dropout(0.5), paddle.nn.Linear(8, 2))
+    ptq = Q.PTQ()
+    q = ptq.quantize(net)
+    # dropout must be OFF during calibration, observers must still sample
+    drop = [l for l in q.children() if type(l).__name__ == "Dropout"][0]
+    assert not drop.training
+    x = paddle.to_tensor(np.ones((4, 4), np.float32))
+    q(x)
+    first = [l for l in q.children() if type(l).__name__ == "QuantedLinear"][0]
+    assert first._a_obs.scale() == 1.0  # saw the raw (unmasked) activations
+
+
+def test_converted_linear_uses_int8():
+    lin = paddle.nn.Linear(8, 3)
+    conv = Q.ConvertedLinear(lin, w_scale=np.abs(lin.weight.numpy()).max(0),
+                             a_scale=1.0)
+    assert str(conv.qweight.dtype) == "int8"
+    x = paddle.to_tensor(np.random.uniform(-1, 1, (2, 8)).astype(np.float32))
+    out = conv(x)
+    ref = lin(x)
+    assert np.abs(out.numpy() - ref.numpy()).max() < 0.1

@@ -1,0 +1,160 @@
+"""Sparse tensor tests (reference: test/legacy_test sparse op tests) —
+numpy-referenced like the OpTest pattern."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+
+
+def _rand_coo(rng, shape=(6, 8), nnz=10):
+    idx = np.stack([rng.integers(0, shape[0], nnz),
+                    rng.integers(0, shape[1], nnz)])
+    vals = rng.standard_normal(nnz).astype(np.float32)
+    return sparse.sparse_coo_tensor(idx, vals, shape), idx, vals
+
+
+def _dense(idx, vals, shape):
+    d = np.zeros(shape, np.float32)
+    np.add.at(d, tuple(idx), vals)
+    return d
+
+
+def test_coo_roundtrip():
+    rng = np.random.default_rng(0)
+    t, idx, vals = _rand_coo(rng)
+    np.testing.assert_allclose(t.to_dense().numpy(), _dense(idx, vals, (6, 8)))
+    assert t.is_sparse_coo() and not t.is_sparse_csr()
+
+
+def test_csr_roundtrip():
+    crows = [0, 2, 3, 5]
+    cols = [1, 3, 2, 0, 3]
+    vals = [1.0, 2.0, 3.0, 4.0, 5.0]
+    t = sparse.sparse_csr_tensor(crows, cols, vals, [3, 4])
+    ref = np.array([[0, 1, 0, 2], [0, 0, 3, 0], [4, 0, 0, 5]], np.float32)
+    np.testing.assert_allclose(t.to_dense().numpy(), ref)
+    # coo <-> csr
+    coo = t.to_sparse_coo()
+    back = coo.to_sparse_csr()
+    np.testing.assert_allclose(back.to_dense().numpy(), ref)
+
+
+def test_sparse_matmul():
+    rng = np.random.default_rng(1)
+    t, idx, vals = _rand_coo(rng)
+    d = rng.standard_normal((8, 5)).astype(np.float32)
+    out = sparse.matmul(t, paddle.to_tensor(d))
+    np.testing.assert_allclose(out.numpy(), _dense(idx, vals, (6, 8)) @ d,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_masked_matmul():
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((6, 4)).astype(np.float32)
+    y = rng.standard_normal((4, 8)).astype(np.float32)
+    mask, idx, _ = _rand_coo(rng, (6, 8), 12)
+    out = sparse.masked_matmul(paddle.to_tensor(x), paddle.to_tensor(y), mask)
+    full = x @ y
+    np.testing.assert_allclose(np.asarray(out.values.numpy()),
+                               full[tuple(idx)], rtol=1e-5)
+
+
+def test_unary_values_only():
+    rng = np.random.default_rng(3)
+    t, idx, vals = _rand_coo(rng)
+    out = sparse.relu(t)
+    assert out.nnz() == t.nnz()  # pattern preserved
+    np.testing.assert_allclose(out.values.numpy(), np.maximum(vals, 0))
+    out2 = sparse.tanh(t)
+    np.testing.assert_allclose(out2.values.numpy(), np.tanh(vals), rtol=1e-6)
+
+
+def test_add_subtract():
+    rng = np.random.default_rng(4)
+    a, ai, av = _rand_coo(rng)
+    b, bi, bv = _rand_coo(rng)
+    s = sparse.add(a, b)
+    np.testing.assert_allclose(
+        s.to_dense().numpy(),
+        _dense(ai, av, (6, 8)) + _dense(bi, bv, (6, 8)), rtol=1e-6)
+    d = sparse.subtract(a, b)
+    np.testing.assert_allclose(
+        d.to_dense().numpy(),
+        _dense(ai, av, (6, 8)) - _dense(bi, bv, (6, 8)), rtol=1e-6)
+
+
+def test_coalesce_merges_duplicates():
+    idx = np.array([[0, 0, 1], [2, 2, 3]])
+    vals = np.array([1.0, 2.0, 5.0], np.float32)
+    t = sparse.sparse_coo_tensor(idx, vals, [2, 4])
+    c = sparse.coalesce(t)
+    ref = np.zeros((2, 4), np.float32)
+    ref[0, 2] = 3.0
+    ref[1, 3] = 5.0
+    np.testing.assert_allclose(c.to_dense().numpy(), ref)
+
+
+def test_sparse_softmax():
+    rng = np.random.default_rng(5)
+    t, idx, vals = _rand_coo(rng)
+    t = sparse.coalesce(t)  # unique indices for a well-defined pattern
+    out = sparse.softmax(t)
+    d = np.asarray(out.to_dense().numpy())
+    # each nonempty row sums to 1
+    idx2 = np.asarray(t.indices.numpy())
+    for r in np.unique(idx2[0]):
+        np.testing.assert_allclose(d[r].sum(), 1.0, rtol=1e-5)
+
+
+def test_transpose():
+    rng = np.random.default_rng(6)
+    t, idx, vals = _rand_coo(rng)
+    tt = sparse.transpose(t, [1, 0])
+    np.testing.assert_allclose(tt.to_dense().numpy(),
+                               _dense(idx, vals, (6, 8)).T)
+
+
+def test_multiply_divide_pattern_semantics():
+    # multiply/divide evaluate on x's pattern — no NaN at structural zeros
+    xi = np.array([[0, 1], [0, 1]])
+    xv = np.array([2.0, 6.0], np.float32)
+    yi = np.array([[0, 2], [0, 2]])
+    yv = np.array([4.0, 5.0], np.float32)
+    x = sparse.sparse_coo_tensor(xi, xv, [3, 3])
+    y = sparse.sparse_coo_tensor(yi, yv, [3, 3])
+    m = sparse.multiply(x, y)
+    assert m.nnz() == 2
+    np.testing.assert_allclose(m.values.numpy(), [8.0, 0.0])
+    d = sparse.divide(x, y)
+    vals = d.values.numpy()
+    assert not np.isnan(vals).any()
+    np.testing.assert_allclose(vals[0], 0.5)
+
+
+def test_add_grad_flows_through_values():
+    xi = np.array([[0, 1], [0, 1]])
+    x_vals = paddle.to_tensor(np.array([2.0, 6.0], np.float32),
+                              stop_gradient=False)
+    y = sparse.sparse_coo_tensor(np.array([[0], [2]]),
+                                 np.array([1.0], np.float32), [3, 3])
+    x = sparse.SparseCooTensor(paddle.to_tensor(xi), x_vals, [3, 3])
+    s = sparse.add(x, y)
+    loss = paddle.sum(s.values * 2.0)
+    loss.backward()
+    assert x_vals.grad is not None
+    np.testing.assert_allclose(x_vals.grad.numpy(), [2.0, 2.0])
+
+
+def test_matmul_grad_flows():
+    rng = np.random.default_rng(7)
+    t, idx, vals = _rand_coo(rng)
+    d = paddle.to_tensor(rng.standard_normal((8, 5)).astype(np.float32),
+                         stop_gradient=False)
+    out = sparse.matmul(t, d)
+    loss = paddle.sum(out)
+    loss.backward()
+    assert d.grad is not None
+    ref = _dense(idx, vals, (6, 8)).sum(0)[:, None] * np.ones((1, 5))
+    np.testing.assert_allclose(d.grad.numpy(), ref, rtol=1e-5)

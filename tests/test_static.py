@@ -308,6 +308,52 @@ def test_fetch_from_fully_folded_program():
     np.testing.assert_allclose(o, 3.0)
 
 
+def test_test_clone_never_trains():
+    main = static.Program()
+    with program_guard(main):
+        x = static.data("x", [8, 4], "float32")
+        lin = paddle.nn.Linear(4, 1)
+        pred = lin(x)
+        loss = paddle.mean(pred ** 2)
+        paddle.optimizer.SGD(learning_rate=1.0,
+                             parameters=list(lin.parameters())).minimize(loss)
+    t = main.clone(for_test=True)
+    exe = Executor()
+    xv = np.ones((8, 4), np.float32)
+    (o1,) = exe.run(t, feed={"x": xv}, fetch_list=[pred])
+    (o2,) = exe.run(t, feed={"x": xv}, fetch_list=[pred])
+    np.testing.assert_array_equal(o1, o2)  # eval must not move weights
+
+
+def test_static_dropout_reproducible_under_seed():
+    main = static.Program()
+    with program_guard(main):
+        x = static.data("x", [64], "float32")
+        y = paddle.nn.functional.dropout(x, p=0.5, training=True)
+    exe = Executor()
+    xv = np.ones(64, np.float32)
+    paddle.seed(42)
+    (a,) = exe.run(main, feed={"x": xv}, fetch_list=[y])
+    paddle.seed(42)
+    (b,) = exe.run(main, feed={"x": xv}, fetch_list=[y])
+    np.testing.assert_array_equal(a, b)
+
+
+def test_static_alpha_dropout_fresh_and_clonable():
+    main = static.Program()
+    with program_guard(main):
+        x = static.data("x", [64], "float32")
+        y = paddle.nn.functional.alpha_dropout(x, p=0.5, training=True)
+    exe = Executor()
+    xv = np.ones(64, np.float32)
+    (o1,) = exe.run(main, feed={"x": xv}, fetch_list=[y])
+    (o2,) = exe.run(main, feed={"x": xv}, fetch_list=[y])
+    assert not np.array_equal(o1, o2), "alpha_dropout mask frozen"
+    t = main.clone(for_test=True)
+    (e,) = exe.run(t, feed={"x": xv}, fetch_list=[y])
+    np.testing.assert_allclose(e, 1.0)  # identity in test clone
+
+
 def test_executor_cache_reuse_after_param_update():
     main = static.Program()
     with program_guard(main):
