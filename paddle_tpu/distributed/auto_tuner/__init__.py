@@ -1,0 +1,206 @@
+"""paddle_tpu.distributed.auto_tuner — search over parallelism configs.
+
+Parity anchors: the reference's auto-tuner
+(/root/reference/python/paddle/distributed/auto_tuner/tuner.py:21 AutoTuner,
+search.py GridSearch, prune.py divisibility/memory prune rules, recorder.py)
+which greedily trials dp/mp/pp/sharding/micro-batch combinations.
+
+TPU-native redesign: candidates are factorizations of the chip count into
+mesh axes {dp, fsdp, tp, pp, sep} plus microbatch counts. Pruning uses the
+model's shape constraints (heads % tp, layers % pp, seq % sep, batch
+divisibility) and an analytic HBM-fit model; ranking uses a roofline-style
+cost model of per-step compute vs ICI collective volume (the quantities the
+scaling-book recipe says matter). An optional live-trial phase measures real
+step time through the Engine for the top-K analytic candidates.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["TuneConfig", "Candidate", "AutoTuner"]
+
+
+@dataclass
+class TuneConfig:
+    n_devices: int
+    # model shape
+    num_layers: int
+    hidden_size: int
+    num_heads: int
+    seq_len: int
+    global_batch: int
+    vocab_size: int = 32000
+    ffn_mult: float = 8 / 3  # swiglu default
+    # hardware
+    hbm_gb: float = 95.0            # v5p per-chip HBM
+    ici_gbps: float = 1200.0        # bidirectional per-chip ICI bandwidth
+    flops_per_chip: float = 459e12  # bf16 peak
+    # training setup
+    param_bytes: int = 2            # bf16 params
+    opt_state_bytes: int = 8        # fp32 m+v
+    grad_bytes: int = 4
+    remat: bool = True
+    # search space
+    max_pp: int = 8
+    max_tp: int = 8
+    allow_sep: bool = True
+
+
+@dataclass
+class Candidate:
+    axes: Dict[str, int]
+    n_micro: int
+    cost: float = 0.0
+    memory_gb: float = 0.0
+    details: Dict[str, float] = field(default_factory=dict)
+
+    def __repr__(self):
+        a = "x".join(f"{k}{v}" for k, v in self.axes.items() if v > 1) or "single"
+        return (f"Candidate({a}, n_micro={self.n_micro}, "
+                f"cost={self.cost:.3g}, mem={self.memory_gb:.1f}GB)")
+
+
+def _factorizations(n: int, axes: Sequence[str]) -> List[Dict[str, int]]:
+    """All ways to write n as an ordered product over the axes (every divisor,
+    so 12 = dp3×tp4 etc. — non-power-of-two topologies are real)."""
+    if not axes:
+        return [{}] if n == 1 else []
+    out = []
+    head, rest = axes[0], axes[1:]
+    for d in range(1, n + 1):
+        if n % d == 0:
+            for tail in _factorizations(n // d, rest):
+                out.append({head: d, **tail})
+    return out
+
+
+class AutoTuner:
+    """Grid-generate -> prune -> rank -> (optionally) trial.
+
+    >>> tuner = AutoTuner(TuneConfig(n_devices=8, num_layers=16, hidden_size=1024,
+    ...                              num_heads=16, seq_len=2048, global_batch=32))
+    >>> best = tuner.search()           # analytic
+    >>> best = tuner.search(run_fn=f)   # f(Candidate) -> step_time_s, live trials
+    """
+
+    AXES = ("dp", "fsdp", "sep", "tp", "pp")
+
+    def __init__(self, config: TuneConfig):
+        self.cfg = config
+        self.history: List[Tuple[Candidate, float]] = []
+
+    # -- candidate generation (reference: search.py GridSearch) --
+    def candidates(self) -> List[Candidate]:
+        cfg = self.cfg
+        out = []
+        for axes in _factorizations(cfg.n_devices, self.AXES):
+            for n_micro in (1, 2, 4, 8, 16):
+                c = Candidate(axes, n_micro)
+                if self._prune(c) is None:
+                    c.cost = self._cost(c)
+                    out.append(c)
+        out.sort(key=lambda c: c.cost)
+        return out
+
+    # -- prune rules (reference: prune.py) --
+    def _prune(self, c: Candidate) -> Optional[str]:
+        cfg, a = self.cfg, c.axes
+        dp_total = a["dp"] * a["fsdp"]
+        if a["tp"] > cfg.max_tp or a["pp"] > cfg.max_pp:
+            return "axis cap"
+        if cfg.num_heads % a["tp"]:
+            return "heads % tp"
+        if cfg.num_layers % a["pp"]:
+            return "layers % pp"
+        if not cfg.allow_sep and a["sep"] > 1:
+            return "sep disabled"
+        if cfg.seq_len % a["sep"]:
+            return "seq % sep"
+        if cfg.hidden_size % a["tp"]:
+            return "hidden % tp"
+        if cfg.global_batch % (dp_total * c.n_micro):
+            return "batch divisibility"
+        if a["pp"] == 1 and c.n_micro > 1:
+            return "microbatching without pp wastes nothing but trials"
+        if a["pp"] > 1 and c.n_micro < a["pp"]:
+            return "n_micro < pp starves the pipeline"
+        mem = self._memory_gb(c)
+        if mem > cfg.hbm_gb * 0.9:
+            return "exceeds HBM"
+        c.memory_gb = mem
+        return None
+
+    # -- analytic models --
+    def _param_count(self) -> float:
+        cfg = self.cfg
+        h, L = cfg.hidden_size, cfg.num_layers
+        ffn = int(cfg.ffn_mult * h)
+        per_layer = 4 * h * h + 3 * h * ffn + 2 * h  # attn + swiglu + norms
+        return L * per_layer + 2 * cfg.vocab_size * h
+
+    def _memory_gb(self, c: Candidate) -> float:
+        cfg, a = self.cfg, c.axes
+        n_params = self._param_count()
+        shard = a["fsdp"] * a["tp"] * a["pp"]
+        state = n_params * (cfg.param_bytes + cfg.opt_state_bytes
+                            + cfg.grad_bytes) / shard
+        # activations: per microbatch per device; remat keeps ~1 layer live
+        mb = cfg.global_batch // (a["dp"] * a["fsdp"] * max(c.n_micro, 1))
+        seq = cfg.seq_len // a["sep"]
+        layers_live = (1 if cfg.remat else cfg.num_layers / a["pp"])
+        act = mb * seq * cfg.hidden_size * 2 * 16 * layers_live / a["tp"]
+        return (state + act) / 1e9
+
+    def _cost(self, c: Candidate) -> float:
+        """Roofline step-time estimate (seconds): max-ish of compute and the
+        serial collective volumes over ICI."""
+        cfg, a = self.cfg, c.axes
+        n_params = self._param_count()
+        tokens = cfg.global_batch * cfg.seq_len
+        flops = 6.0 * n_params * tokens
+        t_compute = flops / (cfg.flops_per_chip * cfg.n_devices)
+
+        bw = cfg.ici_gbps * 1e9 / 8  # bytes/s, rough
+        # fsdp: allgather params + reduce-scatter grads each step
+        v_fsdp = (2 * n_params * cfg.param_bytes * (a["fsdp"] - 1)
+                  / max(a["fsdp"], 1)) / (a["tp"] * a["pp"])
+        # tp: 2 allreduces of activations per layer (fwd+bwd ~2x)
+        mb_tokens = tokens / (a["dp"] * a["fsdp"] * a["sep"])
+        v_tp = (4 * cfg.num_layers * mb_tokens * cfg.hidden_size
+                * cfg.param_bytes * (a["tp"] - 1) / max(a["tp"], 1)) if a["tp"] > 1 else 0.0
+        # sep: all_to_all around attention per layer
+        v_sep = (2 * cfg.num_layers * mb_tokens * cfg.hidden_size
+                 * cfg.param_bytes) if a["sep"] > 1 else 0.0
+        # pp: bubble fraction extends compute
+        bubble = (a["pp"] - 1) / max(c.n_micro + a["pp"] - 1, 1)
+        t_comm = (v_fsdp + v_tp + v_sep) / bw
+        cost = t_compute * (1 + bubble) + 0.5 * t_comm  # half overlapped
+        c.details = {"t_compute": t_compute, "t_comm": t_comm,
+                     "bubble": bubble}
+        return cost
+
+    # -- search driver (reference: tuner.py AutoTuner.search_once loop) --
+    def search(self, run_fn: Optional[Callable[[Candidate], float]] = None,
+               max_trials: int = 4) -> Candidate:
+        cands = self.candidates()
+        if not cands:
+            raise ValueError("no feasible parallel config for this model/mesh")
+        if run_fn is None:
+            return cands[0]
+        best, best_t = None, math.inf
+        for c in cands[:max_trials]:
+            try:
+                t = float(run_fn(c))
+            except Exception:
+                continue  # OOM/compile failure: skip, like the reference's
+                # error-tolerant trial loop
+            self.history.append((c, t))
+            if t < best_t:
+                best, best_t = c, t
+        if best is None:
+            raise RuntimeError("every live trial failed")
+        return best

@@ -1,0 +1,137 @@
+"""paddle_tpu.distributed.ps — parameter-server training, host-side emulation.
+
+Parity anchors: the reference's brpc PS stack
+(/root/reference/paddle/fluid/distributed/ps/service/brpc_ps_server.h,
+ps/table/memory_sparse_table.h dense/sparse tables with server-side
+optimizers, python/paddle/distributed/ps/ glue).
+
+Scope note (TPU-native): the reference's PS mode exists for CPU-cluster
+trillion-parameter embedding models. On TPU pods the same workload is served
+by sharded embedding tables over ICI (expert/embedding sharding in the SPMD
+engine). This module provides a functional host-side PS — dense/sparse tables
+with server-side SGD/Adagrad, push/pull over the RPC layer — so PS-paradigm
+programs port and small-scale PS jobs run; it intentionally does not
+reimplement brpc/heter-PS scale-out. Cf. SURVEY.md §2 #30/#31.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from . import _tables
+from .. import rpc
+
+__all__ = ["ParameterServer", "PsWorker", "DenseTable", "SparseTable",
+           "run_server", "stop_server"]
+
+DenseTable = _tables.DenseTable
+SparseTable = _tables.SparseTable
+
+
+class ParameterServer:
+    """Holds tables; methods are invoked remotely via the rpc layer."""
+
+    def __init__(self):
+        self._tables: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    # -- table management --
+    def create_dense_table(self, name: str, shape, optimizer="sgd", lr=0.01,
+                           initializer="zeros"):
+        with self._lock:
+            if name not in self._tables:
+                self._tables[name] = DenseTable(shape, optimizer, lr, initializer)
+        return True
+
+    def create_sparse_table(self, name: str, emb_dim: int, optimizer="adagrad",
+                            lr=0.01, init_range=0.01):
+        with self._lock:
+            if name not in self._tables:
+                self._tables[name] = SparseTable(emb_dim, optimizer, lr,
+                                                 init_range)
+        return True
+
+    def _table(self, name):
+        return self._tables[name]
+
+    # -- dense --
+    def pull_dense(self, name: str) -> np.ndarray:
+        return self._table(name).pull()
+
+    def push_dense(self, name: str, grad: np.ndarray):
+        self._table(name).push(grad)
+        return True
+
+    # -- sparse --
+    def pull_sparse(self, name: str, ids: Sequence[int]) -> np.ndarray:
+        return self._table(name).pull(ids)
+
+    def push_sparse(self, name: str, ids: Sequence[int], grads: np.ndarray):
+        self._table(name).push(ids, grads)
+        return True
+
+    def stat(self):
+        return {n: t.stat() for n, t in self._tables.items()}
+
+
+_server: Dict[str, Optional[ParameterServer]] = {"ps": None}
+
+
+def run_server() -> ParameterServer:
+    """Make this rpc worker a parameter server (reference:
+    fleet.init(role).run_server for the PSERVER role)."""
+    if _server["ps"] is None:
+        _server["ps"] = ParameterServer()
+    return _server["ps"]
+
+
+def stop_server():
+    _server["ps"] = None
+
+
+def _dispatch(method: str, *args):
+    ps = _server["ps"]
+    if ps is None:
+        raise RuntimeError("this worker is not a parameter server "
+                           "(call ps.run_server() there)")
+    return getattr(ps, method)(*args)
+
+
+class PsWorker:
+    """Trainer-side handle: push/pull against a named server worker
+    (reference: the fleet worker role using BrpcPsClient)."""
+
+    def __init__(self, server_name: str = "ps0"):
+        self.server = server_name
+
+    def _call(self, method, *args):
+        return rpc.rpc_sync(self.server, _dispatch, args=(method,) + args)
+
+    def create_dense_table(self, name, shape, optimizer="sgd", lr=0.01,
+                           initializer="zeros"):
+        return self._call("create_dense_table", name, list(shape), optimizer,
+                          lr, initializer)
+
+    def create_sparse_table(self, name, emb_dim, optimizer="adagrad", lr=0.01,
+                            init_range=0.01):
+        return self._call("create_sparse_table", name, emb_dim, optimizer, lr,
+                          init_range)
+
+    def pull_dense(self, name) -> np.ndarray:
+        return self._call("pull_dense", name)
+
+    def push_dense(self, name, grad) -> bool:
+        return self._call("push_dense", name, np.asarray(grad))
+
+    def pull_sparse(self, name, ids) -> np.ndarray:
+        return self._call("pull_sparse", name, [int(i) for i in ids])
+
+    def push_sparse(self, name, ids, grads) -> bool:
+        return self._call("push_sparse", name, [int(i) for i in ids],
+                          np.asarray(grads))
+
+    def stat(self):
+        return self._call("stat")

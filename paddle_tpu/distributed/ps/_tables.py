@@ -1,0 +1,91 @@
+"""PS tables with server-side optimizers.
+
+Reference: /root/reference/paddle/fluid/distributed/ps/table/ —
+MemoryDenseTable (dense params + server-side SGD/Adam accessors) and
+MemorySparseTable (lazy-materialized embedding rows with per-row optimizer
+state, the "100B-feature" table). Host numpy here: PS tables live in host
+RAM by design (that is the point of the paradigm).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Sequence
+
+import numpy as np
+
+
+class DenseTable:
+    def __init__(self, shape, optimizer="sgd", lr=0.01, initializer="zeros"):
+        shape = tuple(int(s) for s in shape)
+        if initializer == "zeros":
+            self.value = np.zeros(shape, np.float32)
+        else:
+            rng = np.random.default_rng(0)
+            self.value = rng.uniform(-0.01, 0.01, shape).astype(np.float32)
+        self.optimizer = optimizer
+        self.lr = float(lr)
+        self._acc = np.zeros(shape, np.float32)  # adagrad accumulator
+        self._lock = threading.Lock()
+
+    def pull(self) -> np.ndarray:
+        with self._lock:
+            return self.value.copy()
+
+    def push(self, grad: np.ndarray):
+        g = np.asarray(grad, np.float32)
+        with self._lock:
+            if self.optimizer == "adagrad":
+                self._acc += g * g
+                self.value -= self.lr * g / (np.sqrt(self._acc) + 1e-6)
+            else:  # sgd
+                self.value -= self.lr * g
+
+    def stat(self):
+        return {"kind": "dense", "shape": list(self.value.shape),
+                "optimizer": self.optimizer}
+
+
+class SparseTable:
+    """Lazy embedding rows keyed by int64 feature id (reference:
+    memory_sparse_table.h — rows materialize on first touch)."""
+
+    def __init__(self, emb_dim: int, optimizer="adagrad", lr=0.01,
+                 init_range=0.01):
+        self.emb_dim = int(emb_dim)
+        self.optimizer = optimizer
+        self.lr = float(lr)
+        self.init_range = float(init_range)
+        self._rows: Dict[int, np.ndarray] = {}
+        self._acc: Dict[int, np.ndarray] = {}
+        self._rng = np.random.default_rng(0)
+        self._lock = threading.Lock()
+
+    def _row(self, fid: int) -> np.ndarray:
+        r = self._rows.get(fid)
+        if r is None:
+            r = self._rng.uniform(-self.init_range, self.init_range,
+                                  self.emb_dim).astype(np.float32)
+            self._rows[fid] = r
+            self._acc[fid] = np.zeros(self.emb_dim, np.float32)
+        return r
+
+    def pull(self, ids: Sequence[int]) -> np.ndarray:
+        with self._lock:
+            return np.stack([self._row(int(i)) for i in ids])
+
+    def push(self, ids: Sequence[int], grads: np.ndarray):
+        g = np.asarray(grads, np.float32)
+        with self._lock:
+            for i, fid in enumerate(ids):
+                fid = int(fid)
+                row = self._row(fid)
+                if self.optimizer == "adagrad":
+                    self._acc[fid] += g[i] * g[i]
+                    row -= self.lr * g[i] / (np.sqrt(self._acc[fid]) + 1e-6)
+                else:
+                    row -= self.lr * g[i]
+
+    def stat(self):
+        return {"kind": "sparse", "emb_dim": self.emb_dim,
+                "rows": len(self._rows), "optimizer": self.optimizer}

@@ -1,0 +1,192 @@
+"""paddle_tpu.distributed.rpc — user-level RPC.
+
+Parity anchors: the reference's brpc-backed RpcAgent
+(/root/reference/paddle/fluid/distributed/rpc/rpc_agent.h) and its Python API
+(python/paddle/distributed/rpc/rpc.py: init_rpc / rpc_sync / rpc_async /
+shutdown / get_worker_info).
+
+TPU-native role: the collective fabric is XLA's; RPC serves the *control
+plane* — parameter-server emulation (distributed/ps), custom coordination,
+evaluation services. Implementation: one threaded TCP server per worker
+executing pickled callables, with worker discovery through the TCPStore
+rendezvous (communication/store.py), replacing brpc + etcd.
+
+Trust model: pickle over job-internal sockets — same trust boundary as the
+reference's brpc protobuf channel (any rank can already execute code on any
+other via the training script itself).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import os
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+from ..communication.store import TCPStore
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
+           "get_worker_info", "get_all_worker_infos", "WorkerInfo"]
+
+
+@dataclass
+class WorkerInfo:
+    name: str
+    rank: int
+    ip: str
+    port: int
+
+
+_state: Dict[str, Any] = {"agent": None}
+
+
+def _send_msg(sock: socket.socket, payload: bytes):
+    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv_msg(sock: socket.socket) -> bytes:
+    hdr = b""
+    while len(hdr) < 8:
+        chunk = sock.recv(8 - len(hdr))
+        if not chunk:
+            raise ConnectionError("rpc peer closed")
+        hdr += chunk
+    (n,) = struct.unpack("<Q", hdr)
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("rpc peer closed")
+        buf += chunk
+    return bytes(buf)
+
+
+class _RpcHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        try:
+            payload = _recv_msg(self.request)
+            fn, args, kwargs = pickle.loads(payload)
+            try:
+                result = (True, fn(*args, **kwargs))
+            except Exception as e:  # error travels back to the caller
+                result = (False, e)
+            _send_msg(self.request, pickle.dumps(result))
+        except ConnectionError:
+            pass
+
+
+class _ThreadedServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class RpcAgent:
+    """Per-process agent: a serving thread + client connections to peers."""
+
+    def __init__(self, name: str, rank: int, world_size: int, store: TCPStore):
+        self.name = name
+        self.rank = rank
+        self.world_size = world_size
+        self._store = store
+        self._server = _ThreadedServer(("0.0.0.0", 0), _RpcHandler)
+        self._port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        self._pool = concurrent.futures.ThreadPoolExecutor(max_workers=16)
+
+        ip = os.environ.get("PADDLE_LOCAL_IP", "127.0.0.1")
+        info = WorkerInfo(name, rank, ip, self._port)
+        store.set(f"rpc/worker/{rank}", pickle.dumps(info))
+        store.set(f"rpc/name/{name}", pickle.dumps(info))
+        store.add("rpc/ready", 1)
+        store.wait_ge("rpc/ready", world_size)
+        self._workers: Dict[str, WorkerInfo] = {}
+        for r in range(world_size):
+            w = pickle.loads(store.get(f"rpc/worker/{r}"))
+            self._workers[w.name] = w
+
+    def worker(self, name: str) -> WorkerInfo:
+        if name not in self._workers:
+            raise KeyError(f"unknown rpc worker '{name}' "
+                           f"(known: {sorted(self._workers)})")
+        return self._workers[name]
+
+    def call(self, to: str, fn: Callable, args=(), kwargs=None,
+             timeout: Optional[float] = None):
+        w = self.worker(to)
+        with socket.create_connection((w.ip, w.port), timeout=timeout) as s:
+            _send_msg(s, pickle.dumps((fn, tuple(args), dict(kwargs or {}))))
+            s.settimeout(timeout)
+            ok, result = pickle.loads(_recv_msg(s))
+        if not ok:
+            raise result
+        return result
+
+    def call_async(self, to: str, fn, args=(), kwargs=None, timeout=None):
+        return self._pool.submit(self.call, to, fn, args, kwargs, timeout)
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+        self._pool.shutdown(wait=False)
+
+
+def init_rpc(name: str, rank: Optional[int] = None,
+             world_size: Optional[int] = None,
+             master_endpoint: Optional[str] = None,
+             store: Optional[TCPStore] = None) -> RpcAgent:
+    """Start this process's RPC agent and rendezvous with peers
+    (reference: rpc.py init_rpc; env fallbacks mirror PADDLE_TRAINER_*)."""
+    if _state["agent"] is not None:
+        return _state["agent"]
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) if rank is None else rank
+    world_size = (int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+                  if world_size is None else world_size)
+    if store is None:
+        ep = master_endpoint or os.environ.get("PADDLE_MASTER_ENDPOINT",
+                                               "127.0.0.1:29600")
+        host, port = ep.rsplit(":", 1)
+        store = TCPStore(host, int(port), is_master=(rank == 0),
+                         world_size=world_size)
+    agent = RpcAgent(name, rank, world_size, store)
+    _state["agent"] = agent
+    return agent
+
+
+def _agent() -> RpcAgent:
+    if _state["agent"] is None:
+        raise RuntimeError("call paddle.distributed.rpc.init_rpc first")
+    return _state["agent"]
+
+
+def rpc_sync(to: str, fn: Callable, args=(), kwargs=None, timeout=None):
+    return _agent().call(to, fn, args, kwargs, timeout)
+
+
+def rpc_async(to: str, fn: Callable, args=(), kwargs=None, timeout=None):
+    return _agent().call_async(to, fn, args, kwargs, timeout)
+
+
+def get_worker_info(name: Optional[str] = None) -> WorkerInfo:
+    a = _agent()
+    if name is None:
+        return a._workers[a.name]
+    return a.worker(name)
+
+
+def get_all_worker_infos() -> List[WorkerInfo]:
+    return sorted(_agent()._workers.values(), key=lambda w: w.rank)
+
+
+def shutdown():
+    a = _state["agent"]
+    if a is not None:
+        a.stop()
+        _state["agent"] = None

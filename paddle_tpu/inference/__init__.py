@@ -116,7 +116,6 @@ class Predictor:
         from ..framework import io as fio
 
         self._config = config
-        self._exec_cache: Dict[tuple, object] = {}
 
         if config.model_path is None:
             raise ValueError("Config needs a model path prefix (jit.save output)")
@@ -184,6 +183,9 @@ class Predictor:
             if missing:
                 raise RuntimeError(f"inputs not set: {missing}")
             arrs = [t._value for t in self._inputs]
+        if len(arrs) != len(self._input_avals):
+            raise ValueError(
+                f"expected {len(self._input_avals)} inputs, got {len(arrs)}")
         for a, aval in zip(arrs, self._input_avals):
             if tuple(a.shape) != tuple(aval.shape):
                 raise ValueError(
@@ -201,7 +203,7 @@ class Predictor:
         pass
 
     def try_shrink_memory(self):
-        self._exec_cache.clear()
+        pass  # XLA owns the buffers; nothing framework-side to free
 
 
 def create_predictor(config: Config) -> Predictor:

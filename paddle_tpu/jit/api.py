@@ -278,7 +278,15 @@ def save(layer, path, input_spec=None, **configs):
     else:
         raise TypeError("jit.save expects a Layer or @to_static function")
 
-    state = target.state_dict() if target is not None else {}
+    # save EXACTLY the state list the export closes over (_collect_state:
+    # params + all buffers, incl. non-persistable ones) — state_dict() skips
+    # non-persistable buffers and would desync the Predictor's state/input
+    # split when loading the artifact
+    if target is not None:
+        names, tensors = _collect_state(target)
+        state = dict(zip(names, tensors))
+    else:
+        state = {}
     fio.save(state, path + ".pdiparams")
 
     if input_spec:

@@ -216,14 +216,27 @@ class QAT:
 
     def quantize(self, model: Layer, inplace: bool = True) -> Layer:
         cfg = self.config
-        return _replace_layers(
-            model,
-            lambda l: isinstance(l, cfg._types),
-            lambda l: QuantedLinear(l, cfg.activation_factory(),
-                                    cfg.weight_bits, cfg.act_bits))
+        if not inplace:
+            import copy
+
+            model = copy.deepcopy(model)
+
+        def build(l):
+            if not isinstance(l, nn.Linear):
+                raise NotImplementedError(
+                    f"quantization of {type(l).__name__} is not supported yet "
+                    f"(Linear only — conv QAT tracked in docs/PARITY.md)")
+            return QuantedLinear(l, cfg.activation_factory(),
+                                 cfg.weight_bits, cfg.act_bits)
+
+        return _replace_layers(model, lambda l: isinstance(l, cfg._types), build)
 
     def convert(self, model: Layer, inplace: bool = True) -> Layer:
         cfg = self.config
+        if not inplace:
+            import copy
+
+            model = copy.deepcopy(model)
         return _replace_layers(
             model,
             lambda l: isinstance(l, QuantedLinear),

@@ -334,12 +334,11 @@ def _unary(name, fn):
         from ..core.op_registry import apply_fn
 
         if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
-            target = _coo(x) if not isinstance(x, SparseCsrTensor) else x
-            new_vals = apply_fn(f"sparse_{name}", fn, target.values)
-            if isinstance(target, SparseCsrTensor):
-                return SparseCsrTensor(target.crows, target.cols, new_vals,
-                                       target.shape)
-            return target._replace_values(new_vals)
+            # zero-preserving: the op touches values only; CSR stays CSR
+            new_vals = apply_fn(f"sparse_{name}", fn, x.values)
+            if isinstance(x, SparseCsrTensor):
+                return SparseCsrTensor(x.crows, x.cols, new_vals, x.shape)
+            return x._replace_values(new_vals)
         return apply_fn(name, fn, x)
 
     f.__name__ = name

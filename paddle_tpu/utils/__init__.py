@@ -1,0 +1,15 @@
+"""paddle_tpu.utils (reference: python/paddle/utils)."""
+
+from . import cpp_extension  # noqa: F401
+from .custom_op import custom_op  # noqa: F401
+
+__all__ = ["cpp_extension", "custom_op"]
+
+
+def try_import(name: str):
+    import importlib
+
+    try:
+        return importlib.import_module(name)
+    except ImportError:
+        return None

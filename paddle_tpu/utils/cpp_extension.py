@@ -1,0 +1,146 @@
+"""C++ custom-op extension: compile user C++ at runtime and dispatch it as a
+framework op.
+
+Parity anchors: python/paddle/utils/cpp_extension (load/CppExtension/setup JIT
+build path) and the C++ registration macro PD_BUILD_OP
+(/root/reference/paddle/fluid/framework/custom_operator.cc).
+
+TPU-native contract: XLA owns the device, so arbitrary C++ cannot run ON the
+chip — C++ ops execute on the HOST via ``jax.pure_callback`` (one D2H/H2D
+round-trip per call, same placement as the reference's custom CPU ops). The
+device-speed path for user kernels is ``paddle_tpu.utils.custom_op`` with a
+Pallas body. This module is for host-side logic: C++ tokenizers, samplers,
+reference kernels, legacy code.
+
+C ABI (replaces PD_BUILD_OP macro):
+    extern "C" const char* pt_op_list();       // "relu6,scale2"
+    extern "C" void <name>(const float* x, float* y, int64_t n);
+    extern "C" void <name>_grad(const float* x, const float* gy,
+                                float* gx, int64_t n);   // optional
+Elementwise float32 signature; `<name>_grad`, when exported, wires the op's
+backward (PD_BUILD_GRAD_OP analogue).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.op_registry import apply_fn
+
+__all__ = ["load", "CppExtensionModule"]
+
+_CACHE_DIR = os.path.join(tempfile.gettempdir(), "paddle_tpu_extensions")
+
+
+def _build(name: str, sources: Sequence[str], extra_cflags=()) -> str:
+    os.makedirs(_CACHE_DIR, exist_ok=True)
+    blobs = []
+    for s in sources:
+        with open(s, "rb") as f:
+            blobs.append(f.read())
+    tag = hashlib.sha256(b"\0".join(blobs) + repr(extra_cflags).encode()).hexdigest()[:16]
+    so_path = os.path.join(_CACHE_DIR, f"{name}_{tag}.so")
+    if os.path.exists(so_path):
+        return so_path
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-o", so_path,
+           *extra_cflags, *sources]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(f"cpp_extension build failed:\n{proc.stderr}")
+    return so_path
+
+
+class _HostOp:
+    """One C symbol wrapped as a framework op via pure_callback."""
+
+    def __init__(self, lib, name: str, grad_name: Optional[str]):
+        self._fn = getattr(lib, name)
+        self._fn.argtypes = [ctypes.POINTER(ctypes.c_float),
+                             ctypes.POINTER(ctypes.c_float), ctypes.c_int64]
+        self._fn.restype = None
+        self.name = name
+        self._grad = None
+        if grad_name is not None:
+            g = getattr(lib, grad_name)
+            g.argtypes = [ctypes.POINTER(ctypes.c_float),
+                          ctypes.POINTER(ctypes.c_float),
+                          ctypes.POINTER(ctypes.c_float), ctypes.c_int64]
+            g.restype = None
+            self._grad = g
+
+    def _host_fwd(self, x: np.ndarray) -> np.ndarray:
+        x = np.ascontiguousarray(x, np.float32)
+        y = np.empty_like(x)
+        self._fn(x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                 y.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), x.size)
+        return y
+
+    def _host_bwd(self, x: np.ndarray, gy: np.ndarray) -> np.ndarray:
+        x = np.ascontiguousarray(x, np.float32)
+        gy = np.ascontiguousarray(gy, np.float32)
+        gx = np.empty_like(x)
+        self._grad(x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                   gy.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                   gx.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), x.size)
+        return gx
+
+    def kernel(self):
+        host_fwd, host_bwd = self._host_fwd, self._host_bwd
+
+        def fwd_cb(a):
+            return jax.pure_callback(
+                host_fwd, jax.ShapeDtypeStruct(a.shape, jnp.float32),
+                a.astype(jnp.float32), vmap_method="sequential")
+
+        if self._grad is None:
+            return fwd_cb
+
+        f = jax.custom_vjp(fwd_cb)
+
+        def fwd(a):
+            return fwd_cb(a), a
+
+        def bwd(a, gy):
+            gx = jax.pure_callback(
+                host_bwd, jax.ShapeDtypeStruct(a.shape, jnp.float32),
+                a, gy.astype(jnp.float32), vmap_method="sequential")
+            return (gx,)
+
+        f.defvjp(fwd, bwd)
+        return f
+
+    def __call__(self, x):
+        return apply_fn(f"custom_cpp_{self.name}", self.kernel(), x)
+
+
+class CppExtensionModule:
+    def __init__(self, so_path: str):
+        self._lib = ctypes.CDLL(so_path)
+        self.so_path = so_path
+        self._lib.pt_op_list.restype = ctypes.c_char_p
+        names = self._lib.pt_op_list().decode().split(",")
+        self.op_names: List[str] = [n.strip() for n in names if n.strip()]
+        for n in self.op_names:
+            grad_name = f"{n}_grad" if hasattr(self._lib, f"{n}_grad") else None
+            op = _HostOp(self._lib, n, grad_name)
+            # one kernel instance per op: cache it so jit sees a stable callable
+            kern = op.kernel()
+            setattr(self, n, lambda x, _k=kern, _n=n: apply_fn(
+                f"custom_cpp_{_n}", _k, x))
+
+
+def load(name: str, sources: Sequence[str], extra_cflags=(),
+         verbose: bool = False) -> CppExtensionModule:
+    """JIT-compile C++ sources and expose their ops
+    (reference: cpp_extension.load)."""
+    so = _build(name, sources, tuple(extra_cflags))
+    return CppExtensionModule(so)

@@ -1,0 +1,60 @@
+"""Python/Pallas custom ops — the TPU-native custom-kernel story.
+
+Parity anchor: the reference's PD_BUILD_OP C++ custom operator
+(/root/reference/paddle/fluid/framework/custom_operator.cc) whose point is
+"add an op without rebuilding the framework". On TPU the fast path for a user
+kernel is a Pallas kernel or a jax-traceable function, not C++ — this
+decorator registers either into the one op registry so it dispatches with
+tape/AMP/static-graph semantics like every built-in op.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+
+from ..core import op_registry
+from ..core.op_registry import AMP_NEUTRAL, OpDef, apply_fn
+
+
+def custom_op(name: str, vjp: Optional[Callable] = None,
+              amp: str = AMP_NEUTRAL):
+    """Register a jax-traceable function (jnp code or a Pallas call) as a
+    framework op.
+
+    ``vjp(primals..., cotangent) -> grads...`` if given wires a custom
+    backward (the analogue of PD_BUILD_GRAD_OP); otherwise jax autodiff
+    differentiates through the function body.
+
+    >>> @custom_op("my_gelu")
+    ... def my_gelu(x):
+    ...     return 0.5 * x * (1 + jnp.tanh(0.7978845608 * (x + 0.044715 * x**3)))
+    >>> y = my_gelu(paddle.to_tensor(...))   # tape/AMP/jit-aware
+    """
+
+    def deco(fn):
+        kernel = fn
+        if vjp is not None:
+            wrapped = jax.custom_vjp(fn)
+
+            def fwd(*args):
+                return fn(*args), args
+
+            def bwd(saved, cot):
+                grads = vjp(*saved, cot)
+                return grads if isinstance(grads, tuple) else (grads,)
+
+            wrapped.defvjp(fwd, bwd)
+            kernel = wrapped
+        op_registry.OPS[name] = OpDef(name, kernel, amp=amp, doc=fn.__doc__ or "")
+
+        def call(*args, **kwargs):
+            return apply_fn(name, kernel, *args, **kwargs)
+
+        call.__name__ = name
+        call.__doc__ = fn.__doc__
+        call._kernel = kernel
+        return call
+
+    return deco

@@ -1,0 +1,124 @@
+"""Custom-op tests: Pallas/Python custom_op decorator + C++ cpp_extension
+(reference: test/custom_op — PD_BUILD_OP relu/grad tests)."""
+
+import textwrap
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.utils import custom_op
+from paddle_tpu.utils import cpp_extension
+
+
+# ---------------------------------------------------------------------------
+# python/pallas-path custom ops
+# ---------------------------------------------------------------------------
+
+def test_custom_op_forward_and_autodiff():
+    @custom_op("my_gelu")
+    def my_gelu(x):
+        return 0.5 * x * (1 + jnp.tanh(0.7978845608 * (x + 0.044715 * x ** 3)))
+
+    x = paddle.to_tensor(np.linspace(-2, 2, 9).astype(np.float32),
+                         stop_gradient=False)
+    y = my_gelu(x)
+    loss = paddle.sum(y)
+    loss.backward()
+    assert x.grad is not None
+    # grad of tanh-gelu at 0 is 0.5
+    np.testing.assert_allclose(x.grad.numpy()[4], 0.5, atol=1e-3)
+
+
+def test_custom_op_with_custom_vjp():
+    calls = []
+
+    def my_vjp(x, cot):
+        calls.append(1)
+        return cot * 3.0  # deliberately wrong gradient: proves OUR vjp ran
+
+    @custom_op("triple_grad_relu", vjp=my_vjp)
+    def f(x):
+        return jnp.maximum(x, 0)
+
+    x = paddle.to_tensor(np.array([1.0, -1.0], np.float32), stop_gradient=False)
+    y = f(x)
+    paddle.sum(y).backward()
+    assert calls, "custom vjp was not invoked"
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 3.0])
+
+
+def test_custom_op_registered_in_registry():
+    from paddle_tpu.core.op_registry import OPS
+
+    @custom_op("registry_probe")
+    def f(x):
+        return x + 1
+
+    assert "registry_probe" in OPS
+
+
+# ---------------------------------------------------------------------------
+# C++ extension path
+# ---------------------------------------------------------------------------
+
+_CPP = textwrap.dedent("""
+    #include <cstdint>
+    extern "C" const char* pt_op_list() { return "relu6,scale2"; }
+    extern "C" void relu6(const float* x, float* y, int64_t n) {
+        for (int64_t i = 0; i < n; ++i) {
+            float v = x[i] < 0 ? 0 : x[i];
+            y[i] = v > 6 ? 6 : v;
+        }
+    }
+    extern "C" void relu6_grad(const float* x, const float* gy, float* gx,
+                               int64_t n) {
+        for (int64_t i = 0; i < n; ++i)
+            gx[i] = (x[i] > 0 && x[i] < 6) ? gy[i] : 0;
+    }
+    extern "C" void scale2(const float* x, float* y, int64_t n) {
+        for (int64_t i = 0; i < n; ++i) y[i] = 2 * x[i];
+    }
+""")
+
+
+@pytest.fixture(scope="module")
+def ext(tmp_path_factory):
+    src = tmp_path_factory.mktemp("csrc") / "ops.cc"
+    src.write_text(_CPP)
+    return cpp_extension.load("test_ops", [str(src)])
+
+
+def test_cpp_op_forward(ext):
+    assert ext.op_names == ["relu6", "scale2"]
+    x = paddle.to_tensor(np.array([-1.0, 3.0, 9.0], np.float32))
+    y = ext.relu6(x)
+    np.testing.assert_allclose(y.numpy(), [0.0, 3.0, 6.0])
+    np.testing.assert_allclose(ext.scale2(x).numpy(), [-2.0, 6.0, 18.0])
+
+
+def test_cpp_op_grad(ext):
+    x = paddle.to_tensor(np.array([-1.0, 3.0, 9.0], np.float32),
+                         stop_gradient=False)
+    y = ext.relu6(x)
+    paddle.sum(y).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [0.0, 1.0, 0.0])
+
+
+def test_cpp_op_under_jit(ext):
+    import jax
+
+    @jax.jit
+    def f(a):
+        return ext.relu6(paddle.Tensor(a))._data * 2
+
+    out = f(jnp.asarray([1.0, 7.0], jnp.float32))
+    np.testing.assert_allclose(np.asarray(out), [2.0, 12.0])
+
+
+def test_build_cache_reuses_so(ext, tmp_path):
+    src = tmp_path / "ops.cc"
+    src.write_text(_CPP)
+    again = cpp_extension.load("test_ops", [str(src)])
+    assert again.so_path == ext.so_path  # content-hashed build cache

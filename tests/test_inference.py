@@ -50,6 +50,36 @@ def test_predictor_shape_mismatch_raises(saved_model):
         pred.run([np.zeros((3, 8), np.float32)])
 
 
+def test_predictor_with_non_persistable_buffer(tmp_path):
+    class Net(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = paddle.nn.Linear(4, 2)
+            self.register_buffer("offset",
+                                 paddle.to_tensor(np.ones(2, np.float32)),
+                                 persistable=False)
+
+        def forward(self, x):
+            return self.lin(x) + self.offset
+
+    net = Net()
+    path = str(tmp_path / "buf")
+    paddle.jit.save(net, path,
+                    input_spec=[paddle.static.InputSpec([2, 4], "float32")])
+    pred = inference.create_predictor(inference.Config(path))
+    assert len(pred.get_input_names()) == 1  # buffer is state, not an input
+    x = np.random.rand(2, 4).astype(np.float32)
+    (out,) = pred.run([x])
+    np.testing.assert_allclose(out, net(paddle.to_tensor(x)).numpy(), rtol=1e-5)
+
+
+def test_predictor_arity_check(saved_model):
+    _, path = saved_model
+    pred = inference.create_predictor(inference.Config(path))
+    with pytest.raises(ValueError, match="expected 1 inputs"):
+        pred.run([np.zeros((2, 8), np.float32), np.zeros((2, 8), np.float32)])
+
+
 def test_predictor_bf16(saved_model):
     net, path = saved_model
     config = inference.Config(path)

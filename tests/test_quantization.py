@@ -78,6 +78,22 @@ def test_ptq_calibrate_convert_accuracy():
         assert np.abs(got - r).max() / denom < 0.05, "int8 error > 5%"
 
 
+def test_quantize_inplace_false_preserves_original():
+    net = paddle.nn.Sequential(paddle.nn.Linear(4, 2))
+    q = Q.QAT().quantize(net, inplace=False)
+    assert q is not net
+    assert type(next(iter(net.children()))).__name__ == "Linear"
+    assert type(next(iter(q.children()))).__name__ == "QuantedLinear"
+
+
+def test_quantize_unsupported_type_raises():
+    cfg = Q.QuantConfig()
+    cfg.add_type_config(paddle.nn.Conv2D)
+    net = paddle.nn.Sequential(paddle.nn.Conv2D(3, 8, 3))
+    with pytest.raises(NotImplementedError, match="Conv2D"):
+        Q.QAT(cfg).quantize(net)
+
+
 def test_ptq_calibrates_in_eval_mode():
     net = paddle.nn.Sequential(
         paddle.nn.Linear(4, 8), paddle.nn.Dropout(0.5), paddle.nn.Linear(8, 2))

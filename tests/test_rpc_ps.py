@@ -1,0 +1,153 @@
+"""RPC + parameter-server tests.
+
+Reference model: test/legacy_test rpc tests (multi-process, env-var contract)
+and PS push/pull semantics of ps/table. Here: two real processes rendezvous
+through TCPStore, exchange RPCs, and run a PS train loop.
+"""
+
+import multiprocessing as mp
+import os
+import pickle
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.communication.store import TCPStore
+from paddle_tpu.distributed.ps import ParameterServer
+from paddle_tpu.distributed.ps._tables import DenseTable, SparseTable
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# ---------------------------------------------------------------------------
+# tables (pure host logic)
+# ---------------------------------------------------------------------------
+
+def test_dense_table_sgd():
+    t = DenseTable([4], optimizer="sgd", lr=0.1)
+    t.push(np.ones(4, np.float32))
+    np.testing.assert_allclose(t.pull(), -0.1 * np.ones(4), rtol=1e-6)
+
+
+def test_sparse_table_lazy_rows_adagrad():
+    t = SparseTable(8, optimizer="adagrad", lr=0.1)
+    rows = t.pull([3, 7])
+    assert rows.shape == (2, 8)
+    g = np.ones((2, 8), np.float32)
+    t.push([3, 7], g)
+    after = t.pull([3, 7])
+    # adagrad first step: -lr * g / (|g| + eps) ~ -0.1
+    np.testing.assert_allclose(after - rows, -0.1, rtol=1e-3)
+    assert t.stat()["rows"] == 2
+
+
+def test_parameter_server_local():
+    ps = ParameterServer()
+    ps.create_dense_table("w", [3], optimizer="sgd", lr=0.5)
+    ps.push_dense("w", np.array([1.0, 2.0, 3.0], np.float32))
+    np.testing.assert_allclose(ps.pull_dense("w"), [-0.5, -1.0, -1.5])
+    ps.create_sparse_table("emb", 4)
+    v = ps.pull_sparse("emb", [10, 20])
+    assert v.shape == (2, 4)
+
+
+# ---------------------------------------------------------------------------
+# rpc across real processes
+# ---------------------------------------------------------------------------
+
+def _sq(x):
+    return x * x
+
+
+def _rpc_worker(rank, world, port, q):
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(world)
+    from paddle_tpu.distributed import rpc
+
+    try:
+        rpc.init_rpc(f"worker{rank}", rank, world, f"127.0.0.1:{port}")
+        if rank == 0:
+            out = rpc.rpc_sync("worker1", _sq, args=(7,))
+            fut = rpc.rpc_async("worker1", _sq, args=(9,))
+            infos = rpc.get_all_worker_infos()
+            q.put(("ok", out, fut.result(timeout=30), [w.name for w in infos]))
+        else:
+            time.sleep(2.0)  # stay alive to serve
+        rpc.shutdown()
+    except Exception as e:  # pragma: no cover
+        q.put(("err", repr(e), None, None))
+
+
+def test_rpc_two_processes():
+    port = _free_port()
+    ctx = mp.get_context("fork")
+    q = ctx.Queue()
+    procs = [ctx.Process(target=_rpc_worker, args=(r, 2, port, q))
+             for r in range(2)]
+    for p in procs:
+        p.start()
+    status, out, fut_out, names = q.get(timeout=60)
+    for p in procs:
+        p.join(timeout=30)
+    assert status == "ok", out
+    assert out == 49 and fut_out == 81
+    assert names == ["worker0", "worker1"]
+
+
+# ---------------------------------------------------------------------------
+# full PS train loop across processes: server + trainer
+# ---------------------------------------------------------------------------
+
+def _ps_role(rank, port, q):
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    from paddle_tpu.distributed import ps, rpc
+
+    try:
+        rpc.init_rpc("ps0" if rank == 0 else f"trainer{rank}", rank, 2,
+                     f"127.0.0.1:{port}")
+        if rank == 0:
+            ps.run_server()
+            time.sleep(4.0)  # serve
+        else:
+            w = ps.PsWorker("ps0")
+            w.create_dense_table("w", [2], optimizer="sgd", lr=0.1)
+            rng = np.random.default_rng(0)
+            w_true = np.array([1.5, -2.0], np.float32)
+            loss = None
+            for _ in range(60):
+                wv = w.pull_dense("w")
+                x = rng.standard_normal((16, 2)).astype(np.float32)
+                err = x @ wv - x @ w_true
+                loss = float((err ** 2).mean())
+                grad = 2 * x.T @ err / len(x)
+                w.push_dense("w", grad)
+            # sparse path through rpc too
+            w.create_sparse_table("emb", 4)
+            rows = w.pull_sparse("emb", [1, 2, 3])
+            w.push_sparse("emb", [1, 2, 3], np.ones((3, 4), np.float32))
+            q.put(("ok", loss, w.pull_dense("w"), rows.shape))
+        rpc.shutdown()
+    except Exception as e:  # pragma: no cover
+        q.put(("err", repr(e), None, None))
+
+
+def test_ps_train_loop_two_processes():
+    port = _free_port()
+    ctx = mp.get_context("fork")
+    q = ctx.Queue()
+    procs = [ctx.Process(target=_ps_role, args=(r, port, q)) for r in range(2)]
+    for p in procs:
+        p.start()
+    status, loss, w_final, emb_shape = q.get(timeout=90)
+    for p in procs:
+        p.join(timeout=30)
+    assert status == "ok", loss
+    assert loss < 0.05, f"PS training did not converge: {loss}"
+    np.testing.assert_allclose(w_final, [1.5, -2.0], atol=0.15)
+    assert emb_shape == (3, 4)
