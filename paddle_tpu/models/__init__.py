@@ -6,7 +6,11 @@ semi_auto_llama.py:33, test/auto_parallel GPT tests). Here the model families ar
 first-class: mesh-aware (logical-axis sharding), remat-capable, jit-first.
 """
 
-from . import llama  # noqa: F401
+from . import bert  # noqa: F401
 from . import gpt  # noqa: F401
-from .llama import LlamaConfig, LlamaForCausalLM, LlamaModel  # noqa: F401
+from . import llama  # noqa: F401
+from . import unet  # noqa: F401
+from .bert import BertConfig, BertForMaskedLM, BertForSequenceClassification  # noqa: F401
 from .gpt import GPTConfig, GPTForCausalLM  # noqa: F401
+from .llama import LlamaConfig, LlamaForCausalLM, LlamaModel  # noqa: F401
+from .unet import UNet2DConditionModel, UNetConfig  # noqa: F401

@@ -1,0 +1,273 @@
+"""BERT family — bidirectional encoder (bench config #2: BERT/ERNIE fine-tune).
+
+Parity anchor: the reference exercises BERT/ERNIE through its AMP + fleet
+tests (cf. /root/reference/python/paddle/amp/auto_cast.py:1014 usage docs,
+test/collective/fleet hybrid tests); architecture follows the canonical
+encoder: learned positions + token types, post-LN transformer, gelu FFN,
+pooler, MLM + sequence-classification heads.
+
+Same TPU-native convention as llama/modeling.py: plain Layers with logical
+axis annotations; tp/fsdp/sep sharding comes from mesh rules + GSPMD.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...distributed.auto_parallel.logical_sharding import annotate, constrain, current_mesh
+from ...nn import functional as F
+from ...nn import initializer as I
+from ...nn.layer.layers import Layer, LayerList
+
+
+class BertConfig:
+    def __init__(self, vocab_size=30522, hidden_size=768,
+                 num_hidden_layers=12, num_attention_heads=12,
+                 intermediate_size=3072, max_position_embeddings=512,
+                 type_vocab_size=2, layer_norm_eps=1e-12,
+                 hidden_dropout_prob=0.1, attention_probs_dropout_prob=0.1,
+                 initializer_range=0.02, dtype="float32", recompute=False,
+                 num_labels=2):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size
+        self.max_position_embeddings = max_position_embeddings
+        self.type_vocab_size = type_vocab_size
+        self.layer_norm_eps = layer_norm_eps
+        self.hidden_dropout_prob = hidden_dropout_prob
+        self.attention_probs_dropout_prob = attention_probs_dropout_prob
+        self.initializer_range = initializer_range
+        self.dtype = dtype
+        self.recompute = recompute
+        self.num_labels = num_labels
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+    def num_params(self) -> int:
+        h, v = self.hidden_size, self.vocab_size
+        per_layer = 4 * h * h + 2 * h * self.intermediate_size + 13 * h
+        emb = (v + self.max_position_embeddings + self.type_vocab_size) * h
+        return emb + self.num_hidden_layers * per_layer + 2 * h * h
+
+    @classmethod
+    def tiny(cls, **over):
+        d = dict(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                 num_attention_heads=4, intermediate_size=128,
+                 max_position_embeddings=128, hidden_dropout_prob=0.0,
+                 attention_probs_dropout_prob=0.0)
+        d.update(over)
+        return cls(**d)
+
+
+def _mk(layer, shape, config, init=None):
+    init = init or I.Normal(std=config.initializer_range)
+    return layer.create_parameter(shape, dtype=config.dtype,
+                                  default_initializer=init)
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        h = config.hidden_size
+        self.word_embeddings_weight = annotate(
+            _mk(self, [config.vocab_size, h], config), "vocab", "embed")
+        self.position_embeddings_weight = annotate(
+            _mk(self, [config.max_position_embeddings, h], config), None, "embed")
+        self.token_type_embeddings_weight = annotate(
+            _mk(self, [config.type_vocab_size, h], config), None, "embed")
+        self.ln_weight = _mk(self, [h], config, I.Constant(1.0))
+        self.ln_bias = _mk(self, [h], config, I.Constant(0.0))
+
+    def forward(self, input_ids, token_type_ids=None):
+        ids = input_ids._data if isinstance(input_ids, Tensor) else input_ids
+        s = ids.shape[1]
+        x = jnp.take(self.word_embeddings_weight._data, ids, axis=0)
+        x = x + self.position_embeddings_weight._data[:s][None]
+        if token_type_ids is not None:
+            tt = token_type_ids._data if isinstance(token_type_ids, Tensor) else token_type_ids
+            x = x + jnp.take(self.token_type_embeddings_weight._data, tt, axis=0)
+        x = _layer_norm(x, self.ln_weight._data, self.ln_bias._data,
+                        self.config.layer_norm_eps)
+        x = _maybe_dropout(x, self.config.hidden_dropout_prob, self.training)
+        return constrain(x, "batch", "seq", "embed")
+
+
+def _layer_norm(x, w, b, eps):
+    xf = x.astype(jnp.float32)
+    mean = xf.mean(-1, keepdims=True)
+    var = xf.var(-1, keepdims=True)
+    return ((xf - mean) * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w + b
+
+
+def _maybe_dropout(x, p, training):
+    if not training or p == 0.0:
+        return x
+    from ...framework.random import next_key
+
+    keep = jax.random.bernoulli(next_key(), 1.0 - p, x.shape)
+    return jnp.where(keep, x / (1.0 - p), jnp.zeros_like(x))
+
+
+def _encoder_attention(q, k, v, config):
+    """Bidirectional SDPA; Pallas flash kernel on a bare TPU, XLA path
+    otherwise (mesh sharding handled by GSPMD through constrain specs)."""
+    from ...nn.functional.flash_attention import _xla_attention
+
+    mesh = current_mesh()
+    if (mesh is None or mesh.size == 1) and jax.devices()[0].platform == "tpu":
+        from ...ops.flash_attention import flash_attention as fa
+
+        return fa(q, k, v, causal=False)
+    return _xla_attention(q, k, v, causal=False)
+
+
+class BertSelfAttention(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        h, nh, hd = config.hidden_size, config.num_attention_heads, config.head_dim
+        self.num_heads, self.hd = nh, hd
+        self.q_weight = annotate(_mk(self, [h, h], config), "embed", "heads")
+        self.q_bias = _mk(self, [h], config, I.Constant(0.0))
+        self.k_weight = annotate(_mk(self, [h, h], config), "embed", "heads")
+        self.k_bias = _mk(self, [h], config, I.Constant(0.0))
+        self.v_weight = annotate(_mk(self, [h, h], config), "embed", "heads")
+        self.v_bias = _mk(self, [h], config, I.Constant(0.0))
+        self.out_weight = annotate(_mk(self, [h, h], config), "heads", "embed")
+        self.out_bias = _mk(self, [h], config, I.Constant(0.0))
+
+    def forward(self, x):
+        x = x._data if isinstance(x, Tensor) else x
+        b, s, h = x.shape
+        nh, hd = self.num_heads, self.hd
+        q = (jnp.matmul(x, self.q_weight._data) + self.q_bias._data).reshape(b, s, nh, hd)
+        k = (jnp.matmul(x, self.k_weight._data) + self.k_bias._data).reshape(b, s, nh, hd)
+        v = (jnp.matmul(x, self.v_weight._data) + self.v_bias._data).reshape(b, s, nh, hd)
+        q = constrain(q, "batch", "seq", "heads", "head_dim")
+        k = constrain(k, "batch", "seq", "heads", "head_dim")
+        v = constrain(v, "batch", "seq", "heads", "head_dim")
+        out = _encoder_attention(q, k, v, self.config)
+        out = out.reshape(b, s, h)
+        out = jnp.matmul(out, self.out_weight._data) + self.out_bias._data
+        return constrain(out, "batch", "seq", "embed")
+
+
+class BertLayer(Layer):
+    """Post-LN encoder block (original BERT ordering)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        h, m = config.hidden_size, config.intermediate_size
+        self.attention = BertSelfAttention(config)
+        self.attn_ln_weight = _mk(self, [h], config, I.Constant(1.0))
+        self.attn_ln_bias = _mk(self, [h], config, I.Constant(0.0))
+        self.inter_weight = annotate(_mk(self, [h, m], config), "embed", "mlp")
+        self.inter_bias = _mk(self, [m], config, I.Constant(0.0))
+        self.out_weight = annotate(_mk(self, [m, h], config), "mlp", "embed")
+        self.out_bias = _mk(self, [h], config, I.Constant(0.0))
+        self.out_ln_weight = _mk(self, [h], config, I.Constant(1.0))
+        self.out_ln_bias = _mk(self, [h], config, I.Constant(0.0))
+
+    def forward(self, x):
+        x = x._data if isinstance(x, Tensor) else x
+        eps = self.config.layer_norm_eps
+        a = self.attention(x)
+        a = _maybe_dropout(a, self.config.hidden_dropout_prob, self.training)
+        x = _layer_norm(x + a, self.attn_ln_weight._data,
+                        self.attn_ln_bias._data, eps)
+        f = jnp.matmul(x, self.inter_weight._data) + self.inter_bias._data
+        f = jax.nn.gelu(f, approximate=False)
+        f = constrain(f, "batch", "seq", "mlp")
+        f = jnp.matmul(f, self.out_weight._data) + self.out_bias._data
+        f = _maybe_dropout(f, self.config.hidden_dropout_prob, self.training)
+        x = _layer_norm(x + f, self.out_ln_weight._data,
+                        self.out_ln_bias._data, eps)
+        return constrain(x, "batch", "seq", "embed")
+
+
+class BertModel(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        self.layers = LayerList([BertLayer(config)
+                                 for _ in range(config.num_hidden_layers)])
+        h = config.hidden_size
+        self.pooler_weight = annotate(_mk(self, [h, h], config), "embed", None)
+        self.pooler_bias = _mk(self, [h], config, I.Constant(0.0))
+
+    def forward(self, input_ids, token_type_ids=None):
+        x = self.embeddings(input_ids, token_type_ids)
+        x = x._data if isinstance(x, Tensor) else x
+        for layer in self.layers:
+            if self.config.recompute and self.training:
+                x = jax.checkpoint(lambda a, _l=layer: _unwrap(_l(a)))(x)
+            else:
+                x = _unwrap(layer(x))
+        pooled = jnp.tanh(jnp.matmul(x[:, 0], self.pooler_weight._data)
+                          + self.pooler_bias._data)
+        return x, pooled
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+class BertForMaskedLM(Layer):
+    """MLM head tied to the word embeddings (bench config #2 pretrain-style)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.bert = BertModel(config)
+        self.mlm_bias = _mk(self, [config.vocab_size], config, I.Constant(0.0))
+
+    def forward(self, input_ids, token_type_ids=None):
+        x, _ = self.bert(input_ids, token_type_ids)
+        logits = jnp.matmul(x, self.bert.embeddings.word_embeddings_weight._data.T)
+        return logits + self.mlm_bias._data
+
+    def loss_fn(self, input_ids, labels):
+        """Masked-LM CE; label -100 positions are ignored (HF convention)."""
+        logits = self.forward(input_ids)
+        logits = _unwrap(logits).astype(jnp.float32)
+        lbl = _unwrap(labels)
+        mask = (lbl != -100)
+        safe = jnp.where(mask, lbl, 0)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        denom = jnp.maximum(mask.sum(), 1)
+        return jnp.where(mask, nll, 0.0).sum() / denom
+
+
+class BertForSequenceClassification(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.bert = BertModel(config)
+        self.classifier_weight = _mk(self, [config.hidden_size,
+                                            config.num_labels], config)
+        self.classifier_bias = _mk(self, [config.num_labels], config,
+                                   I.Constant(0.0))
+
+    def forward(self, input_ids, token_type_ids=None):
+        _, pooled = self.bert(input_ids, token_type_ids)
+        pooled = _maybe_dropout(pooled, self.config.hidden_dropout_prob,
+                                self.training)
+        return jnp.matmul(pooled, self.classifier_weight._data) + self.classifier_bias._data
+
+    def loss_fn(self, input_ids, labels):
+        logits = _unwrap(self.forward(input_ids)).astype(jnp.float32)
+        lbl = _unwrap(labels)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.take_along_axis(logp, lbl[..., None], axis=-1).mean()

@@ -1,0 +1,1 @@
+from .modeling import UNet2DConditionModel, UNetConfig, timestep_embedding  # noqa: F401

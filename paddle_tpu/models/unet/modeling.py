@@ -1,0 +1,259 @@
+"""Diffusion UNet (bench config #5: Stable-Diffusion-class UNet through the
+compiler path).
+
+Reference anchor: the reference's bench target exercises conv + cross-
+attention through CINN (/root/reference/paddle/fluid/pir/transforms/
+build_cinn_pass.cc:31); here the whole UNet is one XLA program — conv (lax),
+GroupNorm, SiLU, timestep embeddings, self+cross attention mid-blocks.
+
+Compact UNet2DConditionModel shape: down blocks (res+attn, downsample),
+mid (res, cross-attn, res), up blocks with skip concats.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...nn import initializer as I
+from ...nn.layer.layers import Layer, LayerList
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+class UNetConfig:
+    def __init__(self, in_channels=4, out_channels=4,
+                 block_channels=(128, 256, 512), layers_per_block=2,
+                 num_heads=8, cross_attention_dim=768, groups=32,
+                 dtype="float32", recompute=False):
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        self.block_channels = tuple(block_channels)
+        self.layers_per_block = layers_per_block
+        self.num_heads = num_heads
+        self.cross_attention_dim = cross_attention_dim
+        self.groups = groups
+        self.dtype = dtype
+        self.recompute = recompute
+
+    @classmethod
+    def tiny(cls, **over):
+        d = dict(in_channels=4, out_channels=4, block_channels=(32, 64),
+                 layers_per_block=1, num_heads=4, cross_attention_dim=32,
+                 groups=8)
+        d.update(over)
+        return cls(**d)
+
+
+def timestep_embedding(t, dim: int):
+    """Sinusoidal timestep embedding [b] -> [b, dim] (DDPM convention)."""
+    half = dim // 2
+    freqs = jnp.exp(-math.log(10000.0) * jnp.arange(half, dtype=jnp.float32) / half)
+    args = t.astype(jnp.float32)[:, None] * freqs[None]
+    return jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+
+
+def _conv(x, w, b, stride=1, padding=1):
+    out = jax.lax.conv_general_dilated(
+        x, w, (stride, stride), [(padding, padding)] * 2,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    return out + b[None, :, None, None]
+
+
+def _group_norm(x, w, b, groups, eps=1e-5):
+    n, c, h, wd = x.shape
+    g = min(groups, c)
+    xf = x.astype(jnp.float32).reshape(n, g, c // g, h, wd)
+    mu = xf.mean((2, 3, 4), keepdims=True)
+    var = xf.var((2, 3, 4), keepdims=True)
+    xf = (xf - mu) * jax.lax.rsqrt(var + eps)
+    return (xf.reshape(n, c, h, wd).astype(x.dtype) * w[None, :, None, None]
+            + b[None, :, None, None])
+
+
+class ResBlock(Layer):
+    def __init__(self, cfg: UNetConfig, cin: int, cout: int, temb_dim: int):
+        super().__init__()
+        self.cfg = cfg
+        init = I.KaimingNormal()
+        mk = lambda shape, ini=init: self.create_parameter(
+            shape, dtype=cfg.dtype, default_initializer=ini)
+        self.norm1_w = mk([cin], I.Constant(1.0))
+        self.norm1_b = mk([cin], I.Constant(0.0))
+        self.conv1_w = mk([cout, cin, 3, 3])
+        self.conv1_b = mk([cout], I.Constant(0.0))
+        self.temb_w = mk([temb_dim, cout])
+        self.temb_b = mk([cout], I.Constant(0.0))
+        self.norm2_w = mk([cout], I.Constant(1.0))
+        self.norm2_b = mk([cout], I.Constant(0.0))
+        self.conv2_w = mk([cout, cout, 3, 3])
+        self.conv2_b = mk([cout], I.Constant(0.0))
+        self.skip_w = mk([cout, cin, 1, 1]) if cin != cout else None
+
+    def forward(self, x, temb):
+        x = _unwrap(x)
+        h = _group_norm(x, self.norm1_w._data, self.norm1_b._data, self.cfg.groups)
+        h = _conv(jax.nn.silu(h), self.conv1_w._data, self.conv1_b._data)
+        t = jnp.matmul(jax.nn.silu(temb), self.temb_w._data) + self.temb_b._data
+        h = h + t[:, :, None, None]
+        h = _group_norm(h, self.norm2_w._data, self.norm2_b._data, self.cfg.groups)
+        h = _conv(jax.nn.silu(h), self.conv2_w._data, self.conv2_b._data)
+        if self.skip_w is not None:
+            x = jax.lax.conv_general_dilated(
+                x, self.skip_w._data, (1, 1), [(0, 0)] * 2,
+                dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        return x + h
+
+
+class CrossAttnBlock(Layer):
+    """Spatial self-attention + cross-attention to the text context."""
+
+    def __init__(self, cfg: UNetConfig, channels: int):
+        super().__init__()
+        self.cfg = cfg
+        self.channels = channels
+        init = I.XavierUniform()
+        mk = lambda shape, ini=init: self.create_parameter(
+            shape, dtype=cfg.dtype, default_initializer=ini)
+        self.norm_w = mk([channels], I.Constant(1.0))
+        self.norm_b = mk([channels], I.Constant(0.0))
+        self.q_self = mk([channels, channels])
+        self.kv_self = mk([channels, 2 * channels])
+        self.proj_self = mk([channels, channels])
+        self.q_cross = mk([channels, channels])
+        self.k_cross = mk([cfg.cross_attention_dim, channels])
+        self.v_cross = mk([cfg.cross_attention_dim, channels])
+        self.proj_cross = mk([channels, channels])
+
+    def _attn(self, q, k, v):
+        nh = self.cfg.num_heads
+        b, nq, c = q.shape
+        hd = c // nh
+        q = q.reshape(b, nq, nh, hd)
+        k = k.reshape(b, k.shape[1], nh, hd)
+        v = v.reshape(b, v.shape[1], nh, hd)
+        from ...nn.functional.flash_attention import _xla_attention
+
+        return _xla_attention(q, k, v, causal=False).reshape(b, nq, c)
+
+    def forward(self, x, context):
+        x = _unwrap(x)
+        b, c, h, w = x.shape
+        y = _group_norm(x, self.norm_w._data, self.norm_b._data, self.cfg.groups)
+        y = y.reshape(b, c, h * w).transpose(0, 2, 1)  # [b, hw, c]
+        # self-attention
+        q = jnp.matmul(y, self.q_self._data)
+        kv = jnp.matmul(y, self.kv_self._data)
+        k, v = jnp.split(kv, 2, axis=-1)
+        y = y + jnp.matmul(self._attn(q, k, v), self.proj_self._data)
+        # cross-attention to context [b, n_ctx, cross_dim]
+        ctx = _unwrap(context)
+        q = jnp.matmul(y, self.q_cross._data)
+        k = jnp.matmul(ctx, self.k_cross._data)
+        v = jnp.matmul(ctx, self.v_cross._data)
+        y = y + jnp.matmul(self._attn(q, k, v), self.proj_cross._data)
+        y = y.transpose(0, 2, 1).reshape(b, c, h, w)
+        return x + y
+
+
+class UNet2DConditionModel(Layer):
+    def __init__(self, cfg: UNetConfig):
+        super().__init__()
+        self.config = cfg
+        chs = cfg.block_channels
+        temb_dim = chs[0] * 4
+        self.temb_dim0 = chs[0]
+        mk = lambda shape, ini: self.create_parameter(
+            shape, dtype=cfg.dtype, default_initializer=ini)
+        init = I.KaimingNormal()
+        self.temb_w1 = mk([chs[0], temb_dim], init)
+        self.temb_b1 = mk([temb_dim], I.Constant(0.0))
+        self.temb_w2 = mk([temb_dim, temb_dim], init)
+        self.temb_b2 = mk([temb_dim], I.Constant(0.0))
+        self.conv_in_w = mk([chs[0], cfg.in_channels, 3, 3], init)
+        self.conv_in_b = mk([chs[0]], I.Constant(0.0))
+
+        self.down_res = LayerList()
+        self.down_attn = LayerList()
+        self.downsamplers = []
+        cin = chs[0]
+        for i, ch in enumerate(chs):
+            for _ in range(cfg.layers_per_block):
+                self.down_res.append(ResBlock(cfg, cin, ch, temb_dim))
+                self.down_attn.append(CrossAttnBlock(cfg, ch))
+                cin = ch
+            self.downsamplers.append(i < len(chs) - 1)
+
+        self.mid1 = ResBlock(cfg, chs[-1], chs[-1], temb_dim)
+        self.mid_attn = CrossAttnBlock(cfg, chs[-1])
+        self.mid2 = ResBlock(cfg, chs[-1], chs[-1], temb_dim)
+
+        self.up_res = LayerList()
+        self.up_attn = LayerList()
+        for i, ch in enumerate(reversed(chs)):
+            for _ in range(cfg.layers_per_block):
+                self.up_res.append(ResBlock(cfg, cin + ch, ch, temb_dim))
+                self.up_attn.append(CrossAttnBlock(cfg, ch))
+                cin = ch
+
+        self.norm_out_w = mk([chs[0]], I.Constant(1.0))
+        self.norm_out_b = mk([chs[0]], I.Constant(0.0))
+        self.conv_out_w = mk([cfg.out_channels, chs[0], 3, 3], init)
+        self.conv_out_b = mk([cfg.out_channels], I.Constant(0.0))
+
+    def forward(self, sample, timesteps, encoder_hidden_states):
+        cfg = self.config
+        x = _unwrap(sample)
+        t = _unwrap(timesteps)
+        ctx = _unwrap(encoder_hidden_states)
+        temb = timestep_embedding(t, self.temb_dim0)
+        temb = jnp.matmul(jax.nn.silu(
+            jnp.matmul(temb, self.temb_w1._data) + self.temb_b1._data),
+            self.temb_w2._data) + self.temb_b2._data
+
+        x = _conv(x, self.conv_in_w._data, self.conv_in_b._data)
+        skips = []
+        li = 0
+        for i, ch in enumerate(cfg.block_channels):
+            for _ in range(cfg.layers_per_block):
+                x = self.down_res[li](x, temb)
+                x = self.down_attn[li](x, ctx)
+                skips.append(x)
+                li += 1
+            if self.downsamplers[i]:
+                x = jax.lax.reduce_window(
+                    x, 0.0, jax.lax.add, (1, 1, 2, 2), (1, 1, 2, 2),
+                    "VALID") / 4.0
+
+        x = self.mid1(x, temb)
+        x = self.mid_attn(x, ctx)
+        x = self.mid2(x, temb)
+
+        li = 0
+        for i, ch in enumerate(reversed(cfg.block_channels)):
+            for _ in range(cfg.layers_per_block):
+                skip = skips.pop()
+                if skip.shape[2] != x.shape[2]:
+                    # nearest-neighbor 2x upsample to the skip's resolution
+                    x = jnp.repeat(jnp.repeat(x, 2, axis=2), 2, axis=3)
+                x = jnp.concatenate([x, skip], axis=1)
+                x = self.up_res[li](x, temb)
+                x = self.up_attn[li](x, ctx)
+                li += 1
+
+        x = jax.nn.silu(_group_norm(x, self.norm_out_w._data,
+                                    self.norm_out_b._data, cfg.groups))
+        return _conv(x, self.conv_out_w._data, self.conv_out_b._data)
+
+    def loss_fn(self, batch, labels=None):
+        """ε-prediction MSE (DDPM training objective). ``batch`` is a dict of
+        arrays {sample, timesteps, context, noise}."""
+        eps = self.forward(batch["sample"], batch["timesteps"], batch["context"])
+        target = _unwrap(batch["noise"])
+        return jnp.mean((eps.astype(jnp.float32) - target.astype(jnp.float32)) ** 2)

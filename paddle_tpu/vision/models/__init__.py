@@ -2,3 +2,4 @@
 
 from .lenet import LeNet  # noqa: F401
 from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101, resnet152  # noqa: F401
+from .vit import VisionTransformer, ViTConfig, vit_b_16, vit_l_16  # noqa: F401

@@ -1,0 +1,170 @@
+"""Vision Transformer (bench config #5: ViT-L through the compiler path).
+
+Reference anchor: python/paddle/vision ships CNN zoos; ViT is the
+transformer-vision member the benchmarks call for. Pre-LN encoder, learned
+positions, cls token. Same logical-axis convention as models/llama."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...distributed.auto_parallel.logical_sharding import annotate, constrain
+from ...nn import initializer as I
+from ...nn.layer.layers import Layer, LayerList
+
+__all__ = ["VisionTransformer", "ViTConfig", "vit_b_16", "vit_l_16"]
+
+
+class ViTConfig:
+    def __init__(self, image_size=224, patch_size=16, in_channels=3,
+                 hidden_size=768, num_layers=12, num_heads=12, mlp_ratio=4.0,
+                 num_classes=1000, dropout=0.0, dtype="float32",
+                 recompute=False):
+        self.image_size = image_size
+        self.patch_size = patch_size
+        self.in_channels = in_channels
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.mlp_ratio = mlp_ratio
+        self.num_classes = num_classes
+        self.dropout = dropout
+        self.dtype = dtype
+        self.recompute = recompute
+
+    @property
+    def num_patches(self):
+        return (self.image_size // self.patch_size) ** 2
+
+    @classmethod
+    def tiny(cls, **over):
+        d = dict(image_size=32, patch_size=8, hidden_size=64, num_layers=2,
+                 num_heads=4, num_classes=10)
+        d.update(over)
+        return cls(**d)
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+class ViTBlock(Layer):
+    """Pre-LN transformer block."""
+
+    def __init__(self, cfg: ViTConfig):
+        super().__init__()
+        h = cfg.hidden_size
+        m = int(h * cfg.mlp_ratio)
+        self.num_heads = cfg.num_heads
+        init = I.TruncatedNormal(std=0.02)
+        mk = lambda shape, ini=init: self.create_parameter(
+            shape, dtype=cfg.dtype, default_initializer=ini)
+        self.ln1_w = mk([h], I.Constant(1.0))
+        self.ln1_b = mk([h], I.Constant(0.0))
+        self.qkv_w = annotate(mk([h, 3 * h]), "embed", "heads")
+        self.qkv_b = mk([3 * h], I.Constant(0.0))
+        self.proj_w = annotate(mk([h, h]), "heads", "embed")
+        self.proj_b = mk([h], I.Constant(0.0))
+        self.ln2_w = mk([h], I.Constant(1.0))
+        self.ln2_b = mk([h], I.Constant(0.0))
+        self.fc1_w = annotate(mk([h, m]), "embed", "mlp")
+        self.fc1_b = mk([m], I.Constant(0.0))
+        self.fc2_w = annotate(mk([m, h]), "mlp", "embed")
+        self.fc2_b = mk([h], I.Constant(0.0))
+
+    def _ln(self, x, w, b):
+        xf = x.astype(jnp.float32)
+        mu = xf.mean(-1, keepdims=True)
+        var = xf.var(-1, keepdims=True)
+        return ((xf - mu) * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * w + b
+
+    def forward(self, x):
+        x = _unwrap(x)
+        b, n, h = x.shape
+        nh = self.num_heads
+        hd = h // nh
+        y = self._ln(x, self.ln1_w._data, self.ln1_b._data)
+        qkv = jnp.matmul(y, self.qkv_w._data) + self.qkv_b._data
+        q, k, v = jnp.split(qkv.reshape(b, n, 3, nh, hd), 3, axis=2)
+        q, k, v = q[:, :, 0], k[:, :, 0], v[:, :, 0]
+        from ...nn.functional.flash_attention import _xla_attention
+
+        attn = _xla_attention(q, k, v, causal=False).reshape(b, n, h)
+        x = x + jnp.matmul(attn, self.proj_w._data) + self.proj_b._data
+        y = self._ln(x, self.ln2_w._data, self.ln2_b._data)
+        y = jax.nn.gelu(jnp.matmul(y, self.fc1_w._data) + self.fc1_b._data)
+        y = constrain(y, "batch", None, "mlp")
+        x = x + jnp.matmul(y, self.fc2_w._data) + self.fc2_b._data
+        return constrain(x, "batch", None, "embed")
+
+
+class VisionTransformer(Layer):
+    def __init__(self, cfg: ViTConfig):
+        super().__init__()
+        self.config = cfg
+        h, p, c = cfg.hidden_size, cfg.patch_size, cfg.in_channels
+        init = I.TruncatedNormal(std=0.02)
+        self.patch_w = annotate(self.create_parameter(
+            [p * p * c, h], dtype=cfg.dtype, default_initializer=init),
+            None, "embed")
+        self.patch_b = self.create_parameter([h], dtype=cfg.dtype,
+                                             default_initializer=I.Constant(0.0))
+        self.cls_token = self.create_parameter([1, 1, h], dtype=cfg.dtype,
+                                               default_initializer=init)
+        self.pos_embed = self.create_parameter(
+            [1, cfg.num_patches + 1, h], dtype=cfg.dtype,
+            default_initializer=init)
+        self.blocks = LayerList([ViTBlock(cfg) for _ in range(cfg.num_layers)])
+        self.ln_w = self.create_parameter([h], default_initializer=I.Constant(1.0), dtype=cfg.dtype)
+        self.ln_b = self.create_parameter([h], default_initializer=I.Constant(0.0), dtype=cfg.dtype)
+        self.head_w = self.create_parameter([h, cfg.num_classes], dtype=cfg.dtype,
+                                            default_initializer=init)
+        self.head_b = self.create_parameter([cfg.num_classes], dtype=cfg.dtype,
+                                            default_initializer=I.Constant(0.0))
+
+    def _patchify(self, img):
+        """[b, c, H, W] -> [b, n_patches, p*p*c] without conv: a reshape the
+        MXU-bound matmul consumes directly."""
+        b, c, H, W = img.shape
+        p = self.config.patch_size
+        img = img.reshape(b, c, H // p, p, W // p, p)
+        img = img.transpose(0, 2, 4, 3, 5, 1)  # b, hp, wp, p, p, c
+        return img.reshape(b, (H // p) * (W // p), p * p * c)
+
+    def forward(self, images):
+        x = _unwrap(images)
+        x = self._patchify(x)
+        x = jnp.matmul(x, self.patch_w._data) + self.patch_b._data
+        b = x.shape[0]
+        cls = jnp.broadcast_to(self.cls_token._data, (b, 1, x.shape[-1]))
+        x = jnp.concatenate([cls, x], axis=1) + self.pos_embed._data
+        x = constrain(x, "batch", None, "embed")
+        for blk in self.blocks:
+            if self.config.recompute and self.training:
+                x = jax.checkpoint(lambda a, _l=blk: _unwrap(_l(a)))(x)
+            else:
+                x = _unwrap(blk(x))
+        xf = x.astype(jnp.float32)
+        mu = xf.mean(-1, keepdims=True)
+        var = xf.var(-1, keepdims=True)
+        x = ((xf - mu) * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype)
+        x = x * self.ln_w._data + self.ln_b._data
+        return jnp.matmul(x[:, 0], self.head_w._data) + self.head_b._data
+
+    def loss_fn(self, images, labels):
+        logits = _unwrap(self.forward(images)).astype(jnp.float32)
+        lbl = _unwrap(labels)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.take_along_axis(logp, lbl[..., None], axis=-1).mean()
+
+
+def vit_b_16(**over):
+    return VisionTransformer(ViTConfig(hidden_size=768, num_layers=12,
+                                       num_heads=12, **over))
+
+
+def vit_l_16(**over):
+    return VisionTransformer(ViTConfig(hidden_size=1024, num_layers=24,
+                                       num_heads=16, **over))
