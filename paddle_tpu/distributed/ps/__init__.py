@@ -42,16 +42,32 @@ class ParameterServer:
     def create_dense_table(self, name: str, shape, optimizer="sgd", lr=0.01,
                            initializer="zeros"):
         with self._lock:
-            if name not in self._tables:
+            existing = self._tables.get(name)
+            if existing is None:
                 self._tables[name] = DenseTable(shape, optimizer, lr, initializer)
+            elif (not isinstance(existing, DenseTable)
+                  or list(existing.value.shape) != [int(s) for s in shape]
+                  or existing.optimizer != optimizer
+                  or existing.lr != float(lr)):
+                raise ValueError(
+                    f"dense table '{name}' already exists with a different "
+                    f"config: {existing.stat()}")
         return True
 
     def create_sparse_table(self, name: str, emb_dim: int, optimizer="adagrad",
                             lr=0.01, init_range=0.01):
         with self._lock:
-            if name not in self._tables:
+            existing = self._tables.get(name)
+            if existing is None:
                 self._tables[name] = SparseTable(emb_dim, optimizer, lr,
                                                  init_range)
+            elif (not isinstance(existing, SparseTable)
+                  or existing.emb_dim != int(emb_dim)
+                  or existing.optimizer != optimizer
+                  or existing.lr != float(lr)):
+                raise ValueError(
+                    f"sparse table '{name}' already exists with a different "
+                    f"config: {existing.stat()}")
         return True
 
     def _table(self, name):
@@ -74,7 +90,8 @@ class ParameterServer:
         return True
 
     def stat(self):
-        return {n: t.stat() for n, t in self._tables.items()}
+        with self._lock:
+            return {n: t.stat() for n, t in self._tables.items()}
 
 
 _server: Dict[str, Optional[ParameterServer]] = {"ps": None}

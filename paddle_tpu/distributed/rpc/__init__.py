@@ -76,7 +76,15 @@ class _RpcHandler(socketserver.BaseRequestHandler):
                 result = (True, fn(*args, **kwargs))
             except Exception as e:  # error travels back to the caller
                 result = (False, e)
-            _send_msg(self.request, pickle.dumps(result))
+            try:
+                payload = pickle.dumps(result)
+            except Exception as e:  # unpicklable result/exception
+                payload = pickle.dumps(
+                    (False, RuntimeError(
+                        f"rpc result not picklable: {e!r} "
+                        f"(original: {result[1]!r})" if not result[0]
+                        else f"rpc result not picklable: {e!r}")))
+            _send_msg(self.request, payload)
         except ConnectionError:
             pass
 
@@ -101,7 +109,16 @@ class RpcAgent:
         self._thread.start()
         self._pool = concurrent.futures.ThreadPoolExecutor(max_workers=16)
 
-        ip = os.environ.get("PADDLE_LOCAL_IP", "127.0.0.1")
+        # advertise the address peers can actually reach: the local interface
+        # that routes toward the store master (PADDLE_LOCAL_IP overrides)
+        ip = os.environ.get("PADDLE_LOCAL_IP")
+        if ip is None:
+            try:
+                with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as probe:
+                    probe.connect((store.host, max(store.port, 1)))
+                    ip = probe.getsockname()[0]
+            except OSError:
+                ip = "127.0.0.1"
         info = WorkerInfo(name, rank, ip, self._port)
         store.set(f"rpc/worker/{rank}", pickle.dumps(info))
         store.set(f"rpc/name/{name}", pickle.dumps(info))
@@ -144,11 +161,18 @@ def init_rpc(name: str, rank: Optional[int] = None,
              store: Optional[TCPStore] = None) -> RpcAgent:
     """Start this process's RPC agent and rendezvous with peers
     (reference: rpc.py init_rpc; env fallbacks mirror PADDLE_TRAINER_*)."""
-    if _state["agent"] is not None:
-        return _state["agent"]
     rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) if rank is None else rank
     world_size = (int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
                   if world_size is None else world_size)
+    existing = _state["agent"]
+    if existing is not None:
+        if (existing.name, existing.rank, existing.world_size) != (
+                name, rank, world_size):
+            raise RuntimeError(
+                f"rpc already initialized as ({existing.name}, rank "
+                f"{existing.rank}, world {existing.world_size}); call "
+                f"shutdown() before re-initializing with different parameters")
+        return existing
     if store is None:
         ep = master_endpoint or os.environ.get("PADDLE_MASTER_ENDPOINT",
                                                "127.0.0.1:29600")

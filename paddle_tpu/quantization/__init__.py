@@ -216,20 +216,25 @@ class QAT:
 
     def quantize(self, model: Layer, inplace: bool = True) -> Layer:
         cfg = self.config
+        # validate BEFORE mutating so an error never leaves the model
+        # half-quantized
+        unsupported = sorted({
+            type(l).__name__ for _, l in model.named_sublayers()
+            if isinstance(l, cfg._types) and not isinstance(l, nn.Linear)})
+        if unsupported:
+            raise NotImplementedError(
+                f"quantization of {', '.join(unsupported)} is not supported "
+                f"yet (Linear only — conv QAT tracked in docs/PARITY.md)")
         if not inplace:
             import copy
 
             model = copy.deepcopy(model)
 
-        def build(l):
-            if not isinstance(l, nn.Linear):
-                raise NotImplementedError(
-                    f"quantization of {type(l).__name__} is not supported yet "
-                    f"(Linear only — conv QAT tracked in docs/PARITY.md)")
-            return QuantedLinear(l, cfg.activation_factory(),
-                                 cfg.weight_bits, cfg.act_bits)
-
-        return _replace_layers(model, lambda l: isinstance(l, cfg._types), build)
+        return _replace_layers(
+            model,
+            lambda l: isinstance(l, cfg._types),
+            lambda l: QuantedLinear(l, cfg.activation_factory(),
+                                    cfg.weight_bits, cfg.act_bits))
 
     def convert(self, model: Layer, inplace: bool = True) -> Layer:
         cfg = self.config

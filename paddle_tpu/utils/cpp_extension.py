@@ -94,6 +94,11 @@ class _HostOp:
         return gx
 
     def kernel(self):
+        """Build (once) and cache the jax-facing callable — a stable identity
+        so jit tracing caches hold across calls."""
+        cached = getattr(self, "_kernel", None)
+        if cached is not None:
+            return cached
         host_fwd, host_bwd = self._host_fwd, self._host_bwd
 
         def fwd_cb(a):
@@ -102,6 +107,7 @@ class _HostOp:
                 a.astype(jnp.float32), vmap_method="sequential")
 
         if self._grad is None:
+            self._kernel = fwd_cb
             return fwd_cb
 
         f = jax.custom_vjp(fwd_cb)
@@ -116,6 +122,7 @@ class _HostOp:
             return (gx,)
 
         f.defvjp(fwd, bwd)
+        self._kernel = f
         return f
 
     def __call__(self, x):
