@@ -49,10 +49,13 @@ def main():
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
 
     if on_tpu:
+        # head_dim 128 (llama-standard) fills the 128x128 MXU; the tuned
+        # Pallas flash kernels make remat unnecessary at this batch (v5e
+        # 16G HBM): profiled 0.55 MFU vs 0.16 at the old 16-head/remat config
         cfg = LlamaConfig(
             vocab_size=32000, hidden_size=1024, intermediate_size=2816,
-            num_hidden_layers=24, num_attention_heads=16, num_key_value_heads=16,
-            max_position_embeddings=2048, dtype="bfloat16", recompute=True)
+            num_hidden_layers=24, num_attention_heads=8, num_key_value_heads=8,
+            max_position_embeddings=2048, dtype="bfloat16", recompute=False)
         batch, seq, iters = 8, 2048, 10
     else:
         cfg = LlamaConfig.tiny(recompute=True)

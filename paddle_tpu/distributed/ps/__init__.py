@@ -48,7 +48,8 @@ class ParameterServer:
             elif (not isinstance(existing, DenseTable)
                   or list(existing.value.shape) != [int(s) for s in shape]
                   or existing.optimizer != optimizer
-                  or existing.lr != float(lr)):
+                  or existing.lr != float(lr)
+                  or existing.initializer != initializer):
                 raise ValueError(
                     f"dense table '{name}' already exists with a different "
                     f"config: {existing.stat()}")
@@ -64,7 +65,8 @@ class ParameterServer:
             elif (not isinstance(existing, SparseTable)
                   or existing.emb_dim != int(emb_dim)
                   or existing.optimizer != optimizer
-                  or existing.lr != float(lr)):
+                  or existing.lr != float(lr)
+                  or existing.init_range != float(init_range)):
                 raise ValueError(
                     f"sparse table '{name}' already exists with a different "
                     f"config: {existing.stat()}")

@@ -25,6 +25,7 @@ class DenseTable:
             self.value = rng.uniform(-0.01, 0.01, shape).astype(np.float32)
         self.optimizer = optimizer
         self.lr = float(lr)
+        self.initializer = initializer
         self._acc = np.zeros(shape, np.float32)  # adagrad accumulator
         self._lock = threading.Lock()
 

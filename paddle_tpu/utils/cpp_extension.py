@@ -138,11 +138,8 @@ class CppExtensionModule:
         self.op_names: List[str] = [n.strip() for n in names if n.strip()]
         for n in self.op_names:
             grad_name = f"{n}_grad" if hasattr(self._lib, f"{n}_grad") else None
-            op = _HostOp(self._lib, n, grad_name)
-            # one kernel instance per op: cache it so jit sees a stable callable
-            kern = op.kernel()
-            setattr(self, n, lambda x, _k=kern, _n=n: apply_fn(
-                f"custom_cpp_{_n}", _k, x))
+            # _HostOp.__call__ dispatches through apply_fn with its cached kernel
+            setattr(self, n, _HostOp(self._lib, n, grad_name))
 
 
 def load(name: str, sources: Sequence[str], extra_cflags=(),
