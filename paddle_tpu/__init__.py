@@ -42,6 +42,7 @@ from . import autograd  # noqa: F401,E402
 from . import device  # noqa: F401,E402
 from . import distributed  # noqa: F401,E402
 from . import distribution  # noqa: F401,E402
+from . import fft  # noqa: F401,E402
 from . import framework  # noqa: F401,E402
 from . import hapi  # noqa: F401,E402
 from . import incubate  # noqa: F401,E402
@@ -53,12 +54,31 @@ from . import nn  # noqa: F401,E402
 from . import optimizer  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
 from . import quantization  # noqa: F401,E402
+from . import signal  # noqa: F401,E402
 from . import sparse  # noqa: F401,E402
 from . import static  # noqa: F401,E402
 from . import text  # noqa: F401,E402
 from . import vision  # noqa: F401,E402
 from .hapi.model import Model  # noqa: F401,E402
 from .jit.api import to_static  # noqa: F401,E402
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """Standalone trainable parameter (reference: python/paddle/tensor/
+    creation.py create_parameter)."""
+    from .nn import initializer as I
+
+    init = default_initializer or (I.Constant(0.0) if is_bias
+                                   else I.XavierUniform())
+    data = init(shape, dtype)
+    return Parameter(data, dtype=dtype, name=name)
+
+
+def create_tensor(dtype="float32", name=None, persistable=False):
+    import jax.numpy as _jnp
+
+    return Tensor(_jnp.zeros((), _dtype_mod.convert_dtype(dtype)), name=name)
 
 
 def is_compiled_with_cuda() -> bool:

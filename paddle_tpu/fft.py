@@ -1,0 +1,72 @@
+"""paddle_tpu.fft (reference: python/paddle/fft.py — ~20 public functions over
+phi fft kernels). TPU-native: jnp.fft lowers to XLA's FFT HLO."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.op_registry import apply_fn
+from .core.tensor import Tensor
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+    "fft2", "ifft2", "rfft2", "irfft2",
+    "fftn", "ifftn", "rfftn", "irfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+
+def _wrap1(op_name, fn):
+    def f(x, n=None, axis=-1, norm="backward", name=None):
+        return apply_fn(op_name, lambda a: fn(a, n=n, axis=axis, norm=norm), x)
+
+    f.__name__ = op_name
+    return f
+
+
+def _wrap2(op_name, fn):
+    def f(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        return apply_fn(op_name, lambda a: fn(a, s=s, axes=axes, norm=norm), x)
+
+    f.__name__ = op_name
+    return f
+
+
+def _wrapn(op_name, fn):
+    def f(x, s=None, axes=None, norm="backward", name=None):
+        return apply_fn(op_name, lambda a: fn(a, s=s, axes=axes, norm=norm), x)
+
+    f.__name__ = op_name
+    return f
+
+
+fft = _wrap1("fft", jnp.fft.fft)
+ifft = _wrap1("ifft", jnp.fft.ifft)
+rfft = _wrap1("rfft", jnp.fft.rfft)
+irfft = _wrap1("irfft", jnp.fft.irfft)
+hfft = _wrap1("hfft", jnp.fft.hfft)
+ihfft = _wrap1("ihfft", jnp.fft.ihfft)
+fft2 = _wrap2("fft2", jnp.fft.fft2)
+ifft2 = _wrap2("ifft2", jnp.fft.ifft2)
+rfft2 = _wrap2("rfft2", jnp.fft.rfft2)
+irfft2 = _wrap2("irfft2", jnp.fft.irfft2)
+fftn = _wrapn("fftn", jnp.fft.fftn)
+ifftn = _wrapn("ifftn", jnp.fft.ifftn)
+rfftn = _wrapn("rfftn", jnp.fft.rfftn)
+irfftn = _wrapn("irfftn", jnp.fft.irfftn)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.fftfreq(int(n), d=float(d)).astype(dtype or "float32"))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.rfftfreq(int(n), d=float(d)).astype(dtype or "float32"))
+
+
+def fftshift(x, axes=None, name=None):
+    return apply_fn("fftshift", lambda a: jnp.fft.fftshift(a, axes=axes), x)
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply_fn("ifftshift", lambda a: jnp.fft.ifftshift(a, axes=axes), x)

@@ -2,6 +2,7 @@
 
 from . import lr  # noqa: F401
 from .optimizer import (  # noqa: F401
+    ASGD,
     LBFGS,
     SGD,
     Adadelta,
@@ -15,4 +16,5 @@ from .optimizer import (  # noqa: F401
     Optimizer,
     RAdam,
     RMSProp,
+    Rprop,
 )

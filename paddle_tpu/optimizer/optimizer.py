@@ -469,3 +469,52 @@ class LBFGS(Optimizer):
             off += n
         self._step_count += 1
         return loss
+
+
+class ASGD(Optimizer):
+    """Averaged SGD (reference: python/paddle/optimizer/asgd.py — steps with
+    the mean of the last ``batch_num`` gradients, kept in a circular buffer
+    ``ys`` with running sum ``d``: d <- d - ys[i] + g)."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._batch_num = max(int(batch_num), 1)
+
+    def _update(self, g, val, p, lr):
+        n = self._batch_num
+        d = self._acc("d", p)
+        ys = self._acc("ys", p,
+                       init=jnp.zeros((n,) + tuple(p.shape), jnp.float32))
+        i = (self._step_count - 1) % n
+        oldest = ys[i]
+        d = d - oldest + g
+        ys = ys.at[i].set(g)
+        self._set_acc("d", p, d)
+        self._set_acc("ys", p, ys)
+        return val - (lr * d / float(n)).astype(val.dtype)
+
+
+class Rprop(Optimizer):
+    """Resilient backprop (reference: python/paddle/optimizer/rprop.py) —
+    sign-based per-weight step sizes; full-batch regimes only."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._lr_min, self._lr_max = learning_rate_range
+        self._eta_neg, self._eta_pos = etas
+
+    def _update(self, g, val, p, lr):
+        prev = self._acc("prev_grad", p)
+        step = self._acc("step_size", p,
+                         init=jnp.full(tuple(p.shape), self.get_lr(), jnp.float32))
+        sign = jnp.sign(g * prev)
+        factor = jnp.where(sign > 0, self._eta_pos,
+                           jnp.where(sign < 0, self._eta_neg, 1.0))
+        step = jnp.clip(step * factor, self._lr_min, self._lr_max)
+        # where sign flipped, zero the gradient (classic Rprop- variant)
+        g_eff = jnp.where(sign < 0, 0.0, g)
+        self._set_acc("step_size", p, step)
+        self._set_acc("prev_grad", p, g_eff)
+        return val - (step * jnp.sign(g_eff)).astype(val.dtype)

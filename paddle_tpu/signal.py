@@ -1,0 +1,118 @@
+"""paddle_tpu.signal — stft/istft (reference: python/paddle/signal.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.op_registry import apply_fn
+from .core.tensor import Tensor, unwrap
+
+__all__ = ["stft", "istft", "frame", "overlap_add"]
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Overlapping frames. axis=-1: [..., n] -> [..., frame_length, num];
+    axis=0: [n, ...] -> [num, frame_length, ...] (reference: signal.py frame)."""
+    if axis not in (-1, 0):
+        raise ValueError("frame supports axis in (-1, 0)")
+
+    def fn(a):
+        if axis == 0:
+            a = jnp.moveaxis(a, 0, -1)
+        n = a.shape[-1]
+        num = 1 + (n - frame_length) // hop_length
+        idx = (jnp.arange(frame_length)[:, None]
+               + hop_length * jnp.arange(num)[None, :])
+        out = a[..., idx]  # [..., frame_length, num]
+        if axis == 0:
+            # -> [num, frame_length, ...]
+            out = jnp.moveaxis(jnp.moveaxis(out, -1, 0), -1, 1)
+        return out
+
+    return apply_fn("frame", fn, x)
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of frame. axis=-1: [..., frame_length, num] -> [..., n];
+    axis=0: [num, frame_length, ...] -> [n, ...]."""
+    if axis not in (-1, 0):
+        raise ValueError("overlap_add supports axis in (-1, 0)")
+
+    def fn(a):
+        if axis == 0:
+            a = jnp.moveaxis(jnp.moveaxis(a, 1, -1), 0, -1)
+        fl, num = a.shape[-2], a.shape[-1]
+        n = fl + hop_length * (num - 1)
+        out = jnp.zeros(a.shape[:-2] + (n,), a.dtype)
+        for j in range(num):  # static unroll: num_frames is static under jit
+            out = out.at[..., j * hop_length:j * hop_length + fl].add(a[..., j])
+        if axis == 0:
+            out = jnp.moveaxis(out, -1, 0)
+        return out
+
+    return apply_fn("overlap_add", fn, x)
+
+
+def _pad_window(w, n_fft, win_length):
+    lo = (n_fft - win_length) // 2
+    return jnp.pad(w, (lo, n_fft - win_length - lo)) if win_length < n_fft else w
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+         pad_mode="reflect", normalized=False, onesided=True, name=None):
+    """Short-time Fourier transform -> [..., n_fft//2+1 or n_fft, num_frames]."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    w = unwrap(window) if window is not None else jnp.ones(win_length)
+
+    def fn(a, w):
+        w_p = _pad_window(w, n_fft, win_length)
+        if center:
+            a = jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(n_fft // 2, n_fft // 2)],
+                        mode=pad_mode)
+        frames_n = 1 + (a.shape[-1] - n_fft) // hop_length
+        idx = (jnp.arange(n_fft)[:, None]
+               + hop_length * jnp.arange(frames_n)[None, :])
+        fr = a[..., idx] * w_p[:, None]
+        spec = (jnp.fft.rfft(fr, axis=-2) if onesided
+                else jnp.fft.fft(fr, axis=-2))
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        return spec
+
+    if window is not None:
+        return apply_fn("stft", fn, x, Tensor(w))
+    return apply_fn("stft", lambda a: fn(a, jnp.ones(win_length)), x)
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+          normalized=False, onesided=True, length=None, return_complex=False,
+          name=None):
+    """Inverse STFT with window-envelope normalization (NOLA)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    w = unwrap(window) if window is not None else jnp.ones(win_length)
+
+    def fn(spec, w):
+        w_p = _pad_window(w, n_fft, win_length)
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        fr = (jnp.fft.irfft(spec, n=n_fft, axis=-2) if onesided
+              else jnp.fft.ifft(spec, axis=-2).real)
+        fr = fr * w_p[:, None]
+        num = fr.shape[-1]
+        n = n_fft + hop_length * (num - 1)
+        out = jnp.zeros(fr.shape[:-2] + (n,), fr.dtype)
+        env = jnp.zeros((n,), fr.dtype)
+        for j in range(num):
+            sl = slice(j * hop_length, j * hop_length + n_fft)
+            out = out.at[..., sl].add(fr[..., j])
+            env = env.at[sl].add(w_p * w_p)
+        out = out / jnp.maximum(env, 1e-11)
+        if center:
+            out = out[..., n_fft // 2: n - n_fft // 2]
+        if length is not None:
+            out = out[..., :length]
+        return out
+
+    return apply_fn("istft", fn, x, Tensor(w))

@@ -11,8 +11,9 @@ import jax.numpy as jnp
 
 from ..core.op_registry import apply_fn
 from ..core.tensor import Tensor, unwrap
-from . import creation, linalg, manipulation, math, random, search
+from . import creation, extras, linalg, manipulation, math, random, search
 from .creation import *  # noqa: F401,F403
+from .extras import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403
 from .math import *  # noqa: F401,F403
@@ -85,8 +86,22 @@ def _install():
     T.__setitem__ = _setitem
     T.__iter__ = _iter
 
+    # signal methods attach too (reference tensor_method_func includes them)
+    def _stft(self, *a, **k):
+        from .. import signal
+
+        return signal.stft(self, *a, **k)
+
+    def _istft(self, *a, **k):
+        from .. import signal
+
+        return signal.istft(self, *a, **k)
+
+    T.stft = _stft
+    T.istft = _istft
+
     methods = {}
-    for mod in (math, manipulation, linalg, creation, search):
+    for mod in (math, manipulation, linalg, creation, search, extras):
         for name in dir(mod):
             fn = getattr(mod, name)
             if callable(fn) and not name.startswith("_") and name not in ("Tensor",):
@@ -125,6 +140,10 @@ def _install():
         # creation-ish
         "tril", "triu", "diag",
     ]
+    # extras ops all take x first: install every public one as a method
+    # (reference: tensor_method_func includes the full long tail)
+    method_names += [n for n in extras.__all__
+                     if n not in ("is_tensor", "block_diag")]
     for name in method_names:
         if name in methods and not hasattr(T, name):
             setattr(T, name, methods[name])

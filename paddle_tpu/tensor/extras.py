@@ -1,0 +1,529 @@
+"""Breadth completion of the tensor API — the long tail of
+python/paddle/tensor functions not yet in math/linalg/manipulation/search.
+
+Each op is a thin jax-traceable function dispatched through apply_fn (tape /
+AMP / static-graph aware like every other op). Reference file cited per group.
+"""
+
+from __future__ import annotations
+
+import math as _math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtype_mod
+from ..core.op_registry import apply_fn
+from ..core.tensor import Tensor, unwrap
+
+__all__ = [
+    "addmm", "block_diag", "cdist", "cholesky_inverse", "cumulative_trapezoid",
+    "diagonal_scatter", "diff", "dist", "dsplit", "frexp", "gammainc",
+    "gammaincc", "gammaln", "histogram_bin_edges", "histogramdd", "hsplit",
+    "i0", "i0e", "i1", "i1e", "index_fill", "inverse", "is_complex",
+    "is_empty", "is_floating_point", "is_integer", "is_tensor", "isin",
+    "isneginf", "isposinf", "isreal", "ldexp", "logcumsumexp", "logit",
+    "lu_unpack", "masked_scatter", "multigammaln", "nanquantile", "polygamma",
+    "reduce_as", "renorm", "reverse", "select_scatter", "sgn", "signbit",
+    "sinc", "slice_scatter", "svd_lowrank", "tensor_split", "top_p_sampling",
+    "trapezoid", "unflatten", "unfold", "vander", "vsplit", "as_strided",
+    "ormqr",
+]
+
+
+# ---- predicates (reference: tensor/attribute.py) ----
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def is_complex(x):
+    return jnp.issubdtype(unwrap(x).dtype, jnp.complexfloating)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(unwrap(x).dtype, jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(unwrap(x).dtype, jnp.integer)
+
+
+def is_empty(x):
+    return int(np.prod(unwrap(x).shape)) == 0
+
+
+def isreal(x):
+    return apply_fn("isreal", lambda a: jnp.isreal(a), x)
+
+
+def isneginf(x):
+    return apply_fn("isneginf", lambda a: jnp.isneginf(a), x)
+
+
+def isposinf(x):
+    return apply_fn("isposinf", lambda a: jnp.isposinf(a), x)
+
+
+def signbit(x):
+    return apply_fn("signbit", lambda a: jnp.signbit(a), x)
+
+
+# ---- math (reference: tensor/math.py) ----
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply_fn("addmm", lambda i, a, b: beta * i + alpha * (a @ b),
+                    input, x, y)
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    def fn(a, b):
+        d = a[..., :, None, :] - b[..., None, :, :]
+        if p == 2.0:
+            return jnp.sqrt(jnp.maximum(jnp.sum(d * d, -1), 0.0))
+        if p == float("inf"):
+            return jnp.max(jnp.abs(d), -1)
+        return jnp.sum(jnp.abs(d) ** p, -1) ** (1.0 / p)
+
+    return apply_fn("cdist", fn, x, y)
+
+
+def dist(x, y, p=2, name=None):
+    def fn(a, b):
+        d = (a - b).ravel()
+        if p == 0:
+            return jnp.count_nonzero(d).astype(a.dtype)
+        if p == float("inf"):
+            return jnp.max(jnp.abs(d))
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(d))
+        return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+
+    return apply_fn("dist", fn, x, y)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    pre = unwrap(prepend) if prepend is not None else None
+    app = unwrap(append) if append is not None else None
+    return apply_fn("diff",
+                    lambda a: jnp.diff(a, n=n, axis=axis, prepend=pre, append=app),
+                    x)
+
+
+def frexp(x, name=None):
+    return apply_fn("frexp", lambda a: jnp.frexp(a), x)
+
+
+def ldexp(x, y, name=None):
+    return apply_fn("ldexp", lambda a, b: jnp.ldexp(a, b.astype(jnp.int32)), x, y)
+
+
+def gammaln(x, name=None):
+    return apply_fn("gammaln", lambda a: jax.scipy.special.gammaln(a), x)
+
+
+def gammainc(x, y, name=None):
+    return apply_fn("gammainc", lambda a, b: jax.scipy.special.gammainc(a, b), x, y)
+
+
+def gammaincc(x, y, name=None):
+    return apply_fn("gammaincc", lambda a, b: jax.scipy.special.gammaincc(a, b), x, y)
+
+
+def multigammaln(x, p, name=None):
+    return apply_fn("multigammaln",
+                    lambda a: jax.scipy.special.multigammaln(a, int(p)), x)
+
+
+def polygamma(x, n, name=None):
+    return apply_fn("polygamma",
+                    lambda a: jax.scipy.special.polygamma(int(n), a), x)
+
+
+def i0(x, name=None):
+    return apply_fn("i0", lambda a: jax.scipy.special.i0(a), x)
+
+
+def i0e(x, name=None):
+    return apply_fn("i0e", lambda a: jax.scipy.special.i0e(a), x)
+
+
+def i1(x, name=None):
+    return apply_fn("i1", lambda a: jax.scipy.special.i1(a), x)
+
+
+def i1e(x, name=None):
+    return apply_fn("i1e", lambda a: jax.scipy.special.i1e(a), x)
+
+
+def logit(x, eps=None, name=None):
+    def fn(a):
+        if eps is not None:
+            a = jnp.clip(a, eps, 1.0 - eps)
+        return jax.scipy.special.logit(a)
+
+    return apply_fn("logit", fn, x)
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    def fn(a):
+        if axis is None:
+            a = a.ravel()
+            ax = 0
+        else:
+            ax = axis
+        return jax.lax.associative_scan(jnp.logaddexp, a, axis=ax)
+
+    return apply_fn("logcumsumexp", fn, x)
+
+
+def sgn(x, name=None):
+    def fn(a):
+        if jnp.issubdtype(a.dtype, jnp.complexfloating):
+            mag = jnp.abs(a)
+            return jnp.where(mag == 0, 0, a / jnp.maximum(mag, 1e-38))
+        return jnp.sign(a)
+
+    return apply_fn("sgn", fn, x)
+
+
+def sinc(x, name=None):
+    return apply_fn("sinc", lambda a: jnp.sinc(a), x)
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    return apply_fn("isin", lambda a, t: jnp.isin(a, t, invert=invert), x, test_x)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    xv = unwrap(x) if x is not None else None
+
+    def fn(a):
+        return jnp.trapezoid(a, x=xv, dx=dx if dx is not None else 1.0, axis=axis)
+
+    return apply_fn("trapezoid", fn, y)
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    xv = unwrap(x) if x is not None else None
+
+    def fn(a):
+        d = (jnp.diff(xv, axis=axis) if xv is not None
+             else (dx if dx is not None else 1.0))
+        sl1 = [slice(None)] * a.ndim
+        sl2 = [slice(None)] * a.ndim
+        sl1[axis] = slice(1, None)
+        sl2[axis] = slice(None, -1)
+        avg = (a[tuple(sl1)] + a[tuple(sl2)]) / 2.0
+        return jnp.cumsum(avg * d, axis=axis)
+
+    return apply_fn("cumulative_trapezoid", fn, y)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    qv = unwrap(q) if isinstance(q, Tensor) else q
+    return apply_fn(
+        "nanquantile",
+        lambda a: jnp.nanquantile(a, qv, axis=axis, keepdims=keepdim,
+                                  method=interpolation), x)
+
+
+def reduce_as(x, target, name=None):
+    """Sum-reduce x to target's shape (reference: tensor/math.py reduce_as)."""
+
+    def fn(a, t):
+        extra = a.ndim - t.ndim
+        axes = tuple(range(extra)) + tuple(
+            i + extra for i, s in enumerate(t.shape) if s == 1 and a.shape[i + extra] != 1)
+        out = jnp.sum(a, axis=axes, keepdims=False)
+        return out.reshape(t.shape)
+
+    return apply_fn("reduce_as", fn, x, target)
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    def fn(a):
+        axes = tuple(i for i in range(a.ndim) if i != axis % a.ndim)
+        norms = jnp.sum(jnp.abs(a) ** p, axis=axes, keepdims=True) ** (1.0 / p)
+        factor = jnp.where(norms > max_norm, max_norm / jnp.maximum(norms, 1e-12), 1.0)
+        return a * factor
+
+    return apply_fn("renorm", fn, x)
+
+
+def vander(x, n=None, increasing=False, name=None):
+    return apply_fn("vander",
+                    lambda a: jnp.vander(a, N=n, increasing=increasing), x)
+
+
+# ---- linalg (reference: tensor/linalg.py) ----
+
+def inverse(x, name=None):
+    return apply_fn("inverse", lambda a: jnp.linalg.inv(a), x)
+
+
+def cholesky_inverse(x, upper=False, name=None):
+    def fn(l):
+        u = l.T if not upper else l
+        inv_u = jax.scipy.linalg.solve_triangular(
+            u, jnp.eye(u.shape[-1], dtype=u.dtype), lower=False)
+        return inv_u @ inv_u.T
+
+    return apply_fn("cholesky_inverse", fn, x)
+
+
+def block_diag(inputs, name=None):
+    # pass the ORIGINAL tensors through apply_fn so autograd links survive
+    args = [i if isinstance(i, Tensor) else Tensor(np.asarray(i))
+            for i in inputs]
+    return apply_fn("block_diag", lambda *a: jax.scipy.linalg.block_diag(*a),
+                    *args)
+
+
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack combined LU factor + pivots (reference: tensor/linalg.py lu_unpack)."""
+
+    def fn(lu, piv):
+        m, n = lu.shape[-2], lu.shape[-1]
+        k = min(m, n)
+        l = jnp.tril(lu[..., :, :k], -1) + jnp.eye(m, k, dtype=lu.dtype)
+        u = jnp.triu(lu[..., :k, :])
+        # pivots (1-based sequential swaps) -> permutation matrix
+        perm = jnp.arange(m)
+        for i in range(piv.shape[-1]):
+            j = piv[..., i] - 1
+            pi, pj = perm[i], perm[j]
+            perm = perm.at[i].set(pj).at[j].set(pi)
+        p = jnp.eye(m, dtype=lu.dtype)[perm].T
+        return p, l, u
+
+    return apply_fn("lu_unpack", fn, lu_data, lu_pivots)
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """Randomized low-rank SVD (reference: tensor/linalg.py svd_lowrank)."""
+    from ..framework.random import next_key
+
+    def fn(a):
+        m, n = a.shape[-2], a.shape[-1]
+        r = min(q, m, n)
+        g = jax.random.normal(next_key(), a.shape[:-2] + (n, r), a.dtype)
+        y = a @ g
+        for _ in range(niter):
+            y = a @ (a.swapaxes(-1, -2) @ y)
+        qmat, _ = jnp.linalg.qr(y)
+        b = qmat.swapaxes(-1, -2) @ a
+        u, s, vh = jnp.linalg.svd(b, full_matrices=False)
+        return qmat @ u, s, vh.swapaxes(-1, -2)
+
+    return apply_fn("svd_lowrank", fn, x)
+
+
+def ormqr(x, tau, y, left=True, transpose=False, name=None):
+    """Multiply by Q from a QR factorization's householder reflectors
+    (reference: tensor/linalg.py ormqr)."""
+
+    def fn(a, t, other):
+        # pad reflectors/taus to m so householder_product yields the FULL
+        # m x m Q (extra tau=0 reflectors are identities)
+        m = a.shape[-2]
+        if a.shape[-1] < m:
+            pad = [(0, 0)] * (a.ndim - 1) + [(0, m - a.shape[-1])]
+            a = jnp.pad(a, pad)
+        if t.shape[-1] < m:
+            t = jnp.pad(t, [(0, 0)] * (t.ndim - 1) + [(0, m - t.shape[-1])])
+        q = jax.lax.linalg.householder_product(a, t)
+        qm = q.swapaxes(-1, -2) if transpose else q
+        return qm @ other if left else other @ qm
+
+    return apply_fn("ormqr", fn, x, tau, y)
+
+
+# ---- manipulation (reference: tensor/manipulation.py) ----
+
+def _split_helper(op_name, axis):
+    def f(x, num_or_indices, name=None):
+        def fn(a):
+            if isinstance(num_or_indices, int):
+                return tuple(jnp.split(a, num_or_indices, axis=axis))
+            return tuple(jnp.split(a, list(num_or_indices), axis=axis))
+
+        return apply_fn(op_name, fn, x)
+
+    f.__name__ = op_name
+    return f
+
+
+hsplit = _split_helper("hsplit", 1)
+vsplit = _split_helper("vsplit", 0)
+dsplit = _split_helper("dsplit", 2)
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    def fn(a):
+        return tuple(jnp.array_split(a, num_or_indices
+                                     if isinstance(num_or_indices, int)
+                                     else list(num_or_indices), axis=axis))
+
+    return apply_fn("tensor_split", fn, x)
+
+
+def reverse(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    return apply_fn("reverse", lambda a: jnp.flip(a, axis=tuple(axes)), x)
+
+
+def unflatten(x, axis, shape, name=None):
+    shp = tuple(int(unwrap(s)) for s in shape)
+
+    def fn(a):
+        ax = axis % a.ndim
+        return a.reshape(a.shape[:ax] + shp + a.shape[ax + 1:])
+
+    return apply_fn("unflatten", fn, x)
+
+
+def unfold(x, axis, size, step, name=None):
+    """Sliding windows along axis -> appended window dim (reference:
+    tensor/manipulation.py unfold; torch.Tensor.unfold semantics)."""
+
+    def fn(a):
+        ax = axis % a.ndim
+        n = a.shape[ax]
+        num = (n - size) // step + 1
+        starts = jnp.arange(num) * step
+        idx = starts[:, None] + jnp.arange(size)[None, :]
+        out = jnp.take(a, idx.reshape(-1), axis=ax)
+        out = out.reshape(a.shape[:ax] + (num, size) + a.shape[ax + 1:])
+        return jnp.moveaxis(out, ax + 1, -1)
+
+    return apply_fn("unfold", fn, x)
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    """Strided view materialized via gather (XLA has no aliasing views)."""
+    shape = tuple(int(s) for s in shape)
+    stride = tuple(int(s) for s in stride)
+
+    def fn(a):
+        flat = a.ravel()
+        if not shape:
+            return flat[offset]
+        mesh = jnp.meshgrid(*[jnp.arange(s) for s in shape], indexing="ij")
+        lin = offset
+        for g, st in zip(mesh, stride):
+            lin = lin + g * st
+        return flat[lin]
+
+    return apply_fn("as_strided", fn, x)
+
+
+def index_fill(x, index, axis, value, name=None):
+    val = unwrap(value) if isinstance(value, Tensor) else value
+
+    def fn(a, idx):
+        moved = jnp.moveaxis(a, axis, 0)
+        moved = moved.at[idx].set(val)
+        return jnp.moveaxis(moved, 0, axis)
+
+    return apply_fn("index_fill", fn, x, index)
+
+
+def masked_scatter(x, mask, value, name=None):
+    def fn(a, m, v):
+        mb = jnp.broadcast_to(m, a.shape).ravel()
+        # position among True entries for each element
+        pos = jnp.cumsum(mb) - 1
+        src = v.ravel()
+        gathered = src[jnp.clip(pos, 0, src.shape[0] - 1)]
+        return jnp.where(mb, gathered, a.ravel()).reshape(a.shape)
+
+    return apply_fn("masked_scatter", fn, x, mask, value)
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    def fn(a, b):
+        ax1, ax2 = axis1 % a.ndim, axis2 % a.ndim
+        moved = jnp.moveaxis(a, (ax1, ax2), (-2, -1))
+        m, n = moved.shape[-2], moved.shape[-1]
+        rows = jnp.arange(max(m, n))
+        if offset >= 0:
+            r, c = rows[: min(m, n - offset)], rows[: min(m, n - offset)] + offset
+        else:
+            r, c = rows[: min(m + offset, n)] - offset, rows[: min(m + offset, n)]
+        moved = moved.at[..., r, c].set(b)
+        return jnp.moveaxis(moved, (-2, -1), (ax1, ax2))
+
+    return apply_fn("diagonal_scatter", fn, x, y)
+
+
+def select_scatter(x, values, axis, index, name=None):
+    def fn(a, v):
+        moved = jnp.moveaxis(a, axis, 0)
+        moved = moved.at[index].set(v)
+        return jnp.moveaxis(moved, 0, axis)
+
+    return apply_fn("select_scatter", fn, x, values)
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    def fn(a, v):
+        sl = [slice(None)] * a.ndim
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            sl[ax] = slice(st, en, sd)
+        return a.at[tuple(sl)].set(v)
+
+    return apply_fn("slice_scatter", fn, x, value)
+
+
+# ---- histogram (reference: tensor/linalg.py histogram*) ----
+
+def histogram_bin_edges(x, bins=100, min=0.0, max=0.0, name=None):
+    def fn(a):
+        lo, hi = (jnp.min(a), jnp.max(a)) if min == max == 0.0 else (min, max)
+        return jnp.linspace(lo, hi, bins + 1).astype(jnp.float32)
+
+    return apply_fn("histogram_bin_edges", fn, x)
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    wv = unwrap(weights) if weights is not None else None
+
+    def fn(a):
+        return jnp.histogramdd(a, bins=bins, range=ranges, density=density,
+                               weights=wv)
+
+    return apply_fn("histogramdd", fn, x)
+
+
+# ---- sampling (reference: tensor/search.py top_p_sampling) ----
+
+def top_p_sampling(x, ps, threshold=None, seed=None, name=None):
+    """Nucleus sampling over the last axis; ``ps`` may be a scalar or a
+    per-batch tensor [batch]. Returns (values, indices)."""
+    from ..framework.random import next_key
+
+    if isinstance(ps, (float, int)):
+        pv = jnp.asarray(float(ps), jnp.float32)
+    else:
+        pv = unwrap(ps).astype(jnp.float32)
+
+    def fn(logits):
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        sort_idx = jnp.argsort(-probs, axis=-1)
+        sorted_p = jnp.take_along_axis(probs, sort_idx, axis=-1)
+        cum = jnp.cumsum(sorted_p, axis=-1)
+        p_b = pv if pv.ndim == 0 else pv.reshape(pv.shape + (1,) * (logits.ndim - pv.ndim))
+        keep = cum - sorted_p <= p_b  # keep tokens until cumulative mass > p
+        filtered = jnp.where(keep, sorted_p, 0.0)
+        filtered = filtered / jnp.sum(filtered, axis=-1, keepdims=True)
+        key = jax.random.key(int(seed)) if seed is not None else next_key()
+        choice = jax.random.categorical(key, jnp.log(jnp.maximum(filtered, 1e-38)),
+                                        axis=-1)
+        idx = jnp.take_along_axis(sort_idx, choice[..., None], axis=-1)
+        val = jnp.take_along_axis(probs, idx, axis=-1)
+        return val, idx
+
+    return apply_fn("top_p_sampling", fn, x)

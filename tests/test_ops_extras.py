@@ -1,0 +1,262 @@
+"""Numpy-referenced tests for the breadth-completion ops (OpTest pattern:
+test/legacy_test/op_test.py — each op vs its numpy reference)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import fft as pfft
+from paddle_tpu import signal as psignal
+
+T = paddle.to_tensor
+
+
+class TestMathExtras:
+    def test_addmm(self):
+        i = np.ones((2, 3), np.float32)
+        a = np.random.rand(2, 4).astype(np.float32)
+        b = np.random.rand(4, 3).astype(np.float32)
+        out = paddle.addmm(T(i), T(a), T(b), beta=0.5, alpha=2.0)
+        np.testing.assert_allclose(out.numpy(), 0.5 * i + 2.0 * (a @ b), rtol=1e-5)
+
+    def test_cdist_dist(self):
+        x = np.random.rand(5, 3).astype(np.float32)
+        y = np.random.rand(4, 3).astype(np.float32)
+        out = paddle.cdist(T(x), T(y))
+        ref = np.sqrt(((x[:, None] - y[None]) ** 2).sum(-1))
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+        d = paddle.dist(T(x[:4]), T(y), p=2)
+        np.testing.assert_allclose(float(d), np.linalg.norm((x[:4] - y).ravel()),
+                                   rtol=1e-5)
+
+    def test_diff(self):
+        x = np.array([1.0, 4.0, 9.0, 16.0], np.float32)
+        np.testing.assert_allclose(paddle.diff(T(x)).numpy(), np.diff(x))
+
+    def test_special_functions(self):
+        from scipy import special as sp
+
+        x = np.linspace(0.5, 3.0, 6).astype(np.float32)
+        np.testing.assert_allclose(paddle.gammaln(T(x)).numpy(),
+                                   sp.gammaln(x), rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(paddle.i0e(T(x)).numpy(), sp.i0e(x),
+                                   rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(paddle.sinc(T(x)).numpy(), np.sinc(x),
+                                   rtol=1e-4, atol=1e-6)
+        p = np.linspace(0.1, 0.9, 5).astype(np.float32)
+        np.testing.assert_allclose(paddle.logit(T(p)).numpy(),
+                                   np.log(p / (1 - p)), rtol=1e-4, atol=1e-6)
+
+    def test_logcumsumexp(self):
+        x = np.random.rand(6).astype(np.float32)
+        out = paddle.logcumsumexp(T(x), axis=0)
+        ref = np.log(np.cumsum(np.exp(x)))
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+    def test_isin_and_inf_checks(self):
+        x = np.array([1.0, np.inf, -np.inf, 2.0], np.float32)
+        assert paddle.isposinf(T(x)).numpy().tolist() == [False, True, False, False]
+        assert paddle.isneginf(T(x)).numpy().tolist() == [False, False, True, False]
+        out = paddle.isin(T(np.array([1, 2, 3])), T(np.array([2, 3])))
+        assert out.numpy().tolist() == [False, True, True]
+
+    def test_trapezoid(self):
+        y = np.array([1.0, 2.0, 3.0], np.float32)
+        np.testing.assert_allclose(float(paddle.trapezoid(T(y), dx=1.0)), 4.0)
+        ct = paddle.cumulative_trapezoid(T(y), dx=1.0)
+        np.testing.assert_allclose(ct.numpy(), [1.5, 4.0])
+
+    def test_reduce_as(self):
+        x = np.random.rand(4, 3).astype(np.float32)
+        tgt = np.zeros((1, 3), np.float32)
+        out = paddle.reduce_as(T(x), T(tgt))
+        np.testing.assert_allclose(out.numpy(), x.sum(0, keepdims=True), rtol=1e-6)
+
+    def test_renorm_sgn_signbit(self):
+        x = np.array([[3.0, 4.0], [0.3, 0.4]], np.float32)
+        out = paddle.renorm(T(x), p=2.0, axis=0, max_norm=1.0)
+        norms = np.linalg.norm(out.numpy(), axis=1)
+        assert (norms <= 1.0 + 1e-5).all()
+        assert paddle.sgn(T(np.array([-2.0, 0.0, 5.0]))).numpy().tolist() == [-1, 0, 1]
+        assert paddle.signbit(T(np.array([-1.0, 1.0]))).numpy().tolist() == [True, False]
+
+    def test_vander_nanquantile(self):
+        x = np.array([1.0, 2.0, 3.0], np.float32)
+        np.testing.assert_allclose(paddle.vander(T(x)).numpy(), np.vander(x))
+        y = np.array([1.0, np.nan, 3.0, 4.0], np.float32)
+        np.testing.assert_allclose(float(paddle.nanquantile(T(y), 0.5)),
+                                   np.nanquantile(y, 0.5))
+
+
+class TestLinalgExtras:
+    def test_inverse(self):
+        a = np.random.rand(3, 3).astype(np.float32) + 3 * np.eye(3, dtype=np.float32)
+        out = paddle.inverse(T(a))
+        np.testing.assert_allclose(out.numpy() @ a, np.eye(3), atol=1e-4)
+
+    def test_cholesky_inverse(self):
+        a = np.random.rand(3, 3).astype(np.float32)
+        spd = a @ a.T + 3 * np.eye(3, dtype=np.float32)
+        l = np.linalg.cholesky(spd)
+        out = paddle.cholesky_inverse(T(l))
+        np.testing.assert_allclose(out.numpy(), np.linalg.inv(spd), atol=1e-3)
+
+    def test_block_diag(self):
+        a, b = np.ones((2, 2), np.float32), 2 * np.ones((1, 3), np.float32)
+        out = paddle.block_diag([T(a), T(b)])
+        assert out.shape == [3, 5]
+        np.testing.assert_allclose(out.numpy()[:2, :2], a)
+        np.testing.assert_allclose(out.numpy()[2:, 2:], b)
+
+    def test_svd_lowrank(self):
+        rng = np.random.default_rng(0)
+        a = (rng.standard_normal((8, 3)) @ rng.standard_normal((3, 6))).astype(np.float32)
+        u, s, v = paddle.svd_lowrank(T(a), q=3)
+        rec = u.numpy() @ np.diag(s.numpy()) @ v.numpy().T
+        np.testing.assert_allclose(rec, a, atol=1e-3)
+
+    def test_ormqr(self):
+        import scipy.linalg as sla
+
+        a = np.random.rand(4, 3).astype(np.float32)
+        (qr, tau), _ = sla.qr(a, mode="raw")  # LAPACK geqrf reflector layout
+        qr = np.ascontiguousarray(qr).astype(np.float32)
+        y = np.random.rand(4, 2).astype(np.float32)
+        out = paddle.ormqr(T(qr), T(tau.astype(np.float32)), T(y))
+        q_full = sla.qr(a)[0]  # full 4x4 Q from the same reflectors
+        np.testing.assert_allclose(out.numpy(), q_full @ y, rtol=1e-3,
+                                   atol=1e-4)
+
+
+class TestManipExtras:
+    def test_splits(self):
+        x = np.arange(24).reshape(4, 6).astype(np.float32)
+        h = paddle.hsplit(T(x), 2)
+        assert len(h) == 2 and h[0].shape == [4, 3]
+        v = paddle.vsplit(T(x), 2)
+        assert v[0].shape == [2, 6]
+        ts = paddle.tensor_split(T(x), 3, axis=1)
+        assert [t.shape for t in ts] == [[4, 2]] * 3
+
+    def test_reverse_unflatten_unfold(self):
+        x = np.arange(6).astype(np.float32)
+        np.testing.assert_allclose(paddle.reverse(T(x), 0).numpy(), x[::-1])
+        u = paddle.unflatten(T(np.zeros((2, 6), np.float32)), 1, [2, 3])
+        assert u.shape == [2, 2, 3]
+        w = paddle.unfold(T(x), 0, size=3, step=2)
+        np.testing.assert_allclose(w.numpy(), [[0, 1, 2], [2, 3, 4]])
+
+    def test_as_strided(self):
+        x = np.arange(12).astype(np.float32)
+        out = paddle.as_strided(T(x), [3, 2], [4, 1])
+        np.testing.assert_allclose(
+            out.numpy(), np.lib.stride_tricks.as_strided(
+                x, (3, 2), (16, 4)))
+
+    def test_scatter_family(self):
+        x = np.zeros((3, 4), np.float32)
+        out = paddle.index_fill(T(x), T(np.array([0, 2])), 0, 5.0)
+        assert (out.numpy()[[0, 2]] == 5.0).all() and (out.numpy()[1] == 0).all()
+        d = paddle.diagonal_scatter(T(np.zeros((3, 3), np.float32)),
+                                    T(np.array([1.0, 2.0, 3.0], np.float32)))
+        np.testing.assert_allclose(np.diag(d.numpy()), [1, 2, 3])
+        s = paddle.select_scatter(T(x), T(np.ones(4, np.float32)), 0, 1)
+        assert (s.numpy()[1] == 1).all()
+        sl = paddle.slice_scatter(T(x), T(np.ones((3, 2), np.float32)),
+                                  [1], [0], [2], [1])
+        assert (sl.numpy()[:, :2] == 1).all() and (sl.numpy()[:, 2:] == 0).all()
+        ms = paddle.masked_scatter(T(x), T(x == 0),
+                                   T(np.arange(12, dtype=np.float32)))
+        np.testing.assert_allclose(ms.numpy().ravel(), np.arange(12))
+
+    def test_predicates(self):
+        assert paddle.is_tensor(T(np.zeros(2)))
+        assert not paddle.is_tensor(np.zeros(2))
+        assert paddle.is_floating_point(T(np.zeros(2, np.float32)))
+        assert paddle.is_integer(T(np.zeros(2, np.int32)))
+        assert paddle.is_empty(T(np.zeros((0, 3), np.float32)))
+
+
+class TestSampling:
+    def test_top_p_sampling(self):
+        paddle.seed(0)
+        logits = np.full((2, 10), -1e9, np.float32)
+        logits[:, 3] = 10.0  # all mass on token 3
+        val, idx = paddle.top_p_sampling(T(logits), 0.9)
+        assert idx.numpy().ravel().tolist() == [3, 3]
+
+
+class TestFFT:
+    def test_fft_roundtrip(self):
+        x = np.random.rand(16).astype(np.float32)
+        spec = pfft.fft(T(x))
+        back = pfft.ifft(spec)
+        np.testing.assert_allclose(back.numpy().real, x, atol=1e-5)
+
+    def test_rfft_matches_numpy(self):
+        x = np.random.rand(16).astype(np.float32)
+        np.testing.assert_allclose(pfft.rfft(T(x)).numpy(),
+                                   np.fft.rfft(x), rtol=1e-4, atol=1e-5)
+
+    def test_fft2_shift(self):
+        x = np.random.rand(4, 4).astype(np.float32)
+        s = pfft.fftshift(pfft.fft2(T(x)))
+        ref = np.fft.fftshift(np.fft.fft2(x))
+        np.testing.assert_allclose(s.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_fftfreq(self):
+        np.testing.assert_allclose(pfft.fftfreq(8).numpy(), np.fft.fftfreq(8))
+
+
+class TestSignal:
+    def test_stft_istft_roundtrip(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal(512).astype(np.float32)
+        win = np.hanning(128).astype(np.float32)
+        spec = psignal.stft(T(x), n_fft=128, hop_length=32, window=T(win))
+        back = psignal.istft(spec, n_fft=128, hop_length=32, window=T(win),
+                             length=512)
+        np.testing.assert_allclose(back.numpy(), x, atol=1e-3)
+
+    def test_frame_overlap_add(self):
+        x = np.arange(10, dtype=np.float32)
+        fr = psignal.frame(T(x), frame_length=4, hop_length=2)
+        assert fr.shape == [4, 4]
+        np.testing.assert_allclose(fr.numpy()[:, 0], [0, 1, 2, 3])
+
+
+class TestNewOptimizers:
+    def _fit(self, opt_cls, **kw):
+        rng = np.random.default_rng(0)
+        w_true = np.array([[2.0], [-1.0]], np.float32)
+        lin = paddle.nn.Linear(2, 1)
+        opt = opt_cls(parameters=list(lin.parameters()), **kw)
+        for _ in range(150):
+            x = T(rng.standard_normal((32, 2)).astype(np.float32))
+            loss = paddle.mean((lin(x) - T(x.numpy() @ w_true)) ** 2)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        return float(loss)
+
+    def test_asgd_actually_averages(self):
+        # batch_num=4, grads 1 then 3 -> updates use mean over the window:
+        # step1 d=[1,0,0,0] -> -lr*1/4 ; step2 d=[1,3,0,0] -> -lr*4/4
+        import jax.numpy as jnp
+
+        p = paddle.Parameter(np.zeros(1, np.float32))
+        opt = paddle.optimizer.ASGD(learning_rate=1.0, batch_num=4,
+                                    parameters=[p])
+        p.grad = T(np.array([1.0], np.float32))
+        opt.step()
+        np.testing.assert_allclose(p.numpy(), [-0.25])
+        p.grad = T(np.array([3.0], np.float32))
+        opt.step()
+        np.testing.assert_allclose(p.numpy(), [-1.25])  # -0.25 - (1+3)/4
+
+    def test_asgd_converges(self):
+        assert self._fit(paddle.optimizer.ASGD, learning_rate=0.1,
+                         batch_num=1) < 0.05
+
+    def test_rprop_converges(self):
+        assert self._fit(paddle.optimizer.Rprop, learning_rate=0.01) < 0.05
