@@ -291,3 +291,33 @@ class TransformedDistribution(Distribution):
         for t in self.transforms:
             x = t.forward(x)
         return _t(x)
+
+
+# breadth completion: remaining reference distributions + register_kl
+from .extras import (  # noqa: E402,F401
+    Binomial,
+    Cauchy,
+    Chi2,
+    ContinuousBernoulli,
+    ExponentialFamily,
+    Geometric,
+    Gumbel,
+    Independent,
+    LKJCholesky,
+    Laplace,
+    LogNormal,
+    MultivariateNormal,
+    Poisson,
+    StudentT,
+    register_kl,
+)
+from .extras import _lookup_kl as _registry_lookup_kl  # noqa: E402
+
+_builtin_kl = kl_divergence
+
+
+def kl_divergence(p, q):  # noqa: F811 — registry-aware dispatch wraps builtin
+    fn = _registry_lookup_kl(p, q)
+    if fn is not None:
+        return fn(p, q)
+    return _builtin_kl(p, q)

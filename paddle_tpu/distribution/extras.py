@@ -1,0 +1,497 @@
+"""Breadth completion of paddle_tpu.distribution — the remaining reference
+distributions (python/paddle/distribution/: cauchy.py, chi2.py,
+continuous_bernoulli.py, exponential_family.py, multivariate_normal.py,
+independent.py, laplace.py, lognormal.py, lkj_cholesky.py, gumbel.py,
+geometric.py, binomial.py, poisson.py, student_t.py, kl.py register_kl)."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, unwrap
+from ..framework.random import next_key
+from . import Distribution, Normal, _t
+
+__all__ = [
+    "Cauchy", "Chi2", "ContinuousBernoulli", "ExponentialFamily",
+    "MultivariateNormal", "Independent", "Laplace", "LogNormal",
+    "LKJCholesky", "Gumbel", "Geometric", "Binomial", "Poisson", "StudentT",
+    "register_kl",
+]
+
+
+def _arr(x):
+    return jnp.asarray(unwrap(x), jnp.float32)
+
+
+class ExponentialFamily(Distribution):
+    """Base class marking exponential-family members; entropy via Bregman
+    divergence of the log-normalizer (reference: exponential_family.py)."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural):
+        raise NotImplementedError
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc, self.scale = _arr(loc), _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        return _t(self.loc + self.scale * jax.random.cauchy(next_key(), shp))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        z = (v - self.loc) / self.scale
+        return _t(-jnp.log(math.pi * self.scale * (1 + z * z)))
+
+    def entropy(self):
+        return _t(jnp.broadcast_to(jnp.log(4 * math.pi * self.scale),
+                                   self._batch_shape))
+
+    def cdf(self, value):
+        z = (_arr(value) - self.loc) / self.scale
+        return _t(jnp.arctan(z) / math.pi + 0.5)
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc, self.scale = _arr(loc), _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return _t(jnp.broadcast_to(self.loc, self._batch_shape))
+
+    @property
+    def variance(self):
+        return _t(jnp.broadcast_to(2 * self.scale ** 2, self._batch_shape))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        return _t(self.loc + self.scale * jax.random.laplace(next_key(), shp))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return _t(-jnp.abs(v - self.loc) / self.scale
+                  - jnp.log(2 * self.scale))
+
+    def entropy(self):
+        return _t(jnp.broadcast_to(1 + jnp.log(2 * self.scale),
+                                   self._batch_shape))
+
+    def cdf(self, value):
+        z = (_arr(value) - self.loc) / self.scale
+        return _t(0.5 - 0.5 * jnp.sign(z) * jnp.expm1(-jnp.abs(z)))
+
+    def icdf(self, q):
+        qv = _arr(q)
+        return _t(self.loc - self.scale * jnp.sign(qv - 0.5)
+                  * jnp.log1p(-2 * jnp.abs(qv - 0.5)))
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc, self.scale = _arr(loc), _arr(scale)
+        self._normal = Normal(loc, scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return _t(jnp.exp(self.loc + self.scale ** 2 / 2))
+
+    @property
+    def variance(self):
+        s2 = self.scale ** 2
+        return _t(jnp.expm1(s2) * jnp.exp(2 * self.loc + s2))
+
+    def sample(self, shape=()):
+        return _t(jnp.exp(unwrap(self._normal.sample(shape))))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _arr(value)
+        lv = jnp.log(v)
+        return _t(unwrap(self._normal.log_prob(_t(lv))) - lv)
+
+    def entropy(self):
+        return _t(unwrap(self._normal.entropy()) + self.loc)
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc, self.scale = _arr(loc), _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return _t(self.loc + self.scale * np_euler)
+
+    @property
+    def variance(self):
+        return _t((math.pi ** 2 / 6) * self.scale ** 2)
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        return _t(self.loc + self.scale * jax.random.gumbel(next_key(), shp))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        z = (_arr(value) - self.loc) / self.scale
+        return _t(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+    def entropy(self):
+        return _t(jnp.broadcast_to(jnp.log(self.scale) + 1 + np_euler,
+                                   self._batch_shape))
+
+
+np_euler = 0.5772156649015329
+
+
+class Geometric(Distribution):
+    """P(X=k) = (1-p)^k p, k = 0, 1, ... (failures before first success)."""
+
+    def __init__(self, probs=None, logits=None, name=None):
+        if probs is None:
+            probs = jax.nn.sigmoid(_arr(logits))
+        self.probs_arr = _arr(probs)
+        super().__init__(self.probs_arr.shape)
+
+    @property
+    def mean(self):
+        return _t((1 - self.probs_arr) / self.probs_arr)
+
+    @property
+    def variance(self):
+        return _t((1 - self.probs_arr) / self.probs_arr ** 2)
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        u = jax.random.uniform(next_key(), shp, minval=1e-7, maxval=1.0)
+        return _t(jnp.floor(jnp.log(u) / jnp.log1p(-self.probs_arr)))
+
+    def log_prob(self, value):
+        k = _arr(value)
+        return _t(k * jnp.log1p(-self.probs_arr) + jnp.log(self.probs_arr))
+
+    def entropy(self):
+        p = self.probs_arr
+        return _t(-((1 - p) * jnp.log1p(-p) + p * jnp.log(p)) / p)
+
+
+class Poisson(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _arr(rate)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return _t(self.rate)
+
+    @property
+    def variance(self):
+        return _t(self.rate)
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        return _t(jax.random.poisson(next_key(), self.rate, shp).astype(jnp.float32))
+
+    def log_prob(self, value):
+        k = _arr(value)
+        return _t(k * jnp.log(self.rate) - self.rate
+                  - jax.scipy.special.gammaln(k + 1))
+
+
+class Binomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = _arr(total_count)
+        self.probs_arr = _arr(probs)
+        super().__init__(jnp.broadcast_shapes(self.total_count.shape,
+                                              self.probs_arr.shape))
+
+    @property
+    def mean(self):
+        return _t(self.total_count * self.probs_arr)
+
+    @property
+    def variance(self):
+        return _t(self.total_count * self.probs_arr * (1 - self.probs_arr))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        n = int(jnp.max(self.total_count))
+        u = jax.random.uniform(next_key(), shp + (n,))
+        counts = jnp.sum(
+            (u < self.probs_arr[..., None])
+            & (jnp.arange(n) < self.total_count[..., None]), -1)
+        return _t(counts.astype(jnp.float32))
+
+    def log_prob(self, value):
+        k, n, p = _arr(value), self.total_count, self.probs_arr
+        logc = (jax.scipy.special.gammaln(n + 1)
+                - jax.scipy.special.gammaln(k + 1)
+                - jax.scipy.special.gammaln(n - k + 1))
+        return _t(logc + k * jnp.log(p) + (n - k) * jnp.log1p(-p))
+
+
+class Chi2(Distribution):
+    def __init__(self, df, name=None):
+        self.df = _arr(df)
+        super().__init__(self.df.shape)
+
+    @property
+    def mean(self):
+        return _t(self.df)
+
+    @property
+    def variance(self):
+        return _t(2 * self.df)
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        return _t(2 * jax.random.gamma(next_key(), self.df / 2, shp))
+
+    def log_prob(self, value):
+        v, k = _arr(value), self.df
+        return _t((k / 2 - 1) * jnp.log(v) - v / 2 - (k / 2) * math.log(2.0)
+                  - jax.scipy.special.gammaln(k / 2))
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df, self.loc, self.scale = _arr(df), _arr(loc), _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.df.shape, self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return _t(jnp.where(self.df > 1, self.loc, jnp.nan))
+
+    @property
+    def variance(self):
+        v = self.scale ** 2 * self.df / (self.df - 2)
+        return _t(jnp.where(self.df > 2, v, jnp.nan))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        return _t(self.loc + self.scale * jax.random.t(next_key(), self.df, shp))
+
+    def log_prob(self, value):
+        z = (_arr(value) - self.loc) / self.scale
+        nu = self.df
+        lg = jax.scipy.special.gammaln
+        return _t(lg((nu + 1) / 2) - lg(nu / 2)
+                  - 0.5 * jnp.log(nu * math.pi) - jnp.log(self.scale)
+                  - (nu + 1) / 2 * jnp.log1p(z * z / nu))
+
+
+class ContinuousBernoulli(ExponentialFamily):
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs_arr = _arr(probs)
+        self._lims = lims
+        super().__init__(self.probs_arr.shape)
+
+    def _log_norm_const(self):
+        p = self.probs_arr
+        # C(p) = 2 atanh(1-2p) / (1-2p), continuous at p=1/2 where C=2
+        safe = jnp.clip(p, 1e-6, 1 - 1e-6)
+        cut = (safe < self._lims[0]) | (safe > self._lims[1])
+        x = jnp.where(cut, safe, 0.25)  # dummy inside the removable singularity
+        c = 2 * jnp.arctanh(1 - 2 * x) / (1 - 2 * x)
+        return jnp.log(jnp.where(cut, c, 2.0))
+
+    def log_prob(self, value):
+        v, p = _arr(value), jnp.clip(self.probs_arr, 1e-6, 1 - 1e-6)
+        return _t(v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+                  + self._log_norm_const())
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        p = jnp.clip(self.probs_arr, 1e-6, 1 - 1e-6)
+        u = jax.random.uniform(next_key(), shp, minval=1e-6, maxval=1 - 1e-6)
+        # inverse cdf; at p ~ 1/2 the icdf degenerates to u
+        icdf = jnp.log1p((2 * p - 1) * u / (1 - p)) / jnp.log(p / (1 - p))
+        mid = (p > self._lims[0]) & (p < self._lims[1])
+        return _t(jnp.where(mid, u, icdf))
+
+    rsample = sample
+
+
+class MultivariateNormal(Distribution):
+    def __init__(self, loc, covariance_matrix=None, scale_tril=None, name=None):
+        self.loc = _arr(loc)
+        if scale_tril is not None:
+            self.scale_tril = _arr(scale_tril)
+        else:
+            self.scale_tril = jnp.linalg.cholesky(_arr(covariance_matrix))
+        super().__init__(self.loc.shape[:-1], self.loc.shape[-1:])
+
+    @property
+    def mean(self):
+        return _t(self.loc)
+
+    @property
+    def variance(self):
+        return _t(jnp.sum(self.scale_tril ** 2, -1))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape + self._event_shape
+        eps = jax.random.normal(next_key(), shp)
+        return _t(self.loc + jnp.einsum("...ij,...j->...i", self.scale_tril, eps))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        d = self.loc.shape[-1]
+        diff = _arr(value) - self.loc
+        L = jnp.broadcast_to(self.scale_tril,
+                             diff.shape[:-1] + self.scale_tril.shape[-2:])
+        sol = jax.scipy.linalg.solve_triangular(L, diff[..., None],
+                                                lower=True)[..., 0]
+        maha = jnp.sum(sol ** 2, -1)
+        logdet = 2 * jnp.sum(jnp.log(jnp.diagonal(self.scale_tril, axis1=-2,
+                                                  axis2=-1)), -1)
+        return _t(-0.5 * (maha + d * math.log(2 * math.pi) + logdet))
+
+    def entropy(self):
+        d = self.loc.shape[-1]
+        logdet = 2 * jnp.sum(jnp.log(jnp.diagonal(self.scale_tril, axis1=-2,
+                                                  axis2=-1)), -1)
+        return _t(0.5 * (d * (1 + math.log(2 * math.pi)) + logdet))
+
+
+class Independent(Distribution):
+    """Reinterpret batch dims as event dims (reference: independent.py)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.k = int(reinterpreted_batch_rank)
+        bs = tuple(base._batch_shape)
+        super().__init__(bs[: len(bs) - self.k],
+                         bs[len(bs) - self.k:] + tuple(base._event_shape))
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        lp = unwrap(self.base.log_prob(value))
+        return _t(jnp.sum(lp, axis=tuple(range(-self.k, 0))))
+
+    def entropy(self):
+        e = unwrap(self.base.entropy())
+        return _t(jnp.sum(e, axis=tuple(range(-self.k, 0))))
+
+
+class LKJCholesky(Distribution):
+    """LKJ prior over correlation-matrix Cholesky factors
+    (reference: lkj_cholesky.py; onion-method sampling)."""
+
+    def __init__(self, dim, concentration=1.0, sample_method="onion", name=None):
+        self.dim = int(dim)
+        self.concentration = _arr(concentration)
+        super().__init__(self.concentration.shape, (self.dim, self.dim))
+
+    def sample(self, shape=()):
+        d = self.dim
+        eta = self.concentration
+        shp = tuple(shape) + self._batch_shape
+        # onion method: build row by row
+        key_beta = next_key()
+        key_sph = next_key()
+        L = jnp.zeros(shp + (d, d), jnp.float32).at[..., 0, 0].set(1.0)
+        for i in range(1, d):
+            beta_a = eta + (d - 1 - i) / 2.0
+            beta_b = jnp.asarray(i / 2.0, jnp.float32)
+            r2 = jax.random.beta(jax.random.fold_in(key_beta, i),
+                                 beta_b, beta_a, shp)
+            u = jax.random.normal(jax.random.fold_in(key_sph, i), shp + (i,))
+            u = u / jnp.linalg.norm(u, axis=-1, keepdims=True)
+            w = jnp.sqrt(r2)[..., None] * u
+            L = L.at[..., i, :i].set(w)
+            L = L.at[..., i, i].set(jnp.sqrt(jnp.clip(1 - r2, 1e-12)))
+        return _t(L)
+
+    def log_prob(self, value):
+        L = _arr(value)
+        d, eta = self.dim, self.concentration
+        diags = jnp.diagonal(L, axis1=-2, axis2=-1)[..., 1:]
+        orders = jnp.arange(d - 1, 0, -1, dtype=jnp.float32)
+        expo = 2 * (eta[..., None] - 1) + orders
+        unnorm = jnp.sum(expo * jnp.log(diags), -1)
+        # normalizer (reference lkj_cholesky.py closed form)
+        lg = jax.scipy.special.gammaln
+        i = jnp.arange(1, d, dtype=jnp.float32)
+        alpha = eta[..., None] + (d - 1 - i) / 2
+        norm = jnp.sum(i / 2 * math.log(math.pi) + lg(alpha)
+                       - lg(alpha + i / 2), -1)
+        return _t(unnorm - norm)
+
+
+# ---------------------------------------------------------------------------
+# register_kl (reference: python/paddle/distribution/kl.py)
+# ---------------------------------------------------------------------------
+
+_KL_REGISTRY = {}
+
+
+def register_kl(p_cls, q_cls):
+    """Decorator registering a KL(p||q) implementation, dispatched by
+    kl_divergence with most-derived-class matching."""
+
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+
+    return deco
+
+
+def _lookup_kl(p, q):
+    best, best_fn = None, None
+    for (pc, qc), fn in _KL_REGISTRY.items():
+        if isinstance(p, pc) and isinstance(q, qc):
+            score = (len(type(p).__mro__) - len(pc.__mro__),
+                     len(type(q).__mro__) - len(qc.__mro__))
+            if best is None or score < best:
+                best, best_fn = score, fn
+    return best_fn
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace(p, q):
+    # KL = log(b2/b1) + |mu1-mu2|/b2 + (b1/b2) exp(-|mu1-mu2|/b1) - 1
+    d = jnp.abs(p.loc - q.loc)
+    return _t(jnp.log(q.scale / p.scale) + d / q.scale
+              + (p.scale / q.scale) * jnp.exp(-d / p.scale) - 1)
+
+
+@register_kl(Poisson, Poisson)
+def _kl_poisson(p, q):
+    return _t(p.rate * (jnp.log(p.rate) - jnp.log(q.rate)) - p.rate + q.rate)
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geometric(p, q):
+    pp, qq = p.probs_arr, q.probs_arr
+    return _t(jnp.log(pp / qq) + (1 - pp) / pp * jnp.log((1 - pp) / (1 - qq)))

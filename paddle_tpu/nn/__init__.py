@@ -5,6 +5,7 @@ from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa:
 from .layer.activation import *  # noqa: F401,F403
 from .layer.common import *  # noqa: F401,F403
 from .layer.conv import *  # noqa: F401,F403
+from .layer.extras import *  # noqa: F401,F403
 from .layer.layers import Layer, LayerList, ParameterList, Sequential  # noqa: F401
 from .layer.loss import *  # noqa: F401,F403
 from .layer.norm import *  # noqa: F401,F403

@@ -3,6 +3,7 @@
 from .activation import *  # noqa: F401,F403
 from .common import *  # noqa: F401,F403
 from .conv import *  # noqa: F401,F403
+from .extras import *  # noqa: F401,F403
 from .flash_attention import (  # noqa: F401
     flash_attention,
     flashmask_attention,

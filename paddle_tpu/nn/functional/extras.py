@@ -1,0 +1,446 @@
+"""Functional forms for the nn breadth-completion layers (reference:
+python/paddle/nn/functional — loss.py, pooling.py max_unpool*, ctc_loss,
+rnnt_loss, gaussian_nll_loss, multi_margin_loss...)."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.op_registry import apply_fn
+from ...core.tensor import Tensor, unwrap
+
+__all__ = [
+    "max_pool2d_with_mask", "max_unpool1d", "max_unpool2d", "max_unpool3d",
+    "soft_margin_loss", "multi_label_soft_margin_loss", "multi_margin_loss",
+    "poisson_nll_loss", "gaussian_nll_loss", "triplet_margin_with_distance_loss",
+    "pairwise_distance", "ctc_loss", "rnnt_loss", "hsigmoid_loss",
+    "softmax_2d", "feature_alpha_dropout",
+]
+
+
+# ---------------------------------------------------------------------------
+# max pool with indices + unpool (reference: nn/functional/pooling.py)
+# ---------------------------------------------------------------------------
+
+def _tup(v, n):
+    return tuple(v) if isinstance(v, (tuple, list)) else (int(v),) * n
+
+
+def _pool_with_mask(x, kernel, stride, padding, n, ceil_mode=False):
+    """NC<spatial> max pool returning (out, flat_indices_into_spatial)."""
+    if ceil_mode:
+        raise NotImplementedError(
+            "return_mask with ceil_mode is not supported — pad the input "
+            "explicitly instead")
+    kernel, stride = _tup(kernel, n), _tup(stride or kernel, n)
+    pad = _tup(padding, n)
+
+    def fn(a):
+        spatial = a.shape[2:]
+        if any(pad):
+            # pad with a large finite minimum: patch extraction pads with 0
+            # (which would beat negative inputs), and -inf would turn into
+            # NaN inside the conv-based patch gather (0 * -inf)
+            neg = (jnp.finfo(a.dtype).min / 2
+                   if jnp.issubdtype(a.dtype, jnp.floating)
+                   else jnp.iinfo(a.dtype).min)
+            cfg = [(0, 0), (0, 0)] + [(p, p) for p in pad]
+            a_p = jnp.pad(a, cfg, constant_values=neg)
+        else:
+            a_p = a
+        patches = jax.lax.conv_general_dilated_patches(
+            a_p, kernel, stride, [(0, 0)] * n)
+        # patches: [N, C*prod(k), out_spatial...]; feature dim orders C-major
+        N = patches.shape[0]
+        C = a.shape[1]
+        k = int(np.prod(kernel))
+        out_sp = patches.shape[2:]
+        pt = patches.reshape(N, C, k, *out_sp)
+        out = jnp.max(pt, axis=2)
+        win_arg = jnp.argmax(pt, axis=2)  # index within window (never -inf
+        # unless the whole window is padding, which pooling shapes preclude)
+        # convert window-local index -> global flat index in UNPADDED coords
+        win_coords = jnp.unravel_index(win_arg, kernel)
+        grids = jnp.meshgrid(*[jnp.arange(s) for s in out_sp], indexing="ij")
+        flat = jnp.zeros_like(win_arg)
+        mult = 1
+        for d in reversed(range(n)):
+            g = grids[d].reshape((1, 1) + out_sp)
+            coord = g * stride[d] - pad[d] + win_coords[d]
+            coord = jnp.clip(coord, 0, spatial[d] - 1)
+            flat = flat + coord * mult
+            mult *= spatial[d]
+        return out, flat.astype(jnp.int32)
+
+    return apply_fn("max_pool_with_mask", fn, x)
+
+
+def max_pool2d_with_mask(x, kernel_size, stride=None, padding=0):
+    return _pool_with_mask(x, kernel_size, stride, padding, 2)
+
+
+def _unpool(x, indices, n, kernel, stride, padding, output_size):
+    kernel = _tup(kernel, n)
+    stride = _tup(stride or kernel, n)
+    pad = _tup(padding, n)
+
+    def fn(a, idx):
+        N, C = a.shape[:2]
+        in_sp = a.shape[2:]
+        if output_size is not None:
+            out_sp = tuple(int(s) for s in output_size)[-n:]
+        else:
+            out_sp = tuple((in_sp[d] - 1) * stride[d] - 2 * pad[d] + kernel[d]
+                           for d in range(n))
+        total = int(np.prod(out_sp))
+        flat = jnp.zeros((N, C, total), a.dtype)
+        flat = flat.at[
+            jnp.arange(N)[:, None, None],
+            jnp.arange(C)[None, :, None],
+            idx.reshape(N, C, -1),
+        ].set(a.reshape(N, C, -1))
+        return flat.reshape((N, C) + out_sp)
+
+    return apply_fn("max_unpool", fn, x, indices)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCL", name=None):
+    return _unpool(x, indices, 1, kernel_size, stride, padding, output_size)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCHW", name=None):
+    return _unpool(x, indices, 2, kernel_size, stride, padding, output_size)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCDHW", name=None):
+    return _unpool(x, indices, 3, kernel_size, stride, padding, output_size)
+
+
+# ---------------------------------------------------------------------------
+# losses (reference: nn/functional/loss.py)
+# ---------------------------------------------------------------------------
+
+def _reduce(v, reduction):
+    if reduction == "mean":
+        return jnp.mean(v)
+    if reduction == "sum":
+        return jnp.sum(v)
+    return v
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    def fn(x, y):
+        return _reduce(jnp.log1p(jnp.exp(-y * x)), reduction)
+
+    return apply_fn("soft_margin_loss", fn, input, label)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean",
+                                 name=None):
+    w = unwrap(weight) if weight is not None else None
+
+    def fn(x, y):
+        l = -(y * jax.nn.log_sigmoid(x) + (1 - y) * jax.nn.log_sigmoid(-x))
+        if w is not None:
+            l = l * w
+        return _reduce(l.mean(-1), reduction)
+
+    return apply_fn("multi_label_soft_margin_loss", fn, input, label)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    w = unwrap(weight) if weight is not None else None
+
+    def fn(x, y):
+        n, c = x.shape
+        xy = jnp.take_along_axis(x, y[:, None], axis=1)
+        m = jnp.maximum(0.0, margin - xy + x) ** p
+        m = m.at[jnp.arange(n), y].set(0.0)
+        if w is not None:
+            m = m * w[y][:, None]  # per-sample scale by weight[label]
+        return _reduce(m.sum(-1) / c, reduction)
+
+    return apply_fn("multi_margin_loss", fn, input, label)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    def fn(x, y):
+        if log_input:
+            l = jnp.exp(x) - y * x
+        else:
+            l = x - y * jnp.log(x + epsilon)
+        if full:
+            stirling = y * jnp.log(y + epsilon) - y + 0.5 * jnp.log(
+                2 * math.pi * jnp.maximum(y, 1.0))
+            l = l + jnp.where(y > 1, stirling, 0.0)
+        return _reduce(l, reduction)
+
+    return apply_fn("poisson_nll_loss", fn, input, label)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    def fn(mu, y, var):
+        var = jnp.maximum(var, epsilon)
+        l = 0.5 * (jnp.log(var) + (y - mu) ** 2 / var)
+        if full:
+            l = l + 0.5 * math.log(2 * math.pi)
+        return _reduce(l, reduction)
+
+    return apply_fn("gaussian_nll_loss", fn, input, label, variance)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    def fn(a, b):
+        d = a - b + epsilon
+        return jnp.sum(jnp.abs(d) ** p, -1, keepdims=keepdim) ** (1.0 / p)
+
+    return apply_fn("pairwise_distance", fn, x, y)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean", name=None):
+    dist = distance_function or (lambda a, b: pairwise_distance(a, b))
+
+    def fn(a, p_, n_):
+        dp = unwrap(dist(Tensor(a), Tensor(p_)))
+        dn = unwrap(dist(Tensor(a), Tensor(n_)))
+        if swap:
+            dn2 = unwrap(dist(Tensor(p_), Tensor(n_)))
+            dn = jnp.minimum(dn, dn2)
+        return _reduce(jnp.maximum(0.0, dp - dn + margin), reduction)
+
+    return apply_fn("triplet_margin_with_distance_loss", fn,
+                    input, positive, negative)
+
+
+def softmax_2d(x, name=None):
+    """Softmax over the channel dim of NCHW input (reference: Softmax2D)."""
+    return apply_fn("softmax_2d", lambda a: jax.nn.softmax(a, axis=-3), x)
+
+
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    """Alpha dropout over whole channels (SELU-preserving)."""
+    if not training or p == 0.0:
+        return x
+
+    def fn(a):
+        from ...framework.random import next_key
+
+        alpha_p = -1.7580993408473766
+        shape = (a.shape[0], a.shape[1]) + (1,) * (a.ndim - 2)
+        keep = jax.random.bernoulli(next_key(), 1.0 - p, shape)
+        q = 1.0 - p
+        a_coef = (q + alpha_p ** 2 * q * p) ** -0.5
+        b_coef = -a_coef * alpha_p * p
+        return a_coef * jnp.where(keep, a, alpha_p) + b_coef
+
+    return apply_fn("feature_alpha_dropout", fn, x)
+
+
+# ---------------------------------------------------------------------------
+# CTC loss (reference: nn/functional/loss.py ctc_loss over warpctc kernel)
+# ---------------------------------------------------------------------------
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False, name=None):
+    """log-domain alpha recursion inside lax.scan — the TPU-native warpctc.
+
+    log_probs: [T, B, C] (reference layout) log-softmaxed or raw logits;
+    labels: [B, S] int; returns per-batch negative log likelihood.
+    """
+
+    def fn(lp, lab, in_len, lab_len):
+        lp = jax.nn.log_softmax(lp.astype(jnp.float32), axis=-1)
+        T, B, C = lp.shape
+        S = lab.shape[1]
+        # extended sequence: blank l1 blank l2 ... blank lS blank (len 2S+1)
+        ext = jnp.full((B, 2 * S + 1), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lab.astype(jnp.int32))
+        ext_len = 2 * lab_len.astype(jnp.int32) + 1
+        NEG = -1e30
+
+        # allowed skip: ext[s] != ext[s-2] (and s odd positions only)
+        skip_ok = jnp.concatenate(
+            [jnp.zeros((B, 2), bool), ext[:, 2:] != ext[:, :-2]], axis=1)
+        skip_ok = skip_ok & (jnp.arange(2 * S + 1)[None] % 2 == 1)
+
+        emit0 = lp[0]  # [B, C]
+        alpha0 = jnp.full((B, 2 * S + 1), NEG)
+        alpha0 = alpha0.at[:, 0].set(jnp.take_along_axis(
+            emit0, jnp.full((B, 1), blank), 1)[:, 0])
+        alpha0 = alpha0.at[:, 1].set(jnp.where(
+            lab_len > 0,
+            jnp.take_along_axis(emit0, ext[:, 1:2], 1)[:, 0], NEG))
+
+        def step(alpha, t):
+            emit = jnp.take_along_axis(lp[t], ext, axis=1)  # [B, 2S+1]
+            stay = alpha
+            prev1 = jnp.concatenate([jnp.full((B, 1), NEG), alpha[:, :-1]], 1)
+            prev2 = jnp.concatenate([jnp.full((B, 2), NEG), alpha[:, :-2]], 1)
+            prev2 = jnp.where(skip_ok, prev2, NEG)
+            new = jnp.logaddexp(jnp.logaddexp(stay, prev1), prev2) + emit
+            # frozen past input length
+            new = jnp.where(t < in_len[:, None], new, alpha)
+            return new, None
+
+        alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+        last = jnp.take_along_axis(alpha, (ext_len - 1)[:, None], 1)[:, 0]
+        last2 = jnp.take_along_axis(
+            alpha, jnp.maximum(ext_len - 2, 0)[:, None], 1)[:, 0]
+        nll = -jnp.logaddexp(last, last2)
+        if reduction == "mean":
+            return jnp.mean(nll / jnp.maximum(lab_len.astype(jnp.float32), 1))
+        if reduction == "sum":
+            return jnp.sum(nll)
+        return nll
+
+    return apply_fn("ctc_loss", fn, log_probs, labels, input_lengths,
+                    label_lengths)
+
+
+def rnnt_loss(logits, labels, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.0, reduction="mean", name=None):
+    """RNN-T loss: alpha recursion over the (T, U) lattice via scan
+    (reference: nn/functional/loss.py rnnt_loss over warprnnt).
+
+    logits: [B, T, U+1, C]; labels: [B, U] int.
+    """
+
+    def fn(lg, lab, t_len, u_len):
+        lp = jax.nn.log_softmax(lg.astype(jnp.float32), axis=-1)
+        B, T, U1, C = lp.shape
+        U = U1 - 1
+        blank_lp = lp[..., blank]  # [B, T, U+1]
+        lab_lp = jnp.take_along_axis(
+            lp[:, :, :U, :], lab[:, None, :, None].astype(jnp.int32), -1
+        )[..., 0]  # [B, T, U]
+        if fastemit_lambda:
+            # FastEmit (arXiv:2010.11148): up-weight label-emission
+            # transitions by (1 + lambda) to penalize delayed emissions
+            lab_lp = lab_lp * (1.0 + fastemit_lambda)
+        NEG = -1e30
+
+        # alpha over diagonals: alpha[t, u]; scan over t, vector over u
+        def step_t(alpha_prev, t):
+            # alpha_prev: [B, U+1] = alpha[t-1, :]
+            # horizontal (time) move: blank from alpha[t-1, u]
+            from_blank = alpha_prev + blank_lp[:, t - 1, :]
+
+            # vertical (label) moves within this t: sequential over u
+            def step_u(carry, u):
+                a = carry  # alpha[t, u-1... building]
+                new = jnp.logaddexp(from_blank[:, u],
+                                    a + lab_lp[:, t, u - 1])
+                return new, new
+
+            first = from_blank[:, 0]
+            _, rest = jax.lax.scan(step_u, first, jnp.arange(1, U + 1))
+            alpha_t = jnp.concatenate([first[:, None], rest.T], axis=1)
+            alpha_t = jnp.where(t < t_len[:, None], alpha_t, alpha_prev)
+            return alpha_t, None
+
+        # t = 0 row: only label moves
+        def init_u(carry, u):
+            new = carry + lab_lp[:, 0, u - 1]
+            return new, new
+
+        a00 = jnp.zeros((B,), jnp.float32)
+        _, rest0 = jax.lax.scan(init_u, a00, jnp.arange(1, U + 1))
+        alpha0 = jnp.concatenate([a00[:, None], rest0.T], axis=1)
+        mask_u = jnp.arange(U + 1)[None] <= u_len[:, None]
+        alpha0 = jnp.where(mask_u, alpha0, NEG)
+
+        alpha, _ = jax.lax.scan(step_t, alpha0, jnp.arange(1, T))
+        final = jnp.take_along_axis(alpha, u_len[:, None].astype(jnp.int32), 1)[:, 0]
+        # terminal blank emission at (t_len-1, u_len)
+        t_idx = (t_len - 1).astype(jnp.int32)
+        term = jnp.take_along_axis(
+            jnp.take_along_axis(blank_lp, t_idx[:, None, None], 1)[:, 0],
+            u_len[:, None].astype(jnp.int32), 1)[:, 0]
+        nll = -(final + term)
+        if reduction == "mean":
+            return jnp.mean(nll)
+        if reduction == "sum":
+            return jnp.sum(nll)
+        return nll
+
+    return apply_fn("rnnt_loss", fn, logits, labels, input_lengths,
+                    label_lengths)
+
+
+# ---------------------------------------------------------------------------
+# hierarchical sigmoid (reference: nn/functional/loss.py hsigmoid_loss —
+# complete-binary-tree default paths)
+# ---------------------------------------------------------------------------
+
+def _tree_paths(num_classes):
+    """Path (node ids, codes) per class in a complete binary tree with
+    num_classes leaves and num_classes-1 internal nodes (heap layout)."""
+    depth = int(np.ceil(np.log2(max(num_classes, 2))))
+    paths, codes = [], []
+    for c in range(num_classes):
+        node = c + num_classes - 1  # leaf position in heap
+        p, k = [], []
+        while node > 0:
+            parent = (node - 1) // 2
+            p.append(parent)
+            k.append(node == 2 * parent + 2)  # right child -> code 1
+            node = parent
+        p = p[::-1]
+        k = k[::-1]
+        while len(p) < depth:  # pad
+            p.append(0)
+            k.append(False)
+        paths.append(p[:depth])
+        codes.append(k[:depth])
+    valid = []
+    for c in range(num_classes):
+        node = c + num_classes - 1
+        d = 0
+        while node > 0:
+            node = (node - 1) // 2
+            d += 1
+        valid.append([i < d for i in range(depth)])
+    return (np.asarray(paths, np.int32), np.asarray(codes, np.float32),
+            np.asarray(valid, bool))
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False, name=None):
+    """weight: [num_classes-1, feature]; bias: [num_classes-1].
+    Custom trees: path_table [num_classes, depth] node ids (-1 padding) and
+    path_code [num_classes, depth] (reference is_custom path)."""
+    if path_table is not None:
+        pt = np.asarray(unwrap(path_table))
+        paths = np.maximum(pt, 0).astype(np.int32)
+        codes = np.asarray(unwrap(path_code), np.float32)
+        valid = pt >= 0
+    else:
+        paths, codes, valid = _tree_paths(int(num_classes))
+
+    def fn(x, y, w, *b):
+        p = jnp.asarray(paths)[y]      # [B, depth]
+        c = jnp.asarray(codes)[y]      # [B, depth]
+        v = jnp.asarray(valid)[y]      # [B, depth]
+        wp = w[p]                      # [B, depth, feature]
+        logits = jnp.einsum("bdf,bf->bd", wp, x)
+        if b:
+            logits = logits + b[0][p]
+        sign = 1.0 - 2.0 * c           # code 0 -> +1, code 1 -> -1
+        lp = jax.nn.log_sigmoid(sign * logits)
+        return -jnp.sum(jnp.where(v, lp, 0.0), -1).mean()
+
+    if bias is not None:
+        return apply_fn("hsigmoid_loss", fn, input, label, weight, bias)
+    return apply_fn("hsigmoid_loss", fn, input, label, weight)

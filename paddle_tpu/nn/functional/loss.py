@@ -226,10 +226,6 @@ def square_error_cost(input, label):
     return apply_fn("square_error_cost", lambda a, b: jnp.square(a - b), input, label)
 
 
-def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction="mean", norm_by_times=False):
-    raise NotImplementedError("ctc_loss lands with the audio suite (tracked in docs/PARITY.md)")
-
-
 def dice_loss(input, label, epsilon=1e-5, name=None):
     def fn(p, l):
         l_oh = jax.nn.one_hot(l.squeeze(-1).astype(jnp.int32), p.shape[-1], dtype=p.dtype)

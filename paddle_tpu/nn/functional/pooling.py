@@ -43,16 +43,29 @@ def _pool(x, kernel, stride, padding, n, reducer, init, channels_first, count_in
     return apply_fn("pool", fn, x)
 
 
+def _max_pool(x, kernel_size, stride, padding, n, return_mask, ceil_mode, data_format):
+    if return_mask:
+        if not data_format.startswith("NC"):
+            raise NotImplementedError("return_mask requires channels-first layout")
+        from .extras import _pool_with_mask
+
+        return _pool_with_mask(x, kernel_size, stride, padding, n,
+                               ceil_mode=ceil_mode)
+    return _pool(x, kernel_size, stride, padding, n, jax.lax.max,
+                 lambda dt: -jnp.inf if jnp.issubdtype(dt, jnp.floating) else int(jnp.iinfo(dt).min),
+                 data_format.startswith("NC"), ceil_mode=ceil_mode)
+
+
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCL", name=None):
-    return _pool(x, kernel_size, stride, padding, 1, jax.lax.max, lambda dt: -jnp.inf if jnp.issubdtype(dt, jnp.floating) else int(jnp.iinfo(dt).min), data_format.startswith("NC"), ceil_mode=ceil_mode)
+    return _max_pool(x, kernel_size, stride, padding, 1, return_mask, ceil_mode, data_format)
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCHW", name=None):
-    return _pool(x, kernel_size, stride, padding, 2, jax.lax.max, lambda dt: -jnp.inf if jnp.issubdtype(dt, jnp.floating) else int(jnp.iinfo(dt).min), data_format.startswith("NC"), ceil_mode=ceil_mode)
+    return _max_pool(x, kernel_size, stride, padding, 2, return_mask, ceil_mode, data_format)
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCDHW", name=None):
-    return _pool(x, kernel_size, stride, padding, 3, jax.lax.max, lambda dt: -jnp.inf if jnp.issubdtype(dt, jnp.floating) else int(jnp.iinfo(dt).min), data_format.startswith("NC"), ceil_mode=ceil_mode)
+    return _max_pool(x, kernel_size, stride, padding, 3, return_mask, ceil_mode, data_format)
 
 
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False, data_format="NCL", name=None):

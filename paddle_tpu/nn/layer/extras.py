@@ -1,0 +1,622 @@
+"""Breadth-completion layers (reference: python/paddle/nn/layer/ — loss.py,
+pooling.py, common.py, rnn.py dynamic_decode/BeamSearchDecoder, norm.py
+SpectralNorm)."""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor, unwrap
+from ..functional import extras as FX
+from .. import initializer as I
+from .layers import Layer
+
+__all__ = [
+    "PairwiseDistance", "Softmax2D", "Unflatten", "FeatureAlphaDropout",
+    "ZeroPad1D", "ZeroPad3D", "LayerDict",
+    "MaxUnPool1D", "MaxUnPool2D", "MaxUnPool3D",
+    "LPPool1D", "LPPool2D", "FractionalMaxPool2D", "FractionalMaxPool3D",
+    "SoftMarginLoss", "MultiLabelSoftMarginLoss", "MultiMarginLoss",
+    "PoissonNLLLoss", "GaussianNLLLoss", "TripletMarginWithDistanceLoss",
+    "CTCLoss", "RNNTLoss", "HSigmoidLoss", "AdaptiveLogSoftmaxWithLoss",
+    "SpectralNorm", "RNNCellBase", "BiRNN", "BeamSearchDecoder",
+    "dynamic_decode",
+]
+
+
+# ---------------------------------------------------------------------------
+# simple wrappers
+# ---------------------------------------------------------------------------
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p, self.epsilon, self.keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        return FX.pairwise_distance(x, y, self.p, self.epsilon, self.keepdim)
+
+
+class Softmax2D(Layer):
+    def forward(self, x):
+        return FX.softmax_2d(x)
+
+
+class Unflatten(Layer):
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis, self.shape_ = axis, shape
+
+    def forward(self, x):
+        from ...tensor import unflatten
+
+        return unflatten(x, self.axis, self.shape_)
+
+
+class FeatureAlphaDropout(Layer):
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return FX.feature_alpha_dropout(x, self.p, self.training)
+
+
+class ZeroPad1D(Layer):
+    def __init__(self, padding, data_format="NCL", name=None):
+        super().__init__()
+        self.padding = padding
+        self.data_format = data_format
+
+    def forward(self, x):
+        from ..functional import pad
+
+        return pad(x, self.padding, mode="constant", value=0.0,
+                   data_format=self.data_format)
+
+
+class ZeroPad3D(ZeroPad1D):
+    def __init__(self, padding, data_format="NCDHW", name=None):
+        super().__init__(padding, data_format, name)
+
+
+class LayerDict(Layer):
+    """Dict container (reference: nn/layer/container.py LayerDict)."""
+
+    def __init__(self, sublayers=None):
+        super().__init__()
+        if sublayers:
+            self.update(sublayers)
+
+    def __getitem__(self, key):
+        return self._sub_layers[key]
+
+    def __setitem__(self, key, layer):
+        self.add_sublayer(key, layer)
+
+    def __delitem__(self, key):
+        del self._sub_layers[key]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers)
+
+    def __contains__(self, key):
+        return key in self._sub_layers
+
+    def keys(self):
+        return self._sub_layers.keys()
+
+    def values(self):
+        return self._sub_layers.values()
+
+    def items(self):
+        return self._sub_layers.items()
+
+    def update(self, sublayers):
+        for k, v in (sublayers.items() if isinstance(sublayers, dict)
+                     else sublayers):
+            self[k] = v
+
+    def pop(self, key):
+        layer = self._sub_layers[key]
+        del self._sub_layers[key]
+        return layer
+
+
+# ---------------------------------------------------------------------------
+# pooling
+# ---------------------------------------------------------------------------
+
+class MaxUnPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, data_format="NCL",
+                 output_size=None, name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding)
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        k, s, p = self.args
+        return FX.max_unpool1d(x, indices, k, s, p, self.output_size)
+
+
+class MaxUnPool2D(MaxUnPool1D):
+    def forward(self, x, indices):
+        k, s, p = self.args
+        return FX.max_unpool2d(x, indices, k, s, p, self.output_size)
+
+
+class MaxUnPool3D(MaxUnPool1D):
+    def forward(self, x, indices):
+        k, s, p = self.args
+        return FX.max_unpool3d(x, indices, k, s, p, self.output_size)
+
+
+class LPPool1D(Layer):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCL", name=None):
+        super().__init__()
+        self.a = (norm_type, kernel_size, stride, padding, ceil_mode,
+                  data_format)
+
+    def forward(self, x):
+        from ..functional.pooling import lp_pool1d
+
+        n, k, s, p, c, d = self.a
+        return lp_pool1d(x, n, k, s, p, c, d)
+
+
+class LPPool2D(LPPool1D):
+    def forward(self, x):
+        from ..functional.pooling import lp_pool2d
+
+        n, k, s, p, c, d = self.a
+        return lp_pool2d(x, n, k, s, p, c, d)
+
+
+class FractionalMaxPool2D(Layer):
+    """Fractional max pooling (Graham 2014): pseudo-random pooling-region
+    boundaries targeting ``output_size`` (reference: nn/layer/pooling.py).
+    Boundaries are drawn per call from the framework RNG unless random_u
+    is fixed."""
+
+    _ndim = 2
+
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.random_u = random_u
+        self.return_mask = return_mask
+
+    def _boundaries(self, in_size, out_size):
+        if self.random_u is not None:
+            u = float(self.random_u)
+        else:
+            from ...framework.random import next_host_seed
+
+            u = (next_host_seed() % 10000) / 10000.0
+        alpha = in_size / out_size
+        # ceil(alpha * (i + u)) boundaries (Graham's pseudo-random sequence),
+        # clamped so every segment is non-empty: b[i] in [i, in - (out - i)]
+        b = [0]
+        for i in range(1, out_size):
+            v = int(math.ceil(alpha * (i + u)))
+            b.append(min(max(v, i), in_size - (out_size - i)))
+        b.append(in_size)
+        return b
+
+    def forward(self, x):
+        from ...core.op_registry import apply_fn
+
+        n = self._ndim
+        out_size = (self.output_size if isinstance(self.output_size,
+                                                   (tuple, list))
+                    else (self.output_size,) * n)
+        arr_shape = tuple(x.shape)
+        bounds = [self._boundaries(arr_shape[2 + d], out_size[d])
+                  for d in range(n)]
+
+        def fn(a):
+            out = a
+            for d in range(n):
+                segs = []
+                for i in range(len(bounds[d]) - 1):
+                    lo, hi = bounds[d][i], bounds[d][i + 1]
+                    sl = [slice(None)] * out.ndim
+                    sl[2 + d] = slice(lo, hi)
+                    segs.append(jnp.max(out[tuple(sl)], axis=2 + d,
+                                        keepdims=True))
+                out = jnp.concatenate(segs, axis=2 + d)
+            return out
+
+        return apply_fn("fractional_max_pool", fn, x)
+
+
+class FractionalMaxPool3D(FractionalMaxPool2D):
+    _ndim = 3
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+
+class _LossBase(Layer):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self.reduction = reduction
+
+
+class SoftMarginLoss(_LossBase):
+    def forward(self, input, label):
+        return FX.soft_margin_loss(input, label, self.reduction)
+
+
+class MultiLabelSoftMarginLoss(_LossBase):
+    def __init__(self, weight=None, reduction="mean", name=None):
+        super().__init__(reduction)
+        self.weight = weight
+
+    def forward(self, input, label):
+        return FX.multi_label_soft_margin_loss(input, label, self.weight,
+                                               self.reduction)
+
+
+class MultiMarginLoss(_LossBase):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__(reduction)
+        self.p, self.margin, self.weight = p, margin, weight
+
+    def forward(self, input, label):
+        return FX.multi_margin_loss(input, label, self.p, self.margin,
+                                    self.weight, self.reduction)
+
+
+class PoissonNLLLoss(_LossBase):
+    def __init__(self, log_input=True, full=False, epsilon=1e-8,
+                 reduction="mean", name=None):
+        super().__init__(reduction)
+        self.log_input, self.full, self.epsilon = log_input, full, epsilon
+
+    def forward(self, input, label):
+        return FX.poisson_nll_loss(input, label, self.log_input, self.full,
+                                   self.epsilon, self.reduction)
+
+
+class GaussianNLLLoss(_LossBase):
+    def __init__(self, full=False, epsilon=1e-6, reduction="mean", name=None):
+        super().__init__(reduction)
+        self.full, self.epsilon = full, epsilon
+
+    def forward(self, input, label, variance):
+        return FX.gaussian_nll_loss(input, label, variance, self.full,
+                                    self.epsilon, self.reduction)
+
+
+class TripletMarginWithDistanceLoss(_LossBase):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__(reduction)
+        self.distance_function = distance_function
+        self.margin, self.swap = margin, swap
+
+    def forward(self, input, positive, negative):
+        return FX.triplet_margin_with_distance_loss(
+            input, positive, negative, self.distance_function, self.margin,
+            self.swap, self.reduction)
+
+
+class CTCLoss(Layer):
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self.blank, self.reduction = blank, reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths,
+                norm_by_times=False):
+        return FX.ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                           self.blank, self.reduction, norm_by_times)
+
+
+class RNNTLoss(Layer):
+    def __init__(self, blank=0, fastemit_lambda=0.0, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.blank, self.reduction = blank, reduction
+        self.fastemit_lambda = fastemit_lambda
+
+    def forward(self, logits, labels, input_lengths, label_lengths):
+        return FX.rnnt_loss(logits, labels, input_lengths, label_lengths,
+                            self.blank, self.fastemit_lambda, self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False, name=None):
+        super().__init__()
+        self.num_classes = num_classes
+        self.weight = self.create_parameter(
+            [num_classes - 1, feature_size],
+            default_initializer=I.Normal(std=1.0 / math.sqrt(feature_size)))
+        self.bias = (self.create_parameter([num_classes - 1],
+                                           default_initializer=I.Constant(0.0))
+                     if bias_attr is not False else None)
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return FX.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                                self.bias, path_table, path_code)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """Cluster-factored softmax for huge vocabularies
+    (reference: nn/layer/loss.py AdaptiveLogSoftmaxWithLoss)."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, name=None):
+        super().__init__()
+        self.cutoffs = list(cutoffs) + [n_classes]
+        self.n_clusters = len(self.cutoffs) - 1
+        self.head_size = self.cutoffs[0] + self.n_clusters
+        self.in_features = in_features
+        self.n_classes = n_classes
+        init = I.XavierUniform()
+        self.head_weight = self.create_parameter(
+            [in_features, self.head_size], default_initializer=init)
+        self.head_bias = (self.create_parameter(
+            [self.head_size], default_initializer=I.Constant(0.0))
+            if head_bias else None)
+        self.tail_weights = []
+        for i in range(self.n_clusters):
+            hsz = max(1, int(in_features // (div_value ** (i + 1))))
+            osz = self.cutoffs[i + 1] - self.cutoffs[i]
+            w1 = self.create_parameter([in_features, hsz],
+                                       default_initializer=init)
+            w2 = self.create_parameter([hsz, osz], default_initializer=init)
+            self.tail_weights.append((w1, w2))
+            setattr(self, f"tail_{i}_proj", w1)
+            setattr(self, f"tail_{i}_out", w2)
+
+    def _weights_flat(self):
+        ws = [self.head_weight]
+        if self.head_bias is not None:
+            ws.append(self.head_bias)
+        for w1, w2 in self.tail_weights:
+            ws.extend([w1, w2])
+        return ws
+
+    def _split_weights(self, ws):
+        it = iter(ws)
+        head_w = next(it)
+        head_b = next(it) if self.head_bias is not None else None
+        tails = [(next(it), next(it)) for _ in range(self.n_clusters)]
+        return head_w, head_b, tails
+
+    def _head_logp(self, x, head_w, head_b):
+        logits = jnp.matmul(x, head_w)
+        if head_b is not None:
+            logits = logits + head_b
+        return jax.nn.log_softmax(logits, -1)
+
+    def forward(self, input, label):
+        from ...core.op_registry import apply_fn
+
+        shortlist = self.cutoffs[0]
+
+        def fn(x, y, *ws):
+            x = x.astype(jnp.float32)
+            head_w, head_b, tails = self._split_weights(ws)
+            head_logp = self._head_logp(x, head_w, head_b)
+            safe_y = jnp.clip(y, 0, shortlist - 1)
+            lp = jnp.take_along_axis(head_logp, safe_y[:, None], 1)[:, 0]
+            out = jnp.where(y < shortlist, lp, 0.0)
+            for i in range(self.n_clusters):
+                lo, hi = self.cutoffs[i], self.cutoffs[i + 1]
+                in_cluster = (y >= lo) & (y < hi)
+                w1, w2 = tails[i]
+                tail_logp = jax.nn.log_softmax(
+                    jnp.matmul(jnp.matmul(x, w1), w2), -1)
+                rel = jnp.clip(y - lo, 0, hi - lo - 1)
+                lp_tail = (head_logp[:, shortlist + i]
+                           + jnp.take_along_axis(tail_logp, rel[:, None], 1)[:, 0])
+                out = jnp.where(in_cluster, lp_tail, out)
+            return out, -jnp.mean(out)
+
+        return apply_fn("adaptive_log_softmax_with_loss", fn, input, label,
+                        *self._weights_flat())
+
+    def log_prob(self, input):
+        from ...core.op_registry import apply_fn
+
+        def fn(x, *ws):
+            x = x.astype(jnp.float32)
+            head_w, head_b, tails = self._split_weights(ws)
+            head_logp = self._head_logp(x, head_w, head_b)
+            parts = [head_logp[:, : self.cutoffs[0]]]
+            for i in range(self.n_clusters):
+                w1, w2 = tails[i]
+                tail_logp = jax.nn.log_softmax(
+                    jnp.matmul(jnp.matmul(x, w1), w2), -1)
+                parts.append(
+                    head_logp[:, self.cutoffs[0] + i: self.cutoffs[0] + i + 1]
+                    + tail_logp)
+            return jnp.concatenate(parts, -1)
+
+        return apply_fn("adaptive_log_softmax_log_prob", fn, input,
+                        *self._weights_flat())
+
+    def predict(self, input):
+        return Tensor(jnp.argmax(unwrap(self.log_prob(input)), -1))
+
+
+# ---------------------------------------------------------------------------
+# SpectralNorm
+# ---------------------------------------------------------------------------
+
+class SpectralNorm(Layer):
+    """Power-iteration spectral normalization of a weight tensor
+    (reference: nn/layer/norm.py SpectralNorm — forward(weight) returns
+    weight / sigma_max)."""
+
+    def __init__(self, weight_shape, axis=0, power_iters=1, epsilon=1e-12,
+                 name=None, dtype="float32"):
+        super().__init__()
+        self.axis = axis
+        self.power_iters = power_iters
+        self.epsilon = epsilon
+        h = int(weight_shape[axis])
+        w = int(np.prod(weight_shape)) // h
+        self.register_buffer(
+            "weight_u", Tensor(jax.random.normal(jax.random.key(0), (h,))))
+        self.register_buffer(
+            "weight_v", Tensor(jax.random.normal(jax.random.key(1), (w,))))
+
+    def forward(self, weight):
+        from ...core.op_registry import apply_fn
+
+        axis, iters, eps = self.axis, self.power_iters, self.epsilon
+
+        def fn(wt, u, v):
+            mat = jnp.moveaxis(wt, axis, 0).reshape(wt.shape[axis], -1)
+
+            def norm(a):
+                return a / (jnp.linalg.norm(a) + eps)
+
+            for _ in range(max(iters, 1)):
+                v = norm(mat.T @ u)
+                u = norm(mat @ v)
+            sigma = u @ mat @ v
+            return wt / sigma, jax.lax.stop_gradient(u), jax.lax.stop_gradient(v)
+
+        out, u_new, v_new = apply_fn("spectral_norm", fn, weight,
+                                     self.weight_u, self.weight_v)
+        if not isinstance(u_new._data, jax.core.Tracer):
+            # persist power-iteration state so the estimate converges across
+            # forwards (reference updates the u buffer each call)
+            self.weight_u._data = u_new._data
+            self.weight_v._data = v_new._data
+        return out
+
+
+# ---------------------------------------------------------------------------
+# RNN extras
+# ---------------------------------------------------------------------------
+
+class RNNCellBase(Layer):
+    """Base for user RNN cells (reference: nn/layer/rnn.py RNNCellBase)."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype="float32",
+                           init_value=0.0, batch_dim_idx=0):
+        b = unwrap(batch_ref).shape[batch_dim_idx]
+        hidden = shape or [self.state_shape]
+        if isinstance(hidden, int):
+            hidden = [hidden]
+        mk = lambda h: Tensor(jnp.full((b, int(h)), init_value, jnp.float32))
+        if len(hidden) == 1:
+            return mk(hidden[0])
+        return tuple(mk(h) for h in hidden)
+
+
+class BiRNN(Layer):
+    """Bidirectional wrapper over two cells (reference: nn/layer/rnn.py BiRNN)."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        from .rnn import RNN
+
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        st_fw, st_bw = (initial_states if initial_states is not None
+                        else (None, None))
+        out_fw, s_fw = self.rnn_fw(inputs, st_fw, sequence_length)
+        out_bw, s_bw = self.rnn_bw(inputs, st_bw, sequence_length)
+        from ...tensor import concat
+
+        return concat([out_fw, out_bw], axis=-1), (s_fw, s_bw)
+
+
+class BeamSearchDecoder:
+    """Beam search over a cell + output layer (reference: nn/layer/rnn.py
+    BeamSearchDecoder). Works with dynamic_decode."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    def initialize(self, initial_cell_states):
+        b = unwrap(initial_cell_states[0] if isinstance(
+            initial_cell_states, (tuple, list)) else initial_cell_states).shape[0]
+        k = self.beam_size
+        tokens = jnp.full((b, k), self.start_token, jnp.int32)
+        log_probs = jnp.tile(jnp.asarray([[0.0] + [-1e9] * (k - 1)]), (b, 1))
+        finished = jnp.zeros((b, k), bool)
+
+        def tile(s):
+            a = unwrap(s)
+            return Tensor(jnp.repeat(a, k, axis=0))  # [b*k, ...]
+
+        states = jax.tree_util.tree_map(
+            tile, initial_cell_states,
+            is_leaf=lambda x: isinstance(x, Tensor))
+        return (tokens, log_probs, finished), states
+
+    def step(self, time, inputs, states):
+        b, k = inputs[0].shape[0], self.beam_size
+        tokens, log_probs, finished = inputs
+        flat_tok = Tensor(tokens.reshape(-1))
+        emb = (self.embedding_fn(flat_tok) if self.embedding_fn
+               else flat_tok)
+        out, new_states = self.cell(emb, states)
+        logits = self.output_fn(out) if self.output_fn else out
+        logp = jax.nn.log_softmax(unwrap(logits).astype(jnp.float32), -1)
+        V = logp.shape[-1]
+        logp = logp.reshape(b, k, V)
+        # finished beams only extend with end_token at zero cost
+        pad = jnp.full((V,), -1e9).at[self.end_token].set(0.0)
+        logp = jnp.where(finished[..., None], pad[None, None], logp)
+        total = log_probs[..., None] + logp  # [b, k, V]
+        flat = total.reshape(b, k * V)
+        top_lp, top_idx = jax.lax.top_k(flat, k)
+        beam_idx = top_idx // V
+        tok = (top_idx % V).astype(jnp.int32)
+        fin = jnp.take_along_axis(finished, beam_idx, 1) | (tok == self.end_token)
+
+        def pick(s):
+            a = unwrap(s).reshape((b, k) + unwrap(s).shape[1:])
+            sel = jnp.take_along_axis(
+                a, beam_idx.reshape((b, k) + (1,) * (a.ndim - 2)), 1)
+            return Tensor(sel.reshape((b * k,) + a.shape[2:]))
+
+        new_states = jax.tree_util.tree_map(
+            pick, new_states, is_leaf=lambda x: isinstance(x, Tensor))
+        return (tok, top_lp, fin), new_states, tok, fin
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=100, output_time_major=False,
+                   **kwargs):
+    """Run a decoder to completion (reference: nn/layer/rnn.py dynamic_decode).
+    Returns (token ids [B, beam, T], final log probs)."""
+    inputs, states = decoder.initialize(inits)
+    outs = []
+    for t in range(int(max_step_num)):
+        inputs, states, tok, fin = decoder.step(t, inputs, states)
+        outs.append(tok)
+        if bool(jnp.all(fin)):
+            break
+    ids = jnp.stack(outs, -1)  # [b, beam, T]
+    if output_time_major:
+        ids = jnp.moveaxis(ids, -1, 0)
+    return Tensor(ids), Tensor(inputs[1])

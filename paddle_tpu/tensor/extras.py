@@ -290,13 +290,16 @@ def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True, name=N
         k = min(m, n)
         l = jnp.tril(lu[..., :, :k], -1) + jnp.eye(m, k, dtype=lu.dtype)
         u = jnp.triu(lu[..., :k, :])
-        # pivots (1-based sequential swaps) -> permutation matrix
-        perm = jnp.arange(m)
+        # pivots (1-based sequential swaps) -> permutation, batched
+        batch = piv.shape[:-1]
+        perm = jnp.broadcast_to(jnp.arange(m), batch + (m,))
         for i in range(piv.shape[-1]):
-            j = piv[..., i] - 1
-            pi, pj = perm[i], perm[j]
-            perm = perm.at[i].set(pj).at[j].set(pi)
-        p = jnp.eye(m, dtype=lu.dtype)[perm].T
+            j = (piv[..., i] - 1)[..., None].astype(jnp.int32)
+            pi = perm[..., i: i + 1]
+            pj = jnp.take_along_axis(perm, j, -1)
+            perm = jnp.put_along_axis(perm, j, pi, -1, inplace=False)
+            perm = perm.at[..., i].set(pj[..., 0])
+        p = jnp.swapaxes(jnp.eye(m, dtype=lu.dtype)[perm], -1, -2)
         return p, l, u
 
     return apply_fn("lu_unpack", fn, lu_data, lu_pivots)

@@ -1,0 +1,310 @@
+"""Tests for the nn breadth-completion layers (reference: test/legacy_test
+loss/pooling op tests — numpy/torch-referenced semantics)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+
+T = paddle.to_tensor
+
+
+class TestUnpool:
+    def test_max_pool_mask_and_unpool2d_roundtrip(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((2, 3, 8, 8)).astype(np.float32)
+        out, mask = F.max_pool2d(T(x), 2, 2, return_mask=True)
+        assert out.shape == [2, 3, 4, 4] and mask.shape == [2, 3, 4, 4]
+        # mask indexes the flattened 8x8 spatial plane
+        flat = x.reshape(2, 3, 64)
+        picked = np.take_along_axis(flat, mask.numpy().reshape(2, 3, -1), -1)
+        np.testing.assert_allclose(picked.reshape(out.shape), out.numpy())
+
+        rec = nn.MaxUnPool2D(2, 2)(out, mask)
+        assert rec.shape == [2, 3, 8, 8]
+        # unpooled holds max values at argmax positions, zero elsewhere
+        np.testing.assert_allclose(rec.numpy().sum(), out.numpy().sum(), rtol=1e-6)
+        nz = rec.numpy() != 0
+        assert nz.sum() <= 2 * 3 * 16
+
+    def test_unpool1d(self):
+        x = np.array([[[1.0, 3.0, 2.0, 4.0]]], np.float32)
+        out, mask = F.max_pool1d(T(x), 2, 2, return_mask=True)
+        rec = F.max_unpool1d(out, mask, 2, 2)
+        np.testing.assert_allclose(rec.numpy(),
+                                   [[[0.0, 3.0, 0.0, 4.0]]])
+
+
+class TestPoolingExtras:
+    def test_lp_pool_layers(self):
+        x = np.abs(np.random.rand(1, 2, 8, 8)).astype(np.float32)
+        out = nn.LPPool2D(2.0, 2, 2)(T(x))
+        assert out.shape == [1, 2, 4, 4]
+        # p=inf-free check: lp with p=1 * kernel = sum pooling
+        out1 = nn.LPPool1D(1.0, 2, 2)(T(x[:, :, 0]))
+        ref = x[:, :, 0].reshape(1, 2, 4, 2).sum(-1)
+        np.testing.assert_allclose(out1.numpy(), ref, rtol=1e-5)
+
+    def test_fractional_max_pool(self):
+        x = np.random.rand(1, 2, 9, 9).astype(np.float32)
+        out = nn.FractionalMaxPool2D(output_size=4, random_u=0.3)(T(x))
+        assert out.shape == [1, 2, 4, 4]
+        assert (out.numpy() <= x.max()).all() and out.numpy().max() == x.max()
+
+
+class TestReviewRegressions:
+    def test_max_pool_mask_negative_input_with_padding(self):
+        x = -np.ones((1, 1, 4, 4), np.float32)
+        out, mask = F.max_pool2d(T(x), 2, 2, padding=1, return_mask=True)
+        assert (out.numpy() == -1.0).all()  # zero-padding must not win
+
+    def test_fractional_pool_all_u_values(self):
+        x = np.random.rand(1, 1, 4, 4).astype(np.float32)
+        for u in (0.1, 0.5, 0.9):
+            out = nn.FractionalMaxPool2D(output_size=2, random_u=u)(T(x))
+            assert out.shape == [1, 1, 2, 2]
+
+    def test_adaptive_log_softmax_grads_flow(self):
+        m = nn.AdaptiveLogSoftmaxWithLoss(8, 12, cutoffs=[4])
+        x = T(np.random.rand(4, 8).astype(np.float32))
+        y = T(np.array([1, 3, 6, 11]))
+        out, loss = m(x, y)
+        loss.backward()
+        assert m.head_weight.grad is not None
+        assert np.abs(m.head_weight.grad.numpy()).sum() > 0
+
+    def test_multi_margin_weight_applied(self):
+        x = np.array([[0.1, 0.9, 0.2]], np.float32)
+        y = np.array([1])
+        w = np.array([1.0, 10.0, 1.0], np.float32)
+        l0 = float(nn.MultiMarginLoss()(T(x), T(y)))
+        lw = float(nn.MultiMarginLoss(weight=T(w))(T(x), T(y)))
+        np.testing.assert_allclose(lw, 10 * l0, rtol=1e-5)
+
+    def test_hsigmoid_custom_paths(self):
+        paddle.seed(0)
+        layer = nn.HSigmoidLoss(8, 4)
+        x = T(np.random.rand(2, 8).astype(np.float32))
+        y = T(np.array([0, 3]))
+        default = float(layer(x, y))
+        # custom path table differing from the default tree changes the loss
+        # (default for 4 classes: codes [0,0],[0,1],[1,0],[1,1] — flip them)
+        pt = np.array([[0, 1], [0, 1], [0, 2], [0, 2]], np.int32)
+        pc = np.array([[1, 1], [1, 0], [0, 1], [0, 0]], np.float32)
+        custom = float(layer(x, y, path_table=T(pt), path_code=T(pc)))
+        assert abs(default - custom) > 1e-6
+
+    def test_spectral_norm_converges_with_single_iter(self):
+        w = np.random.rand(4, 6).astype(np.float32) * 3
+        sn = nn.SpectralNorm([4, 6], power_iters=1)
+        for _ in range(30):  # u persists across calls -> converges
+            wn = sn(T(w))
+        np.testing.assert_allclose(
+            np.linalg.svd(wn.numpy(), compute_uv=False)[0], 1.0, rtol=1e-3)
+
+    def test_lu_unpack_batched(self):
+        import scipy.linalg as sla
+
+        rng = np.random.default_rng(0)
+        a = rng.standard_normal((2, 3, 3)).astype(np.float32)
+        lus, pivs = [], []
+        for b in range(2):
+            lu, piv = sla.lu_factor(a[b])
+            lus.append(lu)
+            pivs.append(piv + 1)  # LAPACK 1-based
+        p, l, u = paddle.lu_unpack(T(np.stack(lus)),
+                                   T(np.stack(pivs).astype(np.int32)))
+        for b in range(2):
+            rec = p.numpy()[b] @ l.numpy()[b] @ u.numpy()[b]
+            np.testing.assert_allclose(rec, a[b], atol=1e-4)
+
+    def test_rnnt_fastemit_changes_loss(self):
+        rng = np.random.default_rng(1)
+        logits = rng.standard_normal((1, 3, 2, 4)).astype(np.float32)
+        lab = np.array([[1]], np.int32)
+        l0 = float(F.rnnt_loss(T(logits), T(lab), T(np.array([3])),
+                               T(np.array([1]))))
+        l1 = float(F.rnnt_loss(T(logits), T(lab), T(np.array([3])),
+                               T(np.array([1])), fastemit_lambda=0.5))
+        assert abs(l0 - l1) > 1e-6
+
+
+class TestLossExtras:
+    def test_soft_margin(self):
+        x = np.array([0.5, -1.0], np.float32)
+        y = np.array([1.0, -1.0], np.float32)
+        loss = nn.SoftMarginLoss()(T(x), T(y))
+        ref = np.mean(np.log1p(np.exp(-y * x)))
+        np.testing.assert_allclose(float(loss), ref, rtol=1e-5)
+
+    def test_multi_margin(self):
+        x = np.array([[0.1, 0.9, 0.2]], np.float32)
+        y = np.array([1])
+        loss = nn.MultiMarginLoss()(T(x), T(y))
+        ref = (max(0, 1 - 0.9 + 0.1) + max(0, 1 - 0.9 + 0.2)) / 3
+        np.testing.assert_allclose(float(loss), ref, rtol=1e-5)
+
+    def test_poisson_gaussian_nll(self):
+        x = np.array([0.5, 1.0], np.float32)
+        y = np.array([1.0, 2.0], np.float32)
+        l1 = nn.PoissonNLLLoss()(T(x), T(y))
+        np.testing.assert_allclose(float(l1),
+                                   np.mean(np.exp(x) - y * x), rtol=1e-5)
+        var = np.array([1.0, 4.0], np.float32)
+        l2 = nn.GaussianNLLLoss()(T(x), T(y), T(var))
+        ref = np.mean(0.5 * (np.log(var) + (y - x) ** 2 / var))
+        np.testing.assert_allclose(float(l2), ref, rtol=1e-5)
+
+    def test_multilabel_and_triplet(self):
+        x = np.array([[0.2, -0.5]], np.float32)
+        y = np.array([[1.0, 0.0]], np.float32)
+        l = nn.MultiLabelSoftMarginLoss()(T(x), T(y))
+        sig = 1 / (1 + np.exp(-x))
+        ref = np.mean(-(y * np.log(sig) + (1 - y) * np.log(1 - sig)))
+        np.testing.assert_allclose(float(l), ref, rtol=1e-4)
+
+        a = np.zeros((2, 3), np.float32)
+        p = np.ones((2, 3), np.float32) * 0.1
+        n = np.ones((2, 3), np.float32)
+        lt = nn.TripletMarginWithDistanceLoss(margin=1.0)(T(a), T(p), T(n))
+        dp, dn = np.sqrt(3 * 0.01), np.sqrt(3.0)
+        np.testing.assert_allclose(float(lt), max(0, dp - dn + 1), rtol=1e-3)
+
+    def test_ctc_loss_simple(self):
+        # single-label case with T=2: closed-form check
+        Tt, B, C, S = 2, 1, 3, 1
+        logits = np.log(np.array(
+            [[[0.6, 0.3, 0.1]], [[0.5, 0.2, 0.3]]], np.float32))  # [T,B,C]
+        labels = np.array([[1]], np.int32)
+        nll = F.ctc_loss(T(logits), T(labels), T(np.array([2])),
+                         T(np.array([1])), reduction="none")
+        # paths for label [1]: (b,1)=.6*.2, (1,1)=.3*.2, (1,b)=.3*.5
+        pr = 0.6 * 0.2 + 0.3 * 0.2 + 0.3 * 0.5
+        np.testing.assert_allclose(float(nll.numpy()[0]), -np.log(pr), rtol=1e-4)
+
+    def test_ctc_loss_trains(self):
+        rng = np.random.default_rng(0)
+        logits = paddle.to_tensor(
+            rng.standard_normal((8, 2, 5)).astype(np.float32),
+            stop_gradient=False)
+        labels = np.array([[1, 2, 3], [2, 2, 0]], np.int32)
+        loss = F.ctc_loss(logits, T(labels), T(np.array([8, 8])),
+                          T(np.array([3, 2])))
+        loss.backward()
+        assert logits.grad is not None
+        assert np.isfinite(logits.grad.numpy()).all()
+
+    def test_rnnt_loss_runs_and_grads(self):
+        rng = np.random.default_rng(1)
+        logits = paddle.to_tensor(
+            rng.standard_normal((2, 4, 3, 5)).astype(np.float32),
+            stop_gradient=False)
+        labels = np.array([[1, 2], [3, 0]], np.int32)
+        loss = F.rnnt_loss(logits, T(labels), T(np.array([4, 4])),
+                           T(np.array([2, 1])))
+        assert np.isfinite(float(loss))
+        loss.backward()
+        assert np.isfinite(logits.grad.numpy()).all()
+
+    def test_hsigmoid_loss(self):
+        paddle.seed(0)
+        layer = nn.HSigmoidLoss(8, 6)
+        x = T(np.random.rand(4, 8).astype(np.float32))
+        y = T(np.array([0, 2, 4, 5]))
+        loss = layer(x, y)
+        assert np.isfinite(float(loss)) and float(loss) > 0
+
+    def test_adaptive_log_softmax(self):
+        paddle.seed(0)
+        m = nn.AdaptiveLogSoftmaxWithLoss(16, 100, cutoffs=[10, 40])
+        x = T(np.random.rand(6, 16).astype(np.float32))
+        y = T(np.array([1, 5, 15, 35, 60, 99]))
+        out, loss = m(x, y)
+        assert np.isfinite(float(loss))
+        lp = m.log_prob(x)
+        assert lp.shape == [6, 100]
+        # log_prob normalizes
+        np.testing.assert_allclose(np.exp(lp.numpy()).sum(-1), 1.0, rtol=1e-3)
+        # out matches gathering log_prob at labels
+        np.testing.assert_allclose(
+            out.numpy(),
+            np.take_along_axis(lp.numpy(), y.numpy()[:, None], 1)[:, 0],
+            rtol=1e-4)
+
+
+class TestMiscLayers:
+    def test_pairwise_distance_softmax2d_unflatten(self):
+        x = np.random.rand(3, 4).astype(np.float32)
+        y = np.random.rand(3, 4).astype(np.float32)
+        d = nn.PairwiseDistance()(T(x), T(y))
+        np.testing.assert_allclose(d.numpy(),
+                                   np.linalg.norm(x - y + 1e-6, axis=-1),
+                                   rtol=1e-4)
+        s = nn.Softmax2D()(T(np.random.rand(1, 3, 2, 2).astype(np.float32)))
+        np.testing.assert_allclose(s.numpy().sum(1), 1.0, rtol=1e-5)
+        u = nn.Unflatten(1, [2, 2])(T(np.zeros((3, 4), np.float32)))
+        assert u.shape == [3, 2, 2]
+
+    def test_zeropad(self):
+        x = np.ones((1, 2, 4), np.float32)
+        out = nn.ZeroPad1D([1, 2])(T(x))
+        assert out.shape == [1, 2, 7]
+        assert out.numpy()[0, 0, 0] == 0 and out.numpy()[0, 0, -1] == 0
+
+    def test_layer_dict(self):
+        d = nn.LayerDict({"a": nn.Linear(2, 3), "b": nn.ReLU()})
+        assert len(d) == 2 and "a" in d
+        assert isinstance(d["a"], nn.Linear)
+        params = list(d.parameters())
+        assert len(params) == 2  # linear weight+bias
+        d.pop("a")
+        assert len(d) == 1
+
+    def test_spectral_norm(self):
+        w = np.random.rand(4, 6).astype(np.float32) * 3
+        sn = nn.SpectralNorm([4, 6], power_iters=20)
+        wn = sn(T(w))
+        sigma = np.linalg.svd(w, compute_uv=False)[0]
+        np.testing.assert_allclose(
+            np.linalg.svd(wn.numpy(), compute_uv=False)[0], 1.0, rtol=1e-3)
+
+    def test_feature_alpha_dropout(self):
+        x = np.ones((2, 8, 4), np.float32)
+        layer = nn.FeatureAlphaDropout(0.5)
+        out = layer(T(x)).numpy()
+        # whole channels share a mask
+        per_channel = out.reshape(2, 8, 4)
+        for b in range(2):
+            for c in range(8):
+                assert len(np.unique(per_channel[b, c].round(5))) == 1
+        layer.eval()
+        np.testing.assert_allclose(layer(T(x)).numpy(), x)
+
+
+class TestRNNExtras:
+    def test_birnn(self):
+        paddle.seed(0)
+        cell_fw = nn.SimpleRNNCell(4, 8)
+        cell_bw = nn.SimpleRNNCell(4, 8)
+        rnn = nn.BiRNN(cell_fw, cell_bw)
+        x = T(np.random.rand(2, 5, 4).astype(np.float32))
+        out, (sf, sb) = rnn(x)
+        assert out.shape == [2, 5, 16]
+
+    def test_beam_search_decode(self):
+        paddle.seed(0)
+        vocab, hidden = 7, 8
+        cell = nn.GRUCell(hidden, hidden)
+        emb = nn.Embedding(vocab, hidden)
+        proj = nn.Linear(hidden, vocab)
+        dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=1,
+                                   beam_size=3, embedding_fn=emb,
+                                   output_fn=proj)
+        init = T(np.zeros((2, hidden), np.float32))
+        ids, lp = nn.dynamic_decode(dec, init, max_step_num=6)
+        assert ids.shape[0] == 2 and ids.shape[1] == 3
+        assert lp.shape == [2, 3]
+        # beams sorted by log prob
+        assert (np.diff(lp.numpy(), axis=1) <= 1e-5).all()
