@@ -1,1 +1,16 @@
-"""paddle_tpu.audio — audio feature suite (reference: python/paddle/audio). Round-1 stub."""
+"""paddle_tpu.audio (reference: python/paddle/audio — functional/, features/,
+datasets/). Real DSP over the framework stft/fft path."""
+
+from . import datasets, features, functional  # noqa: F401
+from .functional import (  # noqa: F401
+    compute_fbank_matrix,
+    create_dct,
+    fft_frequencies,
+    get_window,
+    hz_to_mel,
+    mel_frequencies,
+    mel_to_hz,
+    power_to_db,
+)
+
+__all__ = ["functional", "features", "datasets"]

@@ -1,0 +1,158 @@
+"""Audio functional ops (reference: python/paddle/audio/functional/
+functional.py + window.py — librosa-compatible mel/fbank/dct/window math)."""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, unwrap
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+           "compute_fbank_matrix", "power_to_db", "create_dct", "get_window"]
+
+
+def hz_to_mel(freq, htk: bool = False):
+    """Hz -> mel (reference: functional.py:29; slaney scale by default)."""
+    scalar = not isinstance(freq, Tensor)
+    f = jnp.asarray(unwrap(freq), jnp.float32)
+    if htk:
+        out = 2595.0 * jnp.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mels = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = jnp.where(f >= min_log_hz,
+                        min_log_mel + jnp.log(jnp.maximum(f, 1e-10) / min_log_hz) / logstep,
+                        mels)
+    return float(out) if scalar and out.ndim == 0 else Tensor(out)
+
+
+def mel_to_hz(mel, htk: bool = False):
+    scalar = not isinstance(mel, Tensor)
+    m = jnp.asarray(unwrap(mel), jnp.float32)
+    if htk:
+        out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        freqs = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = jnp.where(m >= min_log_mel,
+                        min_log_hz * jnp.exp(logstep * (m - min_log_mel)),
+                        freqs)
+    return float(out) if scalar and out.ndim == 0 else Tensor(out)
+
+
+def mel_frequencies(n_mels: int = 64, f_min: float = 0.0, f_max: float = 11025.0,
+                    htk: bool = False, dtype: str = "float32"):
+    lo = hz_to_mel(f_min, htk)
+    hi = hz_to_mel(f_max, htk)
+    mels = jnp.linspace(lo, hi, n_mels)
+    return Tensor(unwrap(mel_to_hz(Tensor(mels), htk)).astype(dtype))
+
+
+def fft_frequencies(sr: int, n_fft: int, dtype: str = "float32"):
+    return Tensor(jnp.linspace(0, sr / 2, 1 + n_fft // 2).astype(dtype))
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0, f_max=None, htk: bool = False,
+                         norm="slaney", dtype: str = "float32"):
+    """Triangular mel filterbank [n_mels, 1 + n_fft//2]
+    (reference: functional.py:189; librosa.filters.mel math)."""
+    f_max = f_max or sr / 2.0
+    fft_f = unwrap(fft_frequencies(sr, n_fft))
+    mel_f = unwrap(mel_frequencies(n_mels + 2, f_min, f_max, htk))
+    fdiff = jnp.diff(mel_f)
+    ramps = mel_f[:, None] - fft_f[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = jnp.maximum(0, jnp.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2: n_mels + 2] - mel_f[:n_mels])
+        weights = weights * enorm[:, None]
+    return Tensor(weights.astype(dtype))
+
+
+def power_to_db(spect, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db: float = 80.0):
+    """Power spectrogram -> dB (reference: functional.py:262)."""
+    s = unwrap(spect)
+    log_spec = 10.0 * jnp.log10(jnp.maximum(amin, s))
+    log_spec = log_spec - 10.0 * math.log10(max(amin, ref_value))
+    if top_db is not None:
+        log_spec = jnp.maximum(log_spec, jnp.max(log_spec) - top_db)
+    return Tensor(log_spec)
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm="ortho", dtype: str = "float32"):
+    """DCT-II basis [n_mels, n_mfcc] (reference: functional.py:306)."""
+    n = jnp.arange(n_mels, dtype=jnp.float32)
+    k = jnp.arange(n_mfcc, dtype=jnp.float32)[None, :]
+    dct = jnp.cos(math.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm == "ortho":
+        dct = dct * math.sqrt(2.0 / n_mels)
+        dct = dct.at[:, 0].multiply(1.0 / math.sqrt(2.0))
+    else:
+        dct = dct * 2.0
+    return Tensor(dct.astype(dtype))
+
+
+_WINDOWS = {
+    "hann": lambda n: 0.5 - 0.5 * jnp.cos(2 * math.pi * jnp.arange(n) / n),
+    "hamming": lambda n: 0.54 - 0.46 * jnp.cos(2 * math.pi * jnp.arange(n) / n),
+    "blackman": lambda n: (0.42 - 0.5 * jnp.cos(2 * math.pi * jnp.arange(n) / n)
+                           + 0.08 * jnp.cos(4 * math.pi * jnp.arange(n) / n)),
+    "bohman": lambda n: _bohman(n),
+    "triang": lambda n: 1 - jnp.abs(2 * jnp.arange(n) - (n - 1)) / n,
+    "bartlett": lambda n: 1 - jnp.abs(2 * jnp.arange(n) - (n - 1)) / (n - 1),
+    "rect": lambda n: jnp.ones(n),
+    "cosine": lambda n: jnp.sin(math.pi / n * (jnp.arange(n) + 0.5)),
+}
+
+
+def _bohman(n):
+    x = jnp.abs(jnp.linspace(-1, 1, n + 2)[1:-1])
+    return (1 - x) * jnp.cos(math.pi * x) + jnp.sin(math.pi * x) / math.pi
+
+
+def get_window(window, win_length: int, fftbins: bool = True,
+               dtype: str = "float32"):
+    """Window function by name (reference: window.py get_window).
+    ``('kaiser', beta)`` / ``('gaussian', std)`` / ``('exponential', None, tau)``
+    tuples supported like scipy."""
+    if isinstance(window, (tuple, list)):
+        name, *params = window
+        if name == "kaiser":
+            # periodic (fftbins=True): sample the symmetric N+1 window's
+            # first N points; symmetric: plain np.kaiser(N)
+            w = jnp.asarray(np.kaiser(win_length + (1 if fftbins else 0),
+                                      params[0]))
+            w = w[:win_length]
+        elif name == "gaussian":
+            half = (win_length - 1) / 2
+            x = jnp.arange(win_length) - half
+            w = jnp.exp(-0.5 * (x / params[0]) ** 2)
+        elif name == "exponential":
+            tau = params[-1]
+            x = jnp.abs(jnp.arange(win_length) - (win_length - 1) / 2)
+            w = jnp.exp(-x / tau)
+        else:
+            raise ValueError(f"unknown window {name}")
+        return Tensor(w.astype(dtype))
+    if window not in _WINDOWS:
+        raise ValueError(f"unknown window {window}; options: {sorted(_WINDOWS)}")
+    if fftbins:
+        w = _WINDOWS[window](win_length)  # periodic: denominators use N
+    else:
+        # symmetric: the N-point symmetric window equals the first N points
+        # of the (N)-denominator... i.e. evaluate with n = N-1 denominators
+        w = _WINDOWS[window](win_length - 1)
+        w = jnp.concatenate([jnp.asarray(w), jnp.asarray(w)[:1]])
+    return Tensor(jnp.asarray(w, jnp.float32)[:win_length].astype(dtype))

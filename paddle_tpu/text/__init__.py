@@ -1,1 +1,172 @@
-"""paddle_tpu.text — text datasets (reference: python/paddle/text). Round-1 stub."""
+"""paddle_tpu.text (reference: python/paddle/text — viterbi_decode.py +
+datasets/). Datasets are synthesized deterministically (zero-egress), keeping
+the documented field shapes."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.op_registry import apply_fn
+from ..core.tensor import Tensor, unwrap
+from ..io.dataset import Dataset
+
+__all__ = ["viterbi_decode", "ViterbiDecoder", "Imdb", "Imikolov",
+           "UCIHousing", "Conll05st", "Movielens", "WMT14", "WMT16"]
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag: bool = True, name=None):
+    """CRF Viterbi decoding (reference: text/viterbi_decode.py:31).
+
+    potentials: [B, T, N] unary emissions; transition_params: [N, N];
+    lengths: [B]. Returns (scores [B], paths [B, T]).
+    """
+
+    def fn(emit, trans, lens):
+        B, T, N = emit.shape
+        if include_bos_eos_tag:
+            # last two tags are BOS/EOS (reference convention): start from BOS
+            alpha0 = emit[:, 0] + trans[N - 2][None]
+        else:
+            alpha0 = emit[:, 0]
+
+        def step(carry, t):
+            alpha = carry  # [B, N]
+            scores = alpha[:, :, None] + trans[None]  # [B, from, to]
+            best_prev = jnp.argmax(scores, axis=1)  # [B, N]
+            alpha_new = jnp.max(scores, axis=1) + emit[:, t]
+            keep = (t < lens)[:, None]
+            alpha_new = jnp.where(keep, alpha_new, alpha)
+            return alpha_new, best_prev
+
+        alpha, back = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+        if include_bos_eos_tag:
+            alpha = alpha + trans[:, N - 1][None]
+        last_tag = jnp.argmax(alpha, -1)  # [B]
+        score = jnp.max(alpha, -1)
+
+        # backtrack (reverse scan over the backpointers)
+        def bt(carry, t):
+            tag = carry
+            prev = back[t]  # [B, N] pointers for transition t -> t+1
+            new = jnp.take_along_axis(prev, tag[:, None], 1)[:, 0]
+            new = jnp.where(t + 1 < lens, new, tag)
+            return new, tag
+
+        tag_final, tags_rev = jax.lax.scan(bt, last_tag,
+                                           jnp.arange(T - 2, -1, -1))
+        path = jnp.concatenate([tag_final[None], tags_rev[::-1]], 0).T
+        # positions beyond each length keep the terminal tag; mask to 0
+        mask = jnp.arange(T)[None] < lens[:, None]
+        path = jnp.where(mask, path, 0)
+        return score, path.astype(jnp.int64)
+
+    return apply_fn("viterbi_decode", fn, potentials, transition_params,
+                    lengths)
+
+
+class ViterbiDecoder:
+    """Layer-style wrapper (reference: text/viterbi_decode.py ViterbiDecoder)."""
+
+    def __init__(self, transitions, include_bos_eos_tag: bool = True,
+                 name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+# ---------------------------------------------------------------------------
+# datasets (synthetic, deterministic)
+# ---------------------------------------------------------------------------
+
+class _SyntheticText(Dataset):
+    vocab_size = 1000
+
+    def __init__(self, mode: str = "train", size: int = 500, **kwargs):
+        self.mode = mode
+        self.size = size
+        self._rng_seed = 0 if mode == "train" else 1
+
+    def __len__(self):
+        return self.size
+
+
+class Imdb(_SyntheticText):
+    """Sentiment classification: (word_ids [T], label 0/1)."""
+
+    def __getitem__(self, idx):
+        rng = np.random.default_rng((self._rng_seed, idx))
+        label = int(rng.integers(0, 2))
+        length = int(rng.integers(20, 100))
+        # class-conditional token distribution so models can actually learn
+        lo, hi = (0, self.vocab_size // 2) if label == 0 else (
+            self.vocab_size // 2, self.vocab_size)
+        doc = rng.integers(lo, hi, length).astype(np.int64)
+        return doc, label
+
+
+class Imikolov(_SyntheticText):
+    """N-gram LM dataset: (context [N-1], next_word)."""
+
+    def __init__(self, mode="train", data_type="NGRAM", window_size=5, **kw):
+        super().__init__(mode, **kw)
+        self.window_size = window_size
+
+    def __getitem__(self, idx):
+        rng = np.random.default_rng((self._rng_seed, idx))
+        seq = rng.integers(0, self.vocab_size, self.window_size).astype(np.int64)
+        return tuple(seq[:-1]) + (seq[-1],)
+
+
+class UCIHousing(_SyntheticText):
+    """Regression: (features [13], price [1]) with a learnable linear map."""
+
+    _w = np.linspace(-1, 1, 13).astype(np.float32)
+
+    def __getitem__(self, idx):
+        rng = np.random.default_rng((self._rng_seed, idx))
+        x = rng.standard_normal(13).astype(np.float32)
+        y = np.array([x @ self._w + 0.1 * rng.standard_normal()], np.float32)
+        return x, y
+
+
+class Conll05st(_SyntheticText):
+    """SRL simplified to (words [T], labels [T])."""
+
+    n_labels = 20
+
+    def __getitem__(self, idx):
+        rng = np.random.default_rng((self._rng_seed, idx))
+        length = int(rng.integers(5, 30))
+        words = rng.integers(0, self.vocab_size, length).astype(np.int64)
+        labels = rng.integers(0, self.n_labels, length).astype(np.int64)
+        return words, labels
+
+
+class Movielens(_SyntheticText):
+    """Rating prediction: (user_id, movie_id, rating)."""
+
+    def __getitem__(self, idx):
+        rng = np.random.default_rng((self._rng_seed, idx))
+        return (int(rng.integers(0, 6000)), int(rng.integers(0, 4000)),
+                float(rng.integers(1, 6)))
+
+
+class WMT14(_SyntheticText):
+    """Translation: (src_ids [S], trg_ids [T], trg_next [T])."""
+
+    def __getitem__(self, idx):
+        rng = np.random.default_rng((self._rng_seed, idx))
+        s, t = int(rng.integers(5, 30)), int(rng.integers(5, 30))
+        src = rng.integers(0, self.vocab_size, s).astype(np.int64)
+        trg = rng.integers(0, self.vocab_size, t).astype(np.int64)
+        return src, trg, np.roll(trg, -1)
+
+
+class WMT16(WMT14):
+    pass

@@ -3,3 +3,19 @@
 from .lenet import LeNet  # noqa: F401
 from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101, resnet152  # noqa: F401
 from .vit import VisionTransformer, ViTConfig, vit_b_16, vit_l_16  # noqa: F401
+from .zoo import (  # noqa: F401
+    AlexNet,
+    MobileNetV1,
+    MobileNetV2,
+    SqueezeNet,
+    VGG,
+    alexnet,
+    mobilenet_v1,
+    mobilenet_v2,
+    squeezenet1_0,
+    squeezenet1_1,
+    vgg11,
+    vgg13,
+    vgg16,
+    vgg19,
+)

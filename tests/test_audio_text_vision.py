@@ -1,0 +1,131 @@
+"""audio / text / vision-zoo tests (reference: test suites for
+paddle.audio features + text viterbi + vision model zoo)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import audio, text
+from paddle_tpu.vision import models as V
+
+T = paddle.to_tensor
+
+
+class TestAudioFunctional:
+    def test_mel_roundtrip(self):
+        for htk in (False, True):
+            f = 440.0
+            m = audio.hz_to_mel(f, htk)
+            back = audio.mel_to_hz(m, htk)
+            np.testing.assert_allclose(back, f, rtol=1e-4)
+
+    def test_fbank_matrix_matches_librosa_shape(self):
+        fb = audio.compute_fbank_matrix(16000, 512, n_mels=40)
+        assert fb.shape == [40, 257]
+        # triangles are nonnegative and rows nonzero
+        assert (fb.numpy() >= 0).all()
+        assert (fb.numpy().sum(1) > 0).all()
+
+    def test_power_to_db(self):
+        s = np.array([1.0, 10.0, 100.0], np.float32)
+        db = audio.power_to_db(T(s), top_db=None)
+        np.testing.assert_allclose(db.numpy(), [0.0, 10.0, 20.0], atol=1e-4)
+
+    def test_dct_orthonormal(self):
+        d = audio.create_dct(8, 8).numpy()
+        np.testing.assert_allclose(d.T @ d, np.eye(8), atol=1e-5)
+
+    def test_windows(self):
+        for w in ("hann", "hamming", "blackman", "triang", "rect", "cosine"):
+            win = audio.get_window(w, 32)
+            assert win.shape == [32]
+        k = audio.get_window(("kaiser", 8.0), 32)
+        assert k.shape == [32]
+
+
+class TestAudioFeatures:
+    def test_spectrogram_shapes(self):
+        wave = T(np.random.rand(2, 1600).astype(np.float32))
+        spec = audio.features.Spectrogram(n_fft=256, hop_length=128)(wave)
+        assert spec.shape[0] == 2 and spec.shape[1] == 129
+        assert (spec.numpy() >= 0).all()
+
+    def test_mfcc_pipeline(self):
+        wave = T(np.random.rand(1600).astype(np.float32))
+        mfcc = audio.features.MFCC(sr=16000, n_mfcc=13, n_fft=256,
+                                   n_mels=40, f_min=0.0)(wave)
+        assert mfcc.shape[0] == 13
+        assert np.isfinite(mfcc.numpy()).all()
+
+    def test_datasets(self):
+        ds = audio.datasets.ESC50(size=4)
+        wave, label = ds[0]
+        assert wave.shape == (int(44100 * 5.0),)
+        assert 0 <= label < 50
+
+
+class TestTextViterbi:
+    def test_viterbi_matches_bruteforce(self):
+        rng = np.random.default_rng(0)
+        B, Tn, N = 2, 5, 4
+        emit = rng.standard_normal((B, Tn, N)).astype(np.float32)
+        trans = rng.standard_normal((N, N)).astype(np.float32)
+        lens = np.array([5, 5], np.int64)
+        score, path = text.viterbi_decode(T(emit), T(trans), T(lens),
+                                          include_bos_eos_tag=False)
+        # brute force
+        import itertools
+
+        for b in range(B):
+            best, best_p = -1e30, None
+            for p in itertools.product(range(N), repeat=Tn):
+                s = emit[b, 0, p[0]] + sum(
+                    trans[p[i - 1], p[i]] + emit[b, i, p[i]]
+                    for i in range(1, Tn))
+                if s > best:
+                    best, best_p = s, p
+            np.testing.assert_allclose(float(score.numpy()[b]), best, rtol=1e-4)
+            assert tuple(path.numpy()[b]) == best_p
+
+    def test_viterbi_respects_lengths(self):
+        rng = np.random.default_rng(1)
+        emit = rng.standard_normal((1, 6, 3)).astype(np.float32)
+        trans = rng.standard_normal((3, 3)).astype(np.float32)
+        s1, p1 = text.viterbi_decode(T(emit), T(trans),
+                                     T(np.array([4])), include_bos_eos_tag=False)
+        s2, p2 = text.viterbi_decode(T(emit[:, :4]), T(trans),
+                                     T(np.array([4])), include_bos_eos_tag=False)
+        np.testing.assert_allclose(float(s1.numpy()[0]), float(s2.numpy()[0]),
+                                   rtol=1e-5)
+        np.testing.assert_array_equal(p1.numpy()[0, :4], p2.numpy()[0])
+
+    def test_text_datasets(self):
+        doc, label = text.Imdb(size=4)[1]
+        assert doc.dtype == np.int64 and label in (0, 1)
+        x, y = text.UCIHousing(size=4)[0]
+        assert x.shape == (13,) and y.shape == (1,)
+        src, trg, nxt = text.WMT14(size=4)[2]
+        assert len(nxt) == len(trg)
+
+
+class TestVisionZoo:
+    def _fwd(self, model, size=64):
+        x = T(np.random.rand(1, 3, size, size).astype(np.float32))
+        model.eval()
+        return model(x)
+
+    def test_vgg(self):
+        out = self._fwd(V.vgg11(num_classes=10), 224)
+        assert out.shape == [1, 10]
+
+    def test_mobilenets(self):
+        out = self._fwd(V.mobilenet_v1(num_classes=7), 64)
+        assert out.shape == [1, 7]
+        out = self._fwd(V.mobilenet_v2(num_classes=7), 64)
+        assert out.shape == [1, 7]
+
+    def test_alexnet_squeezenet(self):
+        out = self._fwd(V.alexnet(num_classes=5), 224)
+        assert out.shape == [1, 5]
+        out = self._fwd(V.squeezenet1_1(num_classes=5), 224)
+        assert out.shape == [1, 5]
