@@ -237,3 +237,109 @@ def variable_length_memory_efficient_attention(q, k, v, seq_lens=None, kv_seq_le
 
 def block_multihead_attention(*args, **kw):
     raise NotImplementedError("paged/block KV attention: ops/paged_attention (serving suite)")
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    """matmul + bias in one XLA fusion (reference: incubate fused_matmul_bias
+    over cublasLt epilogue — XLA fuses the add natively)."""
+
+    def fn(a, w, *b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_y:
+            w = jnp.swapaxes(w, -1, -2)
+        out = jnp.matmul(a, w)
+        return out + b[0] if b else out
+
+    if bias is not None:
+        return apply_fn("fused_matmul_bias", fn, x, y, bias)
+    return apply_fn("fused_matmul_bias", fn, x, y)
+
+
+def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
+                                           ln_scale=None, ln_bias=None,
+                                           dropout_rate=0.5, ln_epsilon=1e-5,
+                                           training=True, mode="upscale_in_train",
+                                           name=None):
+    """(x + bias) -> dropout -> + residual -> LayerNorm, one fusion
+    (reference: incubate/nn/functional/fused_bias_dropout_residual_layer_norm)."""
+    from ....nn import functional as F
+    from ....tensor import add as t_add
+
+    h = x if bias is None else apply_fn("bias_add", lambda a, b: a + b, x, bias)
+    h = F.dropout(h, p=dropout_rate, training=training, mode=mode)
+    h = t_add(h, residual)
+    return F.layer_norm(h, h.shape[-1:], weight=ln_scale, bias=ln_bias,
+                        epsilon=ln_epsilon)
+
+
+def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
+                            linear_weights, linear_biases, ffn_ln_scales,
+                            ffn_ln_biases, ffn1_weights, ffn1_biases,
+                            ffn2_weights, ffn2_biases, pre_layer_norm=True,
+                            epsilon=1e-5, cache_kvs=None, time_step=None,
+                            attn_mask=None, dropout_rate=0.0, activation="gelu",
+                            training=False, mode="upscale_in_train",
+                            trans_qkvw=True, ring_id=-1, num_heads=None,
+                            name=None):
+    """Stacked pre-LN transformer layers in one call (reference:
+    incubate/nn/functional/fused_multi_transformer — the GPT inference
+    megakernel). Each layer: LN -> qkv -> MHA -> proj -> +res -> LN -> FFN.
+    XLA fuses the whole unrolled chain into one program.
+
+    ``num_heads`` is required (the reference reads it from the qkv weight's
+    4-D [3, nh, hd, h] layout; the 2-D layout here cannot infer it safely).
+    Incremental decoding (cache_kvs/time_step) is not implemented."""
+    from ....nn import functional as F
+    from ....tensor import add, reshape, split
+
+    if cache_kvs is not None or time_step is not None:
+        raise NotImplementedError(
+            "fused_multi_transformer: cache_kvs/time_step (incremental "
+            "decoding) not supported — use the model-level kv-cache path")
+    if num_heads is None:
+        raise ValueError("fused_multi_transformer requires num_heads")
+
+    def _drop(t):
+        if dropout_rate and training:
+            return F.dropout(t, p=dropout_rate, training=True, mode=mode)
+        return t
+
+    h = x
+    for i in range(len(qkv_weights)):
+        res = h
+        a_in = h
+        if pre_layer_norm:
+            a_in = F.layer_norm(h, h.shape[-1:], weight=ln_scales[i],
+                                bias=ln_biases[i], epsilon=epsilon)
+        qkv = fused_matmul_bias(a_in, qkv_weights[i], qkv_biases[i],
+                                transpose_y=trans_qkvw)
+        d = qkv.shape[-1] // 3
+        nh = num_heads
+        hd = d // nh
+        q, k, v = split(qkv, 3, axis=-1)
+        b, s = q.shape[0], q.shape[1]
+        attn = F.scaled_dot_product_attention(
+            reshape(q, [b, s, nh, hd]), reshape(k, [b, s, nh, hd]),
+            reshape(v, [b, s, nh, hd]), attn_mask=attn_mask,
+            is_causal=attn_mask is None)
+        out = _drop(fused_matmul_bias(reshape(attn, [b, s, d]),
+                                      linear_weights[i], linear_biases[i]))
+        h = add(res, out)
+        if not pre_layer_norm:  # post-LN: normalize AFTER the residual add
+            h = F.layer_norm(h, h.shape[-1:], weight=ln_scales[i],
+                             bias=ln_biases[i], epsilon=epsilon)
+        res2 = h
+        f_in = h
+        if pre_layer_norm:
+            f_in = F.layer_norm(h, h.shape[-1:], weight=ffn_ln_scales[i],
+                                bias=ffn_ln_biases[i], epsilon=epsilon)
+        f1 = fused_matmul_bias(f_in, ffn1_weights[i], ffn1_biases[i])
+        f1 = F.gelu(f1) if activation == "gelu" else F.relu(f1)
+        h = add(res2, _drop(fused_matmul_bias(f1, ffn2_weights[i],
+                                              ffn2_biases[i])))
+        if not pre_layer_norm:
+            h = F.layer_norm(h, h.shape[-1:], weight=ffn_ln_scales[i],
+                             bias=ffn_ln_biases[i], epsilon=epsilon)
+    return h

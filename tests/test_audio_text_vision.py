@@ -115,7 +115,8 @@ class TestVisionZoo:
         return model(x)
 
     def test_vgg(self):
-        out = self._fwd(V.vgg11(num_classes=10), 224)
+        # adaptive pool tolerates small inputs: 64px keeps the CPU test fast
+        out = self._fwd(V.vgg11(num_classes=10), 64)
         assert out.shape == [1, 10]
 
     def test_mobilenets(self):
@@ -125,7 +126,7 @@ class TestVisionZoo:
         assert out.shape == [1, 7]
 
     def test_alexnet_squeezenet(self):
-        out = self._fwd(V.alexnet(num_classes=5), 224)
+        out = self._fwd(V.alexnet(num_classes=5), 96)
         assert out.shape == [1, 5]
-        out = self._fwd(V.squeezenet1_1(num_classes=5), 224)
+        out = self._fwd(V.squeezenet1_1(num_classes=5), 96)
         assert out.shape == [1, 5]

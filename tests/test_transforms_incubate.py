@@ -1,0 +1,81 @@
+"""Vision-transform + incubate fused-op breadth tests."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate.nn import functional as IF
+from paddle_tpu.vision import transforms as TR
+
+T = paddle.to_tensor
+
+
+class TestTransforms:
+    img = (np.random.default_rng(0).random((32, 32, 3)) * 255).astype(np.uint8)
+
+    def test_geometry(self):
+        assert TR.rotate(self.img, 90).shape == self.img.shape
+        # rotate 0 is identity
+        np.testing.assert_array_equal(TR.rotate(self.img, 0), self.img)
+        np.testing.assert_array_equal(TR.affine(self.img), self.img)
+        out = TR.perspective(self.img, [(0, 0), (31, 0), (31, 31), (0, 31)],
+                             [(0, 0), (31, 0), (31, 31), (0, 31)])
+        np.testing.assert_array_equal(out, self.img)
+        assert TR.vflip(self.img)[0, 0, 0] == self.img[-1, 0, 0]
+        assert TR.pad(self.img, 2).shape == (36, 36, 3)
+
+    def test_color(self):
+        np.testing.assert_array_equal(TR.adjust_brightness(self.img, 1.0),
+                                      self.img)
+        g = TR.to_grayscale(self.img, 3)
+        assert (g[..., 0] == g[..., 1]).all()
+        hue = TR.adjust_hue(self.img, 0.0)
+        assert np.abs(hue.astype(int) - self.img.astype(int)).max() <= 2
+
+    def test_random_transforms_shapes(self):
+        paddle.seed(0)
+        assert TR.RandomResizedCrop(16)(self.img).shape[:2] == (16, 16)
+        assert TR.ColorJitter(0.4, 0.4, 0.4, 0.1)(self.img).shape == self.img.shape
+        assert TR.RandomErasing(prob=1.0)(self.img).shape == self.img.shape
+        assert TR.RandomAffine(10, translate=(0.1, 0.1))(self.img).shape == self.img.shape
+        assert TR.RandomPerspective(1.0)(self.img).shape == self.img.shape
+
+
+class TestIncubateFused:
+    def test_fused_matmul_bias(self):
+        rng = np.random.default_rng(0)
+        x = rng.random((2, 4, 8)).astype(np.float32)
+        w = rng.random((8, 6)).astype(np.float32)
+        b = rng.random(6).astype(np.float32)
+        out = IF.fused_matmul_bias(T(x), T(w), T(b))
+        np.testing.assert_allclose(out.numpy(), x @ w + b, rtol=1e-5)
+        # transpose_y path
+        out2 = IF.fused_matmul_bias(T(x), T(w.T), T(b), transpose_y=True)
+        np.testing.assert_allclose(out2.numpy(), x @ w + b, rtol=1e-5)
+
+    def test_fused_bias_dropout_residual_ln(self):
+        rng = np.random.default_rng(1)
+        x = T(rng.random((2, 4, 8)).astype(np.float32))
+        res = T(rng.random((2, 4, 8)).astype(np.float32))
+        out = IF.fused_bias_dropout_residual_layer_norm(
+            x, res, None, T(np.ones(8, np.float32)),
+            T(np.zeros(8, np.float32)), dropout_rate=0.0)
+        got = out.numpy()
+        np.testing.assert_allclose(got.mean(-1), 0.0, atol=1e-5)
+        np.testing.assert_allclose(got.std(-1), 1.0, atol=1e-2)
+
+    def test_fused_multi_transformer(self):
+        rng = np.random.default_rng(2)
+        L, h = 2, 8
+        mk = lambda *s: T(rng.random(s).astype(np.float32) * 0.05)
+        zeros = lambda n: T(np.zeros(n, np.float32))
+        ones = T(np.ones(h, np.float32))
+        x = T(rng.random((2, 4, h)).astype(np.float32))
+        out = IF.fused_multi_transformer(
+            x, [ones] * L, [zeros(h)] * L, [mk(h, 3 * h)] * L,
+            [zeros(3 * h)] * L, [mk(h, h)] * L, [zeros(h)] * L,
+            [ones] * L, [zeros(h)] * L, [mk(h, 2 * h)] * L,
+            [zeros(2 * h)] * L, [mk(2 * h, h)] * L, [zeros(h)] * L,
+            trans_qkvw=False, num_heads=2)
+        assert out.shape == [2, 4, h]
+        assert np.isfinite(out.numpy()).all()
