@@ -98,3 +98,16 @@ def decorate(models, optimizers=None, level="O1", dtype="bfloat16", master_weigh
 
 
 from . import debugging  # noqa: F401,E402
+
+
+def is_float16_supported(device=None):
+    """fp16 compute support (TPU MXU is bf16-first; fp16 emulated)."""
+    import jax
+
+    return jax.devices()[0].platform in ("gpu", "tpu")
+
+
+def is_bfloat16_supported(device=None):
+    import jax
+
+    return jax.devices()[0].platform in ("tpu", "cpu", "gpu")

@@ -119,3 +119,73 @@ class cuda:
     @staticmethod
     def memory_allocated(device=None):
         return 0
+
+
+# breadth shims (reference: device/__init__.py misc queries)
+def get_cudnn_version():
+    return None  # no cuDNN on TPU
+
+
+class XPUPlace:
+    def __init__(self, dev_id=0):
+        self.dev_id = dev_id
+
+
+class IPUPlace:
+    def __init__(self, dev_id=0):
+        self.dev_id = dev_id
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def _alias_top_level():
+    # single source of truth: the top-level predicates (paddle_tpu/__init__)
+    from .. import (is_compiled_with_cinn, is_compiled_with_cuda,
+                    is_compiled_with_distribute, is_compiled_with_rocm,
+                    is_compiled_with_xpu)
+
+    return (is_compiled_with_xpu, is_compiled_with_cinn,
+            is_compiled_with_cuda, is_compiled_with_rocm,
+            is_compiled_with_distribute)
+
+
+def is_compiled_with_xpu():
+    return _alias_top_level()[0]()
+
+
+def is_compiled_with_cinn():
+    return _alias_top_level()[1]()
+
+
+def is_compiled_with_cuda():
+    return _alias_top_level()[2]()
+
+
+def is_compiled_with_rocm():
+    return _alias_top_level()[3]()
+
+
+def is_compiled_with_distribute():
+    return _alias_top_level()[4]()
+
+
+def get_all_device_type():
+    import jax
+
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_available_device():
+    import jax
+
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return []
+
+
+def set_stream(stream=None):
+    return stream  # XLA owns streams; API parity no-op

@@ -1,0 +1,146 @@
+"""Megatron-style sequence parallelism utilities.
+
+Parity anchor: /root/reference/python/paddle/distributed/fleet/utils/
+sequence_parallel_utils.py — ScatterOp:85 / GatherOp:97 / AllGatherOp /
+ReduceScatterOp, ColumnSequenceParallelLinear:427, RowSequenceParallelLinear,
+mark_as_sequence_parallel_parameter, register_sequence_parallel_allreduce_hooks:192.
+
+TPU-native: activations annotated with a sequence-dim sharding over the mp
+mesh axis; GSPMD materializes the scatter/gather/all-gather/reduce-scatter
+that the reference codes by hand, and the XLA scheduler overlaps them with
+matmuls (the job of the reference's SPInnerOverlapLinear). The explicit Op
+classes remain as thin sharding-constraint primitives so reference training
+code ports verbatim.
+"""
+
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec as P
+
+from ....core.tensor import Tensor
+from ....nn import functional as F
+from ....nn import initializer as I
+from ....nn.layer.layers import Layer
+from ..meta_parallel.parallel_layers.mp_layers import _constrain, _mp_info, _place
+
+__all__ = [
+    "ScatterOp", "GatherOp", "AllGatherOp", "ReduceScatterOp",
+    "mark_as_sequence_parallel_parameter",
+    "register_sequence_parallel_allreduce_hooks",
+    "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
+]
+
+
+def _seq_constrain(x, shard: bool):
+    """Constrain activation [b, s, h] to sequence-sharded over the 'mp' axis
+    (the fixed axis name of HybridCommunicateGroup's mesh) or replicated."""
+    hcg, _ = _mp_info()
+    arr = x._data if isinstance(x, Tensor) else x
+    if hcg is None:
+        return x if isinstance(x, Tensor) else Tensor(arr)
+    spec = P(None, "mp", None) if shard else P(None, None, None)
+    return Tensor(_constrain(arr, hcg.mesh, spec))
+
+
+class ScatterOp:
+    """Split activations along seq dim across the mp group (reference :85).
+    Forward scatter == backward gather; GSPMD derives both from the spec."""
+
+    @staticmethod
+    def apply(x):
+        return _seq_constrain(x, shard=True)
+
+
+class GatherOp:
+    """Gather seq-sharded activations back to full sequence (reference :97)."""
+
+    @staticmethod
+    def apply(x):
+        return _seq_constrain(x, shard=False)
+
+
+class AllGatherOp(GatherOp):
+    """Alias semantics of GatherOp at the XLA level (all-gather over mp)."""
+
+
+class ReduceScatterOp:
+    """Sum partial activations and scatter along seq (reference :138).
+    Under GSPMD the reduce comes from the producing matmul's partial sharding;
+    the scatter is the seq-sharded constraint."""
+
+    @staticmethod
+    def apply(x):
+        return _seq_constrain(x, shard=True)
+
+
+def mark_as_sequence_parallel_parameter(parameter):
+    """Tag params whose grads need mp-group allreduce in the reference
+    (LayerNorm scales inside SP regions). Grads are globally correct under
+    GSPMD already; the tag is kept for porting compatibility."""
+    parameter.sequence_parallel = True
+    return parameter
+
+
+def register_sequence_parallel_allreduce_hooks(model, fuse_sequence_parallel_allreduce=False):
+    """Reference registers fused grad-allreduce hooks for tagged params
+    (:192). GSPMD's partitioner already reduces those grads — nothing to
+    register; retained for API parity."""
+    return model
+
+
+class ColumnSequenceParallelLinear(Layer):
+    """Column-parallel linear with sequence-parallel input: the input arrives
+    seq-sharded, is all-gathered for the matmul, and the output is
+    column-sharded (reference :427). All collectives come from the sharding
+    specs."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=False, name=None):
+        super().__init__()
+        hcg, mp = _mp_info()
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierUniform())
+        self.bias = (self.create_parameter(
+            [out_features], default_initializer=I.Constant(0.0), is_bias=True)
+            if has_bias else None)
+        if hcg is not None:
+            _place(self.weight, hcg.mesh, P(None, "mp"))
+            if self.bias is not None:
+                _place(self.bias, hcg.mesh, P("mp"))
+
+    def forward(self, x):
+        x = GatherOp.apply(x)  # seq-sharded -> full sequence for the matmul
+        out = F.linear(x, self.weight, self.bias)
+        hcg, mp = _mp_info()
+        if hcg is not None and not self.gather_output:
+            out = Tensor(_constrain(out._data, hcg.mesh, P(None, None, "mp")))
+        return out
+
+
+class RowSequenceParallelLinear(Layer):
+    """Row-parallel linear whose output reduce-scatters along seq
+    (reference RowSequenceParallelLinear)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=True, name=None):
+        super().__init__()
+        hcg, mp = _mp_info()
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierUniform())
+        self.bias = (self.create_parameter(
+            [out_features], default_initializer=I.Constant(0.0), is_bias=True)
+            if has_bias else None)
+        if hcg is not None:
+            _place(self.weight, hcg.mesh, P("mp", None))
+
+    def forward(self, x):
+        hcg, mp = _mp_info()
+        if hcg is not None:
+            x = Tensor(_constrain(
+                x._data if isinstance(x, Tensor) else x, hcg.mesh,
+                P(None, None, "mp")))
+        out = F.linear(x, self.weight, self.bias)
+        return ReduceScatterOp.apply(out)  # partial-sum -> seq-sharded

@@ -205,3 +205,103 @@ class VisualDL(Callback):
     def on_train_end(self, logs=None):
         if self._f:
             self._f.close()
+
+
+class ReduceLROnPlateau(Callback):
+    """Reduce LR when a monitored metric plateaus (reference:
+    hapi/callbacks.py ReduceLROnPlateau)."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0.0):
+        super().__init__()
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = min_delta
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.greater = mode == "max" or (mode == "auto" and "acc" in monitor)
+        self._best = None
+        self._wait = 0
+        self._cool = 0
+
+    def _better(self, cur):
+        if self._best is None:
+            return True
+        if self.greater:
+            return cur > self._best + self.min_delta
+        return cur < self._best - self.min_delta
+
+    def on_eval_end(self, logs=None):
+        self._check(logs or {})
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._check(logs or {})
+
+    def _check(self, logs):
+        cur = logs.get(self.monitor)
+        if cur is None:
+            return
+        cur = float(cur[0] if isinstance(cur, (list, tuple)) else cur)
+        if self._better(cur):
+            self._best = cur
+            self._wait = 0
+            return
+        if self._cool > 0:
+            # cooling down: hold the LR, don't accumulate patience
+            self._cool -= 1
+            self._wait = 0
+            return
+        self._wait += 1
+        if self._wait >= self.patience:
+            opt = getattr(self.model, "_optimizer", None)
+            if opt is not None:
+                from ..optimizer.lr import LRScheduler
+
+                if isinstance(opt._lr, LRScheduler):
+                    import warnings
+
+                    warnings.warn(
+                        "ReduceLROnPlateau: optimizer uses an LRScheduler — "
+                        "set_lr would replace the schedule; skipping the "
+                        "reduction (reference paddle raises here)")
+                else:
+                    old = opt.get_lr()
+                    new = max(old * self.factor, self.min_lr)
+                    if new < old:
+                        opt.set_lr(new)
+                        if self.verbose:
+                            print(f"ReduceLROnPlateau: lr {old:.3g} -> {new:.3g}")
+            self._cool = self.cooldown
+            self._wait = 0
+
+
+class WandbCallback(Callback):
+    """Weights & Biases logging (reference: hapi/callbacks.py WandbCallback).
+    Gated on the wandb package being importable; otherwise a no-op logger."""
+
+    def __init__(self, project=None, name=None, dir=None, mode=None, **kwargs):
+        super().__init__()
+        self._kw = dict(project=project, name=name, dir=dir, mode=mode,
+                        **kwargs)
+        try:
+            import wandb  # noqa: F401
+
+            self._wandb = wandb
+        except ImportError:
+            self._wandb = None
+
+    def on_train_begin(self, logs=None):
+        if self._wandb is not None:
+            self._run = self._wandb.init(**{k: v for k, v in self._kw.items()
+                                            if v is not None})
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self._wandb is not None and logs:
+            self._wandb.log({f"train/{k}": v for k, v in logs.items()},
+                            step=epoch)
+
+    def on_train_end(self, logs=None):
+        if self._wandb is not None:
+            self._wandb.finish()

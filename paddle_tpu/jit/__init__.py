@@ -1,3 +1,27 @@
 """paddle_tpu.jit (reference: python/paddle/jit)."""
 
-from .api import StaticFunction, functional_call, ignore_module, load, not_to_static, save, to_static  # noqa: F401
+from .api import (  # noqa: F401
+    StaticFunction,
+    TranslatedLayer,
+    functional_call,
+    ignore_module,
+    load,
+    not_to_static,
+    save,
+    to_static,
+)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """Dy2static debug logging (reference: jit/set_code_level) — traces are
+    jax-level here; retained for API parity."""
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    pass
+
+
+def enable_to_static(enable_to_static_bool=True):
+    from . import api
+
+    api._to_static_enabled[0] = bool(enable_to_static_bool)

@@ -22,6 +22,11 @@ from ..core.tensor import Tensor, unwrap
 from ..nn.layer.layers import Layer
 
 
+# global to_static switch (paddle.jit.enable_to_static): False -> every
+# StaticFunction runs its original eager body
+_to_static_enabled = [True]
+
+
 def _collect_state(layer: Layer):
     """Ordered (names, tensors) for params + buffers."""
     names, tensors = [], []
@@ -119,7 +124,7 @@ class StaticFunction:
         return pure
 
     def __call__(self, *args, **kwargs):
-        if self._fallback_eager:
+        if self._fallback_eager or not _to_static_enabled[0]:
             return self._orig_fn(*args, **kwargs)
         try:
             return self._compiled_call(*args, **kwargs)

@@ -253,3 +253,33 @@ def profile(log_dir="./profiler_log"):
 
 def load_profiler_result(path):
     raise NotImplementedError("open the XPlane dump with TensorBoard's profile plugin")
+
+
+import enum as _enum
+
+
+class SortedKeys(_enum.IntEnum):
+    """Summary sort keys (reference: profiler/profiler_statistic.py SortedKeys)."""
+
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+class SummaryView(_enum.IntEnum):
+    """Summary view selector (reference: profiler/profiler.py SummaryView)."""
+
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8

@@ -1,0 +1,57 @@
+"""Sequence-parallel utils + callbacks tests (reference:
+fleet/utils/sequence_parallel_utils.py test patterns +
+hybrid_parallel_mp_model_with_sequence_parallel.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet.utils import sequence_parallel_utils as spu
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _fleet():
+    fleet.init(is_collective=True, strategy=None)
+
+
+def test_scatter_gather_roundtrip():
+    x = paddle.to_tensor(np.random.rand(2, 8, 16).astype(np.float32))
+    s = spu.ScatterOp.apply(x)
+    g = spu.GatherOp.apply(s)
+    np.testing.assert_allclose(g.numpy(), x.numpy())
+
+
+def test_column_row_sp_linear_matches_dense():
+    paddle.seed(0)
+    col = spu.ColumnSequenceParallelLinear(16, 32)
+    row = spu.RowSequenceParallelLinear(32, 16)
+    x = paddle.to_tensor(np.random.rand(2, 8, 16).astype(np.float32))
+    out = spu.GatherOp.apply(row(col(x)))
+    ref = (x.numpy() @ col.weight.numpy() + col.bias.numpy()) \
+        @ row.weight.numpy() + row.bias.numpy()
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_mark_and_register_are_port_compatible():
+    lin = paddle.nn.Linear(4, 4)
+    spu.mark_as_sequence_parallel_parameter(lin.weight)
+    assert lin.weight.sequence_parallel
+    assert spu.register_sequence_parallel_allreduce_hooks(lin) is lin
+
+
+def test_reduce_lr_on_plateau():
+    import paddle_tpu.hapi.callbacks as cb
+
+    lin = paddle.nn.Linear(2, 1)
+    opt = paddle.optimizer.SGD(learning_rate=1.0,
+                               parameters=list(lin.parameters()))
+
+    class _M:
+        _optimizer = opt
+
+    c = cb.ReduceLROnPlateau(monitor="loss", factor=0.5, patience=2, verbose=0)
+    c.model = _M()
+    for loss in (1.0, 1.0, 1.0, 1.0):
+        c.on_epoch_end(0, {"loss": loss})
+    assert opt.get_lr() == 0.5  # plateaued -> halved
