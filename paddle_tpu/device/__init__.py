@@ -140,35 +140,35 @@ def is_compiled_with_ipu():
     return False
 
 
-def _alias_top_level():
-    # single source of truth: the top-level predicates (paddle_tpu/__init__)
-    from .. import (is_compiled_with_cinn, is_compiled_with_cuda,
-                    is_compiled_with_distribute, is_compiled_with_rocm,
-                    is_compiled_with_xpu)
-
-    return (is_compiled_with_xpu, is_compiled_with_cinn,
-            is_compiled_with_cuda, is_compiled_with_rocm,
-            is_compiled_with_distribute)
-
-
+# single source of truth: the top-level predicates (paddle_tpu/__init__)
 def is_compiled_with_xpu():
-    return _alias_top_level()[0]()
+    from .. import is_compiled_with_xpu as _f
+
+    return _f()
 
 
 def is_compiled_with_cinn():
-    return _alias_top_level()[1]()
+    from .. import is_compiled_with_cinn as _f
+
+    return _f()
 
 
 def is_compiled_with_cuda():
-    return _alias_top_level()[2]()
+    from .. import is_compiled_with_cuda as _f
+
+    return _f()
 
 
 def is_compiled_with_rocm():
-    return _alias_top_level()[3]()
+    from .. import is_compiled_with_rocm as _f
+
+    return _f()
 
 
 def is_compiled_with_distribute():
-    return _alias_top_level()[4]()
+    from .. import is_compiled_with_distribute as _f
+
+    return _f()
 
 
 def get_all_device_type():

@@ -156,6 +156,44 @@ class LlamaAttention(Layer):
         out = constrain(out, "batch", "seq", "embed")
         return out
 
+    def decode_step(self, x, cos, sin, k_cache, v_cache, pos, pad_bias=None):
+        """KV-cache attention for generation (used for prefill AND decode).
+
+        x: [b, s, h] chunk occupying absolute positions [pos, pos+s);
+        caches: [b, max_len, kv_heads, hd]; cos/sin sliced for the chunk's
+        positions ([s, d] shared or [b, s, d] per-row when left-padded).
+        ``pad_bias`` [b, 1, 1, max_len] masks pad cache columns.
+        Returns (out, k_cache, v_cache).
+        """
+        x = x._data if isinstance(x, Tensor) else x
+        b, s, _ = x.shape
+        hd = self.config.head_dim
+        q = jnp.matmul(x, self.q_proj_weight._data).reshape(b, s, self.num_heads, hd)
+        k = jnp.matmul(x, self.k_proj_weight._data).reshape(b, s, self.num_kv_heads, hd)
+        v = jnp.matmul(x, self.v_proj_weight._data).reshape(b, s, self.num_kv_heads, hd)
+        if cos.ndim == 3:  # per-row positions: [b, s, d] -> [b, s, 1, d]
+            cb, sb = cos[:, :, None, :], sin[:, :, None, :]
+            q = (q * cb) + (_rotate_half(q) * sb)
+            k = (k * cb) + (_rotate_half(k) * sb)
+        else:
+            q, k = apply_rotary_pos_emb(q, k, cos, sin)
+        k_cache = jax.lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype),
+                                               (0, pos, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype),
+                                               (0, pos, 0, 0))
+        # mask: chunk row i (absolute pos+i) may see cache cols j <= pos+i
+        max_len = k_cache.shape[1]
+        cols = jnp.arange(max_len)[None, :]
+        rows = pos + jnp.arange(s)[:, None]
+        bias = jnp.where(cols <= rows, 0.0, -1e9)[None, None]  # [1,1,s,max_len]
+        if pad_bias is not None:
+            bias = bias + pad_bias
+        from ...nn.functional.flash_attention import _xla_attention
+
+        out = _xla_attention(q, k_cache, v_cache, bias=bias, causal=False)
+        out = out.reshape(b, s, self.num_heads * hd)
+        return jnp.matmul(out, self.o_proj_weight._data), k_cache, v_cache
+
 
 def _attention(q, k, v, config, attn_bias=None):
     """Causal attention on raw arrays; routes to the Pallas kernel on TPU.
@@ -273,6 +311,17 @@ class LlamaDecoderLayer(Layer):
         x = x + (y._data if isinstance(y, Tensor) else y)
         return constrain(x, "batch", "seq", "embed")
 
+    def decode_step(self, hidden, cos, sin, k_cache, v_cache, pos,
+                    pad_bias=None):
+        x = hidden._data if isinstance(hidden, Tensor) else hidden
+        a, k_cache, v_cache = self.self_attn.decode_step(
+            self.input_layernorm(x), cos, sin, k_cache, v_cache, pos,
+            pad_bias=pad_bias)
+        x = x + a
+        y = self.mlp(self.post_attention_layernorm(x))
+        x = x + (y._data if isinstance(y, Tensor) else y)
+        return x, k_cache, v_cache
+
 
 class LlamaModel(Layer):
     def __init__(self, config: LlamaConfig):
@@ -328,6 +377,38 @@ class LlamaModel(Layer):
         return self.norm(x)
 
 
+def _decode_model(model: "LlamaModel", ids, caches, pos, pad_bias=None,
+                  rope_offset=None):
+    """Run a chunk through all layers with KV caches. ids: [b, s] at absolute
+    positions [pos, pos+s); caches: list of (k, v) per layer.
+
+    ``pad_bias``: [b, 1, 1, max_len] additive bias masking left-pad cache
+    columns; ``rope_offset``: [b] per-row position shift (left padding moves
+    each row's position 0 to its first real token)."""
+    cfg = model.config
+    table = model.embed_tokens_weight._data
+    x = jnp.take(table, ids, axis=0)
+    max_len = caches[0][0].shape[1]
+    cos_full, sin_full = _rope_cos_sin(max_len, cfg.head_dim, cfg.rope_theta,
+                                       x.dtype)
+    s = ids.shape[1]
+    if rope_offset is None:
+        cos = jax.lax.dynamic_slice_in_dim(cos_full, pos, s, 0)
+        sin = jax.lax.dynamic_slice_in_dim(sin_full, pos, s, 0)
+    else:
+        # per-row positions: [b, s] gather -> [b, s, d], clipped at 0 for pads
+        positions = jnp.clip(pos + jnp.arange(s)[None, :]
+                             - rope_offset[:, None], 0, max_len - 1)
+        cos = cos_full[positions]
+        sin = sin_full[positions]
+    new_caches = []
+    for layer, (kc, vc) in zip(model.layers, caches):
+        x, kc, vc = layer.decode_step(x, cos, sin, kc, vc, pos,
+                                      pad_bias=pad_bias)
+        new_caches.append((kc, vc))
+    return model.norm(x), new_caches
+
+
 class LlamaForCausalLM(Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__()
@@ -365,6 +446,112 @@ class LlamaForCausalLM(Layer):
         if self.config.num_experts <= 1:
             return 0.0
         return getattr(self.model, "_moe_aux", 0.0)
+
+    def _decode_fns(self, temperature, top_p):
+        """Jitted prefill/step closures, cached on the model — repeated
+        generate() calls with the same shapes hit jax.jit's trace cache."""
+        key = (float(temperature), top_p)
+        cache = getattr(self, "_gen_fns", None)
+        if cache is not None and cache[0] == key:
+            return cache[1], cache[2]
+        from ...core import autograd_engine
+        from ...jit.api import _Swap, _collect_state
+
+        _, tensors = _collect_state(self)
+
+        def sample(logits, skey):
+            if temperature == 0.0:
+                return jnp.argmax(logits, -1).astype(jnp.int32)
+            logits = logits / max(temperature, 1e-6)
+            if top_p is not None:
+                sort_idx = jnp.argsort(-logits, axis=-1)
+                sorted_p = jax.nn.softmax(
+                    jnp.take_along_axis(logits, sort_idx, -1), -1)
+                cum = jnp.cumsum(sorted_p, -1)
+                keep = cum - sorted_p <= top_p
+                masked = jnp.where(
+                    keep, jnp.take_along_axis(logits, sort_idx, -1), -1e9)
+                choice = jax.random.categorical(skey, masked, axis=-1)
+                return jnp.take_along_axis(
+                    sort_idx, choice[:, None], -1)[:, 0].astype(jnp.int32)
+            return jax.random.categorical(skey, logits, -1).astype(jnp.int32)
+
+        def run_chunk(ps, chunk, cs, pos, pad_bias, rope_offset, skey):
+            with autograd_engine.no_grad(), _Swap(tensors, ps):
+                hidden, cs = _decode_model(self.model, chunk, cs, pos,
+                                           pad_bias, rope_offset)
+                logits = self.logits(hidden)
+            tok = sample(logits[:, -1].astype(jnp.float32), skey)
+            return tok, cs
+
+        prefill = jax.jit(run_chunk)
+        step = jax.jit(run_chunk, donate_argnums=(2,))
+        self._gen_fns = (key, prefill, step)
+        return prefill, step
+
+    def generate(self, input_ids, max_new_tokens: int = 32,
+                 temperature: float = 1.0, top_p: float = None,
+                 eos_token_id: int = None, seed: int = 0,
+                 attention_mask=None):
+        """KV-cache autoregressive generation (greedy / temperature / top-p).
+
+        TPU-native decode: one jitted prefill (whole prompt through the cache
+        path) + one jitted single-token step with donated caches (in-place in
+        HBM); sampling is fused into the jitted step. Batches of unequal
+        prompt lengths use LEFT padding + ``attention_mask`` [b, prompt_len]
+        (1 = real): pad columns are bias-masked out of attention and RoPE
+        positions shift per row so each prompt starts at position 0.
+        """
+        from ...jit.api import _collect_state
+
+        cfg = self.config
+        ids = input_ids._data if isinstance(input_ids, Tensor) else jnp.asarray(input_ids)
+        ids = ids.astype(jnp.int32)
+        b, prompt_len = ids.shape
+        max_len = prompt_len + max_new_tokens
+        _, tensors = _collect_state(self)
+        params = [t._data for t in tensors]
+        kvh, hd = cfg.num_key_value_heads, cfg.head_dim
+        dtype = params[0].dtype
+        caches = [(jnp.zeros((b, max_len, kvh, hd), dtype),
+                   jnp.zeros((b, max_len, kvh, hd), dtype))
+                  for _ in range(cfg.num_hidden_layers)]
+
+        if attention_mask is not None:
+            m = (attention_mask._data if isinstance(attention_mask, Tensor)
+                 else jnp.asarray(attention_mask)).astype(jnp.int32)
+            if bool((m[:, -1] == 0).any()):
+                raise ValueError(
+                    "generate() expects LEFT-padded prompts: the last "
+                    "attention_mask column must be all ones")
+            pad_cols = jnp.concatenate(
+                [m == 0, jnp.zeros((b, max_new_tokens), bool)], axis=1)
+            pad_bias = jnp.where(pad_cols, -1e9, 0.0)[:, None, None, :]
+            rope_offset = (prompt_len - m.sum(-1)).astype(jnp.int32)
+        else:
+            pad_bias = jnp.zeros((b, 1, 1, max_len), jnp.float32)
+            rope_offset = jnp.zeros((b,), jnp.int32)
+
+        prefill, step = self._decode_fns(temperature, top_p)
+        key = jax.random.key(seed)
+        key, sk = jax.random.split(key)
+        tok, caches = prefill(params, ids, caches, 0, pad_bias, rope_offset, sk)
+        out_tokens = [tok]
+        finished = jnp.zeros((b,), bool)
+        if eos_token_id is not None:
+            finished = finished | (tok == eos_token_id)
+        for i in range(1, max_new_tokens):
+            if eos_token_id is not None and bool(finished.all()):
+                break
+            key, sk = jax.random.split(key)
+            nxt, caches = step(params, tok[:, None], caches,
+                               prompt_len + i - 1, pad_bias, rope_offset, sk)
+            if eos_token_id is not None:
+                nxt = jnp.where(finished, eos_token_id, nxt)
+                finished = finished | (nxt == eos_token_id)
+            tok = nxt
+            out_tokens.append(tok)
+        return Tensor(jnp.stack(out_tokens, axis=1))
 
     def loss_fn(self, input_ids, labels):
         """Raw-array loss for jit'ed training steps."""
