@@ -117,9 +117,14 @@ def _rotate_half(x):
 
 
 def apply_rotary_pos_emb(q, k, cos, sin):
-    """q,k: [b, s, h, d]; cos/sin: [s, d] broadcast over batch/heads."""
-    cos = cos[None, :, None, :]
-    sin = sin[None, :, None, :]
+    """q,k: [b, s, h, d]; cos/sin: [s, d] (shared positions) or [b, s, d]
+    (per-row positions, e.g. left-padded decode) — broadcast over heads."""
+    if cos.ndim == 3:
+        cos = cos[:, :, None, :]
+        sin = sin[:, :, None, :]
+    else:
+        cos = cos[None, :, None, :]
+        sin = sin[None, :, None, :]
     return q * cos + _rotate_half(q) * sin, k * cos + _rotate_half(k) * sin
 
 
@@ -171,12 +176,7 @@ class LlamaAttention(Layer):
         q = jnp.matmul(x, self.q_proj_weight._data).reshape(b, s, self.num_heads, hd)
         k = jnp.matmul(x, self.k_proj_weight._data).reshape(b, s, self.num_kv_heads, hd)
         v = jnp.matmul(x, self.v_proj_weight._data).reshape(b, s, self.num_kv_heads, hd)
-        if cos.ndim == 3:  # per-row positions: [b, s, d] -> [b, s, 1, d]
-            cb, sb = cos[:, :, None, :], sin[:, :, None, :]
-            q = (q * cb) + (_rotate_half(q) * sb)
-            k = (k * cb) + (_rotate_half(k) * sb)
-        else:
-            q, k = apply_rotary_pos_emb(q, k, cos, sin)
+        q, k = apply_rotary_pos_emb(q, k, cos, sin)
         k_cache = jax.lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype),
                                                (0, pos, 0, 0))
         v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype),
@@ -452,8 +452,8 @@ class LlamaForCausalLM(Layer):
         generate() calls with the same shapes hit jax.jit's trace cache."""
         key = (float(temperature), top_p)
         cache = getattr(self, "_gen_fns", None)
-        if cache is not None and cache[0] == key:
-            return cache[1], cache[2]
+        if cache is not None and key in cache:
+            return cache[key]
         from ...core import autograd_engine
         from ...jit.api import _Swap, _collect_state
 
@@ -480,13 +480,18 @@ class LlamaForCausalLM(Layer):
             with autograd_engine.no_grad(), _Swap(tensors, ps):
                 hidden, cs = _decode_model(self.model, chunk, cs, pos,
                                            pad_bias, rope_offset)
-                logits = self.logits(hidden)
+                hidden = hidden._data if isinstance(hidden, Tensor) else hidden
+                # lm head only on the position we sample from — a 2k-token
+                # prompt must not pay 2k x vocab logits
+                logits = self.logits(hidden[:, -1:])
             tok = sample(logits[:, -1].astype(jnp.float32), skey)
             return tok, cs
 
         prefill = jax.jit(run_chunk)
         step = jax.jit(run_chunk, donate_argnums=(2,))
-        self._gen_fns = (key, prefill, step)
+        if cache is None:
+            cache = self._gen_fns = {}
+        cache[key] = (prefill, step)
         return prefill, step
 
     def generate(self, input_ids, max_new_tokens: int = 32,
@@ -501,6 +506,9 @@ class LlamaForCausalLM(Layer):
         prompt lengths use LEFT padding + ``attention_mask`` [b, prompt_len]
         (1 = real): pad columns are bias-masked out of attention and RoPE
         positions shift per row so each prompt starts at position 0.
+
+        Always returns [b, max_new_tokens]; rows that hit ``eos_token_id``
+        early are padded out with eos (static shape for downstream stacking).
         """
         from ...jit.api import _collect_state
 
@@ -520,17 +528,21 @@ class LlamaForCausalLM(Layer):
         if attention_mask is not None:
             m = (attention_mask._data if isinstance(attention_mask, Tensor)
                  else jnp.asarray(attention_mask)).astype(jnp.int32)
-            if bool((m[:, -1] == 0).any()):
+            # contiguous LEFT padding only: per-row non-decreasing mask whose
+            # last column is real (interior holes would break the rope_offset
+            # arithmetic silently)
+            if bool((m[:, -1] == 0).any()) or bool((jnp.diff(m, axis=1) < 0).any()):
                 raise ValueError(
-                    "generate() expects LEFT-padded prompts: the last "
-                    "attention_mask column must be all ones")
+                    "generate() expects LEFT-padded prompts: attention_mask "
+                    "must be 0...01...1 per row (pads strictly before tokens)")
             pad_cols = jnp.concatenate(
                 [m == 0, jnp.zeros((b, max_new_tokens), bool)], axis=1)
             pad_bias = jnp.where(pad_cols, -1e9, 0.0)[:, None, None, :]
             rope_offset = (prompt_len - m.sum(-1)).astype(jnp.int32)
         else:
-            pad_bias = jnp.zeros((b, 1, 1, max_len), jnp.float32)
-            rope_offset = jnp.zeros((b,), jnp.int32)
+            # unpadded: None keeps the cheap shared-RoPE / no-bias trace paths
+            pad_bias = None
+            rope_offset = None
 
         prefill, step = self._decode_fns(temperature, top_p)
         key = jax.random.key(seed)
@@ -551,7 +563,13 @@ class LlamaForCausalLM(Layer):
                 finished = finished | (nxt == eos_token_id)
             tok = nxt
             out_tokens.append(tok)
-        return Tensor(jnp.stack(out_tokens, axis=1))
+        out = jnp.stack(out_tokens, axis=1)
+        if out.shape[1] < max_new_tokens:
+            # eos early-stop: pad to the requested static shape with eos
+            pad = jnp.full((b, max_new_tokens - out.shape[1]), eos_token_id,
+                           jnp.int32)
+            out = jnp.concatenate([out, pad], axis=1)
+        return Tensor(out)
 
     def loss_fn(self, input_ids, labels):
         """Raw-array loss for jit'ed training steps."""
