@@ -19,6 +19,14 @@ __all__ = [
     "poisson_nll_loss", "gaussian_nll_loss", "triplet_margin_with_distance_loss",
     "pairwise_distance", "ctc_loss", "rnnt_loss", "hsigmoid_loss",
     "softmax_2d", "feature_alpha_dropout",
+    # final breadth completion
+    "sequence_mask", "zeropad2d", "fractional_max_pool2d",
+    "fractional_max_pool3d", "npair_loss", "margin_cross_entropy",
+    "affine_grid", "grid_sample", "gather_tree", "temporal_shift",
+    "class_center_sample", "sparse_attention",
+    "adaptive_log_softmax_with_loss", "flash_attn_qkvpacked",
+    "flash_attn_varlen_qkvpacked",
+    "elu_", "hardtanh_", "leaky_relu_", "tanh_", "thresholded_relu_",
 ]
 
 
@@ -444,3 +452,371 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
     if bias is not None:
         return apply_fn("hsigmoid_loss", fn, input, label, weight, bias)
     return apply_fn("hsigmoid_loss", fn, input, label, weight)
+
+
+# ---------------------------------------------------------------------------
+# final breadth completion (reference: nn/functional/__init__.py remainder)
+# ---------------------------------------------------------------------------
+
+def _inplace(fn):
+    def f(x, *args, **kwargs):
+        out = fn(x, *args, **kwargs)
+        return x._replace_(out._data, out._node, out._out_idx)
+
+    f.__name__ = fn.__name__ + "_"
+    return f
+
+
+def elu_(x, alpha=1.0, name=None):
+    from .activation import elu
+
+    return _inplace(elu)(x, alpha)
+
+
+def hardtanh_(x, min=-1.0, max=1.0, name=None):
+    from .activation import hardtanh
+
+    return _inplace(hardtanh)(x, min, max)
+
+
+def leaky_relu_(x, negative_slope=0.01, name=None):
+    from .activation import leaky_relu
+
+    return _inplace(leaky_relu)(x, negative_slope)
+
+
+def tanh_(x, name=None):
+    from ...tensor import tanh
+
+    return _inplace(tanh)(x)
+
+
+def thresholded_relu_(x, threshold=1.0, value=0.0, name=None):
+    from .activation import thresholded_relu
+
+    return _inplace(thresholded_relu)(x, threshold, value)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """Length vector -> [*, maxlen] mask (reference: sequence_mask;
+    default dtype int64 like the reference)."""
+    from ...core import dtype as dtype_mod
+
+    if maxlen is None:
+        lens = unwrap(x)
+        if isinstance(lens, jax.core.Tracer):
+            raise ValueError(
+                "sequence_mask under jit needs an explicit maxlen (the "
+                "output shape cannot depend on traced values)")
+        maxlen = int(np.max(np.asarray(lens)))
+    n = int(maxlen)
+
+    def fn(lens):
+        m = jnp.arange(n)[None] < lens[..., None]
+        return m.astype(dtype_mod.convert_dtype(dtype))
+
+    return apply_fn("sequence_mask", fn, x)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    from .common import pad as F_pad
+
+    return F_pad(x, padding, mode="constant", value=0.0,
+                 data_format=data_format)
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    from ..layer.extras import FractionalMaxPool2D
+
+    return FractionalMaxPool2D(output_size, kernel_size, random_u,
+                               return_mask)(x)
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    from ..layer.extras import FractionalMaxPool3D
+
+    return FractionalMaxPool3D(output_size, kernel_size, random_u,
+                               return_mask)(x)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """N-pair loss (reference: nn/functional/loss.py npair_loss)."""
+
+    def fn(a, p, y):
+        reg = jnp.mean(jnp.sum(a * a, -1)) + jnp.mean(jnp.sum(p * p, -1))
+        sim = a @ p.T  # [B, B]
+        same = (y[:, None] == y[None, :]).astype(sim.dtype)
+        same = same / jnp.maximum(same.sum(-1, keepdims=True), 1.0)
+        logp = jax.nn.log_softmax(sim, -1)
+        ce = -jnp.mean(jnp.sum(same * logp, -1))
+        return ce + l2_reg * reg * 0.25
+
+    return apply_fn("npair_loss", fn, anchor, positive, labels)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
+                         scale=64.0, group=None, return_softmax=False,
+                         reduction="mean"):
+    """ArcFace-family margin softmax (reference: margin_cross_entropy —
+    cos(m1*theta + m2) - m3 on the target logit). Single-group; vocab-parallel
+    sharding composes via GSPMD when logits carry a sharded axis."""
+
+    def fn(lg, y):
+        # clip strictly inside (-1, 1): arccos' blows up at the boundary and
+        # autodiff would produce NaN grads for any logit that rounds to 1.0
+        lgf = jnp.clip(lg.astype(jnp.float32), -1.0 + 1e-6, 1.0 - 1e-6)
+        theta = jnp.arccos(jnp.take_along_axis(lgf, y[:, None], 1)[:, 0])
+        target = jnp.cos(margin1 * theta + margin2) - margin3
+        out = lgf.at[jnp.arange(lg.shape[0]), y].set(target) * scale
+        logp = jax.nn.log_softmax(out, -1)
+        nll = -jnp.take_along_axis(logp, y[:, None], 1)[:, 0]
+        loss = _reduce(nll, reduction)
+        if return_softmax:
+            return loss, jax.nn.softmax(out, -1)
+        return loss
+
+    return apply_fn("margin_cross_entropy", fn, logits, label)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """2D affine sampling grid (reference: vision affine_grid)."""
+    shape = [int(s) for s in (out_shape if not isinstance(out_shape, Tensor)
+                              else np.asarray(out_shape._data))]
+
+    def fn(th):
+        n, _, h, w = shape
+        if align_corners:
+            ys = jnp.linspace(-1, 1, h)
+            xs = jnp.linspace(-1, 1, w)
+        else:
+            ys = (jnp.arange(h) + 0.5) * 2 / h - 1
+            xs = (jnp.arange(w) + 0.5) * 2 / w - 1
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        base = jnp.stack([gx, gy, jnp.ones_like(gx)], -1)  # [h, w, 3]
+        return jnp.einsum("nij,hwj->nhwi", th.astype(jnp.float32), base)
+
+    return apply_fn("affine_grid", fn, theta)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Spatial sampling at grid coords (reference: grid_sample). NCHW input,
+    grid [n, h, w, 2] in [-1, 1]."""
+
+    def fn(a, g):
+        n, c, h, w = a.shape
+        gx, gy = g[..., 0], g[..., 1]
+        if align_corners:
+            fx = (gx + 1) * (w - 1) / 2
+            fy = (gy + 1) * (h - 1) / 2
+        else:
+            fx = ((gx + 1) * w - 1) / 2
+            fy = ((gy + 1) * h - 1) / 2
+        import jax.scipy.ndimage as jndi
+
+        order = 1 if mode == "bilinear" else 0
+        mode_nd = {"zeros": "constant", "border": "nearest",
+                   "reflection": "mirror"}[padding_mode]
+
+        def sample_one(img, yy, xx):  # img [c, h, w]
+            return jax.vmap(lambda ch: jndi.map_coordinates(
+                ch, [yy.ravel(), xx.ravel()], order=order, mode=mode_nd,
+                cval=0.0))(img).reshape(c, *yy.shape)
+
+        return jax.vmap(sample_one)(a, fy, fx)
+
+    return apply_fn("grid_sample", fn, x, grid)
+
+
+def gather_tree(ids, parents):
+    """Beam-search backtrace (reference: gather_tree). ids/parents:
+    [max_time, batch, beam]."""
+
+    def fn(i, p):
+        T = i.shape[0]
+
+        def back(carry, t):
+            beams = carry  # [batch, beam] beam indices at t+1
+            tok = jnp.take_along_axis(i[t], beams, -1)
+            beams = jnp.take_along_axis(p[t], beams, -1)
+            return beams, tok
+
+        init = jnp.broadcast_to(jnp.arange(i.shape[2])[None], i.shape[1:])
+        _, toks = jax.lax.scan(back, init, jnp.arange(T - 1, -1, -1))
+        return toks[::-1]
+
+    return apply_fn("gather_tree", fn, ids, parents)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """TSM temporal channel shift (reference: temporal_shift)."""
+
+    def fn(a):
+        if data_format == "NHWC":
+            a = jnp.transpose(a, (0, 3, 1, 2))
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        v = a.reshape(n, seg_num, c, h, w)
+        fold = int(c * shift_ratio)
+        left = jnp.concatenate(
+            [v[:, 1:, :fold], jnp.zeros_like(v[:, :1, :fold])], 1)
+        right = jnp.concatenate(
+            [jnp.zeros_like(v[:, :1, fold:2 * fold]), v[:, :-1, fold:2 * fold]], 1)
+        rest = v[:, :, 2 * fold:]
+        out = jnp.concatenate([left, right, rest], 2).reshape(nt, c, h, w)
+        if data_format == "NHWC":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+
+    return apply_fn("temporal_shift", fn, x)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Sample negative class centers + remap labels (reference:
+    class_center_sample — PartialFC). Deterministic given the RNG stream."""
+    from ...framework.random import next_key
+
+    def fn(y):
+        pos = jnp.zeros((num_classes,), bool).at[y].set(True)
+        noise = jax.random.uniform(next_key(), (num_classes,))
+        # positives first (score 2), then random negatives
+        score = jnp.where(pos, 2.0, noise)
+        _, chosen = jax.lax.top_k(score, num_samples)
+        chosen = jnp.sort(chosen)
+        # remap: label -> its index within chosen (positives always included)
+        remap = jnp.zeros((num_classes,), jnp.int32).at[chosen].set(
+            jnp.arange(num_samples, dtype=jnp.int32))
+        return remap[y], chosen
+
+    return apply_fn("class_center_sample", fn, label)
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """Block-sparse attention with a CSR sparsity pattern (reference:
+    nn/functional/sparse_attention.py over the CUDA kernel). TPU note: XLA
+    has no CSR attention primitive — the pattern is materialized as a bias
+    mask (correct; the perf path on TPU is flashmask/ring attention)."""
+
+    def fn(q, k, v, off, cols, *masks):
+        b, h, s, d = q.shape
+        nnz = cols.shape[-1]
+
+        def one_mask(off_bh, cols_bh):
+            rows = jnp.repeat(jnp.arange(s), jnp.diff(off_bh).astype(jnp.int32),
+                              total_repeat_length=nnz)
+            # entries beyond off[-1] are padding: scatter False via max so
+            # they can never switch a cell on
+            valid = jnp.arange(nnz) < off_bh[-1]
+            return jnp.zeros((s, s), bool).at[rows, cols_bh].max(valid)
+
+        # per-(batch, head) CSR patterns
+        mask = jax.vmap(jax.vmap(one_mask))(off, cols)  # [b, h, s, s]
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                            k.astype(jnp.float32)) / jnp.sqrt(float(d))
+        logits = jnp.where(mask, logits, -1e9)
+        it = iter(masks)
+        if key_padding_mask is not None:
+            kpm = next(it)  # [b, s]: 1/True = keep
+            logits = jnp.where(kpm.astype(bool)[:, None, None, :], logits, -1e9)
+        if attn_mask is not None:
+            logits = logits + next(it).astype(jnp.float32)
+        probs = jax.nn.softmax(logits, -1)
+        return jnp.einsum("bhqk,bhkd->bhqd", probs, v.astype(jnp.float32)).astype(q.dtype)
+
+    extra = [m for m in (key_padding_mask, attn_mask) if m is not None]
+    return apply_fn("sparse_attention", fn, query, key, value,
+                    sparse_csr_offset, sparse_csr_columns, *extra)
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
+                                   cutoffs, head_bias=None, name=None):
+    """Functional form (reference: adaptive_log_softmax_with_loss).
+    head_weight: [in, shortlist+n_clusters]; tail_weights: list of (w1, w2)."""
+
+    cut = list(cutoffs)
+    shortlist = cut[0]
+    if len(cut) - 1 != len(tail_weights):
+        raise ValueError(
+            f"cutoffs must have len(tail_weights)+1 entries (the last one is "
+            f"n_classes): got {len(cut)} cutoffs for {len(tail_weights)} tails")
+    y_eager = unwrap(label)
+    if not isinstance(y_eager, jax.core.Tracer):
+        if bool((np.asarray(y_eager) < 0).any()) or bool(
+                (np.asarray(y_eager) >= cut[-1]).any()):
+            raise ValueError(
+                f"labels must be in [0, {cut[-1]}) for these cutoffs")
+
+    args = [input, label, head_weight]
+    if head_bias is not None:
+        args.append(head_bias)
+    flat_tails = [w for pair in tail_weights for w in pair]
+    args.extend(flat_tails)
+
+    def fn(x, y, hw, *rest):
+        it = iter(rest)
+        hb = next(it) if head_bias is not None else None
+        tails = [(next(it), next(it)) for _ in range(len(tail_weights))]
+        x = x.astype(jnp.float32)
+        logits = x @ hw
+        if hb is not None:
+            logits = logits + hb
+        head_logp = jax.nn.log_softmax(logits, -1)
+        safe_y = jnp.clip(y, 0, shortlist - 1)
+        out = jnp.where(y < shortlist,
+                        jnp.take_along_axis(head_logp, safe_y[:, None], 1)[:, 0],
+                        0.0)
+        for i, (w1, w2) in enumerate(tails):
+            lo, hi = cut[i], cut[i + 1]
+            in_cluster = (y >= lo) & (y < hi)
+            tail_logp = jax.nn.log_softmax((x @ w1) @ w2, -1)
+            rel = jnp.clip(y - lo, 0, hi - lo - 1)
+            lp = (head_logp[:, shortlist + i]
+                  + jnp.take_along_axis(tail_logp, rel[:, None], 1)[:, 0])
+            out = jnp.where(in_cluster, lp, out)
+        return out, -jnp.mean(out)
+
+    return apply_fn("adaptive_log_softmax_with_loss_fn", fn, *args)
+
+
+def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False, return_softmax=False,
+                         name=None):
+    """Packed-qkv flash attention (reference: flash_attention.py
+    flash_attn_qkvpacked). qkv: [b, s, 3, h, d]."""
+    from .flash_attention import flash_attention
+    from ...tensor import unbind
+
+    q, k, v = unbind(qkv, axis=2)
+    return flash_attention(q, k, v, dropout=dropout, causal=causal,
+                           return_softmax=return_softmax)
+
+
+def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k, max_seqlen_q,
+                                max_seqlen_k, scale=None, dropout=0.0,
+                                causal=False, return_softmax=False, name=None):
+    """Varlen packed flash attention (reference: flash_attention.py varlen).
+    qkv: [total_tokens, 3, h, d] with cu_seqlens prefix sums. TPU note:
+    ragged batches are densified per sequence (static shapes); the fast path
+    is the padded flash kernel."""
+    from .flash_attention import _xla_attention
+
+    def fn(pk, cu_q):
+        outs = []
+        cu = np.asarray(cu_q)
+        for i in range(len(cu) - 1):
+            seg = pk[cu[i]:cu[i + 1]]  # [s_i, 3, h, d]
+            q, k, v = seg[:, 0], seg[:, 1], seg[:, 2]
+            o = _xla_attention(q[None], k[None], v[None], causal=causal,
+                               scale=scale)[0]
+            outs.append(o)
+        return jnp.concatenate(outs, 0)
+
+    # host-side loop over the (concrete) prefix sums: eager-only API
+    pk = qkv._data if isinstance(qkv, Tensor) else jnp.asarray(qkv)
+    cu = (cu_seqlens_q._data if isinstance(cu_seqlens_q, Tensor)
+          else jnp.asarray(cu_seqlens_q))
+    # mirror flash_attention's (out, softmax|None) return convention
+    return Tensor(fn(pk, cu)), None

@@ -229,3 +229,51 @@ def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
 
 def multi_dot(x, name=None):
     return apply_fn("multi_dot", lambda *xs: jnp.linalg.multi_dot(xs), *x, _opdef=_MM)
+
+
+def matrix_exp(x, name=None):
+    """Matrix exponential (reference: tensor/linalg.py matrix_exp)."""
+    import jax.scipy.linalg as jsl
+
+    return apply_fn("matrix_exp", lambda a: jsl.expm(a), x)
+
+
+def fp8_fp8_half_gemm_fused(x, y, bias=None, transpose_x=False,
+                            transpose_y=False, scale=1.0,
+                            output_dtype="float16", activation_type="identity",
+                            name=None):
+    """fp8 x fp8 -> half GEMM (reference: incubate cublaslt fp8 gemm).
+    TPU-native: cast through float8_e4m3fn and let XLA pick the low-precision
+    dot; accumulation in fp32, output in half precision."""
+    import jax
+    import jax.numpy as jnp
+    from ..core import dtype as dtype_mod
+
+    out_dt = dtype_mod.convert_dtype(output_dtype)
+
+    def fn(a, b, *bias_arr):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2)
+        a8 = a.astype(jnp.float8_e4m3fn)
+        b8 = b.astype(jnp.float8_e4m3fn)
+        acc = jax.lax.dot_general(
+            a8, b8, (((a8.ndim - 1,), (b8.ndim - 2,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        out = acc * scale
+        if bias_arr:
+            out = out + bias_arr[0].astype(jnp.float32)
+        if activation_type == "gelu":
+            out = jax.nn.gelu(out)
+        elif activation_type == "relu":
+            out = jax.nn.relu(out)
+        return out.astype(out_dt)
+
+    if bias is not None:
+        return apply_fn("fp8_gemm_fused", fn, x, y, bias)
+    return apply_fn("fp8_gemm_fused", fn, x, y)
+
+
+# re-exports so paddle.linalg.* matches the reference namespace
+from .extras import cholesky_inverse, lu_unpack, ormqr, svd_lowrank  # noqa: E402,F401

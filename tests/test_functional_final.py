@@ -1,0 +1,149 @@
+"""Final nn.functional + linalg breadth tests (sequence_mask, spatial
+transformer ops, PartialFC sampling, sparse attention, packed flash,
+inplace activations, matrix_exp, fp8 gemm)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.nn import functional as F
+
+T = paddle.to_tensor
+
+
+def test_sequence_mask_and_zeropad():
+    m = F.sequence_mask(T(np.array([2, 4])), maxlen=5)
+    np.testing.assert_array_equal(
+        m.numpy(), [[1, 1, 0, 0, 0], [1, 1, 1, 1, 0]])
+    z = F.zeropad2d(T(np.ones((1, 2, 3, 3), np.float32)), [1, 1, 1, 1])
+    assert z.shape == [1, 2, 5, 5]
+    assert z.numpy()[0, 0, 0, 0] == 0
+
+
+def test_affine_grid_sample_identity():
+    theta = np.array([[[1, 0, 0], [0, 1, 0]]], np.float32)
+    grid = F.affine_grid(T(theta), [1, 1, 5, 5])
+    img = T(np.arange(25, dtype=np.float32).reshape(1, 1, 5, 5))
+    out = F.grid_sample(img, grid)
+    np.testing.assert_allclose(out.numpy(), img.numpy(), atol=1e-4)
+
+
+def test_grid_sample_shift():
+    # shift grid half a pixel right -> bilinear interpolates neighbors
+    theta = np.array([[[1, 0, 0.5], [0, 1, 0]]], np.float32)
+    img = T(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    grid = F.affine_grid(T(theta), [1, 1, 4, 4])
+    out = F.grid_sample(img, grid).numpy()
+    assert np.isfinite(out).all()
+
+
+def test_margin_cross_entropy_reduces_target_prob():
+    rng = np.random.default_rng(0)
+    logits = np.random.uniform(-1, 1, (4, 10)).astype(np.float32)
+    y = np.array([1, 2, 3, 4])
+    with_margin = float(F.margin_cross_entropy(T(logits), T(y)))
+    no_margin = float(F.margin_cross_entropy(T(logits), T(y), margin1=1.0,
+                                             margin2=0.0, margin3=0.0))
+    assert with_margin > no_margin  # margin makes the target harder
+
+
+def test_npair_loss_finite():
+    rng = np.random.default_rng(1)
+    l = F.npair_loss(T(rng.random((4, 8)).astype(np.float32)),
+                     T(rng.random((4, 8)).astype(np.float32)),
+                     T(np.array([0, 1, 0, 1])))
+    assert np.isfinite(float(l))
+
+
+def test_gather_tree_backtrace():
+    # time 1: beam0's parent is beam1 -> its time-0 token must be ids[0,b,1]
+    ids = np.array([[[1, 2]], [[3, 4]]], np.int32)
+    par = np.array([[[0, 0]], [[1, 0]]], np.int32)
+    out = F.gather_tree(T(ids), T(par)).numpy()
+    assert out[1, 0, 0] == 3 and out[0, 0, 0] == 2  # beam0 traces through p=1
+
+
+def test_temporal_shift_moves_channels():
+    x = np.random.rand(4, 8, 3, 3).astype(np.float32)
+    out = F.temporal_shift(T(x), seg_num=2).numpy()
+    v = x.reshape(2, 2, 8, 3, 3)
+    o = out.reshape(2, 2, 8, 3, 3)
+    np.testing.assert_allclose(o[:, 0, :2], v[:, 1, :2])  # left-shifted fold
+    np.testing.assert_allclose(o[:, 1, 2:4], v[:, 0, 2:4])  # right-shifted
+    np.testing.assert_allclose(o[:, :, 4:], v[:, :, 4:])  # rest untouched
+
+
+def test_class_center_sample_includes_positives():
+    paddle.seed(0)
+    rem, chosen = F.class_center_sample(T(np.array([3, 7])), 16, 6)
+    ch = chosen.numpy()
+    assert 3 in ch and 7 in ch and len(ch) == 6
+    # remapped labels index into chosen
+    r = rem.numpy()
+    assert ch[r[0]] == 3 and ch[r[1]] == 7
+
+
+def test_sparse_attention_full_pattern_matches_dense():
+    rng = np.random.default_rng(2)
+    q = rng.random((1, 2, 4, 8)).astype(np.float32)
+    off = np.tile(np.array([0, 4, 8, 12, 16], np.int32), (1, 2, 1))
+    cols = np.tile(np.tile(np.arange(4, dtype=np.int32), 4), (1, 2, 1))
+    out = F.sparse_attention(T(q), T(q), T(q), T(off), T(cols))
+    from paddle_tpu.nn.functional.flash_attention import _xla_attention
+    import jax.numpy as jnp
+
+    ref = _xla_attention(jnp.swapaxes(jnp.asarray(q), 1, 2),
+                         jnp.swapaxes(jnp.asarray(q), 1, 2),
+                         jnp.swapaxes(jnp.asarray(q), 1, 2), causal=False)
+    np.testing.assert_allclose(out.numpy(), np.swapaxes(np.asarray(ref), 1, 2),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_sparse_attention_per_head_patterns():
+    """Different heads with different CSR patterns must differ in output."""
+    rng = np.random.default_rng(4)
+    q = rng.random((1, 2, 4, 8)).astype(np.float32)
+    # head 0: full rows; head 1: diagonal only
+    off = np.stack([[np.array([0, 4, 8, 12, 16], np.int32),
+                     np.array([0, 1, 2, 3, 4], np.int32)]])
+    cols = np.stack([[np.tile(np.arange(4, dtype=np.int32), 4),
+                      np.concatenate([np.arange(4, dtype=np.int32),
+                                      np.zeros(12, np.int32)])]])
+    out = F.sparse_attention(T(q), T(q), T(q), T(off), T(cols)).numpy()
+    # head 1 diag-only: each position attends only itself -> out == v
+    np.testing.assert_allclose(out[0, 1], q[0, 1], rtol=1e-5)
+    assert not np.allclose(out[0, 0], q[0, 0])
+
+
+def test_packed_flash_variants():
+    rng = np.random.default_rng(3)
+    qkv = T(rng.random((1, 16, 3, 2, 8)).astype(np.float32))
+    o, _ = F.flash_attn_qkvpacked(qkv, causal=True)
+    assert o.shape == [1, 16, 2, 8]
+    pk = T(rng.random((10, 3, 2, 8)).astype(np.float32))
+    ov, _ = F.flash_attn_varlen_qkvpacked(pk, T(np.array([0, 4, 10])), None, 6, 6)
+    assert ov.shape == [10, 2, 8]
+    # per-sequence isolation: tokens of seq 0 see only seq 0
+    ref0, _ = F.flash_attn_qkvpacked(T(pk.numpy()[None, :4]), causal=False)
+    np.testing.assert_allclose(ov.numpy()[:4], ref0.numpy()[0], rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_inplace_activations():
+    x = T(np.array([-1.0, 2.0], np.float32))
+    F.leaky_relu_(x)
+    np.testing.assert_allclose(x.numpy(), [-0.01, 2.0])
+    y = T(np.array([0.5], np.float32))
+    F.tanh_(y)
+    np.testing.assert_allclose(y.numpy(), np.tanh([0.5]), rtol=1e-6)
+
+
+def test_linalg_namespace_completions():
+    me = paddle.linalg.matrix_exp(T(np.zeros((2, 2), np.float32)))
+    np.testing.assert_allclose(me.numpy(), np.eye(2))
+    g8 = paddle.linalg.fp8_fp8_half_gemm_fused(
+        T(np.ones((2, 4), np.float32)), T(np.ones((4, 3), np.float32)))
+    assert str(g8.dtype) == "float16"
+    np.testing.assert_allclose(np.asarray(g8.numpy(), np.float32), 4.0)
+    assert hasattr(paddle.linalg, "svd_lowrank")
+    assert hasattr(paddle.linalg, "cholesky_inverse")
