@@ -487,28 +487,58 @@ class LlamaForCausalLM(Layer):
             tok = sample(logits[:, -1].astype(jnp.float32), skey)
             return tok, cs
 
+        def decode_block(ps, tok, cs, pos0, pad_bias, rope_offset, skey,
+                         finished, eos, n_steps):
+            """n_steps decode iterations inside ONE program (lax.scan) —
+            per-call dispatch is the decode bottleneck through a remote
+            runtime, so it must be amortized. eos rows keep emitting eos."""
+
+            def body(carry, i):
+                tok, cs, k, fin = carry
+                k, sk = jax.random.split(k)
+                nxt, cs = run_chunk(ps, tok[:, None], cs, pos0 + i,
+                                    pad_bias, rope_offset, sk)
+                if eos is not None:
+                    nxt = jnp.where(fin, eos, nxt)
+                    fin = fin | (nxt == eos)
+                return (nxt, cs, k, fin), nxt
+
+            (tok, cs, skey, finished), toks = jax.lax.scan(
+                body, (tok, cs, skey, finished), jnp.arange(n_steps))
+            return jnp.swapaxes(toks, 0, 1), tok, cs, skey, finished
+
+        # NOTE: no donate_argnums — buffer donation through the remote-compile
+        # tunnel forces a slow path (measured 10x per-step cost); the extra
+        # cache copy is cheap relative to that
         prefill = jax.jit(run_chunk)
-        step = jax.jit(run_chunk, donate_argnums=(2,))
+        block = jax.jit(decode_block, static_argnames=("eos", "n_steps"))
         if cache is None:
             cache = self._gen_fns = {}
-        cache[key] = (prefill, step)
-        return prefill, step
+        cache[key] = (prefill, block)
+        return prefill, block
 
     def generate(self, input_ids, max_new_tokens: int = 32,
                  temperature: float = 1.0, top_p: float = None,
                  eos_token_id: int = None, seed: int = 0,
-                 attention_mask=None):
+                 attention_mask=None, max_length: int = None):
         """KV-cache autoregressive generation (greedy / temperature / top-p).
 
         TPU-native decode: one jitted prefill (whole prompt through the cache
-        path) + one jitted single-token step with donated caches (in-place in
-        HBM); sampling is fused into the jitted step. Batches of unequal
+        path), then 16-token jitted lax.scan blocks — per-call dispatch is
+        the decode bottleneck through a remote runtime, so steps are batched
+        into one program (caches NOT donated: see the note in _decode_fns).
+        Sampling is fused into the jitted program. Batches of unequal
         prompt lengths use LEFT padding + ``attention_mask`` [b, prompt_len]
         (1 = real): pad columns are bias-masked out of attention and RoPE
         positions shift per row so each prompt starts at position 0.
 
         Always returns [b, max_new_tokens]; rows that hit ``eos_token_id``
         early are padded out with eos (static shape for downstream stacking).
+
+        ``max_length`` pins the KV-cache length (>= prompt + new tokens):
+        serving should pass a fixed bucket so repeated calls with varying
+        lengths reuse the same compiled programs instead of recompiling per
+        cache shape.
         """
         from ...jit.api import _collect_state
 
@@ -516,7 +546,12 @@ class LlamaForCausalLM(Layer):
         ids = input_ids._data if isinstance(input_ids, Tensor) else jnp.asarray(input_ids)
         ids = ids.astype(jnp.int32)
         b, prompt_len = ids.shape
-        max_len = prompt_len + max_new_tokens
+        max_len = (max_length if max_length is not None
+                   else prompt_len + max_new_tokens)
+        if max_len < prompt_len + max_new_tokens:
+            raise ValueError(
+                f"max_length {max_len} < prompt {prompt_len} + "
+                f"max_new_tokens {max_new_tokens}")
         _, tensors = _collect_state(self)
         params = [t._data for t in tensors]
         kvh, hd = cfg.num_key_value_heads, cfg.head_dim
@@ -536,7 +571,7 @@ class LlamaForCausalLM(Layer):
                     "generate() expects LEFT-padded prompts: attention_mask "
                     "must be 0...01...1 per row (pads strictly before tokens)")
             pad_cols = jnp.concatenate(
-                [m == 0, jnp.zeros((b, max_new_tokens), bool)], axis=1)
+                [m == 0, jnp.zeros((b, max_len - prompt_len), bool)], axis=1)
             pad_bias = jnp.where(pad_cols, -1e9, 0.0)[:, None, None, :]
             rope_offset = (prompt_len - m.sum(-1)).astype(jnp.int32)
         else:
@@ -544,26 +579,28 @@ class LlamaForCausalLM(Layer):
             pad_bias = None
             rope_offset = None
 
-        prefill, step = self._decode_fns(temperature, top_p)
+        prefill, block = self._decode_fns(temperature, top_p)
         key = jax.random.key(seed)
         key, sk = jax.random.split(key)
         tok, caches = prefill(params, ids, caches, 0, pad_bias, rope_offset, sk)
-        out_tokens = [tok]
+        chunks = [tok[:, None]]
         finished = jnp.zeros((b,), bool)
         if eos_token_id is not None:
             finished = finished | (tok == eos_token_id)
-        for i in range(1, max_new_tokens):
+        # decode in fixed-size jitted blocks (one XLA program per 16 tokens);
+        # the last partial block uses its own (cached) n_steps trace
+        done = 1
+        BLOCK = 16
+        while done < max_new_tokens:
             if eos_token_id is not None and bool(finished.all()):
                 break
-            key, sk = jax.random.split(key)
-            nxt, caches = step(params, tok[:, None], caches,
-                               prompt_len + i - 1, pad_bias, rope_offset, sk)
-            if eos_token_id is not None:
-                nxt = jnp.where(finished, eos_token_id, nxt)
-                finished = finished | (nxt == eos_token_id)
-            tok = nxt
-            out_tokens.append(tok)
-        out = jnp.stack(out_tokens, axis=1)
+            n = min(BLOCK, max_new_tokens - done)
+            toks, tok, caches, key, finished = block(
+                params, tok, caches, prompt_len + done - 1, pad_bias,
+                rope_offset, key, finished, eos_token_id, n)
+            chunks.append(toks)
+            done += n
+        out = jnp.concatenate(chunks, axis=1)
         if out.shape[1] < max_new_tokens:
             # eos early-stop: pad to the requested static shape with eos
             pad = jnp.full((b, max_new_tokens - out.shape[1]), eos_token_id,

@@ -78,6 +78,21 @@ def test_left_padded_batch_matches_unpadded(model):
     np.testing.assert_array_equal(got[1], ref2[0])
 
 
+def test_max_length_bucket_with_mask(model):
+    """max_length bucket + attention_mask: bias widths must line up."""
+    cfg, m = model
+    ids = np.random.default_rng(6).integers(1, cfg.vocab_size, (2, 5)).astype(np.int32)
+    mask = np.array([[1, 1, 1, 1, 1], [0, 0, 1, 1, 1]], np.int32)
+    out = m.generate(paddle.to_tensor(ids), max_new_tokens=4, temperature=0.0,
+                     attention_mask=paddle.to_tensor(mask), max_length=32)
+    ref = m.generate(paddle.to_tensor(ids), max_new_tokens=4, temperature=0.0,
+                     attention_mask=paddle.to_tensor(mask))
+    np.testing.assert_array_equal(out.numpy(), ref.numpy())
+    with pytest.raises(ValueError, match="max_length"):
+        m.generate(paddle.to_tensor(ids), max_new_tokens=40, temperature=0.0,
+                   max_length=8)
+
+
 def test_right_padding_rejected(model):
     cfg, m = model
     ids = np.ones((1, 4), np.int32)
