@@ -16,6 +16,7 @@ from ...core.tensor import Tensor
 from ...distributed.auto_parallel.logical_sharding import annotate, constrain
 from ...nn import initializer as I
 from ...nn.layer.layers import Layer, LayerList
+from ..generation_utils import GenerationMixin
 from ..llama.modeling import _attention, _raw
 
 
@@ -70,6 +71,29 @@ class GPTLayerNorm(Layer):
 
 
 class GPTAttention(Layer):
+    def decode_step(self, x, k_cache, v_cache, pos, pad_bias=None):
+        """KV-cache attention for generation (prefill AND decode)."""
+        from ..generation_utils import causal_cache_bias
+        from ...nn.functional.flash_attention import _xla_attention
+
+        x = _raw(x)
+        b, s, h = x.shape
+        hd = self.config.head_dim
+        qkv = jnp.matmul(x, self.qkv_weight._data) + self.qkv_bias._data
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, s, self.num_heads, hd)
+        k = k.reshape(b, s, self.num_heads, hd)
+        v = v.reshape(b, s, self.num_heads, hd)
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (0, pos, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (0, pos, 0, 0))
+        bias = causal_cache_bias(k_cache, pos, s, pad_bias)
+        out = _xla_attention(q, k_cache, v_cache, bias=bias, causal=False)
+        out = out.reshape(b, s, h)
+        return (jnp.matmul(out, self.out_weight._data)
+                + self.out_bias._data, k_cache, v_cache)
+
     def __init__(self, config: GPTConfig):
         super().__init__()
         self.config = config
@@ -136,6 +160,15 @@ class GPTDecoderLayer(Layer):
         return constrain(x, "batch", "seq", "embed")
 
 
+    def decode_step(self, hidden, k_cache, v_cache, pos, pad_bias=None):
+        x = _raw(hidden)
+        a, k_cache, v_cache = self.attn.decode_step(
+            self.ln_1(x), k_cache, v_cache, pos, pad_bias)
+        x = x + a
+        x = x + self.mlp(self.ln_2(x))
+        return x, k_cache, v_cache
+
+
 class GPTModel(Layer):
     def __init__(self, config: GPTConfig):
         super().__init__()
@@ -165,7 +198,7 @@ class GPTModel(Layer):
         return self.ln_f(x)
 
 
-class GPTForCausalLM(Layer):
+class GPTForCausalLM(GenerationMixin, Layer):
     def __init__(self, config: GPTConfig):
         super().__init__()
         self.config = config
@@ -183,3 +216,32 @@ class GPTForCausalLM(Layer):
 
     def loss_fn(self, input_ids, labels):
         return self.forward(input_ids, labels)
+
+
+    # ---- generation hooks (GenerationMixin; default _init_caches) ----
+    def _validate_generate(self, prompt_len, total_len):
+        if total_len > self.config.max_position_embeddings:
+            raise ValueError(
+                f"GPT learned position table holds "
+                f"{self.config.max_position_embeddings} positions; prompt + "
+                f"max_new_tokens = {total_len} exceeds it")
+
+    def _decode_chunk(self, ids, caches, pos, pad_bias, pos_offset):
+        ids = _raw(ids)
+        b, s = ids.shape
+        x = jnp.take(self.gpt.wte._data, ids, axis=0)
+        if pos_offset is None:
+            wpe = jax.lax.dynamic_slice_in_dim(self.gpt.wpe._data, pos, s, 0)
+            x = x + wpe[None]
+        else:
+            positions = jnp.clip(pos + jnp.arange(s)[None, :]
+                                 - pos_offset[:, None], 0,
+                                 self.config.max_position_embeddings - 1)
+            x = x + self.gpt.wpe._data[positions]
+        new_caches = []
+        for layer, (kc, vc) in zip(self.gpt.layers, caches):
+            x, kc, vc = layer.decode_step(x, kc, vc, pos, pad_bias)
+            new_caches.append((kc, vc))
+        hidden = _raw(self.gpt.ln_f(x))
+        logits = jnp.matmul(hidden[:, -1], self.gpt.wte._data.T)
+        return logits.astype(jnp.float32), new_caches

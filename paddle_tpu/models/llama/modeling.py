@@ -26,6 +26,7 @@ from ...distributed.auto_parallel.logical_sharding import annotate, constrain, c
 from ...nn import functional as F
 from ...nn import initializer as I
 from ...nn.layer.layers import Layer, LayerList
+from ..generation_utils import GenerationMixin, causal_cache_bias
 
 
 class LlamaConfig:
@@ -181,13 +182,7 @@ class LlamaAttention(Layer):
                                                (0, pos, 0, 0))
         v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype),
                                                (0, pos, 0, 0))
-        # mask: chunk row i (absolute pos+i) may see cache cols j <= pos+i
-        max_len = k_cache.shape[1]
-        cols = jnp.arange(max_len)[None, :]
-        rows = pos + jnp.arange(s)[:, None]
-        bias = jnp.where(cols <= rows, 0.0, -1e9)[None, None]  # [1,1,s,max_len]
-        if pad_bias is not None:
-            bias = bias + pad_bias
+        bias = causal_cache_bias(k_cache, pos, s, pad_bias)
         from ...nn.functional.flash_attention import _xla_attention
 
         out = _xla_attention(q, k_cache, v_cache, bias=bias, causal=False)
@@ -409,7 +404,7 @@ def _decode_model(model: "LlamaModel", ids, caches, pos, pad_bias=None,
     return model.norm(x), new_caches
 
 
-class LlamaForCausalLM(Layer):
+class LlamaForCausalLM(GenerationMixin, Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__()
         self.config = config
@@ -447,166 +442,13 @@ class LlamaForCausalLM(Layer):
             return 0.0
         return getattr(self.model, "_moe_aux", 0.0)
 
-    def _decode_fns(self, temperature, top_p):
-        """Jitted prefill/step closures, cached on the model — repeated
-        generate() calls with the same shapes hit jax.jit's trace cache."""
-        key = (float(temperature), top_p)
-        cache = getattr(self, "_gen_fns", None)
-        if cache is not None and key in cache:
-            return cache[key]
-        from ...core import autograd_engine
-        from ...jit.api import _Swap, _collect_state
-
-        _, tensors = _collect_state(self)
-
-        def sample(logits, skey):
-            if temperature == 0.0:
-                return jnp.argmax(logits, -1).astype(jnp.int32)
-            logits = logits / max(temperature, 1e-6)
-            if top_p is not None:
-                sort_idx = jnp.argsort(-logits, axis=-1)
-                sorted_p = jax.nn.softmax(
-                    jnp.take_along_axis(logits, sort_idx, -1), -1)
-                cum = jnp.cumsum(sorted_p, -1)
-                keep = cum - sorted_p <= top_p
-                masked = jnp.where(
-                    keep, jnp.take_along_axis(logits, sort_idx, -1), -1e9)
-                choice = jax.random.categorical(skey, masked, axis=-1)
-                return jnp.take_along_axis(
-                    sort_idx, choice[:, None], -1)[:, 0].astype(jnp.int32)
-            return jax.random.categorical(skey, logits, -1).astype(jnp.int32)
-
-        def run_chunk(ps, chunk, cs, pos, pad_bias, rope_offset, skey):
-            with autograd_engine.no_grad(), _Swap(tensors, ps):
-                hidden, cs = _decode_model(self.model, chunk, cs, pos,
-                                           pad_bias, rope_offset)
-                hidden = hidden._data if isinstance(hidden, Tensor) else hidden
-                # lm head only on the position we sample from — a 2k-token
-                # prompt must not pay 2k x vocab logits
-                logits = self.logits(hidden[:, -1:])
-            tok = sample(logits[:, -1].astype(jnp.float32), skey)
-            return tok, cs
-
-        def decode_block(ps, tok, cs, pos0, pad_bias, rope_offset, skey,
-                         finished, eos, n_steps):
-            """n_steps decode iterations inside ONE program (lax.scan) —
-            per-call dispatch is the decode bottleneck through a remote
-            runtime, so it must be amortized. eos rows keep emitting eos."""
-
-            def body(carry, i):
-                tok, cs, k, fin = carry
-                k, sk = jax.random.split(k)
-                nxt, cs = run_chunk(ps, tok[:, None], cs, pos0 + i,
-                                    pad_bias, rope_offset, sk)
-                if eos is not None:
-                    nxt = jnp.where(fin, eos, nxt)
-                    fin = fin | (nxt == eos)
-                return (nxt, cs, k, fin), nxt
-
-            (tok, cs, skey, finished), toks = jax.lax.scan(
-                body, (tok, cs, skey, finished), jnp.arange(n_steps))
-            return jnp.swapaxes(toks, 0, 1), tok, cs, skey, finished
-
-        # NOTE: no donate_argnums — buffer donation through the remote-compile
-        # tunnel forces a slow path (measured 10x per-step cost); the extra
-        # cache copy is cheap relative to that
-        prefill = jax.jit(run_chunk)
-        block = jax.jit(decode_block, static_argnames=("eos", "n_steps"))
-        if cache is None:
-            cache = self._gen_fns = {}
-        cache[key] = (prefill, block)
-        return prefill, block
-
-    def generate(self, input_ids, max_new_tokens: int = 32,
-                 temperature: float = 1.0, top_p: float = None,
-                 eos_token_id: int = None, seed: int = 0,
-                 attention_mask=None, max_length: int = None):
-        """KV-cache autoregressive generation (greedy / temperature / top-p).
-
-        TPU-native decode: one jitted prefill (whole prompt through the cache
-        path), then 16-token jitted lax.scan blocks — per-call dispatch is
-        the decode bottleneck through a remote runtime, so steps are batched
-        into one program (caches NOT donated: see the note in _decode_fns).
-        Sampling is fused into the jitted program. Batches of unequal
-        prompt lengths use LEFT padding + ``attention_mask`` [b, prompt_len]
-        (1 = real): pad columns are bias-masked out of attention and RoPE
-        positions shift per row so each prompt starts at position 0.
-
-        Always returns [b, max_new_tokens]; rows that hit ``eos_token_id``
-        early are padded out with eos (static shape for downstream stacking).
-
-        ``max_length`` pins the KV-cache length (>= prompt + new tokens):
-        serving should pass a fixed bucket so repeated calls with varying
-        lengths reuse the same compiled programs instead of recompiling per
-        cache shape.
-        """
-        from ...jit.api import _collect_state
-
-        cfg = self.config
-        ids = input_ids._data if isinstance(input_ids, Tensor) else jnp.asarray(input_ids)
-        ids = ids.astype(jnp.int32)
-        b, prompt_len = ids.shape
-        max_len = (max_length if max_length is not None
-                   else prompt_len + max_new_tokens)
-        if max_len < prompt_len + max_new_tokens:
-            raise ValueError(
-                f"max_length {max_len} < prompt {prompt_len} + "
-                f"max_new_tokens {max_new_tokens}")
-        _, tensors = _collect_state(self)
-        params = [t._data for t in tensors]
-        kvh, hd = cfg.num_key_value_heads, cfg.head_dim
-        dtype = params[0].dtype
-        caches = [(jnp.zeros((b, max_len, kvh, hd), dtype),
-                   jnp.zeros((b, max_len, kvh, hd), dtype))
-                  for _ in range(cfg.num_hidden_layers)]
-
-        if attention_mask is not None:
-            m = (attention_mask._data if isinstance(attention_mask, Tensor)
-                 else jnp.asarray(attention_mask)).astype(jnp.int32)
-            # contiguous LEFT padding only: per-row non-decreasing mask whose
-            # last column is real (interior holes would break the rope_offset
-            # arithmetic silently)
-            if bool((m[:, -1] == 0).any()) or bool((jnp.diff(m, axis=1) < 0).any()):
-                raise ValueError(
-                    "generate() expects LEFT-padded prompts: attention_mask "
-                    "must be 0...01...1 per row (pads strictly before tokens)")
-            pad_cols = jnp.concatenate(
-                [m == 0, jnp.zeros((b, max_len - prompt_len), bool)], axis=1)
-            pad_bias = jnp.where(pad_cols, -1e9, 0.0)[:, None, None, :]
-            rope_offset = (prompt_len - m.sum(-1)).astype(jnp.int32)
-        else:
-            # unpadded: None keeps the cheap shared-RoPE / no-bias trace paths
-            pad_bias = None
-            rope_offset = None
-
-        prefill, block = self._decode_fns(temperature, top_p)
-        key = jax.random.key(seed)
-        key, sk = jax.random.split(key)
-        tok, caches = prefill(params, ids, caches, 0, pad_bias, rope_offset, sk)
-        chunks = [tok[:, None]]
-        finished = jnp.zeros((b,), bool)
-        if eos_token_id is not None:
-            finished = finished | (tok == eos_token_id)
-        # decode in fixed-size jitted blocks (one XLA program per 16 tokens);
-        # the last partial block uses its own (cached) n_steps trace
-        done = 1
-        BLOCK = 16
-        while done < max_new_tokens:
-            if eos_token_id is not None and bool(finished.all()):
-                break
-            n = min(BLOCK, max_new_tokens - done)
-            toks, tok, caches, key, finished = block(
-                params, tok, caches, prompt_len + done - 1, pad_bias,
-                rope_offset, key, finished, eos_token_id, n)
-            chunks.append(toks)
-            done += n
-        out = jnp.concatenate(chunks, axis=1)
-        if out.shape[1] < max_new_tokens:
-            # eos early-stop: pad to the requested static shape with eos
-            pad = jnp.full((b, max_new_tokens - out.shape[1]), eos_token_id,
-                           jnp.int32)
-            out = jnp.concatenate([out, pad], axis=1)
-        return Tensor(out)
+    def _decode_chunk(self, ids, caches, pos, pad_bias, pos_offset):
+        hidden, caches = _decode_model(self.model, ids, caches, pos,
+                                       pad_bias, pos_offset)
+        hidden = hidden._data if isinstance(hidden, Tensor) else hidden
+        # lm head only on the position we sample from
+        logits = self.logits(hidden[:, -1:])
+        return logits[:, -1].astype(jnp.float32), caches
 
     def loss_fn(self, input_ids, labels):
         """Raw-array loss for jit'ed training steps."""

@@ -102,6 +102,52 @@ def test_right_padding_rejected(model):
                    attention_mask=paddle.to_tensor(mask))
 
 
+@pytest.fixture(scope="module")
+def gpt_model():
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    paddle.seed(5)
+    cfg = GPTConfig.tiny(num_hidden_layers=2)
+    return cfg, GPTForCausalLM(cfg)
+
+
+def test_gpt_greedy_generation_matches_naive(gpt_model):
+    cfg, m = gpt_model
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (2, 6)).astype(np.int32)
+    got = m.generate(paddle.to_tensor(ids), max_new_tokens=5,
+                     temperature=0.0).numpy()
+    ref = _naive_greedy(m, ids, 5)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_gpt_left_padded_matches_unpadded(gpt_model):
+    """GPT's learned-position left-pad arithmetic must match per-row
+    unpadded decoding (no llama analogue: positions come from a table)."""
+    cfg, m = gpt_model
+    rng = np.random.default_rng(7)
+    p1 = rng.integers(1, cfg.vocab_size, (1, 5)).astype(np.int32)
+    p2 = rng.integers(1, cfg.vocab_size, (1, 3)).astype(np.int32)
+    padded = np.vstack([p1, np.concatenate([np.zeros((1, 2), np.int32), p2], 1)])
+    mask = np.array([[1, 1, 1, 1, 1], [0, 0, 1, 1, 1]], np.int32)
+    got = m.generate(paddle.to_tensor(padded), max_new_tokens=4,
+                     temperature=0.0,
+                     attention_mask=paddle.to_tensor(mask)).numpy()
+    ref1 = m.generate(paddle.to_tensor(p1), max_new_tokens=4,
+                      temperature=0.0).numpy()
+    ref2 = m.generate(paddle.to_tensor(p2), max_new_tokens=4,
+                      temperature=0.0).numpy()
+    np.testing.assert_array_equal(got[0], ref1[0])
+    np.testing.assert_array_equal(got[1], ref2[0])
+
+
+def test_gpt_position_table_overflow_rejected(gpt_model):
+    cfg, m = gpt_model
+    ids = np.ones((1, cfg.max_position_embeddings - 2), np.int32)
+    with pytest.raises(ValueError, match="position table"):
+        m.generate(paddle.to_tensor(ids), max_new_tokens=8, temperature=0.0)
+
+
 def test_top_p_sampling_generation(model):
     cfg, m = model
     ids = np.random.default_rng(2).integers(0, cfg.vocab_size, (2, 4)).astype(np.int32)
