@@ -12,6 +12,7 @@ import jax.numpy as jnp
 from ..core.op_registry import apply_fn
 from ..core.tensor import Tensor, unwrap
 from . import creation, extras, linalg, manipulation, math, random, search
+from . import toplevel_extras
 from .creation import *  # noqa: F401,F403
 from .extras import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
@@ -19,6 +20,7 @@ from .manipulation import *  # noqa: F401,F403
 from .math import *  # noqa: F401,F403
 from .random import *  # noqa: F401,F403
 from .search import *  # noqa: F401,F403
+from .toplevel_extras import *  # noqa: F401,F403
 
 
 def einsum(equation, *operands):
@@ -182,3 +184,8 @@ def _install():
 
 
 _install()
+
+# generate the <op>_ in-place family from the installed functional ops and
+# re-export them at module level (reference: paddle top-level *_ exports)
+_inplace_fns = toplevel_extras.install_inplace_variants(globals())
+globals().update(_inplace_fns)
