@@ -49,14 +49,15 @@ def main():
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
 
     if on_tpu:
-        # head_dim 128 (llama-standard) fills the 128x128 MXU; the tuned
-        # Pallas flash kernels make remat unnecessary at this batch (v5e
-        # 16G HBM): profiled 0.55 MFU vs 0.16 at the old 16-head/remat config
+        # hidden 2048 / head_dim 128: large MXU-filling matmuls (profiled
+        # 0.64 MFU vs 0.55 at hidden 1024 and 0.16 at the original
+        # 16-head/remat config); tuned Pallas flash kernels, no remat
+        # (fits v5e 16G HBM at batch 4)
         cfg = LlamaConfig(
-            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
-            num_hidden_layers=24, num_attention_heads=8, num_key_value_heads=8,
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            num_hidden_layers=12, num_attention_heads=16, num_key_value_heads=16,
             max_position_embeddings=2048, dtype="bfloat16", recompute=False)
-        batch, seq, iters = 8, 2048, 10
+        batch, seq, iters = 4, 2048, 10
     else:
         cfg = LlamaConfig.tiny(recompute=True)
         batch, seq, iters = 4, 128, 3
@@ -94,7 +95,7 @@ def main():
     print(json.dumps({
         "metric": "llama_pretrain_tokens_per_sec_per_chip",
         "value": round(tok_per_sec, 2),
-        "unit": f"tokens/s ({'llama-460M bf16 seq2048' if on_tpu else 'tiny cpu'}, "
+        "unit": f"tokens/s ({'llama-750M bf16 seq2048' if on_tpu else 'tiny cpu'}, "
                 f"loss {float(loss):.3f}, mfu {mfu:.3f})",
         "vs_baseline": round(mfu / 0.40, 4),
     }))

@@ -155,25 +155,20 @@ def _install():
                 setattr(T, name, methods[name])
 
     # in-place variants: rebind payload, preserve graph semantics
-    def _make_inplace(fn):
-        def inplace(self, *args, **kwargs):
-            out = fn(self, *args, **kwargs)
-            return self._replace_(out._data, out._node, out._out_idx)
-
-        return inplace
-
+    # ONE in-place wrapper implementation (toplevel_extras._make_inplace)
     for name in ["add", "subtract", "multiply", "divide", "clip", "scale", "exp", "sqrt",
                  "rsqrt", "floor", "ceil", "round", "reciprocal", "tanh", "sigmoid",
                  "cast", "flatten", "squeeze", "unsqueeze", "transpose"]:
         if name in methods:
-            setattr(T, name + "_", _make_inplace(methods[name]))
+            setattr(T, name + "_",
+                    toplevel_extras._make_inplace(methods[name], name + "_"))
 
     def astype(self, dtype):
         return manipulation.cast(self, dtype)
 
     T.astype = astype
     T.mm = methods["matmul"]
-    T.abs_ = _make_inplace(methods["abs"])
+    T.abs_ = toplevel_extras._make_inplace(methods["abs"], "abs_")
     T.zero_ = lambda s: s.set_value(jnp.zeros_like(s._data))
     T.fill_ = lambda s, v: s.set_value(jnp.full_like(s._data, v))
     T.numel = lambda s: creation.numel(s)

@@ -52,6 +52,8 @@ def pdist(x, p=2.0, name=None):
         d = a[:, None] - a[None]
         if p == 2.0:
             full = jnp.sqrt(jnp.maximum(jnp.sum(d * d, -1), 0.0))
+        elif p == float("inf"):
+            full = jnp.max(jnp.abs(d), -1)
         else:
             full = jnp.sum(jnp.abs(d) ** p, -1) ** (1.0 / p)
         iu = jnp.triu_indices(n, k=1)
@@ -60,13 +62,13 @@ def pdist(x, p=2.0, name=None):
     return apply_fn("pdist", fn, x)
 
 
-def _stack_family(name, fn):
-    def f(x, name_arg=None):
+def _stack_family(op_name, fn):
+    def f(x, name=None):
         args = [t if isinstance(t, Tensor) else Tensor(np.asarray(t))
                 for t in x]
-        return apply_fn(name, lambda *a: fn(a), *args)
+        return apply_fn(op_name, lambda *a: fn(a), *args)
 
-    f.__name__ = name
+    f.__name__ = op_name
     return f
 
 
@@ -74,7 +76,7 @@ hstack = _stack_family("hstack", jnp.hstack)
 vstack = _stack_family("vstack", jnp.vstack)
 dstack = _stack_family("dstack", jnp.dstack)
 column_stack = _stack_family("column_stack", jnp.column_stack)
-row_stack = _stack_family("row_stack", jnp.vstack)
+row_stack = vstack  # reference alias
 
 
 def cartesian_prod(x, name=None):
@@ -150,6 +152,8 @@ class LazyGuard:
 def check_shape(x, expected):
     got = list(unwrap(x).shape)
     exp = [int(s) if s is not None else None for s in expected]
+    if len(got) != len(exp):
+        raise ValueError(f"rank mismatch: got {got}, expected {exp}")
     for g, e in zip(got, exp):
         if e is not None and e != -1 and g != e:
             raise ValueError(f"shape mismatch: got {got}, expected {exp}")
@@ -225,12 +229,14 @@ def _make_inplace(base_fn, name):
 
 
 def _random_fill(name, sampler):
-    """In-place random fill: x is overwritten with samples of its shape."""
+    """In-place random fill: x is overwritten with samples of its shape.
+    Goes through _replace_ so the stale autograd node is dropped — the new
+    value no longer depends on x's producers."""
 
     def f(x, *args, **kwargs):
         kwargs.pop("name", None)
-        x._data = sampler(tuple(x.shape), *args, **kwargs).astype(x.dtype)
-        return x
+        new = sampler(tuple(x.shape), *args, **kwargs).astype(x.dtype)
+        return x._replace_(new)
 
     f.__name__ = name
     return f
@@ -248,9 +254,11 @@ cauchy_ = _random_fill(
 
 
 def _geometric_sample(shp, probs):
+    # continuous log(u)/log1p(-p), matching the reference's
+    # x.uniform_().log_().divide_(log1p(-p)) (creation.py geometric_ — no floor)
     p = unwrap(probs) if isinstance(probs, Tensor) else jnp.asarray(float(probs))
     u = jax.random.uniform(next_key(), shp, minval=1e-7)
-    return jnp.floor(jnp.log(u) / jnp.log1p(-p))
+    return jnp.log(u) / jnp.log1p(-p)
 
 
 geometric_ = _random_fill("geometric_", _geometric_sample)
