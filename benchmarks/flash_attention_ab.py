@@ -1,0 +1,69 @@
+"""A/B: in-repo Pallas flash fwd+bwd vs jax library kernel vs XLA recompute."""
+import time, functools, os
+import jax, jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ops.flash_attention import (
+    flash_attention, _jax_tuned_flash, _xla_reference, _flash, _tuned_block)
+
+def bench(f, *args, iters=20):
+    o = jax.block_until_ready(f(*args))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        o = f(*args)
+    jax.block_until_ready(o)
+    return (time.perf_counter() - t0) / iters
+
+def attn_flops(b, sq, skv, hq, d, causal):
+    f = 4 * b * hq * sq * skv * d
+    return f // 2 if causal else f
+
+def run(name, b, s, hq, hkv, d, dtype=jnp.bfloat16):
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq, (b, s, hq, d), dtype)
+    k = jax.random.normal(kk, (b, s, hkv, d), dtype)
+    v = jax.random.normal(kv, (b, s, hkv, d), dtype)
+    fl = attn_flops(b, s, s, hq, d, True)
+
+    def loss_inrepo(q, k, v):
+        return (flash_attention(q, k, v, causal=True).astype(jnp.float32) ** 2).sum()
+    def loss_xla(q, k, v):
+        return (_xla_reference(q, k, v, True, d ** -0.5).astype(jnp.float32) ** 2).sum()
+
+    g_inrepo = jax.jit(jax.grad(loss_inrepo, argnums=(0, 1, 2)))
+    g_xla = jax.jit(jax.grad(loss_xla, argnums=(0, 1, 2)))
+    fwd_inrepo = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
+
+    # correctness vs xla ref (fp32 inputs to tighten tolerance)
+    qf, kf, vf = q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32)
+    o1 = np.asarray(jax.jit(lambda q,k,v: flash_attention(q,k,v,causal=True))(qf, kf, vf))
+    o2 = np.asarray(_xla_reference(qf, kf, vf, True, d ** -0.5))
+    err = np.abs(o1 - o2).max()
+    gg1 = jax.jit(jax.grad(lambda q,k,v: (flash_attention(q,k,v,causal=True)**2).sum(), argnums=(0,1,2)))(qf, kf, vf)
+    gg2 = jax.jit(jax.grad(lambda q,k,v: (_xla_reference(q,k,v,True,d**-0.5)**2).sum(), argnums=(0,1,2)))(qf, kf, vf)
+    gerr = max(np.abs(np.asarray(a)-np.asarray(b2)).max() for a, b2 in zip(gg1, gg2))
+
+    t_fwd = bench(fwd_inrepo, q, k, v)
+    t_bwd = bench(g_inrepo, q, k, v)
+    t_xla_bwd = bench(g_xla, q, k, v)
+    line = (f"{name}: fwd {fl/t_fwd/1e12:.1f} TF/s ({t_fwd*1e3:.2f}ms) | "
+            f"fwd+bwd {3.5*fl/t_bwd/1e12:.1f} TF/s ({t_bwd*1e3:.2f}ms) | "
+            f"xla-recompute bwd {t_xla_bwd*1e3:.2f}ms | speedup {t_xla_bwd/t_bwd:.2f}x | "
+            f"err {err:.2e} gerr {gerr:.2e}")
+    print(line, flush=True)
+
+    if hq == hkv:
+        os.environ["PADDLE_TPU_FLASH_IMPL"] = "jaxlib"
+        try:
+            g_lib = jax.jit(jax.grad(lambda q,k,v: (flash_attention(q,k,v,causal=True).astype(jnp.float32)**2).sum(), argnums=(0,1,2)))
+            f_lib = jax.jit(lambda q,k,v: flash_attention(q,k,v,causal=True))
+            t_lf = bench(f_lib, q, k, v)
+            t_lb = bench(g_lib, q, k, v)
+            print(f"  jaxlib: fwd {fl/t_lf/1e12:.1f} TF/s ({t_lf*1e3:.2f}ms) | fwd+bwd {3.5*fl/t_lb/1e12:.1f} TF/s ({t_lb*1e3:.2f}ms) | inrepo/lib bwd ratio {t_lb/t_bwd:.2f}", flush=True)
+        finally:
+            del os.environ["PADDLE_TPU_FLASH_IMPL"]
+
+print("backend:", jax.default_backend(), jax.devices())
+run("MHA b4 s2048 h16 d128", 4, 2048, 16, 16, 128)
+run("GQA b1 s4096 h32/8 d128", 1, 4096, 32, 8, 128)
+run("GQA b2 s4096 h16/4 d128", 2, 4096, 16, 4, 128)

@@ -66,15 +66,20 @@ class enable_grad:
 class GradNode:
     """One recorded op: maps output cotangents -> input cotangents via stored vjp."""
 
-    __slots__ = ("name", "vjp_fn", "inputs", "out_avals", "n_outputs", "hooks")
+    __slots__ = ("name", "vjp_fn", "inputs", "out_avals", "n_outputs", "hooks",
+                 "pure_fn")
 
-    def __init__(self, name: str, vjp_fn, inputs: List[Tensor], out_avals):
+    def __init__(self, name: str, vjp_fn, inputs: List[Tensor], out_avals,
+                 pure_fn=None):
         self.name = name
         self.vjp_fn = vjp_fn
         self.inputs = inputs  # differentiable input Tensors, in vjp order
         self.out_avals = out_avals  # [(shape, dtype)] per output
         self.n_outputs = len(out_avals)
         self.hooks = None  # {out_idx: [fn]}
+        # pure forward fn over the diff-input arrays — enables create_graph=True
+        # (double backward): the VJP is re-derived and DISPATCHED as a taped op
+        self.pure_fn = pure_fn
 
     def __repr__(self):
         return f"GradNode<{self.name}>"
@@ -87,11 +92,51 @@ def _zero_cotangent(shape, dtype):
 
 
 def _accumulate(slot, value):
-    return value if slot is None else slot + value
+    if slot is None:
+        return value
+    if isinstance(slot, Tensor) or isinstance(value, Tensor):
+        a = slot if isinstance(slot, Tensor) else Tensor(slot)
+        return a + value  # dispatched add — keeps create_graph linkage
+    return slot + value
+
+
+def _taped_vjp(node: "GradNode", cots):
+    """create_graph=True: run this node's VJP as a *dispatched op* so the tape
+    records it and the returned cotangents are themselves differentiable
+    (reference: double-grad nodes created by RunBackward,
+    fluid/eager/backward.cc:105)."""
+    from .op_registry import apply_fn
+
+    if node.pure_fn is None:
+        if node.vjp_fn is None:
+            # a prior non-retained backward consumed and freed this node
+            raise RuntimeError(
+                "Trying to backward through the graph a second time "
+                "(use retain_graph=True).")
+        raise RuntimeError(
+            f"create_graph=True cannot differentiate through '{node.name}' — "
+            "this node has no recorded pure forward (PyLayer / to_static). "
+            "Use paddle.autograd.jacobian/hessian, or express the op through "
+            "the dispatcher.")
+    n_out = node.n_outputs
+
+    def grad_fn(*flat):
+        cot_arrays, primal_arrays = flat[:n_out], flat[n_out:]
+        _, vjp = jax.vjp(node.pure_fn, *primal_arrays)
+        payload = cot_arrays[0] if n_out == 1 else tuple(cot_arrays)
+        res = vjp(payload)
+        # single-input nodes return the bare array so the dispatcher's
+        # single-output payload convention holds at the next grad level
+        return res[0] if len(res) == 1 else tuple(res)
+
+    args = [c if isinstance(c, Tensor) else Tensor(c) for c in cots]
+    args += list(node.inputs)
+    out = apply_fn(node.name + "_grad", grad_fn, *args)
+    return out if isinstance(out, tuple) else (out,)
 
 
 def run_backward(root: Tensor, grad_tensor: Optional[Tensor] = None, retain_graph: bool = False,
-                 sink=None, capture_tensors=None):
+                 sink=None, capture_tensors=None, create_graph: bool = False):
     """Reverse-topological cotangent propagation (cf. backward.cc:105).
 
     When ``sink`` is given (paddle.grad mode), cotangents for ``capture_tensors``
@@ -102,6 +147,8 @@ def run_backward(root: Tensor, grad_tensor: Optional[Tensor] = None, retain_grap
         if not jnp.issubdtype(root.dtype, jnp.floating):
             raise RuntimeError("backward() root must be floating point")
         seed = jnp.ones(root._data.shape, root.dtype)
+    elif create_graph and isinstance(grad_tensor, Tensor):
+        seed = grad_tensor  # keep linkage: the seed may itself require grad
     else:
         seed = grad_tensor._data if isinstance(grad_tensor, Tensor) else jnp.asarray(grad_tensor)
 
@@ -163,19 +210,25 @@ def run_backward(root: Tensor, grad_tensor: Optional[Tensor] = None, retain_grap
             full = list(full)
             for idx, fns in node.hooks.items():
                 for fn in fns:
-                    out = fn(Tensor(full[idx]))
+                    c = full[idx]
+                    out = fn(c if isinstance(c, Tensor) else Tensor(c))
                     if out is not None:
-                        full[idx] = out._data if isinstance(out, Tensor) else out
+                        full[idx] = out
             full = tuple(full)
-        if node.vjp_fn is None:
-            raise RuntimeError(
-                "Trying to backward through the graph a second time "
-                "(use retain_graph=True)."
-            )
-        payload = full[0] if node.n_outputs == 1 else full
-        in_cots = node.vjp_fn(payload)
-        if not retain_graph:
-            node.vjp_fn = None
+        if create_graph:
+            in_cots = _taped_vjp(node, full)
+        else:
+            if node.vjp_fn is None:
+                raise RuntimeError(
+                    "Trying to backward through the graph a second time "
+                    "(use retain_graph=True)."
+                )
+            full = tuple(c._data if isinstance(c, Tensor) else c for c in full)
+            payload = full[0] if node.n_outputs == 1 else full
+            in_cots = node.vjp_fn(payload)
+            if not retain_graph:
+                node.vjp_fn = None
+                node.pure_fn = None  # frees the forward-args closure too
         for t, g in zip(node.inputs, in_cots):
             if g is None or (hasattr(g, "dtype") and g.dtype == jax.dtypes.float0):
                 continue
@@ -193,9 +246,15 @@ def run_backward(root: Tensor, grad_tensor: Optional[Tensor] = None, retain_grap
 def _write_leaf_grad(t: Tensor, g):
     if t._hooks:
         for fn in t._hooks:
-            out = fn(Tensor(g))
+            out = fn(g if isinstance(g, Tensor) else Tensor(g))
             if out is not None:
                 g = out._data if isinstance(out, Tensor) else out
+    if isinstance(g, Tensor):  # create_graph: .grad stays part of the graph
+        if t._grad is None:
+            t._grad = g
+        else:
+            t._grad = t._grad + g
+        return
     if t._grad is None:
         gt = Tensor(g)
         gt.stop_gradient = True
@@ -226,11 +285,15 @@ def grad(
     retain = True if retain_graph is None else retain_graph
     for i, out in enumerate(outputs):
         g = grad_outputs[i] if grad_outputs is not None else None
-        run_backward(out, g, retain_graph=retain, sink=sink, capture_tensors=inputs)
+        run_backward(out, g, retain_graph=retain, sink=sink, capture_tensors=inputs,
+                     create_graph=create_graph)
     results = []
     for t in inputs:
         g = sink.get(id(t))
         if g is None and not allow_unused:
             raise RuntimeError(f"Tensor {t.name} is unused in the graph")
-        results.append(Tensor(g) if g is not None else None)
+        if g is None:
+            results.append(None)
+        else:
+            results.append(g if isinstance(g, Tensor) else Tensor(g))
     return results

@@ -172,6 +172,7 @@ def apply_fn(name: str, fn: Callable, *args, _opdef: Optional[OpDef] = None, **k
             vjp_fn,
             [args[i] for i in diff_idx],
             [(o.shape, o.dtype) for o in out_list],
+            pure_fn=pure,
         )
         results = []
         for idx, o in enumerate(out_list):

@@ -43,6 +43,25 @@ def _batch_sharding(mesh: Mesh, ndim: int = 2) -> NamedSharding:
     return NamedSharding(mesh, logical_to_spec(axes[:ndim], mesh))
 
 
+_DEFAULT_CLIP = object()  # sentinel: "caller did not choose" vs explicit value
+
+
+class _ParamProxy:
+    """Shape/dtype/name carrier handed to ``Optimizer._update`` inside the
+    jitted train step. The Engine functionalizes params into bare arrays
+    (stacked pipeline params never have a live Tensor at all), but the
+    optimizer state machinery keys accumulators off a param object — this is
+    that object."""
+
+    __slots__ = ("shape", "dtype", "name", "optimize_attr")
+
+    def __init__(self, shape, dtype, name):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+        self.optimize_attr = {"learning_rate": 1.0}
+
+
 class Engine:
     """Jitted SPMD trainer for a Layer with a ``loss_fn(input_ids, labels)``.
 
@@ -66,13 +85,14 @@ class Engine:
         epsilon: float = 1e-8,
         weight_decay: float = 0.1,
         apply_decay_param_fun: Optional[Callable[[str], bool]] = None,
-        clip_norm: Optional[float] = 1.0,
+        clip_norm: Optional[float] = _DEFAULT_CLIP,
         rules=None,
         loss_fn: Optional[Callable] = None,
         donate: bool = True,
         n_micro: Optional[int] = None,
         pp_remat: Optional[bool] = None,
         pp_interleave: int = 1,
+        optimizer=None,
     ):
         self.model = model
         self.mesh = mesh if mesh is not None else current_mesh()
@@ -81,7 +101,7 @@ class Engine:
         self.beta1, self.beta2 = beta1, beta2
         self.epsilon = epsilon
         self.weight_decay = weight_decay
-        self.clip_norm = clip_norm
+        self.clip_norm = 1.0 if clip_norm is _DEFAULT_CLIP else clip_norm
         self._loss_fn = loss_fn
         self._donate = donate
 
@@ -155,15 +175,123 @@ class Engine:
             with axis_rules(self.mesh, self.rules):
                 self._shardings = [param_sharding(p, self.mesh) for p in self._param_tensors]
             self._shardings = self._shardings + self._block_shardings
-            zeros = lambda a, s: jax.device_put(jnp.zeros(a.shape, jnp.float32), s)
-            self.m = [zeros(a, s) for a, s in zip(self.params, self._shardings)]
-            self.v = [zeros(a, s) for a, s in zip(self.params, self._shardings)]
+
+        self._optimizer = optimizer
+        self.m = self.v = None
+        self.opt_state = None
+        if optimizer is None:
+            # built-in fused AdamW fast path
+            if self.mesh is not None:
+                zeros = lambda a, s: jax.device_put(jnp.zeros(a.shape, jnp.float32), s)
+                self.m = [zeros(a, s) for a, s in zip(self.params, self._shardings)]
+                self.v = [zeros(a, s) for a, s in zip(self.params, self._shardings)]
+            else:
+                self.m = [jnp.zeros(a.shape, jnp.float32) for a in self.params]
+                self.v = [jnp.zeros(a.shape, jnp.float32) for a in self.params]
         else:
-            self.m = [jnp.zeros(a.shape, jnp.float32) for a in self.params]
-            self.v = [jnp.zeros(a.shape, jnp.float32) for a in self.params]
+            # pluggable path: any paddle_tpu.optimizer.Optimizer runs inside the
+            # jitted SPMD step via its pure _functional_update (reference parity:
+            # HybridParallelOptimizer wraps any inner optimizer,
+            # hybrid_parallel_optimizer.py:258)
+            oc = getattr(optimizer, "_grad_clip", None)
+            if oc is not None:
+                # only global-norm clip is expressible in the SPMD step; other
+                # clip classes must not be silently reinterpreted
+                if type(oc).__name__ != "ClipGradByGlobalNorm":
+                    raise ValueError(
+                        f"Engine supports ClipGradByGlobalNorm only, got "
+                        f"{type(oc).__name__}; pass clip_norm=... instead")
+                if clip_norm is _DEFAULT_CLIP:
+                    self.clip_norm = oc.clip_norm
+            self._proxies = [_ParamProxy(a.shape, a.dtype, n)
+                             for a, n in zip(self.params, self._param_names)]
+            self.opt_state, self._opt_state_shardings = self._init_opt_state()
         self.step_count = jnp.zeros((), jnp.int32)
         self._jit_step = None
         self._jit_loss = None
+
+    # ---- pluggable-optimizer state ----
+    def _init_opt_state(self):
+        """Discover the optimizer's accumulator pytree and materialize it sharded.
+
+        Two probes: (1) a concrete scalar-shaped run records each accumulator's
+        INIT value (Adagrad's initial_accumulator_value, NAdam's mu_product=1 —
+        eval_shape alone would lose these); (2) an eval_shape run on the real
+        param shapes gives each accumulator's shape/dtype. Param-shaped
+        accumulators inherit the param's NamedSharding (ZeRO via fsdp axis);
+        scalar state is replicated."""
+        opt = self._optimizer
+        inits: dict = {}
+        orig_acc = opt._acc
+
+        def probing_acc(name, p, init=None, dtype=None):
+            d = opt._accumulators.setdefault(name, {})
+            fresh = id(p) not in d
+            out = orig_acc(name, p, init=init, dtype=dtype)
+            if fresh:
+                arr = jnp.asarray(out)
+                inits[name] = float(arr.reshape(-1)[0]) if arr.size else 0.0
+            return out
+
+        scalar_proxies = [_ParamProxy((), a.dtype, n)
+                          for a, n in zip(self.params, self._param_names)]
+        opt._acc = probing_acc
+        try:
+            opt._functional_update(
+                [jnp.zeros((), jnp.float32) for _ in self.params],
+                [jnp.zeros((), a.dtype) for a in self.params],
+                scalar_proxies, {}, 1e-3, 1)
+        finally:
+            opt._acc = orig_acc
+
+        def probe(grads, values):
+            _, acc = opt._functional_update(grads, values, self._proxies, {}, 1e-3, 1)
+            return acc
+
+        g_avals = [jax.ShapeDtypeStruct(a.shape, jnp.float32) for a in self.params]
+        v_avals = [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in self.params]
+        acc_struct = jax.eval_shape(probe, g_avals, v_avals)
+
+        id2idx = {id(p): i for i, p in enumerate(self._proxies)}
+        rep = NamedSharding(self.mesh, P()) if self.mesh is not None else None
+        state, shardings = {}, {}
+        for name, d in acc_struct.items():
+            sub, ssub = {}, {}
+            for pid, aval in d.items():
+                i = id2idx[pid]
+                fill = inits.get(name, 0.0)
+                arr = (jnp.zeros(aval.shape, aval.dtype) if fill == 0.0
+                       else jnp.full(aval.shape, fill, aval.dtype))
+                if self.mesh is not None:
+                    sh = (self._shardings[i]
+                          if tuple(aval.shape) == tuple(self.params[i].shape) else rep)
+                    arr = jax.device_put(arr, sh)
+                    ssub[i] = sh
+                sub[i] = arr
+            state[name] = sub
+            shardings[name] = ssub
+        return state, (shardings if self.mesh is not None else None)
+
+    def _clip_grads(self, grads):
+        if self.clip_norm is None:
+            return grads
+        # global-norm clip across ALL params — the reference clips across
+        # MP/PP groups too (hybrid_parallel_optimizer.py); here the grads are
+        # global (GSPMD), so a plain global norm is already group-correct.
+        gsq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in grads)
+        gnorm = jnp.sqrt(gsq)
+        scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(gnorm, 1e-6))
+        return [g * scale.astype(g.dtype) for g in grads]
+
+    def _current_lr(self) -> float:
+        """Host-side scalar fed to the jitted step as an argument each call —
+        LRScheduler objects advance on host (scheduler.step()), no retrace."""
+        opt = self._optimizer
+        try:
+            return float(opt.get_lr())
+        except Exception:
+            lr = opt._learning_rate
+            return float(lr() if callable(lr) else lr)
 
     # ---- pure functions ----
     def _pure_loss(self, param_arrays, input_ids, labels):
@@ -207,14 +335,7 @@ class Engine:
         bc1 = 1.0 - b1 ** stepf
         bc2 = 1.0 - b2 ** stepf
 
-        if self.clip_norm is not None:
-            # global-norm clip across ALL params — the reference clips across
-            # MP/PP groups too (hybrid_parallel_optimizer.py); here the grads are
-            # global (GSPMD), so a plain global norm is already group-correct.
-            gsq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in grads)
-            gnorm = jnp.sqrt(gsq)
-            scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(gnorm, 1e-6))
-            grads = [g * scale.astype(g.dtype) for g in grads]
+        grads = self._clip_grads(grads)
 
         new_p, new_m, new_v = [], [], []
         for p, mm, vv, g, decay in zip(params, m, v, grads, self._decay_mask):
@@ -247,6 +368,39 @@ class Engine:
             kw["donate_argnums"] = (0, 1, 2, 3)
         return jax.jit(train_step, **kw)
 
+    def _build_opt_step(self):
+        """Train step around a pluggable ``paddle_tpu.optimizer.Optimizer``:
+        its per-tensor ``_update`` rules trace into the same single jitted SPMD
+        program as the built-in AdamW path (lr arrives as an argument so host-
+        side LR schedules never retrace)."""
+        opt = self._optimizer
+        id2idx = {id(p): i for i, p in enumerate(self._proxies)}
+
+        def train_step(params, opt_state, step, lr, input_ids, labels):
+            step = step + 1
+            loss, grads = jax.value_and_grad(self._pure_loss)(params, input_ids, labels)
+            grads = self._clip_grads(grads)
+            grads = [g.astype(jnp.float32) for g in grads]
+            acc = {name: {id(self._proxies[i]): a for i, a in d.items()}
+                   for name, d in opt_state.items()}
+            new_p, new_acc = opt._functional_update(
+                grads, params, self._proxies, acc, lr, step.astype(jnp.float32))
+            new_state = {name: {id2idx[pid]: a for pid, a in d.items()}
+                         for name, d in new_acc.items()}
+            return new_p, new_state, step, loss
+
+        kw = {}
+        if self.mesh is not None:
+            sh = self._shardings
+            osh = self._opt_state_shardings
+            bsh = _batch_sharding(self.mesh)
+            rep = NamedSharding(self.mesh, P())
+            kw["in_shardings"] = (sh, osh, rep, rep, bsh, bsh)
+            kw["out_shardings"] = (sh, osh, rep, rep)
+        if self._donate:
+            kw["donate_argnums"] = (0, 1, 2)
+        return jax.jit(train_step, **kw)
+
     # ---- public API ----
     def shard_batch(self, *arrays):
         """device_put host batches onto the mesh (dp×fsdp batch, sep seq)."""
@@ -258,10 +412,17 @@ class Engine:
 
     def step(self, input_ids, labels):
         """Run one fused train step; returns the (device) scalar loss."""
-        if self._jit_step is None:
-            self._jit_step = self._build_step()
         ids = input_ids._data if isinstance(input_ids, Tensor) else jnp.asarray(input_ids)
         lbl = labels._data if isinstance(labels, Tensor) else jnp.asarray(labels)
+        if self._optimizer is not None:
+            if self._jit_step is None:
+                self._jit_step = self._build_opt_step()
+            lr = jnp.asarray(self._current_lr(), jnp.float32)
+            self.params, self.opt_state, self.step_count, loss = self._jit_step(
+                self.params, self.opt_state, self.step_count, lr, ids, lbl)
+            return loss
+        if self._jit_step is None:
+            self._jit_step = self._build_step()
         self.params, self.m, self.v, self.step_count, loss = self._jit_step(
             self.params, self.m, self.v, self.step_count, ids, lbl)
         return loss
@@ -296,12 +457,15 @@ class Engine:
 
     def state_dict(self):
         self.sync_model()
-        return {
-            "model": self.model.state_dict(),
-            "m": {n: jnp.copy(a) for n, a in zip(self._param_names, self.m)},
-            "v": {n: jnp.copy(a) for n, a in zip(self._param_names, self.v)},
-            "step": jnp.copy(self.step_count),
-        }
+        out = {"model": self.model.state_dict(), "step": jnp.copy(self.step_count)}
+        if self._optimizer is not None:
+            out["opt"] = {
+                name: {self._param_names[i]: jnp.copy(a) for i, a in d.items()}
+                for name, d in self.opt_state.items()}
+        else:
+            out["m"] = {n: jnp.copy(a) for n, a in zip(self._param_names, self.m)}
+            out["v"] = {n: jnp.copy(a) for n, a in zip(self._param_names, self.v)}
+        return out
 
 
 ShardedTrainer = Engine

@@ -1,21 +1,36 @@
-"""Flash attention — Pallas TPU kernel (online softmax, block-streamed K/V).
+"""Flash attention — Pallas TPU kernels, forward AND backward.
 
 Replaces the reference's vendored CUDA flashattn (dynload wrapper
 /root/reference/paddle/phi/backends/dynload/flashattn.cc, python surface
 nn/functional/flash_attention.py:195). TPU design:
+
+Forward:
   - grid (batch, q_heads, q_blocks); K/V stream through VMEM in BLOCK_K chunks
   - fp32 running max/sum (online softmax), bf16 MXU matmuls
-  - causal grids skip fully-masked K blocks (upper bound on the fori_loop)
+  - causal grids skip fully-masked K blocks (dynamic fori_loop trip count)
   - GQA: q-head → kv-head mapping folded into the BlockSpec index_map, so
     K/V are never materialized per-q-head (the XLA fallback repeats them)
-Backward: rematerialized XLA attention VJP (correct, XLA-fused); a dedicated
-Pallas backward kernel is a later optimization.
+  - train path emits logsumexp [b, h, s_q, LSE_LANES] so backward can
+    recompute P row-stably; the primal/inference path skips the write
+
+Backward (FlashAttention-2 style, two kernels sharing the saved lse):
+  - delta = rowsum(dO * O) computed in plain XLA (one fused elementwise pass)
+  - dQ kernel: grid (b, hq, q_blocks), streams K/V blocks with the same
+    causal skip as forward; dS = P*(dP-delta), dQ += dS·K
+  - dK/dV kernel: grid (b, kv_heads, k_blocks, q_blocks) — q innermost so the
+    fp32 VMEM accumulators persist across q steps; the GQA head group is a
+    static python loop (all q-heads of one kv-head arrive in one block via
+    a `group`-sized head block in the BlockSpec). Causal skip is a pl.when.
+
+Layouts: public API is [batch, seq, heads, head_dim] (reference layout);
+kernels run on [batch, heads, seq, head_dim].
 """
 
 from __future__ import annotations
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -24,6 +39,7 @@ from jax.experimental import pallas as pl
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 NEG_INF = -1e30
+LSE_LANES = 8  # trailing lane dim for lse/delta storage (TPU tiling)
 
 
 def _xla_reference(q, k, v, causal, scale):
@@ -45,8 +61,12 @@ def _xla_reference(q, k, v, causal, scale):
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q, block_k,
-               kv_len, q_len):
+# ---------------------------------------------------------------------------
+# forward kernel
+# ---------------------------------------------------------------------------
+
+def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_ref, scale, causal,
+                   block_q, block_k, kv_len, q_len):
     """One (batch, head, q-block) program; streams K/V in block_k chunks."""
     qi = pl.program_id(2)
     q = q_ref[0, 0].astype(jnp.float32) * scale          # [BQ, d]
@@ -83,23 +103,45 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q, block_k,
     m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q,), jnp.float32)
     acc, m, l = jax.lax.fori_loop(0, num_kv, body, (acc0, m0, l0))
-    o_ref[0, 0] = (acc / l[:, None]).astype(o_ref.dtype)
+    l_safe = jnp.where(l > 0, l, 1.0)
+    o_ref[0, 0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    if maybe_lse_ref:
+        # lse (train path only — the primal/inference kernel skips the write)
+        # in units of the SCALED logits; rows with no valid keys get NEG_INF.
+        # Stored with LSE_LANES trailing lanes (TPU block constraint: the last
+        # block dim must be 128-divisible or equal the array dim — 8 lanes
+        # beats the library kernel's 128-lane padding on HBM traffic 16x).
+        lse_ref = maybe_lse_ref[0]
+        lse = jnp.where(l > 0, m + jnp.log(l_safe), NEG_INF)
+        lse_ref[0, 0] = jax.lax.broadcast_in_dim(lse, lse_ref.shape[2:], (0,))
 
 
-def _pallas_attention(q, k, v, causal, scale, block_q, block_k, interpret):
+def _pallas_forward(q, k, v, causal, scale, block_q, block_k, interpret,
+                    with_lse=True):
+    """q,k,v in [b, s, h, d]. Returns (out [b,s,h,d],
+    lse [b, hq, s_q, LSE_LANES] fp32 — or None when with_lse=False, the
+    primal/inference path, which skips the lse HBM write entirely)."""
     b, s_q, hq, d = q.shape
     _, s_kv, hkv, _ = k.shape
     group = hq // hkv
-    # [b, h, s, d] layout for blocking
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
 
     grid = (b, hq, s_q // block_q)
     kernel = functools.partial(
-        _fa_kernel, scale=scale, causal=causal,
+        _fa_fwd_kernel, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, kv_len=s_kv, q_len=s_q)
-    out = pl.pallas_call(
+    out_specs = [
+        pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+    ]
+    out_shape = [jax.ShapeDtypeStruct(qt.shape, q.dtype)]
+    if with_lse:
+        out_specs.append(pl.BlockSpec((1, 1, block_q, LSE_LANES),
+                                      lambda bi, hi, qi: (bi, hi, qi, 0)))
+        out_shape.append(
+            jax.ShapeDtypeStruct((b, hq, s_q, LSE_LANES), jnp.float32))
+    res = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -107,18 +149,214 @@ def _pallas_attention(q, k, v, causal, scale, block_q, block_k, interpret):
             pl.BlockSpec((1, 1, s_kv, d), lambda bi, hi, qi: (bi, hi // group, 0, 0)),
             pl.BlockSpec((1, 1, s_kv, d), lambda bi, hi, qi: (bi, hi // group, 0, 0)),
         ],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(qt, kt, vt)
+    lse = res[1] if with_lse else None
+    return jnp.swapaxes(res[0], 1, 2), lse
+
+
+# ---------------------------------------------------------------------------
+# backward kernels
+# ---------------------------------------------------------------------------
+
+def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                      *, scale, causal, block_q, block_k, kv_len, q_len):
+    """dQ for one (batch, q_head, q_block); streams K/V like forward."""
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32)                   # [BQ, d]
+    do = do_ref[0, 0].astype(jnp.float32)                 # [BQ, d]
+    lse = lse_ref[0, 0][:, :1]                            # [BQ, 1]
+    delta = delta_ref[0, 0][:, :1]                        # [BQ, 1]
+    d = q.shape[-1]
+
+    offset = kv_len - q_len
+    num_kv = kv_len // block_k
+    if causal:
+        last_k = qi * block_q + block_q - 1 + offset
+        num_kv = jnp.clip((last_k + block_k) // block_k, 0, num_kv)
+
+    def body(j, dq):
+        kb = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vb = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos + offset >= k_pos, s, NEG_INF)
+        # rows with no valid keys store lse = NEG_INF; exp(s - lse) would give
+        # p = 1 there (s is NEG_INF too) — force those rows to zero instead
+        p = jnp.where(lse > NEG_INF / 2, jnp.exp(s - lse), 0.0)   # [BQ, BK]
+        dp = jax.lax.dot_general(do, vb, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale                      # [BQ, BK]
+        return dq + jax.lax.dot_general(ds, kb, (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, num_kv, body, jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+
+
+def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                       dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
+                       block_q, block_k, kv_len, q_len, group):
+    """dK/dV for one (batch, kv_head, k_block); q_blocks is the innermost grid
+    dim so dk_acc/dv_acc VMEM scratch persists and accumulates across q steps.
+    All `group` q-heads of this kv-head arrive in one head-blocked q block."""
+    ki = pl.program_id(2)
+    qi = pl.program_id(3)
+    nq = pl.num_programs(3)
+    offset = kv_len - q_len
+
+    @pl.when(qi == 0)
+    def _():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    # causal: skip q blocks entirely in the past of this k block
+    run = True
+    if causal:
+        run = qi * block_q + block_q - 1 + offset >= ki * block_k
+
+    @pl.when(run)
+    def _():
+        kb = k_ref[0, 0].astype(jnp.float32)               # [BK, d]
+        vb = v_ref[0, 0].astype(jnp.float32)               # [BK, d]
+        dk = dk_acc[...]
+        dv = dv_acc[...]
+        for g in range(group):                             # static unroll (GQA)
+            q = q_ref[0, g].astype(jnp.float32)            # [BQ, d]
+            do = do_ref[0, g].astype(jnp.float32)          # [BQ, d]
+            lse = lse_ref[0, g][:, :1]                     # [BQ, 1]
+            delta = delta_ref[0, g][:, :1]                 # [BQ, 1]
+            s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32) * scale
+            if causal:
+                q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0)
+                k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1)
+                s = jnp.where(q_pos + offset >= k_pos, s, NEG_INF)
+            # see dq kernel: fully-masked rows (lse == NEG_INF) must give p = 0
+            p = jnp.where(lse > NEG_INF / 2, jnp.exp(s - lse), 0.0)
+            # dV += P^T · dO
+            dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                          preferred_element_type=jnp.float32)
+            dp = jax.lax.dot_general(do, vb, (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            ds = p * (dp - delta) * scale                  # [BQ, BK]
+            # dK += dS^T · Q
+            dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                          preferred_element_type=jnp.float32)
+        dk_acc[...] = dk
+        dv_acc[...] = dv
+
+    @pl.when(qi == nq - 1)
+    def _():
+        dk_ref[0, 0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _pallas_backward(q, k, v, o, lse, do, causal, scale, block_q, block_k,
+                     interpret):
+    """All arrays in the public [b, s, h, d] layout; lse is the forward's
+    [b, hq, s_q, LSE_LANES] output (value broadcast across the lane dim)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, s_q, hq, d = q.shape
+    _, s_kv, hkv, _ = k.shape
+    group = hq // hkv
+
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    dot = jnp.swapaxes(do, 1, 2)
+    # delta_i = rowsum(dO_i * O_i) — one fused XLA elementwise+reduce pass,
+    # broadcast to LSE_LANES trailing lanes to satisfy TPU block tiling
+    delta = jnp.einsum("bshd,bshd->bhs", do.astype(jnp.float32),
+                       o.astype(jnp.float32))
+    delta = jnp.broadcast_to(delta[..., None], delta.shape + (LSE_LANES,))
+
+    # ---- dQ ----
+    grid_dq = (b, hq, s_q // block_q)
+    dq_kernel = functools.partial(
+        _fa_bwd_dq_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, kv_len=s_kv, q_len=s_q)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=grid_dq,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, s_kv, d), lambda bi, hi, qi: (bi, hi // group, 0, 0)),
+            pl.BlockSpec((1, 1, s_kv, d), lambda bi, hi, qi: (bi, hi // group, 0, 0)),
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, LSE_LANES),
+                         lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, LSE_LANES),
+                         lambda bi, hi, qi: (bi, hi, qi, 0)),
+        ],
         out_specs=pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
         out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
         interpret=interpret,
-    )(qt, kt, vt)
-    return jnp.swapaxes(out, 1, 2)
+    )(qt, kt, vt, dot, lse, delta)
 
+    # ---- dK / dV ----
+    # q-heads blocked by `group` so one program sees every q-head of its
+    # kv-head; q_blocks innermost so VMEM accumulators carry across q steps.
+    grid_dkv = (b, hkv, s_kv // block_k, s_q // block_q)
+    dkv_kernel = functools.partial(
+        _fa_bwd_dkv_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, kv_len=s_kv, q_len=s_q, group=group)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=grid_dkv,
+        in_specs=[
+            pl.BlockSpec((1, group, block_q, d),
+                         lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, group, block_q, d),
+                         lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, group, block_q, LSE_LANES),
+                         lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, group, block_q, LSE_LANES),
+                         lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(kt.shape, k.dtype),
+            jax.ShapeDtypeStruct(vt.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, delta)
+
+    return (jnp.swapaxes(dq, 1, 2), jnp.swapaxes(dk, 1, 2),
+            jnp.swapaxes(dv, 1, 2))
+
+
+# ---------------------------------------------------------------------------
+# dispatch + custom_vjp
+# ---------------------------------------------------------------------------
 
 def _use_pallas(q, k, block_q, block_k, interpret):
     # shape guards apply in interpret mode too — a non-divisible seq would leave
     # output rows unwritten / drop kv tokens silently
     s_q, s_kv = q.shape[1], k.shape[1]
-    shapes_ok = s_q % block_q == 0 and s_kv % block_k == 0
+    shapes_ok = (s_q % block_q == 0 and s_kv % block_k == 0
+                 and q.shape[2] % k.shape[2] == 0)
     if interpret:
         return shapes_ok
     if jax.default_backend() != "tpu":
@@ -129,17 +367,27 @@ def _use_pallas(q, k, block_q, block_k, interpret):
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
     if _use_pallas(q, k, block_q, block_k, interpret):
-        return _pallas_attention(q, k, v, causal, scale, block_q, block_k, interpret)
+        # primal (inference) path: skip the lse output entirely
+        return _pallas_forward(q, k, v, causal, scale, block_q, block_k,
+                               interpret, with_lse=False)[0]
     return _xla_reference(q, k, v, causal, scale)
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    return _flash(q, k, v, causal, scale, block_q, block_k, interpret), (q, k, v)
+    if _use_pallas(q, k, block_q, block_k, interpret):
+        out, lse = _pallas_forward(q, k, v, causal, scale, block_q, block_k,
+                                   interpret)
+        return out, (q, k, v, out, lse)
+    return _xla_reference(q, k, v, causal, scale), (q, k, v, None, None)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda a, b, c: _xla_reference(a, b, c, causal, scale), q, k, v)
+    q, k, v, o, lse = res
+    if lse is not None:
+        return _pallas_backward(q, k, v, o, lse, g, causal, scale,
+                                block_q, block_k, interpret)
+    _, vjp = jax.vjp(lambda a, b, c: _xla_reference(a, b, c, causal, scale),
+                     q, k, v)
     return vjp(g)
 
 
@@ -148,17 +396,20 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 def _tuned_block(n: int) -> int:
     """Largest of 512/256/128 dividing n (v5e-profiled: 512 blocks reach
-    ~25 TF/s fwd+bwd at head_dim 128 vs ~8 TF/s at the library defaults)."""
+    ~25 TF/s fwd+bwd at head_dim 128 vs ~8 TF/s at the library defaults).
+    Sequences shorter than 128 use one whole-sequence block; longer sequences
+    not divisible by 128 get the default block, which fails the
+    divisibility guard in _use_pallas and routes to the XLA fallback
+    (a whole-sequence block there would materialize [s, s] scores in VMEM)."""
     for b in (512, 256, 128):
         if n % b == 0:
             return b
-    return n
+    return n if n < 128 else DEFAULT_BLOCK_Q
 
 
 def _jax_tuned_flash(q, k, v, causal, scale):
-    """Route to jax's tuned TPU Pallas flash kernels (fwd AND bwd kernels —
-    our in-repo kernel still uses the XLA-recompute VJP, which materializes
-    [s, s] logits in backward and is ~3x slower at seq 2048)."""
+    """jax's library TPU flash kernel — kept as an A/B comparison path
+    (PADDLE_TPU_FLASH_IMPL=jaxlib). MHA, q_len == kv_len only."""
     from jax.experimental.pallas.ops.tpu.flash_attention import (
         BlockSizes, flash_attention as jfa)
 
@@ -177,29 +428,28 @@ def _jax_tuned_flash(q, k, v, causal, scale):
 
 
 def flash_attention(q, k, v, causal: bool = False, scale=None,
-                    block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K,
+                    block_q: int = 0, block_k: int = 0,
                     interpret: bool = False):
     """q,k,v: [batch, seq, heads, head_dim] (reference layout,
     nn/functional/flash_attention.py:195). Returns same layout/dtype as q.
 
-    On TPU, MHA self-attention shapes dispatch to jax's tuned Pallas flash
-    kernels (fwd + dedicated bwd; ~3x faster at seq 2048). Kept on the
-    in-repo online-softmax kernel:
-      - GQA (q_heads != kv_heads): the in-repo kernel maps q-head→kv-head in
-        its BlockSpec index_map without materializing repeated K/V
-      - q_len != kv_len (kv-cache decode): the in-repo kernel/_xla_reference
-        use END-aligned causal masking (tril(k=kv-q)); jax's kernel is
-        top-left aligned, which would silently mask out the cache
-      - CPU/interpret mode (tests)."""
+    Production path is the IN-REPO Pallas kernel pair (fwd with logsumexp +
+    FlashAttention-2 backward), covering MHA, GQA (q-head→kv-head folded into
+    BlockSpec index maps — K/V never repeated), and kv-cache decode
+    (q_len != kv_len via END-aligned causal masking, tril(k=kv-q)).
+    Set PADDLE_TPU_FLASH_IMPL=jaxlib to A/B against jax's library kernel
+    (MHA equal-length shapes only). Non-divisible / odd shapes fall back to
+    the XLA reference implementation."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    if (not interpret and jax.default_backend() == "tpu"
+    impl = os.environ.get("PADDLE_TPU_FLASH_IMPL", "")
+    if (impl == "jaxlib" and not interpret and jax.default_backend() == "tpu"
             and q.shape[1] == k.shape[1] and q.shape[1] % 128 == 0
             and q.shape[-1] in (64, 128, 256)
             and q.shape[2] == k.shape[2]):
         return _jax_tuned_flash(q, k, v, causal, scale)
-    bq = min(block_q, q.shape[1])
-    bk = min(block_k, k.shape[1])
+    bq = min(block_q or _tuned_block(q.shape[1]), q.shape[1])
+    bk = min(block_k or _tuned_block(k.shape[1]), k.shape[1])
     return _flash(q, k, v, causal, float(scale), bq, bk, interpret)
 
 

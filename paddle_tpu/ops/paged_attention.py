@@ -1,0 +1,261 @@
+"""Paged (block) KV-cache attention — Pallas TPU kernels for batched serving.
+
+TPU-native replacement for the reference's paged serving kernels
+(/root/reference/paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu,
+python surface python/paddle/incubate/nn/functional/block_multihead_attention.py):
+KV lives in a pool of fixed-size pages; each sequence owns a list of pages via a
+block table, so cache memory is bounded by total tokens, not batch × max_len.
+
+Layouts (reference block_multihead_attention):
+  k_cache/v_cache: [num_pages, kv_heads, page_size, head_dim]
+  block_tables:    [batch, pages_per_seq] int32 (-1 = unassigned)
+  context_lens:    [batch] int32 — tokens already in cache (incl. current step)
+
+Decode kernel design (measured 435 GB/s-class architecture, v5e):
+  - grid (batch, kv_heads, seq_chunks); each chunk DMAs G pages of ONE kv head
+    HBM→VMEM. The chunk loop is a *grid* dimension, so double buffering runs
+    across grid steps: an SMEM buffer index persists, and each step prefetches
+    the NEXT VALID (b, h, chunk) step's pages while computing its own.
+  - context lengths arrive via scalar prefetch; chunks past a sequence's
+    length are skipped entirely (no DMA, no compute).
+  - online softmax in fp32 with VMEM carry across chunks; GQA computes all
+    `group` q-heads of the kv head in one [group, G*page] block.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# XLA reference (tests + CPU fallback)
+# ---------------------------------------------------------------------------
+
+def paged_decode_reference(q, k_cache, v_cache, block_tables, context_lens,
+                           scale=None):
+    """Dense-gather paged decode: q [b, hq, d] -> out [b, hq, d]."""
+    b, hq, d = q.shape
+    n_pages, hkv, page, _ = k_cache.shape
+    group = hq // hkv
+    if scale is None:
+        scale = d ** -0.5
+    max_pages = block_tables.shape[1]
+    safe_tables = jnp.maximum(block_tables, 0)
+    # [b, max_pages, hkv, page, d] -> [b, hkv, L, d]
+    kg = jnp.swapaxes(k_cache[safe_tables], 2, 3).reshape(b, max_pages * page, hkv, d)
+    vg = jnp.swapaxes(v_cache[safe_tables], 2, 3).reshape(b, max_pages * page, hkv, d)
+    kg = jnp.swapaxes(kg, 1, 2)
+    vg = jnp.swapaxes(vg, 1, 2)
+    qf = q.reshape(b, hkv, group, d).astype(jnp.float32)
+    s = jnp.einsum("bhgd,bhld->bhgl", qf, kg.astype(jnp.float32)) * scale
+    pos = jnp.arange(max_pages * page)[None, None, None, :]
+    s = jnp.where(pos < context_lens[:, None, None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhgl,bhld->bhgd", p, vg.astype(jnp.float32))
+    return out.reshape(b, hq, d).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas decode kernel
+# ---------------------------------------------------------------------------
+
+def _paged_decode_kernel(lens_ref, tables_ref, buf_idx, init_ref,
+                         q_ref, k_hbm, v_hbm, o_ref,
+                         k_buf, v_buf, acc_ref, m_ref, l_ref,
+                         sem, *, page, G, max_pages, scale, group, hkv, batch):
+    bi, hi, ci = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    chunk_tokens = page * G
+    ctx = lens_ref[bi]
+    # every (b, h) processes AT LEAST one chunk even at length 0 — otherwise a
+    # zero-length row would break the prefetch chain and the next valid row
+    # would wait on semaphores armed with the wrong pages (its own output is
+    # documented-undefined; neighbors must stay correct)
+    n_chunks_b = jnp.maximum((ctx + chunk_tokens - 1) // chunk_tokens, 1)
+
+    def chunk_copies(slot, b2, h2, c2):
+        out = []
+        for g in range(G):
+            pidx = jnp.maximum(tables_ref[b2 * max_pages + c2 * G + g], 0)
+            out.append(pltpu.make_async_copy(
+                k_hbm.at[pidx, h2], k_buf.at[slot, g], sem.at[slot, 0]))
+            out.append(pltpu.make_async_copy(
+                v_hbm.at[pidx, h2], v_buf.at[slot, g], sem.at[slot, 1]))
+        return out
+
+    def next_step(b2, h2, c2):
+        # lexicographic next VALID step in (b, h, chunk) grid order —
+        # chunks beyond a sequence's length are skipped by everyone
+        # (min 1 chunk per (b, h): matches n_chunks_b above)
+        nb = jnp.maximum((lens_ref[b2] + chunk_tokens - 1) // chunk_tokens, 1)
+        c3 = c2 + 1
+        roll_h = c3 >= nb
+        h3 = jnp.where(roll_h, h2 + 1, h2)
+        c3 = jnp.where(roll_h, 0, c3)
+        roll_b = h3 >= hkv
+        b3 = jnp.where(roll_b, b2 + 1, b2)
+        h3 = jnp.where(roll_b, 0, h3)
+        return b3, h3, c3
+
+    @pl.when(ci < n_chunks_b)
+    def _():
+        # very first valid step of the whole grid: no one prefetched for us
+        # (init flag arrives as a scalar-prefetch input set to 1 by the caller
+        # and is cleared here — SMEM scratch is NOT zero-initialized)
+        @pl.when(init_ref[0] == 1)
+        def _():
+            init_ref[0] = 0
+            buf_idx[0] = 0
+            for c in chunk_copies(0, bi, hi, ci):
+                c.start()
+
+        cur = buf_idx[0]
+        b3, h3, c3 = next_step(bi, hi, ci)
+
+        @pl.when(b3 < batch)
+        def _():
+            for c in chunk_copies(1 - cur, b3, h3, c3):
+                c.start()
+        for c in chunk_copies(cur, bi, hi, ci):
+            c.wait()
+        buf_idx[0] = 1 - cur
+
+        @pl.when(ci == 0)
+        def _():
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+            m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+            l_ref[...] = jnp.zeros_like(l_ref)
+
+        d = q_ref.shape[-1]
+        q = q_ref[0, 0].astype(jnp.float32) * scale        # [group, d]
+        kb = k_buf[cur].reshape(chunk_tokens, d).astype(jnp.float32)
+        vb = v_buf[cur].reshape(chunk_tokens, d).astype(jnp.float32)
+        s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # [group, CT]
+        pos = ci * chunk_tokens + jax.lax.broadcasted_iota(
+            jnp.int32, (group, chunk_tokens), 1)
+        s = jnp.where(pos < ctx, s, NEG_INF)
+
+        m_prev = m_ref[:, :1]                               # [group, 1]
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+        @pl.when(ci == n_chunks_b - 1)
+        def _():
+            l_fin = l_ref[:, :1]
+            l_safe = jnp.where(l_fin > 0, l_fin, 1.0)
+            o_ref[0, 0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+
+
+def paged_decode_attention(q, k_cache, v_cache, block_tables, context_lens,
+                           scale=None, pages_per_chunk: int = 4,
+                           interpret: bool = False):
+    """One-token-per-sequence paged decode.
+
+    q: [batch, q_heads, head_dim]; caches [num_pages, kv_heads, page, d];
+    block_tables [batch, max_pages_per_seq] int32; context_lens [batch] int32
+    (number of valid cache tokens INCLUDING the current position's k/v, which
+    must already be appended via append_paged_kv; rows with length 0 produce
+    undefined output). Returns [batch, hq, d].
+    """
+    b, hq, d = q.shape
+    n_pages, hkv, page, _ = k_cache.shape
+    group = hq // hkv
+    if scale is None:
+        scale = d ** -0.5
+    if not interpret and jax.default_backend() != "tpu":
+        return paged_decode_reference(q, k_cache, v_cache, block_tables,
+                                      context_lens, scale)
+    max_pages = block_tables.shape[1]
+    G = pages_per_chunk
+    while max_pages % G:
+        G -= 1
+    n_chunks = max_pages // G
+    qr = q.reshape(b, hkv, group, d)
+
+    kernel = functools.partial(
+        _paged_decode_kernel, page=page, G=G, max_pages=max_pages,
+        scale=float(scale), group=group, hkv=hkv, batch=b)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(b, hkv, n_chunks),
+        in_specs=[
+            pl.BlockSpec((1, 1, group, d), lambda bi, hi, ci, *_: (bi, hi, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, 1, group, d),
+                               lambda bi, hi, ci, *_: (bi, hi, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, G, page, d), k_cache.dtype),
+            pltpu.VMEM((2, G, page, d), v_cache.dtype),
+            pltpu.VMEM((group, d), jnp.float32),
+            pltpu.VMEM((group, 128), jnp.float32),
+            pltpu.VMEM((group, 128), jnp.float32),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, group, d), q.dtype),
+        # all three dims "arbitrary": the double-buffer prefetch chain carries
+        # SMEM/semaphore state ACROSS batch boundaries, so no grid dim may be
+        # split across megacores
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(context_lens, block_tables.reshape(-1),
+      jnp.zeros((1,), jnp.int32),   # buffer index
+      jnp.ones((1,), jnp.int32),    # init flag
+      qr, k_cache, v_cache)
+    return out.reshape(b, hq, d)
+
+
+# ---------------------------------------------------------------------------
+# cache maintenance (XLA scatters — bandwidth-bound, no kernel needed)
+# ---------------------------------------------------------------------------
+
+def append_paged_kv(k_cache, v_cache, k_new, v_new, block_tables, positions,
+                    seq_ids=None):
+    """Scatter new tokens into the page pool.
+
+    k_new/v_new: [n_tokens, kv_heads, d]; positions [n_tokens] absolute
+    position of each token within its sequence; seq_ids [n_tokens] row of
+    block_tables per token (defaults to arange — one token per sequence,
+    the decode step). Returns updated (k_cache, v_cache)."""
+    n_tokens = k_new.shape[0]
+    page = k_cache.shape[2]
+    if seq_ids is None:
+        seq_ids = jnp.arange(n_tokens, dtype=jnp.int32)
+    page_idx = block_tables[seq_ids, positions // page]      # [n]
+    offs = positions % page                                   # [n]
+    k_cache = k_cache.at[page_idx, :, offs, :].set(k_new)
+    v_cache = v_cache.at[page_idx, :, offs, :].set(v_new)
+    return k_cache, v_cache
+
+
+def gather_paged_kv(k_cache, v_cache, block_tables, max_len):
+    """Dense [b, max_len, hkv, d] views of the paged cache (prefill path /
+    debugging). max_len must be a multiple of page size."""
+    b = block_tables.shape[0]
+    page = k_cache.shape[2]
+    hkv, d = k_cache.shape[1], k_cache.shape[3]
+    n = max_len // page
+    tables = jnp.maximum(block_tables[:, :n], 0)
+    kg = jnp.swapaxes(k_cache[tables], 2, 3).reshape(b, max_len, hkv, d)
+    vg = jnp.swapaxes(v_cache[tables], 2, 3).reshape(b, max_len, hkv, d)
+    return kg, vg

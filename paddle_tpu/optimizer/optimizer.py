@@ -97,12 +97,23 @@ class Optimizer:
     def _update(self, grad, param_value, p: Tensor, lr):
         raise NotImplementedError
 
-    def _apply_weight_decay(self, p, g):
-        """L2 regularization folded into the gradient (reference 'weight_decay' regularizer)."""
+    def _l2_coeff(self) -> float:
+        """L2 regularization coefficient from ``weight_decay`` (a number, or a
+        regularizer object carrying a coefficient attribute). Decoupled-decay
+        optimizers (AdamW) handle decay inside ``_update`` instead."""
         wd = self._weight_decay
         if wd is None or isinstance(self, _DecoupledWeightDecay):
-            return g
-        coeff = wd if isinstance(wd, float) else getattr(wd, "_coeff", 0.0)
+            return 0.0
+        if isinstance(wd, (int, float)):
+            return float(wd)
+        for attr in ("_regularization_coeff", "_coeff"):
+            if hasattr(wd, attr):
+                return float(getattr(wd, attr))
+        return 0.0
+
+    def _apply_weight_decay(self, p, g):
+        """L2 regularization folded into the gradient (reference 'weight_decay' regularizer)."""
+        coeff = self._l2_coeff()
         if coeff:
             return g + coeff * p._data.astype(g.dtype)
         return g
@@ -135,12 +146,16 @@ class Optimizer:
         saved_acc, saved_step = self._accumulators, self._step_count
         self._accumulators = acc_state
         self._step_count = step
+        # L2 regularizer coefficient (decoupled decay lives in AdamW._update)
+        l2 = self._l2_coeff()
         try:
             new_vals = []
             for g, v, p in zip(grads, values, params):
                 if g is None:
                     new_vals.append(v)
                     continue
+                if l2:
+                    g = g + l2 * v.astype(g.dtype)
                 out = self._update(g, v, p, lr)
                 new_vals.append(out.astype(v.dtype) if out.dtype != v.dtype else out)
         finally:
@@ -373,11 +388,15 @@ class RAdam(Optimizer):
         rho_inf = 2 / (1 - self._beta2) - 1
         rho_t = rho_inf - 2 * t * self._beta2**t / (1 - self._beta2**t)
         mhat = m / (1 - self._beta1**t)
-        if rho_t > 4:
-            vhat = jnp.sqrt(v / (1 - self._beta2**t))
-            r = (((rho_t - 4) * (rho_t - 2) * rho_inf) / ((rho_inf - 4) * (rho_inf - 2) * rho_t)) ** 0.5
-            return val - (lr * r * mhat / (vhat + self._epsilon)).astype(val.dtype)
-        return val - (lr * mhat).astype(val.dtype)
+        # branch written with jnp.where so `t` may be a traced step counter
+        # (jitted Engine/hapi path) as well as a python int (eager step())
+        vhat = jnp.sqrt(v / (1 - self._beta2**t))
+        ratio = ((rho_t - 4) * (rho_t - 2) * rho_inf) / (
+            (rho_inf - 4) * (rho_inf - 2) * rho_t)
+        r = jnp.sqrt(jnp.maximum(ratio, 1e-16))
+        adaptive = val - (lr * r * mhat / (vhat + self._epsilon)).astype(val.dtype)
+        plain = val - (lr * mhat).astype(val.dtype)
+        return jnp.where(rho_t > 4, adaptive, plain)
 
 
 class Lamb(Optimizer):

