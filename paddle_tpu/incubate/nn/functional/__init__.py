@@ -219,24 +219,398 @@ def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train", name=
     return F.dropout(x, p, training=training, mode=mode) + y
 
 
-def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=False, **kw):
-    raise NotImplementedError("use nn.MultiHeadAttention (XLA/Pallas fused) — tracked in docs/PARITY.md")
+def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=False,
+                               pre_ln_scale=None, pre_ln_bias=None, ln_scale=None,
+                               ln_bias=None, pre_ln_epsilon=1e-5, qkv_bias=None,
+                               linear_bias=None, cache_kv=None, attn_mask=None,
+                               dropout_rate=0.5, attn_dropout_rate=0.5,
+                               ln_epsilon=1e-5, training=True,
+                               mode="upscale_in_train", ring_id=-1,
+                               add_residual=True, num_heads=-1,
+                               transpose_qkv_wb=False, name=None):
+    """Fused self-attention block (reference:
+    incubate/nn/functional/fused_transformer.py:513 — pseudo code at :546).
+
+    x: [b, s, dim]. qkv_weight: [3, nh, hd, dim] (or [dim, 3*dim] when
+    ``transpose_qkv_wb``, which requires ``num_heads``). cache_kv
+    [2, b, nh, cache_len, hd] appends this call's K/V (generation); the
+    updated cache is written back into the ``cache_kv`` tensor (reference
+    in-place contract) and attention spans cache + current."""
+
+    def fn(xx, qkvw, lw, *rest):
+        names = []
+        if pre_layer_norm and pre_ln_scale is not None:
+            names += ["pls", "plb"]
+        if qkv_bias is not None:
+            names += ["qb"]
+        if linear_bias is not None:
+            names += ["lb"]
+        if cache_kv is not None:
+            names += ["cache"]
+        if attn_mask is not None:
+            names += ["mask"]
+        if not pre_layer_norm and ln_scale is not None:
+            names += ["lns", "lnb"]
+        r = dict(zip(names, rest))
+
+        b, s, dim = xx.shape
+        residual = xx
+        h = xx
+        if pre_layer_norm:
+            mean = jnp.mean(h, -1, keepdims=True)
+            var = jnp.var(h, -1, keepdims=True)
+            h = (h - mean) * jax.lax.rsqrt(var + pre_ln_epsilon)
+            if "pls" in r:
+                h = h * r["pls"] + r["plb"]
+        if transpose_qkv_wb:
+            if num_heads is None or num_heads <= 0:
+                raise ValueError(
+                    "fused_multi_head_attention(transpose_qkv_wb=True) "
+                    "requires num_heads (the 2-D qkv weight cannot infer it)")
+            nh = num_heads
+            hd = dim // nh
+            qkv = jnp.matmul(h, qkvw)                     # [b, s, 3*dim]
+            if "qb" in r:
+                qkv = qkv + r["qb"]
+            qkv = qkv.reshape(b, s, 3, nh, hd)
+        else:
+            _, nh, hd, _ = qkvw.shape
+            qkv = jnp.einsum("bsd,tnhd->bstnh", h, qkvw)  # [b, s, 3, nh, hd]
+            if "qb" in r:
+                qkv = qkv + r["qb"][None, None]
+        q = jnp.swapaxes(qkv[:, :, 0], 1, 2)              # [b, nh, s, hd]
+        k = jnp.swapaxes(qkv[:, :, 1], 1, 2)
+        v = jnp.swapaxes(qkv[:, :, 2], 1, 2)
+        new_cache = None
+        if "cache" in r:
+            k = jnp.concatenate([r["cache"][0], k], axis=2)
+            v = jnp.concatenate([r["cache"][1], v], axis=2)
+            new_cache = jnp.stack([k, v])
+        logits = jnp.einsum("bnqh,bnkh->bnqk", q, k).astype(jnp.float32) * (hd ** -0.5)
+        if "mask" in r:
+            m = r["mask"]
+            if m.dtype == jnp.bool_:
+                m = jnp.where(m, 0.0, -1e9)
+            elif jnp.issubdtype(m.dtype, jnp.integer):
+                m = jnp.where(m != 0, 0.0, -1e9)
+            logits = logits + m.astype(jnp.float32)
+        probs = jax.nn.softmax(logits, -1).astype(xx.dtype)
+        if attn_dropout_rate and training:
+            from ....framework.random import next_key
+
+            keep = jax.random.bernoulli(next_key(), 1.0 - attn_dropout_rate, probs.shape)
+            probs = jnp.where(keep, probs / (1.0 - attn_dropout_rate), 0.0)
+        ctx = jnp.einsum("bnqk,bnkh->bnqh", probs, v)
+        ctx = jnp.swapaxes(ctx, 1, 2).reshape(b, s, dim)
+        out = jnp.matmul(ctx, lw)
+        if "lb" in r:
+            out = out + r["lb"]
+        if dropout_rate and training:
+            from ....framework.random import next_key
+
+            keep = jax.random.bernoulli(next_key(), 1.0 - dropout_rate, out.shape)
+            out = jnp.where(keep, out / (1.0 - dropout_rate), 0.0)
+        if add_residual:
+            out = residual + out
+        if not pre_layer_norm:
+            mean = jnp.mean(out, -1, keepdims=True)
+            var = jnp.var(out, -1, keepdims=True)
+            out = (out - mean) * jax.lax.rsqrt(var + ln_epsilon)
+            if "lns" in r:
+                out = out * r["lns"] + r["lnb"]
+        if new_cache is not None:
+            return out, new_cache
+        return out
+
+    args = [x, qkv_weight, linear_weight]
+    if pre_layer_norm and pre_ln_scale is not None:
+        args += [pre_ln_scale, pre_ln_bias]
+    if qkv_bias is not None:
+        args += [qkv_bias]
+    if linear_bias is not None:
+        args += [linear_bias]
+    if cache_kv is not None:
+        args += [cache_kv]
+    if attn_mask is not None:
+        args += [attn_mask]
+    if not pre_layer_norm and ln_scale is not None:
+        args += [ln_scale, ln_bias]
+    res = apply_fn("fused_multi_head_attention", fn, *args)
+    if cache_kv is not None:
+        out, new_cache = res
+        cache_kv._data = new_cache._data  # reference in-place cache contract
+        return out, new_cache  # reference returns (final_out, cache_kv_out)
+    return res
 
 
-def fused_feedforward(x, linear1_weight, linear2_weight, **kw):
-    raise NotImplementedError("XLA fuses nn.Linear+act+Linear chains natively — tracked in docs/PARITY.md")
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu", ln1_epsilon=1e-5,
+                      ln2_epsilon=1e-5, pre_layer_norm=False, training=True,
+                      mode="upscale_in_train", ring_id=-1, add_residual=True,
+                      name=None):
+    """Fused transformer FFN block (reference:
+    incubate/nn/functional/fused_transformer.py:47): [LN ->] linear1 -> act ->
+    dropout1 -> linear2 -> dropout2 -> +residual [-> LN] in one traced region."""
+
+    def fn(xx, w1, w2, *rest):
+        names = []
+        if linear1_bias is not None:
+            names += ["b1"]
+        if linear2_bias is not None:
+            names += ["b2"]
+        if ln1_scale is not None:
+            names += ["s1", "bb1"]
+        if ln2_scale is not None:
+            names += ["s2", "bb2"]
+        r = dict(zip(names, rest))
+
+        def ln(t, scale, bias, eps):
+            mean = jnp.mean(t, -1, keepdims=True)
+            var = jnp.var(t, -1, keepdims=True)
+            out = (t - mean) * jax.lax.rsqrt(var + eps)
+            if scale is not None:
+                out = out * scale + bias
+            return out
+
+        def drop(t, rate):
+            if rate and training:
+                from ....framework.random import next_key
+
+                keep = jax.random.bernoulli(next_key(), 1.0 - rate, t.shape)
+                return jnp.where(keep, t / (1.0 - rate), 0.0)
+            return t
+
+        residual = xx
+        h = xx
+        if pre_layer_norm:
+            h = ln(h, r.get("s1"), r.get("bb1"), ln1_epsilon)
+        h = jnp.matmul(h, w1)
+        if "b1" in r:
+            h = h + r["b1"]
+        act = getattr(jax.nn, activation, None)
+        if act is None:
+            raise ValueError(f"fused_feedforward: unknown activation "
+                             f"'{activation}' (not a jax.nn function)")
+        h = act(h)
+        h = drop(h, dropout1_rate)
+        h = jnp.matmul(h, w2)
+        if "b2" in r:
+            h = h + r["b2"]
+        h = drop(h, dropout2_rate)
+        if add_residual:
+            h = residual + h
+        if not pre_layer_norm:  # post-LN architecture normalizes with ln2
+            h = ln(h, r.get("s2"), r.get("bb2"), ln2_epsilon)
+        return h
+
+    args = [x, linear1_weight, linear2_weight]
+    for t in (linear1_bias, linear2_bias):
+        if t is not None:
+            args.append(t)
+    if ln1_scale is not None:
+        args += [ln1_scale, ln1_bias]
+    if ln2_scale is not None:
+        args += [ln2_scale, ln2_bias]
+    return apply_fn("fused_feedforward", fn, *args)
 
 
-def masked_multihead_attention(x, cache_kv=None, **kw):
-    raise NotImplementedError("decode-time MHA lands with the serving suite — see ops/paged_attention")
+def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
+                               cum_offsets=None, sequence_lengths=None,
+                               rotary_tensor=None, beam_cache_offset=None,
+                               qkv_out_scale=None, out_shift=None, out_smooth=None,
+                               seq_len=1, rotary_emb_dims=0,
+                               use_neox_rotary_style=False,
+                               compute_dtype="default", out_scale=-1,
+                               quant_round_type=1, quant_max_bound=127.0,
+                               quant_min_bound=-127.0):
+    """Decode-time fused MHA over a dense KV cache (reference:
+    incubate/nn/functional/masked_multihead_attention.py:74 over
+    masked_multihead_attention_kernel.cu).
+
+    x: [b, 3*nh*hd] — ONE new token per sequence. cache_kv:
+    [2, b, nh, max_seq_len, hd]. ``sequence_lengths`` [b] or [b, 1] gives each
+    row's current cache length (write position); attention spans positions
+    0..len inclusive. Returns (out [b, nh*hd], cache_kv) — the cache tensor is
+    also updated in place like the reference."""
+    if qkv_out_scale is not None or out_scale != -1:
+        raise NotImplementedError("masked_multihead_attention quantization")
+    if rotary_emb_dims:
+        raise NotImplementedError("masked_multihead_attention rotary path — "
+                                  "apply fused_rotary_position_embedding before")
+    if sequence_lengths is None:
+        raise ValueError(
+            "masked_multihead_attention requires sequence_lengths (each row's "
+            "current cache length / write position)")
+
+    def fn(xx, cache, lens, *rest):
+        names = []
+        if bias is not None:
+            names += ["bias"]
+        if src_mask is not None:
+            names += ["mask"]
+        r = dict(zip(names, rest))
+        _, b, nh, max_seq, hd = cache.shape
+        qkv = xx.reshape(b, 3, nh, hd)
+        if "bias" in r:
+            qkv = qkv + r["bias"].reshape(1, 3, nh, hd)
+        q, k_new, v_new = qkv[:, 0], qkv[:, 1], qkv[:, 2]   # [b, nh, hd]
+        pos = lens.reshape(b).astype(jnp.int32)
+        bidx = jnp.arange(b)
+        kc = cache[0].at[bidx, :, pos, :].set(k_new.astype(cache.dtype))
+        vc = cache[1].at[bidx, :, pos, :].set(v_new.astype(cache.dtype))
+        logits = jnp.einsum("bnh,bnsh->bns", q.astype(jnp.float32),
+                            kc.astype(jnp.float32)) * (hd ** -0.5)
+        valid = jnp.arange(max_seq)[None, None, :] <= pos[:, None, None]
+        logits = jnp.where(valid, logits, -1e30)
+        if "mask" in r:
+            m = r["mask"].reshape(b, 1, -1).astype(jnp.float32)
+            logits = logits + jnp.pad(m, ((0, 0), (0, 0), (0, max_seq - m.shape[-1])))
+        probs = jax.nn.softmax(logits, -1)
+        out = jnp.einsum("bns,bnsh->bnh", probs, vc.astype(jnp.float32))
+        return out.reshape(b, nh * hd).astype(xx.dtype), jnp.stack([kc, vc])
+
+    args = [x, cache_kv, sequence_lengths]
+    if bias is not None:
+        args.append(bias)
+    if src_mask is not None:
+        args.append(src_mask)
+    out, new_cache = apply_fn("masked_multihead_attention", fn, *args)
+    cache_kv._data = new_cache._data  # reference in-place cache contract
+    if beam_cache_offset is not None:
+        return out, new_cache, beam_cache_offset
+    return out, new_cache
 
 
 def variable_length_memory_efficient_attention(q, k, v, seq_lens=None, kv_seq_lens=None, mask=None, scale=None, causal=False):
     return F.scaled_dot_product_attention(q, k, v, attn_mask=mask, is_causal=causal)
 
 
-def block_multihead_attention(*args, **kw):
-    raise NotImplementedError("paged/block KV attention: ops/paged_attention (serving suite)")
+def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
+                              seq_lens_decoder, seq_lens_this_time,
+                              padding_offsets, cum_offsets, cu_seqlens_q,
+                              cu_seqlens_k, block_tables, pre_key_cache=None,
+                              pre_value_cache=None, cache_k_quant_scales=None,
+                              cache_v_quant_scales=None,
+                              cache_k_dequant_scales=None,
+                              cache_v_dequant_scales=None, qkv_out_scale=None,
+                              qkv_bias=None, out_shift=None, out_smooth=None,
+                              max_enc_len_this_time=None,
+                              max_dec_len_this_time=None, rope_emb=None,
+                              mask=None, tgt_mask=None, max_seq_len=-1,
+                              block_size=64, use_neox_style=False,
+                              use_dynamic_cachekv_quant=False,
+                              quant_round_type=1, quant_max_bound=127.0,
+                              quant_min_bound=-127.0, out_scale=-1,
+                              compute_dtype="default"):
+    """Paged (block) KV-cache attention for batched serving (reference:
+    incubate/nn/functional/block_multihead_attention.py:30 over
+    block_multi_head_attention_kernel.cu).
+
+    qkv: [token_num, (nh + 2*kv_nh)*hd] unpadded tokens; caches
+    [max_block_num, kv_nh, block_size, hd]; block_tables [b, pages_per_seq].
+    Per sequence: encoder rows (seq_lens_encoder > 0) prefill — K/V scattered
+    into their pages and causal self-attention over the prompt; decoder rows
+    (seq_lens_decoder > 0, one token this time) append at position
+    seq_lens_decoder[i] and run the paged decode kernel
+    (ops/paged_attention.py) over the whole cache. Returns
+    (out [token_num, nh*hd], qkv, key_cache, value_cache); caches are also
+    updated in place (reference contract). Quantized caches / pre-cache /
+    in-op rope are not supported (apply rope to qkv beforehand)."""
+    if any(t is not None for t in (cache_k_quant_scales, cache_v_quant_scales,
+                                   cache_k_dequant_scales, cache_v_dequant_scales,
+                                   qkv_out_scale, out_shift, out_smooth,
+                                   pre_key_cache, pre_value_cache)):
+        raise NotImplementedError("block_multihead_attention: quant/pre-cache")
+    if rope_emb is not None:
+        raise NotImplementedError("block_multihead_attention: in-op rope — "
+                                  "apply fused_rotary_position_embedding to qkv")
+    import numpy as np
+
+    from ....core.tensor import Tensor, unwrap
+    from ....ops.flash_attention import flash_attention
+    from ....ops.paged_attention import append_paged_kv, paged_decode_attention
+
+    qkv_arr = unwrap(qkv)
+    kc = unwrap(key_cache)
+    vc = unwrap(value_cache)
+    tables = unwrap(block_tables).astype(jnp.int32)
+    enc = np.asarray(unwrap(seq_lens_encoder)).reshape(-1)
+    dec = np.asarray(unwrap(seq_lens_decoder)).reshape(-1)
+    this_time = np.asarray(unwrap(seq_lens_this_time)).reshape(-1)
+    b = enc.shape[0]
+    kv_nh, hd = kc.shape[1], kc.shape[3]
+    nh = qkv_arr.shape[-1] // hd - 2 * kv_nh
+    group = nh // kv_nh
+
+    starts = np.concatenate([[0], np.cumsum(this_time)])
+    qkv3 = qkv_arr.reshape(-1, nh + 2 * kv_nh, hd)
+    if qkv_bias is not None:
+        qkv3 = qkv3 + unwrap(qkv_bias).reshape(1, nh + 2 * kv_nh, hd)
+    q_tok = qkv3[:, :nh]                   # [tokens, nh, hd]
+    k_tok = qkv3[:, nh:nh + kv_nh]
+    v_tok = qkv3[:, nh + kv_nh:]
+
+    # scatter every new token's K/V into its sequence's pages
+    seq_ids = np.repeat(np.arange(b), this_time).astype(np.int32)
+    pos_in_seq = np.concatenate(
+        [np.arange(t) + (dec[i] if dec[i] > 0 else 0)
+         for i, t in enumerate(this_time)]).astype(np.int32) if len(seq_ids) else np.zeros(0, np.int32)
+    kc, vc = append_paged_kv(kc, vc, k_tok.astype(kc.dtype),
+                             v_tok.astype(vc.dtype), tables,
+                             jnp.asarray(pos_in_seq), jnp.asarray(seq_ids))
+
+    out = jnp.zeros((qkv3.shape[0], nh, hd), qkv_arr.dtype)
+
+    # ---- decode rows: ONE batched paged-kernel call (the serving hot path)
+    dec_rows = np.nonzero((dec > 0) & (this_time == 1))[0]
+    if len(dec_rows):
+        ridx = jnp.asarray(dec_rows, jnp.int32)
+        tok_idx = jnp.asarray(starts[dec_rows], jnp.int32)
+        qd = q_tok[tok_idx]                             # [n, nh, hd]
+        ctx = jnp.asarray(dec[dec_rows] + 1, jnp.int32)
+        od = paged_decode_attention(qd, kc, vc, tables[ridx], ctx)
+        out = out.at[tok_idx].set(od.astype(out.dtype))
+
+    # ---- prefill rows (enc > 0) AND multi-token continuations (dec > 0 with
+    # several tokens this time — chunked prefill / speculative decode): the
+    # chunk attends the row's whole cache prefix + itself, end-aligned causal
+    from ....ops.paged_attention import gather_paged_kv
+
+    page = kc.shape[2]
+    chunk_rows = np.nonzero((enc > 0) | ((dec > 0) & (this_time > 1)))[0]
+    for i in chunk_rows:
+        s0, s1 = int(starts[i]), int(starts[i + 1])
+        n_new = s1 - s0
+        prefix = int(dec[i]) if dec[i] > 0 else 0
+        ctx = prefix + n_new
+        qp = q_tok[s0:s1][None]                          # [1, s, nh, hd]
+        if prefix:
+            # pages already hold prefix + the newly scattered chunk
+            kg, vg = gather_paged_kv(kc, vc, tables[i:i + 1],
+                                     tables.shape[1] * page)
+            kp, vp = kg[:, :ctx], vg[:, :ctx]
+        else:
+            kp, vp = k_tok[s0:s1][None], v_tok[s0:s1][None]
+        if mask is not None:
+            # mask path: dense fallback honoring the provided bias
+            m = unwrap(mask)[i, :, :n_new, :ctx][None]
+            logits = jnp.einsum("bqnh,bknh->bnqk", qp.astype(jnp.float32),
+                                jnp.repeat(kp, group, 2).astype(jnp.float32))
+            logits = logits * (hd ** -0.5) + m.astype(jnp.float32)
+            probs = jax.nn.softmax(logits, -1)
+            op = jnp.einsum("bnqk,bknh->bqnh", probs,
+                            jnp.repeat(vp, group, 2).astype(jnp.float32))[0]
+        else:
+            op = flash_attention(qp, kp, vp, causal=True)[0]
+        out = out.at[s0:s1].set(op.astype(out.dtype))
+
+    out = out.reshape(-1, nh * hd)
+    key_cache._data = kc    # reference in-place cache contract
+    value_cache._data = vc
+    return (Tensor(out), qkv if isinstance(qkv, Tensor) else Tensor(qkv_arr),
+            key_cache, value_cache)
 
 
 def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
@@ -290,16 +664,23 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
 
     ``num_heads`` is required (the reference reads it from the qkv weight's
     4-D [3, nh, hd, h] layout; the 2-D layout here cannot infer it safely).
-    Incremental decoding (cache_kvs/time_step) is not implemented."""
+
+    Incremental decoding: ``cache_kvs`` is a per-layer list of dense caches
+    [2, b, nh, max_seq, hd]. ``time_step=None`` prefills (writes positions
+    0..s-1); an integer/Tensor time_step decodes at that position attending
+    over the whole cache prefix. Caches update in place (reference contract)."""
+    from ....core.tensor import unwrap
     from ....nn import functional as F
     from ....tensor import add, reshape, split
 
-    if cache_kvs is not None or time_step is not None:
-        raise NotImplementedError(
-            "fused_multi_transformer: cache_kvs/time_step (incremental "
-            "decoding) not supported — use the model-level kv-cache path")
+    import numpy as np
+
     if num_heads is None:
         raise ValueError("fused_multi_transformer requires num_heads")
+    pos0 = 0
+    if time_step is not None:
+        pos0 = (time_step if isinstance(time_step, int)
+                else int(np.asarray(unwrap(time_step)).reshape(-1)[0]))
 
     def _drop(t):
         if dropout_rate and training:
@@ -320,9 +701,25 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
         hd = d // nh
         q, k, v = split(qkv, 3, axis=-1)
         b, s = q.shape[0], q.shape[1]
+        q4 = reshape(q, [b, s, nh, hd])
+        k4 = reshape(k, [b, s, nh, hd])
+        v4 = reshape(v, [b, s, nh, hd])
+        if cache_kvs is not None:
+            # write this chunk at positions pos0..pos0+s-1, attend over the
+            # whole prefix (end-aligned causal handles kv_len > q_len)
+            cache = unwrap(cache_kvs[i])                  # [2, b, nh, max, hd]
+            knew = jnp.swapaxes(unwrap(k4), 1, 2)         # [b, nh, s, hd]
+            vnew = jnp.swapaxes(unwrap(v4), 1, 2)
+            kc = jax.lax.dynamic_update_slice(
+                cache[0], knew.astype(cache.dtype), (0, 0, pos0, 0))
+            vc = jax.lax.dynamic_update_slice(
+                cache[1], vnew.astype(cache.dtype), (0, 0, pos0, 0))
+            cache_kvs[i]._data = jnp.stack([kc, vc])      # in-place contract
+            from ....core.tensor import Tensor as _T
+            k4 = _T(jnp.swapaxes(kc[:, :, : pos0 + s], 1, 2))
+            v4 = _T(jnp.swapaxes(vc[:, :, : pos0 + s], 1, 2))
         attn = F.scaled_dot_product_attention(
-            reshape(q, [b, s, nh, hd]), reshape(k, [b, s, nh, hd]),
-            reshape(v, [b, s, nh, hd]), attn_mask=attn_mask,
+            q4, k4, v4, attn_mask=attn_mask,
             is_causal=attn_mask is None)
         out = _drop(fused_matmul_bias(reshape(attn, [b, s, d]),
                                       linear_weights[i], linear_biases[i]))

@@ -98,10 +98,16 @@ class GenerationMixin:
         cache[key] = (prefill, block)
         return prefill, block
 
+    def _init_paged_caches(self, b, max_len, page_size=64):
+        raise NotImplementedError(
+            f"{type(self).__name__} has no paged-KV cache path "
+            "(cache_impl='paged'); use the default dense caches")
+
     def generate(self, input_ids, max_new_tokens: int = 32,
                  temperature: float = 1.0, top_p: float = None,
                  eos_token_id: int = None, seed: int = 0,
-                 attention_mask=None, max_length: int = None):
+                 attention_mask=None, max_length: int = None,
+                 cache_impl: str = "dense", page_size: int = 64):
         """KV-cache autoregressive generation (greedy / temperature / top-p).
 
         Batches of unequal prompt lengths use LEFT padding +
@@ -126,7 +132,14 @@ class GenerationMixin:
         self._validate_generate(prompt_len, prompt_len + max_new_tokens)
         _, tensors = _collect_state(self)
         params = [t._data for t in tensors]
-        caches = self._init_caches(b, max_len)
+        if cache_impl == "paged":
+            if attention_mask is not None:
+                raise ValueError(
+                    "cache_impl='paged' does not support attention_mask "
+                    "(left padding) yet — use equal-length prompts")
+            caches = self._init_paged_caches(b, max_len, page_size)
+        else:
+            caches = self._init_caches(b, max_len)
 
         if attention_mask is not None:
             m = (attention_mask._data if isinstance(attention_mask, Tensor)

@@ -189,6 +189,38 @@ class LlamaAttention(Layer):
         out = out.reshape(b, s, self.num_heads * hd)
         return jnp.matmul(out, self.o_proj_weight._data), k_cache, v_cache
 
+    def paged_decode_step(self, x, cos, sin, k_pages, v_pages, tables, pos):
+        """Paged-KV generation step (serving suite, ops/paged_attention.py).
+
+        Pools [num_pages, kv_heads, page, hd]; tables [b, pages_per_seq].
+        Prefill chunks (s > 1, pos == 0) run causal flash over the chunk;
+        decode steps (s == 1) run the paged decode kernel over the whole
+        cache. K/V always scatter into the pages. Returns (out, k_pages,
+        v_pages)."""
+        from ...ops.flash_attention import flash_attention
+        from ...ops.paged_attention import append_paged_kv, paged_decode_attention
+
+        x = x._data if isinstance(x, Tensor) else x
+        b, s, _ = x.shape
+        hd = self.config.head_dim
+        q = jnp.matmul(x, self.q_proj_weight._data).reshape(b, s, self.num_heads, hd)
+        k = jnp.matmul(x, self.k_proj_weight._data).reshape(b, s, self.num_kv_heads, hd)
+        v = jnp.matmul(x, self.v_proj_weight._data).reshape(b, s, self.num_kv_heads, hd)
+        q, k = apply_rotary_pos_emb(q, k, cos, sin)
+        seq_ids = jnp.repeat(jnp.arange(b, dtype=jnp.int32), s)
+        positions = jnp.tile(pos + jnp.arange(s, dtype=jnp.int32), b)
+        k_pages, v_pages = append_paged_kv(
+            k_pages, v_pages, k.reshape(b * s, self.num_kv_heads, hd),
+            v.reshape(b * s, self.num_kv_heads, hd), tables, positions, seq_ids)
+        if s == 1:
+            ctx = jnp.full((b,), pos + 1, jnp.int32)
+            out = paged_decode_attention(q[:, 0], k_pages, v_pages, tables,
+                                         ctx)[:, None]
+        else:
+            out = flash_attention(q, k, v, causal=True)
+        out = out.reshape(b, s, self.num_heads * hd)
+        return jnp.matmul(out, self.o_proj_weight._data), k_pages, v_pages
+
 
 def _attention(q, k, v, config, attn_bias=None):
     """Causal attention on raw arrays; routes to the Pallas kernel on TPU.
@@ -317,6 +349,15 @@ class LlamaDecoderLayer(Layer):
         x = x + (y._data if isinstance(y, Tensor) else y)
         return x, k_cache, v_cache
 
+    def paged_decode_step(self, hidden, cos, sin, k_pages, v_pages, tables, pos):
+        x = hidden._data if isinstance(hidden, Tensor) else hidden
+        a, k_pages, v_pages = self.self_attn.paged_decode_step(
+            self.input_layernorm(x), cos, sin, k_pages, v_pages, tables, pos)
+        x = x + a
+        y = self.mlp(self.post_attention_layernorm(x))
+        x = x + (y._data if isinstance(y, Tensor) else y)
+        return x, k_pages, v_pages
+
 
 class LlamaModel(Layer):
     def __init__(self, config: LlamaConfig):
@@ -404,6 +445,27 @@ def _decode_model(model: "LlamaModel", ids, caches, pos, pad_bias=None,
     return model.norm(x), new_caches
 
 
+def _decode_model_paged(model: "LlamaModel", ids, caches, pos):
+    """Paged-KV chunk decode: caches = {"kv": [(k_pages, v_pages)] per layer,
+    "tables": [b, pages_per_seq]}. Left padding is not supported on this path
+    (generate() rejects attention_mask with cache_impl='paged')."""
+    cfg = model.config
+    x = jnp.take(model.embed_tokens_weight._data, ids, axis=0)
+    tables = caches["tables"]
+    page = caches["kv"][0][0].shape[2]
+    max_len = tables.shape[1] * page
+    cos_full, sin_full = _rope_cos_sin(max_len, cfg.head_dim, cfg.rope_theta,
+                                       x.dtype)
+    s = ids.shape[1]
+    cos = jax.lax.dynamic_slice_in_dim(cos_full, pos, s, 0)
+    sin = jax.lax.dynamic_slice_in_dim(sin_full, pos, s, 0)
+    new_kv = []
+    for layer, (kp, vp) in zip(model.layers, caches["kv"]):
+        x, kp, vp = layer.paged_decode_step(x, cos, sin, kp, vp, tables, pos)
+        new_kv.append((kp, vp))
+    return model.norm(x), {"kv": new_kv, "tables": tables}
+
+
 class LlamaForCausalLM(GenerationMixin, Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__()
@@ -442,9 +504,28 @@ class LlamaForCausalLM(GenerationMixin, Layer):
             return 0.0
         return getattr(self.model, "_moe_aux", 0.0)
 
+    def _init_paged_caches(self, b, max_len, page_size=64):
+        """Paged-KV pools for ``generate(cache_impl='paged')`` — the serving
+        layout (ops/paged_attention.py): per-layer page pools + a shared block
+        table, pages allocated per sequence."""
+        cfg = self.config
+        kvh = getattr(cfg, "num_key_value_heads", cfg.num_attention_heads)
+        hd = cfg.head_dim
+        dtype = next(iter(p._data.dtype for _, p in self.named_parameters()))
+        maxp = -(-max_len // page_size)
+        npages = b * maxp
+        tables = jnp.arange(npages, dtype=jnp.int32).reshape(b, maxp)
+        kv = [(jnp.zeros((npages, kvh, page_size, hd), dtype),
+               jnp.zeros((npages, kvh, page_size, hd), dtype))
+              for _ in range(cfg.num_hidden_layers)]
+        return {"kv": kv, "tables": tables}
+
     def _decode_chunk(self, ids, caches, pos, pad_bias, pos_offset):
-        hidden, caches = _decode_model(self.model, ids, caches, pos,
-                                       pad_bias, pos_offset)
+        if isinstance(caches, dict):  # paged-KV serving path
+            hidden, caches = _decode_model_paged(self.model, ids, caches, pos)
+        else:
+            hidden, caches = _decode_model(self.model, ids, caches, pos,
+                                           pad_bias, pos_offset)
         hidden = hidden._data if isinstance(hidden, Tensor) else hidden
         # lm head only on the position we sample from
         logits = self.logits(hidden[:, -1:])

@@ -194,8 +194,8 @@ def paged_decode_attention(q, k_cache, v_cache, block_tables, context_lens,
         grid=(b, hkv, n_chunks),
         in_specs=[
             pl.BlockSpec((1, 1, group, d), lambda bi, hi, ci, *_: (bi, hi, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=pl.BlockSpec((1, 1, group, d),
                                lambda bi, hi, ci, *_: (bi, hi, 0, 0)),

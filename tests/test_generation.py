@@ -159,3 +159,26 @@ def test_top_p_sampling_generation(model):
     out2 = m.generate(paddle.to_tensor(ids), max_new_tokens=4,
                       temperature=0.8, top_p=0.9, seed=7)
     np.testing.assert_array_equal(out.numpy(), out2.numpy())
+
+
+def test_paged_generation_matches_dense(model):
+    """cache_impl='paged' (serving suite: page pools + block tables + paged
+    decode kernel) must produce exactly the dense-cache greedy tokens."""
+    cfg, m = model
+    rng = np.random.default_rng(4)
+    ids = rng.integers(0, cfg.vocab_size, (3, 9)).astype(np.int32)
+    dense = m.generate(paddle.to_tensor(ids), max_new_tokens=7,
+                       temperature=0.0).numpy()
+    paged = m.generate(paddle.to_tensor(ids), max_new_tokens=7,
+                       temperature=0.0, cache_impl="paged",
+                       page_size=8).numpy()
+    np.testing.assert_array_equal(paged, dense)
+
+
+def test_paged_generation_rejects_mask(model):
+    cfg, m = model
+    ids = np.zeros((2, 4), np.int32)
+    mask = np.ones((2, 4), np.int32)
+    with pytest.raises(ValueError, match="paged"):
+        m.generate(paddle.to_tensor(ids), max_new_tokens=2,
+                   attention_mask=paddle.to_tensor(mask), cache_impl="paged")

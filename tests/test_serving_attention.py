@@ -1,0 +1,399 @@
+"""Serving-attention suite: paged/block KV cache, masked decode MHA, fused
+transformer blocks (reference: incubate/nn/functional/block_multihead_attention,
+masked_multihead_attention, fused_transformer; kernels
+phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu etc.).
+
+Pattern per SURVEY §4: every fused op is compared against a plain dense
+composition on the same inputs.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.incubate.nn.functional as IF
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops.paged_attention import (
+    append_paged_kv,
+    gather_paged_kv,
+    paged_decode_attention,
+    paged_decode_reference,
+)
+
+
+def _rand(shape, seed, dtype=jnp.float32):
+    return jax.random.normal(jax.random.PRNGKey(seed), shape, dtype)
+
+
+def _dense_attn(q, k, v, causal=True):
+    """[b,s,h,d] reference attention."""
+    from paddle_tpu.ops.flash_attention import _xla_reference
+
+    return _xla_reference(q, k, v, causal, q.shape[-1] ** -0.5)
+
+
+# ---------------------------------------------------------------------------
+# paged decode kernel
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("group", [1, 4])
+def test_paged_decode_matches_reference(group):
+    rng = np.random.default_rng(0)
+    b, hkv, d, page, maxp, npages = 3, 2, 64, 16, 4, 16
+    hq = hkv * group
+    q = _rand((b, hq, d), 0)
+    kc = _rand((npages, hkv, page, d), 1)
+    vc = _rand((npages, hkv, page, d), 2)
+    tables = jnp.asarray(rng.permutation(npages)[: b * maxp].reshape(b, maxp),
+                         jnp.int32)
+    lens = jnp.asarray([37, 16, 5], jnp.int32)
+    ref = paged_decode_reference(q, kc, vc, tables, lens)
+    out = paged_decode_attention(q, kc, vc, tables, lens, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_paged_decode_zero_length_neighbors_intact():
+    rng = np.random.default_rng(0)
+    b, hq, hkv, d, page, maxp, npages = 3, 8, 2, 64, 16, 4, 16
+    q = _rand((b, hq, d), 0)
+    kc = _rand((npages, hkv, page, d), 1)
+    vc = _rand((npages, hkv, page, d), 2)
+    tables = jnp.asarray(rng.permutation(npages)[: b * maxp].reshape(b, maxp),
+                         jnp.int32)
+    lens = jnp.asarray([37, 0, 23], jnp.int32)  # empty middle row
+    ref = paged_decode_reference(q, kc, vc, tables, lens)
+    out = paged_decode_attention(q, kc, vc, tables, lens, interpret=True)
+    for i in (0, 2):  # row 1 is documented-undefined
+        np.testing.assert_allclose(np.asarray(out[i]), np.asarray(ref[i]),
+                                   atol=2e-5)
+
+
+def test_append_and_gather_paged_kv_roundtrip():
+    rng = np.random.default_rng(1)
+    b, hkv, d, page, maxp, npages = 3, 2, 32, 8, 4, 12
+    kc = jnp.zeros((npages, hkv, page, d))
+    vc = jnp.zeros((npages, hkv, page, d))
+    tables = jnp.asarray(rng.permutation(npages).reshape(-1)[: b * maxp]
+                         .reshape(b, maxp), jnp.int32)
+    lens = np.array([5, 17, 2])
+    # prefill-style append: per-seq token runs
+    seq_ids = jnp.asarray(np.repeat(np.arange(b), lens), jnp.int32)
+    pos = jnp.asarray(np.concatenate([np.arange(n) for n in lens]), jnp.int32)
+    kn = _rand((int(lens.sum()), hkv, d), 3)
+    vn = _rand((int(lens.sum()), hkv, d), 4)
+    kc, vc = append_paged_kv(kc, vc, kn, vn, tables, pos, seq_ids)
+    kg, vg = gather_paged_kv(kc, vc, tables, maxp * page)
+    off = 0
+    for i, n in enumerate(lens):
+        np.testing.assert_allclose(np.asarray(kg[i, :n]),
+                                   np.asarray(kn[off:off + n]))
+        np.testing.assert_allclose(np.asarray(vg[i, :n]),
+                                   np.asarray(vn[off:off + n]))
+        off += n
+
+
+# ---------------------------------------------------------------------------
+# block_multihead_attention (the serving entry point)
+# ---------------------------------------------------------------------------
+
+def _make_blha_batch(lens_np, kv_nh, nh, hd, page, maxp, mode, seed=0):
+    """Build reference-layout inputs for block_multihead_attention."""
+    b = len(lens_np)
+    npages = b * maxp
+    rng = np.random.default_rng(seed)
+    tables = jnp.asarray(rng.permutation(npages).reshape(b, maxp), jnp.int32)
+    kc = jnp.zeros((npages, kv_nh, page, hd))
+    vc = jnp.zeros((npages, kv_nh, page, hd))
+    if mode == "prefill":
+        this_time = lens_np
+        enc = lens_np
+        dec = np.zeros(b, np.int64)
+    else:
+        this_time = np.ones(b, np.int64)
+        enc = np.zeros(b, np.int64)
+        dec = lens_np
+    tok = int(this_time.sum())
+    qkv = _rand((tok, (nh + 2 * kv_nh) * hd), seed + 1)
+    cu_q = np.concatenate([[0], np.cumsum(this_time)])
+    return dict(
+        qkv=Tensor(qkv), key_cache=Tensor(kc), value_cache=Tensor(vc),
+        seq_lens_encoder=Tensor(jnp.asarray(enc, jnp.int32)[:, None]),
+        seq_lens_decoder=Tensor(jnp.asarray(dec, jnp.int32)[:, None]),
+        seq_lens_this_time=Tensor(jnp.asarray(this_time, jnp.int32)[:, None]),
+        padding_offsets=Tensor(jnp.zeros((tok,), jnp.int32)),
+        cum_offsets=Tensor(jnp.zeros((b,), jnp.int32)),
+        cu_seqlens_q=Tensor(jnp.asarray(cu_q, jnp.int32)[:, None]),
+        cu_seqlens_k=Tensor(jnp.asarray(cu_q, jnp.int32)[:, None]),
+        block_tables=Tensor(tables),
+        block_size=page,
+    )
+
+
+def test_blha_prefill_matches_dense_and_fills_cache():
+    kv_nh, nh, hd, page, maxp = 2, 4, 32, 8, 8
+    lens = np.array([12, 7, 20])
+    kw = _make_blha_batch(lens, kv_nh, nh, hd, page, maxp, "prefill")
+    out, _, kc2, vc2 = IF.block_multihead_attention(**kw)
+    qkv = kw["qkv"].numpy().reshape(-1, nh + 2 * kv_nh, hd)
+    starts = np.concatenate([[0], np.cumsum(lens)])
+    for i, n in enumerate(lens):
+        s0, s1 = starts[i], starts[i + 1]
+        q = jnp.asarray(qkv[s0:s1, :nh])[None]
+        k = jnp.asarray(qkv[s0:s1, nh:nh + kv_nh])[None]
+        v = jnp.asarray(qkv[s0:s1, nh + kv_nh:])[None]
+        ref = _dense_attn(q, k, v, causal=True)[0].reshape(n, nh * hd)
+        np.testing.assert_allclose(out.numpy()[s0:s1], np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+    # cache got the prompt K/V
+    kg, _ = gather_paged_kv(kc2._data, vc2._data, kw["block_tables"]._data,
+                            maxp * page)
+    np.testing.assert_allclose(np.asarray(kg[0, :12]),
+                               qkv[:12, nh:nh + kv_nh], atol=1e-6)
+
+
+def test_blha_decode_matches_dense():
+    kv_nh, nh, hd, page, maxp = 2, 4, 32, 8, 8
+    prompt_lens = np.array([12, 7, 20])
+    kw = _make_blha_batch(prompt_lens, kv_nh, nh, hd, page, maxp, "prefill")
+    IF.block_multihead_attention(**kw)  # fills caches in place
+
+    dec_kw = _make_blha_batch(prompt_lens, kv_nh, nh, hd, page, maxp,
+                              "decode", seed=7)
+    dec_kw["key_cache"] = kw["key_cache"]      # carry the filled caches
+    dec_kw["value_cache"] = kw["value_cache"]
+    dec_kw["block_tables"] = kw["block_tables"]
+    out, _, _, _ = IF.block_multihead_attention(**dec_kw)
+
+    prompt_qkv = kw["qkv"].numpy().reshape(-1, nh + 2 * kv_nh, hd)
+    dec_qkv = dec_kw["qkv"].numpy().reshape(-1, nh + 2 * kv_nh, hd)
+    starts = np.concatenate([[0], np.cumsum(prompt_lens)])
+    for i, n in enumerate(prompt_lens):
+        s0, s1 = starts[i], starts[i + 1]
+        k_full = np.concatenate([prompt_qkv[s0:s1, nh:nh + kv_nh],
+                                 dec_qkv[i:i + 1, nh:nh + kv_nh]])
+        v_full = np.concatenate([prompt_qkv[s0:s1, nh + kv_nh:],
+                                 dec_qkv[i:i + 1, nh + kv_nh:]])
+        q = jnp.asarray(dec_qkv[i:i + 1, :nh])[None]
+        ref = _dense_attn(q, jnp.asarray(k_full)[None],
+                          jnp.asarray(v_full)[None], causal=True)[0]
+        np.testing.assert_allclose(out.numpy()[i], np.asarray(ref).reshape(-1),
+                                   atol=2e-5, rtol=2e-5)
+
+
+def test_blha_mixed_prefill_decode_batch():
+    kv_nh, nh, hd, page, maxp = 1, 2, 32, 8, 8
+    # seq 0 decodes (8 cached), seq 1 prefills 5 tokens
+    b = 2
+    rng = np.random.default_rng(3)
+    npages = b * maxp
+    tables = jnp.asarray(rng.permutation(npages).reshape(b, maxp), jnp.int32)
+    kc = jnp.zeros((npages, kv_nh, page, hd))
+    vc = jnp.zeros((npages, kv_nh, page, hd))
+    # pre-fill seq 0's cache with 8 random tokens
+    k_hist = _rand((8, kv_nh, hd), 11)
+    v_hist = _rand((8, kv_nh, hd), 12)
+    kc, vc = append_paged_kv(kc, vc, k_hist, v_hist, tables,
+                             jnp.arange(8, dtype=jnp.int32),
+                             jnp.zeros((8,), jnp.int32))
+    this_time = np.array([1, 5])
+    tok = 6
+    qkv = _rand((tok, (nh + 2 * kv_nh) * hd), 13)
+    cu = np.array([0, 1, 6])
+    out, _, _, _ = IF.block_multihead_attention(
+        Tensor(qkv), Tensor(kc), Tensor(vc),
+        Tensor(jnp.asarray([0, 5], jnp.int32)[:, None]),
+        Tensor(jnp.asarray([8, 0], jnp.int32)[:, None]),
+        Tensor(jnp.asarray(this_time, jnp.int32)[:, None]),
+        Tensor(jnp.zeros((tok,), jnp.int32)), Tensor(jnp.zeros((b,), jnp.int32)),
+        Tensor(jnp.asarray(cu, jnp.int32)[:, None]),
+        Tensor(jnp.asarray(cu, jnp.int32)[:, None]),
+        Tensor(tables), block_size=page)
+    qkv3 = np.asarray(qkv).reshape(tok, nh + 2 * kv_nh, hd)
+    # decode row
+    kf = np.concatenate([np.asarray(k_hist), qkv3[0:1, nh:nh + kv_nh]])
+    vf = np.concatenate([np.asarray(v_hist), qkv3[0:1, nh + kv_nh:]])
+    ref0 = _dense_attn(jnp.asarray(qkv3[0:1, :nh])[None],
+                       jnp.asarray(kf)[None], jnp.asarray(vf)[None])[0]
+    np.testing.assert_allclose(out.numpy()[0], np.asarray(ref0).reshape(-1),
+                               atol=2e-5, rtol=2e-5)
+    # prefill row
+    ref1 = _dense_attn(jnp.asarray(qkv3[1:, :nh])[None],
+                       jnp.asarray(qkv3[1:, nh:nh + kv_nh])[None],
+                       jnp.asarray(qkv3[1:, nh + kv_nh:])[None])[0]
+    np.testing.assert_allclose(out.numpy()[1:], np.asarray(ref1).reshape(5, -1),
+                               atol=2e-5, rtol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# masked_multihead_attention (dense-cache decode)
+# ---------------------------------------------------------------------------
+
+def test_mmha_matches_dense_and_updates_cache():
+    b, nh, hd, max_seq = 2, 4, 32, 16
+    lens = np.array([5, 9])
+    cache = np.zeros((2, b, nh, max_seq, hd), np.float32)
+    hist_k = np.asarray(_rand((b, nh, max_seq, hd), 0))
+    hist_v = np.asarray(_rand((b, nh, max_seq, hd), 1))
+    for i, n in enumerate(lens):
+        cache[0, i, :, :n] = hist_k[i, :, :n]
+        cache[1, i, :, :n] = hist_v[i, :, :n]
+    cache_t = Tensor(jnp.asarray(cache))
+    x = _rand((b, 3 * nh * hd), 2)
+    out, new_cache = IF.masked_multihead_attention(
+        Tensor(x), cache_t, sequence_lengths=Tensor(jnp.asarray(lens, jnp.int32)))
+    x3 = np.asarray(x).reshape(b, 3, nh, hd)
+    for i, n in enumerate(lens):
+        kf = np.concatenate([cache[0, i, :, :n], x3[i, 1][:, None]], axis=1)
+        vf = np.concatenate([cache[1, i, :, :n], x3[i, 2][:, None]], axis=1)
+        logits = np.einsum("nh,nsh->ns", x3[i, 0], kf) * hd ** -0.5
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ref = np.einsum("ns,nsh->nh", p, vf).reshape(-1)
+        np.testing.assert_allclose(out.numpy()[i], ref, atol=2e-5, rtol=2e-5)
+        # in-place cache update at position n
+        np.testing.assert_allclose(np.asarray(cache_t._data)[0, i, :, n],
+                                   x3[i, 1], atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# fused_multi_head_attention / fused_feedforward / fused_multi_transformer
+# ---------------------------------------------------------------------------
+
+def test_fused_mha_matches_composition():
+    b, s, nh, hd = 2, 6, 2, 16
+    dim = nh * hd
+    x = _rand((b, s, dim), 0)
+    qkvw = _rand((3, nh, hd, dim), 1) * 0.2
+    lw = _rand((dim, dim), 2) * 0.2
+    out = IF.fused_multi_head_attention(
+        Tensor(x), Tensor(qkvw), Tensor(lw), pre_layer_norm=True,
+        pre_ln_scale=Tensor(jnp.ones(dim)), pre_ln_bias=Tensor(jnp.zeros(dim)),
+        dropout_rate=0.0, attn_dropout_rate=0.0)
+    # manual composition
+    h = np.asarray(x)
+    mean = h.mean(-1, keepdims=True)
+    var = h.var(-1, keepdims=True)
+    hn = (h - mean) / np.sqrt(var + 1e-5)
+    qkv = np.einsum("bsd,tnhd->bstnh", hn, np.asarray(qkvw))
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    logits = np.einsum("bqnh,bknh->bnqk", q, k) * hd ** -0.5
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ctx = np.einsum("bnqk,bknh->bqnh", p, v).reshape(b, s, dim)
+    ref = np.asarray(x) + ctx @ np.asarray(lw)
+    np.testing.assert_allclose(out.numpy(), ref, atol=2e-4, rtol=2e-4)
+
+
+def test_fused_mha_cache_generation_step():
+    b, s, nh, hd = 1, 4, 2, 8
+    dim = nh * hd
+    x = _rand((b, s, dim), 0)
+    qkvw = _rand((3, nh, hd, dim), 1) * 0.3
+    lw = _rand((dim, dim), 2) * 0.3
+    cache = Tensor(jnp.zeros((2, b, nh, 0, hd)))
+    out1, cache_out = IF.fused_multi_head_attention(
+        Tensor(x), Tensor(qkvw), Tensor(lw), dropout_rate=0.0,
+        attn_dropout_rate=0.0, cache_kv=cache, add_residual=True,
+        pre_layer_norm=True)
+    assert cache.shape[3] == s  # cache grew in place
+    assert cache_out.shape[3] == s
+
+
+def test_blha_multi_token_continuation():
+    # chunked-prefill continuation: dec > 0 with several tokens this time
+    kv_nh, nh, hd, page, maxp = 1, 2, 32, 8, 8
+    b = 1
+    rng = np.random.default_rng(9)
+    npages = b * maxp
+    tables = jnp.asarray(rng.permutation(npages).reshape(b, maxp), jnp.int32)
+    kc = jnp.zeros((npages, kv_nh, page, hd))
+    vc = jnp.zeros((npages, kv_nh, page, hd))
+    k_hist = _rand((6, kv_nh, hd), 21)
+    v_hist = _rand((6, kv_nh, hd), 22)
+    kc, vc = append_paged_kv(kc, vc, k_hist, v_hist, tables,
+                             jnp.arange(6, dtype=jnp.int32),
+                             jnp.zeros((6,), jnp.int32))
+    tok = 3
+    qkv = _rand((tok, (nh + 2 * kv_nh) * hd), 23)
+    out, _, _, _ = IF.block_multihead_attention(
+        Tensor(qkv), Tensor(kc), Tensor(vc),
+        Tensor(jnp.asarray([0], jnp.int32)[:, None]),
+        Tensor(jnp.asarray([6], jnp.int32)[:, None]),
+        Tensor(jnp.asarray([tok], jnp.int32)[:, None]),
+        Tensor(jnp.zeros((tok,), jnp.int32)), Tensor(jnp.zeros((b,), jnp.int32)),
+        Tensor(jnp.asarray([0, tok], jnp.int32)[:, None]),
+        Tensor(jnp.asarray([0, tok], jnp.int32)[:, None]),
+        Tensor(tables), block_size=page)
+    assert float(np.abs(out.numpy()).sum()) > 0  # not the silent-zeros bug
+    qkv3 = np.asarray(qkv).reshape(tok, nh + 2 * kv_nh, hd)
+    kf = np.concatenate([np.asarray(k_hist), qkv3[:, nh:nh + kv_nh]])
+    vf = np.concatenate([np.asarray(v_hist), qkv3[:, nh + kv_nh:]])
+    ref = _dense_attn(jnp.asarray(qkv3[:, :nh])[None], jnp.asarray(kf)[None],
+                      jnp.asarray(vf)[None], causal=True)[0]
+    np.testing.assert_allclose(out.numpy(), np.asarray(ref).reshape(tok, -1),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_fused_feedforward_matches_composition():
+    b, s, dim, hidden = 2, 5, 16, 32
+    x = _rand((b, s, dim), 0)
+    w1 = _rand((dim, hidden), 1) * 0.2
+    w2 = _rand((hidden, dim), 2) * 0.2
+    out = IF.fused_feedforward(
+        Tensor(x), Tensor(w1), Tensor(w2), dropout1_rate=0.0,
+        dropout2_rate=0.0, pre_layer_norm=True,
+        ln1_scale=Tensor(jnp.ones(dim)), ln1_bias=Tensor(jnp.zeros(dim)),
+        activation="relu")
+    h = np.asarray(x)
+    hn = (h - h.mean(-1, keepdims=True)) / np.sqrt(h.var(-1, keepdims=True) + 1e-5)
+    ref = h + np.maximum(hn @ np.asarray(w1), 0) @ np.asarray(w2)
+    np.testing.assert_allclose(out.numpy(), ref, atol=2e-4, rtol=2e-4)
+
+
+def test_fused_multi_transformer_cache_decode_matches_full():
+    """Prefill + token-by-token decode must equal the no-cache full forward."""
+    paddle.seed(0)
+    b, s, nh, hd, L = 1, 6, 2, 8, 2
+    dim = nh * hd
+    rng = np.random.default_rng(5)
+
+    def mk(shape, scale=0.2):
+        return Tensor(jnp.asarray(rng.normal(size=shape) * scale, jnp.float32))
+
+    ln_s = [mk(dim, 0) + 1.0 for _ in range(L)]
+    ln_b = [mk(dim, 0) for _ in range(L)]
+    qkvw = [mk((3 * dim, dim)) for _ in range(L)]
+    qkvb = [mk(3 * dim) for _ in range(L)]
+    lws = [mk((dim, dim)) for _ in range(L)]
+    lbs = [mk(dim) for _ in range(L)]
+    fln_s = [mk(dim, 0) + 1.0 for _ in range(L)]
+    fln_b = [mk(dim, 0) for _ in range(L)]
+    w1 = [mk((dim, 2 * dim)) for _ in range(L)]
+    b1 = [mk(2 * dim) for _ in range(L)]
+    w2 = [mk((2 * dim, dim)) for _ in range(L)]
+    b2 = [mk(dim) for _ in range(L)]
+    x = Tensor(jnp.asarray(rng.normal(size=(b, s, dim)), jnp.float32))
+
+    common = dict(pre_layer_norm=True, num_heads=nh, dropout_rate=0.0,
+                  training=False)
+    full = IF.fused_multi_transformer(
+        x, ln_s, ln_b, qkvw, qkvb, lws, lbs, fln_s, fln_b, w1, b1, w2, b2,
+        **common)
+
+    max_seq = 16
+    caches = [Tensor(jnp.zeros((2, b, nh, max_seq, hd))) for _ in range(L)]
+    from paddle_tpu.tensor import slice as t_slice  # noqa: F401
+
+    pre = IF.fused_multi_transformer(
+        Tensor(x._data[:, : s - 1]), ln_s, ln_b, qkvw, qkvb, lws, lbs,
+        fln_s, fln_b, w1, b1, w2, b2, cache_kvs=caches, **common)
+    np.testing.assert_allclose(pre.numpy(), full.numpy()[:, : s - 1],
+                               atol=2e-4, rtol=2e-4)
+    last = IF.fused_multi_transformer(
+        Tensor(x._data[:, s - 1:]), ln_s, ln_b, qkvw, qkvb, lws, lbs,
+        fln_s, fln_b, w1, b1, w2, b2, cache_kvs=caches, time_step=s - 1,
+        **common)
+    np.testing.assert_allclose(last.numpy(), full.numpy()[:, s - 1:],
+                               atol=2e-4, rtol=2e-4)
