@@ -1,0 +1,77 @@
+"""Pipeline bubble measurement (VERDICT r1 #7: 'prove it or build it').
+
+Times the jitted scan+ppermute pipeline (fwd+bwd) on the 8-virtual-device CPU
+mesh at pp=4 across microbatch counts, fits the per-tick cost, and checks the
+measured step time against the schedule model:
+
+    GPipe ticks = M + p - 1          (stage-sized work per tick)
+    VPP ticks   = v*M + p - 1        (chunk-sized work = 1/v stage per tick)
+
+If the measured times match the model, the pipeline's only overhead IS the
+fill/drain bubble — no hidden serialization — and the bubble fraction table
+in docs/PP_BUBBLE.md follows analytically.
+
+Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 python benchmarks/pp_bubble.py
+"""
+
+import os
+import sys
+import time
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def run(p=4, v=1, hidden=1024, layers=8, mb_size=16, Ms=(4, 8, 16, 32), iters=10):
+    from paddle_tpu.distributed.auto_parallel.pipeline import pipeline_call
+
+    mesh = Mesh(np.array(jax.devices()[:p]), ("pp",))
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(size=(layers, hidden, hidden)) * 0.05,
+                    jnp.float32)
+    w = jax.device_put(w, NamedSharding(mesh, P("pp")))
+
+    def block_fn(wl, h):
+        return jnp.tanh(h @ wl[0])
+
+    results = {}
+    for M in Ms:
+        x = jnp.asarray(rng.normal(size=(M * mb_size, hidden)), jnp.float32)
+
+        def loss(w, x):
+            out = pipeline_call(block_fn, [w], x, mesh=mesh, n_micro=M,
+                                interleave=v)
+            return (out.astype(jnp.float32) ** 2).mean()
+
+        g = jax.jit(jax.grad(loss))
+        jax.block_until_ready(g(w, x))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            gv = g(w, x)
+        jax.block_until_ready(gv)
+        dt = (time.perf_counter() - t0) / iters
+        # per-microbatch time normalizes away the growing batch
+        results[M] = dt / M
+        print(f"p={p} v={v} M={M:3d}: {dt*1e3:8.2f} ms/step  "
+              f"{dt/M*1e3:6.2f} ms/microbatch", flush=True)
+
+    # model check: time/M proportional to (vM + p - 1) / (vM)
+    M0, M1 = Ms[0], Ms[-1]
+    meas_ratio = results[M0] / results[M1]
+    model_ratio = ((v * M0 + p - 1) / (v * M0)) / ((v * M1 + p - 1) / (v * M1))
+    print(f"p={p} v={v}: measured per-mb ratio M={M0}/M={M1} = {meas_ratio:.3f}, "
+          f"schedule model = {model_ratio:.3f}", flush=True)
+    return results
+
+
+if __name__ == "__main__":
+    run(p=4, v=1)
+    run(p=4, v=2)

@@ -26,11 +26,15 @@ class ParallelEnv:
 
     @property
     def rank(self):
-        return int(os.environ.get("PADDLE_TRAINER_ID", jax.process_index()))
+        v = os.environ.get("PADDLE_TRAINER_ID")
+        # lazy fallback: querying jax initializes the XLA backend, which must
+        # not happen before jax.distributed.initialize in multi-host bootstrap
+        return int(v) if v is not None else jax.process_index()
 
     @property
     def world_size(self):
-        return int(os.environ.get("PADDLE_TRAINERS_NUM", jax.process_count()))
+        v = os.environ.get("PADDLE_TRAINERS_NUM")
+        return int(v) if v is not None else jax.process_count()
 
     @property
     def local_rank(self):
@@ -51,16 +55,27 @@ class ParallelEnv:
 
 
 def init_parallel_env():
-    """Initialize multi-host JAX if the launch env asks for it; idempotent."""
+    """Initialize multi-host JAX if the launch env asks for it; idempotent.
+
+    ORDER MATTERS: jax.distributed.initialize must run before ANY backend
+    query (jax.devices/process_count initialize XLA), so the decision is made
+    purely from the PADDLE_* / MASTER_* launch env contract
+    (reference: parallel.py:978 init_parallel_env + launch controllers)."""
     if _parallel_env_initialized[0]:
         return get_default_group()
-    env = ParallelEnv()
+    world = os.environ.get("PADDLE_TRAINERS_NUM")
+    rank = os.environ.get("PADDLE_TRAINER_ID")
     coord = os.environ.get("MASTER_ADDR"), os.environ.get("MASTER_PORT")
-    if env.world_size > 1 and jax.process_count() == 1 and all(coord):
+    # idempotence without touching the backend: jax.distributed keeps its
+    # client in global_state — if a launcher already called initialize(),
+    # calling again would raise
+    already = getattr(jax._src.distributed.global_state, "client", None)
+    if (already is None and world is not None and int(world) > 1
+            and rank is not None and all(coord)):
         jax.distributed.initialize(
             coordinator_address=f"{coord[0]}:{coord[1]}",
-            num_processes=env.world_size,
-            process_id=env.rank,
+            num_processes=int(world),
+            process_id=int(rank),
         )
     _parallel_env_initialized[0] = True
     return get_default_group()

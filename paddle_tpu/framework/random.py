@@ -15,8 +15,16 @@ import threading
 import jax
 
 _state = threading.local()
-_global = {"key": jax.random.key(0), "seed": 0}
+# key created LAZILY: building it at import would initialize the XLA backend,
+# which must not happen before jax.distributed.initialize in multi-host boot
+_global = {"key": None, "seed": 0}
 _host_counter = [0]
+
+
+def _key():
+    if _global["key"] is None:
+        _global["key"] = jax.random.key(_global["seed"])
+    return _global["key"]
 
 
 def seed(s: int):
@@ -28,7 +36,7 @@ def seed(s: int):
 
 
 def get_rng_state():
-    return _global["key"]
+    return _key()
 
 
 def set_rng_state(key):
@@ -66,6 +74,6 @@ def next_key():
         top = stack[-1]
         top["count"] += 1
         return jax.random.fold_in(top["key"], top["count"])
-    k1, k2 = jax.random.split(_global["key"])
+    k1, k2 = jax.random.split(_key())
     _global["key"] = k1
     return k2

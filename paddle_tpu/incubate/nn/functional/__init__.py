@@ -236,22 +236,29 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=Fals
     [2, b, nh, cache_len, hd] appends this call's K/V (generation); the
     updated cache is written back into the ``cache_kv`` tensor (reference
     in-place contract) and attention spans cache + current."""
+    # ONE (name, tensor) list drives both the positional args and the in-fn
+    # binding — they cannot drift. Missing LN biases become zeros.
+    dim0 = x.shape[-1]
+    zeros = lambda: jnp.zeros((dim0,), jnp.float32)
+    opt = []
+    if pre_layer_norm and pre_ln_scale is not None:
+        opt += [("pls", pre_ln_scale),
+                ("plb", pre_ln_bias if pre_ln_bias is not None else zeros())]
+    if qkv_bias is not None:
+        opt += [("qb", qkv_bias)]
+    if linear_bias is not None:
+        opt += [("lb", linear_bias)]
+    if cache_kv is not None:
+        opt += [("cache", cache_kv)]
+    if attn_mask is not None:
+        opt += [("mask", attn_mask)]
+    if not pre_layer_norm and ln_scale is not None:
+        opt += [("lns", ln_scale),
+                ("lnb", ln_bias if ln_bias is not None else zeros())]
+    opt_names = [n for n, _ in opt]
 
     def fn(xx, qkvw, lw, *rest):
-        names = []
-        if pre_layer_norm and pre_ln_scale is not None:
-            names += ["pls", "plb"]
-        if qkv_bias is not None:
-            names += ["qb"]
-        if linear_bias is not None:
-            names += ["lb"]
-        if cache_kv is not None:
-            names += ["cache"]
-        if attn_mask is not None:
-            names += ["mask"]
-        if not pre_layer_norm and ln_scale is not None:
-            names += ["lns", "lnb"]
-        r = dict(zip(names, rest))
+        r = dict(zip(opt_names, rest))
 
         b, s, dim = xx.shape
         residual = xx
@@ -322,19 +329,7 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=Fals
             return out, new_cache
         return out
 
-    args = [x, qkv_weight, linear_weight]
-    if pre_layer_norm and pre_ln_scale is not None:
-        args += [pre_ln_scale, pre_ln_bias]
-    if qkv_bias is not None:
-        args += [qkv_bias]
-    if linear_bias is not None:
-        args += [linear_bias]
-    if cache_kv is not None:
-        args += [cache_kv]
-    if attn_mask is not None:
-        args += [attn_mask]
-    if not pre_layer_norm and ln_scale is not None:
-        args += [ln_scale, ln_bias]
+    args = [x, qkv_weight, linear_weight] + [t for _, t in opt]
     res = apply_fn("fused_multi_head_attention", fn, *args)
     if cache_kv is not None:
         out, new_cache = res
@@ -354,17 +349,23 @@ def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
     incubate/nn/functional/fused_transformer.py:47): [LN ->] linear1 -> act ->
     dropout1 -> linear2 -> dropout2 -> +residual [-> LN] in one traced region."""
 
+    dim0 = x.shape[-1]
+    zeros = lambda: jnp.zeros((dim0,), jnp.float32)
+    opt = []
+    if linear1_bias is not None:
+        opt += [("b1", linear1_bias)]
+    if linear2_bias is not None:
+        opt += [("b2", linear2_bias)]
+    if ln1_scale is not None:
+        opt += [("s1", ln1_scale),
+                ("bb1", ln1_bias if ln1_bias is not None else zeros())]
+    if ln2_scale is not None:
+        opt += [("s2", ln2_scale),
+                ("bb2", ln2_bias if ln2_bias is not None else zeros())]
+    opt_names = [n for n, _ in opt]
+
     def fn(xx, w1, w2, *rest):
-        names = []
-        if linear1_bias is not None:
-            names += ["b1"]
-        if linear2_bias is not None:
-            names += ["b2"]
-        if ln1_scale is not None:
-            names += ["s1", "bb1"]
-        if ln2_scale is not None:
-            names += ["s2", "bb2"]
-        r = dict(zip(names, rest))
+        r = dict(zip(opt_names, rest))
 
         def ln(t, scale, bias, eps):
             mean = jnp.mean(t, -1, keepdims=True)
@@ -405,14 +406,7 @@ def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
             h = ln(h, r.get("s2"), r.get("bb2"), ln2_epsilon)
         return h
 
-    args = [x, linear1_weight, linear2_weight]
-    for t in (linear1_bias, linear2_bias):
-        if t is not None:
-            args.append(t)
-    if ln1_scale is not None:
-        args += [ln1_scale, ln1_bias]
-    if ln2_scale is not None:
-        args += [ln2_scale, ln2_bias]
+    args = [x, linear1_weight, linear2_weight] + [t for _, t in opt]
     return apply_fn("fused_feedforward", fn, *args)
 
 
@@ -444,13 +438,15 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
             "masked_multihead_attention requires sequence_lengths (each row's "
             "current cache length / write position)")
 
+    opt = []
+    if bias is not None:
+        opt += [("bias", bias)]
+    if src_mask is not None:
+        opt += [("mask", src_mask)]
+    opt_names = [n for n, _ in opt]
+
     def fn(xx, cache, lens, *rest):
-        names = []
-        if bias is not None:
-            names += ["bias"]
-        if src_mask is not None:
-            names += ["mask"]
-        r = dict(zip(names, rest))
+        r = dict(zip(opt_names, rest))
         _, b, nh, max_seq, hd = cache.shape
         qkv = xx.reshape(b, 3, nh, hd)
         if "bias" in r:
@@ -471,11 +467,7 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
         out = jnp.einsum("bns,bnsh->bnh", probs, vc.astype(jnp.float32))
         return out.reshape(b, nh * hd).astype(xx.dtype), jnp.stack([kc, vc])
 
-    args = [x, cache_kv, sequence_lengths]
-    if bias is not None:
-        args.append(bias)
-    if src_mask is not None:
-        args.append(src_mask)
+    args = [x, cache_kv, sequence_lengths] + [t for _, t in opt]
     out, new_cache = apply_fn("masked_multihead_attention", fn, *args)
     cache_kv._data = new_cache._data  # reference in-place cache contract
     if beam_cache_offset is not None:

@@ -260,9 +260,13 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _pallas_backward(q, k, v, o, lse, do, causal, scale, block_q, block_k,
-                     interpret):
+                     interpret, g_lse=None):
     """All arrays in the public [b, s, h, d] layout; lse is the forward's
-    [b, hq, s_q, LSE_LANES] output (value broadcast across the lane dim)."""
+    [b, hq, s_q, LSE_LANES] output (value broadcast across the lane dim).
+
+    ``g_lse`` [b, hq, s_q] is an optional cotangent on the lse OUTPUT (ring
+    attention's merge differentiates through it): with l̄ present the score
+    gradient becomes ds = p·(dp − delta + l̄), i.e. l̄ just shifts delta."""
     from jax.experimental.pallas import tpu as pltpu
 
     b, s_q, hq, d = q.shape
@@ -277,6 +281,8 @@ def _pallas_backward(q, k, v, o, lse, do, causal, scale, block_q, block_k,
     # broadcast to LSE_LANES trailing lanes to satisfy TPU block tiling
     delta = jnp.einsum("bshd,bshd->bhs", do.astype(jnp.float32),
                        o.astype(jnp.float32))
+    if g_lse is not None:
+        delta = delta - g_lse.astype(jnp.float32)
     delta = jnp.broadcast_to(delta[..., None], delta.shape + (LSE_LANES,))
 
     # ---- dQ ----
@@ -392,6 +398,62 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _xla_reference_lse(q, k, v, causal, scale):
+    """XLA fallback returning (out, lse [b, hq, s_q] fp32 of SCALED logits)."""
+    qh = jnp.swapaxes(q, 1, 2).astype(jnp.float32)
+    kh = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
+    vh = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
+    if kh.shape[1] != qh.shape[1]:
+        rep = qh.shape[1] // kh.shape[1]
+        kh = jnp.repeat(kh, rep, axis=1)
+        vh = jnp.repeat(vh, rep, axis=1)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
+    if causal:
+        ql, kl = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((ql, kl), bool), k=kl - ql)
+        logits = jnp.where(mask, logits, NEG_INF)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)   # [b, h, q]
+    probs = jnp.exp(logits - lse[..., None])
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype), lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention_with_lse(q, k, v, causal, scale, block_q, block_k,
+                             interpret):
+    """(out [b,s,h,d], lse [b, hq, s_q] fp32) — differentiable INCLUDING the
+    lse output (ring attention's online-softmax merge needs d/dlse; the
+    backward folds the lse cotangent into the delta term: ds = p·(dp−δ+l̄))."""
+    if _use_pallas(q, k, block_q, block_k, interpret):
+        out, lse4 = _pallas_forward(q, k, v, causal, scale, block_q, block_k,
+                                    interpret, with_lse=True)
+        return out, lse4[..., 0]
+    return _xla_reference_lse(q, k, v, causal, scale)
+
+
+def _fwl_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    if _use_pallas(q, k, block_q, block_k, interpret):
+        out, lse4 = _pallas_forward(q, k, v, causal, scale, block_q, block_k,
+                                    interpret, with_lse=True)
+        return (out, lse4[..., 0]), (q, k, v, out, lse4)
+    out, lse = _xla_reference_lse(q, k, v, causal, scale)
+    return (out, lse), (q, k, v, None, None)
+
+
+def _fwl_bwd(causal, scale, block_q, block_k, interpret, res, cots):
+    q, k, v, o, lse4 = res
+    g_out, g_lse = cots
+    if lse4 is not None:
+        return _pallas_backward(q, k, v, o, lse4, g_out, causal, scale,
+                                block_q, block_k, interpret, g_lse=g_lse)
+    _, vjp = jax.vjp(
+        lambda a, b, c: _xla_reference_lse(a, b, c, causal, scale), q, k, v)
+    return vjp((g_out, g_lse))
+
+
+flash_attention_with_lse.defvjp(_fwl_fwd, _fwl_bwd)
 
 
 def _tuned_block(n: int) -> int:

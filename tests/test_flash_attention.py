@@ -180,3 +180,98 @@ def test_flash_gradients_q_longer_than_kv():
                                np.asarray(g2[0][:, offset:]), atol=5e-5, rtol=5e-5)
     # masked q rows: kernel must give exactly zero dq
     np.testing.assert_array_equal(np.asarray(g1[0][:, :offset]), 0.0)
+
+
+def test_ring_attention_zigzag_gqa(mesh8):
+    from jax.sharding import Mesh
+
+    from paddle_tpu.distributed.auto_parallel.logical_sharding import axis_rules
+    from paddle_tpu.ops.ring_attention import ring_attention
+
+    mesh = Mesh(np.asarray(mesh8).reshape(4, 2), ("sep", "tp"))
+    b, s, hq, hkv, d = 1, 256, 4, 2, 32
+    q = _rand((b, s, hq, d), 0)
+    k, v = _rand((b, s, hkv, d), 1), _rand((b, s, hkv, d), 2)
+    ref = _xla_reference(q, k, v, True, d ** -0.5)
+    with axis_rules(mesh):
+        out = ring_attention(q, k, v, mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5,
+                               rtol=2e-5)
+
+
+def test_ring_attention_contiguous_layout(mesh8):
+    from jax.sharding import Mesh
+
+    from paddle_tpu.distributed.auto_parallel.logical_sharding import axis_rules
+    from paddle_tpu.ops.ring_attention import ring_attention
+
+    mesh = Mesh(np.asarray(mesh8).reshape(4, 2), ("sep", "tp"))
+    b, s, h, d = 1, 256, 2, 32
+    q, k, v = _rand((b, s, h, d), 0), _rand((b, s, h, d), 1), _rand((b, s, h, d), 2)
+    ref = _xla_reference(q, k, v, True, d ** -0.5)
+    with axis_rules(mesh):
+        out = ring_attention(q, k, v, mesh, causal=True, layout="contiguous")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5,
+                               rtol=2e-5)
+
+
+def test_ring_attention_non_causal(mesh8):
+    from jax.sharding import Mesh
+
+    from paddle_tpu.distributed.auto_parallel.logical_sharding import axis_rules
+    from paddle_tpu.ops.ring_attention import ring_attention
+
+    mesh = Mesh(np.asarray(mesh8).reshape(4, 2), ("sep", "tp"))
+    b, s, h, d = 1, 128, 2, 32
+    q, k, v = _rand((b, s, h, d), 0), _rand((b, s, h, d), 1), _rand((b, s, h, d), 2)
+    ref = _xla_reference(q, k, v, False, d ** -0.5)
+    with axis_rules(mesh):
+        out = ring_attention(q, k, v, mesh, causal=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5,
+                               rtol=2e-5)
+
+
+@pytest.mark.parametrize("n", [2, 4, 8])
+def test_ring_zigzag_work_is_balanced(n):
+    from paddle_tpu.ops.ring_attention import _zigzag_pair_counts
+
+    counts = _zigzag_pair_counts(n)
+    assert len(set(counts)) == 1, counts            # every rank equal
+    assert counts[0] == 2 * n + 1                    # 2 blocks/step + diagonal
+
+
+def test_ring_zigzag_perm_roundtrip():
+    from paddle_tpu.ops.ring_attention import zigzag_inverse, zigzag_perm
+
+    s, n = 64, 4
+    perm, inv = zigzag_perm(s, n), zigzag_inverse(s, n)
+    np.testing.assert_array_equal(perm[inv], np.arange(s))
+    # rank 0's shard = stripes 0 and 2n-1
+    c = s // (2 * n)
+    np.testing.assert_array_equal(perm[:c], np.arange(c))
+    np.testing.assert_array_equal(perm[c:2 * c],
+                                  np.arange((2 * n - 1) * c, 2 * n * c))
+
+
+def test_flash_with_lse_gradients_including_lse_cotangent():
+    # ring attention differentiates through the lse OUTPUT of each block:
+    # bwd must fold the lse cotangent into delta (ds = p*(dp - delta + lbar))
+    from paddle_tpu.ops.flash_attention import (_xla_reference_lse,
+                                                flash_attention_with_lse)
+
+    b, s, h, d = 1, 256, 2, 64
+    q, k, v = _rand((b, s, h, d), 0), _rand((b, s, h, d), 1), _rand((b, s, h, d), 2)
+
+    def loss(q, k, v):
+        out, lse = flash_attention_with_lse(q, k, v, True, d ** -0.5, 128, 128, True)
+        return (out.astype(jnp.float32) ** 2).sum() + (jnp.sin(lse) * 0.3).sum()
+
+    def loss_ref(q, k, v):
+        out, lse = _xla_reference_lse(q, k, v, True, d ** -0.5)
+        return (out.astype(jnp.float32) ** 2).sum() + (jnp.sin(lse) * 0.3).sum()
+
+    g1 = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=5e-5,
+                                   rtol=5e-5)

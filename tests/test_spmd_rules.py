@@ -122,3 +122,178 @@ def test_flash_attention_batch_sharded(mesh):
     out = jax.jit(lambda q: _xla_attention(q, q, q, causal=True))(q)
     spec = tuple(out.sharding.spec)
     assert spec[0] == "x"
+
+
+# ---------------------------------------------------------------------------
+# Framework-routed SPMD tests (VERDICT r1 #8): the ops go through paddle_tpu
+# dispatch + logical_sharding.constrain / logical_to_spec, and the compiled
+# HLO is grepped for the collectives GSPMD must insert — a regression in the
+# dispatch or constraint layer breaks these, not just raw-GSPMD behavior.
+# Reference: test/auto_parallel/spmd_rules/ per-op rule tests.
+# ---------------------------------------------------------------------------
+
+def _hlo_count(fn, *args, word="all-reduce"):
+    txt = jax.jit(fn).lower(*args).compile().as_text()
+    return txt.count(f" {word}(") + txt.count(f" {word}-start(")
+
+
+@pytest.fixture(scope="module")
+def lmesh():
+    from paddle_tpu.distributed.auto_parallel import make_mesh
+
+    return make_mesh({"dp": 2, "fsdp": 1, "sep": 1, "tp": 4})
+
+
+def test_framework_matmul_logical_spec(lmesh):
+    """paddle matmul through the dispatcher + constrain produces the spec
+    logical_to_spec maps ('batch','mlp') to."""
+    from paddle_tpu.distributed.auto_parallel.logical_sharding import (
+        axis_rules, constrain, logical_to_spec)
+    import paddle_tpu.tensor as pt
+
+    def f(a, w):
+        with axis_rules(lmesh):
+            out = pt.matmul(a, w)
+            out = out._data if hasattr(out, "_data") else out
+            return constrain(out, "batch", "mlp")
+
+    a = _sharded(lmesh, jnp.ones((8, 16)), P("dp", None))
+    w = _sharded(lmesh, jnp.ones((16, 32)), P(None, "tp"))
+    out = jax.jit(f)(a, w)
+    want = NamedSharding(lmesh, logical_to_spec(("batch", "mlp"), lmesh))
+    assert out.sharding.is_equivalent_to(want, out.ndim)
+
+
+def test_framework_embedding_logical_spec(lmesh):
+    from paddle_tpu.distributed.auto_parallel.logical_sharding import (
+        axis_rules, constrain)
+    import paddle_tpu.nn.functional as F
+
+    def f(table, ids):
+        with axis_rules(lmesh):
+            out = F.embedding(ids, table)
+            out = out._data if hasattr(out, "_data") else out
+            return constrain(out, "batch", "seq", "embed")
+
+    table = _sharded(lmesh, jnp.ones((64, 16)), P(None, None))
+    ids = _sharded(lmesh, jnp.zeros((8, 4), jnp.int32), P("dp", None))
+    out = jax.jit(f)(table, ids)
+    assert tuple(out.sharding.spec)[0] == "dp"
+
+
+def test_framework_layer_norm_keeps_batch(lmesh):
+    import paddle_tpu.nn.functional as F
+
+    def f(x, w, b):
+        out = F.layer_norm(x, [16], w, b, 1e-5)
+        return out._data if hasattr(out, "_data") else out
+
+    x = _sharded(lmesh, jnp.ones((8, 16)), P("dp", None))
+    w = _sharded(lmesh, jnp.ones((16,)), P(None))
+    b = _sharded(lmesh, jnp.zeros((16,)), P(None))
+    out = jax.jit(f)(x, w, b)
+    assert tuple(out.sharding.spec)[0] == "dp"
+
+
+def test_framework_reduction_spec(lmesh):
+    import paddle_tpu.tensor as pt
+
+    def f(x):
+        out = pt.sum(x, axis=1)
+        return out._data if hasattr(out, "_data") else out
+
+    x = _sharded(lmesh, jnp.ones((8, 16)), P("dp", "tp"))
+    out = jax.jit(f)(x)
+    assert tuple(out.sharding.spec)[0] == "dp"
+
+
+def test_column_parallel_linear_fwd_no_allreduce():
+    """Column-parallel keeps the output mp-sharded: forward must compile to
+    ZERO all-reduces (Megatron rule; mp_layers.py ColumnParallelLinear)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet.meta_parallel.parallel_layers.mp_layers import (
+        ColumnParallelLinear)
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 4}
+    fleet.init(is_collective=True, strategy=strategy)
+    paddle.seed(0)
+    lin = ColumnParallelLinear(16, 32, gather_output=False)
+    if lin.mesh is None:
+        pytest.skip("no mp mesh in this environment")
+
+    def f(x, w, b):
+        lin.weight._data, lin.bias._data = w, b
+        out = lin(paddle.to_tensor(x) if not hasattr(x, "aval") else x)
+        return out._data if hasattr(out, "_data") else out
+
+    x = jnp.ones((4, 16))
+    n_ar = _hlo_count(f, x, lin.weight._data, lin.bias._data)
+    assert n_ar == 0, f"column-parallel fwd emitted {n_ar} all-reduces"
+
+
+def test_row_parallel_linear_fwd_has_allreduce():
+    """Row-parallel contracts over the sharded dim: the dispatcher's constrain
+    must make GSPMD insert at least one all-reduce in forward."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet.meta_parallel.parallel_layers.mp_layers import (
+        RowParallelLinear)
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 4}
+    fleet.init(is_collective=True, strategy=strategy)
+    paddle.seed(0)
+    lin = RowParallelLinear(32, 16)
+    if lin.mesh is None:
+        pytest.skip("no mp mesh in this environment")
+
+    def f(x, w, b):
+        lin.weight._data, lin.bias._data = w, b
+        out = lin(x)
+        return out._data if hasattr(out, "_data") else out
+
+    x = jnp.ones((4, 32))
+    n_ar = _hlo_count(f, x, lin.weight._data, lin.bias._data)
+    assert n_ar >= 1, "row-parallel fwd must all-reduce the partial sums"
+
+
+def test_flash_attention_framework_sharded(lmesh):
+    """F.scaled_dot_product_attention via the dispatcher keeps batch/heads
+    sharding on the output."""
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.distributed.auto_parallel.logical_sharding import axis_rules
+
+    rng = np.random.default_rng(2)
+    q = _sharded(lmesh, jnp.asarray(
+        rng.standard_normal((8, 16, 4, 8)), jnp.float32),
+        P("dp", None, "tp", None))
+
+    def f(q):
+        with axis_rules(lmesh):
+            out = F.scaled_dot_product_attention(q, q, q, is_causal=True)
+            return out._data if hasattr(out, "_data") else out
+
+    out = jax.jit(f)(q)
+    assert tuple(out.sharding.spec)[0] == "dp"
+
+
+def test_moe_expert_axis_constrain():
+    """'expert' logical axis maps to the ep mesh axis through constrain (the
+    dispatch layout GShard MoE relies on)."""
+    from paddle_tpu.distributed.auto_parallel import make_mesh
+    from paddle_tpu.distributed.auto_parallel.logical_sharding import (
+        axis_rules, constrain, logical_to_spec)
+
+    mesh = make_mesh({"ep": 2, "fsdp": 4})
+
+    def f(x):
+        with axis_rules(mesh):
+            return constrain(x * 2.0, "expert", None, "embed")
+
+    x = _sharded(mesh, jnp.ones((4, 8, 16)), P(None, None, None))
+    out = jax.jit(f)(x)
+    want = NamedSharding(mesh, logical_to_spec(("expert", None, "embed"), mesh))
+    assert out.sharding.is_equivalent_to(want, out.ndim)
+    assert tuple(out.sharding.spec)[0] == "ep"
