@@ -12,6 +12,12 @@ Two execution regimes, one API:
    across the group and the ops compute the equivalent replicated result
    (e.g. all_reduce(SUM) == x * nranks). This mirrors how the reference's tests use
    collectives on identical inputs, and keeps user code portable.
+
+3. **Eager, multi-process** (after a multi-host ``init_parallel_env``): each
+   controller holds genuinely different data, so ``all_reduce`` builds a global
+   array with one shard per process and runs a jitted cross-process psum over
+   the coordination-service-backed mesh — true per-rank semantics, matching the
+   reference's per-rank collective tests (test_collective_api_base.py).
 """
 
 from __future__ import annotations
@@ -52,6 +58,51 @@ def _task():
     return _Done()
 
 
+_mp_reduce_cache: dict = {}
+
+
+def _mp_all_reduce(x, op):
+    """True cross-process eager all-reduce over the WORLD: one shard per
+    PROCESS on a mesh spanning every controller; the reduce is a jitted psum.
+    Compiled fns are cached per (op, shape, dtype) — re-jitting each call
+    would recompile every time."""
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    key = (str(op), tuple(x.shape), str(x.dtype))
+    entry = _mp_reduce_cache.get(key)
+    if entry is None:
+        by_proc = {}
+        for d in jax.devices():
+            by_proc.setdefault(d.process_index, d)
+        devs = np.array([by_proc[p] for p in sorted(by_proc)])
+        mesh = Mesh(devs, ("r",))
+
+        def body(a):
+            v = a[0]
+            if op == ReduceOp.SUM:
+                r = lax.psum(v, "r")
+            elif op == ReduceOp.MAX:
+                r = lax.pmax(v, "r")
+            elif op == ReduceOp.MIN:
+                r = lax.pmin(v, "r")
+            elif op == ReduceOp.AVG:
+                r = lax.pmean(v, "r")
+            else:
+                r = jnp.exp(lax.psum(jnp.log(v), "r"))
+            return r[None]
+
+        fn = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P("r"),
+                                   out_specs=P("r")))
+        entry = (fn, mesh, by_proc[jax.process_index()], len(devs))
+        _mp_reduce_cache[key] = entry
+    fn, mesh, mine, n = entry
+    shard = jax.device_put(x[None], mine)
+    arr = jax.make_array_from_single_device_arrays(
+        (n,) + x.shape, NamedSharding(mesh, P("r")), [shard])
+    return fn(arr).addressable_shards[0].data[0]
+
+
 def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True, use_calc_stream=False):
     g = _group(group)
     x = unwrap(tensor)
@@ -66,6 +117,18 @@ def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True, use_ca
             out = lax.pmean(x, g.axis_name)
         else:
             out = jnp.exp(lax.psum(jnp.log(x), g.axis_name))
+    elif (jax.process_count() > 1
+          and not isinstance(x, jax.core.Tracer)):
+        # true cross-process semantics cover the WORLD group only — a proper
+        # subgroup would need a subgroup mesh AND all its members (and only
+        # them) to call in; refuse rather than silently over-reduce
+        if g.nranks not in (jax.process_count(), jax.device_count()):
+            raise NotImplementedError(
+                "eager multi-process all_reduce supports the world group "
+                f"only (group has {g.nranks} ranks, world "
+                f"{jax.process_count()} processes); run subgroup "
+                "collectives inside shard_map over the group's mesh axis")
+        out = _mp_all_reduce(x, op)
     else:
         n = g.nranks
         if op == ReduceOp.SUM:

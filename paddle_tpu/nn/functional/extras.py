@@ -797,26 +797,22 @@ def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False, return_softmax=False,
 def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k, max_seqlen_q,
                                 max_seqlen_k, scale=None, dropout=0.0,
                                 causal=False, return_softmax=False, name=None):
-    """Varlen packed flash attention (reference: flash_attention.py varlen).
-    qkv: [total_tokens, 3, h, d] with cu_seqlens prefix sums. TPU note:
-    ragged batches are densified per sequence (static shapes); the fast path
-    is the padded flash kernel."""
-    from .flash_attention import _xla_attention
+    """Varlen packed flash attention (reference: flash_attention.py:792 over
+    the CUDA varlen kernels). qkv: [total_tokens, 3, h, d] with cu_seqlens
+    prefix sums. TPU-native: ONE segment-masked Pallas flash kernel call over
+    the whole packed buffer (ops/flash_attention.flash_attention_varlen) —
+    no per-sequence loop, no padding."""
+    from ...ops.flash_attention import flash_attention_varlen
 
-    def fn(pk, cu_q):
-        outs = []
-        cu = np.asarray(cu_q)
-        for i in range(len(cu) - 1):
-            seg = pk[cu[i]:cu[i + 1]]  # [s_i, 3, h, d]
-            q, k, v = seg[:, 0], seg[:, 1], seg[:, 2]
-            o = _xla_attention(q[None], k[None], v[None], causal=causal,
-                               scale=scale)[0]
-            outs.append(o)
-        return jnp.concatenate(outs, 0)
-
-    # host-side loop over the (concrete) prefix sums: eager-only API
     pk = qkv._data if isinstance(qkv, Tensor) else jnp.asarray(qkv)
-    cu = (cu_seqlens_q._data if isinstance(cu_seqlens_q, Tensor)
-          else jnp.asarray(cu_seqlens_q))
+    cu = np.asarray(cu_seqlens_q._data if isinstance(cu_seqlens_q, Tensor)
+                    else cu_seqlens_q).reshape(-1)
+    total = pk.shape[0]
+    # token i belongs to segment searchsorted(cu, i, 'right') - 1
+    seg = jnp.asarray(np.searchsorted(cu, np.arange(total), side="right") - 1,
+                      jnp.int32)[None]
+    q, k, v = pk[None, :, 0], pk[None, :, 1], pk[None, :, 2]
+    out = flash_attention_varlen(q, k, v, seg, seg, causal,
+                                 None if scale is None else float(scale))
     # mirror flash_attention's (out, softmax|None) return convention
-    return Tensor(fn(pk, cu)), None
+    return Tensor(out[0]), None

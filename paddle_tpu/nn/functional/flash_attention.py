@@ -83,35 +83,56 @@ def flashmask_attention(query, key, value, startend_row_indices=None, dropout=0.
                         causal=False, window_size=None, return_softmax_lse=False,
                         return_seed_offset=False, fixed_seed_offset=None, rng_name="",
                         training=True, name=None):
-    """Sparse-mask attention (reference :1098). Round-1: dense-mask materialization."""
-    bias = None
+    """Sparse-mask attention (reference :1098 over the flashmask CUDA
+    kernels). The LT start/end encodings ([b, hm, kv_len, {1,2}]) stream
+    through the in-repo Pallas flash kernel as per-column row bounds
+    (ops/flash_attention.flash_attention_rowmask — fwd AND bwd); the 4-index
+    bidirectional encodings fall back to a dense additive bias."""
+    from ...core.tensor import Tensor, unwrap
+
     if startend_row_indices is not None:
-        # Build an additive bias from start/end row indices: masked where kv row >= start.
-        import numpy as np
-
-        from ...core.tensor import unwrap
-
-        idx = unwrap(startend_row_indices)  # [b, kv_heads, kv_len, {1,2,4}]
-        b, h, kv_len, nidx = idx.shape
+        idx = unwrap(startend_row_indices)  # [b, hm, kv_len, {1,2,4}]
+        b, hm, kv_len, nidx = idx.shape
         q_len = query.shape[1]
-        rows = jnp.arange(q_len)[None, None, :, None]
-        if causal:
-            start = idx[..., 0][:, :, None, :]  # [b,h,1,kv]
-            mask = rows >= start
-            if nidx >= 2:
-                end = idx[..., 1][:, :, None, :]
-                mask = mask & (rows < end)
-            bias = jnp.where(mask, jnp.float32(-1e9), 0.0)
-        else:
-            start = idx[..., 0][:, :, None, :]
-            mask = rows >= start
-            bias = jnp.where(mask, jnp.float32(-1e9), 0.0)
-    from ...core.tensor import Tensor
+        if causal and nidx <= 2 and dropout == 0.0:
+            # kernel path (causal LT encodings): per kv column, q rows in
+            # [LT_start, LT_end) are masked (LT_end = ∞ for the 1-index form)
+            start = idx[..., 0]
+            end = (idx[..., 1] if nidx >= 2
+                   else jnp.full_like(start, q_len + kv_len))
+            from ...core.op_registry import apply_fn
+            from ...ops.flash_attention import flash_attention_rowmask
 
-    out = scaled_dot_product_attention(query, key, value,
-                                       None if bias is None else Tensor(bias),
-                                       dropout, causal, training)
-    return out
+            def fn(q, k, v, st, en):
+                return flash_attention_rowmask(q, k, v, st, en, causal, None)
+
+            return apply_fn("flashmask_attention", fn, query, key, value,
+                            Tensor(start), Tensor(end))
+        # dense additive-bias path:
+        #   causal 4-index  [LTS, LTE, UTS, UTE]: two masked bands
+        #   non-causal 2-index [LTS, UTE]: masked rows >= LTS OR rows < UTE
+        #   non-causal 4-index [LTS, LTE, UTS, UTE]: two masked bands
+        rows = jnp.arange(q_len)[None, None, :, None]
+        lts = idx[..., 0][:, :, None, :]
+        if causal:
+            mask = rows >= lts
+            if nidx >= 2:
+                lte = idx[..., 1][:, :, None, :]
+                mask = mask & (rows < lte)
+        elif nidx == 2:
+            ute = idx[..., 1][:, :, None, :]
+            mask = (rows >= lts) | (rows < ute)
+        else:
+            lte = idx[..., 1][:, :, None, :]
+            uts = idx[..., 2][:, :, None, :]
+            ute = idx[..., 3][:, :, None, :]
+            mask = ((rows >= lts) & (rows < lte)) | \
+                   ((rows >= uts) & (rows < ute))
+        bias = jnp.where(mask, jnp.float32(-1e9), 0.0)
+        return scaled_dot_product_attention(query, key, value, Tensor(bias),
+                                            dropout, causal, training)
+    return scaled_dot_product_attention(query, key, value, None, dropout,
+                                        causal, training)
 
 
 def sdp_kernel(*args, **kwargs):

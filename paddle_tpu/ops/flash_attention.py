@@ -65,12 +65,29 @@ def _xla_reference(q, k, v, causal, scale):
 # forward kernel
 # ---------------------------------------------------------------------------
 
-def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_ref, scale, causal,
-                   block_q, block_k, kv_len, q_len):
-    """One (batch, head, q-block) program; streams K/V in block_k chunks."""
+def _fa_fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal,
+                   block_q, block_k, kv_len, q_len, with_seg=False,
+                   with_rowmask=False):
+    """One (batch, head, q-block) program; streams K/V in block_k chunks.
+    With ``with_seg`` the first two extra refs are per-position segment ids
+    ([b, s, LSE_LANES] int32) and attention is block-diagonal over equal
+    segments (varlen packed batches). With ``with_rowmask`` the next two refs
+    are per-KV-COLUMN row bounds ([b, h, s_kv, LSE_LANES] int32): q rows in
+    [start[col], end[col]) are masked (the reference's flashmask LT masks,
+    nn/functional/flash_attention.py:1098)."""
+    if with_seg:
+        qseg_ref, kseg_ref = refs[0], refs[1]
+        refs = refs[2:]
+    if with_rowmask:
+        start_ref, end_ref = refs[0], refs[1]
+        refs = refs[2:]
+    o_ref = refs[0]
+    maybe_lse_ref = refs[1:]
     qi = pl.program_id(2)
     q = q_ref[0, 0].astype(jnp.float32) * scale          # [BQ, d]
     d = q.shape[-1]
+    if with_seg:
+        qs = qseg_ref[0][:, 0]                            # [BQ]
 
     # End-aligned causal offset: q row i attends k cols <= i + (kv_len - q_len),
     # matching _xla_reference's tril(k=kl-ql) (kv-cache style when kv > q).
@@ -91,6 +108,16 @@ def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_ref, scale, causal,
             q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos + offset >= k_pos, s, NEG_INF)
+        if with_seg:
+            ks = kseg_ref[0, pl.ds(j * block_k, block_k), 0]  # [BK]
+            s = jnp.where(qs[:, None] == ks[None, :], s, NEG_INF)
+        if with_rowmask:
+            st = start_ref[0, 0, pl.ds(j * block_k, block_k), 0]   # [BK]
+            en = end_ref[0, 0, pl.ds(j * block_k, block_k), 0]
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            masked = (rows >= st[None, :]) & (rows < en[None, :])
+            s = jnp.where(masked, NEG_INF, s)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[:, None])
         alpha = jnp.exp(m - m_new)
@@ -116,8 +143,15 @@ def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_ref, scale, causal,
         lse_ref[0, 0] = jax.lax.broadcast_in_dim(lse, lse_ref.shape[2:], (0,))
 
 
+def _seg_lanes(seg, s):
+    """[b, s] int32 -> [b, s, LSE_LANES] (TPU block tiling)."""
+    seg = seg.astype(jnp.int32)
+    return jnp.broadcast_to(seg[..., None], seg.shape + (LSE_LANES,))
+
+
 def _pallas_forward(q, k, v, causal, scale, block_q, block_k, interpret,
-                    with_lse=True):
+                    with_lse=True, q_seg=None, kv_seg=None,
+                    row_start=None, row_end=None):
     """q,k,v in [b, s, h, d]. Returns (out [b,s,h,d],
     lse [b, hq, s_q, LSE_LANES] fp32 — or None when with_lse=False, the
     primal/inference path, which skips the lse HBM write entirely)."""
@@ -129,9 +163,12 @@ def _pallas_forward(q, k, v, causal, scale, block_q, block_k, interpret,
     vt = jnp.swapaxes(v, 1, 2)
 
     grid = (b, hq, s_q // block_q)
+    with_seg = q_seg is not None
+    with_rowmask = row_start is not None
     kernel = functools.partial(
         _fa_fwd_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, kv_len=s_kv, q_len=s_q)
+        block_q=block_q, block_k=block_k, kv_len=s_kv, q_len=s_q,
+        with_seg=with_seg, with_rowmask=with_rowmask)
     out_specs = [
         pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
     ]
@@ -141,18 +178,37 @@ def _pallas_forward(q, k, v, causal, scale, block_q, block_k, interpret,
                                       lambda bi, hi, qi: (bi, hi, qi, 0)))
         out_shape.append(
             jax.ShapeDtypeStruct((b, hq, s_q, LSE_LANES), jnp.float32))
+    in_specs = [
+        pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+        pl.BlockSpec((1, 1, s_kv, d), lambda bi, hi, qi: (bi, hi // group, 0, 0)),
+        pl.BlockSpec((1, 1, s_kv, d), lambda bi, hi, qi: (bi, hi // group, 0, 0)),
+    ]
+    operands = [qt, kt, vt]
+    if with_seg:
+        in_specs += [
+            pl.BlockSpec((1, block_q, LSE_LANES), lambda bi, hi, qi: (bi, qi, 0)),
+            pl.BlockSpec((1, s_kv, LSE_LANES), lambda bi, hi, qi: (bi, 0, 0)),
+        ]
+        operands += [_seg_lanes(q_seg, s_q), _seg_lanes(kv_seg, s_kv)]
+    if with_rowmask:
+        # bounds are per kv-HEAD [b, hkv, s_kv]; q-head hi maps via hi//group
+        hm = row_start.shape[1]
+        in_specs += [
+            pl.BlockSpec((1, 1, s_kv, LSE_LANES),
+                         lambda bi, hi, qi: (bi, (hi // group) % hm, 0, 0)),
+            pl.BlockSpec((1, 1, s_kv, LSE_LANES),
+                         lambda bi, hi, qi: (bi, (hi // group) % hm, 0, 0)),
+        ]
+        operands += [_seg_lanes(row_start.astype(jnp.int32), s_kv),
+                     _seg_lanes(row_end.astype(jnp.int32), s_kv)]
     res = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, s_kv, d), lambda bi, hi, qi: (bi, hi // group, 0, 0)),
-            pl.BlockSpec((1, 1, s_kv, d), lambda bi, hi, qi: (bi, hi // group, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
         interpret=interpret,
-    )(qt, kt, vt)
+    )(*operands)
     lse = res[1] if with_lse else None
     return jnp.swapaxes(res[0], 1, 2), lse
 
@@ -161,15 +217,25 @@ def _pallas_forward(q, k, v, causal, scale, block_q, block_k, interpret,
 # backward kernels
 # ---------------------------------------------------------------------------
 
-def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                      *, scale, causal, block_q, block_k, kv_len, q_len):
+def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
+                      scale, causal, block_q, block_k, kv_len, q_len,
+                      with_seg=False, with_rowmask=False):
     """dQ for one (batch, q_head, q_block); streams K/V like forward."""
+    if with_seg:
+        qseg_ref, kseg_ref = refs[0], refs[1]
+        refs = refs[2:]
+    if with_rowmask:
+        start_ref, end_ref = refs[0], refs[1]
+        refs = refs[2:]
+    dq_ref = refs[0]
     qi = pl.program_id(2)
     q = q_ref[0, 0].astype(jnp.float32)                   # [BQ, d]
     do = do_ref[0, 0].astype(jnp.float32)                 # [BQ, d]
     lse = lse_ref[0, 0][:, :1]                            # [BQ, 1]
     delta = delta_ref[0, 0][:, :1]                        # [BQ, 1]
     d = q.shape[-1]
+    if with_seg:
+        qs = qseg_ref[0][:, 0]                            # [BQ]
 
     offset = kv_len - q_len
     num_kv = kv_len // block_k
@@ -186,6 +252,16 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos + offset >= k_pos, s, NEG_INF)
+        if with_seg:
+            ks = kseg_ref[0, pl.ds(j * block_k, block_k), 0]
+            s = jnp.where(qs[:, None] == ks[None, :], s, NEG_INF)
+        if with_rowmask:
+            st = start_ref[0, 0, pl.ds(j * block_k, block_k), 0]
+            en = end_ref[0, 0, pl.ds(j * block_k, block_k), 0]
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            s = jnp.where((rows >= st[None, :]) & (rows < en[None, :]),
+                          NEG_INF, s)
         # rows with no valid keys store lse = NEG_INF; exp(s - lse) would give
         # p = 1 there (s is NEG_INF too) — force those rows to zero instead
         p = jnp.where(lse > NEG_INF / 2, jnp.exp(s - lse), 0.0)   # [BQ, BK]
@@ -200,11 +276,19 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 
 def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                       dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
-                       block_q, block_k, kv_len, q_len, group):
+                       *refs, scale, causal,
+                       block_q, block_k, kv_len, q_len, group, with_seg=False,
+                       with_rowmask=False):
     """dK/dV for one (batch, kv_head, k_block); q_blocks is the innermost grid
     dim so dk_acc/dv_acc VMEM scratch persists and accumulates across q steps.
     All `group` q-heads of this kv-head arrive in one head-blocked q block."""
+    if with_seg:
+        qseg_ref, kseg_ref = refs[0], refs[1]
+        refs = refs[2:]
+    if with_rowmask:
+        start_ref, end_ref = refs[0], refs[1]
+        refs = refs[2:]
+    dk_ref, dv_ref, dk_acc, dv_acc = refs
     ki = pl.program_id(2)
     qi = pl.program_id(3)
     nq = pl.num_programs(3)
@@ -239,6 +323,17 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 k_pos = ki * block_k + jax.lax.broadcasted_iota(
                     jnp.int32, (block_q, block_k), 1)
                 s = jnp.where(q_pos + offset >= k_pos, s, NEG_INF)
+            if with_seg:
+                qsg = qseg_ref[0][:, 0]
+                ksg = kseg_ref[0][:, 0]
+                s = jnp.where(qsg[:, None] == ksg[None, :], s, NEG_INF)
+            if with_rowmask:
+                st = start_ref[0, 0][:, 0]
+                en = end_ref[0, 0][:, 0]
+                rows = qi * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0)
+                s = jnp.where((rows >= st[None, :]) & (rows < en[None, :]),
+                              NEG_INF, s)
             # see dq kernel: fully-masked rows (lse == NEG_INF) must give p = 0
             p = jnp.where(lse > NEG_INF / 2, jnp.exp(s - lse), 0.0)
             # dV += P^T · dO
@@ -260,7 +355,8 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _pallas_backward(q, k, v, o, lse, do, causal, scale, block_q, block_k,
-                     interpret, g_lse=None):
+                     interpret, g_lse=None, q_seg=None, kv_seg=None,
+                     row_start=None, row_end=None):
     """All arrays in the public [b, s, h, d] layout; lse is the forward's
     [b, hq, s_q, LSE_LANES] output (value broadcast across the lane dim).
 
@@ -285,28 +381,51 @@ def _pallas_backward(q, k, v, o, lse, do, causal, scale, block_q, block_k,
         delta = delta - g_lse.astype(jnp.float32)
     delta = jnp.broadcast_to(delta[..., None], delta.shape + (LSE_LANES,))
 
+    with_seg = q_seg is not None
+    with_rowmask = row_start is not None
+    seg_ops = ([_seg_lanes(q_seg, s_q), _seg_lanes(kv_seg, s_kv)]
+               if with_seg else [])
+    if with_rowmask:
+        seg_ops += [_seg_lanes(row_start.astype(jnp.int32), s_kv),
+                    _seg_lanes(row_end.astype(jnp.int32), s_kv)]
+        hm = row_start.shape[1]
+
     # ---- dQ ----
     grid_dq = (b, hq, s_q // block_q)
     dq_kernel = functools.partial(
         _fa_bwd_dq_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, kv_len=s_kv, q_len=s_q)
+        block_q=block_q, block_k=block_k, kv_len=s_kv, q_len=s_q,
+        with_seg=with_seg, with_rowmask=with_rowmask)
+    dq_in_specs = [
+        pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+        pl.BlockSpec((1, 1, s_kv, d), lambda bi, hi, qi: (bi, hi // group, 0, 0)),
+        pl.BlockSpec((1, 1, s_kv, d), lambda bi, hi, qi: (bi, hi // group, 0, 0)),
+        pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+        pl.BlockSpec((1, 1, block_q, LSE_LANES),
+                     lambda bi, hi, qi: (bi, hi, qi, 0)),
+        pl.BlockSpec((1, 1, block_q, LSE_LANES),
+                     lambda bi, hi, qi: (bi, hi, qi, 0)),
+    ]
+    if with_seg:
+        dq_in_specs += [
+            pl.BlockSpec((1, block_q, LSE_LANES), lambda bi, hi, qi: (bi, qi, 0)),
+            pl.BlockSpec((1, s_kv, LSE_LANES), lambda bi, hi, qi: (bi, 0, 0)),
+        ]
+    if with_rowmask:
+        dq_in_specs += [
+            pl.BlockSpec((1, 1, s_kv, LSE_LANES),
+                         lambda bi, hi, qi: (bi, (hi // group) % hm, 0, 0)),
+            pl.BlockSpec((1, 1, s_kv, LSE_LANES),
+                         lambda bi, hi, qi: (bi, (hi // group) % hm, 0, 0)),
+        ]
     dq = pl.pallas_call(
         dq_kernel,
         grid=grid_dq,
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, s_kv, d), lambda bi, hi, qi: (bi, hi // group, 0, 0)),
-            pl.BlockSpec((1, 1, s_kv, d), lambda bi, hi, qi: (bi, hi // group, 0, 0)),
-            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, block_q, LSE_LANES),
-                         lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, block_q, LSE_LANES),
-                         lambda bi, hi, qi: (bi, hi, qi, 0)),
-        ],
+        in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
         out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
         interpret=interpret,
-    )(qt, kt, vt, dot, lse, delta)
+    )(qt, kt, vt, dot, lse, delta, *seg_ops)
 
     # ---- dK / dV ----
     # q-heads blocked by `group` so one program sees every q-head of its
@@ -314,24 +433,40 @@ def _pallas_backward(q, k, v, o, lse, do, causal, scale, block_q, block_k,
     grid_dkv = (b, hkv, s_kv // block_k, s_q // block_q)
     dkv_kernel = functools.partial(
         _fa_bwd_dkv_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, kv_len=s_kv, q_len=s_q, group=group)
+        block_q=block_q, block_k=block_k, kv_len=s_kv, q_len=s_q, group=group,
+        with_seg=with_seg, with_rowmask=with_rowmask)
+    dkv_in_specs = [
+        pl.BlockSpec((1, group, block_q, d),
+                     lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+        pl.BlockSpec((1, 1, block_k, d),
+                     lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
+        pl.BlockSpec((1, 1, block_k, d),
+                     lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
+        pl.BlockSpec((1, group, block_q, d),
+                     lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+        pl.BlockSpec((1, group, block_q, LSE_LANES),
+                     lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+        pl.BlockSpec((1, group, block_q, LSE_LANES),
+                     lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+    ]
+    if with_seg:
+        dkv_in_specs += [
+            pl.BlockSpec((1, block_q, LSE_LANES),
+                         lambda bi, hi, ki, qi: (bi, qi, 0)),
+            pl.BlockSpec((1, block_k, LSE_LANES),
+                         lambda bi, hi, ki, qi: (bi, ki, 0)),
+        ]
+    if with_rowmask:
+        dkv_in_specs += [
+            pl.BlockSpec((1, 1, block_k, LSE_LANES),
+                         lambda bi, hi, ki, qi: (bi, hi % hm, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, LSE_LANES),
+                         lambda bi, hi, ki, qi: (bi, hi % hm, ki, 0)),
+        ]
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=grid_dkv,
-        in_specs=[
-            pl.BlockSpec((1, group, block_q, d),
-                         lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, block_k, d),
-                         lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
-            pl.BlockSpec((1, 1, block_k, d),
-                         lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
-            pl.BlockSpec((1, group, block_q, d),
-                         lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, group, block_q, LSE_LANES),
-                         lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, group, block_q, LSE_LANES),
-                         lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
-        ],
+        in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, block_k, d),
                          lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
@@ -347,7 +482,7 @@ def _pallas_backward(q, k, v, o, lse, do, causal, scale, block_q, block_k,
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=interpret,
-    )(qt, kt, vt, dot, lse, delta)
+    )(qt, kt, vt, dot, lse, delta, *seg_ops)
 
     return (jnp.swapaxes(dq, 1, 2), jnp.swapaxes(dk, 1, 2),
             jnp.swapaxes(dv, 1, 2))
@@ -513,6 +648,189 @@ def flash_attention(q, k, v, causal: bool = False, scale=None,
     bq = min(block_q or _tuned_block(q.shape[1]), q.shape[1])
     bk = min(block_k or _tuned_block(k.shape[1]), k.shape[1])
     return _flash(q, k, v, causal, float(scale), bq, bk, interpret)
+
+
+# ---------------------------------------------------------------------------
+# varlen (packed, segment-masked) attention
+# ---------------------------------------------------------------------------
+
+def _xla_varlen_reference(q, k, v, q_seg, kv_seg, causal, scale):
+    """Dense-mask fallback: attention restricted to equal segment ids."""
+    qh = jnp.swapaxes(q, 1, 2).astype(jnp.float32)
+    kh = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
+    vh = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
+    if kh.shape[1] != qh.shape[1]:
+        rep = qh.shape[1] // kh.shape[1]
+        kh = jnp.repeat(kh, rep, axis=1)
+        vh = jnp.repeat(vh, rep, axis=1)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
+    mask = q_seg[:, None, :, None] == kv_seg[:, None, None, :]
+    if causal:
+        ql, kl = logits.shape[-2], logits.shape[-1]
+        mask = mask & jnp.tril(jnp.ones((ql, kl), bool), k=kl - ql)
+    logits = jnp.where(mask, logits, NEG_INF)
+    # fully-masked rows (padding segments) -> zero output, not NaN
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    p = jnp.exp(logits - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p / jnp.maximum(l, 1e-30), vh)
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+
+def _seg_zero_cot(seg):
+    import numpy as _np
+
+    return _np.zeros(seg.shape, jax.dtypes.float0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def flash_attention_varlen(q, k, v, q_seg, kv_seg, causal=True, scale=None,
+                           block_q=0, block_k=0, interpret=False):
+    """Packed variable-length attention as a KERNEL (reference:
+    nn/functional/flash_attention.py:792 varlen over the CUDA varlen kernels).
+
+    q/k/v: [b, s, h, d]; q_seg/kv_seg: [b, s] int32 segment ids — attention is
+    block-diagonal over equal segments (plus causal within each segment, since
+    packed positions are monotone per segment). Runs the in-repo Pallas flash
+    kernels fwd+bwd with the segment mask folded into the score masking; CPU /
+    non-divisible shapes take a dense-mask XLA path."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    bq = min(block_q or _tuned_block(q.shape[1]), q.shape[1])
+    bk = min(block_k or _tuned_block(k.shape[1]), k.shape[1])
+    if _use_pallas(q, k, bq, bk, interpret):
+        return _pallas_forward(q, k, v, causal, float(scale), bq, bk,
+                               interpret, with_lse=False,
+                               q_seg=q_seg, kv_seg=kv_seg)[0]
+    return _xla_varlen_reference(q, k, v, q_seg, kv_seg, causal, float(scale))
+
+
+def _fav_fwd(q, k, v, q_seg, kv_seg, causal, scale, block_q, block_k,
+             interpret):
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    bq = min(block_q or _tuned_block(q.shape[1]), q.shape[1])
+    bk = min(block_k or _tuned_block(k.shape[1]), k.shape[1])
+    if _use_pallas(q, k, bq, bk, interpret):
+        out, lse = _pallas_forward(q, k, v, causal, float(scale), bq, bk,
+                                   interpret, with_lse=True,
+                                   q_seg=q_seg, kv_seg=kv_seg)
+        return out, (q, k, v, q_seg, kv_seg, out, lse)
+    out = _xla_varlen_reference(q, k, v, q_seg, kv_seg, causal, float(scale))
+    return out, (q, k, v, q_seg, kv_seg, None, None)
+
+
+def _fav_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v, q_seg, kv_seg, o, lse = res
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if lse is not None:
+        bq = min(block_q or _tuned_block(q.shape[1]), q.shape[1])
+        bk = min(block_k or _tuned_block(k.shape[1]), k.shape[1])
+        dq, dk, dv = _pallas_backward(q, k, v, o, lse, g, causal, float(scale),
+                                      bq, bk, interpret,
+                                      q_seg=q_seg, kv_seg=kv_seg)
+        return dq, dk, dv, _seg_zero_cot(q_seg), _seg_zero_cot(kv_seg)
+    _, vjp = jax.vjp(
+        lambda a, b, c: _xla_varlen_reference(a, b, c, q_seg, kv_seg, causal,
+                                              float(scale)), q, k, v)
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, _seg_zero_cot(q_seg), _seg_zero_cot(kv_seg)
+
+
+flash_attention_varlen.defvjp(_fav_fwd, _fav_bwd)
+
+
+# ---------------------------------------------------------------------------
+# flashmask (per-column row-bound sparse masks) attention
+# ---------------------------------------------------------------------------
+
+def _xla_rowmask_reference(q, k, v, row_start, row_end, causal, scale):
+    """Dense fallback: q row r masked from kv col c iff start[c] <= r < end[c].
+    row bounds: [b, hm, s_kv] with hm in {1, kv_heads}."""
+    qh = jnp.swapaxes(q, 1, 2).astype(jnp.float32)
+    kh = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
+    vh = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
+    hq, hkv = qh.shape[1], kh.shape[1]
+    if hkv != hq:
+        rep = hq // hkv
+        kh = jnp.repeat(kh, rep, axis=1)
+        vh = jnp.repeat(vh, rep, axis=1)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
+    ql, kl = logits.shape[-2], logits.shape[-1]
+    hm = row_start.shape[1]
+    st = jnp.repeat(row_start, hq // hm, axis=1) if hm not in (1,) else row_start
+    en = jnp.repeat(row_end, hq // hm, axis=1) if hm not in (1,) else row_end
+    rows = jnp.arange(ql)[None, None, :, None]
+    blocked = (rows >= st[:, :, None, :]) & (rows < en[:, :, None, :])
+    keep = ~blocked
+    if causal:
+        keep = keep & jnp.tril(jnp.ones((ql, kl), bool), k=kl - ql)
+    logits = jnp.where(keep, logits, NEG_INF)
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    p = jnp.exp(logits - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p / jnp.maximum(l, 1e-30), vh)
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def flash_attention_rowmask(q, k, v, row_start, row_end, causal=True,
+                            scale=None, block_q=0, block_k=0,
+                            interpret=False):
+    """Flashmask attention as a KERNEL (reference:
+    nn/functional/flash_attention.py:1098 flashmask_attention): per-KV-column
+    row bounds [b, hm, s_kv] (hm in {1, kv_heads}) mask q rows in
+    [start[c], end[c]) — the reference's LT sparse-mask encoding — streamed
+    through the Pallas flash kernels fwd+bwd. CPU / odd shapes take a dense
+    path."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    bq = min(block_q or _tuned_block(q.shape[1]), q.shape[1])
+    bk = min(block_k or _tuned_block(k.shape[1]), k.shape[1])
+    if _use_pallas(q, k, bq, bk, interpret):
+        return _pallas_forward(q, k, v, causal, float(scale), bq, bk,
+                               interpret, with_lse=False,
+                               row_start=row_start, row_end=row_end)[0]
+    return _xla_rowmask_reference(q, k, v, row_start, row_end, causal,
+                                  float(scale))
+
+
+def _far_fwd(q, k, v, row_start, row_end, causal, scale, block_q, block_k,
+             interpret):
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    bq = min(block_q or _tuned_block(q.shape[1]), q.shape[1])
+    bk = min(block_k or _tuned_block(k.shape[1]), k.shape[1])
+    if _use_pallas(q, k, bq, bk, interpret):
+        out, lse = _pallas_forward(q, k, v, causal, float(scale), bq, bk,
+                                   interpret, with_lse=True,
+                                   row_start=row_start, row_end=row_end)
+        return out, (q, k, v, row_start, row_end, out, lse)
+    out = _xla_rowmask_reference(q, k, v, row_start, row_end, causal,
+                                 float(scale))
+    return out, (q, k, v, row_start, row_end, None, None)
+
+
+def _far_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v, row_start, row_end, o, lse = res
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if lse is not None:
+        bq = min(block_q or _tuned_block(q.shape[1]), q.shape[1])
+        bk = min(block_k or _tuned_block(k.shape[1]), k.shape[1])
+        dq, dk, dv = _pallas_backward(q, k, v, o, lse, g, causal,
+                                      float(scale), bq, bk, interpret,
+                                      row_start=row_start, row_end=row_end)
+    else:
+        _, vjp = jax.vjp(
+            lambda a, b, c: _xla_rowmask_reference(
+                a, b, c, row_start, row_end, causal, float(scale)), q, k, v)
+        dq, dk, dv = vjp(g)
+    return dq, dk, dv, _seg_zero_cot(row_start), _seg_zero_cot(row_end)
+
+
+flash_attention_rowmask.defvjp(_far_fwd, _far_bwd)
 
 
 # Back-compat name used by nn.functional

@@ -251,8 +251,51 @@ def profile(log_dir="./profiler_log"):
         jax.profiler.stop_trace()
 
 
+class ProfilerResult:
+    """Aggregated view of an exported chrome trace (reference:
+    profiler_statistic.py statistics over the event tree)."""
+
+    def __init__(self, events):
+        self.events = events
+        agg = {}
+        for e in events:
+            if e.get("ph") != "X":
+                continue
+            name = e.get("name", "?")
+            d = agg.setdefault(name, {"calls": 0, "total_us": 0.0})
+            d["calls"] += 1
+            d["total_us"] += float(e.get("dur", 0.0))
+        self.summary = {
+            n: {**d, "avg_us": d["total_us"] / max(d["calls"], 1)}
+            for n, d in agg.items()}
+
+    def sorted_by_total(self):
+        return sorted(self.summary.items(), key=lambda kv: -kv[1]["total_us"])
+
+
 def load_profiler_result(path):
-    raise NotImplementedError("open the XPlane dump with TensorBoard's profile plugin")
+    """Load an exported chrome-trace JSON (export_chrome_tracing /
+    export_host_chrome_trace output, or a jax.profiler trace dir) into a
+    ProfilerResult with per-name call counts and durations. Raw XPlane
+    protobuf dumps remain TensorBoard-profile territory."""
+    import gzip
+    import json
+    import os
+
+    if os.path.isdir(path):
+        cands = [os.path.join(r, f) for r, _, fs in os.walk(path)
+                 for f in fs if f.endswith((".json", ".json.gz",
+                                            ".trace.json.gz"))]
+        if not cands:
+            raise FileNotFoundError(
+                f"no chrome-trace .json under {path} (XPlane-only dump? "
+                "open it with TensorBoard's profile plugin)")
+        path = max(cands, key=os.path.getmtime)  # newest capture
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        data = json.load(f)
+    events = data.get("traceEvents", data if isinstance(data, list) else [])
+    return ProfilerResult(events)
 
 
 import enum as _enum

@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.op_registry import apply_fn
-from ..core.tensor import Tensor
+from ..core.tensor import Tensor, unwrap
 from ..nn.layer.layers import Layer
 from .. import nn
 
@@ -182,6 +182,78 @@ class ConvertedLinear(Layer):
 # QuantConfig / QAT / PTQ drivers
 # ---------------------------------------------------------------------------
 
+class QuantedConv2D(Layer):
+    """QAT conv: fake-quant per-channel weights + per-tensor activations in
+    forward (straight-through estimator in backward) — the conv analogue of
+    QuantedLinear. Reference: nn/quant/qat/conv.py."""
+
+    def __init__(self, conv, activation_observer=None, weight_bits: int = 8,
+                 act_bits: int = 8):
+        super().__init__()
+        self._conv = conv
+        self.weight = conv.weight
+        self.bias = conv.bias
+        # out-channel axis 0 of the OIHW weight layout
+        self._w_obs = PerChannelAbsmaxObserver(weight_bits, channel_axis=0)
+        self._a_obs = activation_observer or MovingAverageAbsmaxObserver(act_bits)
+        self._w_bits, self._a_bits = weight_bits, act_bits
+        self._calibrating = False  # matches QuantedLinear: eval() is stable
+
+    def forward(self, x):
+        if self._calibrating or self.training:
+            self._a_obs.sample(unwrap(x))
+            self._w_obs.sample(self.weight._data)
+        xq = fake_quant(x, self._a_obs.scale(), self._a_bits)
+        # per-out-channel scale broadcasts over the OIHW trailing dims
+        w_scale = jnp.asarray(self._w_obs.scale()).reshape(
+            (-1,) + (1,) * (self.weight._data.ndim - 1))
+        wq = fake_quant(self.weight, w_scale, self._w_bits)
+        c = self._conv
+        return nn.functional.conv2d(xq, wq, self.bias, c._stride, c._padding,
+                                    c._dilation, c._groups, c._data_format)
+
+
+class ConvertedConv2D(Layer):
+    """Deployment conv: int8-stored per-channel weights, dequantized at the
+    conv input (XLA fuses the dequant into the conv). Unlike the linear case
+    there is no profitable raw int8xint8 conv on the MXU, so storage is
+    quantized and compute is bf16/f32 — the reference's onednn int8 conv plays
+    the same storage-vs-compute trade on CPU."""
+
+    def __init__(self, conv, w_scale, a_scale, act_bits: int = 8,
+                 weight_bits: int = 8):
+        super().__init__()
+        inner = getattr(conv, "_conv", conv)
+        # keep only the conv CONFIG, never the live layer — registering it
+        # would drag the fp32 weight into parameters()/state_dict(), making
+        # the "int8 deployment" bigger than the original
+        self._cfg = (inner._stride, inner._padding, inner._dilation,
+                     inner._groups, inner._data_format)
+        w = conv.weight._data.astype(jnp.float32)
+        qmax = 2 ** (weight_bits - 1) - 1
+        # observer convention: scale == per-channel absmax; int value is
+        # round(w / (absmax / qmax)) — same as ConvertedLinear
+        scale = jnp.maximum(jnp.asarray(w_scale, jnp.float32), 1e-8)
+        bshape = (-1,) + (1,) * (w.ndim - 1)
+        step = (scale / qmax).reshape(bshape)
+        self.register_buffer("qweight", Tensor(
+            jnp.clip(jnp.round(w / step), -qmax, qmax).astype(jnp.int8)))
+        self._w_step = step
+        self.bias = inner.bias
+
+    def forward(self, x):
+        stride, padding, dilation, groups, data_format = self._cfg
+
+        def fn(a, qw, *b):
+            w = qw.astype(a.dtype) * self._w_step.astype(a.dtype)
+            return unwrap(nn.functional.conv2d(
+                Tensor(a), Tensor(w), Tensor(b[0]) if b else None, stride,
+                padding, dilation, groups, data_format))
+
+        args = [x, self.qweight] + ([self.bias] if self.bias is not None else [])
+        return apply_fn("quantized_conv2d", fn, *args)
+
+
 class QuantConfig:
     """Which layers to quantize, with which observers
     (reference: python/paddle/quantization/config.py)."""
@@ -220,21 +292,26 @@ class QAT:
         # half-quantized
         unsupported = sorted({
             type(l).__name__ for _, l in model.named_sublayers()
-            if isinstance(l, cfg._types) and not isinstance(l, nn.Linear)})
+            if isinstance(l, cfg._types)
+            and not isinstance(l, (nn.Linear, nn.Conv2D))})
         if unsupported:
             raise NotImplementedError(
                 f"quantization of {', '.join(unsupported)} is not supported "
-                f"yet (Linear only — conv QAT tracked in docs/PARITY.md)")
+                f"yet (Linear and Conv2D — see docs/PARITY.md)")
         if not inplace:
             import copy
 
             model = copy.deepcopy(model)
 
+        def build(l):
+            if isinstance(l, nn.Conv2D):
+                return QuantedConv2D(l, cfg.activation_factory(),
+                                     cfg.weight_bits, cfg.act_bits)
+            return QuantedLinear(l, cfg.activation_factory(),
+                                 cfg.weight_bits, cfg.act_bits)
+
         return _replace_layers(
-            model,
-            lambda l: isinstance(l, cfg._types),
-            lambda l: QuantedLinear(l, cfg.activation_factory(),
-                                    cfg.weight_bits, cfg.act_bits))
+            model, lambda l: isinstance(l, cfg._types), build)
 
     def convert(self, model: Layer, inplace: bool = True) -> Layer:
         cfg = self.config
@@ -242,11 +319,17 @@ class QAT:
             import copy
 
             model = copy.deepcopy(model)
+        def build(l):
+            if isinstance(l, QuantedConv2D):
+                return ConvertedConv2D(l, l._w_obs.scale(), l._a_obs.scale(),
+                                       cfg.act_bits, cfg.weight_bits)
+            return ConvertedLinear(l, l._w_obs.scale(), l._a_obs.scale(),
+                                   cfg.act_bits, cfg.weight_bits)
+
         return _replace_layers(
             model,
-            lambda l: isinstance(l, QuantedLinear),
-            lambda l: ConvertedLinear(l, l._w_obs.scale(), l._a_obs.scale(),
-                                      cfg.act_bits, cfg.weight_bits))
+            lambda l: isinstance(l, (QuantedLinear, QuantedConv2D)),
+            build)
 
 
 class PTQ:
@@ -265,13 +348,13 @@ class PTQ:
         q = self._qat.quantize(model, inplace)
         q.eval()
         for _, layer in q.named_sublayers(include_self=True):
-            if isinstance(layer, QuantedLinear):
+            if isinstance(layer, (QuantedLinear, QuantedConv2D)):
                 layer._calibrating = True
         return q
 
     def convert(self, model: Layer, inplace: bool = True) -> Layer:
         model.eval()
         for _, layer in model.named_sublayers(include_self=True):
-            if isinstance(layer, QuantedLinear):
+            if isinstance(layer, (QuantedLinear, QuantedConv2D)):
                 layer._calibrating = False
         return self._qat.convert(model, inplace)

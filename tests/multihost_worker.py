@@ -65,7 +65,17 @@ def main():
     g = dist.new_group(list(range(world)))
     assert g.nranks == world
 
-    print(f"MULTIHOST_OK rank={rank} sum={got}", flush=True)
+    # eager framework all_reduce with genuinely different per-rank operands
+    # (multi-process regime #3 in communication/functional.py)
+    import paddle_tpu as paddle
+
+    t = paddle.to_tensor(np.full((4,), float(rank + 1), np.float32))
+    dist.all_reduce(t)
+    want_ar = sum(range(1, world + 1))
+    got_ar = float(np.asarray(t.numpy())[0])
+    assert got_ar == want_ar, (got_ar, want_ar)
+
+    print(f"MULTIHOST_OK rank={rank} sum={got} ar={got_ar}", flush=True)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,65 @@
+"""CI gates (reference: tools/check_api_compatible.py + ci_op_benchmark.sh):
+the API-compat manifest check runs as a test so a removed public symbol fails
+the suite, and the bench-regression gate's comparison logic is pinned.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_api_surface_matches_manifest():
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import check_api_compatible as gate
+    finally:
+        sys.path.pop(0)
+    problems = gate.check(update=False)
+    assert not problems, f"API breaks: {problems}"
+
+
+def test_manifest_counts_cover_reference_parity():
+    """The frozen manifest must keep at least the asserted parity counts
+    (top-level 418, nn 140, nn.functional 128, linalg 33 vs reference
+    __all__ — the surfaces may exceed, never shrink below)."""
+    m = json.load(open(os.path.join(ROOT, "tools", "api_manifest.json")))
+    assert len(m["paddle"]) >= 418
+    assert len(m["paddle.nn"]) >= 140
+    assert len(m["paddle.nn.functional"]) >= 128
+    assert len(m["paddle.linalg"]) >= 33
+    assert len(m["paddle.tensor_methods"]) >= 350
+
+
+def test_bench_regression_gate_logic(tmp_path):
+    gate = os.path.join(ROOT, "tools", "check_bench_regression.py")
+    base = {"metric": "llama_pretrain_tokens_per_sec_per_chip",
+            "value": 100.0, "unit": "tok/s", "vs_baseline": 1.0}
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(base))
+
+    def run(vs):
+        fresh = tmp_path / "fresh.txt"
+        fresh.write_text(json.dumps({**base, "vs_baseline": vs}) + "\n")
+        # point the gate at tmp_path as repo root by copying it there
+        g2 = tmp_path / "tools" / "check_bench_regression.py"
+        g2.parent.mkdir(exist_ok=True)
+        g2.write_text(open(gate).read())
+        return subprocess.run([sys.executable, str(g2), str(fresh)],
+                              capture_output=True, text=True).returncode
+
+    assert run(0.99) == 0          # within 5%
+    assert run(0.96) == 0
+    assert run(0.90) == 1          # >5% drop fails
+
+
+def test_pip_installable_metadata():
+    import tomllib
+
+    with open(os.path.join(ROOT, "pyproject.toml"), "rb") as f:
+        meta = tomllib.load(f)
+    assert meta["project"]["name"] == "paddle-tpu"
+    assert "jax" in meta["project"]["dependencies"]

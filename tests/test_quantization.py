@@ -88,10 +88,44 @@ def test_quantize_inplace_false_preserves_original():
 
 def test_quantize_unsupported_type_raises():
     cfg = Q.QuantConfig()
-    cfg.add_type_config(paddle.nn.Conv2D)
-    net = paddle.nn.Sequential(paddle.nn.Conv2D(3, 8, 3))
-    with pytest.raises(NotImplementedError, match="Conv2D"):
+    cfg.add_type_config(paddle.nn.Conv2DTranspose)
+    net = paddle.nn.Sequential(paddle.nn.Conv2DTranspose(3, 8, 3))
+    with pytest.raises(NotImplementedError, match="Conv2DTranspose"):
         Q.QAT(cfg).quantize(net)
+
+
+def test_conv_qat_roundtrip_accuracy():
+    """Conv2D QAT: fake-quant training forward stays close to fp32; convert
+    produces int8-stored weights whose conv output tracks fp32 closely."""
+    import numpy as np
+
+    paddle.seed(0)
+    cfg = Q.QuantConfig()
+    cfg.add_type_config(paddle.nn.Conv2D)
+    net = paddle.nn.Sequential(paddle.nn.Conv2D(3, 8, 3, padding=1),
+                               paddle.nn.ReLU(),
+                               paddle.nn.Conv2D(8, 4, 3, padding=1))
+    x = paddle.to_tensor(np.random.default_rng(0)
+                         .standard_normal((2, 3, 8, 8)).astype("float32"))
+    ref = net(x).numpy()
+    q = Q.QAT(cfg).quantize(net, inplace=False)
+    kinds = [type(l).__name__ for _, l in q.named_sublayers()]
+    assert kinds.count("QuantedConv2D") == 2
+    q.eval()
+    for _, l in q.named_sublayers():
+        if hasattr(l, "_calibrating"):
+            l._calibrating = True
+    q(x)  # calibrate observers
+    deployed = Q.QAT(cfg).convert(q)
+    kinds = [type(l).__name__ for _, l in deployed.named_sublayers()]
+    assert kinds.count("ConvertedConv2D") == 2
+    # int8 weight storage
+    conv0 = next(l for _, l in deployed.named_sublayers()
+                 if type(l).__name__ == "ConvertedConv2D")
+    assert str(conv0.qweight.dtype) in ("int8", "DataType.INT8")
+    out = deployed(x).numpy()
+    rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-6)
+    assert rel < 0.08, rel  # int8 quantization error bound
 
 
 def test_ptq_calibrates_in_eval_mode():

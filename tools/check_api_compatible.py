@@ -1,0 +1,66 @@
+"""API-compatibility gate (reference: tools/check_api_compatible.py).
+
+Compares the live public surfaces against the frozen manifest
+(tools/api_manifest.json). A symbol REMOVED from any surface fails the gate;
+additions are allowed (and `--update` refreezes the manifest to include them).
+
+Run:  python tools/check_api_compatible.py [--update]
+Also enforced in CI via tests/test_ci_gates.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+MANIFEST = os.path.join(HERE, "api_manifest.json")
+
+
+def live_surfaces():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as paddle
+
+    def names(mod):
+        all_ = getattr(mod, "__all__", None)
+        if all_:
+            return sorted(set(all_))
+        return sorted(n for n in dir(mod) if not n.startswith("_"))
+
+    return {
+        "paddle": names(paddle),
+        "paddle.tensor_methods": sorted(
+            n for n in dir(paddle.Tensor) if not n.startswith("_")),
+        "paddle.nn": names(paddle.nn),
+        "paddle.nn.functional": names(paddle.nn.functional),
+        "paddle.linalg": names(paddle.linalg),
+        "paddle.optimizer": names(paddle.optimizer),
+        "paddle.distributed": names(paddle.distributed),
+        "paddle.incubate.nn.functional": names(paddle.incubate.nn.functional),
+    }
+
+
+def check(update: bool = False):
+    live = live_surfaces()
+    if update or not os.path.exists(MANIFEST):
+        json.dump(live, open(MANIFEST, "w"), indent=0, sort_keys=True)
+        print(f"manifest written: { {k: len(v) for k, v in live.items()} }")
+        return []
+    frozen = json.load(open(MANIFEST))
+    problems = []
+    for surface, want in frozen.items():
+        have = set(live.get(surface, []))
+        missing = sorted(set(want) - have)
+        if missing:
+            problems.append((surface, missing))
+    return problems
+
+
+if __name__ == "__main__":
+    probs = check(update="--update" in sys.argv)
+    for surface, missing in probs:
+        print(f"API BREAK in {surface}: removed {missing}")
+    sys.exit(1 if probs else 0)

@@ -1,0 +1,89 @@
+"""Bench-regression gate (reference: tools/ci_op_benchmark.sh +
+check_op_benchmark_result.py — relative old-vs-new perf comparison).
+
+Compares a fresh bench output (JSON lines from bench.py) against the last
+recorded driver result (BENCH_r*.json in the repo root, or an explicit
+baseline file). Fails when the primary metric's vs_baseline drops more than
+--tolerance (default 5%).
+
+Usage:
+    python bench.py > /tmp/bench_now.txt
+    python tools/check_bench_regression.py /tmp/bench_now.txt
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+PRIMARY = "llama_pretrain_tokens_per_sec_per_chip"
+
+
+def parse_lines(path):
+    out = {}
+    for line in open(path):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            d = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if "metric" in d:
+            out[d["metric"]] = d
+    return out
+
+
+def last_recorded(root):
+    files = sorted(glob.glob(os.path.join(root, "BENCH_r*.json")))
+    if not files:
+        return None
+    d = json.load(open(files[-1]))
+    # driver records either the raw line or a {"parsed": {...}} wrapper
+    return d.get("parsed", d)
+
+
+def main():
+    if len(sys.argv) < 2:
+        print(__doc__)
+        return 2
+    tol = 0.05
+    for i, a in enumerate(sys.argv):
+        if a == "--tolerance":
+            tol = float(sys.argv[i + 1])
+    now = parse_lines(sys.argv[1])
+    base = last_recorded(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    if base is None:
+        print("no recorded BENCH_r*.json baseline — gate passes vacuously")
+        return 0
+    cur = now.get(PRIMARY)
+    if cur is None:
+        print(f"FAIL: fresh output has no '{PRIMARY}' line")
+        return 1
+    prev_vs, cur_vs = base.get("vs_baseline"), cur.get("vs_baseline")
+    if prev_vs is None:
+        print("baseline has no vs_baseline — gate passes vacuously")
+        return 0
+    # the measured CONFIG lives in the unit string ("tokens/s (<config>, ...")
+    # — comparing across a config change (e.g. the round-2 switch to the
+    # honest seq-4096 GQA shape) is not a regression signal
+    def config_of(d):
+        u = d.get("unit", "")
+        return u.split("(", 1)[-1].split(",", 1)[0] if "(" in u else u
+
+    if config_of(base) != config_of(cur):
+        print(f"config changed ({config_of(base)!r} -> {config_of(cur)!r}) — "
+              "gate passes vacuously; next recorded BENCH becomes the baseline")
+        return 0
+    if cur_vs < prev_vs * (1.0 - tol):
+        print(f"FAIL: {PRIMARY} vs_baseline {cur_vs:.4f} < "
+              f"{prev_vs:.4f} * (1 - {tol}) — perf regression")
+        return 1
+    print(f"OK: {PRIMARY} vs_baseline {cur_vs:.4f} (baseline {prev_vs:.4f})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
