@@ -120,6 +120,15 @@ class Engine:
                 f"VPP needs n_micro % pp == 0, got {self._n_micro} % {pp_size}")
         self._pp_remat = (pp_remat if pp_remat is not None
                           else bool(getattr(getattr(model, "config", None), "recompute", False)))
+        # the model's remat policy (e.g. save flash out+lse) applies to the
+        # pipelined block remat too — same knob, both paths
+        try:
+            from ...models.llama.modeling import remat_policy_of
+
+            self._pp_remat_policy = remat_policy_of(
+                getattr(model, "config", None))
+        except Exception:
+            self._pp_remat_policy = None
         block_param_ids = {id(t) for b in self._blocks for _, t in b.named_parameters()}
 
         # --- functionalize: ordered trainable params (non-block "rest" first) ---
@@ -310,7 +319,8 @@ class Engine:
                     self._block_fn, stacked, x, cos, sin,
                     mesh=self.mesh, n_micro=self._n_micro,
                     remat=self._pp_remat, with_aux=self._pp_with_aux,
-                    interleave=self._pp_interleave)
+                    interleave=self._pp_interleave,
+                    remat_policy=self._pp_remat_policy)
                 if self._pp_with_aux:
                     # aux is summed per microbatch; average to match the
                     # whole-batch scale of the non-pp path

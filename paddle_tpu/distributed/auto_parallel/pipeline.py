@@ -216,6 +216,7 @@ def pipeline_call(
     remat: bool = False,
     with_aux: bool = False,
     interleave: int = 1,
+    remat_policy=None,
 ):
     """Run ``x`` through ``n_layers`` stacked blocks, pipelined over ``axis_name``.
 
@@ -235,7 +236,11 @@ def pipeline_call(
     over all layers and microbatches when ``with_aux``).
     """
     n_stages = mesh.shape[axis_name]
-    blk = jax.checkpoint(block_fn) if remat else block_fn
+    if remat:
+        blk = (jax.checkpoint(block_fn, policy=remat_policy)
+               if remat_policy is not None else jax.checkpoint(block_fn))
+    else:
+        blk = block_fn
 
     def _run_layers(wls, h, *bargs):
         # wls: [n_local_layers, ...] arrays; scan blocks over the leading dim

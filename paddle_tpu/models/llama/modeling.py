@@ -45,6 +45,7 @@ class LlamaConfig:
         tie_word_embeddings: bool = False,
         dtype: str = "float32",
         recompute: bool = False,
+        remat_policy: str = "flash",
         use_flash_attention: bool = True,
         sequence_parallel: bool = False,
         num_experts: int = 1,
@@ -66,6 +67,10 @@ class LlamaConfig:
         self.tie_word_embeddings = tie_word_embeddings
         self.dtype = dtype
         self.recompute = recompute
+        if remat_policy not in ("flash", "full"):
+            raise ValueError(f"remat_policy must be 'flash' or 'full', got "
+                             f"{remat_policy!r}")
+        self.remat_policy = remat_policy
         self.use_flash_attention = use_flash_attention
         self.sequence_parallel = sequence_parallel
         self.num_experts = num_experts
@@ -403,7 +408,7 @@ class LlamaModel(Layer):
                          else jnp.zeros((), jnp.float32))
                     return y, a
 
-                x, aux = jax.checkpoint(blk)(x, cos, sin)
+                x, aux = _remat(blk, cfg)(x, cos, sin)
             else:
                 x = layer(x, cos, sin, attn_bias)
                 aux = _raw(layer.mlp.get_loss()) if moe else 0.0
@@ -411,6 +416,25 @@ class LlamaModel(Layer):
                 aux_total = aux_total + aux
         self._moe_aux = aux_total
         return self.norm(x)
+
+
+def remat_policy_of(cfg):
+    """The jax.checkpoint policy for cfg.remat_policy: 'flash' SAVES the
+    attention kernel's out+lse residuals (named in
+    ops/flash_attention._flash_fwd) so backward skips re-running the flash
+    forward kernel (verified: grad jaxpr drops from 4 to 3 pallas calls);
+    'full' (None) recomputes everything."""
+    if getattr(cfg, "remat_policy", "flash") == "flash":
+        return jax.checkpoint_policies.save_only_these_names(
+            "flash_out", "flash_lse")
+    return None
+
+
+def _remat(fn, cfg):
+    policy = remat_policy_of(cfg)
+    if policy is not None:
+        return jax.checkpoint(fn, policy=policy)
+    return jax.checkpoint(fn)
 
 
 def _decode_model(model: "LlamaModel", ids, caches, pos, pad_bias=None,

@@ -516,8 +516,14 @@ def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
     if _use_pallas(q, k, block_q, block_k, interpret):
+        from jax.ad_checkpoint import checkpoint_name
+
         out, lse = _pallas_forward(q, k, v, causal, scale, block_q, block_k,
                                    interpret)
+        # named so a remat policy can SAVE these residuals — backward then
+        # skips re-running the flash forward kernel (save-attention-out remat)
+        out = checkpoint_name(out, "flash_out")
+        lse = checkpoint_name(lse, "flash_lse")
         return out, (q, k, v, out, lse)
     return _xla_reference(q, k, v, causal, scale), (q, k, v, None, None)
 
