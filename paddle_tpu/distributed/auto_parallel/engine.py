@@ -121,14 +121,10 @@ class Engine:
         self._pp_remat = (pp_remat if pp_remat is not None
                           else bool(getattr(getattr(model, "config", None), "recompute", False)))
         # the model's remat policy (e.g. save flash out+lse) applies to the
-        # pipelined block remat too — same knob, both paths
-        try:
-            from ...models.llama.modeling import remat_policy_of
-
-            self._pp_remat_policy = remat_policy_of(
-                getattr(model, "config", None))
-        except Exception:
-            self._pp_remat_policy = None
+        # pipelined block remat too — same knob, both paths. Models expose it
+        # via a ``remat_policy()`` hook (no model-specific imports here).
+        pol_fn = getattr(model, "remat_policy", None)
+        self._pp_remat_policy = pol_fn() if callable(pol_fn) else None
         block_param_ids = {id(t) for b in self._blocks for _, t in b.named_parameters()}
 
         # --- functionalize: ordered trainable params (non-block "rest" first) ---

@@ -39,6 +39,11 @@ DEFAULT_RULES = (
     ("expert_mlp", "tp"),
     ("head_dim", None),
     ("norm", None),
+    # Megatron-SP residual stream: sequence sharded over the TP group too
+    # (fleet/utils/sequence_parallel_utils.py ScatterOp/GatherOp semantics) —
+    # GSPMD inserts the all-gather before qkv/mlp projections and the
+    # reduce-scatter after the row-parallel matmuls
+    ("seq_sp", ("sep", "tp")),
 )
 
 _state = threading.local()

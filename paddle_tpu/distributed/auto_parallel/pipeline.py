@@ -236,11 +236,8 @@ def pipeline_call(
     over all layers and microbatches when ``with_aux``).
     """
     n_stages = mesh.shape[axis_name]
-    if remat:
-        blk = (jax.checkpoint(block_fn, policy=remat_policy)
-               if remat_policy is not None else jax.checkpoint(block_fn))
-    else:
-        blk = block_fn
+    # policy=None is jax.checkpoint's default (plain full remat)
+    blk = jax.checkpoint(block_fn, policy=remat_policy) if remat else block_fn
 
     def _run_layers(wls, h, *bargs):
         # wls: [n_local_layers, ...] arrays; scan blocks over the leading dim

@@ -67,9 +67,9 @@ class LlamaConfig:
         self.tie_word_embeddings = tie_word_embeddings
         self.dtype = dtype
         self.recompute = recompute
-        if remat_policy not in ("flash", "full"):
-            raise ValueError(f"remat_policy must be 'flash' or 'full', got "
-                             f"{remat_policy!r}")
+        if remat_policy not in ("flash", "flash_mlp", "full"):
+            raise ValueError(f"remat_policy must be 'flash', 'flash_mlp' or "
+                             f"'full', got {remat_policy!r}")
         self.remat_policy = remat_policy
         self.use_flash_attention = use_flash_attention
         self.sequence_parallel = sequence_parallel
@@ -287,11 +287,15 @@ class LlamaMLP(Layer):
         self.down_proj_weight = annotate(mk(m, h), "mlp", "embed")
 
     def forward(self, x):
+        from jax.ad_checkpoint import checkpoint_name
+
         x = x._data if isinstance(x, Tensor) else x
         g = jnp.matmul(x, self.gate_proj_weight._data)
         u = jnp.matmul(x, self.up_proj_weight._data)
         act = jax.nn.silu(g) * u   # swiglu — XLA fuses this into the matmuls
         act = constrain(act, "batch", "seq", "mlp")
+        # named for the 'flash_mlp' remat policy (saveable, not saved by default)
+        act = checkpoint_name(act, "mlp_act")
         out = jnp.matmul(act, self.down_proj_weight._data)
         return constrain(out, "batch", "seq", "embed")
 
@@ -341,6 +345,12 @@ class LlamaDecoderLayer(Layer):
         x = x + self.self_attn(self.input_layernorm(x), cos, sin, attn_bias)
         y = self.mlp(self.post_attention_layernorm(x))
         x = x + (y._data if isinstance(y, Tensor) else y)
+        if self.config.sequence_parallel:
+            # Megatron-SP: the residual stream (and the norms computed from
+            # it) lives sequence-sharded over sep AND tp between blocks;
+            # GSPMD all-gathers into the projections and reduce-scatters out
+            # of them (reference ColumnSequenceParallelLinear:427 semantics)
+            return constrain(x, "batch", "seq_sp", "embed")
         return constrain(x, "batch", "seq", "embed")
 
     def decode_step(self, hidden, cos, sin, k_cache, v_cache, pos,
@@ -424,17 +434,21 @@ def remat_policy_of(cfg):
     ops/flash_attention._flash_fwd) so backward skips re-running the flash
     forward kernel (verified: grad jaxpr drops from 4 to 3 pallas calls);
     'full' (None) recomputes everything."""
-    if getattr(cfg, "remat_policy", "flash") == "flash":
+    p = getattr(cfg, "remat_policy", "flash")
+    if p == "flash":
         return jax.checkpoint_policies.save_only_these_names(
             "flash_out", "flash_lse")
+    if p == "flash_mlp":
+        # additionally saves the swiglu product — measured OOM on the 853M
+        # seq-4096 batch-4 config (16.8G > 15.75G hbm); viable for smaller
+        # models/batches only
+        return jax.checkpoint_policies.save_only_these_names(
+            "flash_out", "flash_lse", "mlp_act")
     return None
 
 
 def _remat(fn, cfg):
-    policy = remat_policy_of(cfg)
-    if policy is not None:
-        return jax.checkpoint(fn, policy=policy)
-    return jax.checkpoint(fn)
+    return jax.checkpoint(fn, policy=remat_policy_of(cfg))
 
 
 def _decode_model(model: "LlamaModel", ids, caches, pos, pad_bias=None,
@@ -517,6 +531,10 @@ class LlamaForCausalLM(GenerationMixin, Layer):
             return Tensor(logits) if not isinstance(logits, jax.core.Tracer) else logits
         loss = LlamaPretrainingCriterion.compute(logits, _raw(labels))
         return loss
+
+    def remat_policy(self):
+        """Engine hook: the jax.checkpoint policy for this model's blocks."""
+        return remat_policy_of(self.config)
 
     def moe_aux_loss(self):
         """Sum of gate load-balance losses from the last forward (0 if dense).

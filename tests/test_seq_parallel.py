@@ -55,3 +55,63 @@ def test_reduce_lr_on_plateau():
     for loss in (1.0, 1.0, 1.0, 1.0):
         c.on_epoch_end(0, {"loss": loss})
     assert opt.get_lr() == 0.5  # plateaued -> halved
+
+
+def test_llama_megatron_sp_matches_dense(mesh8):
+    """cfg.sequence_parallel shards the residual stream over tp (Megatron-SP);
+    training must match the non-SP model exactly (same seed/data)."""
+    import numpy as np
+
+    from paddle_tpu.distributed.auto_parallel import Engine, axis_rules, make_mesh
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    losses = {}
+    for sp in (False, True):
+        paddle.seed(42)
+        mesh = make_mesh({"dp": 1, "fsdp": 2, "sep": 1, "tp": 4})
+        with axis_rules(mesh):
+            cfg = LlamaConfig.tiny(sequence_parallel=sp)
+            model = LlamaForCausalLM(cfg)
+        eng = Engine(model, mesh, lr=1e-3)
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, cfg.vocab_size, (4, 64)).astype(np.int32)
+        a, b = eng.shard_batch(ids, ids)
+        losses[sp] = [float(eng.step(a, b)) for _ in range(3)]
+    np.testing.assert_allclose(losses[True], losses[False], rtol=2e-4)
+
+
+def test_llama_sp_residual_sharded_over_tp(mesh8):
+    """Trace the decoder layer: with sequence_parallel the block OUTPUT comes
+    back sequence-sharded over tp (the Megatron-SP residual-stream layout);
+    without the flag it does not."""
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.distributed.auto_parallel import axis_rules, make_mesh
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.llama.modeling import _rope_cos_sin
+
+    mesh = make_mesh({"dp": 1, "fsdp": 2, "sep": 1, "tp": 4})
+    specs = {}
+    for sp in (False, True):
+        paddle.seed(0)
+        with axis_rules(mesh):
+            cfg = LlamaConfig.tiny(sequence_parallel=sp)
+            model = LlamaForCausalLM(cfg)
+        layer = model.model.layers[0]
+        cos, sin = _rope_cos_sin(64, cfg.head_dim, cfg.rope_theta, np.float32)
+
+        def f(x):
+            with axis_rules(mesh):
+                return layer(x, cos, sin)
+
+        x = jax.device_put(np.zeros((4, 64, cfg.hidden_size), np.float32),
+                           NamedSharding(mesh, P(None, None, None)))
+        out = jax.jit(f)(x)
+        seq_part = tuple(out.sharding.spec)[1] if len(tuple(out.sharding.spec)) > 1 else None
+        parts = (seq_part if isinstance(seq_part, tuple)
+                 else (seq_part,) if seq_part else ())
+        specs[sp] = parts
+    assert "tp" in specs[True], specs
+    assert "tp" not in specs[False], specs
