@@ -99,9 +99,20 @@ class GenerationMixin:
         return prefill, block
 
     def _init_paged_caches(self, b, max_len, page_size=64):
-        raise NotImplementedError(
-            f"{type(self).__name__} has no paged-KV cache path "
-            "(cache_impl='paged'); use the default dense caches")
+        """Paged-KV pools (serving layout, ops/paged_attention.py): per-layer
+        page pools + a shared block table with pages statically assigned per
+        sequence. Families with a different cache layout override this."""
+        cfg = self.config
+        kvh = getattr(cfg, "num_key_value_heads", cfg.num_attention_heads)
+        hd = cfg.head_dim
+        dtype = next(iter(p._data.dtype for _, p in self.named_parameters()))
+        maxp = -(-max_len // page_size)
+        npages = b * maxp
+        tables = jnp.arange(npages, dtype=jnp.int32).reshape(b, maxp)
+        kv = [(jnp.zeros((npages, kvh, page_size, hd), dtype),
+               jnp.zeros((npages, kvh, page_size, hd), dtype))
+              for _ in range(cfg.num_hidden_layers)]
+        return {"kv": kv, "tables": tables}
 
     def generate(self, input_ids, max_new_tokens: int = 32,
                  temperature: float = 1.0, top_p: float = None,

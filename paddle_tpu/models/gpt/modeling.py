@@ -94,6 +94,55 @@ class GPTAttention(Layer):
         return (jnp.matmul(out, self.out_weight._data)
                 + self.out_bias._data, k_cache, v_cache)
 
+    def paged_decode_step(self, x, k_pages, v_pages, tables, pos):
+        """Paged-KV generation step (serving suite) — see the llama analogue."""
+        from ...ops.flash_attention import flash_attention
+        from ...ops.paged_attention import append_paged_kv, paged_decode_attention
+
+        x = _raw(x)
+        b, s, h = x.shape
+        hd = self.config.head_dim
+        qkv = jnp.matmul(x, self.qkv_weight._data) + self.qkv_bias._data
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, s, self.num_heads, hd)
+        k = k.reshape(b, s, self.num_heads, hd)
+        v = v.reshape(b, s, self.num_heads, hd)
+        seq_ids = jnp.repeat(jnp.arange(b, dtype=jnp.int32), s)
+        positions = jnp.tile(pos + jnp.arange(s, dtype=jnp.int32), b)
+        k_pages, v_pages = append_paged_kv(
+            k_pages, v_pages, k.reshape(b * s, self.num_heads, hd),
+            v.reshape(b * s, self.num_heads, hd), tables, positions, seq_ids)
+        if s == 1:
+            ctx = jnp.full((b,), pos + 1, jnp.int32)
+            out = paged_decode_attention(q[:, 0], k_pages, v_pages, tables,
+                                         ctx)[:, None]
+        else:
+            out = flash_attention(q, k, v, causal=True)
+        out = out.reshape(b, s, h)
+        return (jnp.matmul(out, self.out_weight._data)
+                + self.out_bias._data, k_pages, v_pages)
+
+    def paged_token_step(self, x, k_pages, v_pages, tables, pos_vec):
+        """ONE token per row at PER-ROW positions (continuous batching)."""
+        from ...ops.paged_attention import append_paged_kv, paged_decode_attention
+
+        x = _raw(x)
+        b = x.shape[0]
+        h = x.shape[-1]
+        hd = self.config.head_dim
+        qkv = jnp.matmul(x, self.qkv_weight._data) + self.qkv_bias._data
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, 1, self.num_heads, hd)
+        k = k.reshape(b, 1, self.num_heads, hd)
+        v = v.reshape(b, 1, self.num_heads, hd)
+        k_pages, v_pages = append_paged_kv(
+            k_pages, v_pages, k[:, 0], v[:, 0], tables, pos_vec)
+        out = paged_decode_attention(q[:, 0], k_pages, v_pages, tables,
+                                     pos_vec + 1)
+        out = out.reshape(b, 1, h)
+        return (jnp.matmul(out, self.out_weight._data)
+                + self.out_bias._data, k_pages, v_pages)
+
     def __init__(self, config: GPTConfig):
         super().__init__()
         self.config = config
@@ -159,6 +208,22 @@ class GPTDecoderLayer(Layer):
         x = x + self.mlp(self.ln_2(x))
         return constrain(x, "batch", "seq", "embed")
 
+
+    def paged_decode_step(self, hidden, k_pages, v_pages, tables, pos):
+        x = _raw(hidden)
+        a, k_pages, v_pages = self.attn.paged_decode_step(
+            self.ln_1(x), k_pages, v_pages, tables, pos)
+        x = x + a
+        x = x + _raw(self.mlp(self.ln_2(x)))
+        return x, k_pages, v_pages
+
+    def paged_token_step(self, hidden, k_pages, v_pages, tables, pos_vec):
+        x = _raw(hidden)
+        a, k_pages, v_pages = self.attn.paged_token_step(
+            self.ln_1(x), k_pages, v_pages, tables, pos_vec)
+        x = x + a
+        x = x + _raw(self.mlp(self.ln_2(x)))
+        return x, k_pages, v_pages
 
     def decode_step(self, hidden, k_cache, v_cache, pos, pad_bias=None):
         x = _raw(hidden)
@@ -226,6 +291,22 @@ class GPTForCausalLM(GenerationMixin, Layer):
                 f"{self.config.max_position_embeddings} positions; prompt + "
                 f"max_new_tokens = {total_len} exceeds it")
 
+    def paged_token_step(self, toks, caches, pos_vec):
+        """Continuous-batching hook (see inference/serving.py): one token per
+        slot at per-slot positions."""
+        cfg = self.config
+        posc = jnp.clip(pos_vec, 0, cfg.max_position_embeddings - 1)
+        x = (jnp.take(self.gpt.wte._data, toks[:, None], axis=0)
+             + self.gpt.wpe._data[posc][:, None])
+        tables = caches["tables"]
+        new_kv = []
+        for layer, (kp, vp) in zip(self.gpt.layers, caches["kv"]):
+            x, kp, vp = layer.paged_token_step(x, kp, vp, tables, pos_vec)
+            new_kv.append((kp, vp))
+        hidden = _raw(self.gpt.ln_f(x))
+        logits = jnp.matmul(hidden[:, -1], self.gpt.wte._data.T)
+        return logits.astype(jnp.float32), {"kv": new_kv, "tables": tables}
+
     def _decode_chunk(self, ids, caches, pos, pad_bias, pos_offset):
         ids = _raw(ids)
         b, s = ids.shape
@@ -238,10 +319,18 @@ class GPTForCausalLM(GenerationMixin, Layer):
                                  - pos_offset[:, None], 0,
                                  self.config.max_position_embeddings - 1)
             x = x + self.gpt.wpe._data[positions]
-        new_caches = []
-        for layer, (kc, vc) in zip(self.gpt.layers, caches):
-            x, kc, vc = layer.decode_step(x, kc, vc, pos, pad_bias)
-            new_caches.append((kc, vc))
+        if isinstance(caches, dict):  # paged-KV serving path
+            tables = caches["tables"]
+            new_kv = []
+            for layer, (kp, vp) in zip(self.gpt.layers, caches["kv"]):
+                x, kp, vp = layer.paged_decode_step(x, kp, vp, tables, pos)
+                new_kv.append((kp, vp))
+            new_caches = {"kv": new_kv, "tables": tables}
+        else:
+            new_caches = []
+            for layer, (kc, vc) in zip(self.gpt.layers, caches):
+                x, kc, vc = layer.decode_step(x, kc, vc, pos, pad_bias)
+                new_caches.append((kc, vc))
         hidden = _raw(self.gpt.ln_f(x))
         logits = jnp.matmul(hidden[:, -1], self.gpt.wte._data.T)
         return logits.astype(jnp.float32), new_caches

@@ -226,6 +226,26 @@ class LlamaAttention(Layer):
         out = out.reshape(b, s, self.num_heads * hd)
         return jnp.matmul(out, self.o_proj_weight._data), k_pages, v_pages
 
+    def paged_token_step(self, x, cos, sin, k_pages, v_pages, tables, pos_vec):
+        """ONE token per row at PER-ROW positions (continuous batching:
+        every slot is at a different decode offset). x: [b, 1, h];
+        cos/sin [b, 1, d] gathered per row; pos_vec [b] int32."""
+        from ...ops.paged_attention import append_paged_kv, paged_decode_attention
+
+        x = x._data if isinstance(x, Tensor) else x
+        b = x.shape[0]
+        hd = self.config.head_dim
+        q = jnp.matmul(x, self.q_proj_weight._data).reshape(b, 1, self.num_heads, hd)
+        k = jnp.matmul(x, self.k_proj_weight._data).reshape(b, 1, self.num_kv_heads, hd)
+        v = jnp.matmul(x, self.v_proj_weight._data).reshape(b, 1, self.num_kv_heads, hd)
+        q, k = apply_rotary_pos_emb(q, k, cos, sin)
+        k_pages, v_pages = append_paged_kv(
+            k_pages, v_pages, k[:, 0], v[:, 0], tables, pos_vec)
+        out = paged_decode_attention(q[:, 0], k_pages, v_pages, tables,
+                                     pos_vec + 1)
+        out = out.reshape(b, 1, self.num_heads * hd)
+        return jnp.matmul(out, self.o_proj_weight._data), k_pages, v_pages
+
 
 def _attention(q, k, v, config, attn_bias=None):
     """Causal attention on raw arrays; routes to the Pallas kernel on TPU.
@@ -368,6 +388,17 @@ class LlamaDecoderLayer(Layer):
         x = hidden._data if isinstance(hidden, Tensor) else hidden
         a, k_pages, v_pages = self.self_attn.paged_decode_step(
             self.input_layernorm(x), cos, sin, k_pages, v_pages, tables, pos)
+        x = x + a
+        y = self.mlp(self.post_attention_layernorm(x))
+        x = x + (y._data if isinstance(y, Tensor) else y)
+        return x, k_pages, v_pages
+
+    def paged_token_step(self, hidden, cos, sin, k_pages, v_pages, tables,
+                         pos_vec):
+        x = hidden._data if isinstance(hidden, Tensor) else hidden
+        a, k_pages, v_pages = self.self_attn.paged_token_step(
+            self.input_layernorm(x), cos, sin, k_pages, v_pages, tables,
+            pos_vec)
         x = x + a
         y = self.mlp(self.post_attention_layernorm(x))
         x = x + (y._data if isinstance(y, Tensor) else y)
@@ -532,6 +563,32 @@ class LlamaForCausalLM(GenerationMixin, Layer):
         loss = LlamaPretrainingCriterion.compute(logits, _raw(labels))
         return loss
 
+    def paged_token_step(self, toks, caches, pos_vec):
+        """Continuous-batching hook: ONE token per slot at per-slot positions.
+        toks [b] int32, pos_vec [b] int32, caches from _init_paged_caches.
+        Returns (logits [b, vocab] f32, caches)."""
+        cfg = self.config
+        model = self.model
+        x = jnp.take(model.embed_tokens_weight._data, toks[:, None], axis=0)
+        tables = caches["tables"]
+        page = caches["kv"][0][0].shape[2]
+        max_len = tables.shape[1] * page
+        cos_full, sin_full = _rope_cos_sin(max_len, cfg.head_dim,
+                                           cfg.rope_theta, x.dtype)
+        posc = jnp.clip(pos_vec, 0, max_len - 1)
+        cos = cos_full[posc][:, None, :]
+        sin = sin_full[posc][:, None, :]
+        new_kv = []
+        for layer, (kp, vp) in zip(model.layers, caches["kv"]):
+            x, kp, vp = layer.paged_token_step(x, cos, sin, kp, vp, tables,
+                                               pos_vec)
+            new_kv.append((kp, vp))
+        hidden = model.norm(x)
+        hidden = hidden._data if isinstance(hidden, Tensor) else hidden
+        logits = self.logits(hidden[:, -1:])
+        return logits[:, -1].astype(jnp.float32), {"kv": new_kv,
+                                                   "tables": tables}
+
     def remat_policy(self):
         """Engine hook: the jax.checkpoint policy for this model's blocks."""
         return remat_policy_of(self.config)
@@ -545,22 +602,6 @@ class LlamaForCausalLM(GenerationMixin, Layer):
         if self.config.num_experts <= 1:
             return 0.0
         return getattr(self.model, "_moe_aux", 0.0)
-
-    def _init_paged_caches(self, b, max_len, page_size=64):
-        """Paged-KV pools for ``generate(cache_impl='paged')`` — the serving
-        layout (ops/paged_attention.py): per-layer page pools + a shared block
-        table, pages allocated per sequence."""
-        cfg = self.config
-        kvh = getattr(cfg, "num_key_value_heads", cfg.num_attention_heads)
-        hd = cfg.head_dim
-        dtype = next(iter(p._data.dtype for _, p in self.named_parameters()))
-        maxp = -(-max_len // page_size)
-        npages = b * maxp
-        tables = jnp.arange(npages, dtype=jnp.int32).reshape(b, maxp)
-        kv = [(jnp.zeros((npages, kvh, page_size, hd), dtype),
-               jnp.zeros((npages, kvh, page_size, hd), dtype))
-              for _ in range(cfg.num_hidden_layers)]
-        return {"kv": kv, "tables": tables}
 
     def _decode_chunk(self, ids, caches, pos, pad_bias, pos_offset):
         if isinstance(caches, dict):  # paged-KV serving path

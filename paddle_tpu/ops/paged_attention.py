@@ -176,7 +176,10 @@ def paged_decode_attention(q, k_cache, v_cache, block_tables, context_lens,
     group = hq // hkv
     if scale is None:
         scale = d ** -0.5
-    if not interpret and jax.default_backend() != "tpu":
+    # Mosaic page-DMA slicing needs a 128-aligned trailing dim and 8-aligned
+    # page dim; other shapes take the dense-gather fallback
+    shapes_ok = d % 128 == 0 and page % 8 == 0
+    if not interpret and (jax.default_backend() != "tpu" or not shapes_ok):
         return paged_decode_reference(q, k_cache, v_cache, block_tables,
                                       context_lens, scale)
     max_pages = block_tables.shape[1]

@@ -182,3 +182,15 @@ def test_paged_generation_rejects_mask(model):
     with pytest.raises(ValueError, match="paged"):
         m.generate(paddle.to_tensor(ids), max_new_tokens=2,
                    attention_mask=paddle.to_tensor(mask), cache_impl="paged")
+
+
+def test_gpt_paged_generation_matches_dense(gpt_model):
+    cfg, m = gpt_model
+    rng = np.random.default_rng(5)
+    ids = rng.integers(0, cfg.vocab_size, (2, 8)).astype(np.int32)
+    dense = m.generate(paddle.to_tensor(ids), max_new_tokens=6,
+                       temperature=0.0).numpy()
+    paged = m.generate(paddle.to_tensor(ids), max_new_tokens=6,
+                       temperature=0.0, cache_impl="paged",
+                       page_size=8).numpy()
+    np.testing.assert_array_equal(paged, dense)
