@@ -53,6 +53,8 @@ class LlamaConfig:
         moe_gate: str = "gshard",
         moe_aux_weight: float = 0.01,
         moe_capacity_factor: float = 1.25,
+        fused_ce: bool = True,
+        fused_ce_chunk: int = 1024,
     ):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
@@ -78,6 +80,10 @@ class LlamaConfig:
         self.moe_gate = moe_gate
         self.moe_aux_weight = moe_aux_weight
         self.moe_capacity_factor = moe_capacity_factor
+        # chunked lm-head+CE (ops/fused_ce.py) — skips the [b, s, V] logits
+        # materialization in the training loss; generation is unaffected
+        self.fused_ce = fused_ce
+        self.fused_ce_chunk = fused_ce_chunk
 
     @property
     def head_dim(self) -> int:
@@ -549,10 +555,13 @@ class LlamaForCausalLM(GenerationMixin, Layer):
                                       dtype=config.dtype, default_initializer=init),
                 "embed", "vocab")
 
+    def _lm_head_w(self):
+        """[hidden, vocab] projection — tied embedding transpose or lm_head."""
+        return (self.model.embed_tokens_weight._data.T
+                if self.lm_head_weight is None else self.lm_head_weight._data)
+
     def logits(self, hidden):
-        w = (self.model.embed_tokens_weight._data.T
-             if self.lm_head_weight is None else self.lm_head_weight._data)
-        out = jnp.matmul(hidden, w)
+        out = jnp.matmul(hidden, self._lm_head_w())
         return constrain(out, "batch", "seq", "vocab")
 
     def forward(self, input_ids, labels=None, attn_bias=None):
@@ -617,10 +626,22 @@ class LlamaForCausalLM(GenerationMixin, Layer):
     def loss_fn(self, input_ids, labels):
         """Raw-array loss for jit'ed training steps."""
         hidden = self.model(input_ids)
-        loss = LlamaPretrainingCriterion.compute(self.logits(hidden), _raw(labels))
+        loss = self._lm_loss(hidden, labels)
         if self.config.num_experts > 1:
             loss = loss + self.config.moe_aux_weight * self.moe_aux_loss()
         return loss
+
+    def _lm_loss(self, hidden, labels):
+        """Shifted CE from final hidden states; fused-chunked by default."""
+        hidden = hidden._data if isinstance(hidden, Tensor) else hidden
+        if self.config.fused_ce:
+            from ...ops.fused_ce import fused_linear_cross_entropy
+
+            return fused_linear_cross_entropy(
+                hidden, self._lm_head_w(), _raw(labels),
+                chunk=self.config.fused_ce_chunk)
+        return LlamaPretrainingCriterion.compute(self.logits(hidden),
+                                                 _raw(labels))
 
     # ---- pipeline-parallel protocol (used by Engine when mesh has pp > 1) ----
     @property
@@ -645,7 +666,8 @@ class LlamaForCausalLM(GenerationMixin, Layer):
         res = run_blocks(x, cos, sin)
         x, aux = res if isinstance(res, tuple) else (res, None)
         x = self.model.norm(x)
-        loss = LlamaPretrainingCriterion.compute(self.logits(x), _raw(labels))
+        x = x._data if isinstance(x, Tensor) else x
+        loss = self._lm_loss(x, labels)
         if aux is not None:
             loss = loss + self.config.moe_aux_weight * aux
         return loss
