@@ -46,11 +46,14 @@ def _device_peak(dev) -> float:
 
 
 def _emit(metric, value, unit, vs_baseline):
+    # vs_baseline=None → JSON null: BASELINE.json defines no denominator for
+    # this line (only the north-star MFU target exists); never fabricate 1.0.
     print(json.dumps({
         "metric": metric,
         "value": round(float(value), 2),
         "unit": unit,
-        "vs_baseline": round(float(vs_baseline), 4),
+        "vs_baseline": (None if vs_baseline is None
+                        else round(float(vs_baseline), 4)),
     }), flush=True)
 
 
@@ -126,7 +129,7 @@ def bench_resnet(dev, on_tpu):
     dt = time.perf_counter() - t0  # train_batch host-syncs the loss per step
     ips = batch * iters / dt
     _emit("resnet18_cifar_images_per_sec", ips,
-          f"images/s (batch {batch}, fp32, loss {loss[0]:.3f})", 1.0)
+          f"images/s (batch {batch}, fp32, loss {loss[0]:.3f})", None)
 
 
 def _scalar(x):
@@ -167,7 +170,72 @@ def bench_bert(dev, on_tpu):
     tps = batch * seq * iters / dt
     _emit("bert_base_ft_tokens_per_sec", tps,
           f"tokens/s (bf16 seq {seq} batch {batch}, loss {_scalar(loss):.3f})",
-          1.0)
+          None)
+
+
+def bench_serving(dev, on_tpu):
+    """Continuous-batching serving throughput vs dense-cache generate().
+
+    Config per the serving suite's design point: llama-750M-class bf16,
+    8 slots, prompt 64 (one bucket), 64 new tokens per request, greedy.
+    vs_baseline = engine tokens/s over dense-cache batch-8 generate()
+    tokens/s — the engine must not lose to the naive path it replaces.
+    """
+    import time as _t
+
+    import jax
+
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine, Request
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            num_hidden_layers=12, num_attention_heads=16,
+            num_key_value_heads=16, max_position_embeddings=2048,
+            dtype="bfloat16")
+        n_req, prompt_len, new_tok, slots, block = 16, 64, 64, 8, 8
+    else:
+        cfg = LlamaConfig.tiny()
+        n_req, prompt_len, new_tok, slots, block = 4, 8, 8, 2, 4
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size, (prompt_len,)).astype(np.int32)
+               for _ in range(n_req)]
+
+    # dense-cache generate() baseline: two full batches of 8
+    ids = np.stack(prompts[:slots])
+    model.generate(ids, max_new_tokens=new_tok, temperature=0.0)  # compile
+    t0 = _t.perf_counter()
+    for lo in range(0, n_req, slots):
+        model.generate(np.stack(prompts[lo:lo + slots]),
+                       max_new_tokens=new_tok, temperature=0.0)
+    dt_dense = _t.perf_counter() - t0
+    dense_tps = n_req * new_tok / dt_dense
+
+    # ONE engine for warmup + timing: jit caches key on the engine's closures,
+    # so a fresh engine would re-trace/compile inside the timed window
+    eng = ContinuousBatchingEngine(
+        model, max_batch=slots, max_len=prompt_len + new_tok,
+        page_size=64 if on_tpu else 8, block_size=block,
+        prompt_buckets=[prompt_len])
+
+    def run_wave():
+        for p in prompts:
+            eng.add_request(Request(p, max_new_tokens=new_tok))
+        eng.run_until_done()
+
+    run_wave()                                     # compile both programs
+    t0 = _t.perf_counter()
+    run_wave()
+    dt = _t.perf_counter() - t0
+    eng_tps = n_req * new_tok / dt
+    ms_per_step = dt / (n_req * new_tok / slots) * 1e3  # per fused token step row
+    _emit("serving_tokens_per_sec", eng_tps,
+          f"generated tok/s (llama-750M bf16, {slots} slots, prompt "
+          f"{prompt_len}→{new_tok} new, block {block}, "
+          f"{ms_per_step:.1f} ms/token-row; dense generate batch-{slots}: "
+          f"{dense_tps:.0f} tok/s)", eng_tps / dense_tps)
 
 
 def main():
@@ -189,6 +257,11 @@ def main():
         bench_bert(dev, on_tpu)
     except Exception as e:
         print(f"# bert bench failed: {e!r}", flush=True)
+    gc.collect()
+    try:
+        bench_serving(dev, on_tpu)
+    except Exception as e:
+        print(f"# serving bench failed: {e!r}", flush=True)
     gc.collect()
 
     if on_tpu:

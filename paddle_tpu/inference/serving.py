@@ -2,7 +2,8 @@
 
 The TPU-native counterpart of the reference's serving stack around
 block_multihead_attention (python/paddle/incubate/nn/functional/
-block_multihead_attention.py over block_multi_head_attention_kernel.cu):
+block_multihead_attention.py over block_multi_head_attention_kernel.cu)
+plus its sampling op (python/paddle/tensor/search.py:1362 top_p_sampling):
 a fixed pool of KV pages + per-slot block tables, requests admitted into
 free slots as others finish — decode compute and cache memory are bounded
 by the pool, not by the longest request.
@@ -10,31 +11,37 @@ by the pool, not by the longest request.
 Design (one jitted program per phase, static shapes):
   - ``max_batch`` slots share per-layer page pools sized
     ``max_batch * ceil(max_len / page)`` pages (``_init_paged_caches``).
-  - ADMIT: a new request prefills ITS slot only (an s>1 paged_decode_step
-    chunk at exact prompt length; lengths compile once each — pad prompts
-    client-side to a few buckets to bound compilations).
-  - STEP: ONE fused ``paged_token_step`` advances EVERY active slot — each
-    slot at its own position (per-row positions/context lengths flow into
-    the paged decode kernel). Inactive slots run on a parked dummy row whose
-    output is ignored.
+  - ADMIT: a new request prefills ITS slot only. With ``prompt_buckets`` the
+    prompt is right-padded to the nearest bucket (one compilation per bucket):
+    the padded chunk fills the cache, then the last REAL token is re-stepped
+    at its true position so the first sampled token sees exactly the real
+    prompt — pad cache entries sit beyond the attended window and are
+    overwritten as decode advances.
+  - STEP: ONE fused ``lax.scan`` of ``paged_token_step`` advances EVERY
+    active slot up to ``block_size`` tokens per host round-trip — per-row
+    positions flow into the paged decode kernel; the host syncs once per
+    block, not once per token. Inactive slots run on a parked dummy row
+    whose output is ignored.
+  - SAMPLE: per-request temperature / top-p / top-k / seed, applied
+    row-vectorized inside the fused step. Keys are stateless:
+    ``fold_in(key(seed), token_position)`` — reproducible per request and
+    independent of batching/arrival order. temperature==0 is greedy.
   - FINISH: eos or max_new_tokens frees the slot; its pages are reused by
-    the next admission (tables are per-slot, so no copying).
+    the next admission (tables are per-slot, so no copying). Tokens decoded
+    past an eos inside a block are discarded on the host (bounded waste,
+    the standard continuous-batching speculation tradeoff).
 
-Greedy decoding (the serving default). Models plug in via the GenerationMixin
-paged hooks: ``_init_paged_caches`` + ``paged_token_step`` + ``_decode_chunk``
-(llama and GPT implement all three).
-
-Numerics: the engine is EXACTLY equal to ``generate(cache_impl='paged')``
-(verified token-for-token on the real chip, 32/32); versus the dense-cache
-generate it matches exactly in fp32 (CPU tests) while bf16-on-TPU tokens may
-diverge at softmax near-ties between the two attention kernels — the standard
-cross-kernel serving caveat.
+Numerics: with default greedy sampling the engine is EXACTLY equal to
+``generate(cache_impl='paged')`` (verified token-for-token on the real chip);
+versus the dense-cache generate it matches exactly in fp32 (CPU tests) while
+bf16-on-TPU tokens may diverge at softmax near-ties between the two attention
+kernels — the standard cross-kernel serving caveat.
 """
 
 from __future__ import annotations
 
 import collections
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -43,13 +50,26 @@ import numpy as np
 from ..core.tensor import Tensor
 
 
+# THE sampler lives in generation_utils so generate() and the engine share one
+# implementation; re-exported here for the serving-facing API surface.
+from ..models.generation_utils import fold_keys as _fold_keys, sample_rows
+
+
 class Request:
-    """One generation request tracked by the engine."""
+    """One generation request tracked by the engine.
+
+    Sampling params mirror ``generate()``: ``temperature=0`` (default) is
+    greedy; otherwise temperature + optional top-p (nucleus) + top-k filter.
+    ``seed`` (default: the request id) makes the request's sample stream
+    reproducible regardless of batching or arrival order.
+    """
 
     _counter = [0]
 
     def __init__(self, prompt_ids, max_new_tokens: int = 32,
-                 eos_token_id: Optional[int] = None):
+                 eos_token_id: Optional[int] = None,
+                 temperature: float = 0.0, top_p: float = 1.0,
+                 top_k: int = 0, seed: Optional[int] = None):
         Request._counter[0] += 1
         self.rid = Request._counter[0]
         self.prompt = np.asarray(
@@ -57,22 +77,37 @@ class Request:
         ).reshape(-1).astype(np.int32)
         self.max_new_tokens = int(max_new_tokens)
         self.eos_token_id = eos_token_id
+        self.temperature = float(temperature)
+        self.top_p = float(top_p)
+        self.top_k = int(top_k)
+        self.seed = int(seed if seed is not None else self.rid)
         self.output: List[int] = []
         self.done = False
 
 
 class ContinuousBatchingEngine:
     def __init__(self, model, max_batch: int = 8, max_len: int = 512,
-                 page_size: int = 64):
+                 page_size: int = 64, block_size: int = 8,
+                 prompt_buckets: Optional[Sequence[int]] = None):
         self.model = model
         self.max_batch = max_batch
         self.max_len = max_len
         self.page_size = page_size
+        self.block_size = max(1, int(block_size))
+        self.prompt_buckets = (sorted(int(b) for b in prompt_buckets)
+                               if prompt_buckets else None)
+        if self.prompt_buckets and self.prompt_buckets[-1] > max_len:
+            raise ValueError(f"prompt bucket {self.prompt_buckets[-1]} "
+                             f"exceeds max_len {max_len}")
         self.caches = model._init_paged_caches(max_batch, max_len, page_size)
         self._slots: List[Optional[Request]] = [None] * max_batch
         # per-slot NEXT write position (== tokens currently in the slot's cache)
         self._pos = np.zeros(max_batch, np.int32)
         self._last_tok = np.zeros(max_batch, np.int32)
+        self._temps = np.zeros(max_batch, np.float32)
+        self._tops = np.ones(max_batch, np.float32)
+        self._topks = np.zeros(max_batch, np.int32)
+        self._seeds = np.zeros(max_batch, np.int32)
         self._queue: collections.deque = collections.deque()
         self._finished: Dict[int, Request] = {}
 
@@ -90,6 +125,10 @@ class ContinuousBatchingEngine:
             raise ValueError(
                 f"prompt {len(req.prompt)} + max_new {req.max_new_tokens} "
                 f"exceeds engine max_len {self.max_len}")
+        if self.prompt_buckets and len(req.prompt) > self.prompt_buckets[-1]:
+            raise ValueError(
+                f"prompt {len(req.prompt)} exceeds largest prompt bucket "
+                f"{self.prompt_buckets[-1]}")
         # family-specific length limits (e.g. GPT's learned position table) —
         # the same validation generate() applies
         validate = getattr(self.model, "_validate_generate", None)
@@ -102,11 +141,20 @@ class ContinuousBatchingEngine:
         return bool(self._queue) or any(s is not None for s in self._slots)
 
     def step(self):
-        """Admit whatever fits, then advance every active slot one token."""
+        """Admit whatever fits, then advance active slots up to block_size
+        tokens in ONE device program (one host sync per block)."""
         self._admit()
-        if not any(s is not None for s in self._slots):
+        live = [(i, r) for i, r in enumerate(self._slots) if r is not None]
+        if not live:
             return
         active = np.array([s is not None for s in self._slots])
+        # block length: never decode past a request's max_new_tokens or the
+        # engine max_len (pages beyond the table would clamp-corrupt)
+        n = self.block_size
+        for i, r in live:
+            n = min(n, r.max_new_tokens - len(r.output),
+                    self.max_len - int(self._pos[i]))
+        n = max(1, n)
         # parked rows decode at position 0 over slot-local pages — harmless
         pos_vec = jnp.asarray(np.where(active, self._pos, 1) - 1)
         toks = jnp.asarray(self._last_tok)
@@ -114,29 +162,46 @@ class ContinuousBatchingEngine:
             from ..core import autograd_engine
             from ..jit.api import _Swap
 
-            def run(params, toks, caches, pos_vec):
-                with autograd_engine.no_grad(), _Swap(self._tensors, params):
-                    logits, caches = self.model.paged_token_step(
-                        toks, caches, pos_vec)
-                return jnp.argmax(logits, -1).astype(jnp.int32), caches
+            def run(params, toks, caches, pos_vec, seeds, temps, tops, topks,
+                    n_steps):
+                def body(carry, _):
+                    tok, cs, pos = carry
+                    with autograd_engine.no_grad(), _Swap(self._tensors,
+                                                          params):
+                        logits, cs = self.model.paged_token_step(tok, cs, pos)
+                    keys = _fold_keys(seeds, pos + 1)
+                    nxt = sample_rows(logits, keys, temps, tops, topks)
+                    return (nxt, cs, pos + 1), nxt
 
-            self._jit_step = jax.jit(run)
-        nxt, self.caches = self._jit_step(self._params, toks, self.caches,
-                                          pos_vec)
-        nxt = np.asarray(nxt)
+                (tok, cs, _), out = jax.lax.scan(
+                    body, (toks, caches, pos_vec), None, length=n_steps)
+                return jnp.swapaxes(out, 0, 1), cs
+
+            self._jit_step = jax.jit(run, static_argnames=("n_steps",))
+        out, self.caches = self._jit_step(
+            self._params, toks, self.caches, pos_vec,
+            jnp.asarray(self._seeds), jnp.asarray(self._temps),
+            jnp.asarray(self._tops), jnp.asarray(self._topks), n_steps=n)
+        out = np.asarray(out)
         for i, req in enumerate(self._slots):
             if req is None:
                 continue
-            tok = int(nxt[i])
-            req.output.append(tok)
-            self._last_tok[i] = tok
-            self._pos[i] += 1
-            if ((req.eos_token_id is not None and tok == req.eos_token_id)
-                    or len(req.output) >= req.max_new_tokens):
-                req.done = True
+            took = 0
+            for j in range(n):
+                tok = int(out[i, j])
+                req.output.append(tok)
+                took = j + 1
+                if ((req.eos_token_id is not None and tok == req.eos_token_id)
+                        or len(req.output) >= req.max_new_tokens):
+                    req.done = True
+                    break
+            self._last_tok[i] = req.output[-1]
+            self._pos[i] += took
+            if req.done:
                 self._finished[req.rid] = req
                 self._slots[i] = None       # slot + its pages are free again
                 self._pos[i] = 0
+                self._temps[i] = 0.0
 
     def run_until_done(self, max_steps: int = 100000):
         steps = 0
@@ -155,6 +220,10 @@ class ContinuousBatchingEngine:
             if self._slots[i] is not None or not self._queue:
                 continue
             req = self._queue.popleft()
+            self._temps[i] = req.temperature
+            self._tops[i] = req.top_p
+            self._topks[i] = req.top_k
+            self._seeds[i] = req.seed
             first = self._prefill(i, req)
             self._slots[i] = req
             req.output.append(first)
@@ -166,29 +235,64 @@ class ContinuousBatchingEngine:
                 self._finished[req.rid] = req
                 self._slots[i] = None
                 self._pos[i] = 0
+                self._temps[i] = 0.0
+
+    def _bucket(self, n: int) -> int:
+        if not self.prompt_buckets:
+            return n
+        for b in self.prompt_buckets:
+            if b >= n:
+                return b
+        return n  # unreachable: add_request validates against the last bucket
 
     def _prefill(self, slot: int, req: Request) -> int:
         """Prefill ONE slot's pages with the prompt; returns the first token.
 
-        Compiles once per (slot-independent) prompt length — pad prompts to a
-        few fixed buckets client-side to bound compilations."""
+        Compiles once per PADDED prompt length — with ``prompt_buckets`` that
+        is once per bucket; the re-step of the last real token keeps bucketed
+        numerics exact (see module docstring)."""
         n = len(req.prompt)
-        fn = self._jit_prefill.get(n)
+        padded = self._bucket(n)
+        bucketed = padded != n
+        ids = req.prompt
+        if bucketed:
+            ids = np.concatenate([ids, np.zeros(padded - n, np.int32)])
+        # the re-step is compiled in only for genuinely padded prompts — an
+        # exact-length prefill (incl. the prompt_buckets=None default) carries
+        # no dead extra token step
+        fn = self._jit_prefill.get((padded, bucketed))
         if fn is None:
             from ..core import autograd_engine
             from ..jit.api import _Swap
 
-            def run(params, ids, kv, tables):
+            def run(params, ids, kv, tables, true_len, seed, temp, top_p,
+                    top_k, restep=bucketed):
                 sub = {"kv": kv, "tables": tables}
                 with autograd_engine.no_grad(), _Swap(self._tensors, params):
                     logits, sub = self.model._decode_chunk(
                         ids, sub, 0, None, None)
-                return jnp.argmax(logits, -1).astype(jnp.int32), sub["kv"]
+                    if restep:
+                        # re-step the last REAL token at its true position:
+                        # identical k/v rewrite, logits over the real prompt
+                        # only (pad columns beyond true_len not yet attended)
+                        last = jnp.take_along_axis(
+                            ids, true_len[:, None] - 1, axis=1)[:, 0]
+                        logits, sub = self.model.paged_token_step(
+                            last, sub, true_len - 1)
+                keys = _fold_keys(seed, true_len)
+                nxt = sample_rows(logits, keys, temp, top_p,
+                                  top_k)
+                return nxt, sub["kv"]
 
-            fn = self._jit_prefill[n] = jax.jit(run)
+            fn = self._jit_prefill[(padded, bucketed)] = jax.jit(
+                run, static_argnames=("restep",))
         tables = self.caches["tables"][slot:slot + 1]
         kv = self.caches["kv"]
-        first, new_kv = fn(self._params, jnp.asarray(req.prompt)[None], kv,
-                           tables)
+        first, new_kv = fn(
+            self._params, jnp.asarray(ids)[None], kv, tables,
+            jnp.asarray([n], jnp.int32), jnp.asarray([req.seed], jnp.int32),
+            jnp.asarray([req.temperature], jnp.float32),
+            jnp.asarray([req.top_p], jnp.float32),
+            jnp.asarray([req.top_k], jnp.int32))
         self.caches = {"kv": new_kv, "tables": self.caches["tables"]}
         return int(first[0])

@@ -23,6 +23,39 @@ from ..core.tensor import Tensor
 DECODE_BLOCK = 16
 
 
+def sample_rows(logits, keys, temps, top_ps, top_ks):
+    """Row-vectorized sampling: per-row temperature/top-p/top-k/key.
+
+    THE sampling implementation — ``generate()`` and the continuous-batching
+    serving engine both draw through it, so their distributions are identical
+    by construction (reference sampling op: python/paddle/tensor/search.py:1362
+    top_p_sampling).
+
+    logits [b, V] f32; keys: typed PRNG key array [b]; temps/top_ps [b] f32;
+    top_ks [b] int32 (0 = disabled). temperature<=0 rows take argmax.
+    """
+    V = logits.shape[-1]
+    greedy = jnp.argmax(logits, -1).astype(jnp.int32)
+    lg = logits / jnp.maximum(temps[:, None], 1e-6)
+    sort_idx = jnp.argsort(-lg, axis=-1)
+    sorted_lg = jnp.take_along_axis(lg, sort_idx, -1)
+    p = jax.nn.softmax(sorted_lg, -1)
+    cum = jnp.cumsum(p, -1)
+    keep = (cum - p) <= top_ps[:, None]
+    kk = jnp.where(top_ks > 0, top_ks, V)
+    keep = keep & (jnp.arange(V)[None, :] < kk[:, None])
+    masked = jnp.where(keep, sorted_lg, -1e9)
+    choice = jax.vmap(jax.random.categorical)(keys, masked)
+    sampled = jnp.take_along_axis(sort_idx, choice[:, None], -1)[:, 0]
+    return jnp.where(temps <= 0.0, greedy, sampled.astype(jnp.int32))
+
+
+def fold_keys(seeds, positions):
+    """Stateless per-row keys: fold the token position into the request seed."""
+    return jax.vmap(
+        lambda s, p: jax.random.fold_in(jax.random.key(s), p))(seeds, positions)
+
+
 class GenerationMixin:
     def _init_caches(self, b, max_len):
         """Default KV caches [b, max_len, kv_heads, head_dim] per layer; a
@@ -53,19 +86,12 @@ class GenerationMixin:
         def sample(logits, skey):
             if temperature == 0.0:
                 return jnp.argmax(logits, -1).astype(jnp.int32)
-            logits = logits / max(temperature, 1e-6)
-            if top_p is not None:
-                sort_idx = jnp.argsort(-logits, axis=-1)
-                sorted_p = jax.nn.softmax(
-                    jnp.take_along_axis(logits, sort_idx, -1), -1)
-                cum = jnp.cumsum(sorted_p, -1)
-                keep = cum - sorted_p <= top_p
-                masked = jnp.where(
-                    keep, jnp.take_along_axis(logits, sort_idx, -1), -1e9)
-                choice = jax.random.categorical(skey, masked, axis=-1)
-                return jnp.take_along_axis(
-                    sort_idx, choice[:, None], -1)[:, 0].astype(jnp.int32)
-            return jax.random.categorical(skey, logits, -1).astype(jnp.int32)
+            b = logits.shape[0]
+            return sample_rows(
+                logits, jax.random.split(skey, b),
+                jnp.full((b,), temperature, jnp.float32),
+                jnp.full((b,), 1.0 if top_p is None else top_p, jnp.float32),
+                jnp.zeros((b,), jnp.int32))
 
         def run_chunk(ps, chunk, cs, pos, pad_bias, pos_offset, skey):
             with autograd_engine.no_grad(), _Swap(tensors, ps):
