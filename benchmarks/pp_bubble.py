@@ -30,7 +30,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def run(p=4, v=1, hidden=1024, layers=8, mb_size=16, Ms=(4, 8, 16, 32), iters=10):
+def run(p=4, v=1, hidden=1024, layers=8, mb_size=16, Ms=(4, 8, 16, 32),
+        iters=10, schedule="auto"):
     from paddle_tpu.distributed.auto_parallel.pipeline import pipeline_call
 
     mesh = Mesh(np.array(jax.devices()[:p]), ("pp",))
@@ -48,7 +49,7 @@ def run(p=4, v=1, hidden=1024, layers=8, mb_size=16, Ms=(4, 8, 16, 32), iters=10
 
         def loss(w, x):
             out = pipeline_call(block_fn, [w], x, mesh=mesh, n_micro=M,
-                                interleave=v)
+                                interleave=v, schedule=schedule)
             return (out.astype(jnp.float32) ** 2).mean()
 
         g = jax.jit(jax.grad(loss))
@@ -60,7 +61,7 @@ def run(p=4, v=1, hidden=1024, layers=8, mb_size=16, Ms=(4, 8, 16, 32), iters=10
         dt = (time.perf_counter() - t0) / iters
         # per-microbatch time normalizes away the growing batch
         results[M] = dt / M
-        print(f"p={p} v={v} M={M:3d}: {dt*1e3:8.2f} ms/step  "
+        print(f"p={p} v={v} {schedule:>4} M={M:3d}: {dt*1e3:8.2f} ms/step  "
               f"{dt/M*1e3:6.2f} ms/microbatch", flush=True)
 
     # model check: time/M proportional to (vM + p - 1) / (vM)
@@ -73,5 +74,11 @@ def run(p=4, v=1, hidden=1024, layers=8, mb_size=16, Ms=(4, 8, 16, 32), iters=10
 
 
 if __name__ == "__main__":
-    run(p=4, v=1)
-    run(p=4, v=2)
+    # ZB vs same-v schedule at the VERDICT's comparison points (M=p, M=2p)
+    if "--zb" in sys.argv:
+        for v in (1, 2):
+            run(p=4, v=v, Ms=(4, 8), schedule="auto")
+            run(p=4, v=v, Ms=(4, 8), schedule="zb")
+    else:
+        run(p=4, v=1)
+        run(p=4, v=2)

@@ -92,6 +92,7 @@ class Engine:
         n_micro: Optional[int] = None,
         pp_remat: Optional[bool] = None,
         pp_interleave: int = 1,
+        pp_schedule: str = "auto",
         optimizer=None,
     ):
         self.model = model
@@ -110,6 +111,9 @@ class Engine:
         self._pp = pp_size > 1 and hasattr(model, "pipeline_blocks")
         self._blocks = model.pipeline_blocks() if self._pp else []
         self._pp_interleave = pp_interleave if self._pp else 1
+        # "auto" = GPipe / interleaved-VPP; "zb" = zero-bubble W/B split
+        # (reference ZBH1, pipeline_scheduler_pass/__init__.py:22)
+        self._pp_schedule = pp_schedule
         if self._pp and len(self._blocks) % (pp_size * self._pp_interleave) != 0:
             raise ValueError(
                 f"num blocks {len(self._blocks)} not divisible by "
@@ -316,7 +320,8 @@ class Engine:
                     mesh=self.mesh, n_micro=self._n_micro,
                     remat=self._pp_remat, with_aux=self._pp_with_aux,
                     interleave=self._pp_interleave,
-                    remat_policy=self._pp_remat_policy)
+                    remat_policy=self._pp_remat_policy,
+                    schedule=self._pp_schedule)
                 if self._pp_with_aux:
                     # aux is summed per microbatch; average to match the
                     # whole-batch scale of the non-pp path

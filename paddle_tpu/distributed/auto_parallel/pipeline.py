@@ -193,6 +193,195 @@ def interleaved_schedule(stage_fn: Callable, n_stages: int, interleave: int,
     return pipeline
 
 
+def zb_schedule(layer_fn, n_stages: int, interleave: int, lc: int,
+                axis_name: str = "pp"):
+    """Zero-bubble (ZBH1-class) W/B-split schedule, run INSIDE shard_map.
+
+    Parity anchor: the reference's zero-bubble pipeline passes
+    (distributed/passes/pipeline_scheduler_pass/__init__.py:22,36 — ZBH1 /
+    ZBVPP, impl pipeline_zero_bubble.py), which split each backward into
+    activation-grad (B, on the critical path) and weight-grad (W, deferrable)
+    so drain-phase bubbles fill with W work.
+
+    TPU-native redesign (hand-built reverse schedule replacing grad-of-scan):
+
+      1. FWD scan (ticks = vM + p - 1): identical dataflow to the interleaved
+         schedule, but every LAYER of the tick's chunk runs under ``jax.vjp``;
+         the per-layer pullbacks (linearization residuals) ride out of the
+         scans as stacked ys — jax vjp closures are pytrees, so ``lax.scan``
+         stacks them.
+      2. BWD scan (reverse, same tick count): chains each layer's pullback to
+         propagate ONLY the activation cotangent upstream (the weight half of
+         each layer's transposed jaxpr is dead code the compiler eliminates),
+         reverse-``ppermute``s it, and SAVES the per-layer output cotangents.
+         Per-tick critical-path work is B only: the W third of the
+         reference's bubble is GONE from both scans.
+      3. W drain: one accumulation scan re-applies the saved per-layer
+         pullbacks to the saved per-layer cotangents, keeping only the weight
+         grads — per-layer deferral exactly like ZBH1's W ops, so no
+         activation-chaining is recomputed (each layer's dW is one transpose
+         given its own cotangent). No cross-stage dependency — pure local
+         matmuls off the permute chain, batched per tick.
+
+    Total critical path ≈ (vM+p-1)(F + B)/v + M·W  vs  the interleaved
+    schedule's (vM+p-1)(F + B + W)/v — a saving of W·(p-1)/v wall-clock, the
+    exact W-bubble ZBH1 targets. Cost: linearization residuals (incl. the
+    tick's param slice) are saved for every tick — the no-remat memory regime,
+    ZB-paper "ZB-∞" end of the memory/bubble tradeoff — so ``remat`` is
+    ignored on this schedule. Gradient equality vs sequential is exact
+    (tests/test_pipeline.py).
+
+    ``layer_fn(per_layer_params, h, *bargs)`` runs ONE block; local params
+    carry a leading [v*lc] dim, chunk c covers rows [c*lc, (c+1)*lc). MoE aux
+    side-outputs are not supported (use VPP for MoE+pp).
+    """
+    p, v = n_stages, interleave
+    vp = v * p
+    perm_f = [(i, (i + 1) % p) for i in range(p)]
+    perm_b = [(i, (i - 1) % p) for i in range(p)]
+
+    def _meta(t, d, M):
+        cyc = jnp.mod(t - d, vp)
+        c = jnp.clip(cyc // p, 0, v - 1)  # local chunk index this tick
+        e = t - (c * p + d)               # entry tick of this (chunk, device)
+        er = jnp.mod(e, vp)
+        mb_raw = (e // vp) * p + er
+        active = (e >= 0) & (er < p) & (mb_raw < M)
+        mb = jnp.clip(mb_raw, 0, M - 1)
+        inj_here = (d == 0) & (cyc < p)   # device 0, chunk 0: consumes inject
+        inj_idx = jnp.clip((t // vp) * p + jnp.mod(t, vp), 0, M - 1)
+        is_out = (d == p - 1) & (c == v - 1) & active
+        return c, mb, active, inj_here, inj_idx, is_out
+
+    def _run_fwd(params, micro_in, bargs):
+        M = micro_in.shape[0]
+        d = jax.lax.axis_index(axis_name)
+        T = v * M + p - 1
+
+        def ftick(carry, t):
+            buf, outs = carry
+            c, mb, active, inj_here, inj_idx, is_out = _meta(t, d, M)
+            inj = jax.lax.dynamic_index_in_dim(micro_in, inj_idx, 0,
+                                               keepdims=False)
+            h = jnp.where(inj_here, inj, buf)
+            wls = [jax.lax.dynamic_slice_in_dim(w, c * lc, lc, 0)
+                   for w in params]
+
+            def layer_step(hh, wl):
+                yl, pb = jax.vjp(
+                    lambda w_, h_: layer_fn(w_, h_, *bargs), wl, hh)
+                return yl, pb
+
+            with _ManualCtx():
+                y, pbs_t = jax.lax.scan(layer_step, h, wls)
+            prev = jax.lax.dynamic_index_in_dim(outs, mb, 0, keepdims=False)
+            outs = jax.lax.dynamic_update_index_in_dim(
+                outs, jnp.where(is_out, y, prev), mb, 0)
+            nxt = jax.lax.ppermute(y, axis_name, perm_f)
+            return (nxt, outs), pbs_t
+
+        buf0 = jnp.zeros(micro_in.shape[1:], micro_in.dtype)
+        outs0 = jnp.zeros(micro_in.shape, micro_in.dtype)
+        (_, outs), pbs = jax.lax.scan(ftick, (buf0, outs0), jnp.arange(T))
+        outs = jnp.where(d == p - 1, outs, jnp.zeros_like(outs))
+        return jax.lax.psum(outs, axis_name), pbs
+
+    @jax.custom_vjp
+    def pipeline(params, micro_in, bargs):
+        outs, _ = _run_fwd(params, micro_in, bargs)
+        return outs
+
+    def pipeline_fwd(params, micro_in, bargs):
+        outs, pbs = _run_fwd(params, micro_in, bargs)
+        return outs, (pbs, params, bargs)
+
+    def pipeline_bwd(res, g):
+        pbs, params, bargs = res
+        # mirror the transpose of the fwd's final psum: shard_map delivers a
+        # replicated (P()) output's cotangent split 1/p per device; psumming
+        # reconstitutes the full cotangent on every device (exactly what
+        # autodiff of `psum(masked_outs)` does in the grad-of-scan schedules)
+        g = jax.lax.psum(g, axis_name)
+        mshape, mdtype = g.shape, g.dtype  # outs shape/dtype == micro_in's
+        M = mshape[0]
+        d = jax.lax.axis_index(axis_name)
+        T = v * M + p - 1
+
+        # ---- B scan: activation grads only, reverse tick order ----
+        def btick(carry, xs):
+            gbuf, dmicro = carry
+            t, pbs_t = xs
+            c, mb, active, inj_here, inj_idx, is_out = _meta(t, d, M)
+            g_m = jax.lax.dynamic_index_in_dim(g, mb, 0, keepdims=False)
+            dy = jnp.where(is_out, g_m.astype(gbuf.dtype), gbuf)
+            dy = jnp.where(active, dy, jnp.zeros_like(dy))
+
+            def layer_bwd(dh, pb):
+                # weight half of pb unused here -> DCE'd from the scan; the
+                # INCOMING dh is this layer's output cotangent — saved for W
+                _dw_dead, dh2 = pb(dh)
+                return dh2, dh
+
+            dh, dys_t = jax.lax.scan(layer_bwd, dy, pbs_t, reverse=True)
+            take = inj_here & active
+            prev = jax.lax.dynamic_index_in_dim(dmicro, mb, 0, keepdims=False)
+            dmicro = jax.lax.dynamic_update_index_in_dim(
+                dmicro, jnp.where(take, dh, prev), mb, 0)
+            # injected ticks consumed micro_in, not the permuted buf — send
+            # nothing upstream for them
+            send = jnp.where(inj_here, jnp.zeros_like(dh), dh)
+            gnxt = jax.lax.ppermute(send, axis_name, perm_b)
+            return (gnxt, dmicro), dys_t
+
+        gbuf0 = jnp.zeros(mshape[1:], mdtype)
+        dmicro0 = jnp.zeros(mshape, mdtype)
+        (_, dmicro), dys = jax.lax.scan(
+            btick, (gbuf0, dmicro0), (jnp.arange(T), pbs), reverse=True)
+        # shard_map transposes a replicated (P()) input by psumming per-device
+        # cotangents — return only THIS device's contribution
+        dmicro = jnp.where(d == 0, dmicro, jnp.zeros_like(dmicro))
+
+        # ---- W drain: per-layer weight grads from saved pullbacks + dys.
+        # Iterates only the v*M ACTIVE (chunk, microbatch) pairs — bubble
+        # ticks are skipped entirely (the reference's ZB schedules likewise
+        # emit W ops per real microbatch only), so the drain is vM ticks of
+        # pure W work vs the reverse schedules' T = vM + p - 1.
+        def wtick(acc, k):
+            c = k // M
+            m = k - c * M
+            # invert the tick mapping: entry tick of microbatch m on device 0
+            # chunk 0 is (m//p)*vp + m%p; this (chunk, device) sees it c*p + d
+            # ticks later
+            t = (m // p) * vp + jnp.mod(m, p) + c * p + d
+            pbs_t = jax.tree_util.tree_map(
+                lambda a: jax.lax.dynamic_index_in_dim(a, t, 0,
+                                                       keepdims=False), pbs)
+            dys_t = jax.lax.dynamic_index_in_dim(dys, t, 0, keepdims=False)
+
+            def layer_w(_, xs_l):
+                pb, dyl = xs_l
+                dwl, _dh_dead = pb(dyl)  # activation half unused -> DCE'd
+                return None, dwl
+
+            _, dws = jax.lax.scan(layer_w, None, (pbs_t, dys_t))
+            # scatter-add this tick's [lc]-chunk grads into the local stack
+            out = []
+            for a, dch in zip(acc, dws):
+                cur = jax.lax.dynamic_slice_in_dim(a, c * lc, lc, 0)
+                out.append(jax.lax.dynamic_update_slice_in_dim(
+                    a, cur + dch.astype(a.dtype), c * lc, 0))
+            return tuple(out), None
+
+        dw0 = tuple(jnp.zeros(a.shape, a.dtype) for a in params)
+        dw, _ = jax.lax.scan(wtick, dw0, jnp.arange(v * M))
+        dbargs = jax.tree_util.tree_map(
+            lambda a: jnp.zeros(a.shape, a.dtype), bargs)
+        return dw, dmicro, dbargs
+
+    pipeline.defvjp(pipeline_fwd, pipeline_bwd)
+    return pipeline
+
+
 def vpp_layer_order(n_layers: int, p: int, v: int):
     """Layer permutation so a contiguous [L/p] slice per device holds its v
     round-robin chunks: device d gets virtual stages {c*p + d}."""
@@ -217,6 +406,7 @@ def pipeline_call(
     with_aux: bool = False,
     interleave: int = 1,
     remat_policy=None,
+    schedule: str = "auto",
 ):
     """Run ``x`` through ``n_layers`` stacked blocks, pipelined over ``axis_name``.
 
@@ -231,11 +421,30 @@ def pipeline_call(
         tables).
       n_micro: number of microbatches (the reference's ``accumulate_steps``).
       remat: rematerialise each block in backward (fleet/recompute parity).
+      schedule: "auto" (GPipe for interleave=1, interleaved VPP otherwise) or
+        "zb" — the zero-bubble W/B-split schedule (see :func:`zb_schedule`;
+        ignores ``remat``, treats ``broadcast_args`` as non-differentiable,
+        no ``with_aux``).
 
     Returns global activations with the same shape as ``x`` (plus the aux sum
     over all layers and microbatches when ``with_aux``).
     """
     n_stages = mesh.shape[axis_name]
+    if schedule not in ("auto", "zb"):
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
+    if schedule == "zb":
+        if with_aux:
+            raise NotImplementedError(
+                "zero-bubble schedule does not support MoE aux side-outputs "
+                "— use the interleaved (VPP) schedule for MoE+pp")
+        if remat:
+            import warnings
+
+            warnings.warn(
+                "schedule='zb' ignores remat: it saves per-tick linearization "
+                "residuals by construction (ZB-∞ memory regime). Use the "
+                "GPipe/VPP schedules if recompute is required to fit memory.")
+        remat = False  # zb saves linearization residuals by construction
     # policy=None is jax.checkpoint's default (plain full remat)
     blk = jax.checkpoint(block_fn, policy=remat_policy) if remat else block_fn
 
@@ -266,13 +475,13 @@ def pipeline_call(
     mb = batch // n_micro
     micro = x.reshape((n_micro, mb) + x.shape[1:])
 
-    if interleave > 1:
+    if interleave > 1 or schedule == "zb":
         n_layers = stacked_params[0].shape[0]
         if n_layers % (interleave * n_stages) != 0:
             raise ValueError(
                 f"n_layers {n_layers} not divisible by interleave*pp "
                 f"{interleave}*{n_stages}")
-        if n_micro % n_stages != 0:
+        if interleave > 1 and n_micro % n_stages != 0:
             raise ValueError(
                 f"VPP requires n_micro % pp == 0, got {n_micro} % {n_stages} "
                 f"(reference: accumulate_steps % pp_degree == 0)")
@@ -284,6 +493,12 @@ def pipeline_call(
                    for w in local_params]
             return _run_layers(wls, h, *bargs)
 
+    if schedule == "zb":
+        zb = zb_schedule(blk, n_stages, interleave, lc, axis_name)
+
+        def pipeline(params, micro_in, *bargs):
+            return zb(params, micro_in, tuple(bargs))
+    elif interleave > 1:
         pipeline = interleaved_schedule(
             chunk_stage_fn, n_stages, interleave, axis_name, with_aux=with_aux)
     else:
