@@ -63,6 +63,9 @@ amp_state = None  # type: Optional[Any]
 # ---- profiler hook (set by paddle_tpu.profiler) ----
 profile_scope = None  # type: Optional[Callable]
 
+# ---- tensor-stats dump hook (set by paddle_tpu.amp.debugging) ----
+stats_recorder = None  # type: Optional[Any]
+
 
 def _is_tracer(x) -> bool:
     return isinstance(x, jax.core.Tracer)
@@ -182,12 +185,17 @@ def apply_fn(name: str, fn: Callable, *args, _opdef: Optional[OpDef] = None, **k
             results.append(t)
         if flags.get_flag("check_nan_inf"):
             _check_nan_inf(name, out_list)
+        if stats_recorder is not None:
+            stats_recorder.record(name, out_list)
         return results[0] if single else tuple(results)
 
     out = call_with(arrays)
-    if not tracing and flags.get_flag("check_nan_inf"):
+    if not tracing:
         outs = out if isinstance(out, (tuple, list)) else [out]
-        _check_nan_inf(name, [o for o in outs if hasattr(o, "dtype")])
+        if flags.get_flag("check_nan_inf"):
+            _check_nan_inf(name, [o for o in outs if hasattr(o, "dtype")])
+        if stats_recorder is not None:
+            stats_recorder.record(name, [o for o in outs if hasattr(o, "dtype")])
     if isinstance(out, (tuple, list)):
         return tuple(Tensor(o) if not isinstance(o, Tensor) else o for o in out)
     return Tensor(out) if not isinstance(out, Tensor) else out

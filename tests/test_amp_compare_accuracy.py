@@ -1,0 +1,62 @@
+"""AMP accuracy-compare tooling (amp/debugging.py compare_accuracy).
+
+Reference: paddle.amp.debugging.compare_accuracy
+(/root/reference/python/paddle/amp/debugging.py:595) — dump two runs
+(fp32 vs low precision), align per-op, emit the error table, flag excess error.
+"""
+
+import csv
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.amp.debugging import compare_accuracy, dump_tensor_stats
+
+
+def _run(dtype, path):
+    x = paddle.to_tensor(np.full((4, 4), 11.5, np.float32).astype(dtype))
+    w = paddle.to_tensor((np.eye(4) * 1.000244).astype(dtype))
+    with dump_tensor_stats(path):
+        y = paddle.matmul(x, w)      # benign in both precisions
+        z = paddle.exp(y)            # exp(11.5) ~ 1e5 — overflows fp16 to inf
+        _ = paddle.tanh(z)
+    return path
+
+
+def test_compare_accuracy_flags_unstable_op(tmp_path):
+    a = _run(np.float32, tmp_path / "fp32.jsonl")
+    b = _run(np.float16, tmp_path / "fp16.jsonl")
+    out_csv = tmp_path / "cmp.csv"
+
+    flagged = compare_accuracy(str(a), str(b), str(out_csv))
+    assert any(r["op"] == "exp" and r["status"] == "EXCESS_ERROR"
+               for r in flagged), flagged
+    # matmul is within tolerance in fp16 at these magnitudes
+    assert not any(r["op"] == "matmul" for r in flagged), flagged
+
+    with open(out_csv) as f:
+        rows = list(csv.DictReader(f))
+    ops = {r["op"] for r in rows}
+    assert {"matmul", "exp", "tanh"} <= ops
+    exp_row = next(r for r in rows if r["op"] == "exp")
+    assert int(exp_row["nan_inf_b"]) > 0  # fp16 overflow recorded
+
+
+def test_compare_accuracy_identical_runs_clean(tmp_path):
+    a = _run(np.float32, tmp_path / "a.jsonl")
+    b = _run(np.float32, tmp_path / "b.jsonl")
+    flagged = compare_accuracy(str(a), str(b), str(tmp_path / "cmp.csv"))
+    assert flagged == []
+
+
+def test_compare_accuracy_loss_scale(tmp_path):
+    """Run B dumped with grads scaled 8x compares clean at loss_scale=8."""
+    x32 = paddle.to_tensor(np.ones((2, 2), np.float32) * 3.0)
+    with dump_tensor_stats(tmp_path / "a.jsonl"):
+        _ = paddle.matmul(x32, x32)
+    with dump_tensor_stats(tmp_path / "b.jsonl"):
+        _ = paddle.matmul(x32, paddle.to_tensor(np.ones((2, 2), np.float32) * 24.0))
+    flagged = compare_accuracy(str(tmp_path / "a.jsonl"),
+                               str(tmp_path / "b.jsonl"),
+                               str(tmp_path / "cmp.csv"), loss_scale=8)
+    assert flagged == []

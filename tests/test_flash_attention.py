@@ -451,3 +451,24 @@ def test_flashmask_noncausal_lts_ute_semantics():
     ref = np.einsum("bhqk,bkhd->bqhd", p, v)
     np.testing.assert_allclose(out.numpy()[:, 8:24], ref[:, 8:24], atol=2e-5,
                                rtol=2e-5)
+
+
+def test_dispatch_default_is_inrepo(monkeypatch):
+    """The production dispatch default is the IN-REPO Pallas kernel: the
+    jaxlib library kernel runs ONLY under explicit PADDLE_TPU_FLASH_IMPL=jaxlib
+    (docs/FLASH_AB.md records the on-chip A/B justifying the default)."""
+    import importlib
+
+    fa_mod = importlib.import_module("paddle_tpu.ops.flash_attention")
+    calls = []
+    monkeypatch.setattr(fa_mod, "_jax_tuned_flash",
+                        lambda *a, **k: calls.append(1))
+    # make every jaxlib-branch precondition true EXCEPT the env opt-in
+    monkeypatch.setattr(fa_mod.jax, "default_backend", lambda: "tpu")
+    monkeypatch.delenv("PADDLE_TPU_FLASH_IMPL", raising=False)
+    q = jnp.zeros((1, 128, 2, 128), jnp.float32)
+    fa_mod.flash_attention(q, q, q, causal=True, interpret=True)
+    assert calls == []          # in-repo kernel, not the library one
+    monkeypatch.setenv("PADDLE_TPU_FLASH_IMPL", "jaxlib")
+    fa_mod.flash_attention(q, q, q, causal=True)
+    assert calls == [1]         # explicit opt-in routes to jaxlib
