@@ -66,15 +66,9 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
     q/k/v layout: [batch, seq, heads, head_dim]."""
 
     def rope_one(t, s, c):
-        if use_neox_rotary_style:
-            d = t.shape[-1]
-            t1, t2 = t[..., : d // 2], t[..., d // 2:]
-            rot = jnp.concatenate([-t2, t1], axis=-1)
-            return t * c + rot * s
-        t1 = t[..., 0::2]
-        t2 = t[..., 1::2]
-        rot = jnp.stack([-t2, t1], axis=-1).reshape(t.shape)
-        return t * c + rot * s
+        # shared rotation primitive — same code path as the serving ops' in-op
+        # rope (_rope_one below), so the two conventions cannot drift
+        return _rope_one(t, c, s, use_neox_rotary_style)
 
     def make_sincos(seq_len, dim, dtype):
         inv = 1.0 / (rotary_emb_base ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
@@ -410,6 +404,46 @@ def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
     return apply_fn("fused_feedforward", fn, *args)
 
 
+def _rope_one(t, cos, sin, neox):
+    """THE rotation primitive (used by fused_rotary_position_embedding AND the
+    serving ops' in-op rope) given FULL-head-dim cos/sin tables.
+
+    neox=True: half-rotation (GPT-NeoX); neox=False: interleaved
+    rotate-every-two (GPT-J) — matching the reference kernel's two styles
+    (masked_multihead_attention_kernel.cu:247 neox branch)."""
+    if neox:
+        h = t.shape[-1] // 2
+        rot = jnp.concatenate([-t[..., h:], t[..., :h]], -1)
+    else:
+        rot = jnp.stack([-t[..., 1::2], t[..., 0::2]], -1).reshape(t.shape)
+    return t * cos + rot * sin
+
+
+def _rope_pair(q, k, cos, sin, neox):
+    return _rope_one(q, cos, sin, neox), _rope_one(k, cos, sin, neox)
+
+
+def _expand_rope_tables(cos_h, sin_h, hd, neox):
+    """Half-size ([..., hd//2]) reference tables -> full head_dim, per style."""
+    if cos_h.shape[-1] == hd:
+        return cos_h, sin_h
+    if neox:
+        return (jnp.concatenate([cos_h, cos_h], -1),
+                jnp.concatenate([sin_h, sin_h], -1))
+    return jnp.repeat(cos_h, 2, -1), jnp.repeat(sin_h, 2, -1)
+
+
+def _quant_cache(x, scales, round_type, qmax, qmin):
+    """Per-kv-head static cache quantization (reference cache_k_quant_scales
+    semantics): int8 = clip(round(x * scale[head]), qmin, qmax)."""
+    s = x.astype(jnp.float32) * scales.reshape(1, -1, 1)
+    if round_type == 0:
+        r = jnp.round(s)                      # round-half-to-even
+    else:
+        r = jnp.sign(s) * jnp.floor(jnp.abs(s) + 0.5)  # half away from zero
+    return jnp.clip(r, qmin, qmax).astype(jnp.int8)
+
+
 def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
                                cum_offsets=None, sequence_lengths=None,
                                rotary_tensor=None, beam_cache_offset=None,
@@ -430,9 +464,12 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
     also updated in place like the reference."""
     if qkv_out_scale is not None or out_scale != -1:
         raise NotImplementedError("masked_multihead_attention quantization")
-    if rotary_emb_dims:
-        raise NotImplementedError("masked_multihead_attention rotary path — "
-                                  "apply fused_rotary_position_embedding before")
+    if rotary_emb_dims not in (0, 1):
+        raise NotImplementedError(
+            "masked_multihead_attention rotary_emb_dims=2 (pos_ids_extra "
+            "2-section rope) is not supported")
+    if rotary_emb_dims and rotary_tensor is None:
+        raise ValueError("rotary_emb_dims=1 requires rotary_tensor")
     if sequence_lengths is None:
         raise ValueError(
             "masked_multihead_attention requires sequence_lengths (each row's "
@@ -443,6 +480,8 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
         opt += [("bias", bias)]
     if src_mask is not None:
         opt += [("mask", src_mask)]
+    if rotary_emb_dims:
+        opt += [("rope", rotary_tensor)]
     opt_names = [n for n, _ in opt]
 
     def fn(xx, cache, lens, *rest):
@@ -453,6 +492,30 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
             qkv = qkv + r["bias"].reshape(1, 3, nh, hd)
         q, k_new, v_new = qkv[:, 0], qkv[:, 1], qkv[:, 2]   # [b, nh, hd]
         pos = lens.reshape(b).astype(jnp.int32)
+        if "rope" in r:
+            # reference layout [2, B, rotary_seq_len, 1, Dh]
+            # (masked_multihead_attention_kernel.cu:46): cos then sin; a
+            # seq axis > 1 is indexed at each row's current position
+            rot = r["rope"]
+            if rot.shape[0] != 2 or rot.shape[1] not in (1, b):
+                raise ValueError(
+                    "rotary_tensor must be [2, batch (or 1), seq, 1, "
+                    f"head_dim] (cos;sin), got shape {rot.shape}")
+            if rot.shape[1] == 1 and b > 1:   # batch-broadcast table
+                rot = jnp.broadcast_to(rot, (2, b) + rot.shape[2:])
+            rot = rot.reshape(2, b, -1, rot.shape[-1]).astype(jnp.float32)
+            if rot.shape[2] > 1:
+                bidx0 = jnp.arange(b)
+                cos_t, sin_t = rot[0][bidx0, pos], rot[1][bidx0, pos]
+            else:
+                cos_t, sin_t = rot[0][:, 0], rot[1][:, 0]
+            cos_t, sin_t = _expand_rope_tables(cos_t, sin_t, hd,
+                                               use_neox_rotary_style)
+            qf, kf = _rope_pair(q.astype(jnp.float32),
+                                k_new.astype(jnp.float32),
+                                cos_t[:, None, :], sin_t[:, None, :],
+                                use_neox_rotary_style)
+            q, k_new = qf.astype(q.dtype), kf.astype(k_new.dtype)
         bidx = jnp.arange(b)
         kc = cache[0].at[bidx, :, pos, :].set(k_new.astype(cache.dtype))
         vc = cache[1].at[bidx, :, pos, :].set(v_new.astype(cache.dtype))
@@ -508,16 +571,33 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
     seq_lens_decoder[i] and run the paged decode kernel
     (ops/paged_attention.py) over the whole cache. Returns
     (out [token_num, nh*hd], qkv, key_cache, value_cache); caches are also
-    updated in place (reference contract). Quantized caches / pre-cache /
-    in-op rope are not supported (apply rope to qkv beforehand)."""
-    if any(t is not None for t in (cache_k_quant_scales, cache_v_quant_scales,
-                                   cache_k_dequant_scales, cache_v_dequant_scales,
-                                   qkv_out_scale, out_shift, out_smooth,
+    updated in place (reference contract).
+
+    In-op rope: ``rope_emb`` [2, batch, max_seq, 1, head_size//2] (cos;sin,
+    reference layout) is applied to q and the new k at each token's absolute
+    position BEFORE the cache append, in ``use_neox_style`` or interleaved
+    form.
+
+    Int8 KV cache: with int8 caches + static per-kv-head
+    ``cache_*_quant_scales``/``cache_*_dequant_scales`` [kv_heads], new K/V
+    quantize on append and the decode path dequantizes EXACTLY (per-head
+    scales commute with online softmax: the K scale folds into q per head
+    before the paged kernel, the V scale folds into its output — the kernel's
+    VMEM loop reads int8 pages directly, halving cache HBM). Dynamic
+    (per-batch) quant scales are not supported."""
+    if any(t is not None for t in (qkv_out_scale, out_shift, out_smooth,
                                    pre_key_cache, pre_value_cache)):
-        raise NotImplementedError("block_multihead_attention: quant/pre-cache")
-    if rope_emb is not None:
-        raise NotImplementedError("block_multihead_attention: in-op rope — "
-                                  "apply fused_rotary_position_embedding to qkv")
+        raise NotImplementedError(
+            "block_multihead_attention: qkv/out smooth-quant and pre-cache")
+    all_scales = (cache_k_quant_scales, cache_v_quant_scales,
+                  cache_k_dequant_scales, cache_v_dequant_scales)
+    quant = any(t is not None for t in all_scales)
+    if use_dynamic_cachekv_quant:
+        raise NotImplementedError(
+            "block_multihead_attention: dynamic (per-batch) cache-kv quant — "
+            "use static per-head scales")
+    if quant and any(t is None for t in all_scales):
+        raise ValueError("cache quant needs all four k/v quant/dequant scales")
     import numpy as np
 
     from ....core.tensor import Tensor, unwrap
@@ -544,13 +624,38 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
     k_tok = qkv3[:, nh:nh + kv_nh]
     v_tok = qkv3[:, nh + kv_nh:]
 
-    # scatter every new token's K/V into its sequence's pages
     seq_ids = np.repeat(np.arange(b), this_time).astype(np.int32)
     pos_in_seq = np.concatenate(
         [np.arange(t) + (dec[i] if dec[i] > 0 else 0)
          for i, t in enumerate(this_time)]).astype(np.int32) if len(seq_ids) else np.zeros(0, np.int32)
-    kc, vc = append_paged_kv(kc, vc, k_tok.astype(kc.dtype),
-                             v_tok.astype(vc.dtype), tables,
+
+    if rope_emb is not None:
+        # [2, b, max_seq, 1, hd//2] -> per-token cos/sin at absolute position
+        rot = unwrap(rope_emb).astype(jnp.float32)
+        rot = rot.reshape(2, rot.shape[1], -1, rot.shape[-1])
+        sid = jnp.asarray(seq_ids)
+        posj = jnp.asarray(pos_in_seq)
+        cos_t, sin_t = rot[0][sid, posj], rot[1][sid, posj]   # [tokens, hd//2]
+        cos_t, sin_t = _expand_rope_tables(cos_t, sin_t, hd, use_neox_style)
+        qf, kf = _rope_pair(q_tok.astype(jnp.float32),
+                            k_tok.astype(jnp.float32),
+                            cos_t[:, None, :], sin_t[:, None, :],
+                            use_neox_style)
+        q_tok, k_tok = qf.astype(q_tok.dtype), kf.astype(k_tok.dtype)
+
+    # scatter every new token's K/V into its sequence's pages
+    if quant:
+        ks_q = unwrap(cache_k_quant_scales).astype(jnp.float32)
+        vs_q = unwrap(cache_v_quant_scales).astype(jnp.float32)
+        k_store = _quant_cache(k_tok, ks_q, quant_round_type,
+                               quant_max_bound, quant_min_bound)
+        v_store = _quant_cache(v_tok, vs_q, quant_round_type,
+                               quant_max_bound, quant_min_bound)
+        ks_d = unwrap(cache_k_dequant_scales).astype(jnp.float32)
+        vs_d = unwrap(cache_v_dequant_scales).astype(jnp.float32)
+    else:
+        k_store, v_store = k_tok.astype(kc.dtype), v_tok.astype(vc.dtype)
+    kc, vc = append_paged_kv(kc, vc, k_store, v_store, tables,
                              jnp.asarray(pos_in_seq), jnp.asarray(seq_ids))
 
     out = jnp.zeros((qkv3.shape[0], nh, hd), qkv_arr.dtype)
@@ -562,7 +667,14 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
         tok_idx = jnp.asarray(starts[dec_rows], jnp.int32)
         qd = q_tok[tok_idx]                             # [n, nh, hd]
         ctx = jnp.asarray(dec[dec_rows] + 1, jnp.int32)
+        if quant:
+            # static per-kv-head scales commute with online softmax: K dequant
+            # folds into q (s = (q*ks)·k_int8), V dequant into the output
+            # (out = (Σp·v_int8/l)·vs) — the kernel streams int8 pages
+            qd = qd * jnp.repeat(ks_d, group)[None, :, None].astype(qd.dtype)
         od = paged_decode_attention(qd, kc, vc, tables[ridx], ctx)
+        if quant:
+            od = od * jnp.repeat(vs_d, group)[None, :, None].astype(od.dtype)
         out = out.at[tok_idx].set(od.astype(out.dtype))
 
     # ---- prefill rows (enc > 0) AND multi-token continuations (dec > 0 with
@@ -583,6 +695,11 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
             kg, vg = gather_paged_kv(kc, vc, tables[i:i + 1],
                                      tables.shape[1] * page)
             kp, vp = kg[:, :ctx], vg[:, :ctx]
+            if quant:
+                kp = (kp.astype(jnp.float32)
+                      * ks_d.reshape(1, 1, -1, 1)).astype(q_tok.dtype)
+                vp = (vp.astype(jnp.float32)
+                      * vs_d.reshape(1, 1, -1, 1)).astype(q_tok.dtype)
         else:
             kp, vp = k_tok[s0:s1][None], v_tok[s0:s1][None]
         if mask is not None:

@@ -176,9 +176,11 @@ def paged_decode_attention(q, k_cache, v_cache, block_tables, context_lens,
     group = hq // hkv
     if scale is None:
         scale = d ** -0.5
-    # Mosaic page-DMA slicing needs a 128-aligned trailing dim and 8-aligned
-    # page dim; other shapes take the dense-gather fallback
-    shapes_ok = d % 128 == 0 and page % 8 == 0
+    # Mosaic page-DMA slicing needs a 128-aligned trailing dim and a
+    # sublane-aligned page dim — 8 sublanes at 4-byte, 16 at 2-byte, 32 at
+    # 1-byte (int8 KV cache); other shapes take the dense-gather fallback
+    sublane = {4: 8, 2: 16, 1: 32}.get(jnp.dtype(k_cache.dtype).itemsize, 8)
+    shapes_ok = d % 128 == 0 and page % sublane == 0
     if not interpret and (jax.default_backend() != "tpu" or not shapes_ok):
         return paged_decode_reference(q, k_cache, v_cache, block_tables,
                                       context_lens, scale)

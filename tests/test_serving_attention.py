@@ -397,3 +397,237 @@ def test_fused_multi_transformer_cache_decode_matches_full():
         **common)
     np.testing.assert_allclose(last.numpy(), full.numpy()[:, s - 1:],
                                atol=2e-4, rtol=2e-4)
+
+
+# ---------------------------------------------------------------------------
+# in-op rope + int8 KV-cache quant (reference block_multihead_attention.py:54,94)
+# ---------------------------------------------------------------------------
+
+def _rope_ref(x, cos_h, sin_h, neox):
+    """Reference rope on [tokens, heads, hd] with half tables [tokens, hd/2]."""
+    x = np.asarray(x, np.float64)
+    hd = x.shape[-1]
+    if neox:
+        cos = np.concatenate([cos_h, cos_h], -1)[:, None, :]
+        sin = np.concatenate([sin_h, sin_h], -1)[:, None, :]
+        rot = np.concatenate([-x[..., hd // 2:], x[..., :hd // 2]], -1)
+    else:
+        cos = np.repeat(cos_h, 2, -1)[:, None, :]
+        sin = np.repeat(sin_h, 2, -1)[:, None, :]
+        rot = np.stack([-x[..., 1::2], x[..., 0::2]], -1).reshape(x.shape)
+    return x * cos + rot * sin
+
+
+@pytest.mark.parametrize("neox", [False, True])
+def test_blha_in_op_rope_matches_pre_applied(neox):
+    """rope_emb inside block_multihead_attention == applying rope to q/k
+    beforehand and calling without rope_emb."""
+    kv_nh, nh, hd, page, maxp = 2, 4, 32, 8, 8
+    lens = np.array([6, 11])
+    max_seq = maxp * page
+    rng = np.random.default_rng(21)
+    inv = 1.0 / (10000.0 ** (np.arange(0, hd, 2) / hd))
+    t = np.arange(max_seq)
+    fr = np.outer(t, inv)
+    rope = np.stack([np.cos(fr), np.sin(fr)])[:, None].repeat(2, 1)
+    rope_emb = Tensor(jnp.asarray(rope[:, :, :, None, :], jnp.float32))
+
+    kw = _make_blha_batch(lens, kv_nh, nh, hd, page, maxp, "prefill", seed=5)
+    out_in, _, kc_in, _ = IF.block_multihead_attention(
+        **kw, rope_emb=rope_emb, use_neox_style=neox)
+
+    # pre-apply to q/k of each token at its absolute position
+    kw2 = _make_blha_batch(lens, kv_nh, nh, hd, page, maxp, "prefill", seed=5)
+    qkv = kw2["qkv"].numpy().reshape(-1, nh + 2 * kv_nh, hd).copy()
+    pos = np.concatenate([np.arange(n) for n in lens])
+    cos_h, sin_h = np.cos(fr)[pos], np.sin(fr)[pos]
+    qkv[:, :nh] = _rope_ref(qkv[:, :nh], cos_h, sin_h, neox)
+    qkv[:, nh:nh + kv_nh] = _rope_ref(qkv[:, nh:nh + kv_nh], cos_h, sin_h, neox)
+    kw2["qkv"] = Tensor(jnp.asarray(qkv.reshape(len(pos), -1), jnp.float32))
+    out_pre, _, kc_pre, _ = IF.block_multihead_attention(**kw2)
+
+    np.testing.assert_allclose(out_in.numpy(), out_pre.numpy(),
+                               atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(kc_in._data),
+                               np.asarray(kc_pre._data), atol=2e-5)
+
+
+def test_blha_in_op_rope_decode_positions():
+    """Decode rows rotate at their own absolute position (dec[i])."""
+    kv_nh, nh, hd, page, maxp = 1, 2, 32, 8, 4
+    lens = np.array([5, 9])
+    max_seq = maxp * page
+    inv = 1.0 / (10000.0 ** (np.arange(0, hd, 2) / hd))
+    fr = np.outer(np.arange(max_seq), inv)
+    rope = np.stack([np.cos(fr), np.sin(fr)])[:, None].repeat(2, 1)
+    rope_emb = Tensor(jnp.asarray(rope[:, :, :, None, :], jnp.float32))
+
+    kw = _make_blha_batch(lens, kv_nh, nh, hd, page, maxp, "prefill", seed=6)
+    IF.block_multihead_attention(**kw, rope_emb=rope_emb)
+    dec_kw = _make_blha_batch(lens, kv_nh, nh, hd, page, maxp, "decode", seed=8)
+    dec_kw["key_cache"] = kw["key_cache"]
+    dec_kw["value_cache"] = kw["value_cache"]
+    dec_kw["block_tables"] = kw["block_tables"]
+    out, _, _, _ = IF.block_multihead_attention(**dec_kw, rope_emb=rope_emb)
+
+    # manual reference: rope everything, dense attention over the history
+    pq = kw["qkv"].numpy().reshape(-1, nh + 2 * kv_nh, hd)
+    dq = dec_kw["qkv"].numpy().reshape(-1, nh + 2 * kv_nh, hd)
+    starts = np.concatenate([[0], np.cumsum(lens)])
+    for i, n in enumerate(lens):
+        s0, s1 = starts[i], starts[i + 1]
+        pos = np.arange(n)
+        kf = _rope_ref(pq[s0:s1, nh:nh + kv_nh], np.cos(fr)[pos], np.sin(fr)[pos], False)
+        kd = _rope_ref(dq[i:i + 1, nh:nh + kv_nh], np.cos(fr)[n:n + 1], np.sin(fr)[n:n + 1], False)
+        qd = _rope_ref(dq[i:i + 1, :nh], np.cos(fr)[n:n + 1], np.sin(fr)[n:n + 1], False)
+        k_full = np.concatenate([kf, kd]).astype(np.float32)
+        v_full = np.concatenate([pq[s0:s1, nh + kv_nh:], dq[i:i + 1, nh + kv_nh:]])
+        ref = _dense_attn(jnp.asarray(qd, jnp.float32)[None],
+                          jnp.asarray(k_full)[None],
+                          jnp.asarray(v_full)[None])[0]
+        np.testing.assert_allclose(out.numpy()[i], np.asarray(ref).reshape(-1),
+                                   atol=2e-5, rtol=2e-5)
+
+
+def test_blha_int8_cache_quant_close_to_fp():
+    """int8 paged cache (static per-head scales): decode matches the fp-cache
+    path within quantization tolerance; cache memory is half."""
+    kv_nh, nh, hd, page, maxp = 2, 4, 32, 8, 8
+    lens = np.array([12, 7])
+    # scales sized to the data range: amax ~3 for standard normal
+    kq = np.full(kv_nh, 127.0 / 4.0, np.float32)
+    scales = dict(
+        cache_k_quant_scales=Tensor(jnp.asarray(kq)),
+        cache_v_quant_scales=Tensor(jnp.asarray(kq)),
+        cache_k_dequant_scales=Tensor(jnp.asarray(1.0 / kq)),
+        cache_v_dequant_scales=Tensor(jnp.asarray(1.0 / kq)))
+
+    kw = _make_blha_batch(lens, kv_nh, nh, hd, page, maxp, "prefill", seed=9)
+    kw["key_cache"] = Tensor(jnp.zeros((len(lens) * maxp, kv_nh, page, hd), jnp.int8))
+    kw["value_cache"] = Tensor(jnp.zeros((len(lens) * maxp, kv_nh, page, hd), jnp.int8))
+    out_q, _, kc_q, vc_q = IF.block_multihead_attention(**kw, **scales)
+    assert kc_q._data.dtype == jnp.int8 and vc_q._data.dtype == jnp.int8
+
+    kw_fp = _make_blha_batch(lens, kv_nh, nh, hd, page, maxp, "prefill", seed=9)
+    out_fp, _, _, _ = IF.block_multihead_attention(**kw_fp)
+    # prefill outputs are computed from the raw (pre-quant) chunk → exact
+    np.testing.assert_allclose(out_q.numpy(), out_fp.numpy(), atol=2e-5)
+
+    # decode step reads the int8 cache — close to fp within int8 tolerance
+    dec_q = _make_blha_batch(lens, kv_nh, nh, hd, page, maxp, "decode", seed=10)
+    dec_q["key_cache"], dec_q["value_cache"] = kw["key_cache"], kw["value_cache"]
+    dec_q["block_tables"] = kw["block_tables"]
+    out_dq, _, _, _ = IF.block_multihead_attention(**dec_q, **scales)
+
+    dec_fp = _make_blha_batch(lens, kv_nh, nh, hd, page, maxp, "decode", seed=10)
+    dec_fp["key_cache"], dec_fp["value_cache"] = kw_fp["key_cache"], kw_fp["value_cache"]
+    dec_fp["block_tables"] = kw_fp["block_tables"]
+    out_dfp, _, _, _ = IF.block_multihead_attention(**dec_fp)
+    err = np.abs(out_dq.numpy() - out_dfp.numpy()).max()
+    assert err < 0.05, err                      # int8 cache tolerance
+    np.testing.assert_allclose(out_dq.numpy(), out_dfp.numpy(), atol=0.05)
+
+
+def test_blha_int8_cache_continuation_and_validation():
+    kv_nh, nh, hd, page, maxp = 1, 2, 32, 8, 8
+    kq = np.full(kv_nh, 127.0 / 4.0, np.float32)
+    scales = dict(
+        cache_k_quant_scales=Tensor(jnp.asarray(kq)),
+        cache_v_quant_scales=Tensor(jnp.asarray(kq)),
+        cache_k_dequant_scales=Tensor(jnp.asarray(1.0 / kq)),
+        cache_v_dequant_scales=Tensor(jnp.asarray(1.0 / kq)))
+    lens = np.array([6])
+    kw = _make_blha_batch(lens, kv_nh, nh, hd, page, maxp, "prefill", seed=12)
+    kw["key_cache"] = Tensor(jnp.zeros((maxp, kv_nh, page, hd), jnp.int8))
+    kw["value_cache"] = Tensor(jnp.zeros((maxp, kv_nh, page, hd), jnp.int8))
+    IF.block_multihead_attention(**kw, **scales)
+
+    # 3-token continuation reads the quantized prefix via gather+dequant
+    cont = _make_blha_batch(np.array([6]), kv_nh, nh, hd, page, maxp,
+                            "decode", seed=13)
+    qkv3 = _rand((3, (nh + 2 * kv_nh) * hd), 14)
+    cont["qkv"] = Tensor(qkv3)
+    cont["seq_lens_this_time"] = Tensor(jnp.asarray([[3]], jnp.int32))
+    cont["cu_seqlens_q"] = Tensor(jnp.asarray([[0], [3]], jnp.int32))
+    cont["cu_seqlens_k"] = Tensor(jnp.asarray([[0], [3]], jnp.int32))
+    cont["key_cache"], cont["value_cache"] = kw["key_cache"], kw["value_cache"]
+    cont["block_tables"] = kw["block_tables"]
+    out, _, _, _ = IF.block_multihead_attention(**cont, **scales)
+    assert np.isfinite(out.numpy()).all()
+
+    # validation: dynamic quant and missing scales raise
+    with pytest.raises(NotImplementedError, match="dynamic"):
+        IF.block_multihead_attention(**_make_blha_batch(
+            lens, kv_nh, nh, hd, page, maxp, "prefill"), **scales,
+            use_dynamic_cachekv_quant=True)
+    with pytest.raises(ValueError, match="scales"):
+        IF.block_multihead_attention(**_make_blha_batch(
+            lens, kv_nh, nh, hd, page, maxp, "prefill"),
+            cache_k_quant_scales=scales["cache_k_quant_scales"])
+
+
+@pytest.mark.parametrize("neox", [False, True])
+def test_mmha_rotary_matches_pre_applied(neox):
+    """rotary_tensor inside masked_multihead_attention == pre-applied rope."""
+    b, nh, hd, max_seq = 2, 2, 32, 16
+    lens = np.array([5, 9])
+    rng = np.random.default_rng(31)
+    x = rng.normal(size=(b, 3 * nh * hd)).astype(np.float32)
+    cache = rng.normal(size=(2, b, nh, max_seq, hd)).astype(np.float32)
+    inv = 1.0 / (10000.0 ** (np.arange(0, hd, 2) / hd))
+    fr = np.outer(lens, inv)               # each row at its own position
+    cos_h, sin_h = np.cos(fr), np.sin(fr)
+    if neox:
+        cos = np.concatenate([cos_h, cos_h], -1)
+        sin = np.concatenate([sin_h, sin_h], -1)
+    else:
+        cos = np.repeat(cos_h, 2, -1)
+        sin = np.repeat(sin_h, 2, -1)
+    rot = np.stack([cos, sin]).reshape(2, b, 1, 1, hd)
+
+    out_in, _ = IF.masked_multihead_attention(
+        Tensor(jnp.asarray(x)), Tensor(jnp.asarray(cache)),
+        sequence_lengths=Tensor(jnp.asarray(lens, jnp.int32)[:, None]),
+        rotary_tensor=Tensor(jnp.asarray(rot, jnp.float32)),
+        rotary_emb_dims=1, use_neox_rotary_style=neox)
+
+    # pre-apply rope to q and k of the incoming token
+    x3 = x.reshape(b, 3, nh, hd).copy()
+    for bi in range(b):
+        x3[bi, 0] = _rope_ref(x3[bi, 0][None].transpose(1, 0, 2),
+                              cos_h[bi:bi + 1], sin_h[bi:bi + 1], neox
+                              ).transpose(1, 0, 2)[0]
+        x3[bi, 1] = _rope_ref(x3[bi, 1][None].transpose(1, 0, 2),
+                              cos_h[bi:bi + 1], sin_h[bi:bi + 1], neox
+                              ).transpose(1, 0, 2)[0]
+    out_pre, _ = IF.masked_multihead_attention(
+        Tensor(jnp.asarray(x3.reshape(b, -1), jnp.float32)),
+        Tensor(jnp.asarray(cache)),
+        sequence_lengths=Tensor(jnp.asarray(lens, jnp.int32)[:, None]))
+    np.testing.assert_allclose(out_in.numpy(), out_pre.numpy(),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_paged_decode_kernel_int8_interpret():
+    """The Pallas decode kernel path (interpret mode) streams int8 pages:
+    per-head dequant scales folded into q/out match the fp reference."""
+    b, hq, hkv, d, page, maxp = 2, 4, 2, 128, 32, 4
+    rng = np.random.default_rng(17)
+    lens = jnp.asarray([37, 90], jnp.int32)
+    tables = jnp.asarray(rng.permutation(b * maxp).reshape(b, maxp), jnp.int32)
+    kf = rng.normal(size=(b * maxp, hkv, page, d)).astype(np.float32)
+    vf = rng.normal(size=(b * maxp, hkv, page, d)).astype(np.float32)
+    q = jnp.asarray(rng.normal(size=(b, hq, d)).astype(np.float32))
+    ks = np.float32(127.0 / 4.0)
+    k8 = jnp.asarray(np.clip(np.round(kf * ks), -127, 127), jnp.int8)
+    v8 = jnp.asarray(np.clip(np.round(vf * ks), -127, 127), jnp.int8)
+
+    from paddle_tpu.ops.paged_attention import (paged_decode_attention,
+                                                paged_decode_reference)
+
+    # scale folding: K dequant into q, V dequant into out
+    out8 = paged_decode_attention(q * (1.0 / ks), k8, v8, tables, lens,
+                                  interpret=True) * (1.0 / ks)
+    ref = paged_decode_reference(q, jnp.asarray(kf), jnp.asarray(vf),
+                                 tables, lens)
+    np.testing.assert_allclose(np.asarray(out8), np.asarray(ref), atol=0.05)
