@@ -61,21 +61,25 @@ def _task():
 _mp_reduce_cache: dict = {}
 
 
-def _mp_all_reduce(x, op):
-    """True cross-process eager all-reduce over the WORLD: one shard per
-    PROCESS on a mesh spanning every controller; the reduce is a jitted psum.
-    Compiled fns are cached per (op, shape, dtype) — re-jitting each call
-    would recompile every time."""
+def _mp_all_reduce(x, op, ranks):
+    """True cross-process eager all-reduce over the processes in ``ranks``
+    (rank == process_index, the init_parallel_env contract): one shard per
+    member process on a mesh of exactly the group's devices; the reduce is a
+    jitted psum. Only member processes execute the computation — jax
+    multi-controller permits submesh computations as long as every process
+    owning a shard calls in (same contract as a NCCL subgroup). Compiled fns
+    are cached per (op, ranks, shape, dtype) — re-jitting each call would
+    recompile every time."""
     import numpy as np
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-    key = (str(op), tuple(x.shape), str(x.dtype))
+    key = (str(op), tuple(ranks), tuple(x.shape), str(x.dtype))
     entry = _mp_reduce_cache.get(key)
     if entry is None:
         by_proc = {}
         for d in jax.devices():
             by_proc.setdefault(d.process_index, d)
-        devs = np.array([by_proc[p] for p in sorted(by_proc)])
+        devs = np.array([by_proc[p] for p in ranks])
         mesh = Mesh(devs, ("r",))
 
         def body(a):
@@ -119,16 +123,27 @@ def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True, use_ca
             out = jnp.exp(lax.psum(jnp.log(x), g.axis_name))
     elif (jax.process_count() > 1
           and not isinstance(x, jax.core.Tracer)):
-        # true cross-process semantics cover the WORLD group only — a proper
-        # subgroup would need a subgroup mesh AND all its members (and only
-        # them) to call in; refuse rather than silently over-reduce
-        if g.nranks not in (jax.process_count(), jax.device_count()):
-            raise NotImplementedError(
-                "eager multi-process all_reduce supports the world group "
-                f"only (group has {g.nranks} ranks, world "
-                f"{jax.process_count()} processes); run subgroup "
-                "collectives inside shard_map over the group's mesh axis")
-        out = _mp_all_reduce(x, op)
+        # true cross-process semantics: the group's rank list (rank ==
+        # process_index) becomes a submesh of one device per member process.
+        # EVERY member (and only members) must call in — the same collective
+        # contract as a NCCL subgroup (reference
+        # test_collective_api_base.py); a non-member calling is a clear
+        # error rather than a silent over-reduce or a hang.
+        ranks = sorted(g.ranks)
+        if jax.process_index() not in ranks:
+            raise RuntimeError(
+                f"process {jax.process_index()} is not a member of {g} — "
+                "only (and all of) the group's member processes may call "
+                "all_reduce(group=g)")
+        if ranks and ranks[-1] >= jax.process_count():
+            if ranks == list(range(jax.device_count())):
+                ranks = sorted(range(jax.process_count()))  # device-world grp
+            else:
+                raise NotImplementedError(
+                    f"eager multi-process all_reduce: group ranks {ranks} "
+                    "exceed the process count — device-granular subgroups "
+                    "run inside shard_map over the group's mesh axis")
+        out = _mp_all_reduce(x, op, ranks)
     else:
         n = g.nranks
         if op == ReduceOp.SUM:

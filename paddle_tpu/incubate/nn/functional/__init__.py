@@ -474,6 +474,20 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
         raise ValueError(
             "masked_multihead_attention requires sequence_lengths (each row's "
             "current cache length / write position)")
+    if rotary_emb_dims and rotary_tensor is not None:
+        import numpy as _np
+
+        from ....core.tensor import unwrap as _unwrap
+
+        rshape = _unwrap(rotary_tensor).shape
+        seq_axis = int(_np.prod(rshape[2:-1]))
+        if seq_axis > 1:
+            lens_np = _np.asarray(_unwrap(sequence_lengths)).reshape(-1)
+            if int(lens_np.max()) >= seq_axis:
+                raise ValueError(
+                    f"rotary_tensor covers {seq_axis} positions but a row "
+                    f"decodes at position {int(lens_np.max())} — indexing "
+                    "would silently clamp to the last row's rotation")
 
     opt = []
     if bias is not None:
@@ -632,7 +646,18 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
     if rope_emb is not None:
         # [2, b, max_seq, 1, hd//2] -> per-token cos/sin at absolute position
         rot = unwrap(rope_emb).astype(jnp.float32)
+        if rot.shape[0] != 2 or rot.shape[1] not in (1, b):
+            raise ValueError(
+                "rope_emb must be [2, batch (or 1), max_seq, 1, head//2] "
+                f"(cos;sin), got shape {rot.shape}")
+        if rot.shape[1] == 1 and b > 1:
+            rot = jnp.broadcast_to(rot, (2, b) + rot.shape[2:])
         rot = rot.reshape(2, rot.shape[1], -1, rot.shape[-1])
+        if len(pos_in_seq) and int(pos_in_seq.max()) >= rot.shape[2]:
+            raise ValueError(
+                f"rope_emb covers {rot.shape[2]} positions but a token sits "
+                f"at position {int(pos_in_seq.max())} — fancy-index clamping "
+                "would silently reuse the last row's rotation")
         sid = jnp.asarray(seq_ids)
         posj = jnp.asarray(pos_in_seq)
         cos_t, sin_t = rot[0][sid, posj], rot[1][sid, posj]   # [tokens, hd//2]

@@ -27,7 +27,7 @@ def _free_port():
     return port
 
 
-@pytest.mark.parametrize("world", [2])
+@pytest.mark.parametrize("world", [2, 3])
 def test_two_process_global_mesh_allreduce(world):
     port = _free_port()
     procs = []
