@@ -311,6 +311,13 @@ def save(layer, path, input_spec=None, **configs):
         )
         with open(path + ".pdmodel", "wb") as f:
             f.write(exp.serialize())
+        # portable StableHLO TEXT module alongside the serialized artifact:
+        # the non-Python consumption surface (native/src/stablehlo_runner.cc
+        # executes it from C++; any PJRT host language can compile it) —
+        # the analogue of the reference's jit::Layer C++ artifact
+        # (/root/reference/paddle/fluid/jit/layer.h:1, r/ and goapi clients)
+        with open(path + ".mlir", "w") as f:
+            f.write(str(exp.mlir_module()))
 
 
 def load(path, **configs):
