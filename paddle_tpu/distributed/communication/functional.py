@@ -130,11 +130,9 @@ def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True, use_ca
         # test_collective_api_base.py); a non-member calling is a clear
         # error rather than a silent over-reduce or a hang.
         ranks = sorted(g.ranks)
-        if jax.process_index() not in ranks:
-            raise RuntimeError(
-                f"process {jax.process_index()} is not a member of {g} — "
-                "only (and all of) the group's member processes may call "
-                "all_reduce(group=g)")
+        # device-granular classification FIRST: a group over device ids (not
+        # process ranks) must get the shard_map guidance, not a misleading
+        # membership error no process could ever satisfy
         if ranks and ranks[-1] >= jax.process_count():
             if ranks == list(range(jax.device_count())):
                 ranks = sorted(range(jax.process_count()))  # device-world grp
@@ -143,6 +141,11 @@ def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True, use_ca
                     f"eager multi-process all_reduce: group ranks {ranks} "
                     "exceed the process count — device-granular subgroups "
                     "run inside shard_map over the group's mesh axis")
+        if jax.process_index() not in ranks:
+            raise RuntimeError(
+                f"process {jax.process_index()} is not a member of {g} — "
+                "only (and all of) the group's member processes may call "
+                "all_reduce(group=g)")
         out = _mp_all_reduce(x, op, ranks)
     else:
         n = g.nranks

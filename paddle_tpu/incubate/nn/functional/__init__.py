@@ -474,20 +474,22 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
         raise ValueError(
             "masked_multihead_attention requires sequence_lengths (each row's "
             "current cache length / write position)")
-    if rotary_emb_dims and rotary_tensor is not None:
+    if rotary_emb_dims and rotary_tensor is not None and cache_kv is not None:
         import numpy as _np
 
         from ....core.tensor import unwrap as _unwrap
 
+        # shape-only coverage check (no host sync, trace-safe): positions are
+        # bounded by the cache's max_seq, so a table with a seq axis must
+        # span it — otherwise indexing would silently clamp to the last row
         rshape = _unwrap(rotary_tensor).shape
         seq_axis = int(_np.prod(rshape[2:-1]))
-        if seq_axis > 1:
-            lens_np = _np.asarray(_unwrap(sequence_lengths)).reshape(-1)
-            if int(lens_np.max()) >= seq_axis:
-                raise ValueError(
-                    f"rotary_tensor covers {seq_axis} positions but a row "
-                    f"decodes at position {int(lens_np.max())} — indexing "
-                    "would silently clamp to the last row's rotation")
+        max_seq_c = _unwrap(cache_kv).shape[3]
+        if seq_axis > 1 and seq_axis < max_seq_c:
+            raise ValueError(
+                f"rotary_tensor covers {seq_axis} positions but the cache "
+                f"holds up to {max_seq_c} — decode positions past the table "
+                "would silently clamp to the last row's rotation")
 
     opt = []
     if bias is not None:

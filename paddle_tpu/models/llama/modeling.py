@@ -46,6 +46,7 @@ class LlamaConfig:
         dtype: str = "float32",
         recompute: bool = False,
         remat_policy: str = "flash",
+        remat_every: int = 1,
         use_flash_attention: bool = True,
         sequence_parallel: bool = False,
         num_experts: int = 1,
@@ -73,6 +74,14 @@ class LlamaConfig:
             raise ValueError(f"remat_policy must be 'flash', 'flash_mlp' or "
                              f"'full', got {remat_policy!r}")
         self.remat_policy = remat_policy
+        # partial remat: layer i is rematerialized iff i % remat_every == 0
+        # (1 = every layer, the reference recompute default; 2 = half the
+        # stack — trades activation memory back for the recompute FLOPs,
+        # the measured ~13% remat tax on the north-star shape)
+        if remat_every < 1:
+            raise ValueError(f"remat_every must be >= 1 (got {remat_every}); "
+                             "use recompute=False to disable remat")
+        self.remat_every = remat_every
         self.use_flash_attention = use_flash_attention
         self.sequence_parallel = sequence_parallel
         self.num_experts = num_experts
@@ -442,8 +451,9 @@ class LlamaModel(Layer):
         remat = cfg.recompute and isinstance(x, jax.core.Tracer)
         moe = cfg.num_experts > 1
         aux_total = jnp.zeros((), jnp.float32) if moe else 0.0
-        for layer in self.layers:
-            if remat:
+        every = max(1, getattr(cfg, "remat_every", 1))
+        for li, layer in enumerate(self.layers):
+            if remat and li % every == 0:
                 # closure holds the params (inputs, not recomputed); activations
                 # inside the layer are rematerialized in backward — the TPU
                 # analogue of fleet/recompute/recompute.py:455. The MoE aux loss
