@@ -18,10 +18,14 @@ Design (one jitted program per phase, static shapes):
     prompt — pad cache entries sit beyond the attended window and are
     overwritten as decode advances.
   - STEP: ONE fused ``lax.scan`` of ``paged_token_step`` advances EVERY
-    active slot up to ``block_size`` tokens per host round-trip — per-row
-    positions flow into the paged decode kernel; the host syncs once per
-    block, not once per token. Inactive slots run on a parked dummy row
-    whose output is ignored.
+    active slot — per-row positions flow into the paged decode kernel;
+    inactive slots run on a parked dummy row whose output is ignored.
+    Without eos the schedule is deterministic, so the engine runs toward the
+    next completion event per program (scan lengths block_size·2^k), chains
+    the last-token carry device-to-device, and materializes token values
+    LAZILY (``_drain_pending``) — zero synchronous host round-trips, like
+    ``generate()``'s async dispatch. eos-carrying batches pace at
+    ``block_size`` tokens per host sync (early exit needs the values).
   - SAMPLE: per-request temperature / top-p / top-k / seed, applied
     row-vectorized inside the fused step. Keys are stateless:
     ``fold_in(key(seed), token_position)`` — reproducible per request and
@@ -83,6 +87,10 @@ class Request:
         self.seed = int(seed if seed is not None else self.rid)
         self.output: List[int] = []
         self.done = False
+        # tokens SCHEDULED so far (device-side results may still be pending
+        # materialization — without eos the schedule is deterministic, so the
+        # engine books progress before reading any token value)
+        self._n_out = 0
 
 
 class ContinuousBatchingEngine:
@@ -103,11 +111,19 @@ class ContinuousBatchingEngine:
         self._slots: List[Optional[Request]] = [None] * max_batch
         # per-slot NEXT write position (== tokens currently in the slot's cache)
         self._pos = np.zeros(max_batch, np.int32)
-        self._last_tok = np.zeros(max_batch, np.int32)
+        # last emitted token per slot, DEVICE-resident: the decode chain never
+        # round-trips token values through the host (they're materialized
+        # lazily from self._pending — see _drain_pending)
+        self._last_tok = jnp.zeros(max_batch, jnp.int32)
+        self._pending: List[tuple] = []
         self._temps = np.zeros(max_batch, np.float32)
         self._tops = np.ones(max_batch, np.float32)
         self._topks = np.zeros(max_batch, np.int32)
         self._seeds = np.zeros(max_batch, np.int32)
+        # device copies of the sampling params, re-uploaded only when an
+        # admission changes them (every host->device put costs a dispatch
+        # through a remote runtime)
+        self._samp_dev = None
         self._queue: collections.deque = collections.deque()
         self._finished: Dict[int, Request] = {}
 
@@ -141,8 +157,18 @@ class ContinuousBatchingEngine:
         return bool(self._queue) or any(s is not None for s in self._slots)
 
     def step(self):
-        """Admit whatever fits, then advance active slots up to block_size
-        tokens in ONE device program (one host sync per block)."""
+        """Admit whatever fits, then advance active slots in ONE device
+        program.
+
+        Without eos the whole schedule is DETERMINISTIC (a slot frees exactly
+        when its request's max_new_tokens are scheduled), so no host decision
+        ever needs a token VALUE: the engine runs to the next completion
+        event per program, chains the last-token carry device-to-device, and
+        defers all token materialization to ``_drain_pending`` — zero
+        synchronous host round-trips in the decode path, exactly like
+        ``generate()``'s async dispatch. eos-carrying batches pace at
+        ``block_size`` and materialize each block (early exit needs the
+        values)."""
         self._admit()
         live = [(i, r) for i, r in enumerate(self._slots) if r is not None]
         if not live:
@@ -150,52 +176,90 @@ class ContinuousBatchingEngine:
         active = np.array([s is not None for s in self._slots])
         # block length: never decode past a request's max_new_tokens or the
         # engine max_len (pages beyond the table would clamp-corrupt)
-        n = self.block_size
-        for i, r in live:
-            n = min(n, r.max_new_tokens - len(r.output),
-                    self.max_len - int(self._pos[i]))
+        cap = min(min(r.max_new_tokens - r._n_out for _, r in live),
+                  min(self.max_len - int(self._pos[i]) for i, _ in live))
+        n = min(self.block_size, cap)
+        async_ok = all(r.eos_token_id is None for _, r in live)
+        if async_ok:
+            # run toward the next completion event; allowed scan lengths are
+            # block_size * 2^k so the compiled-program set stays O(log) in
+            # max_len (each distinct n compiles a full-model scan)
+            stretch = self.block_size
+            while stretch * 2 <= cap:
+                stretch *= 2
+            n = max(n, cap if cap <= self.block_size else stretch)
         n = max(1, n)
         # parked rows decode at position 0 over slot-local pages — harmless
         pos_vec = jnp.asarray(np.where(active, self._pos, 1) - 1)
-        toks = jnp.asarray(self._last_tok)
+        toks = self._last_tok
         if self._jit_step is None:
             from ..core import autograd_engine
             from ..jit.api import _Swap
 
             def run(params, toks, caches, pos_vec, seeds, temps, tops, topks,
-                    n_steps):
+                    n_steps, do_sample):
                 def body(carry, _):
                     tok, cs, pos = carry
                     with autograd_engine.no_grad(), _Swap(self._tensors,
                                                           params):
                         logits, cs = self.model.paged_token_step(tok, cs, pos)
-                    keys = _fold_keys(seeds, pos + 1)
-                    nxt = sample_rows(logits, keys, temps, tops, topks)
+                    if do_sample:
+                        keys = _fold_keys(seeds, pos + 1)
+                        nxt = sample_rows(logits, keys, temps, tops, topks)
+                    else:
+                        # all-greedy batches skip the sampler: its vocab-wide
+                        # argsort costs ~10 ms/token at 32k vocab (measured
+                        # 150x engine slowdown before this gate)
+                        nxt = jnp.argmax(logits, -1).astype(jnp.int32)
                     return (nxt, cs, pos + 1), nxt
 
                 (tok, cs, _), out = jax.lax.scan(
                     body, (toks, caches, pos_vec), None, length=n_steps)
-                return jnp.swapaxes(out, 0, 1), cs
+                return jnp.swapaxes(out, 0, 1), tok, cs
 
-            self._jit_step = jax.jit(run, static_argnames=("n_steps",))
-        out, self.caches = self._jit_step(
+            self._jit_step = jax.jit(run,
+                                     static_argnames=("n_steps", "do_sample"))
+        do_sample = bool(any(self._temps[i] > 0.0 for i, _ in live))
+        if self._samp_dev is None:
+            self._samp_dev = (jnp.asarray(self._seeds),
+                              jnp.asarray(self._temps),
+                              jnp.asarray(self._tops),
+                              jnp.asarray(self._topks))
+        seeds_d, temps_d, tops_d, topks_d = self._samp_dev
+        out, self._last_tok, self.caches = self._jit_step(
             self._params, toks, self.caches, pos_vec,
-            jnp.asarray(self._seeds), jnp.asarray(self._temps),
-            jnp.asarray(self._tops), jnp.asarray(self._topks), n_steps=n)
+            seeds_d, temps_d, tops_d, topks_d, n_steps=n,
+            do_sample=do_sample)
+        if async_ok:
+            entries = []
+            for i, req in live:
+                took = min(n, req.max_new_tokens - req._n_out)
+                entries.append((i, req, took))
+                req._n_out += took
+                self._pos[i] += took
+                if req._n_out >= req.max_new_tokens:
+                    req.done = True
+                    self._finished[req.rid] = req
+                    self._slots[i] = None   # slot + its pages are free again
+                    self._pos[i] = 0
+                    self._temps[i] = 0.0
+            self._pending.append((out, entries))
+            return
+        # eos path: materialize (in generation order — drain older pendings
+        # first so req.output stays ordered across an async->sync transition)
+        self._drain_pending()
         out = np.asarray(out)
-        for i, req in enumerate(self._slots):
-            if req is None:
-                continue
+        for i, req in live:
             took = 0
             for j in range(n):
                 tok = int(out[i, j])
                 req.output.append(tok)
+                req._n_out += 1
                 took = j + 1
                 if ((req.eos_token_id is not None and tok == req.eos_token_id)
-                        or len(req.output) >= req.max_new_tokens):
+                        or req._n_out >= req.max_new_tokens):
                     req.done = True
                     break
-            self._last_tok[i] = req.output[-1]
             self._pos[i] += took
             if req.done:
                 self._finished[req.rid] = req
@@ -211,31 +275,83 @@ class ContinuousBatchingEngine:
         return self.finished()
 
     def finished(self) -> Dict[int, Request]:
+        self._drain_pending()
         out, self._finished = self._finished, {}
         return out
 
+    def _drain_pending(self):
+        """Materialize deferred token blocks into request outputs.
+
+        All host copies are STARTED asynchronously first — a remote runtime
+        charges a full round trip per synchronous readback (measured ~130 ms
+        through the axon tunnel), so serial np.asarray calls would dominate
+        the whole decode wave."""
+        for arr_dev, _ in self._pending:
+            try:
+                arr_dev.copy_to_host_async()
+            except AttributeError:
+                pass
+        for arr_dev, entries in self._pending:
+            arr = np.asarray(arr_dev)
+            for row, req, took in entries:
+                if arr.ndim == 1:           # prefill firsts [g]
+                    req.output.append(int(arr[row]))
+                else:                       # decode block [slots, n]
+                    req.output.extend(int(t) for t in arr[row, :took])
+        self._pending.clear()
+
     # ---- internals ----
     def _admit(self):
-        for i in range(self.max_batch):
-            if self._slots[i] is not None or not self._queue:
-                continue
-            req = self._queue.popleft()
-            self._temps[i] = req.temperature
-            self._tops[i] = req.top_p
-            self._topks[i] = req.top_k
-            self._seeds[i] = req.seed
-            first = self._prefill(i, req)
-            self._slots[i] = req
-            req.output.append(first)
-            self._last_tok[i] = first
-            self._pos[i] = len(req.prompt) + 1
-            if ((req.eos_token_id is not None and first == req.eos_token_id)
-                    or len(req.output) >= req.max_new_tokens):
-                req.done = True
-                self._finished[req.rid] = req
-                self._slots[i] = None
-                self._pos[i] = 0
-                self._temps[i] = 0.0
+        """Admit queued requests into free slots — ONE batched prefill call
+        per prompt bucket (per-request prefills pay a full host round trip
+        each through a remote runtime; batching amortizes it and runs the
+        prompt chunks as one device program)."""
+        free = [i for i in range(self.max_batch) if self._slots[i] is None]
+        take = []
+        while free and self._queue:
+            take.append((free.pop(0), self._queue.popleft()))
+        if not take:
+            return
+        # group by (bucket, padded?): exact-length rows must take the
+        # no-restep program — their first token then comes from the SAME
+        # prefill-chunk logits generate(cache_impl='paged') computes, keeping
+        # the token-exact equality guarantee even at bf16 softmax near-ties
+        groups: Dict[tuple, list] = {}
+        for slot, req in take:
+            b = self._bucket(len(req.prompt))
+            groups.setdefault((b, len(req.prompt) != b), []).append(
+                (slot, req))
+        self._samp_dev = None   # sampling params change -> re-upload lazily
+        for (padded, _), grp in groups.items():
+            # the prefill program also scatters the group's first tokens into
+            # the device-resident last-token carry (no eager device ops here:
+            # each eager dispatch costs ~8 ms python-side through the tunnel)
+            firsts_dev = self._prefill_group(padded, grp)
+            any_eos = any(r.eos_token_id is not None for _, r in grp)
+            firsts = np.asarray(firsts_dev) if any_eos else None
+            entries = []
+            for row, (slot, req) in enumerate(grp):
+                self._temps[slot] = req.temperature
+                self._tops[slot] = req.top_p
+                self._topks[slot] = req.top_k
+                self._seeds[slot] = req.seed
+                self._slots[slot] = req
+                req._n_out += 1
+                self._pos[slot] = len(req.prompt) + 1
+                if firsts is not None:
+                    req.output.append(int(firsts[row]))
+                else:
+                    entries.append((row, req, 1))
+                if ((firsts is not None and req.eos_token_id is not None
+                     and int(firsts[row]) == req.eos_token_id)
+                        or req._n_out >= req.max_new_tokens):
+                    req.done = True
+                    self._finished[req.rid] = req
+                    self._slots[slot] = None
+                    self._pos[slot] = 0
+                    self._temps[slot] = 0.0
+            if entries:
+                self._pending.append((firsts_dev, entries))
 
     def _bucket(self, n: int) -> int:
         if not self.prompt_buckets:
@@ -245,33 +361,43 @@ class ContinuousBatchingEngine:
                 return b
         return n  # unreachable: add_request validates against the last bucket
 
-    def _prefill(self, slot: int, req: Request) -> int:
-        """Prefill ONE slot's pages with the prompt; returns the first token.
+    def _prefill_group(self, padded: int, grp):
+        """Prefill a GROUP of slots sharing one padded prompt length; returns
+        the first sampled token per slot.
 
-        Compiles once per PADDED prompt length — with ``prompt_buckets`` that
-        is once per bucket; the re-step of the last real token keeps bucketed
-        numerics exact (see module docstring)."""
-        n = len(req.prompt)
-        padded = self._bucket(n)
-        bucketed = padded != n
-        ids = req.prompt
-        if bucketed:
-            ids = np.concatenate([ids, np.zeros(padded - n, np.int32)])
-        # the re-step is compiled in only for genuinely padded prompts — an
-        # exact-length prefill (incl. the prompt_buckets=None default) carries
-        # no dead extra token step
-        fn = self._jit_prefill.get((padded, bucketed))
+        Compiles once per (PADDED length, restep, sampling, group size) — with
+        ``prompt_buckets`` that is once per bucket per admission width; the
+        re-step of the last real token keeps bucketed numerics exact (see
+        module docstring). ``_admit`` groups exact-length rows separately so
+        they take the no-restep program (same prefill-chunk logits as
+        ``generate(cache_impl='paged')``, token-exact even at bf16 ties)."""
+        slots = [s for s, _ in grp]
+        reqs = [r for _, r in grp]
+        restep = any(len(r.prompt) != padded for r in reqs)
+        ids = np.stack([
+            np.concatenate([r.prompt,
+                            np.zeros(padded - len(r.prompt), np.int32)])
+            for r in reqs])
+        do_sample = any(r.temperature > 0.0 for r in reqs)
+        fn = self._jit_prefill.get((padded, restep, do_sample))
         if fn is None:
             from ..core import autograd_engine
             from ..jit.api import _Swap
 
-            def run(params, ids, kv, tables, true_len, seed, temp, top_p,
-                    top_k, restep=bucketed):
-                sub = {"kv": kv, "tables": tables}
+            def run(params, ids, kv, all_tables, last_tok, ints, floats,
+                    _restep=restep, _sample=do_sample):
+                # ints [g, 4]: true_len, seed, top_k, slot; floats [g, 2]:
+                # temperature, top_p — packed so an admission moves THREE
+                # host->device buffers total (ids/ints/floats); the table
+                # gather and last-token scatter run inside this program
+                true_len, seed, top_k, slots_ = (ints[:, 0], ints[:, 1],
+                                                 ints[:, 2], ints[:, 3])
+                temp, top_p = floats[:, 0], floats[:, 1]
+                sub = {"kv": kv, "tables": all_tables[slots_]}
                 with autograd_engine.no_grad(), _Swap(self._tensors, params):
                     logits, sub = self.model._decode_chunk(
                         ids, sub, 0, None, None)
-                    if restep:
+                    if _restep:
                         # re-step the last REAL token at its true position:
                         # identical k/v rewrite, logits over the real prompt
                         # only (pad columns beyond true_len not yet attended)
@@ -279,20 +405,23 @@ class ContinuousBatchingEngine:
                             ids, true_len[:, None] - 1, axis=1)[:, 0]
                         logits, sub = self.model.paged_token_step(
                             last, sub, true_len - 1)
-                keys = _fold_keys(seed, true_len)
-                nxt = sample_rows(logits, keys, temp, top_p,
-                                  top_k)
-                return nxt, sub["kv"]
+                if _sample:
+                    # sample_rows takes temp<=0 rows to argmax — mixed
+                    # greedy/sampling groups stay exact for the greedy rows
+                    keys = _fold_keys(seed, true_len)
+                    nxt = sample_rows(logits, keys, temp, top_p, top_k)
+                else:
+                    nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+                return nxt, sub["kv"], last_tok.at[slots_].set(nxt)
 
-            fn = self._jit_prefill[(padded, bucketed)] = jax.jit(
-                run, static_argnames=("restep",))
-        tables = self.caches["tables"][slot:slot + 1]
-        kv = self.caches["kv"]
-        first, new_kv = fn(
-            self._params, jnp.asarray(ids)[None], kv, tables,
-            jnp.asarray([n], jnp.int32), jnp.asarray([req.seed], jnp.int32),
-            jnp.asarray([req.temperature], jnp.float32),
-            jnp.asarray([req.top_p], jnp.float32),
-            jnp.asarray([req.top_k], jnp.int32))
+            fn = self._jit_prefill[(padded, restep, do_sample)] = jax.jit(run)
+        ints = np.asarray([[len(r.prompt), r.seed, r.top_k, s]
+                           for s, r in grp], np.int32)
+        floats = np.asarray([[r.temperature, r.top_p] for _, r in grp],
+                            np.float32)
+        firsts, new_kv, self._last_tok = fn(
+            self._params, jnp.asarray(ids), self.caches["kv"],
+            self.caches["tables"], self._last_tok,
+            jnp.asarray(ints), jnp.asarray(floats))
         self.caches = {"kv": new_kv, "tables": self.caches["tables"]}
-        return int(first[0])
+        return firsts                      # device array — materialized lazily

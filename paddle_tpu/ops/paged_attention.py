@@ -59,6 +59,8 @@ def paged_decode_reference(q, k_cache, v_cache, block_tables, context_lens,
     s = jnp.where(pos < context_lens[:, None, None, None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("bhgl,bhld->bhgd", p, vg.astype(jnp.float32))
+    # zero-length rows (freed/parked slots) return zeros, not garbage
+    out = jnp.where(context_lens[:, None, None, None] > 0, out, 0.0)
     return out.reshape(b, hq, d).astype(q.dtype)
 
 
@@ -76,7 +78,7 @@ def _paged_decode_kernel(lens_ref, tables_ref, buf_idx, init_ref,
     # every (b, h) processes AT LEAST one chunk even at length 0 — otherwise a
     # zero-length row would break the prefetch chain and the next valid row
     # would wait on semaphores armed with the wrong pages (its own output is
-    # documented-undefined; neighbors must stay correct)
+    # forced to zeros at the final-store below; neighbors must stay correct)
     n_chunks_b = jnp.maximum((ctx + chunk_tokens - 1) // chunk_tokens, 1)
 
     def chunk_copies(slot, b2, h2, c2):
@@ -157,7 +159,10 @@ def _paged_decode_kernel(lens_ref, tables_ref, buf_idx, init_ref,
         def _():
             l_fin = l_ref[:, :1]
             l_safe = jnp.where(l_fin > 0, l_fin, 1.0)
-            o_ref[0, 0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+            out = acc_ref[...] / l_safe
+            # zero-length rows (freed/parked slots) emit zeros, not garbage —
+            # callers may rely on inactive rows being inert
+            o_ref[0, 0] = jnp.where(ctx > 0, out, 0.0).astype(o_ref.dtype)
 
 
 def paged_decode_attention(q, k_cache, v_cache, block_tables, context_lens,
@@ -168,8 +173,9 @@ def paged_decode_attention(q, k_cache, v_cache, block_tables, context_lens,
     q: [batch, q_heads, head_dim]; caches [num_pages, kv_heads, page, d];
     block_tables [batch, max_pages_per_seq] int32; context_lens [batch] int32
     (number of valid cache tokens INCLUDING the current position's k/v, which
-    must already be appended via append_paged_kv; rows with length 0 produce
-    undefined output). Returns [batch, hq, d].
+    must already be appended via append_paged_kv; rows with length 0 return
+    ZEROS — freed/parked serving slots are guaranteed inert). Returns
+    [batch, hq, d].
     """
     b, hq, d = q.shape
     n_pages, hkv, page, _ = k_cache.shape
@@ -189,6 +195,13 @@ def paged_decode_attention(q, k_cache, v_cache, block_tables, context_lens,
     while max_pages % G:
         G -= 1
     n_chunks = max_pages // G
+    # single-chunk rows have nothing to stream: the kernel's serial per-(b,h)
+    # DMA chain is pure latency (~measured 3 ms in-situ at b8·h16·2 pages vs
+    # ~µs for the XLA gather+einsum), so short-context serving routes to the
+    # dense-gather path; the kernel wins once chunks per row >= 2
+    if n_chunks < 2 and not interpret:
+        return paged_decode_reference(q, k_cache, v_cache, block_tables,
+                                      context_lens, scale)
     qr = q.reshape(b, hkv, group, d)
 
     kernel = functools.partial(
