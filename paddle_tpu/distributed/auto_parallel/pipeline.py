@@ -194,7 +194,7 @@ def interleaved_schedule(stage_fn: Callable, n_stages: int, interleave: int,
 
 
 def zb_schedule(layer_fn, n_stages: int, interleave: int, lc: int,
-                axis_name: str = "pp"):
+                axis_name: str = "pp", bargs=()):
     """Zero-bubble (ZBH1-class) W/B-split schedule, run INSIDE shard_map.
 
     Parity anchor: the reference's zero-bubble pipeline passes
@@ -233,7 +233,11 @@ def zb_schedule(layer_fn, n_stages: int, interleave: int, lc: int,
 
     ``layer_fn(per_layer_params, h, *bargs)`` runs ONE block; local params
     carry a leading [v*lc] dim, chunk c covers rows [c*lc, (c+1)*lc). MoE aux
-    side-outputs are not supported (use VPP for MoE+pp).
+    side-outputs are not supported (use VPP for MoE+pp). ``bargs`` are CLOSED
+    OVER by the custom_vjp (not passed as differentiable arguments): rope
+    tables etc. work unchanged, while differentiating w.r.t. a broadcast arg
+    raises JAX's closed-over-tracer error at trace time instead of silently
+    producing zero gradients.
     """
     p, v = n_stages, interleave
     vp = v * p
@@ -253,7 +257,7 @@ def zb_schedule(layer_fn, n_stages: int, interleave: int, lc: int,
         is_out = (d == p - 1) & (c == v - 1) & active
         return c, mb, active, inj_here, inj_idx, is_out
 
-    def _run_fwd(params, micro_in, bargs):
+    def _run_fwd(params, micro_in):
         M = micro_in.shape[0]
         d = jax.lax.axis_index(axis_name)
         T = v * M + p - 1
@@ -287,16 +291,16 @@ def zb_schedule(layer_fn, n_stages: int, interleave: int, lc: int,
         return jax.lax.psum(outs, axis_name), pbs
 
     @jax.custom_vjp
-    def pipeline(params, micro_in, bargs):
-        outs, _ = _run_fwd(params, micro_in, bargs)
+    def pipeline(params, micro_in):
+        outs, _ = _run_fwd(params, micro_in)
         return outs
 
-    def pipeline_fwd(params, micro_in, bargs):
-        outs, pbs = _run_fwd(params, micro_in, bargs)
-        return outs, (pbs, params, bargs)
+    def pipeline_fwd(params, micro_in):
+        outs, pbs = _run_fwd(params, micro_in)
+        return outs, (pbs, params)
 
     def pipeline_bwd(res, g):
-        pbs, params, bargs = res
+        pbs, params = res
         # mirror the transpose of the fwd's final psum: shard_map delivers a
         # replicated (P()) output's cotangent split 1/p per device; psumming
         # reconstitutes the full cotangent on every device (exactly what
@@ -374,9 +378,7 @@ def zb_schedule(layer_fn, n_stages: int, interleave: int, lc: int,
 
         dw0 = tuple(jnp.zeros(a.shape, a.dtype) for a in params)
         dw, _ = jax.lax.scan(wtick, dw0, jnp.arange(v * M))
-        dbargs = jax.tree_util.tree_map(
-            lambda a: jnp.zeros(a.shape, a.dtype), bargs)
-        return dw, dmicro, dbargs
+        return dw, dmicro
 
     pipeline.defvjp(pipeline_fwd, pipeline_bwd)
     return pipeline
@@ -423,7 +425,8 @@ def pipeline_call(
       remat: rematerialise each block in backward (fleet/recompute parity).
       schedule: "auto" (GPipe for interleave=1, interleaved VPP otherwise) or
         "zb" — the zero-bubble W/B-split schedule (see :func:`zb_schedule`;
-        ignores ``remat``, treats ``broadcast_args`` as non-differentiable,
+        ignores ``remat``; ``broadcast_args`` are non-differentiable (a grad
+        w.r.t. one raises at trace time),
         no ``with_aux``).
 
     Returns global activations with the same shape as ``x`` (plus the aux sum
@@ -494,10 +497,12 @@ def pipeline_call(
             return _run_layers(wls, h, *bargs)
 
     if schedule == "zb":
-        zb = zb_schedule(blk, n_stages, interleave, lc, axis_name)
-
         def pipeline(params, micro_in, *bargs):
-            return zb(params, micro_in, tuple(bargs))
+            # bargs are closed over by the zb custom_vjp: differentiating
+            # w.r.t. them raises at trace time (vs. silent zero cotangents)
+            zb = zb_schedule(blk, n_stages, interleave, lc, axis_name,
+                             bargs=bargs)
+            return zb(params, micro_in)
     elif interleave > 1:
         pipeline = interleaved_schedule(
             chunk_stage_fn, n_stages, interleave, axis_name, with_aux=with_aux)

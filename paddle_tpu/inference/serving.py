@@ -45,6 +45,7 @@ kernels — the standard cross-kernel serving caveat.
 from __future__ import annotations
 
 import collections
+import weakref
 from typing import Dict, List, Optional, Sequence
 
 import jax
@@ -56,7 +57,8 @@ from ..core.tensor import Tensor
 
 # THE sampler lives in generation_utils so generate() and the engine share one
 # implementation; re-exported here for the serving-facing API surface.
-from ..models.generation_utils import fold_keys as _fold_keys, sample_rows
+from ..models.generation_utils import (fold_keys as _fold_keys,
+                                       sample_rows, validate_sampling)
 
 
 class Request:
@@ -74,6 +76,7 @@ class Request:
                  eos_token_id: Optional[int] = None,
                  temperature: float = 0.0, top_p: float = 1.0,
                  top_k: int = 0, seed: Optional[int] = None):
+        validate_sampling(temperature, top_p, top_k)
         Request._counter[0] += 1
         self.rid = Request._counter[0]
         self.prompt = np.asarray(
@@ -91,6 +94,26 @@ class Request:
         # materialization — without eos the schedule is deterministic, so the
         # engine books progress before reading any token value)
         self._n_out = 0
+        self._engine = None  # weakref, set by add_request
+
+    @property
+    def tokens(self) -> List[int]:
+        """Materialized output tokens. Under async (deterministic-schedule)
+        batching, ``done`` can flip True while token blocks are still
+        device-side; this accessor drains the engine's pending readbacks
+        first, so it is always complete once ``done`` is True. Reading
+        ``.output`` directly is only guaranteed complete after the engine's
+        ``finished()`` has returned the request."""
+        eng = self._engine() if self._engine is not None else None
+        if eng is not None:
+            eng._drain_pending()
+        elif len(self.output) < self._n_out:
+            raise RuntimeError(
+                f"request {self.rid}: {self._n_out - len(self.output)} "
+                "scheduled tokens were never materialized and the engine has "
+                "been garbage-collected — keep the engine alive (or call its "
+                "finished()) before dropping it")
+        return self.output
 
 
 class ContinuousBatchingEngine:
@@ -150,6 +173,7 @@ class ContinuousBatchingEngine:
         validate = getattr(self.model, "_validate_generate", None)
         if validate is not None:
             validate(len(req.prompt), len(req.prompt) + req.max_new_tokens)
+        req._engine = weakref.ref(self)
         self._queue.append(req)
         return req.rid
 

@@ -23,6 +23,22 @@ from ..core.tensor import Tensor
 DECODE_BLOCK = 16
 
 
+def validate_sampling(temperature, top_p, top_k=0):
+    """Shared range checks for sampling params (generate() + serving Request).
+
+    Out-of-range values fail loudly here instead of silently degenerating in
+    ``sample_rows`` (e.g. top_p < 0 masks every candidate, making categorical
+    sample near-uniformly over the whole vocab).
+    """
+    # `not (x >= 0)` (vs `x < 0`) also rejects NaN
+    if temperature is not None and not float(temperature) >= 0.0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if top_p is not None and not 0.0 < float(top_p) <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if top_k is not None and int(top_k) < 0:
+        raise ValueError(f"top_k must be >= 0, got {top_k}")
+
+
 def sample_rows(logits, keys, temps, top_ps, top_ks):
     """Row-vectorized sampling: per-row temperature/top-p/top-k/key.
 
@@ -157,6 +173,7 @@ class GenerationMixin:
         """
         from ..jit.api import _collect_state
 
+        validate_sampling(temperature, top_p)
         ids = (input_ids._data if isinstance(input_ids, Tensor)
                else jnp.asarray(input_ids)).astype(jnp.int32)
         b, prompt_len = ids.shape

@@ -21,7 +21,8 @@ HBM round-trip) and forms
 in fp32, then downcasts to bf16 before the two grad matmuls so they stay on
 the MXU bf16 fast path (an autodiff transpose would run them in fp32 at
 ~1/4 throughput). ``lax.scan`` over chunks keeps one compiled matmul body;
-XLA accumulates dW across chunks in-place.
+dW is accumulated across chunks in an fp32 scan carry (bf16 matmul inputs,
+fp32 MXU accumulation) and downcast to w.dtype once at the end.
 """
 
 from __future__ import annotations
@@ -30,17 +31,6 @@ import functools
 
 import jax
 import jax.numpy as jnp
-
-
-@functools.partial(jax.custom_vjp, nondiff_argnums=())
-def _chunk_nll_sum(hc, w, lc, valid):
-    """Sum of masked token NLLs for one chunk.
-
-    hc: [b, c, h] hidden states; w: [h, V]; lc: [b, c] int labels
-    (already shifted; ignore positions carry valid=0); valid: [b, c] f32.
-    """
-    nll, _ = _chunk_fwd_math(hc, w, lc, valid)
-    return nll
 
 
 def _chunk_fwd_math(hc, w, lc, valid):
@@ -52,27 +42,63 @@ def _chunk_fwd_math(hc, w, lc, valid):
     return nll, logz
 
 
-def _chunk_fwd(hc, w, lc, valid):
-    nll, logz = _chunk_fwd_math(hc, w, lc, valid)
-    # residuals: chunk inputs + the tiny [b, c] logz — logits are recomputed
-    return nll, (hc, w, lc, valid, logz)
+@functools.partial(jax.custom_vjp, nondiff_argnums=())
+def _nll_sum_scan(hcs, w, lcs, vcs):
+    """Masked-NLL total over all chunks (scan over the leading chunk dim).
+
+    hcs: [n, b, c, h]; w: [h, V]; lcs/vcs: [n, b, c]. The custom_vjp spans
+    the WHOLE scan so the backward owns the dW accumulator: per-chunk dW
+    partials are produced by a bf16 MXU matmul with fp32 accumulation
+    (`preferred_element_type`) and summed across chunks in an fp32 carry —
+    downcast to w.dtype exactly once at the end. (A per-chunk custom_vjp
+    would be forced to hand XLA w.dtype cotangents, i.e. bf16 accumulation
+    across chunks in the default bf16 config.)
+    """
+    def body(tot, xs):
+        hc, lc, vc = xs
+        nll, _ = _chunk_fwd_math(hc, w, lc, vc)
+        return tot + nll, None
+
+    tot, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (hcs, lcs, vcs))
+    return tot
 
 
-def _chunk_bwd(res, g):
-    hc, w, lc, valid, logz = res
-    lg = jnp.matmul(hc, w, preferred_element_type=jnp.float32)
-    p = jnp.exp(lg - logz[..., None])                           # softmax, f32
-    safe = jnp.where(valid > 0, lc, 0).astype(jnp.int32)
-    onehot = jax.nn.one_hot(safe, lg.shape[-1], dtype=jnp.float32)
-    dlg = (p - onehot) * (valid * g)[..., None]
-    dlg = dlg.astype(hc.dtype)                  # bf16 grad matmuls (MXU path)
-    b, c, h = hc.shape
-    dhc = jnp.matmul(dlg, w.T).astype(hc.dtype)
-    dw = jnp.matmul(hc.reshape(b * c, h).T, dlg.reshape(b * c, -1))
-    return dhc, dw.astype(w.dtype), None, None
+def _scan_fwd(hcs, w, lcs, vcs):
+    def body(tot, xs):
+        hc, lc, vc = xs
+        nll, logz = _chunk_fwd_math(hc, w, lc, vc)
+        return tot + nll, logz
+
+    tot, logzs = jax.lax.scan(body, jnp.zeros((), jnp.float32),
+                              (hcs, lcs, vcs))
+    # residuals: inputs + the tiny [n, b, c] logz — logits are recomputed
+    return tot, (hcs, w, lcs, vcs, logzs)
 
 
-_chunk_nll_sum.defvjp(_chunk_fwd, _chunk_bwd)
+def _scan_bwd(res, g):
+    hcs, w, lcs, vcs, logzs = res
+    h, V = w.shape
+
+    def body(dw_acc, xs):
+        hc, lc, vc, logz = xs
+        lg = jnp.matmul(hc, w, preferred_element_type=jnp.float32)
+        p = jnp.exp(lg - logz[..., None])                       # softmax, f32
+        safe = jnp.where(vc > 0, lc, 0).astype(jnp.int32)
+        onehot = jax.nn.one_hot(safe, V, dtype=jnp.float32)
+        dlg = (p - onehot) * (vc * g)[..., None]
+        dlg = dlg.astype(hc.dtype)              # bf16 grad matmuls (MXU path)
+        b, c, _ = hc.shape
+        dhc = jnp.matmul(dlg, w.T).astype(hc.dtype)
+        dw = jnp.matmul(hc.reshape(b * c, h).T, dlg.reshape(b * c, V),
+                        preferred_element_type=jnp.float32)
+        return dw_acc + dw, dhc
+
+    dw, dhcs = jax.lax.scan(body, jnp.zeros((h, V), jnp.float32),
+                            (hcs, lcs, vcs, logzs))
+    return dhcs, dw.astype(w.dtype), None, None
+
+
+_nll_sum_scan.defvjp(_scan_fwd, _scan_bwd)
 
 
 def fused_linear_cross_entropy(hidden, w, labels, ignore_index: int = -100,
@@ -101,9 +127,5 @@ def fused_linear_cross_entropy(hidden, w, labels, ignore_index: int = -100,
     lcs = labels.reshape(b, n, chunk).transpose(1, 0, 2)
     vcs = valid.reshape(b, n, chunk).transpose(1, 0, 2)
 
-    def body(tot, xs):
-        hc, lc, vc = xs
-        return tot + _chunk_nll_sum(hc, w, lc, vc), None
-
-    tot, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (hcs, lcs, vcs))
+    tot = _nll_sum_scan(hcs, w, lcs, vcs)
     return tot / jnp.maximum(cnt, 1.0)

@@ -94,3 +94,29 @@ def test_llama_loss_fused_tied_embeddings():
     loss_plain = model.loss_fn(ids, ids)
     np.testing.assert_allclose(np.asarray(loss_fused), np.asarray(loss_plain),
                                rtol=1e-5)
+
+
+def test_fused_ce_bf16_dw_fp32_accumulation():
+    """bf16 params: dW must accumulate across chunks in fp32 (scan carry),
+    so the chunked grad tracks the unfused fp32 reference within bf16
+    resolution even with many chunks."""
+    rng = np.random.default_rng(5)
+    b, s, h, v = 2, 64, 32, 48
+    hidden_f = rng.normal(size=(b, s, h)).astype(np.float32)
+    w_f = (rng.normal(size=(h, v)) * 0.1).astype(np.float32)
+    labels = jnp.asarray(rng.integers(0, v, (b, s)).astype(np.int32))
+
+    # fp32 unfused reference grad
+    _, dw_ref = jax.grad(lambda hh, ww: _plain(hh, ww, labels),
+                         argnums=(0, 1))(jnp.asarray(hidden_f),
+                                         jnp.asarray(w_f))
+    hidden_bf = jnp.asarray(hidden_f).astype(jnp.bfloat16)
+    w_bf = jnp.asarray(w_f).astype(jnp.bfloat16)
+    _, dw_bf = jax.grad(
+        lambda hh, ww: fused_linear_cross_entropy(hh, ww, labels, chunk=8),
+        argnums=(0, 1))(hidden_bf, w_bf)
+    assert dw_bf.dtype == jnp.bfloat16
+    # bf16 has ~3 decimal digits; fp32 accumulation keeps the error at
+    # single-rounding scale instead of sqrt(n_chunks) growth
+    np.testing.assert_allclose(np.asarray(dw_bf, np.float32),
+                               np.asarray(dw_ref), rtol=0.05, atol=3e-3)

@@ -289,6 +289,40 @@ class TestZeroBubble:
         g1[np.asarray(order)] = np.asarray(g1p)
         np.testing.assert_allclose(g1, np.asarray(g2), rtol=1e-4, atol=1e-6)
 
+    def test_zb_broadcast_args_nondiff_ok_diff_raises(self):
+        """bargs are closed over by the zb custom_vjp: a non-differentiated
+        barg (rope tables etc.) works and matches sequential; differentiating
+        w.r.t. one raises loudly instead of returning silent zeros
+        (ADVICE r3, pipeline.py zb bargs)."""
+        mesh = make_mesh({"pp": 4})
+        rng = np.random.default_rng(12)
+        ws = jnp.asarray(rng.standard_normal((8, 16, 16)), jnp.float32) * 0.5
+        x = jnp.asarray(rng.standard_normal((8, 16)), jnp.float32)
+        scale = jnp.float32(1.1)
+
+        def blk(params, h, s):
+            (w,) = params
+            return jnp.tanh(h @ w) * s
+
+        def loss_zb(ws, x, s):
+            y = pipeline_call(blk, [ws], x, s, mesh=mesh, n_micro=4,
+                              schedule="zb")
+            return jnp.mean(y**2)
+
+        def loss_seq(ws, x, s):
+            def body(h, w):
+                return jnp.tanh(h @ w) * s, None
+            y, _ = jax.lax.scan(body, x, ws)
+            return jnp.mean(y**2)
+
+        l1, g1 = jax.jit(jax.value_and_grad(loss_zb))(ws, x, scale)
+        l2, g2 = jax.jit(jax.value_and_grad(loss_seq))(ws, x, scale)
+        np.testing.assert_allclose(l1, l2, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                   rtol=1e-4, atol=1e-6)
+        with pytest.raises(jax.errors.UnexpectedTracerError):
+            jax.jit(jax.grad(loss_zb, argnums=2))(ws, x, scale)
+
     def test_zb_rejects_with_aux(self):
         mesh = make_mesh({"pp": 4})
         ws = jnp.zeros((8, 4, 4), jnp.float32)

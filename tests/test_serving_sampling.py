@@ -189,3 +189,39 @@ def test_engine_eos_mid_block(model):
     eng.run_until_done()
     assert r.output == ref[:3]
     assert not eng.has_work()
+
+
+def test_request_validates_sampling_params():
+    with pytest.raises(ValueError):
+        Request([1, 2], temperature=-0.5)
+    with pytest.raises(ValueError):
+        Request([1, 2], temperature=1.0, top_p=-0.1)
+    with pytest.raises(ValueError):
+        Request([1, 2], temperature=1.0, top_p=0.0)
+    with pytest.raises(ValueError):
+        Request([1, 2], temperature=1.0, top_p=1.5)
+    with pytest.raises(ValueError):
+        Request([1, 2], top_k=-3)
+    Request([1, 2], temperature=0.0, top_p=1.0, top_k=0)  # valid
+
+
+def test_request_tokens_accessor_drains_pending(model):
+    """Async scheduling books req.done before materializing tokens;
+    req.tokens must drain the engine's pending readbacks so it is complete
+    the moment done is True (ADVICE r3: polling done + reading output raw
+    could observe a partial list)."""
+    cfg, m = model
+    rng = np.random.default_rng(7)
+    eng = ContinuousBatchingEngine(m, max_batch=2, max_len=64, page_size=16,
+                                   block_size=8)
+    prompt = rng.integers(0, cfg.vocab_size, (5,)).astype(np.int32)
+    req = Request(prompt, max_new_tokens=12)  # no eos -> async path
+    eng.add_request(req)
+    steps = 0
+    while not req.done and steps < 100:
+        eng.step()
+        steps += 1
+    assert req.done
+    toks = req.tokens
+    assert len(toks) == 12
+    assert toks == _ref_tokens(m, prompt, 12)
