@@ -134,9 +134,10 @@ def interleaved_schedule(stage_fn: Callable, n_stages: int, interleave: int,
 
     Zero-bubble schedules (ZBH1/ZBVPP, pipeline_scheduler_pass/__init__.py:32)
     split weight-grad from activation-grad compute to fill the drain bubble;
-    that decomposition is not expressible through grad-of-scan — XLA's
-    latency-hiding scheduler instead overlaps the collective-permutes with
-    compute. Documented as intentionally out of scope.
+    that decomposition is not expressible through grad-of-scan, so it is
+    implemented as a hand-built reverse schedule in :func:`zb_schedule`
+    below (select with ``schedule='zb'``; composes with ``interleave`` —
+    the ZBVPP shape). This function remains the grad-of-scan path.
 
     ``stage_fn(local_params, chunk_idx, h, *bargs)`` must apply chunk
     ``chunk_idx`` (local params carry a leading [v] chunk dim).
