@@ -5,22 +5,32 @@ Replaces the reference's vendored CUDA flashattn (dynload wrapper
 nn/functional/flash_attention.py:195). TPU design:
 
 Forward:
-  - grid (batch, q_heads, q_blocks); K/V stream through VMEM in BLOCK_K chunks
-  - fp32 running max/sum (online softmax), bf16 MXU matmuls
-  - causal grids skip fully-masked K blocks (dynamic fori_loop trip count)
+  - grid (batch, q_heads, q_blocks, kv_blocks) — kv INNERMOST, so K/V stream
+    through VMEM one [block_k, d] block per grid step and Pallas's grid
+    pipeline double-buffers the next block's DMA behind the current block's
+    compute. Max context is bounded by HBM, not VMEM (seq 32k+ single chip).
+  - online-softmax state (acc, m, l) lives in fp32 VMEM scratch that persists
+    across the kv steps of one q block; (re)initialized at kv step 0,
+    finalized into out/lse at the last kv step.
+  - causal: fully-masked K blocks are skipped via pl.when AND their DMA is
+    elided by clamping the K/V BlockSpec index_map to the last valid block
+    (Pallas skips re-fetch when consecutive steps map to the same block).
   - GQA: q-head → kv-head mapping folded into the BlockSpec index_map, so
     K/V are never materialized per-q-head (the XLA fallback repeats them)
   - train path emits logsumexp [b, h, s_q, LSE_LANES] so backward can
     recompute P row-stably; the primal/inference path skips the write
 
 Backward (FlashAttention-2 style, two kernels sharing the saved lse):
-  - delta = rowsum(dO * O) computed in plain XLA (one fused elementwise pass)
-  - dQ kernel: grid (b, hq, q_blocks), streams K/V blocks with the same
-    causal skip as forward; dS = P*(dP-delta), dQ += dS·K
+  - dQ kernel: grid (b, hq, q_blocks, kv_blocks), same kv streaming/clamping
+    as forward; dS = P*(dP-delta), dQ accumulates in VMEM scratch. delta =
+    rowsum(dO * O) is FUSED into kv step 0 (dO and O are already VMEM-resident
+    there) and emitted as a lane-broadcast side output — no separate XLA pass
+    over dO/O and no extra HBM round-trip for delta.
   - dK/dV kernel: grid (b, kv_heads, k_blocks, q_blocks) — q innermost so the
     fp32 VMEM accumulators persist across q steps; the GQA head group is a
     static python loop (all q-heads of one kv-head arrive in one block via
     a `group`-sized head block in the BlockSpec). Causal skip is a pl.when.
+    Consumes the dQ kernel's delta output.
 
 Layouts: public API is [batch, seq, heads, head_dim] (reference layout);
 kernels run on [batch, heads, seq, head_dim].
@@ -65,16 +75,51 @@ def _xla_reference(q, k, v, causal, scale):
 # forward kernel
 # ---------------------------------------------------------------------------
 
+def _causal_last_block(qi, block_q, offset, block_k, n_kv):
+    """Index of the last kv block a causal q block attends to (clipped into
+    range — BlockSpec index_maps must return valid indices even for q blocks
+    with no valid keys; those programs are compute-gated off by pl.when)."""
+    last_k = qi * block_q + block_q - 1 + offset
+    return jnp.clip(last_k // block_k, 0, n_kv - 1)
+
+
+def _make_kv_idx(causal, block_q, offset, block_k, n_kv):
+    """kv-block index map component for kv-innermost grids: clamp future
+    (fully-masked) blocks onto the last valid one — consecutive grid steps
+    then map to the SAME block and Pallas elides the DMA."""
+    def kv_idx(qi, ki):
+        if not causal:
+            return ki
+        return jnp.minimum(ki, _causal_last_block(qi, block_q, offset,
+                                                  block_k, n_kv))
+    return kv_idx
+
+
+def _make_q_idx(causal, block_q, offset, block_k, n_q):
+    """Mirror of :func:`_make_kv_idx` for the dK/dV kernel's q-innermost
+    grid: q blocks entirely BEFORE a k block (run=False there) are clamped
+    onto the first valid q block, eliding their q/do/lse/delta DMAs."""
+    def q_idx(ki, qi):
+        if not causal:
+            return qi
+        first = (ki * block_k - offset) // block_q
+        return jnp.maximum(qi, jnp.clip(first, 0, n_q - 1))
+    return q_idx
+
+
 def _fa_fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal,
-                   block_q, block_k, kv_len, q_len, with_seg=False,
+                   block_q, block_k, kv_len, q_len, n_kv, with_seg=False,
                    with_rowmask=False):
-    """One (batch, head, q-block) program; streams K/V in block_k chunks.
-    With ``with_seg`` the first two extra refs are per-position segment ids
-    ([b, s, LSE_LANES] int32) and attention is block-diagonal over equal
-    segments (varlen packed batches). With ``with_rowmask`` the next two refs
-    are per-KV-COLUMN row bounds ([b, h, s_kv, LSE_LANES] int32): q rows in
-    [start[col], end[col]) are masked (the reference's flashmask LT masks,
-    nn/functional/flash_attention.py:1098)."""
+    """One (batch, head, q-block, kv-block) program. K/V arrive one
+    [block_k, d] block per grid step (kv innermost — Pallas double-buffers
+    the next block's DMA behind this block's compute); the online-softmax
+    state (acc, m, l) persists in fp32 VMEM scratch across the kv steps of a
+    q block. With ``with_seg`` the first two extra refs are per-position
+    segment ids ([b, s, LSE_LANES] int32) and attention is block-diagonal
+    over equal segments (varlen packed batches). With ``with_rowmask`` the
+    next two refs are per-KV-COLUMN row bounds ([b, h, s_kv, LSE_LANES]
+    int32): q rows in [start[col], end[col]) are masked (the reference's
+    flashmask LT masks, nn/functional/flash_attention.py:1098)."""
     if with_seg:
         qseg_ref, kseg_ref = refs[0], refs[1]
         refs = refs[2:]
@@ -82,65 +127,81 @@ def _fa_fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal,
         start_ref, end_ref = refs[0], refs[1]
         refs = refs[2:]
     o_ref = refs[0]
-    maybe_lse_ref = refs[1:]
+    # refs after o_ref: [lse_ref (train path only)] + [acc_sc, m_sc, l_sc]
+    if len(refs) == 5:
+        lse_ref = refs[1]
+        acc_sc, m_sc, l_sc = refs[2], refs[3], refs[4]
+    else:
+        lse_ref = None
+        acc_sc, m_sc, l_sc = refs[1], refs[2], refs[3]
     qi = pl.program_id(2)
-    q = q_ref[0, 0].astype(jnp.float32) * scale          # [BQ, d]
-    d = q.shape[-1]
-    if with_seg:
-        qs = qseg_ref[0][:, 0]                            # [BQ]
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _():
+        acc_sc[...] = jnp.zeros_like(acc_sc)
+        m_sc[...] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[...] = jnp.zeros_like(l_sc)
 
     # End-aligned causal offset: q row i attends k cols <= i + (kv_len - q_len),
     # matching _xla_reference's tril(k=kl-ql) (kv-cache style when kv > q).
     offset = kv_len - q_len
-    num_kv = kv_len // block_k
+    run = True
     if causal:
-        # blocks entirely in the future are skipped (dynamic trip count)
-        last_k = qi * block_q + block_q - 1 + offset
-        num_kv = jnp.clip((last_k + block_k) // block_k, 0, num_kv)
+        # blocks entirely in the future: no compute (their DMA is already
+        # elided by the clamped index_map)
+        run = qi * block_q + block_q - 1 + offset >= ki * block_k
 
-    def body(j, carry):
-        acc, m, l = carry
-        kb = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        vb = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(run)
+    def _():
+        q = q_ref[0, 0].astype(jnp.float32) * scale       # [BQ, d]
+        kb = k_ref[0, 0].astype(jnp.float32)              # [BK, d]
+        vb = v_ref[0, 0].astype(jnp.float32)
         s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)  # [BQ, BK]
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos + offset >= k_pos, s, NEG_INF)
         if with_seg:
-            ks = kseg_ref[0, pl.ds(j * block_k, block_k), 0]  # [BK]
+            qs = qseg_ref[0][:, 0]                        # [BQ]
+            ks = kseg_ref[0][:, 0]                        # [BK]
             s = jnp.where(qs[:, None] == ks[None, :], s, NEG_INF)
         if with_rowmask:
-            st = start_ref[0, 0, pl.ds(j * block_k, block_k), 0]   # [BK]
-            en = end_ref[0, 0, pl.ds(j * block_k, block_k), 0]
+            st = start_ref[0, 0][:, 0]                    # [BK]
+            en = end_ref[0, 0][:, 0]
             rows = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             masked = (rows >= st[None, :]) & (rows < en[None, :])
             s = jnp.where(masked, NEG_INF, s)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[:, None])
+        m = m_sc[...][:, :1]                              # [BQ, 1]
+        l = l_sc[...][:, :1]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=-1)
-        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_sc[...] = acc_sc[...] * alpha + jax.lax.dot_general(
             p, vb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-        return acc_new, m_new, l_new
+        m_sc[...] = jnp.broadcast_to(m_new, m_sc.shape)
+        l_sc[...] = jnp.broadcast_to(l_new, l_sc.shape)
 
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
-    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
-    acc, m, l = jax.lax.fori_loop(0, num_kv, body, (acc0, m0, l0))
-    l_safe = jnp.where(l > 0, l, 1.0)
-    o_ref[0, 0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    if maybe_lse_ref:
-        # lse (train path only — the primal/inference kernel skips the write)
-        # in units of the SCALED logits; rows with no valid keys get NEG_INF.
-        # Stored with LSE_LANES trailing lanes (TPU block constraint: the last
-        # block dim must be 128-divisible or equal the array dim — 8 lanes
-        # beats the library kernel's 128-lane padding on HBM traffic 16x).
-        lse_ref = maybe_lse_ref[0]
-        lse = jnp.where(l > 0, m + jnp.log(l_safe), NEG_INF)
-        lse_ref[0, 0] = jax.lax.broadcast_in_dim(lse, lse_ref.shape[2:], (0,))
+    @pl.when(ki == n_kv - 1)
+    def _():
+        l = l_sc[...][:, :1]
+        m = m_sc[...][:, :1]
+        l_safe = jnp.where(l > 0, l, 1.0)
+        o_ref[0, 0] = (acc_sc[...] / l_safe).astype(o_ref.dtype)
+        if lse_ref is not None:
+            # lse (train path only — the primal/inference kernel skips the
+            # write) in units of the SCALED logits; rows with no valid keys
+            # get NEG_INF. Stored with LSE_LANES trailing lanes (TPU block
+            # constraint: the last block dim must be 128-divisible or equal
+            # the array dim — 8 lanes beats the library kernel's 128-lane
+            # padding on HBM traffic 16x).
+            lse = jnp.where(l > 0, m + jnp.log(l_safe), NEG_INF)
+            lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:])
 
 
 def _seg_lanes(seg, s):
@@ -155,6 +216,8 @@ def _pallas_forward(q, k, v, causal, scale, block_q, block_k, interpret,
     """q,k,v in [b, s, h, d]. Returns (out [b,s,h,d],
     lse [b, hq, s_q, LSE_LANES] fp32 — or None when with_lse=False, the
     primal/inference path, which skips the lse HBM write entirely)."""
+    from jax.experimental.pallas import tpu as pltpu
+
     b, s_q, hq, d = q.shape
     _, s_kv, hkv, _ = k.shape
     group = hq // hkv
@@ -162,42 +225,56 @@ def _pallas_forward(q, k, v, causal, scale, block_q, block_k, interpret,
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
 
-    grid = (b, hq, s_q // block_q)
+    n_kv = s_kv // block_k
+    grid = (b, hq, s_q // block_q, n_kv)
+    offset = s_kv - s_q
+    _kv_idx = _make_kv_idx(causal, block_q, offset, block_k, n_kv)
+
     with_seg = q_seg is not None
     with_rowmask = row_start is not None
     kernel = functools.partial(
         _fa_fwd_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, kv_len=s_kv, q_len=s_q,
+        block_q=block_q, block_k=block_k, kv_len=s_kv, q_len=s_q, n_kv=n_kv,
         with_seg=with_seg, with_rowmask=with_rowmask)
     out_specs = [
-        pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+        pl.BlockSpec((1, 1, block_q, d),
+                     lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
     ]
     out_shape = [jax.ShapeDtypeStruct(qt.shape, q.dtype)]
     if with_lse:
         out_specs.append(pl.BlockSpec((1, 1, block_q, LSE_LANES),
-                                      lambda bi, hi, qi: (bi, hi, qi, 0)))
+                                      lambda bi, hi, qi, ki: (bi, hi, qi, 0)))
         out_shape.append(
             jax.ShapeDtypeStruct((b, hq, s_q, LSE_LANES), jnp.float32))
     in_specs = [
-        pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-        pl.BlockSpec((1, 1, s_kv, d), lambda bi, hi, qi: (bi, hi // group, 0, 0)),
-        pl.BlockSpec((1, 1, s_kv, d), lambda bi, hi, qi: (bi, hi // group, 0, 0)),
+        pl.BlockSpec((1, 1, block_q, d),
+                     lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        pl.BlockSpec((1, 1, block_k, d),
+                     lambda bi, hi, qi, ki: (bi, hi // group,
+                                             _kv_idx(qi, ki), 0)),
+        pl.BlockSpec((1, 1, block_k, d),
+                     lambda bi, hi, qi, ki: (bi, hi // group,
+                                             _kv_idx(qi, ki), 0)),
     ]
     operands = [qt, kt, vt]
     if with_seg:
         in_specs += [
-            pl.BlockSpec((1, block_q, LSE_LANES), lambda bi, hi, qi: (bi, qi, 0)),
-            pl.BlockSpec((1, s_kv, LSE_LANES), lambda bi, hi, qi: (bi, 0, 0)),
+            pl.BlockSpec((1, block_q, LSE_LANES),
+                         lambda bi, hi, qi, ki: (bi, qi, 0)),
+            pl.BlockSpec((1, block_k, LSE_LANES),
+                         lambda bi, hi, qi, ki: (bi, _kv_idx(qi, ki), 0)),
         ]
         operands += [_seg_lanes(q_seg, s_q), _seg_lanes(kv_seg, s_kv)]
     if with_rowmask:
         # bounds are per kv-HEAD [b, hkv, s_kv]; q-head hi maps via hi//group
         hm = row_start.shape[1]
         in_specs += [
-            pl.BlockSpec((1, 1, s_kv, LSE_LANES),
-                         lambda bi, hi, qi: (bi, (hi // group) % hm, 0, 0)),
-            pl.BlockSpec((1, 1, s_kv, LSE_LANES),
-                         lambda bi, hi, qi: (bi, (hi // group) % hm, 0, 0)),
+            pl.BlockSpec((1, 1, block_k, LSE_LANES),
+                         lambda bi, hi, qi, ki: (bi, (hi // group) % hm,
+                                                 _kv_idx(qi, ki), 0)),
+            pl.BlockSpec((1, 1, block_k, LSE_LANES),
+                         lambda bi, hi, qi, ki: (bi, (hi // group) % hm,
+                                                 _kv_idx(qi, ki), 0)),
         ]
         operands += [_seg_lanes(row_start.astype(jnp.int32), s_kv),
                      _seg_lanes(row_end.astype(jnp.int32), s_kv)]
@@ -207,6 +284,11 @@ def _pallas_forward(q, k, v, causal, scale, block_q, block_k, interpret,
         in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),          # acc
+            pltpu.VMEM((block_q, LSE_LANES), jnp.float32),  # running max
+            pltpu.VMEM((block_q, LSE_LANES), jnp.float32),  # running sum
+        ],
         interpret=interpret,
     )(*operands)
     lse = res[1] if with_lse else None
@@ -217,47 +299,67 @@ def _pallas_forward(q, k, v, causal, scale, block_q, block_k, interpret,
 # backward kernels
 # ---------------------------------------------------------------------------
 
-def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
-                      scale, causal, block_q, block_k, kv_len, q_len,
-                      with_seg=False, with_rowmask=False):
-    """dQ for one (batch, q_head, q_block); streams K/V like forward."""
+def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, *refs,
+                      scale, causal, block_q, block_k, kv_len, q_len, n_kv,
+                      with_glse=False, with_seg=False, with_rowmask=False):
+    """dQ for one (batch, q_head, q_block, kv_block); K/V stream through the
+    innermost grid dim like forward. delta = rowsum(dO*O) [− l̄] is computed
+    at kv step 0 (dO/O are VMEM-resident) into scratch and emitted as a
+    lane-broadcast side output for the dK/dV kernel — the separate XLA
+    delta pass and its HBM round-trip are gone."""
+    if with_glse:
+        glse_ref = refs[0]
+        refs = refs[1:]
     if with_seg:
         qseg_ref, kseg_ref = refs[0], refs[1]
         refs = refs[2:]
     if with_rowmask:
         start_ref, end_ref = refs[0], refs[1]
         refs = refs[2:]
-    dq_ref = refs[0]
+    dq_ref, delta_ref, dq_sc, delta_sc = refs
     qi = pl.program_id(2)
-    q = q_ref[0, 0].astype(jnp.float32)                   # [BQ, d]
-    do = do_ref[0, 0].astype(jnp.float32)                 # [BQ, d]
-    lse = lse_ref[0, 0][:, :1]                            # [BQ, 1]
-    delta = delta_ref[0, 0][:, :1]                        # [BQ, 1]
-    d = q.shape[-1]
-    if with_seg:
-        qs = qseg_ref[0][:, 0]                            # [BQ]
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _():
+        do0 = do_ref[0, 0].astype(jnp.float32)
+        o0 = o_ref[0, 0].astype(jnp.float32)
+        delta = jnp.sum(do0 * o0, axis=-1, keepdims=True)  # [BQ, 1]
+        if with_glse:
+            # ring attention's lse cotangent folds into delta: ds = p·(dp−δ+l̄)
+            delta = delta - glse_ref[0, 0][:, :1]
+        dq_sc[...] = jnp.zeros_like(dq_sc)
+        delta_sc[...] = jnp.broadcast_to(delta, delta_sc.shape)
+        delta_ref[0, 0] = jnp.broadcast_to(delta, delta_ref.shape[2:])
 
     offset = kv_len - q_len
-    num_kv = kv_len // block_k
+    run = True
     if causal:
-        last_k = qi * block_q + block_q - 1 + offset
-        num_kv = jnp.clip((last_k + block_k) // block_k, 0, num_kv)
+        run = qi * block_q + block_q - 1 + offset >= ki * block_k
 
-    def body(j, dq):
-        kb = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        vb = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(run)
+    def _():
+        q = q_ref[0, 0].astype(jnp.float32)                # [BQ, d]
+        do = do_ref[0, 0].astype(jnp.float32)              # [BQ, d]
+        lse = lse_ref[0, 0][:, :1]                         # [BQ, 1]
+        delta = delta_sc[...][:, :1]                       # [BQ, 1]
+        kb = k_ref[0, 0].astype(jnp.float32)               # [BK, d]
+        vb = v_ref[0, 0].astype(jnp.float32)
         s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos + offset >= k_pos, s, NEG_INF)
         if with_seg:
-            ks = kseg_ref[0, pl.ds(j * block_k, block_k), 0]
+            qs = qseg_ref[0][:, 0]                         # [BQ]
+            ks = kseg_ref[0][:, 0]
             s = jnp.where(qs[:, None] == ks[None, :], s, NEG_INF)
         if with_rowmask:
-            st = start_ref[0, 0, pl.ds(j * block_k, block_k), 0]
-            en = end_ref[0, 0, pl.ds(j * block_k, block_k), 0]
+            st = start_ref[0, 0][:, 0]
+            en = end_ref[0, 0][:, 0]
             rows = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             s = jnp.where((rows >= st[None, :]) & (rows < en[None, :]),
@@ -268,11 +370,13 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
         dp = jax.lax.dot_general(do, vb, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale                      # [BQ, BK]
-        return dq + jax.lax.dot_general(ds, kb, (((1,), (0,)), ((), ())),
-                                        preferred_element_type=jnp.float32)
+        dq_sc[...] = dq_sc[...] + jax.lax.dot_general(
+            ds, kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
-    dq = jax.lax.fori_loop(0, num_kv, body, jnp.zeros((block_q, d), jnp.float32))
-    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+    @pl.when(ki == n_kv - 1)
+    def _():
+        dq_ref[0, 0] = dq_sc[...].astype(dq_ref.dtype)
 
 
 def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -373,14 +477,14 @@ def _pallas_backward(q, k, v, o, lse, do, causal, scale, block_q, block_k,
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
     dot = jnp.swapaxes(do, 1, 2)
-    # delta_i = rowsum(dO_i * O_i) — one fused XLA elementwise+reduce pass,
-    # broadcast to LSE_LANES trailing lanes to satisfy TPU block tiling
-    delta = jnp.einsum("bshd,bshd->bhs", do.astype(jnp.float32),
-                       o.astype(jnp.float32))
-    if g_lse is not None:
-        delta = delta - g_lse.astype(jnp.float32)
-    delta = jnp.broadcast_to(delta[..., None], delta.shape + (LSE_LANES,))
+    ot = jnp.swapaxes(o, 1, 2)
 
+    n_kv = s_kv // block_k
+    offset = s_kv - s_q
+    _kv_idx = _make_kv_idx(causal, block_q, offset, block_k, n_kv)
+    _q_idx = _make_q_idx(causal, block_q, offset, block_k, s_q // block_q)
+
+    with_glse = g_lse is not None
     with_seg = q_seg is not None
     with_rowmask = row_start is not None
     seg_ops = ([_seg_lanes(q_seg, s_q), _seg_lanes(kv_seg, s_kv)]
@@ -390,42 +494,58 @@ def _pallas_backward(q, k, v, o, lse, do, causal, scale, block_q, block_k,
                     _seg_lanes(row_end.astype(jnp.int32), s_kv)]
         hm = row_start.shape[1]
 
-    # ---- dQ ----
-    grid_dq = (b, hq, s_q // block_q)
+    # ---- dQ (+ fused delta side output) ----
+    grid_dq = (b, hq, s_q // block_q, n_kv)
     dq_kernel = functools.partial(
         _fa_bwd_dq_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, kv_len=s_kv, q_len=s_q,
-        with_seg=with_seg, with_rowmask=with_rowmask)
-    dq_in_specs = [
-        pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-        pl.BlockSpec((1, 1, s_kv, d), lambda bi, hi, qi: (bi, hi // group, 0, 0)),
-        pl.BlockSpec((1, 1, s_kv, d), lambda bi, hi, qi: (bi, hi // group, 0, 0)),
-        pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-        pl.BlockSpec((1, 1, block_q, LSE_LANES),
-                     lambda bi, hi, qi: (bi, hi, qi, 0)),
-        pl.BlockSpec((1, 1, block_q, LSE_LANES),
-                     lambda bi, hi, qi: (bi, hi, qi, 0)),
-    ]
+        block_q=block_q, block_k=block_k, kv_len=s_kv, q_len=s_q, n_kv=n_kv,
+        with_glse=with_glse, with_seg=with_seg, with_rowmask=with_rowmask)
+    _qb = pl.BlockSpec((1, 1, block_q, d),
+                       lambda bi, hi, qi, ki: (bi, hi, qi, 0))
+    _qlanes = pl.BlockSpec((1, 1, block_q, LSE_LANES),
+                           lambda bi, hi, qi, ki: (bi, hi, qi, 0))
+    _kvb = pl.BlockSpec((1, 1, block_k, d),
+                        lambda bi, hi, qi, ki: (bi, hi // group,
+                                                _kv_idx(qi, ki), 0))
+    dq_in_specs = [_qb, _kvb, _kvb, _qb, _qb, _qlanes]
+    dq_ops = [qt, kt, vt, dot, ot, lse]
+    if with_glse:
+        dq_in_specs.append(_qlanes)
+        glse_lanes = jnp.broadcast_to(
+            g_lse.astype(jnp.float32)[..., None],
+            g_lse.shape + (LSE_LANES,))
+        dq_ops.append(glse_lanes)
     if with_seg:
         dq_in_specs += [
-            pl.BlockSpec((1, block_q, LSE_LANES), lambda bi, hi, qi: (bi, qi, 0)),
-            pl.BlockSpec((1, s_kv, LSE_LANES), lambda bi, hi, qi: (bi, 0, 0)),
+            pl.BlockSpec((1, block_q, LSE_LANES),
+                         lambda bi, hi, qi, ki: (bi, qi, 0)),
+            pl.BlockSpec((1, block_k, LSE_LANES),
+                         lambda bi, hi, qi, ki: (bi, _kv_idx(qi, ki), 0)),
         ]
     if with_rowmask:
         dq_in_specs += [
-            pl.BlockSpec((1, 1, s_kv, LSE_LANES),
-                         lambda bi, hi, qi: (bi, (hi // group) % hm, 0, 0)),
-            pl.BlockSpec((1, 1, s_kv, LSE_LANES),
-                         lambda bi, hi, qi: (bi, (hi // group) % hm, 0, 0)),
+            pl.BlockSpec((1, 1, block_k, LSE_LANES),
+                         lambda bi, hi, qi, ki: (bi, (hi // group) % hm,
+                                                 _kv_idx(qi, ki), 0)),
+            pl.BlockSpec((1, 1, block_k, LSE_LANES),
+                         lambda bi, hi, qi, ki: (bi, (hi // group) % hm,
+                                                 _kv_idx(qi, ki), 0)),
         ]
-    dq = pl.pallas_call(
+    dq, delta = pl.pallas_call(
         dq_kernel,
         grid=grid_dq,
         in_specs=dq_in_specs,
-        out_specs=pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        out_specs=[_qb, _qlanes],
+        out_shape=[
+            jax.ShapeDtypeStruct(qt.shape, q.dtype),
+            jax.ShapeDtypeStruct((b, hq, s_q, LSE_LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),          # dq accumulator
+            pltpu.VMEM((block_q, LSE_LANES), jnp.float32),  # delta
+        ],
         interpret=interpret,
-    )(qt, kt, vt, dot, lse, delta, *seg_ops)
+    )(*dq_ops, *seg_ops)
 
     # ---- dK / dV ----
     # q-heads blocked by `group` so one program sees every q-head of its
@@ -437,22 +557,22 @@ def _pallas_backward(q, k, v, o, lse, do, causal, scale, block_q, block_k,
         with_seg=with_seg, with_rowmask=with_rowmask)
     dkv_in_specs = [
         pl.BlockSpec((1, group, block_q, d),
-                     lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+                     lambda bi, hi, ki, qi: (bi, hi, _q_idx(ki, qi), 0)),
         pl.BlockSpec((1, 1, block_k, d),
                      lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
         pl.BlockSpec((1, 1, block_k, d),
                      lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
         pl.BlockSpec((1, group, block_q, d),
-                     lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+                     lambda bi, hi, ki, qi: (bi, hi, _q_idx(ki, qi), 0)),
         pl.BlockSpec((1, group, block_q, LSE_LANES),
-                     lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+                     lambda bi, hi, ki, qi: (bi, hi, _q_idx(ki, qi), 0)),
         pl.BlockSpec((1, group, block_q, LSE_LANES),
-                     lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+                     lambda bi, hi, ki, qi: (bi, hi, _q_idx(ki, qi), 0)),
     ]
     if with_seg:
         dkv_in_specs += [
             pl.BlockSpec((1, block_q, LSE_LANES),
-                         lambda bi, hi, ki, qi: (bi, qi, 0)),
+                         lambda bi, hi, ki, qi: (bi, _q_idx(ki, qi), 0)),
             pl.BlockSpec((1, block_k, LSE_LANES),
                          lambda bi, hi, ki, qi: (bi, ki, 0)),
         ]
