@@ -301,6 +301,22 @@ def main():
             print(f"# remat llama bench failed: {e!r}", flush=True)
         gc.collect()
 
+        # long-context line: seq 16k single chip — possible since the flash
+        # fwd/dq kernels stream K/V through the grid (HBM-bound, not
+        # VMEM-bound). b1 no-remat fits (fused CE; measured faster than
+        # remat: 0.51 vs 0.49 MFU)
+        lc = LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            num_hidden_layers=12, num_attention_heads=16,
+            num_key_value_heads=4, max_position_embeddings=16384,
+            dtype="bfloat16", recompute=False)
+        try:
+            bench_llama("llama_672M_seq16k_tokens_per_sec", lc,
+                        batch=1, seq=16384, iters=6, dev=dev)
+        except Exception as e:
+            print(f"# long-context llama bench failed: {e!r}", flush=True)
+        gc.collect()
+
         # NORTH STAR (printed last — primary line): seq 4096, GQA 4:1,
         # ~850M params — the BASELINE.json 7B-class training shape, honestly
         # measured. Round-3 operating point: batch 2 WITHOUT remat — the

@@ -500,3 +500,38 @@ def test_flash_long_context_16k_interpret():
     ref = jnp.einsum("bhqk,bkhd->bqhd", p, v)
     np.testing.assert_allclose(np.asarray(out[:, sl]), np.asarray(ref),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_ring_attention_32k_sep2(mesh8):
+    """Long-context SP: 32k tokens ring-sharded over sep=2 (16k local shards
+    — each runs the grid-streamed flash path; VERDICT r3 next #3). CPU mesh:
+    correctness vs a q-chunked dense reference that never materializes the
+    32k x 32k score matrix."""
+    import math
+
+    from jax.sharding import Mesh
+
+    from paddle_tpu.distributed.auto_parallel.logical_sharding import axis_rules
+    from paddle_tpu.ops.ring_attention import ring_attention
+
+    mesh = Mesh(np.asarray(mesh8)[:2].reshape(2), ("sep",))
+    b, s, h, d = 1, 32768, 1, 8
+    q, k, v = _rand((b, s, h, d), 0), _rand((b, s, h, d), 1), _rand((b, s, h, d), 2)
+    with axis_rules(mesh):
+        out = ring_attention(q, k, v, mesh, axis_name="sep", causal=True)
+
+    def chunk_ref(ci, cq=2048):
+        qs = q[:, ci * cq:(ci + 1) * cq]
+        lg = jnp.einsum("bqhd,bkhd->bhqk", qs.astype(jnp.float32),
+                        k.astype(jnp.float32)) / math.sqrt(d)
+        rows = ci * cq + jnp.arange(cq)[:, None]
+        lg = jnp.where(rows >= jnp.arange(s)[None, :], lg, -1e30)
+        p = jax.nn.softmax(lg, -1)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+
+    # spot-check chunks at the shard boundary and both ends
+    for ci in [0, 7, 8, 15]:
+        ref = chunk_ref(ci)
+        got = out[:, ci * 2048:(ci + 1) * 2048].astype(jnp.float32)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=3e-5, rtol=3e-3)
