@@ -31,7 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
 def run(p=4, v=1, hidden=1024, layers=8, mb_size=16, Ms=(4, 8, 16, 32),
-        iters=10, schedule="auto"):
+        iters=10, schedule="auto", remat=False):
     from paddle_tpu.distributed.auto_parallel.pipeline import pipeline_call
 
     mesh = Mesh(np.array(jax.devices()[:p]), ("pp",))
@@ -49,7 +49,7 @@ def run(p=4, v=1, hidden=1024, layers=8, mb_size=16, Ms=(4, 8, 16, 32),
 
         def loss(w, x):
             out = pipeline_call(block_fn, [w], x, mesh=mesh, n_micro=M,
-                                interleave=v, schedule=schedule)
+                                interleave=v, schedule=schedule, remat=remat)
             return (out.astype(jnp.float32) ** 2).mean()
 
         g = jax.jit(jax.grad(loss))
@@ -61,7 +61,8 @@ def run(p=4, v=1, hidden=1024, layers=8, mb_size=16, Ms=(4, 8, 16, 32),
         dt = (time.perf_counter() - t0) / iters
         # per-microbatch time normalizes away the growing batch
         results[M] = dt / M
-        print(f"p={p} v={v} {schedule:>4} M={M:3d}: {dt*1e3:8.2f} ms/step  "
+        tag = schedule + ("+rm" if remat else "")
+        print(f"p={p} v={v} {tag:>7} M={M:3d}: {dt*1e3:8.2f} ms/step  "
               f"{dt/M*1e3:6.2f} ms/microbatch", flush=True)
 
     # model check: time/M proportional to (vM + p - 1) / (vM)
@@ -79,6 +80,11 @@ if __name__ == "__main__":
         for v in (1, 2):
             run(p=4, v=v, Ms=(4, 8), schedule="auto")
             run(p=4, v=v, Ms=(4, 8), schedule="zb")
+    elif "--zb-remat" in sys.argv:
+        # memory-constrained regime: both schedules under remat semantics
+        for v in (1, 2):
+            run(p=4, v=v, Ms=(4, 8), schedule="auto", remat=True)
+            run(p=4, v=v, Ms=(4, 8), schedule="zb", remat=True)
     else:
         run(p=4, v=1)
         run(p=4, v=2)

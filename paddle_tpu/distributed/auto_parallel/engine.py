@@ -94,6 +94,7 @@ class Engine:
         pp_interleave: int = 1,
         pp_schedule: str = "auto",
         optimizer=None,
+        abstract_state: bool = False,
     ):
         self.model = model
         self.mesh = mesh if mesh is not None else current_mesh()
@@ -185,12 +186,28 @@ class Engine:
                 self._shardings = [param_sharding(p, self.mesh) for p in self._param_tensors]
             self._shardings = self._shardings + self._block_shardings
 
+        self._abstract_state = abstract_state
+        if abstract_state and (optimizer is not None or self.mesh is None):
+            raise ValueError(
+                "abstract_state=True requires the built-in AdamW path and a "
+                "mesh (it exists to AOT-lower the hybrid step without "
+                "materializing fp32 m/v)")
         self._optimizer = optimizer
         self.m = self.v = None
         self.opt_state = None
         if optimizer is None:
             # built-in fused AdamW fast path
-            if self.mesh is not None:
+            if abstract_state and self.mesh is not None:
+                # AOT-lowering mode: optimizer state as sharded
+                # ShapeDtypeStructs — ``lower()`` needs shapes + shardings
+                # only, so configs whose fp32 m/v exceed host RAM (7B+ on a
+                # virtual mesh) can still trace/lower the full hybrid step.
+                # step() is NOT runnable in this mode.
+                zeros = lambda a, s: jax.ShapeDtypeStruct(
+                    a.shape, jnp.float32, sharding=s)
+                self.m = [zeros(a, s) for a, s in zip(self.params, self._shardings)]
+                self.v = [zeros(a, s) for a, s in zip(self.params, self._shardings)]
+            elif self.mesh is not None:
                 zeros = lambda a, s: jax.device_put(jnp.zeros(a.shape, jnp.float32), s)
                 self.m = [zeros(a, s) for a, s in zip(self.params, self._shardings)]
                 self.v = [zeros(a, s) for a, s in zip(self.params, self._shardings)]
@@ -423,6 +440,11 @@ class Engine:
 
     def step(self, input_ids, labels):
         """Run one fused train step; returns the (device) scalar loss."""
+        if self._abstract_state:
+            raise RuntimeError(
+                "Engine was built with abstract_state=True (AOT-lowering "
+                "mode): optimizer state is ShapeDtypeStructs, step() cannot "
+                "execute — use _build_step().lower(...) instead")
         ids = input_ids._data if isinstance(input_ids, Tensor) else jnp.asarray(input_ids)
         lbl = labels._data if isinstance(labels, Tensor) else jnp.asarray(labels)
         if self._optimizer is not None:

@@ -195,7 +195,7 @@ def interleaved_schedule(stage_fn: Callable, n_stages: int, interleave: int,
 
 
 def zb_schedule(layer_fn, n_stages: int, interleave: int, lc: int,
-                axis_name: str = "pp", bargs=()):
+                axis_name: str = "pp", bargs=(), remat: bool = False):
     """Zero-bubble (ZBH1-class) W/B-split schedule, run INSIDE shard_map.
 
     Parity anchor: the reference's zero-bubble pipeline passes
@@ -226,10 +226,19 @@ def zb_schedule(layer_fn, n_stages: int, interleave: int, lc: int,
 
     Total critical path ≈ (vM+p-1)(F + B)/v + M·W  vs  the interleaved
     schedule's (vM+p-1)(F + B + W)/v — a saving of W·(p-1)/v wall-clock, the
-    exact W-bubble ZBH1 targets. Cost: linearization residuals (incl. the
-    tick's param slice) are saved for every tick — the no-remat memory regime,
-    ZB-paper "ZB-∞" end of the memory/bubble tradeoff — so ``remat`` is
-    ignored on this schedule. Gradient equality vs sequential is exact
+    exact W-bubble ZBH1 targets.
+
+    Memory regimes (the ZB paper's memory/bubble tradeoff axis):
+      - ``remat=False`` (ZB-∞): step 1 saves full linearization residuals
+        (incl. the tick's param slice) for every tick — fastest, most memory.
+      - ``remat=True`` (memory-bounded, ZBH1's regime): step 1 saves ONLY
+        each layer's boundary input activation; step 2 recomputes the layer
+        under ``jax.vjp`` w.r.t. activations only (the weight half is never
+        traced); step 3 recomputes once more w.r.t. weights only. Memory
+        drops to the boundary-activations class (same as GPipe+remat); the
+        extra cost is one more in-layer forward in the W drain — which runs
+        OFF the permute critical path, exactly where ZBH1 hides work.
+    Gradient equality vs sequential is exact in both regimes
     (tests/test_pipeline.py).
 
     ``layer_fn(per_layer_params, h, *bargs)`` runs ONE block; local params
@@ -244,6 +253,11 @@ def zb_schedule(layer_fn, n_stages: int, interleave: int, lc: int,
     vp = v * p
     perm_f = [(i, (i + 1) % p) for i in range(p)]
     perm_b = [(i, (i - 1) % p) for i in range(p)]
+
+    def _chunk(params, c):
+        # chunk c's [lc, ...] slice of each local [v*lc, ...] param stack
+        return [jax.lax.dynamic_slice_in_dim(w, c * lc, lc, 0)
+                for w in params]
 
     def _meta(t, d, M):
         cyc = jnp.mod(t - d, vp)
@@ -269,13 +283,19 @@ def zb_schedule(layer_fn, n_stages: int, interleave: int, lc: int,
             inj = jax.lax.dynamic_index_in_dim(micro_in, inj_idx, 0,
                                                keepdims=False)
             h = jnp.where(inj_here, inj, buf)
-            wls = [jax.lax.dynamic_slice_in_dim(w, c * lc, lc, 0)
-                   for w in params]
+            wls = _chunk(params, c)
 
-            def layer_step(hh, wl):
-                yl, pb = jax.vjp(
-                    lambda w_, h_: layer_fn(w_, h_, *bargs), wl, hh)
-                return yl, pb
+            if remat:
+                # memory-bounded: stack each layer's INPUT activation only
+                def layer_step(hh, wl):
+                    return layer_fn(wl, hh, *bargs), hh
+            else:
+                # ZB-∞: stack the full per-layer pullback (vjp closures are
+                # pytrees, so lax.scan stacks their residuals)
+                def layer_step(hh, wl):
+                    yl, pb = jax.vjp(
+                        lambda w_, h_: layer_fn(w_, h_, *bargs), wl, hh)
+                    return yl, pb
 
             with _ManualCtx():
                 y, pbs_t = jax.lax.scan(layer_step, h, wls)
@@ -298,10 +318,13 @@ def zb_schedule(layer_fn, n_stages: int, interleave: int, lc: int,
 
     def pipeline_fwd(params, micro_in):
         outs, pbs = _run_fwd(params, micro_in)
-        return outs, (pbs, params)
+        # bargs ride the RESIDUALS: the bwd runs under a different trace than
+        # the fwd whose closure captured them (shard_map transpose), so the
+        # remat recomputes must read residual-plumbed values, not the closure
+        return outs, (pbs, params, bargs)
 
     def pipeline_bwd(res, g):
-        pbs, params = res
+        pbs, params, bargs_r = res
         # mirror the transpose of the fwd's final psum: shard_map delivers a
         # replicated (P()) output's cotangent split 1/p per device; psumming
         # reconstitutes the full cotangent on every device (exactly what
@@ -321,13 +344,29 @@ def zb_schedule(layer_fn, n_stages: int, interleave: int, lc: int,
             dy = jnp.where(is_out, g_m.astype(gbuf.dtype), gbuf)
             dy = jnp.where(active, dy, jnp.zeros_like(dy))
 
-            def layer_bwd(dh, pb):
-                # weight half of pb unused here -> DCE'd from the scan; the
+            if remat:
+                # recompute the layer fwd from its saved INPUT, differentiate
+                # w.r.t. activations only (weight half never traced); the
                 # INCOMING dh is this layer's output cotangent — saved for W
-                _dw_dead, dh2 = pb(dh)
-                return dh2, dh
+                wls = _chunk(params, c)
 
-            dh, dys_t = jax.lax.scan(layer_bwd, dy, pbs_t, reverse=True)
+                def layer_bwd(dh, xs_l):
+                    hl, wl = xs_l
+                    _, pb = jax.vjp(
+                        lambda h_: layer_fn(wl, h_, *bargs_r), hl)
+                    (dh2,) = pb(dh)
+                    return dh2, dh
+
+                bxs = (pbs_t, tuple(wls))
+            else:
+                def layer_bwd(dh, pb):
+                    # weight half of pb unused here -> DCE'd from the scan
+                    _dw_dead, dh2 = pb(dh)
+                    return dh2, dh
+
+                bxs = pbs_t
+
+            dh, dys_t = jax.lax.scan(layer_bwd, dy, bxs, reverse=True)
             take = inj_here & active
             prev = jax.lax.dynamic_index_in_dim(dmicro, mb, 0, keepdims=False)
             dmicro = jax.lax.dynamic_update_index_in_dim(
@@ -363,12 +402,29 @@ def zb_schedule(layer_fn, n_stages: int, interleave: int, lc: int,
                                                        keepdims=False), pbs)
             dys_t = jax.lax.dynamic_index_in_dim(dys, t, 0, keepdims=False)
 
-            def layer_w(_, xs_l):
-                pb, dyl = xs_l
-                dwl, _dh_dead = pb(dyl)  # activation half unused -> DCE'd
-                return None, dwl
+            if remat:
+                # recompute the layer fwd once more from its saved input,
+                # differentiate w.r.t. WEIGHTS only — pure local matmuls off
+                # the permute chain, exactly the work ZBH1 defers
+                wls = _chunk(params, c)
 
-            _, dws = jax.lax.scan(layer_w, None, (pbs_t, dys_t))
+                def layer_w(_, xs_l):
+                    hl, dyl, wl = xs_l
+                    _, pb = jax.vjp(
+                        lambda w_: layer_fn(w_, hl, *bargs_r), wl)
+                    (dwl,) = pb(dyl)
+                    return None, dwl
+
+                wxs = (pbs_t, dys_t, tuple(wls))
+            else:
+                def layer_w(_, xs_l):
+                    pb, dyl = xs_l
+                    dwl, _dh_dead = pb(dyl)  # activation half unused -> DCE'd
+                    return None, dwl
+
+                wxs = (pbs_t, dys_t)
+
+            _, dws = jax.lax.scan(layer_w, None, wxs)
             # scatter-add this tick's [lc]-chunk grads into the local stack
             out = []
             for a, dch in zip(acc, dws):
@@ -426,8 +482,9 @@ def pipeline_call(
       remat: rematerialise each block in backward (fleet/recompute parity).
       schedule: "auto" (GPipe for interleave=1, interleaved VPP otherwise) or
         "zb" — the zero-bubble W/B-split schedule (see :func:`zb_schedule`;
-        ignores ``remat``; ``broadcast_args`` are non-differentiable (a grad
-        w.r.t. one raises at trace time),
+        ``remat=True`` selects its memory-bounded boundary-storage regime,
+        ``remat=False`` the ZB-∞ residual-saving regime; ``broadcast_args``
+        are non-differentiable (a grad w.r.t. one raises at trace time);
         no ``with_aux``).
 
     Returns global activations with the same shape as ``x`` (plus the aux sum
@@ -441,16 +498,18 @@ def pipeline_call(
             raise NotImplementedError(
                 "zero-bubble schedule does not support MoE aux side-outputs "
                 "— use the interleaved (VPP) schedule for MoE+pp")
-        if remat:
-            import warnings
-
-            warnings.warn(
-                "schedule='zb' ignores remat: it saves per-tick linearization "
-                "residuals by construction (ZB-∞ memory regime). Use the "
-                "GPipe/VPP schedules if recompute is required to fit memory.")
-        remat = False  # zb saves linearization residuals by construction
+    # zb handles remat via its own boundary-storage regime (see zb_schedule);
+    # jax.checkpoint wrapping applies to the grad-of-scan schedules only.
     # policy=None is jax.checkpoint's default (plain full remat)
-    blk = jax.checkpoint(block_fn, policy=remat_policy) if remat else block_fn
+    if schedule == "zb" and remat and remat_policy is not None:
+        import warnings
+
+        warnings.warn(
+            "schedule='zb' with remat=True always recomputes the full layer "
+            "in B and W (boundary-activation storage); the selective "
+            "remat_policy is ignored on this schedule")
+    blk = (jax.checkpoint(block_fn, policy=remat_policy)
+           if remat and schedule != "zb" else block_fn)
 
     def _run_layers(wls, h, *bargs):
         # wls: [n_local_layers, ...] arrays; scan blocks over the leading dim
@@ -502,7 +561,7 @@ def pipeline_call(
             # bargs are closed over by the zb custom_vjp: differentiating
             # w.r.t. them raises at trace time (vs. silent zero cotangents)
             zb = zb_schedule(blk, n_stages, interleave, lc, axis_name,
-                             bargs=bargs)
+                             bargs=bargs, remat=remat)
             return zb(params, micro_in)
     elif interleave > 1:
         pipeline = interleaved_schedule(

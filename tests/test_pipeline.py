@@ -375,3 +375,100 @@ class TestZeroBubble:
         zb = run("zb", 1)
         vpp = run("auto", 2)
         np.testing.assert_allclose(zb, vpp, rtol=2e-4)
+
+
+class TestZeroBubbleRemat:
+    """Memory-bounded (ZBH1-regime) zero-bubble: boundary-activation storage
+    + inside-layer recompute in B and W (VERDICT r3 next #4). Grads must
+    stay exactly sequential, and the schedule must compose with
+    Engine(pp_remat=True)."""
+
+    def test_zb_remat_matches_sequential(self):
+        mesh = make_mesh({"pp": 4})
+        rng = np.random.default_rng(13)
+        ws = jnp.asarray(rng.standard_normal((8, 16, 16)), jnp.float32) * 0.5
+        x = jnp.asarray(rng.standard_normal((8, 16)), jnp.float32)
+
+        def loss_zb(ws, x):
+            y = pipeline_call(_toy_block_fn, [ws], x, mesh=mesh, n_micro=4,
+                              schedule="zb", remat=True)
+            return jnp.mean(y**2)
+
+        def loss_seq(ws, x):
+            def body(h, w):
+                return jnp.tanh(h @ w), None
+            y, _ = jax.lax.scan(body, x, ws)
+            return jnp.mean(y**2)
+
+        l1, (gw1, gx1) = jax.jit(
+            jax.value_and_grad(loss_zb, argnums=(0, 1)))(ws, x)
+        l2, (gw2, gx2) = jax.jit(
+            jax.value_and_grad(loss_seq, argnums=(0, 1)))(ws, x)
+        np.testing.assert_allclose(l1, l2, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(gw1), np.asarray(gw2),
+                                   rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(gx1), np.asarray(gx2),
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_zb_remat_interleaved_matches_sequential(self):
+        from paddle_tpu.distributed.auto_parallel.pipeline import vpp_layer_order
+
+        mesh = make_mesh({"pp": 4})
+        rng = np.random.default_rng(14)
+        n_layers, d, v, p = 8, 16, 2, 4
+        ws = jnp.asarray(rng.standard_normal((n_layers, d, d)), jnp.float32) * 0.5
+        x = jnp.asarray(rng.standard_normal((8, d)), jnp.float32)
+        order = vpp_layer_order(n_layers, p, v)
+        ws_perm = ws[jnp.asarray(order)]
+
+        def loss_zb(wsp, x):
+            y = pipeline_call(_toy_block_fn, [wsp], x, mesh=mesh, n_micro=4,
+                              schedule="zb", remat=True, interleave=v)
+            return jnp.mean(y**2)
+
+        def loss_seq(ws, x):
+            def body(h, w):
+                return jnp.tanh(h @ w), None
+            y, _ = jax.lax.scan(body, x, ws)
+            return jnp.mean(y**2)
+
+        l1, g1p = jax.jit(jax.value_and_grad(loss_zb))(ws_perm, x)
+        l2, g2 = jax.jit(jax.value_and_grad(loss_seq))(ws, x)
+        np.testing.assert_allclose(l1, l2, rtol=1e-5)
+        g1 = np.empty_like(np.asarray(g1p))
+        g1[np.asarray(order)] = np.asarray(g1p)
+        np.testing.assert_allclose(g1, np.asarray(g2), rtol=1e-4, atol=1e-6)
+
+    def test_zb_remat_engine_llama(self):
+        """Engine(pp_schedule='zb', recompute=True model): loss agrees with
+        the dp-only engine and training decreases the loss — zb now composes
+        with exactly the memory-constrained configs that need it."""
+        import paddle_tpu as paddle
+
+        mesh_pp = make_mesh({"pp": 2, "dp": 2})
+        paddle.seed(7)
+        with axis_rules(mesh_pp):
+            cfg = LlamaConfig.tiny(num_hidden_layers=4, recompute=True)
+            model_pp = LlamaForCausalLM(cfg)
+        eng_pp = Engine(model_pp, mesh_pp, lr=5e-3, n_micro=2,
+                        pp_schedule="zb")
+        assert eng_pp._pp_remat  # model recompute flag flows to the schedule
+
+        mesh_dp = make_mesh({"dp": 8})
+        paddle.seed(7)
+        with axis_rules(mesh_dp):
+            model_dp = LlamaForCausalLM(
+                LlamaConfig.tiny(num_hidden_layers=4, recompute=True))
+        eng_dp = Engine(model_dp, mesh_dp, lr=5e-3)
+
+        rng = np.random.default_rng(3)
+        ids = rng.integers(0, cfg.vocab_size, (8, 16)).astype(np.int32)
+        l_pp = float(eng_pp.eval_loss(*map(jnp.asarray, (ids, ids))))
+        l_dp = float(eng_dp.eval_loss(*map(jnp.asarray, (ids, ids))))
+        np.testing.assert_allclose(l_pp, l_dp, rtol=2e-4)
+
+        ids_d, lbl_d = eng_pp.shard_batch(ids, ids)
+        l0 = float(eng_pp.step(ids_d, lbl_d))
+        for _ in range(3):
+            l = float(eng_pp.step(ids_d, lbl_d))
+        assert np.isfinite(l) and l < l0, f"zb+remat training: {l0} -> {l}"

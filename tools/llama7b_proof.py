@@ -1,0 +1,144 @@
+"""End-to-end Llama-2-7B v5p-32 proof WITHOUT v5p hardware (VERDICT r3 #2).
+
+Three artifacts, recorded in docs/LLAMA7B_V5P.md:
+  1. auto_tuner mesh selection for Llama-2-7B (hidden 4096, 32 layers, MHA,
+     seq 4096) on a 16-chip v5p-32 slice (16 chips x 2 TensorCores), with
+     the HBM-fit arithmetic per candidate.
+  2. AOT lowering of the FULL hybrid Engine train step (fwd + fused CE loss +
+     bwd + global-norm clip + AdamW, remat, real 7B shapes) over a 16-device
+     virtual mesh with the selected shardings — proving the 7B program
+     traces, shards, and lowers exactly as it would on hardware. Lowering
+     needs shapes and shardings only, so params are zero-initialized (the
+     StableHLO is identical for any parameter values).
+  3. roofline projection of tokens/s/chip + MFU from the tuner's cost model.
+
+Run: XLA_FLAGS=--xla_force_host_platform_device_count=16 python tools/llama7b_proof.py
+
+Reference anchor: test/auto_parallel/hybrid_strategy/semi_auto_llama.py:33
+(the reference's 7B-class hybrid-parallel llama test).
+"""
+
+import os
+import sys
+import time
+
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=16")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def pick_mesh(n_devices=16, global_batch=64):
+    from paddle_tpu.distributed.auto_tuner import AutoTuner, TuneConfig
+
+    cfg = TuneConfig(
+        n_devices=n_devices,
+        num_layers=32, hidden_size=4096, num_heads=32,
+        seq_len=4096, global_batch=global_batch,
+        vocab_size=32000, ffn_mult=11008 / 4096,
+        hbm_gb=95.0, flops_per_chip=459e12,  # v5p
+        remat=True, max_pp=8, max_tp=8)
+    tuner = AutoTuner(cfg)
+    cands = tuner.candidates()
+    print(f"feasible candidates: {len(cands)}; top 5 by roofline cost:")
+    for c in cands[:5]:
+        d = c.details
+        print(f"  {c}  t_compute={d['t_compute']*1e3:.1f}ms "
+              f"t_comm={d['t_comm']*1e3:.1f}ms bubble={d['bubble']:.3f}")
+    best = cands[0]
+    n_params = tuner._param_count()
+    print(f"\nselected: {best}")
+    print(f"params: {n_params/1e9:.2f}B")
+    shard = best.axes["fsdp"] * best.axes["tp"] * best.axes["pp"]
+    state_gb = n_params * 14 / shard / 1e9
+    print(f"HBM fit: params(bf16 2B) + grads(4B) + AdamW m+v(8B) = 14 B/param"
+          f" / {shard} shards = {state_gb:.1f} GB/chip of 95 GB")
+    tok_s_chip = global_batch * 4096 / best.cost / n_devices
+    mfu = tok_s_chip * 6 * n_params / 459e12
+    # the roofline is an upper bound (perfect MXU utilization); scale by the
+    # MEASURED single-chip matmul efficiency from the v5e north-star line
+    # (0.65-0.67 model-MFU, BENCH_r03/r04) for a realistic projection
+    eff = 0.65
+    print(f"roofline UPPER BOUND: step {best.cost*1e3:.0f} ms -> "
+          f"{tok_s_chip:.0f} tok/s/chip, MFU {mfu:.3f}")
+    print(f"realistic projection (x{eff} measured single-chip efficiency): "
+          f"{tok_s_chip*eff:.0f} tok/s/chip, MFU {mfu*eff:.3f} "
+          f"(north-star target >= 0.40)")
+    return best, n_params
+
+
+def lower_7b(best, fast_init=True):
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.auto_parallel import Engine, axis_rules, make_mesh
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    axes = {k: v for k, v in best.axes.items()}
+    mesh = make_mesh(axes)
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=4096, intermediate_size=11008,
+        num_hidden_layers=32, num_attention_heads=32, num_key_value_heads=32,
+        max_position_embeddings=4096, dtype="bfloat16", recompute=True)
+
+    saved = {}
+    if fast_init:
+        # zero-init: StableHLO depends on shapes/shardings only; random init
+        # of 6.7B params on one CPU core would take ~20 min for nothing
+        import paddle_tpu.nn.initializer as ini
+
+        for cls in (ini.Normal, ini.XavierNormal, ini.XavierUniform,
+                    ini.KaimingNormal, ini.Uniform):
+            saved[cls] = cls.__call__
+            cls.__call__ = lambda self, shape, dtype, *a, **k: (
+                jax.numpy.zeros(tuple(shape), dtype))
+    t0 = time.time()
+    try:
+        with axis_rules(mesh):
+            model = LlamaForCausalLM(cfg)
+    finally:
+        for cls, fn in saved.items():
+            cls.__call__ = fn
+    print(f"7B model materialized (zeros) in {time.time()-t0:.1f}s; "
+          f"{cfg.num_params()/1e9:.2f}B params")
+
+    def lower_with(mesh, n_micro=None):
+        eng = Engine(model, mesh, lr=3e-4, clip_norm=1.0, n_micro=n_micro,
+                     abstract_state=True)
+        # batch: dp*fsdp shards the batch dim; feed the GLOBAL batch
+        ids = jax.ShapeDtypeStruct((64, 4096), jax.numpy.int32)
+        t0 = time.time()
+        if eng._jit_step is None:
+            eng._jit_step = eng._build_step()
+        lowered = eng._jit_step.lower(eng.params, eng.m, eng.v,
+                                      eng.step_count, ids, ids)
+        txt = lowered.as_text()
+        dt = time.time() - t0
+        counts = {x: txt.count(x) for x in
+                  ("all_reduce", "all_gather", "reduce_scatter",
+                   "collective_permute", "all_to_all")}
+        # NOTE: GSPMD inserts fsdp gathers/tp reductions at COMPILE time;
+        # the StableHLO here shows sharding annotations + the explicit
+        # collectives (psum grad reductions, pipeline ppermutes)
+        print(f"AOT lowering OK in {dt:.1f}s: StableHLO {len(txt)/1e6:.1f} MB,"
+              f" mesh {dict(mesh.shape)}, explicit collectives {counts}")
+        return lowered
+
+    lowered = lower_with(make_mesh(dict(best.axes)),
+                         n_micro=best.n_micro if best.axes["pp"] > 1 else None)
+    # ALSO prove the full 4-axis hybrid machinery at 7B shapes: dp x fsdp x
+    # tp x pp with microbatched pipeline (the reference's 3D-hybrid shape,
+    # semi_auto_llama.py) — re-stacks decoder weights [32, ...] over pp
+    print("\nhybrid dp2xfsdp2xtp2xpp2 (n_micro=4) lowering:")
+    lower_with(make_mesh({"dp": 2, "fsdp": 2, "sep": 1, "tp": 2, "pp": 2}),
+               n_micro=4)
+    return lowered
+
+
+if __name__ == "__main__":
+    best, n_params = pick_mesh()
+    lower_7b(best)
+    print("\n7B v5p-32 proof complete.")
