@@ -1,0 +1,94 @@
+"""Eager per-op dispatch overhead microbenchmark (VERDICT r3 next #8).
+
+Parity anchor: the reference gates op-level perf in CI
+(tools/ci_op_benchmark.sh over benchmark/api scripts). Here the measured
+quantity is the FRAMEWORK overhead per eager op — everything apply_fn adds
+on top of jax's own eager dispatch: tape recording, AMP classification,
+static-graph interception checks, Tensor wrap/unwrap.
+
+Methodology: time N chained `paddle.add` calls on a small [8, 8] operand
+(device work ~0) in four regimes, then subtract the raw-jnp baseline.
+Numbers are host-CPU-bound; run on an idle machine. The CI gate
+(tests/test_ci_gates.py::test_eager_dispatch_overhead_bounded) asserts a
+GENEROUS multiple of the raw-jnp time so real regressions (accidental
+per-op retraces, O(n) tape scans) fail fast while shared-CI jitter passes.
+
+Run: python benchmarks/eager_dispatch.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def measure(n_ops: int = 2000):
+    # NOTE: no platform pinning here — the test suite imports this under its
+    # own CPU-pinned config; standalone runs pin in __main__ below
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.core import autograd_engine
+
+    x = paddle.to_tensor(np.ones((8, 8), np.float32))
+    y = paddle.to_tensor(np.ones((8, 8), np.float32))
+    xa, ya = x._data, y._data
+
+    def timed(fn, reps=3):
+        fn()  # warm (compile the add)
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn()
+            best = min(best, time.perf_counter() - t0)
+        return best / n_ops * 1e6  # us per op
+
+    def raw_jnp():
+        a = xa
+        for _ in range(n_ops):
+            a = jnp.add(a, ya)
+        a.block_until_ready()
+
+    def eager_no_grad():
+        with autograd_engine.no_grad():
+            a = x
+            for _ in range(n_ops):
+                a = paddle.add(a, y)
+            a._data.block_until_ready()
+
+    def eager_tape():
+        xg = paddle.to_tensor(np.ones((8, 8), np.float32),
+                              stop_gradient=False)
+        a = xg
+        for _ in range(n_ops):
+            a = paddle.add(a, y)
+        a._data.block_until_ready()
+
+    def eager_amp():
+        with autograd_engine.no_grad(), paddle.amp.auto_cast():
+            a = x
+            for _ in range(n_ops):
+                a = paddle.add(a, y)
+            a._data.block_until_ready()
+
+    out = {
+        "raw_jnp_us": timed(raw_jnp),
+        "eager_no_grad_us": timed(eager_no_grad),
+        "eager_tape_us": timed(eager_tape),
+        "eager_amp_us": timed(eager_amp),
+    }
+    base = out["raw_jnp_us"]
+    for k in ("eager_no_grad_us", "eager_tape_us", "eager_amp_us"):
+        out[k.replace("_us", "_x_raw")] = out[k] / base
+    return out
+
+
+if __name__ == "__main__":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # host-dispatch measurement
+    res = measure()
+    for k, v in res.items():
+        print(f"{k:24s} {v:8.2f}")

@@ -44,16 +44,20 @@ from . import distributed  # noqa: F401,E402
 from . import distribution  # noqa: F401,E402
 from . import fft  # noqa: F401,E402
 from . import framework  # noqa: F401,E402
+from . import geometric  # noqa: F401,E402
 from . import hapi  # noqa: F401,E402
+from . import hub  # noqa: F401,E402
 from . import incubate  # noqa: F401,E402
 from . import inference  # noqa: F401,E402
 from . import io  # noqa: F401,E402
 from . import jit  # noqa: F401,E402
 from . import metric  # noqa: F401,E402
 from . import nn  # noqa: F401,E402
+from . import onnx  # noqa: F401,E402
 from . import optimizer  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
 from . import quantization  # noqa: F401,E402
+from . import regularizer  # noqa: F401,E402
 from . import signal  # noqa: F401,E402
 from . import sparse  # noqa: F401,E402
 from . import static  # noqa: F401,E402

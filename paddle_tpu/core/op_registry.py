@@ -168,7 +168,31 @@ def apply_fn(name: str, fn: Callable, *args, _opdef: Optional[OpDef] = None, **k
                 full[i] = next(it) if i in diff_idx else args[i]._data
             return fn(*full, **kwargs)
 
-        out, vjp_fn = jax.vjp(pure, *diff_arrays)
+        # DEFERRED linearization: run the plain forward now (one XLA
+        # dispatch); backward() traces the vjp lazily from (pure, primals).
+        # Measured 25x lower per-op tape overhead (benchmarks/
+        # eager_dispatch.py) vs calling jax.vjp here, and ops never
+        # differentiated never pay for a linearize at all.
+        from ..framework import random as _frandom
+
+        _rng_key0 = _frandom._global["key"]
+        _rng_stack = _frandom._ctx_stack()
+        _rng_cnt0 = _rng_stack[-1]["count"] if _rng_stack else None
+        out = pure(*diff_arrays)
+        if (_frandom._global["key"] is not _rng_key0
+                or (_rng_stack and _rng_stack[-1]["count"] != _rng_cnt0)):
+            # the op drew RNG inside (dropout etc.): a deferred re-run would
+            # sample a DIFFERENT mask than the forward output used. Rewind
+            # the stream and linearize NOW — jax.vjp replays the same keys,
+            # so output, residuals, and the net stream advance all match.
+            _frandom._global["key"] = _rng_key0
+            if _rng_stack:
+                _rng_stack[-1]["count"] = _rng_cnt0
+            out, vjp_fn = jax.vjp(pure, *diff_arrays)
+            primals = None
+        else:
+            vjp_fn = None
+            primals = diff_arrays
         out_list, single = (list(out), False) if isinstance(out, (tuple, list)) else ([out], True)
         node = autograd_engine.GradNode(
             name,
@@ -176,6 +200,7 @@ def apply_fn(name: str, fn: Callable, *args, _opdef: Optional[OpDef] = None, **k
             [args[i] for i in diff_idx],
             [(o.shape, o.dtype) for o in out_list],
             pure_fn=pure,
+            primals=primals,
         )
         results = []
         for idx, o in enumerate(out_list):

@@ -1,0 +1,305 @@
+"""paddle_tpu.geometric — graph-learning ops.
+
+Parity anchors: the reference's paddle.geometric package —
+segment reductions (python/paddle/geometric/math.py:29 segment_sum et al.
+over phi segment_pool kernels), message passing
+(geometric/message_passing/send_recv.py:55 send_u_recv, :210 send_ue_recv,
+:413 send_uv over graph_send_recv kernels), graph reindexing
+(geometric/reindex.py:32 reindex_graph/reindex_heter_graph) and neighbor
+sampling (geometric/sampling/neighbors.py:68 sample_neighbors,
+weighted_sample_neighbors).
+
+TPU-native design: the dense per-edge/per-node compute (gather → message →
+segment-reduce) maps to ``jnp.take`` + ``jax.ops.segment_*`` — XLA lowers
+them to fused gather/scatter that stay on-device and differentiate through
+``jax.grad``. The structural ops (reindex, neighbor sampling) have
+data-DEPENDENT output shapes, so — like the reference, whose sampling
+pipeline runs on concrete tensors between training steps — they execute
+eagerly on host numpy and return concrete Tensors.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.op_registry import apply_fn
+from ..core.tensor import Tensor
+
+__all__ = [
+    "send_u_recv", "send_ue_recv", "send_uv",
+    "segment_sum", "segment_mean", "segment_min", "segment_max",
+    "reindex_graph", "reindex_heter_graph",
+    "sample_neighbors", "weighted_sample_neighbors",
+]
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _wrap(x):
+    return Tensor(x) if not isinstance(x, jax.core.Tracer) else x
+
+
+def _num_segments(ids, hint=None):
+    if hint is not None:
+        return int(hint)
+    if isinstance(ids, jax.core.Tracer):
+        raise ValueError(
+            "segment/send ops under jit need a static out_size / "
+            "num_segments (data-dependent output shapes cannot be traced)")
+    return int(jnp.max(ids)) + 1 if ids.size else 0
+
+
+# ---------------------------------------------------------------------------
+# segment reductions (math.py)
+# ---------------------------------------------------------------------------
+
+def _reduce_to_dst(msg, dst, n, reduce_op):
+    # segment counts in fp32: a low-precision data dtype (bf16) loses
+    # integer exactness above 256, corrupting means for high-degree nodes
+    def counts():
+        return jax.ops.segment_sum(jnp.ones((msg.shape[0],), jnp.float32),
+                                   dst, num_segments=n)
+
+    if reduce_op == "sum":
+        return jax.ops.segment_sum(msg, dst, num_segments=n)
+    if reduce_op == "mean":
+        tot = jax.ops.segment_sum(msg, dst, num_segments=n)
+        cnt = jnp.maximum(counts(), 1.0).astype(msg.dtype)
+        return tot / cnt.reshape((n,) + (1,) * (msg.ndim - 1))
+    if reduce_op in ("min", "max"):
+        fn = jax.ops.segment_min if reduce_op == "min" else jax.ops.segment_max
+        out = fn(msg, dst, num_segments=n)
+        # empty rows: the reference's kernels write 0, not +-inf
+        mask = (counts() > 0).reshape((n,) + (1,) * (msg.ndim - 1))
+        return jnp.where(mask, out, jnp.zeros_like(out))
+    raise ValueError(f"reduce_op must be sum/mean/min/max, got {reduce_op!r}")
+
+
+def _segment(data, segment_ids, op, num_segments=None):
+    """Dispatched through apply_fn so eager tape autograd flows through the
+    data input (the reference's segment kernels are dygraph-differentiable)."""
+    ids = _arr(segment_ids).astype(jnp.int32)
+    n = _num_segments(ids, num_segments)
+
+    def impl(d):
+        return _reduce_to_dst(d, ids, n, op)
+
+    if isinstance(data, Tensor):      # eager: dispatched (tape autograd)
+        return apply_fn(f"geometric.segment_{op}", impl, data)
+    return _wrap(impl(_arr(data)))    # raw arrays -> Tensor; tracers pass
+
+
+def segment_sum(data, segment_ids, name=None):
+    """out[i] = sum of data rows with segment_ids == i (math.py:29)."""
+    return _segment(data, segment_ids, "sum")
+
+
+def segment_mean(data, segment_ids, name=None):
+    """Mean per segment; empty segments give 0 (math.py:84)."""
+    return _segment(data, segment_ids, "mean")
+
+
+def segment_min(data, segment_ids, name=None):
+    """Min per segment; empty segments give 0 (math.py:140)."""
+    return _segment(data, segment_ids, "min")
+
+
+def segment_max(data, segment_ids, name=None):
+    """Max per segment; empty segments give 0 (math.py:196)."""
+    return _segment(data, segment_ids, "max")
+
+
+# ---------------------------------------------------------------------------
+# message passing (send_recv.py)
+# ---------------------------------------------------------------------------
+
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather x[src_index], reduce into rows dst_index
+    (send_recv.py:55 graph_send_recv). out rows = out_size (static under
+    jit) or max(dst_index)+1; untouched rows are 0."""
+    xa = _arr(x)
+    src = _arr(src_index).astype(jnp.int32)
+    dst = _arr(dst_index).astype(jnp.int32)
+    # reference default (out_size None/<=0): output dim0 == x.shape[0]
+    n = (int(out_size) if out_size is not None and int(out_size) > 0
+         else xa.shape[0])
+    def impl(xd):
+        return _reduce_to_dst(jnp.take(xd, src, axis=0), dst, n, reduce_op)
+
+    if isinstance(x, Tensor):
+        return apply_fn("geometric.send_u_recv", impl, x)
+    return _wrap(impl(xa))
+
+
+def _edge_message(xg, y, message_op):
+    y = _arr(y)
+    if y.ndim < xg.ndim:
+        y = y.reshape(y.shape + (1,) * (xg.ndim - y.ndim))
+    if message_op == "add":
+        return xg + y
+    if message_op == "sub":
+        return xg - y
+    if message_op == "mul":
+        return xg * y
+    if message_op == "div":
+        return xg / y
+    raise ValueError(f"message_op must be add/sub/mul/div, got {message_op!r}")
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Gather x[src_index], combine with per-edge y via message_op, reduce
+    into rows dst_index (send_recv.py:210 graph_send_ue_recv)."""
+    xa = _arr(x)
+    src = _arr(src_index).astype(jnp.int32)
+    dst = _arr(dst_index).astype(jnp.int32)
+    n = (int(out_size) if out_size is not None and int(out_size) > 0
+         else xa.shape[0])
+    def impl(xd, yd):
+        return _reduce_to_dst(
+            _edge_message(jnp.take(xd, src, axis=0), yd, message_op),
+            dst, n, reduce_op)
+
+    if isinstance(x, Tensor) or isinstance(y, Tensor):
+        x_t = x if isinstance(x, Tensor) else Tensor(xa)
+        y_t = y if isinstance(y, Tensor) else Tensor(_arr(y))
+        return apply_fn("geometric.send_ue_recv", impl, x_t, y_t)
+    return _wrap(impl(xa, _arr(y)))
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-EDGE output op(x[src], y[dst]) with no reduction
+    (send_recv.py:413 graph_send_uv)."""
+    if message_op not in ("add", "sub", "mul", "div"):
+        raise ValueError(
+            f"message_op must be add/sub/mul/div, got {message_op!r}")
+    src = _arr(src_index).astype(jnp.int32)
+    dst = _arr(dst_index).astype(jnp.int32)
+    def impl(xd, yd):
+        xs = jnp.take(xd, src, axis=0)
+        yg = jnp.take(yd, dst, axis=0)
+        return {"add": xs + yg, "sub": xs - yg,
+                "mul": xs * yg, "div": xs / yg}[message_op]
+
+    if isinstance(x, Tensor) or isinstance(y, Tensor):
+        x_t = x if isinstance(x, Tensor) else Tensor(_arr(x))
+        y_t = y if isinstance(y, Tensor) else Tensor(_arr(y))
+        return apply_fn("geometric.send_uv", impl, x_t, y_t)
+    return _wrap(impl(_arr(x), _arr(y)))
+
+
+# ---------------------------------------------------------------------------
+# reindex (reindex.py) — host-side, data-dependent shapes
+# ---------------------------------------------------------------------------
+
+def _np(x):
+    return np.asarray(x._data if isinstance(x, Tensor) else x)
+
+
+def _reindex(x, neighbor_lists, count_lists):
+    x = _np(x).reshape(-1)
+    mapping = {}
+    out_nodes = []
+    for v in x.tolist():
+        mapping[v] = len(out_nodes)
+        out_nodes.append(v)
+    srcs, dsts = [], []
+    for neighbors, count in zip(neighbor_lists, count_lists):
+        neighbors = _np(neighbors).reshape(-1)
+        count = _np(count).reshape(-1)
+        for v in neighbors.tolist():
+            if v not in mapping:
+                mapping[v] = len(out_nodes)
+                out_nodes.append(v)
+        srcs.append(np.asarray([mapping[v] for v in neighbors.tolist()],
+                               np.int64))
+        dsts.append(np.repeat(np.arange(len(count), dtype=np.int64), count))
+    src = np.concatenate(srcs) if srcs else np.zeros((0,), np.int64)
+    dst = np.concatenate(dsts) if dsts else np.zeros((0,), np.int64)
+    nodes = np.asarray(out_nodes, x.dtype)
+    return (Tensor(src.astype(x.dtype)), Tensor(dst.astype(x.dtype)),
+            Tensor(nodes))
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Reindex sampled-subgraph node ids from 0 (reindex.py:32): returns
+    (reindex_src, reindex_dst, out_nodes); out_nodes = x ++ first-seen
+    neighbors not in x. Host-side (data-dependent shapes), like the
+    reference's sampling pipeline."""
+    return _reindex(x, [neighbors], [count])
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """reindex_graph over per-edge-type neighbor lists sharing one node set
+    (reindex.py:157)."""
+    return _reindex(x, list(neighbors), list(count))
+
+
+# ---------------------------------------------------------------------------
+# neighbor sampling (sampling/neighbors.py) — host-side
+# ---------------------------------------------------------------------------
+
+def _sample(row, colptr, input_nodes, sample_size, eids, return_eids,
+            weights=None):
+    row = _np(row).reshape(-1)
+    colptr = _np(colptr).reshape(-1)
+    nodes = _np(input_nodes).reshape(-1)
+    if eids is not None:
+        eids = _np(eids).reshape(-1)
+    elif return_eids:
+        raise ValueError("return_eids=True requires eids")
+    out_n, out_c, out_e = [], [], []
+    # reproducible under paddle.seed: the framework RNG stream seeds numpy
+    from ..framework import random as frandom
+
+    rng = np.random.default_rng(frandom.next_host_seed())
+    for n in nodes.tolist():
+        lo, hi = int(colptr[n]), int(colptr[n + 1])
+        deg = hi - lo
+        idx = np.arange(lo, hi)
+        if 0 <= sample_size < deg:
+            if weights is None:
+                idx = rng.choice(idx, size=sample_size, replace=False)
+            else:
+                w = _np(weights).reshape(-1)[lo:hi].astype(np.float64)
+                p = w / w.sum() if w.sum() > 0 else None
+                idx = rng.choice(idx, size=sample_size, replace=False, p=p)
+            deg = sample_size
+        out_n.append(row[idx])
+        out_c.append(deg)
+        if return_eids:
+            out_e.append(eids[idx])
+    neighbors = (np.concatenate(out_n) if out_n
+                 else np.zeros((0,), row.dtype))
+    counts = np.asarray(out_c, np.int32)
+    if return_eids:
+        e = np.concatenate(out_e) if out_e else np.zeros((0,), row.dtype)
+        return Tensor(neighbors), Tensor(counts), Tensor(e)
+    return Tensor(neighbors), Tensor(counts)
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """Uniform neighbor sampling over a CSC graph
+    (sampling/neighbors.py:68): returns (neighbors, counts[, eids]).
+    sample_size=-1 takes all neighbors."""
+    return _sample(row, colptr, input_nodes, sample_size, eids, return_eids)
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    """Weight-proportional sampling without replacement
+    (sampling/neighbors.py weighted_sample_neighbors)."""
+    return _sample(row, colptr, input_nodes, sample_size, eids, return_eids,
+                   weights=edge_weight)

@@ -1,3 +1,4 @@
 """paddle_tpu.incubate (reference: python/paddle/incubate)."""
 
+from . import asp  # noqa: F401
 from . import distributed, nn  # noqa: F401

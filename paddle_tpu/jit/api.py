@@ -98,6 +98,7 @@ class StaticFunction:
         # flow, the function permanently falls back to eager execution
         self._full_graph = full_graph
         self._fallback_eager = False
+        self._split_plan = None  # SOT-style partial graphs (partial_graph.py)
         functools.update_wrapper(self, self._orig_fn)
 
     @property
@@ -126,6 +127,12 @@ class StaticFunction:
     def __call__(self, *args, **kwargs):
         if self._fallback_eager or not _to_static_enabled[0]:
             return self._orig_fn(*args, **kwargs)
+        if self._split_plan is not None:
+            if kwargs:
+                # the split plan is positional-only; kwarg call sites keep
+                # the original (eager) semantics rather than crashing
+                return self._orig_fn(*args, **kwargs)
+            return self._split_plan(*args)
         try:
             return self._compiled_call(*args, **kwargs)
         except (jax.errors.TracerBoolConversionError,
@@ -134,13 +141,28 @@ class StaticFunction:
                 jax.errors.TracerIntegerConversionError) as e:
             # graph break: value-dependent Python control flow inside the
             # traced region. The reference's SOT splits the bytecode at the
-            # break (sot/opcode_translator); the jax-native equivalent is
-            # whole-function eager fallback — correctness preserved, speed
-            # reverts to op-by-op dispatch.
+            # break and resumes compiled execution (sot/translate.py:31);
+            # the jax-native equivalent splits the AST at a breaking top-
+            # level `if`: prefix-jit -> eager condition -> per-branch
+            # suffix-jit (jit/partial_graph.py). Breaks the splitter cannot
+            # express fall back to whole-function eager execution.
             if self._full_graph:
                 raise
             import warnings
 
+            if self._layer is None and not kwargs:
+                from .partial_graph import break_lineno_of, try_split
+
+                plan = try_split(self._orig_fn, break_lineno_of(e, self._orig_fn))
+                if plan is not None:
+                    warnings.warn(
+                        f"to_static: graph break in "
+                        f"{getattr(self._orig_fn, '__name__', '?')} "
+                        f"({type(e).__name__}) — split into prefix/suffix "
+                        "compiled subgraphs with an eager bridge at the "
+                        "breaking condition (SOT-style partial graphs).")
+                    self._split_plan = plan
+                    return plan(*args)
             warnings.warn(
                 f"to_static: graph break in {getattr(self._orig_fn, '__name__', '?')} "
                 f"({type(e).__name__}) — falling back to eager execution. "

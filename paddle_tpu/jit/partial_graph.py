@@ -1,0 +1,188 @@
+"""Partial-graph compilation for ``to_static(full_graph=False)``.
+
+Parity anchor: the reference's SOT resumes COMPILED execution after a graph
+break instead of abandoning compilation (jit/sot/translate.py:31 — the
+opcode translator splits the bytecode at the break and stitches compiled
+subgraphs with an eager bridge).
+
+TPU-native redesign: instead of bytecode surgery, the function's AST is
+split at the breaking ``if`` statement:
+
+    prefix  = statements before the if           -> one jitted graph
+    bridge  = the if CONDITION, evaluated eagerly on the prefix's concrete
+              outputs (the data-dependent bool the trace could not take)
+    suffix  = branch body + remaining statements -> one jitted graph per
+              taken branch (compiled lazily, only for branches that run)
+
+Each suffix is itself a ``full_graph=False`` StaticFunction, so a second
+break inside it splits again (elif chains are nested ifs and recurse
+naturally). When the break is not an ``if`` at the top level of the function
+body — while-on-tensor, tensor-int conversion in indexing, breaks inside
+loops — :func:`try_split` returns None and the caller keeps the
+whole-function eager fallback.
+
+Bounds (documented, not silent): plain functions only (no *args/**kwargs,
+no Layer state), source must be available, and the breaking statement must
+be a top-level ``if``.
+"""
+
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+from typing import Optional
+
+__all__ = ["try_split", "SplitPlan", "break_lineno_of"]
+
+
+def break_lineno_of(exc, fn) -> Optional[int]:
+    """Line (in fn's file) where tracing broke, from the exception traceback."""
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        return None
+    tb = exc.__traceback__
+    lineno = None
+    while tb is not None:
+        if tb.tb_frame.f_code is code:
+            lineno = tb.tb_lineno
+        tb = tb.tb_next
+    return lineno
+
+
+class _Names(ast.NodeVisitor):
+    def __init__(self):
+        self.loads = set()
+        self.stores = set()
+
+    def visit_Name(self, node):
+        (self.loads if isinstance(node.ctx, ast.Load)
+         else self.stores).add(node.id)
+
+    def visit_AugAssign(self, node):
+        # `s += x` both reads and writes s (ast marks the target Store only)
+        if isinstance(node.target, ast.Name):
+            self.loads.add(node.target.id)
+        self.generic_visit(node)
+
+
+def _names(nodes):
+    v = _Names()
+    for n in nodes:
+        v.visit(n)
+    return v
+
+
+_SYNTH_COUNT = [0]
+
+
+def _make_fn(name, arg_names, body_stmts, globs):
+    """exec a synthesized def and return the function object. Its source is
+    registered in linecache so a SECOND graph break inside it can be split
+    again (try_split needs inspect.getsource)."""
+    import linecache
+
+    fdef = ast.FunctionDef(
+        name=name,
+        args=ast.arguments(posonlyargs=[], args=[ast.arg(a) for a in arg_names],
+                           kwonlyargs=[], kw_defaults=[], defaults=[]),
+        body=body_stmts or [ast.Pass()],
+        decorator_list=[], returns=None, type_params=[])
+    mod = ast.Module(body=[fdef], type_ignores=[])
+    ast.fix_missing_locations(mod)
+    src = ast.unparse(mod)
+    _SYNTH_COUNT[0] += 1
+    fname = f"<partial_graph:{name}:{_SYNTH_COUNT[0]}>"
+    linecache.cache[fname] = (len(src), None, src.splitlines(True), fname)
+    ns = {}
+    exec(compile(src, fname, "exec"), globs, ns)  # noqa: S102
+    return ns[name]
+
+
+class SplitPlan:
+    """Callable implementing prefix-jit -> eager condition -> suffix-jit.
+
+    The prefix returns EVERY value the suffix reads (including reassigned
+    parameters — `x = x * 2` before the break must reach the suffix as the
+    doubled value, not the caller's argument), so the condition and branches
+    take only the live tuple."""
+
+    def __init__(self, prefix_sf, cond_fn, true_sf, false_sf, live):
+        self._prefix = prefix_sf
+        self._cond = cond_fn
+        self._true = true_sf
+        self._false = false_sf
+        self._live = live
+
+    def __call__(self, *args):
+        live_vals = self._prefix(*args)
+        if not isinstance(live_vals, tuple):
+            live_vals = (live_vals,)
+        cond = bool(self._cond(*live_vals))
+        branch = self._true if cond else self._false
+        return branch(*live_vals)
+
+
+def try_split(fn, lineno: Optional[int]) -> Optional[SplitPlan]:
+    """Build a SplitPlan for a break at ``lineno`` (file line), or None."""
+    from .api import StaticFunction
+
+    if lineno is None:
+        return None
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+    except (OSError, TypeError):
+        return None
+    tree = ast.parse(src)
+    fdef = tree.body[0]
+    if not isinstance(fdef, ast.FunctionDef):
+        return None
+    a = fdef.args
+    if (a.vararg or a.kwarg or a.kwonlyargs or a.posonlyargs or a.defaults):
+        return None
+    arg_names = [x.arg for x in a.args]
+    # map the file lineno onto the dedented source's linenos: getsource
+    # starts at co_firstlineno (the first decorator when decorated), which
+    # is line 1 of the parsed source
+    rel = lineno - fn.__code__.co_firstlineno + 1
+    idx = None
+    for i, stmt in enumerate(fdef.body):
+        if stmt.lineno <= rel <= (stmt.end_lineno or stmt.lineno):
+            idx = i
+            break
+    if idx is None or not isinstance(fdef.body[idx], ast.If):
+        return None
+    prefix_stmts = fdef.body[:idx]
+    if_stmt = fdef.body[idx]
+    rest = fdef.body[idx + 1:]
+
+    # live set: everything the suffix reads that exists at the break —
+    # arguments INCLUDED (a reassigned parameter must flow through the
+    # prefix's return, not the caller's original value)
+    produced = _names(prefix_stmts).stores | set(arg_names)
+    needed = _names([if_stmt] + rest).loads
+    live = sorted(produced & needed)
+
+    globs = dict(fn.__globals__)
+    globs.update(inspect.getclosurevars(fn).nonlocals)
+
+    ret_live = ast.Return(ast.Tuple(
+        [ast.Name(n, ast.Load()) for n in live], ast.Load()))
+    prefix_fn = _make_fn("__pg_prefix", arg_names,
+                         prefix_stmts + [ret_live], globs)
+    cond_fn = _make_fn("__pg_cond", live,
+                       [ast.Return(if_stmt.test)], globs)
+    true_fn = _make_fn("__pg_true", live,
+                       if_stmt.body + rest, globs)
+    false_fn = _make_fn("__pg_false", live,
+                        (if_stmt.orelse or []) + rest, globs)
+
+    # prefix: one jitted graph (a break before the if would have surfaced
+    # earlier, but keep the eager safety net); suffixes: full_graph=False so
+    # a second break splits again
+    return SplitPlan(
+        StaticFunction(prefix_fn, full_graph=False),
+        cond_fn,
+        StaticFunction(true_fn, full_graph=False),
+        StaticFunction(false_fn, full_graph=False),
+        live)

@@ -142,6 +142,10 @@ class Layer:
         data = init(shape, dtype)
         p = Parameter(data, dtype=dtype, name=name, trainable=trainable)
         p.optimize_attr["learning_rate"] = learning_rate
+        if attr is not None and attr is not False:
+            # per-param regularizer (overrides the optimizer-level
+            # weight_decay — see Optimizer._decay_term)
+            p.regularizer = getattr(attr, "regularizer", None)
         return p
 
     def create_tensor(self, name=None, dtype=None, persistable=False):

@@ -1,0 +1,41 @@
+"""paddle_tpu.onnx — model export for external inference backends.
+
+Parity anchor: python/paddle/onnx/export.py:33 (paddle.onnx.export), which
+delegates ONNX serialization to the external paddle2onnx package (the
+reference itself raises without it).
+
+TPU-native stance: the portable interchange format of the XLA world is
+StableHLO, not ONNX — :func:`export` traces the layer exactly like
+``paddle.onnx.export`` (jit.save machinery, InputSpec-driven) and writes the
+StableHLO artifact at the requested path; that artifact is the deployable
+product (inference.Predictor / the C++ stablehlo_runner load it). The final
+StableHLO->ONNX serialization is NOT implemented in-repo — export() always
+raises after producing the artifact, naming what exists and what is missing,
+mirroring the reference's hard paddle2onnx dependency rather than silently
+stubbing.
+"""
+
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path: str, input_spec=None, opset_version: int = 9,
+           **configs):
+    """Trace ``layer`` with ``input_spec`` and export for external
+    inference. Writes the StableHLO artifact at ``path`` (jit.save format,
+    loadable by inference.Predictor and the native stablehlo_runner), then
+    raises: the final StableHLO->ONNX serialization is not implemented
+    in-repo (reference parity: onnx/export.py:33 hard-depends on the
+    external paddle2onnx converter)."""
+    from ..jit.api import save as jit_save
+
+    if path.endswith(".onnx"):
+        path = path[:-5]
+    jit_save(layer, path, input_spec=input_spec)
+    raise RuntimeError(
+        f"paddle_tpu.onnx.export: traced artifact saved at {path!r} "
+        "(StableHLO, loadable by inference.Predictor / the C++ "
+        "stablehlo_runner). StableHLO->ONNX serialization is not "
+        "implemented in-repo (the reference likewise hard-depends on the "
+        "external paddle2onnx converter for this step)")

@@ -111,12 +111,36 @@ class Optimizer:
                 return float(getattr(wd, attr))
         return 0.0
 
-    def _apply_weight_decay(self, p, g):
-        """L2 regularization folded into the gradient (reference 'weight_decay' regularizer)."""
+    def _decay_term(self, value, g_dtype, param=None):
+        """Decay contribution added to the gradient, or None.
+
+        A regularizer OBJECT (paddle_tpu.regularizer.L1Decay/L2Decay)
+        contributes its own term — L1's coeff*sign(p) cannot be expressed by
+        a bare coefficient; a number means L2. A per-param regularizer
+        (ParamAttr(regularizer=...), stored on the Tensor) OVERRIDES the
+        optimizer-level one, matching the reference's precedence
+        (regularizer.py: 'ParamAttr has higher priority than optimizer').
+        Decoupled-decay optimizers (AdamW) handle decay inside _update."""
+        if isinstance(self, _DecoupledWeightDecay):
+            return None
+        from ..regularizer import WeightDecayRegularizer
+
+        wd = getattr(param, "regularizer", None)
+        if not isinstance(wd, WeightDecayRegularizer):
+            wd = self._weight_decay
+        if wd is None:
+            return None
+        if isinstance(wd, WeightDecayRegularizer):
+            out = wd(value)
+            return out.astype(g_dtype) if out.dtype != g_dtype else out
         coeff = self._l2_coeff()
-        if coeff:
-            return g + coeff * p._data.astype(g.dtype)
-        return g
+        return coeff * value.astype(g_dtype) if coeff else None
+
+    def _apply_weight_decay(self, p, g):
+        """Regularization folded into the gradient (reference 'weight_decay'
+        regularizer + per-param ParamAttr regularizers)."""
+        d = self._decay_term(p._data, g.dtype, p)
+        return g + d if d is not None else g
 
     @no_grad()
     def step(self):
@@ -146,16 +170,17 @@ class Optimizer:
         saved_acc, saved_step = self._accumulators, self._step_count
         self._accumulators = acc_state
         self._step_count = step
-        # L2 regularizer coefficient (decoupled decay lives in AdamW._update)
-        l2 = self._l2_coeff()
         try:
             new_vals = []
             for g, v, p in zip(grads, values, params):
                 if g is None:
                     new_vals.append(v)
                     continue
-                if l2:
-                    g = g + l2 * v.astype(g.dtype)
+                # same regularizer semantics as the eager path (incl. L1's
+                # sign decay and per-param ParamAttr regularizers)
+                d = self._decay_term(v, g.dtype, p)
+                if d is not None:
+                    g = g + d
                 out = self._update(g, v, p, lr)
                 new_vals.append(out.astype(v.dtype) if out.dtype != v.dtype else out)
         finally:

@@ -1,0 +1,60 @@
+"""paddle_tpu.regularizer — public weight-decay regularizer classes.
+
+Parity anchor: python/paddle/regularizer.py (L1Decay at :51, L2Decay at
+:169) — both carry ``_coeff`` and are accepted by optimizers' ``weight_decay``
+argument (optimizer/optimizer.py duck-types the coefficient) and by
+``ParamAttr(regularizer=...)``. ``__call__(param)`` returns the decay term
+added to the gradient: ``coeff * sign(param)`` for L1 (the gradient of
+``coeff * sum(|x|)``), ``coeff * param`` for L2 (gradient of
+``0.5 * coeff * sum(x^2)``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.tensor import Tensor
+
+__all__ = ["WeightDecayRegularizer", "L1Decay", "L2Decay"]
+
+
+class WeightDecayRegularizer:
+    def __call__(self, param):
+        raise NotImplementedError
+
+    def __str__(self):
+        raise NotImplementedError
+
+
+def _arr(p):
+    return p._data if isinstance(p, Tensor) else jnp.asarray(p)
+
+
+class L1Decay(WeightDecayRegularizer):
+    """loss += coeff * sum(|param|); grad contribution coeff * sign(param)
+    (regularizer.py:51)."""
+
+    def __init__(self, coeff: float = 0.0):
+        self._coeff = float(coeff)
+        self._regularization_coeff = self._coeff  # legacy attribute name
+
+    def __call__(self, param):
+        return self._coeff * jnp.sign(_arr(param))
+
+    def __str__(self):
+        return f"L1Decay, coeff={self._coeff:f}"
+
+
+class L2Decay(WeightDecayRegularizer):
+    """loss += 0.5 * coeff * sum(param^2); grad contribution coeff * param
+    (regularizer.py:169)."""
+
+    def __init__(self, coeff: float = 0.0):
+        self._coeff = float(coeff)
+        self._regularization_coeff = self._coeff
+
+    def __call__(self, param):
+        return self._coeff * _arr(param)
+
+    def __str__(self):
+        return f"L2Decay, coeff={self._coeff:f}"

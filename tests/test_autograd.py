@@ -186,3 +186,43 @@ def test_create_graph_through_pylayer_raises():
     y = Square.apply(x).sum()
     with pytest.raises(RuntimeError, match="create_graph"):
         paddle.grad(y, x, create_graph=True)
+
+
+def test_rng_op_gradients_match_forward_mask():
+    """Deferred tape linearization must NOT re-sample RNG ops at backward
+    time: dropout's gradient mask must be EXACTLY the mask the forward
+    output used (round-4 review finding — a naive deferred re-run draws a
+    fresh key and silently corrupts grads)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+
+    paddle.seed(42)
+    x = paddle.to_tensor(np.ones((64, 64), np.float32), stop_gradient=False)
+    y = F.dropout(x, p=0.5, training=True)
+    fwd_mask = (np.asarray(y.numpy()) != 0.0)
+    y.sum().backward()
+    g = x.grad.numpy()
+    # grad of sum(dropout(x)) is the forward's mask / keep_prob
+    np.testing.assert_allclose((g != 0.0), fwd_mask)
+    np.testing.assert_allclose(g[fwd_mask], 2.0, rtol=1e-6)
+
+
+def test_rng_stream_reproducible_with_tape():
+    """Recording a tape around an RNG op must advance the stream exactly
+    once (the rewind+revjp path), keeping paddle.seed reproducibility."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+
+    def run():
+        paddle.seed(7)
+        x = paddle.to_tensor(np.ones((16, 16), np.float32),
+                             stop_gradient=False)
+        a = F.dropout(x, p=0.5, training=True)  # taped rng op
+        b = F.dropout(x, p=0.5, training=True)
+        return a.numpy().copy(), b.numpy().copy()
+
+    a1, b1 = run()
+    a2, b2 = run()
+    np.testing.assert_array_equal(a1, a2)
+    np.testing.assert_array_equal(b1, b2)
+    assert (a1 != b1).any()  # distinct draws within one run

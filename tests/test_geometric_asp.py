@@ -1,0 +1,293 @@
+"""paddle.geometric + incubate.asp + regularizer + hub (VERDICT r3 next #6).
+
+Numpy-referenced in the reference's OpTest style; geometric anchors:
+python/paddle/geometric/math.py, message_passing/send_recv.py, reindex.py,
+sampling/neighbors.py. ASP anchors: incubate/asp/utils.py + asp.py.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import geometric
+
+
+class TestSegment:
+    def test_segment_sum_reference_example(self):
+        data = [[1., 2., 3.], [3., 2., 1.], [4., 5., 6.]]
+        out = geometric.segment_sum(data, [0, 0, 1])
+        np.testing.assert_allclose(out.numpy(), [[4, 4, 4], [4, 5, 6]])
+
+    @pytest.mark.parametrize("op", ["sum", "mean", "min", "max"])
+    def test_vs_numpy(self, op):
+        rng = np.random.default_rng(0)
+        data = rng.standard_normal((20, 5)).astype(np.float32)
+        ids = np.sort(rng.integers(0, 6, 20)).astype(np.int32)
+        got = getattr(geometric, f"segment_{op}")(data, ids).numpy()
+        ref = np.zeros((ids.max() + 1, 5), np.float32)
+        for i in range(ids.max() + 1):
+            rows = data[ids == i]
+            if rows.size:
+                ref[i] = {"sum": rows.sum(0), "mean": rows.mean(0),
+                          "min": rows.min(0), "max": rows.max(0)}[op]
+        np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-6)
+
+    def test_empty_segment_gives_zero(self):
+        out = geometric.segment_max([[1., 1.]], [1])  # segment 0 empty... ids must cover
+        # ids [1] -> segments 0 (empty) and 1
+        np.testing.assert_allclose(out.numpy(), [[0, 0], [1, 1]])
+
+
+class TestSendRecv:
+    def test_send_u_recv_reference_example(self):
+        x = [[0., 2., 3.], [1., 4., 5.], [2., 6., 7.]]
+        src = [0, 1, 2, 0]
+        dst = [1, 2, 1, 0]
+        out = geometric.send_u_recv(x, src, dst, reduce_op="sum")
+        np.testing.assert_allclose(out.numpy(),
+                                   [[0, 2, 3], [2, 8, 10], [1, 4, 5]])
+
+    def test_send_u_recv_out_size(self):
+        x = [[0., 2., 3.], [1., 4., 5.], [2., 6., 7.]]
+        out = geometric.send_u_recv(x, [0, 2, 0], [1, 1, 0],
+                                    reduce_op="sum", out_size=2)
+        np.testing.assert_allclose(out.numpy(), [[0, 2, 3], [2, 8, 10]])
+
+    @pytest.mark.parametrize("mop", ["add", "sub", "mul", "div"])
+    def test_send_ue_recv(self, mop):
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((4, 3)).astype(np.float32)
+        y = (rng.standard_normal(5).astype(np.float32) + 3.0)
+        src = np.asarray([0, 1, 2, 3, 0], np.int32)
+        dst = np.asarray([1, 0, 3, 2, 2], np.int32)
+        got = geometric.send_ue_recv(x, y, src, dst, message_op=mop,
+                                     reduce_op="sum").numpy()
+        msg = {"add": x[src] + y[:, None], "sub": x[src] - y[:, None],
+               "mul": x[src] * y[:, None], "div": x[src] / y[:, None]}[mop]
+        ref = np.zeros_like(x)
+        for e, d in enumerate(dst):
+            ref[d] += msg[e]
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+    def test_send_uv_per_edge(self):
+        x = np.asarray([[1., 2.], [3., 4.]], np.float32)
+        y = np.asarray([[10., 20.], [30., 40.]], np.float32)
+        out = geometric.send_uv(x, y, [0, 1], [1, 0], message_op="add")
+        np.testing.assert_allclose(out.numpy(), [[31, 42], [13, 24]])
+
+    def test_send_u_recv_differentiable(self):
+        x = jnp.asarray(np.random.default_rng(2).standard_normal((3, 2)),
+                        jnp.float32)
+        src = jnp.asarray([0, 1, 2], jnp.int32)
+        dst = jnp.asarray([1, 1, 0], jnp.int32)
+
+        def loss(x):
+            return geometric.send_u_recv(x, src, dst, out_size=3).sum()
+
+        g = jax.grad(loss)(x)
+        np.testing.assert_allclose(np.asarray(g), np.ones((3, 2)), rtol=1e-6)
+
+
+class TestReindexSampling:
+    def test_reindex_graph_reference_example(self):
+        src, dst, nodes = geometric.reindex_graph(
+            np.asarray([0, 1, 2], np.int64),
+            np.asarray([8, 9, 0, 4, 7, 6, 7], np.int64),
+            np.asarray([2, 3, 2], np.int32))
+        np.testing.assert_array_equal(src.numpy(), [3, 4, 0, 5, 6, 7, 6])
+        np.testing.assert_array_equal(dst.numpy(), [0, 0, 1, 1, 1, 2, 2])
+        np.testing.assert_array_equal(nodes.numpy(), [0, 1, 2, 8, 9, 4, 7, 6])
+
+    def test_reindex_heter_graph(self):
+        src, dst, nodes = geometric.reindex_heter_graph(
+            np.asarray([0, 1], np.int64),
+            [np.asarray([2, 3], np.int64), np.asarray([3, 0], np.int64)],
+            [np.asarray([1, 1], np.int32), np.asarray([1, 1], np.int32)])
+        np.testing.assert_array_equal(nodes.numpy(), [0, 1, 2, 3])
+        np.testing.assert_array_equal(src.numpy(), [2, 3, 3, 0])
+        np.testing.assert_array_equal(dst.numpy(), [0, 1, 0, 1])
+
+    def test_sample_neighbors_all_and_partial(self):
+        # CSC: node 0 neighbors [1, 2], node 1 [0], node 2 []
+        row = np.asarray([1, 2, 0], np.int64)
+        colptr = np.asarray([0, 2, 3, 3], np.int64)
+        n, c = geometric.sample_neighbors(row, colptr, np.asarray([0, 1, 2]))
+        np.testing.assert_array_equal(c.numpy(), [2, 1, 0])
+        np.testing.assert_array_equal(np.sort(n.numpy()[:2]), [1, 2])
+        n2, c2 = geometric.sample_neighbors(row, colptr, np.asarray([0]),
+                                            sample_size=1)
+        assert c2.numpy()[0] == 1 and n2.numpy()[0] in (1, 2)
+
+    def test_weighted_sample_prefers_heavy_edges(self):
+        row = np.asarray([1, 2], np.int64)
+        colptr = np.asarray([0, 2], np.int64)
+        w = np.asarray([1e6, 1e-6], np.float32)
+        hits = 0
+        for _ in range(20):
+            n, c = geometric.weighted_sample_neighbors(
+                row, colptr, w, np.asarray([0]), sample_size=1)
+            hits += int(n.numpy()[0] == 1)
+        assert hits >= 18  # overwhelming weight ratio
+
+
+class TestASP:
+    def test_mask_1d_reference_example(self):
+        from paddle_tpu.incubate import asp
+
+        mat = np.asarray([[0, 1, 5, 4], [2, 7, 3, 6]], np.float32)
+        mask = asp.get_mask_1d(mat, 2, 4)
+        np.testing.assert_array_equal(mask, [[0, 0, 1, 1], [0, 1, 0, 1]])
+        assert asp.check_mask_1d(mask, 2, 4)
+        assert not asp.check_mask_1d(np.ones((2, 4)), 2, 4)
+
+    def test_mask_2d_greedy_and_best(self):
+        from paddle_tpu.incubate import asp
+
+        rng = np.random.default_rng(5)
+        mat = rng.standard_normal((8, 8)).astype(np.float32)
+        for algo in (asp.get_mask_2d_greedy, asp.get_mask_2d_best):
+            mask = algo(mat, 2, 4)
+            assert asp.check_mask_2d(mask, 2, 4), algo.__name__
+        # best keeps at least as much magnitude as greedy
+        g = (np.abs(mat) * asp.get_mask_2d_greedy(mat, 2, 4)).sum()
+        b = (np.abs(mat) * asp.get_mask_2d_best(mat, 2, 4)).sum()
+        assert b >= g - 1e-6
+
+    def test_calculate_density(self):
+        from paddle_tpu.incubate import asp
+
+        x = np.asarray([[0, 1, 3, 0], [1, 1, 0, 1]])
+        assert asp.calculate_density(x) == 0.625
+
+    def test_prune_model_and_decorate_keep_pattern(self):
+        from paddle_tpu.incubate import asp
+
+        paddle.seed(0)
+        net = paddle.nn.Sequential(
+            paddle.nn.Linear(16, 32), paddle.nn.ReLU(),
+            paddle.nn.Linear(32, 8))
+        asp.prune_model(net, n=2, m=4)
+        for _, layer in net.named_sublayers():
+            if type(layer).__name__ == "Linear":
+                assert asp.check_sparsity(layer.weight.numpy().T, n=2, m=4)
+
+        opt = asp.decorate(paddle.optimizer.SGD(
+            learning_rate=0.1, parameters=net.parameters()))
+        x = paddle.to_tensor(np.random.default_rng(1)
+                             .standard_normal((4, 16)).astype(np.float32))
+        loss = net(x).mean()
+        loss.backward()
+        opt.step()
+        for _, layer in net.named_sublayers():
+            if type(layer).__name__ == "Linear":
+                assert asp.check_sparsity(layer.weight.numpy().T, n=2, m=4)
+
+    def test_excluded_layers(self):
+        from paddle_tpu.incubate import asp
+
+        paddle.seed(0)
+        net = paddle.nn.Sequential(paddle.nn.Linear(8, 8),
+                                   paddle.nn.Linear(8, 8))
+        names = [n for n, _ in net.named_sublayers()]
+        asp.set_excluded_layers([names[0]])
+        try:
+            masks = asp.prune_model(net, n=2, m=4)
+            assert len(masks) == 1
+        finally:
+            asp.reset_excluded_layers()
+
+
+class TestRegularizerHub:
+    def test_l1_l2_decay_grad_contribution(self):
+        from paddle_tpu.regularizer import L1Decay, L2Decay
+
+        p = paddle.to_tensor(np.asarray([[1., -2.], [0.5, 0.]], np.float32))
+        np.testing.assert_allclose(
+            np.asarray(L2Decay(0.1)(p)), 0.1 * p.numpy())
+        np.testing.assert_allclose(
+            np.asarray(L1Decay(0.1)(p)), 0.1 * np.sign(p.numpy()))
+
+    def test_optimizer_accepts_regularizer_objects(self):
+        from paddle_tpu.regularizer import L1Decay, L2Decay
+
+        for reg, expect in ((L2Decay(0.5), "l2"), (L1Decay(0.5), "l1")):
+            paddle.seed(1)
+            lin = paddle.nn.Linear(4, 4)
+            w0 = lin.weight.numpy().copy()
+            opt = paddle.optimizer.SGD(learning_rate=1.0,
+                                       parameters=lin.parameters(),
+                                       weight_decay=reg)
+            x = paddle.to_tensor(np.zeros((2, 4), np.float32))
+            loss = lin(x).sum()  # zero input: data grad of weight is 0
+            loss.backward()
+            opt.step()
+            decay = 0.5 * w0 if expect == "l2" else 0.5 * np.sign(w0)
+            np.testing.assert_allclose(lin.weight.numpy(), w0 - decay,
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_hub_local_roundtrip(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(
+            "dependencies = ['numpy']\n"
+            "def tiny_model(scale=2.0):\n"
+            "    '''A tiny test model.'''\n"
+            "    return ('model', scale)\n")
+        assert paddle.hub.list(str(tmp_path), source="local") == ["tiny_model"]
+        assert "tiny" in paddle.hub.help(str(tmp_path), "tiny_model",
+                                         source="local")
+        assert paddle.hub.load(str(tmp_path), "tiny_model", source="local",
+                               scale=3.0) == ("model", 3.0)
+        with pytest.raises(RuntimeError, match="egress"):
+            paddle.hub.list("user/repo", source="github")
+
+    def test_onnx_export_gate(self, tmp_path):
+        from paddle_tpu.static import InputSpec
+
+        paddle.seed(0)
+        lin = paddle.nn.Linear(4, 2)
+        with pytest.raises(RuntimeError, match="StableHLO"):
+            paddle.onnx.export(lin, str(tmp_path / "m.onnx"),
+                               input_spec=[InputSpec([2, 4], "float32")])
+        # the traced artifact was still produced (Predictor-loadable format)
+        assert any(p.name.startswith("m") for p in tmp_path.iterdir())
+
+    def test_l1_decay_matches_in_functional_path(self):
+        """The jitted _functional_update path (hapi/Engine) must apply the
+        SAME regularizer semantics as eager opt.step() — L1's sign decay,
+        not a silent L2 reinterpretation (round-4 review finding)."""
+        from paddle_tpu.regularizer import L1Decay
+
+        paddle.seed(2)
+        lin = paddle.nn.Linear(4, 4)
+        w0 = lin.weight.numpy().copy()
+        opt = paddle.optimizer.SGD(learning_rate=1.0,
+                                   parameters=lin.parameters(),
+                                   weight_decay=L1Decay(0.5))
+        params = [p for p in lin.parameters()]
+        grads = [jnp.zeros_like(p._data) for p in params]
+        values = [p._data for p in params]
+        new_vals, _ = opt._functional_update(grads, values, params, {}, 1.0, 1)
+        np.testing.assert_allclose(np.asarray(new_vals[0]),
+                                   w0 - 0.5 * np.sign(w0),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_param_attr_regularizer_overrides_optimizer(self):
+        """ParamAttr(regularizer=...) takes precedence over the
+        optimizer-level weight_decay (reference regularizer.py contract)."""
+        from paddle_tpu.regularizer import L1Decay, L2Decay
+
+        paddle.seed(3)
+        lin = paddle.nn.Linear(
+            4, 4, weight_attr=paddle.ParamAttr(regularizer=L1Decay(0.25)))
+        w0 = lin.weight.numpy().copy()
+        opt = paddle.optimizer.SGD(learning_rate=1.0,
+                                   parameters=lin.parameters(),
+                                   weight_decay=L2Decay(0.9))
+        x = paddle.to_tensor(np.zeros((2, 4), np.float32))
+        lin(x).sum().backward()
+        opt.step()
+        np.testing.assert_allclose(lin.weight.numpy(),
+                                   w0 - 0.25 * np.sign(w0),
+                                   rtol=1e-5, atol=1e-6)

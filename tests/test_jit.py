@@ -79,3 +79,103 @@ def test_jit_save_load(tmp_path):
     loaded = paddle.jit.load(path)
     x = paddle.to_tensor(np.random.rand(4, 3).astype(np.float32))
     np.testing.assert_allclose(loaded(x).numpy(), lin(x).numpy(), rtol=1e-6)
+
+
+class TestPartialGraph:
+    """SOT-style partial-graph compilation (jit/partial_graph.py): on a
+    data-dependent `if`, the function's two halves run as separate compiled
+    subgraphs with an eager bridge at the condition (reference:
+    jit/sot/translate.py resumes compiled execution after a break)."""
+
+    def test_split_halves_are_jitted(self):
+        from paddle_tpu.jit.api import to_static
+
+        @to_static(full_graph=False)
+        def f(x):
+            y = x * 2.0
+            if (y.sum() > 0):
+                z = y + 1.0
+            else:
+                z = y - 1.0
+            return z * 3.0
+
+        xp = paddle.to_tensor(np.asarray([1., 2.], np.float32))
+        xn = paddle.to_tensor(np.asarray([-1., -2.], np.float32))
+        with pytest.warns(UserWarning, match="split into prefix/suffix"):
+            rp = f(xp)
+        rn = f(xn)
+        np.testing.assert_allclose(rp.numpy(), (np.asarray([1., 2.]) * 2 + 1) * 3)
+        np.testing.assert_allclose(rn.numpy(), (np.asarray([-1., -2.]) * 2 - 1) * 3)
+        plan = f._split_plan
+        assert plan is not None and not f._fallback_eager
+        # the halves genuinely compiled (jit cache entries exist)
+        assert plan._prefix._fwd_cache and plan._true._fwd_cache \
+            and plan._false._fwd_cache
+
+    def test_second_break_splits_again(self):
+        from paddle_tpu.jit.api import to_static
+
+        @to_static(full_graph=False)
+        def g(x):
+            y = x + 1.0
+            if (y.sum() > 0):
+                w = y * 2.0
+            else:
+                w = y * 4.0
+            if (w.mean() > 10.0):
+                out = w - 100.0
+            else:
+                out = w + 100.0
+            return out
+
+        def ref(a):
+            y = a + 1.0
+            w = y * 2.0 if y.sum() > 0 else y * 4.0
+            return w - 100.0 if w.mean() > 10.0 else w + 100.0
+
+        for arr in ([20., 20.], [1., 1.], [-9., -9.]):
+            a = np.asarray(arr, np.float32)
+            np.testing.assert_allclose(
+                g(paddle.to_tensor(a)).numpy(), ref(a), rtol=1e-6)
+        # the true-branch suffix hit the SECOND if and split recursively
+        assert g._split_plan is not None
+        assert g._split_plan._true._split_plan is not None
+
+    def test_unsplittable_break_falls_back_eager(self):
+        from paddle_tpu.jit.api import to_static
+
+        @to_static(full_graph=False)
+        def h(x):
+            n = 0
+            while (x.sum() > 0):   # while-on-tensor: not an if split
+                x = x - 1.0
+                n += 1
+            return x
+
+        with pytest.warns(UserWarning, match="falling back to eager"):
+            out = h(paddle.to_tensor(np.asarray([2.5], np.float32)))
+        np.testing.assert_allclose(out.numpy(), [-0.5])
+        assert h._fallback_eager
+
+    def test_split_with_reassigned_argument(self):
+        """A parameter reassigned before the break must flow through the
+        prefix's outputs, not the caller's original value (round-4 review
+        finding), and += inside a branch must count as a read."""
+        from paddle_tpu.jit.api import to_static
+
+        @to_static(full_graph=False)
+        def k(x):
+            x = x * 2.0
+            s = x * 0.0
+            if (x.sum() > 0):
+                s += x + 1.0
+            else:
+                s += x - 1.0
+            return s
+
+        a = np.asarray([1., 2.], np.float32)
+        out = k(paddle.to_tensor(a))
+        np.testing.assert_allclose(out.numpy(), a * 2 + 1)
+        b = np.asarray([-1., -2.], np.float32)
+        np.testing.assert_allclose(k(paddle.to_tensor(b)).numpy(), b * 2 - 1)
+        assert k._split_plan is not None and not k._fallback_eager

@@ -40,6 +40,8 @@ def live_surfaces():
         "paddle.optimizer": names(paddle.optimizer),
         "paddle.distributed": names(paddle.distributed),
         "paddle.incubate.nn.functional": names(paddle.incubate.nn.functional),
+        "paddle.geometric": names(paddle.geometric),
+        "paddle.incubate.asp": names(paddle.incubate.asp),
     }
 
 

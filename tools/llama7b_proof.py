@@ -127,15 +127,21 @@ def lower_7b(best, fast_init=True):
               f" mesh {dict(mesh.shape)}, explicit collectives {counts}")
         return lowered
 
-    lowered = lower_with(make_mesh(dict(best.axes)),
-                         n_micro=best.n_micro if best.axes["pp"] > 1 else None)
-    # ALSO prove the full 4-axis hybrid machinery at 7B shapes: dp x fsdp x
-    # tp x pp with microbatched pipeline (the reference's 3D-hybrid shape,
-    # semi_auto_llama.py) — re-stacks decoder weights [32, ...] over pp
-    print("\nhybrid dp2xfsdp2xtp2xpp2 (n_micro=4) lowering:")
-    lower_with(make_mesh({"dp": 2, "fsdp": 2, "sep": 1, "tp": 2, "pp": 2}),
-               n_micro=4)
-    return lowered
+    if "--hybrid" in sys.argv:
+        # the full hybrid machinery at 7B shapes: fsdp x tp x pp with
+        # microbatched pipeline (the reference's 3D-hybrid shape,
+        # semi_auto_llama.py). Separate process from the tuner-selected
+        # lowering (each 7B trace holds tens of GB of host RAM), and 8
+        # virtual devices, not 16 — resharding 7B arrays across 16
+        # single-core CPU "devices" trips XLA's 40s collective-rendezvous
+        # timeout; dp is the trivial batch axis and is already proven by
+        # the dp8xfsdp2 lowering above.
+        print("\nhybrid fsdp2xtp2xpp2 (n_micro=4) lowering:")
+        return lower_with(
+            make_mesh({"dp": 1, "fsdp": 2, "sep": 1, "tp": 2, "pp": 2}),
+            n_micro=4)
+    return lower_with(make_mesh(dict(best.axes)),
+                      n_micro=best.n_micro if best.axes["pp"] > 1 else None)
 
 
 if __name__ == "__main__":
