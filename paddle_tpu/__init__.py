@@ -39,6 +39,7 @@ from .tensor import einsum  # noqa: F401
 from . import amp  # noqa: F401,E402
 from . import audio  # noqa: F401,E402
 from . import autograd  # noqa: F401,E402
+from . import dataset  # noqa: F401,E402
 from . import device  # noqa: F401,E402
 from . import distributed  # noqa: F401,E402
 from . import distribution  # noqa: F401,E402

@@ -161,11 +161,18 @@ def apply_fn(name: str, fn: Callable, *args, _opdef: Optional[OpDef] = None, **k
         ]
         diff_arrays = [args[i]._data for i in diff_idx]
 
+        # SNAPSHOT non-diff tensor inputs now: the deferred backward (and
+        # create_graph's _taped_vjp) replays `pure` later, and an in-place
+        # mutation of an index/mask Tensor in between must not change what
+        # the recorded op saw (Tensor._data rebinds on set_value/copy_)
+        nondiff_snap = {i: args[i]._data for i in tensor_idx
+                        if i not in diff_idx}
+
         def pure(*darrs):
             full = list(args)
             it = iter(darrs)
             for i in tensor_idx:
-                full[i] = next(it) if i in diff_idx else args[i]._data
+                full[i] = next(it) if i in diff_idx else nondiff_snap[i]
             return fn(*full, **kwargs)
 
         # DEFERRED linearization: run the plain forward now (one XLA

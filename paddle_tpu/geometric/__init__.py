@@ -178,16 +178,11 @@ def send_ue_recv(x, y, src_index, dst_index, message_op="add",
 def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
     """Per-EDGE output op(x[src], y[dst]) with no reduction
     (send_recv.py:413 graph_send_uv)."""
-    if message_op not in ("add", "sub", "mul", "div"):
-        raise ValueError(
-            f"message_op must be add/sub/mul/div, got {message_op!r}")
     src = _arr(src_index).astype(jnp.int32)
     dst = _arr(dst_index).astype(jnp.int32)
     def impl(xd, yd):
-        xs = jnp.take(xd, src, axis=0)
-        yg = jnp.take(yd, dst, axis=0)
-        return {"add": xs + yg, "sub": xs - yg,
-                "mul": xs * yg, "div": xs / yg}[message_op]
+        return _edge_message(jnp.take(xd, src, axis=0),
+                             jnp.take(yd, dst, axis=0), message_op)
 
     if isinstance(x, Tensor) or isinstance(y, Tensor):
         x_t = x if isinstance(x, Tensor) else Tensor(_arr(x))
@@ -259,6 +254,8 @@ def _sample(row, colptr, input_nodes, sample_size, eids, return_eids,
     elif return_eids:
         raise ValueError("return_eids=True requires eids")
     out_n, out_c, out_e = [], [], []
+    w_all = _np(weights).reshape(-1).astype(np.float64) \
+        if weights is not None else None
     # reproducible under paddle.seed: the framework RNG stream seeds numpy
     from ..framework import random as frandom
 
@@ -268,10 +265,10 @@ def _sample(row, colptr, input_nodes, sample_size, eids, return_eids,
         deg = hi - lo
         idx = np.arange(lo, hi)
         if 0 <= sample_size < deg:
-            if weights is None:
+            if w_all is None:
                 idx = rng.choice(idx, size=sample_size, replace=False)
             else:
-                w = _np(weights).reshape(-1)[lo:hi].astype(np.float64)
+                w = w_all[lo:hi]
                 p = w / w.sum() if w.sum() > 0 else None
                 idx = rng.choice(idx, size=sample_size, replace=False, p=p)
             deg = sample_size

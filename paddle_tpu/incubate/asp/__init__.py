@@ -72,8 +72,11 @@ def check_mask_1d(mat, n: int, m: int) -> bool:
 
 def get_mask_1d(mat, n: int, m: int):
     """Keep the m-n largest |values| per 1 x m block — at least n zeros per
-    block (utils.py:192)."""
+    block (utils.py:192). 1-D input is treated as one row, matching
+    check_mask_1d."""
     mat = np.asarray(mat)
+    if mat.ndim == 1:
+        return get_mask_1d(mat.reshape(1, -1), n, m).reshape(-1)
     orig_cols = mat.shape[1]
     padded, pad = _pad_cols(mat, m)
     blocks = np.abs(padded.reshape(-1, m))

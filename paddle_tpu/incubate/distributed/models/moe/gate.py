@@ -55,20 +55,21 @@ def _load_balance_loss(probs, first_choice_mask):
     return probs.shape[-1] * jnp.sum(me * ce)
 
 
-def topk_dispatch(probs, k: int, capacity: int, renormalize: bool = True):
-    """Dense top-k routing with per-expert capacity.
+def topk_routing(probs, k: int, capacity: int, renormalize: bool = True):
+    """Sparse top-k routing with per-expert capacity — ONE source of truth
+    for the GShard semantics (topk_dispatch assembles its dense one-hots
+    from this, routed_ffn's scatter path consumes it directly).
 
-    probs: [tokens, E] softmax gate probabilities.
-    Returns (combine [tokens, E, C], dispatch_mask [tokens, E, C] bool, aux_loss).
-    Tokens overflowing an expert's capacity are dropped for that choice
-    (GShard semantics).
+    probs: [tokens, E]. Returns (expert_idx [n, k] int32, cap_pos [n, k]
+    int32, weight [n, k], keep [n, k] bool, aux_loss). Tokens overflowing an
+    expert's capacity get keep=False and weight 0 for that choice.
     """
     n, e = probs.shape
     remaining = probs
     prev_count = jnp.zeros((e,), jnp.int32)
-    combine = jnp.zeros((n, e, capacity), probs.dtype)
     gate_sum = jnp.zeros((n,), probs.dtype)
     first_mask = None
+    eidxs, cposs, gates, keeps = [], [], [], []
     for _ in range(k):
         idx = jnp.argmax(remaining, axis=-1)                    # [n]
         mask = jax.nn.one_hot(idx, e, dtype=probs.dtype)        # [n, e]
@@ -81,13 +82,34 @@ def topk_dispatch(probs, k: int, capacity: int, renormalize: bool = True):
         gate_j = jnp.sum(probs * mask, axis=-1)                 # [n]
         gate_sum = gate_sum + gate_j
         pos_tok = jnp.sum(pos * mask, axis=-1).astype(jnp.int32)  # [n]
-        onehot_c = jax.nn.one_hot(pos_tok, capacity, dtype=probs.dtype)  # [n, c]
-        combine = combine + gate_j[:, None, None] * mask[:, :, None] * onehot_c[:, None, :]
+        eidxs.append(idx.astype(jnp.int32))
+        cposs.append(pos_tok)
+        gates.append(gate_j)
+        keeps.append(jnp.sum(mask, axis=-1) > 0)
         remaining = remaining * (1.0 - jax.nn.one_hot(idx, e, dtype=probs.dtype))
+    w = jnp.stack(gates, axis=1)                                # [n, k]
     if renormalize and k > 1:
-        combine = combine / jnp.maximum(gate_sum, 1e-9)[:, None, None]
-    dispatch = combine > 0
+        w = w / jnp.maximum(gate_sum, 1e-9)[:, None]
     aux = _load_balance_loss(probs, first_mask)
+    return (jnp.stack(eidxs, axis=1), jnp.stack(cposs, axis=1), w,
+            jnp.stack(keeps, axis=1), aux)
+
+
+def topk_dispatch(probs, k: int, capacity: int, renormalize: bool = True):
+    """Dense top-k routing with per-expert capacity.
+
+    probs: [tokens, E] softmax gate probabilities.
+    Returns (combine [tokens, E, C], dispatch_mask [tokens, E, C] bool, aux_loss).
+    Tokens overflowing an expert's capacity are dropped for that choice
+    (GShard semantics). Dense assembly over :func:`topk_routing`.
+    """
+    n, e = probs.shape
+    eidx, cpos, w, keep, aux = topk_routing(probs, k, capacity, renormalize)
+    onehot_e = jax.nn.one_hot(eidx, e, dtype=probs.dtype)       # [n, k, E]
+    onehot_c = jax.nn.one_hot(cpos, capacity, dtype=probs.dtype)  # [n, k, C]
+    wk = w * keep.astype(probs.dtype)
+    combine = jnp.einsum("nk,nke,nkc->nec", wk, onehot_e, onehot_c)
+    dispatch = combine > 0
     return combine, dispatch, aux
 
 

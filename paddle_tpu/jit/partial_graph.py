@@ -155,6 +155,11 @@ def try_split(fn, lineno: Optional[int]) -> Optional[SplitPlan]:
     prefix_stmts = fdef.body[:idx]
     if_stmt = fdef.body[idx]
     rest = fdef.body[idx + 1:]
+    # an early `return` anywhere in the prefix (e.g. a static guard) would
+    # be swallowed by the synthesized live-tuple return — don't split
+    if any(isinstance(n, ast.Return)
+           for stmt in prefix_stmts for n in ast.walk(stmt)):
+        return None
 
     # live set: everything the suffix reads that exists at the break —
     # arguments INCLUDED (a reassigned parameter must flow through the

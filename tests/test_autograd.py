@@ -226,3 +226,18 @@ def test_rng_stream_reproducible_with_tape():
     np.testing.assert_array_equal(a1, a2)
     np.testing.assert_array_equal(b1, b2)
     assert (a1 != b1).any()  # distinct draws within one run
+
+
+def test_index_input_mutation_after_forward_does_not_corrupt_grad():
+    """Deferred-linearization replay must use the index values the forward
+    SAW, not post-mutation ones (round-4 review finding)."""
+    import paddle_tpu as paddle
+
+    x = paddle.to_tensor(np.asarray([1., 2., 3., 4.], np.float32),
+                         stop_gradient=False)
+    idx = paddle.to_tensor(np.asarray([0, 1], np.int64))
+    y = paddle.gather(x, idx)
+    # mutate the index tensor BETWEEN forward and backward
+    paddle.assign(paddle.to_tensor(np.asarray([2, 3], np.int64)), idx)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1., 1., 0., 0.])

@@ -291,3 +291,24 @@ class TestRegularizerHub:
         np.testing.assert_allclose(lin.weight.numpy(),
                                    w0 - 0.25 * np.sign(w0),
                                    rtol=1e-5, atol=1e-6)
+
+
+class TestLegacyDataset:
+    """Legacy reader-creator API (reference: python/paddle/dataset) over the
+    synthetic in-repo datasets."""
+
+    def test_uci_housing_readers(self):
+        xs = list(paddle.dataset.uci_housing.train()())
+        assert len(xs) == 404
+        x, y = xs[0]
+        assert x.shape == (13,) and y.shape == (1,)
+        assert len(paddle.dataset.uci_housing.feature_names) == 13
+        assert len(list(paddle.dataset.uci_housing.test()())) == 102
+
+    def test_mnist_cifar_readers(self):
+        img, lbl = next(paddle.dataset.mnist.train(8)())
+        assert img.shape == (784,) and isinstance(lbl, int)
+        assert -1.0 <= img.min() and img.max() <= 1.0
+        img, lbl = next(paddle.dataset.cifar.train10(8)())
+        assert img.shape == (3072,)
+        assert 0.0 <= img.min() and img.max() <= 1.0

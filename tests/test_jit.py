@@ -179,3 +179,51 @@ class TestPartialGraph:
         b = np.asarray([-1., -2.], np.float32)
         np.testing.assert_allclose(k(paddle.to_tensor(b)).numpy(), b * 2 - 1)
         assert k._split_plan is not None and not k._fallback_eager
+
+    def test_early_return_guard_is_not_corrupted(self):
+        """A static-guard `return` before the breaking if must NOT be
+        swallowed by a synthesized prefix (round-4 review finding): either
+        the split lands at/before the guard (branches carry the return
+        semantics correctly) or the function falls back eager — both paths
+        must give the original results for every input."""
+        from paddle_tpu.jit.api import to_static
+
+        @to_static(full_graph=False)
+        def m(x, flag):
+            if flag == 1:        # static python guard with early return
+                return x
+            y = x * 2.0
+            if (y.sum() > 0):
+                return y + 1.0
+            return y - 1.0
+
+        a = np.asarray([1., 2.], np.float32)
+        np.testing.assert_allclose(m(paddle.to_tensor(a), 0).numpy(),
+                                   a * 2 + 1)
+        np.testing.assert_allclose(m(paddle.to_tensor(a), 1).numpy(), a)
+        b = np.asarray([-1., -2.], np.float32)
+        np.testing.assert_allclose(m(paddle.to_tensor(b), 0).numpy(),
+                                   b * 2 - 1)
+
+    def test_try_split_rejects_return_in_prefix(self):
+        """try_split itself must refuse a prefix containing a Return (the
+        synthesized live-tuple return would swallow it)."""
+        import ast as _ast
+
+        from paddle_tpu.jit import partial_graph as pg
+
+        src = (
+            "def q(x):\n"
+            "    if x is None:\n"
+            "        return 0\n"
+            "    y = x * 2.0\n"
+            "    if (y.sum() > 0):\n"
+            "        return y\n"
+            "    return -y\n")
+        ns = {}
+        exec(compile(src, "<pgtest>", "exec"), ns)
+        import linecache
+        linecache.cache["<pgtest>"] = (len(src), None,
+                                       src.splitlines(True), "<pgtest>")
+        # lineno 5 = the tensor if; prefix contains the early-return guard
+        assert pg.try_split(ns["q"], 5) is None
