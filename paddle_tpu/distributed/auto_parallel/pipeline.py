@@ -195,7 +195,8 @@ def interleaved_schedule(stage_fn: Callable, n_stages: int, interleave: int,
 
 
 def zb_schedule(layer_fn, n_stages: int, interleave: int, lc: int,
-                axis_name: str = "pp", bargs=(), remat: bool = False):
+                axis_name: str = "pp", bargs=(), remat: bool = False,
+                with_aux: bool = False):
     """Zero-bubble (ZBH1-class) W/B-split schedule, run INSIDE shard_map.
 
     Parity anchor: the reference's zero-bubble pipeline passes
@@ -241,13 +242,14 @@ def zb_schedule(layer_fn, n_stages: int, interleave: int, lc: int,
     Gradient equality vs sequential is exact in both regimes
     (tests/test_pipeline.py).
 
-    ``layer_fn(per_layer_params, h, *bargs)`` runs ONE block; local params
-    carry a leading [v*lc] dim, chunk c covers rows [c*lc, (c+1)*lc). MoE aux
-    side-outputs are not supported (use VPP for MoE+pp). ``bargs`` are CLOSED
-    OVER by the custom_vjp (not passed as differentiable arguments): rope
-    tables etc. work unchanged, while differentiating w.r.t. a broadcast arg
-    raises JAX's closed-over-tracer error at trace time instead of silently
-    producing zero gradients.
+    ``layer_fn(per_layer_params, h, *bargs)`` runs ONE block (``-> (y,
+    aux_scalar)`` when ``with_aux`` — MoE gate losses: the aux sum over
+    active ticks is a second differentiable output, and its cotangent enters
+    every layer pullback in both the B scan and the W drain). ``bargs`` are
+    CLOSED OVER by the custom_vjp (not passed as differentiable arguments):
+    rope tables etc. work unchanged, while differentiating w.r.t. a
+    broadcast arg raises JAX's closed-over-tracer error at trace time
+    instead of silently producing zero gradients.
     """
     p, v = n_stages, interleave
     vp = v * p
@@ -258,6 +260,17 @@ def zb_schedule(layer_fn, n_stages: int, interleave: int, lc: int,
         # chunk c's [lc, ...] slice of each local [v*lc, ...] param stack
         return [jax.lax.dynamic_slice_in_dim(w, c * lc, lc, 0)
                 for w in params]
+
+    def _fn(wl, h, *b):
+        # with_aux: normalize the aux scalar to f32 INSIDE the traced fn so
+        # every pullback's aux cotangent is f32 regardless of the block's
+        # compute dtype (a bf16 gate under AMP would otherwise reject the
+        # f32 g_aux at trace time on zb only)
+        res = layer_fn(wl, h, *b)
+        if with_aux:
+            y, aux = res
+            return y, jnp.asarray(aux, jnp.float32)
+        return res
 
     def _meta(t, d, M):
         cyc = jnp.mod(t - d, vp)
@@ -278,7 +291,7 @@ def zb_schedule(layer_fn, n_stages: int, interleave: int, lc: int,
         T = v * M + p - 1
 
         def ftick(carry, t):
-            buf, outs = carry
+            buf, outs, aux_acc = carry
             c, mb, active, inj_here, inj_idx, is_out = _meta(t, d, M)
             inj = jax.lax.dynamic_index_in_dim(micro_in, inj_idx, 0,
                                                keepdims=False)
@@ -287,29 +300,42 @@ def zb_schedule(layer_fn, n_stages: int, interleave: int, lc: int,
 
             if remat:
                 # memory-bounded: stack each layer's INPUT activation only
-                def layer_step(hh, wl):
-                    return layer_fn(wl, hh, *bargs), hh
+                def layer_step(carry_l, wl):
+                    hh, asum = carry_l
+                    res = _fn(wl, hh, *bargs)
+                    y, auxl = res if with_aux else (res, 0.0)
+                    return (y, asum + auxl), hh
             else:
                 # ZB-∞: stack the full per-layer pullback (vjp closures are
                 # pytrees, so lax.scan stacks their residuals)
-                def layer_step(hh, wl):
-                    yl, pb = jax.vjp(
-                        lambda w_, h_: layer_fn(w_, h_, *bargs), wl, hh)
-                    return yl, pb
+                def layer_step(carry_l, wl):
+                    hh, asum = carry_l
+                    res, pb = jax.vjp(
+                        lambda w_, h_: _fn(w_, h_, *bargs), wl, hh)
+                    y, auxl = res if with_aux else (res, 0.0)
+                    return (y, asum + auxl), pb
 
             with _ManualCtx():
-                y, pbs_t = jax.lax.scan(layer_step, h, wls)
+                (y, tick_aux), pbs_t = jax.lax.scan(
+                    layer_step, (h, jnp.zeros((), jnp.float32)), wls)
+            if with_aux:
+                aux_acc = aux_acc + jnp.where(active, tick_aux, 0.0)
             prev = jax.lax.dynamic_index_in_dim(outs, mb, 0, keepdims=False)
             outs = jax.lax.dynamic_update_index_in_dim(
                 outs, jnp.where(is_out, y, prev), mb, 0)
             nxt = jax.lax.ppermute(y, axis_name, perm_f)
-            return (nxt, outs), pbs_t
+            return (nxt, outs, aux_acc), pbs_t
 
         buf0 = jnp.zeros(micro_in.shape[1:], micro_in.dtype)
         outs0 = jnp.zeros(micro_in.shape, micro_in.dtype)
-        (_, outs), pbs = jax.lax.scan(ftick, (buf0, outs0), jnp.arange(T))
+        aux0 = jnp.zeros((), jnp.float32)
+        (_, outs, aux_acc), pbs = jax.lax.scan(
+            ftick, (buf0, outs0, aux0), jnp.arange(T))
         outs = jnp.where(d == p - 1, outs, jnp.zeros_like(outs))
-        return jax.lax.psum(outs, axis_name), pbs
+        outs = jax.lax.psum(outs, axis_name)
+        if with_aux:
+            return (outs, jax.lax.psum(aux_acc, axis_name)), pbs
+        return outs, pbs
 
     @jax.custom_vjp
     def pipeline(params, micro_in):
@@ -325,6 +351,11 @@ def zb_schedule(layer_fn, n_stages: int, interleave: int, lc: int,
 
     def pipeline_bwd(res, g):
         pbs, params, bargs_r = res
+        if with_aux:
+            g, g_aux = g
+            g_aux = jax.lax.psum(jnp.asarray(g_aux, jnp.float32), axis_name)
+        else:
+            g_aux = None
         # mirror the transpose of the fwd's final psum: shard_map delivers a
         # replicated (P()) output's cotangent split 1/p per device; psumming
         # reconstitutes the full cotangent on every device (exactly what
@@ -343,6 +374,12 @@ def zb_schedule(layer_fn, n_stages: int, interleave: int, lc: int,
             g_m = jax.lax.dynamic_index_in_dim(g, mb, 0, keepdims=False)
             dy = jnp.where(is_out, g_m.astype(gbuf.dtype), gbuf)
             dy = jnp.where(active, dy, jnp.zeros_like(dy))
+            # aux cotangent: the SAME scalar reaches every active tick's
+            # layers (inactive ticks' aux was masked out of the fwd sum)
+            daux = (jnp.where(active, g_aux, 0.0) if with_aux else None)
+
+            def _cot(dh):
+                return (dh, daux) if with_aux else dh
 
             if remat:
                 # recompute the layer fwd from its saved INPUT, differentiate
@@ -353,15 +390,15 @@ def zb_schedule(layer_fn, n_stages: int, interleave: int, lc: int,
                 def layer_bwd(dh, xs_l):
                     hl, wl = xs_l
                     _, pb = jax.vjp(
-                        lambda h_: layer_fn(wl, h_, *bargs_r), hl)
-                    (dh2,) = pb(dh)
+                        lambda h_: _fn(wl, h_, *bargs_r), hl)
+                    (dh2,) = pb(_cot(dh))
                     return dh2, dh
 
                 bxs = (pbs_t, tuple(wls))
             else:
                 def layer_bwd(dh, pb):
                     # weight half of pb unused here -> DCE'd from the scan
-                    _dw_dead, dh2 = pb(dh)
+                    _dw_dead, dh2 = pb(_cot(dh))
                     return dh2, dh
 
                 bxs = pbs_t
@@ -411,15 +448,17 @@ def zb_schedule(layer_fn, n_stages: int, interleave: int, lc: int,
                 def layer_w(_, xs_l):
                     hl, dyl, wl = xs_l
                     _, pb = jax.vjp(
-                        lambda w_: layer_fn(w_, hl, *bargs_r), wl)
-                    (dwl,) = pb(dyl)
+                        lambda w_: _fn(w_, hl, *bargs_r), wl)
+                    # wtick iterates only ACTIVE pairs -> aux cot = g_aux
+                    (dwl,) = pb((dyl, g_aux) if with_aux else dyl)
                     return None, dwl
 
                 wxs = (pbs_t, dys_t, tuple(wls))
             else:
                 def layer_w(_, xs_l):
                     pb, dyl = xs_l
-                    dwl, _dh_dead = pb(dyl)  # activation half unused -> DCE'd
+                    # activation half unused -> DCE'd
+                    dwl, _dh_dead = pb((dyl, g_aux) if with_aux else dyl)
                     return None, dwl
 
                 wxs = (pbs_t, dys_t)
@@ -485,7 +524,7 @@ def pipeline_call(
         ``remat=True`` selects its memory-bounded boundary-storage regime,
         ``remat=False`` the ZB-∞ residual-saving regime; ``broadcast_args``
         are non-differentiable (a grad w.r.t. one raises at trace time);
-        no ``with_aux``).
+        ``with_aux`` is supported — MoE gate losses ride the zb schedule).
 
     Returns global activations with the same shape as ``x`` (plus the aux sum
     over all layers and microbatches when ``with_aux``).
@@ -493,11 +532,6 @@ def pipeline_call(
     n_stages = mesh.shape[axis_name]
     if schedule not in ("auto", "zb"):
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
-    if schedule == "zb":
-        if with_aux:
-            raise NotImplementedError(
-                "zero-bubble schedule does not support MoE aux side-outputs "
-                "— use the interleaved (VPP) schedule for MoE+pp")
     # zb handles remat via its own boundary-storage regime (see zb_schedule);
     # jax.checkpoint wrapping applies to the grad-of-scan schedules only.
     # policy=None is jax.checkpoint's default (plain full remat)
@@ -561,7 +595,7 @@ def pipeline_call(
             # bargs are closed over by the zb custom_vjp: differentiating
             # w.r.t. them raises at trace time (vs. silent zero cotangents)
             zb = zb_schedule(blk, n_stages, interleave, lc, axis_name,
-                             bargs=bargs, remat=remat)
+                             bargs=bargs, remat=remat, with_aux=with_aux)
             return zb(params, micro_in)
     elif interleave > 1:
         pipeline = interleaved_schedule(
