@@ -1,0 +1,153 @@
+// capi_runner — C-ABI shared library over the StableHLO artifact interpreter.
+//
+// Parity anchor: the reference ships R and Go inference clients
+// (/root/reference/r/README.md, goapi) over a C API into its C++ predictor
+// (paddle/fluid/inference/capi_exp/pd_inference_api.h). The TPU-native
+// equivalent: jit.save emits a StableHLO module; THIS library exposes it to
+// any FFI-capable language (C, Go via cgo, Rust via bindgen, R via .Call,
+// Python ctypes) with a dozen plain-C entry points and no Python, JAX, or
+// framework dependency in the process.
+//
+// Build:  g++ -O2 -std=c++17 -shared -fPIC -o libpaddle_tpu_infer.so capi_runner.cc
+//
+// Contract (all functions thread-compatible per handle, not thread-safe on
+// one handle):
+//   ptpu_load(path, err, errlen)          -> handle or NULL (err filled)
+//   ptpu_num_inputs / ptpu_num_outputs(h) -> counts (outputs known at load)
+//   ptpu_input_rank / ptpu_input_shape / ptpu_input_numel(h, i)
+//   ptpu_run(h, inputs[], err, errlen)    -> 0 ok / -1 error; inputs are
+//       caller-owned f32 buffers matching the signature order and sizes
+//   ptpu_output_numel(h, k)               -> element count of output k
+//   ptpu_get_output(h, k, buf)            -> copy output k into caller buf
+//   ptpu_free(h)
+
+#include <memory>
+
+#include "stablehlo_interp.h"
+
+namespace {
+
+struct Handle {
+  shlo::Program program;
+  std::vector<std::string> rets;
+  std::vector<shlo::Tensor> outputs;
+  // persistent per-run environment: input tensors are allocated once and
+  // overwritten in place each run (no per-call map rebuild / realloc); a
+  // caller that knows its leading inputs are frozen weights can also skip
+  // re-uploading them via ptpu_run_partial's `first_input`
+  std::map<std::string, shlo::Tensor> env;
+  bool env_ready = false;
+};
+
+void set_err(char* err, int errlen, const std::string& msg) {
+  if (err && errlen > 0) {
+    std::snprintf(err, (size_t)errlen, "%s", msg.c_str());
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ptpu_load(const char* mlir_path, char* err, int errlen) {
+  try {
+    auto h = std::make_unique<Handle>();
+    h->program = shlo::parse(shlo::slurp(mlir_path));
+    h->rets = shlo::parse_operands(h->program.ret_line);
+    return h.release();
+  } catch (const std::exception& e) {
+    set_err(err, errlen, e.what());
+    return nullptr;
+  }
+}
+
+int ptpu_num_inputs(const void* h) {
+  return (int)static_cast<const Handle*>(h)->program.args.size();
+}
+
+int ptpu_num_outputs(const void* h) {
+  return (int)static_cast<const Handle*>(h)->rets.size();
+}
+
+int ptpu_input_rank(const void* h, int i) {
+  return (int)static_cast<const Handle*>(h)->program.args[(size_t)i].second.size();
+}
+
+void ptpu_input_shape(const void* h, int i, long long* dims) {
+  const auto& s = static_cast<const Handle*>(h)->program.args[(size_t)i].second;
+  for (size_t d = 0; d < s.size(); ++d) dims[d] = (long long)s[d];
+}
+
+long long ptpu_input_numel(const void* h, int i) {
+  const auto& s = static_cast<const Handle*>(h)->program.args[(size_t)i].second;
+  long long n = 1;
+  for (long long d : s) n *= d;
+  return n;
+}
+
+static int run_impl(Handle* h, const float* const* inputs, int first_input,
+                    char* err, int errlen) {
+  try {
+    if (!h->env_ready) {
+      for (const auto& arg : h->program.args) {
+        shlo::Tensor t;
+        t.shape = arg.second;
+        t.data.assign((size_t)t.numel(), 0.f);
+        h->env[arg.first] = std::move(t);
+      }
+      h->env_ready = true;
+      if (first_input > 0) {
+        set_err(err, errlen, "first run must upload all inputs");
+        return -1;
+      }
+    }
+    // overwrite in place from first_input on (weights uploaded once can be
+    // skipped on later runs); intermediate values from the previous run are
+    // recomputed by shlo::run, inputs persist
+    for (size_t i = (size_t)first_input; i < h->program.args.size(); ++i) {
+      shlo::Tensor& t = h->env[h->program.args[i].first];
+      std::memcpy(t.data.data(), inputs[i - (size_t)first_input],
+                  t.data.size() * sizeof(float));
+    }
+    shlo::run(h->program, h->env);
+    h->outputs.clear();
+    for (const auto& name : h->rets) h->outputs.push_back(h->env.at(name));
+    return 0;
+  } catch (const std::exception& e) {
+    set_err(err, errlen, e.what());
+    return -1;
+  }
+}
+
+int ptpu_run(void* hp, const float* const* inputs, char* err, int errlen) {
+  return run_impl(static_cast<Handle*>(hp), inputs, 0, err, errlen);
+}
+
+// Re-run uploading only inputs [first_input:] (earlier ones — typically the
+// frozen weight tensors — keep their previously uploaded values).
+int ptpu_run_partial(void* hp, const float* const* inputs, int first_input,
+                     char* err, int errlen) {
+  return run_impl(static_cast<Handle*>(hp), inputs, first_input, err, errlen);
+}
+
+long long ptpu_output_numel(const void* h, int k) {
+  return static_cast<const Handle*>(h)->outputs[(size_t)k].numel();
+}
+
+int ptpu_output_rank(const void* h, int k) {
+  return (int)static_cast<const Handle*>(h)->outputs[(size_t)k].shape.size();
+}
+
+void ptpu_output_shape(const void* h, int k, long long* dims) {
+  const auto& s = static_cast<const Handle*>(h)->outputs[(size_t)k].shape;
+  for (size_t d = 0; d < s.size(); ++d) dims[d] = (long long)s[d];
+}
+
+void ptpu_get_output(const void* h, int k, float* buf) {
+  const auto& t = static_cast<const Handle*>(h)->outputs[(size_t)k];
+  std::memcpy(buf, t.data.data(), t.data.size() * sizeof(float));
+}
+
+void ptpu_free(void* h) { delete static_cast<Handle*>(h); }
+
+}  // extern "C"

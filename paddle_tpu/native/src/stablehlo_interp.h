@@ -1,0 +1,349 @@
+// stablehlo_interp.h — the restricted StableHLO text interpreter shared by
+// the standalone stablehlo_runner binary and the C-ABI library
+// (capi_runner.cc). See stablehlo_runner.cc for the op-coverage contract.
+// Errors throw std::runtime_error (the binary catches and exits; the C API
+// catches and returns an error string).
+#pragma once
+
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <functional>
+#include <map>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace shlo {
+
+struct Tensor {
+  std::vector<int64_t> shape;
+  std::vector<float> data;
+  int64_t numel() const {
+    int64_t n = 1;
+    for (int64_t d : shape) n *= d;
+    return n;
+  }
+};
+
+[[noreturn]] inline void fail(const std::string& msg) {
+  throw std::runtime_error(msg);
+}
+
+// ---- tiny text utilities -------------------------------------------------
+
+inline std::string slurp(const std::string& path) {
+  std::ifstream f(path);
+  if (!f) fail("cannot open " + path);
+  std::stringstream ss;
+  ss << f.rdbuf();
+  return ss.str();
+}
+
+// parse "tensor<2x8xf32>" (or "tensor<f32>" scalar) starting at s[pos]=='t'
+inline std::vector<int64_t> parse_tensor_type(const std::string& s, size_t pos) {
+  size_t lt = s.find('<', pos), gt = s.find('>', pos);
+  if (lt == std::string::npos || gt == std::string::npos) fail("bad tensor type");
+  std::string inner = s.substr(lt + 1, gt - lt - 1);
+  if (inner.find("f32") == std::string::npos)
+    fail("only f32 tensors supported, got tensor<" + inner + ">");
+  std::vector<int64_t> shape;
+  size_t p = 0;
+  while (p < inner.size()) {
+    size_t x = inner.find('x', p);
+    std::string tok = inner.substr(p, x == std::string::npos ? x : x - p);
+    if (tok == "f32") break;
+    shape.push_back(std::stoll(tok));
+    if (x == std::string::npos) break;
+    p = x + 1;
+  }
+  return shape;
+}
+
+// parse "[1, 0]" integer list at s[pos]=='['
+inline std::vector<int64_t> parse_int_list(const std::string& s, size_t pos) {
+  size_t rb = s.find(']', pos);
+  std::string inner = s.substr(pos + 1, rb - pos - 1);
+  std::vector<int64_t> out;
+  std::stringstream ss(inner);
+  std::string tok;
+  while (std::getline(ss, tok, ',')) {
+    if (!tok.empty()) out.push_back(std::stoll(tok));
+  }
+  return out;
+}
+
+inline std::string strip(const std::string& s) {
+  size_t a = s.find_first_not_of(" \t\r\n");
+  size_t b = s.find_last_not_of(" \t\r\n");
+  return a == std::string::npos ? "" : s.substr(a, b - a + 1);
+}
+
+// operand list "%4, %arg2" -> names, stopping at an attribute or " : "
+inline std::vector<std::string> parse_operands(const std::string& s) {
+  std::vector<std::string> out;
+  size_t p = 0;
+  while ((p = s.find('%', p)) != std::string::npos) {
+    size_t e = p + 1;
+    while (e < s.size() && (std::isalnum(s[e]) || s[e] == '_')) e++;
+    out.push_back(s.substr(p, e - p));
+    // stop scanning once the type section starts
+    size_t colon = s.find(" : ");
+    p = e;
+    if (colon != std::string::npos && p > colon) break;
+  }
+  return out;
+}
+
+// ---- op implementations --------------------------------------------------
+
+inline std::vector<int64_t> strides_of(const std::vector<int64_t>& shape) {
+  std::vector<int64_t> st(shape.size(), 1);
+  for (int i = (int)shape.size() - 2; i >= 0; --i)
+    st[i] = st[i + 1] * shape[i + 1];
+  return st;
+}
+
+inline Tensor broadcast_in_dim(const Tensor& x, const std::vector<int64_t>& dims,
+                        const std::vector<int64_t>& out_shape) {
+  Tensor out{out_shape, std::vector<float>((size_t)1, 0.f)};
+  out.data.assign((size_t)out.numel(), 0.f);
+  auto ost = strides_of(out_shape);
+  auto xst = strides_of(x.shape);
+  std::vector<int64_t> idx(out_shape.size(), 0);
+  for (int64_t lin = 0; lin < out.numel(); ++lin) {
+    int64_t rem = lin;
+    for (size_t d = 0; d < out_shape.size(); ++d) {
+      idx[d] = rem / ost[d];
+      rem %= ost[d];
+    }
+    int64_t xi = 0;
+    for (size_t j = 0; j < dims.size(); ++j)
+      xi += (x.shape[j] == 1 ? 0 : idx[(size_t)dims[j]]) * xst[j];
+    out.data[(size_t)lin] = x.data[(size_t)xi];
+  }
+  return out;
+}
+
+inline Tensor transpose(const Tensor& x, const std::vector<int64_t>& perm) {
+  std::vector<int64_t> out_shape(perm.size());
+  for (size_t i = 0; i < perm.size(); ++i) out_shape[i] = x.shape[(size_t)perm[i]];
+  Tensor out{out_shape, std::vector<float>((size_t)x.numel())};
+  auto xst = strides_of(x.shape);
+  auto ost = strides_of(out_shape);
+  std::vector<int64_t> idx(perm.size(), 0);
+  for (int64_t lin = 0; lin < x.numel(); ++lin) {
+    int64_t rem = lin;
+    int64_t xi = 0;
+    for (size_t d = 0; d < out_shape.size(); ++d) {
+      idx[d] = rem / ost[d];
+      rem %= ost[d];
+      xi += idx[d] * xst[(size_t)perm[d]];
+    }
+    out.data[(size_t)lin] = x.data[(size_t)xi];
+  }
+  return out;
+}
+
+// general dot_general: reorder both sides to [B, M, K] / [B, K, N]
+inline Tensor dot_general(const Tensor& lhs, const Tensor& rhs,
+                   std::vector<int64_t> lb, std::vector<int64_t> rb,
+                   std::vector<int64_t> lc, std::vector<int64_t> rc) {
+  auto free_dims = [](const Tensor& t, const std::vector<int64_t>& b,
+                      const std::vector<int64_t>& c) {
+    std::vector<int64_t> f;
+    for (int64_t d = 0; d < (int64_t)t.shape.size(); ++d) {
+      bool used = false;
+      for (int64_t x : b) used |= (x == d);
+      for (int64_t x : c) used |= (x == d);
+      if (!used) f.push_back(d);
+    }
+    return f;
+  };
+  auto lf = free_dims(lhs, lb, lc), rf = free_dims(rhs, rb, rc);
+  auto pack = [](const Tensor& t, std::vector<int64_t> order) {
+    return transpose(t, order);
+  };
+  std::vector<int64_t> lorder(lb), rorder(rb);
+  lorder.insert(lorder.end(), lf.begin(), lf.end());
+  lorder.insert(lorder.end(), lc.begin(), lc.end());
+  rorder.insert(rorder.end(), rc.begin(), rc.end());
+  rorder.insert(rorder.end(), rf.begin(), rf.end());
+  Tensor L = pack(lhs, lorder);   // [batch..., M..., K...]
+  Tensor R = pack(rhs, rorder);   // [batch..., K..., N...]
+  int64_t B = 1, M = 1, K = 1, N = 1;
+  for (size_t i = 0; i < lb.size(); ++i) B *= lhs.shape[(size_t)lb[i]];
+  for (int64_t d : lf) M *= lhs.shape[(size_t)d];
+  for (int64_t d : lc) K *= lhs.shape[(size_t)d];
+  for (int64_t d : rf) N *= rhs.shape[(size_t)d];
+  std::vector<int64_t> out_shape;
+  for (int64_t d : lb) out_shape.push_back(lhs.shape[(size_t)d]);
+  for (int64_t d : lf) out_shape.push_back(lhs.shape[(size_t)d]);
+  for (int64_t d : rf) out_shape.push_back(rhs.shape[(size_t)d]);
+  if (out_shape.empty()) out_shape.push_back(1);  // scalar-ish
+  Tensor out{out_shape, std::vector<float>((size_t)(B * M * N), 0.f)};
+  for (int64_t b = 0; b < B; ++b)
+    for (int64_t m = 0; m < M; ++m)
+      for (int64_t k = 0; k < K; ++k) {
+        float lv = L.data[(size_t)((b * M + m) * K + k)];
+        if (lv == 0.f) continue;
+        const float* rrow = &R.data[(size_t)((b * K + k) * N)];
+        float* orow = &out.data[(size_t)((b * M + m) * N)];
+        for (int64_t n = 0; n < N; ++n) orow[(size_t)n] += lv * rrow[(size_t)n];
+      }
+  if (out.shape.size() == 1 && out.shape[0] == 1 && lb.empty() && lf.empty() &&
+      rf.empty())
+    out.shape.clear();
+  return out;
+}
+
+// ---- interpreter ---------------------------------------------------------
+
+struct Program {
+  std::vector<std::pair<std::string, std::vector<int64_t>>> args;
+  std::vector<std::string> body;   // op lines, in order
+  std::string ret_line;
+};
+
+inline Program parse(const std::string& text) {
+  Program p;
+  size_t fpos = text.find("func.func public @main(");
+  if (fpos == std::string::npos) fpos = text.find("func.func @main(");
+  if (fpos == std::string::npos) fail("no @main function found");
+  // signature runs until the '{' that opens the body
+  size_t open = text.find('{', fpos);
+  std::string sig = text.substr(fpos, open - fpos);
+  size_t ap = 0;
+  while ((ap = sig.find("%arg", ap)) != std::string::npos) {
+    size_t e = ap + 4;
+    while (e < sig.size() && std::isdigit(sig[e])) e++;
+    std::string name = sig.substr(ap, e - ap);
+    size_t tpos = sig.find("tensor<", e);
+    if (tpos == std::string::npos) fail("arg without tensor type");
+    // only record each %argN once (result attrs can repeat names)
+    if (p.args.empty() || p.args.back().first != name)
+      p.args.emplace_back(name, parse_tensor_type(sig, tpos));
+    ap = e;
+  }
+  // body: lines up to the matching close of @main's block
+  size_t pos = open + 1;
+  std::stringstream ss(text.substr(pos));
+  std::string line;
+  while (std::getline(ss, line)) {
+    std::string t = strip(line);
+    if (t.rfind("return", 0) == 0 || t.rfind("func.return", 0) == 0) {
+      p.ret_line = t;
+      break;
+    }
+    if (t.find("= stablehlo.") != std::string::npos ||
+        t.find("= mhlo.") != std::string::npos)
+      p.body.push_back(t);
+  }
+  if (p.ret_line.empty()) fail("no return found in @main");
+  return p;
+}
+
+inline void run(const Program& p, std::map<std::string, Tensor>& env) {
+  auto ew1 = [&](const std::string& lhs, const Tensor& a,
+                 float (*f)(float)) {
+    Tensor out = a;
+    for (auto& v : out.data) v = f(v);
+    env[lhs] = std::move(out);
+  };
+  auto ew2 = [&](const std::string& lhs, const Tensor& a, const Tensor& b,
+                 const std::function<float(float, float)>& f) {
+    if (a.numel() != b.numel()) fail("elementwise shape mismatch");
+    Tensor out = a;
+    for (size_t i = 0; i < out.data.size(); ++i)
+      out.data[i] = f(a.data[i], b.data[i]);
+    env[lhs] = std::move(out);
+  };
+
+  for (const std::string& line : p.body) {
+    size_t eq = line.find(" = ");
+    std::string lhs = strip(line.substr(0, eq));
+    std::string rest = line.substr(eq + 3);
+    size_t dot = rest.find('.');
+    size_t sp = rest.find_first_of(" (", dot);
+    std::string op = rest.substr(dot + 1, sp - dot - 1);
+    std::string after = rest.substr(sp);
+    auto ops = parse_operands(after);
+    auto get = [&](size_t i) -> const Tensor& {
+      auto it = env.find(ops.at(i));
+      if (it == env.end()) fail("undefined value " + ops.at(i));
+      return it->second;
+    };
+
+    if (op == "add") ew2(lhs, get(0), get(1), [](float x, float y) { return x + y; });
+    else if (op == "subtract") ew2(lhs, get(0), get(1), [](float x, float y) { return x - y; });
+    else if (op == "multiply") ew2(lhs, get(0), get(1), [](float x, float y) { return x * y; });
+    else if (op == "divide") ew2(lhs, get(0), get(1), [](float x, float y) { return x / y; });
+    else if (op == "maximum") ew2(lhs, get(0), get(1), [](float x, float y) { return x > y ? x : y; });
+    else if (op == "minimum") ew2(lhs, get(0), get(1), [](float x, float y) { return x < y ? x : y; });
+    else if (op == "negate") ew1(lhs, get(0), [](float x) { return -x; });
+    else if (op == "tanh") ew1(lhs, get(0), [](float x) { return std::tanh(x); });
+    else if (op == "logistic") ew1(lhs, get(0), [](float x) { return 1.f / (1.f + std::exp(-x)); });
+    else if (op == "exponential") ew1(lhs, get(0), [](float x) { return std::exp(x); });
+    else if (op == "sqrt") ew1(lhs, get(0), [](float x) { return std::sqrt(x); });
+    else if (op == "rsqrt") ew1(lhs, get(0), [](float x) { return 1.f / std::sqrt(x); });
+    else if (op == "convert") {
+      env[lhs] = get(0);  // f32->f32 only (type gate in parse_tensor_type)
+    } else if (op == "reshape") {
+      size_t arrow = after.rfind("-> tensor<");
+      Tensor out = get(0);
+      out.shape = parse_tensor_type(after, arrow + 3);
+      env[lhs] = std::move(out);
+    } else if (op == "transpose") {
+      size_t dp = after.find("dims = [");
+      env[lhs] = transpose(get(0), parse_int_list(after, dp + 7));
+    } else if (op == "broadcast_in_dim") {
+      size_t dp = after.find("dims = [");
+      size_t arrow = after.rfind("-> tensor<");
+      env[lhs] = broadcast_in_dim(get(0), parse_int_list(after, dp + 7),
+                                  parse_tensor_type(after, arrow + 3));
+    } else if (op == "dot_general") {
+      std::vector<int64_t> lb, rb, lc, rc;
+      size_t bp = after.find("batching_dims = [");
+      if (bp != std::string::npos) {
+        lb = parse_int_list(after, after.find('[', bp));
+        size_t x = after.find(" x ", bp);
+        rb = parse_int_list(after, after.find('[', x));
+      }
+      size_t cp = after.find("contracting_dims = [");
+      if (cp != std::string::npos) {
+        lc = parse_int_list(after, after.find('[', cp));
+        size_t x = after.find(" x ", cp);
+        rc = parse_int_list(after, after.find('[', x));
+      }
+      env[lhs] = dot_general(get(0), get(1), lb, rb, lc, rc);
+    } else if (op == "constant") {
+      size_t dp = after.find("dense<");
+      size_t close = after.find("> :", dp);
+      std::string val = after.substr(dp + 6, close - dp - 6);
+      size_t tpos = after.find("tensor<", close);
+      Tensor out;
+      out.shape = parse_tensor_type(after, tpos);
+      int64_t n = out.numel();
+      out.data.reserve((size_t)n);
+      if (val.find('[') == std::string::npos) {
+        out.data.assign((size_t)n, std::stof(val));  // splat
+      } else {
+        for (char& c : val)
+          if (c == '[' || c == ']' || c == ',') c = ' ';
+        std::stringstream vs(val);
+        float f;
+        while (vs >> f) out.data.push_back(f);
+        if ((int64_t)out.data.size() != n) fail("constant element count mismatch");
+      }
+      env[lhs] = std::move(out);
+    } else {
+      fail("unsupported op stablehlo." + op +
+           " (restricted interpreter — extend the op table)");
+    }
+  }
+}
+
+}  // namespace shlo

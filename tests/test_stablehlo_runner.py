@@ -133,3 +133,99 @@ def test_cpp_runner_rejects_wrong_input_count(runner_bin, tmp_path):
                          capture_output=True, text=True)
     assert res.returncode != 0
     assert "expects" in res.stderr
+
+
+@pytest.fixture(scope="module")
+def capi_lib(tmp_path_factory):
+    if gxx is None:
+        pytest.skip("g++ not available")
+    src = os.path.join(REPO, "paddle_tpu", "native", "src", "capi_runner.cc")
+    out = tmp_path_factory.mktemp("lib") / "libpaddle_tpu_infer.so"
+    subprocess.run([gxx, "-O2", "-std=c++17", "-shared", "-fPIC",
+                    "-o", str(out), src], check=True)
+    return str(out)
+
+
+def test_capi_library_matches_python(capi_lib, tmp_path):
+    """C-ABI inference library (component #69: language bindings): load the
+    jit.save StableHLO artifact through plain C entry points via ctypes —
+    the same C surface Go/R/Rust would bind — and match the
+    Python model bit-for-bit in fp32."""
+    import ctypes
+
+    paddle.seed(3)
+    net = _Net()
+    x = np.random.default_rng(0).standard_normal((4, 8)).astype(np.float32)
+    ref = net(paddle.to_tensor(x)).numpy()
+
+    path = str(tmp_path / "net")
+    paddle.jit.save(net, path,
+                    input_spec=[paddle.static.InputSpec([4, 8], "float32")])
+
+    lib = ctypes.CDLL(capi_lib)
+    lib.ptpu_load.restype = ctypes.c_void_p
+    lib.ptpu_load.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
+    lib.ptpu_input_numel.restype = ctypes.c_longlong
+    lib.ptpu_input_numel.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.ptpu_num_inputs.argtypes = [ctypes.c_void_p]
+    lib.ptpu_num_outputs.argtypes = [ctypes.c_void_p]
+    lib.ptpu_run.argtypes = [ctypes.c_void_p,
+                             ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+                             ctypes.c_char_p, ctypes.c_int]
+    lib.ptpu_output_numel.restype = ctypes.c_longlong
+    lib.ptpu_output_numel.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.ptpu_get_output.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                    ctypes.POINTER(ctypes.c_float)]
+    lib.ptpu_free.argtypes = [ctypes.c_void_p]
+
+    err = ctypes.create_string_buffer(256)
+    h = lib.ptpu_load((path + ".mlir").encode(), err, 256)
+    assert h, err.value
+    # signature = state tensors (in _collect_state order) + the input
+    from paddle_tpu.jit.api import _collect_state
+
+    _, tensors = _collect_state(net)
+    n_in = lib.ptpu_num_inputs(h)
+    assert n_in == len(tensors) + 1
+    bufs = [np.ascontiguousarray(np.asarray(t.numpy(), np.float32)
+                                 .reshape(-1)) for t in tensors]
+    bufs.append(np.ascontiguousarray(x.reshape(-1)))
+    for i, b in enumerate(bufs):
+        assert lib.ptpu_input_numel(h, i) == b.size
+    arr_t = ctypes.POINTER(ctypes.c_float) * n_in
+    ins = arr_t(*[b.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+                  for b in bufs])
+    rc = lib.ptpu_run(h, ins, err, 256)
+    assert rc == 0, err.value
+    assert lib.ptpu_num_outputs(h) == 1
+    n = lib.ptpu_output_numel(h, 0)
+    out = np.zeros(n, np.float32)
+    lib.ptpu_get_output(h, 0, out.ctypes.data_as(
+        ctypes.POINTER(ctypes.c_float)))
+    np.testing.assert_allclose(out.reshape(ref.shape), ref,
+                               rtol=1e-5, atol=1e-6)
+
+    # run_partial: re-run uploading only the activation input (weights
+    # persist from the first run) — a second x must give the model's output
+    lib.ptpu_run_partial.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+        ctypes.c_int, ctypes.c_char_p, ctypes.c_int]
+    x2 = np.random.default_rng(1).standard_normal((4, 8)).astype(np.float32)
+    ref2 = net(paddle.to_tensor(x2)).numpy()
+    x2in = np.ascontiguousarray(x2.reshape(-1))
+    one = (ctypes.POINTER(ctypes.c_float) * 1)(
+        x2in.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+    rc = lib.ptpu_run_partial(h, one, n_in - 1, err, 256)
+    assert rc == 0, err.value
+    out2 = np.zeros(n, np.float32)
+    lib.ptpu_get_output(h, 0, out2.ctypes.data_as(
+        ctypes.POINTER(ctypes.c_float)))
+    np.testing.assert_allclose(out2.reshape(ref2.shape), ref2,
+                               rtol=1e-5, atol=1e-6)
+
+    # error path: bad artifact -> NULL + message, no crash
+    bad = tmp_path / "bad.mlir"
+    bad.write_text("not an mlir module")
+    assert not lib.ptpu_load(str(bad).encode(), err, 256)
+    assert b"main" in err.value
+    lib.ptpu_free(h)
