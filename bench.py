@@ -247,6 +247,65 @@ def bench_serving(dev, on_tpu):
           f"{dense_tps:.0f} useful tok/s)", eng_tps / dense_tps)
 
 
+def bench_unet(dev, on_tpu):
+    """Stable-Diffusion-class UNet train step (BASELINE config #5: conv +
+    cross-attention through the compiler path). One jitted
+    value_and_grad+SGD step, fp32 (the UNet conv/groupnorm path is fp32);
+    reports latents/s."""
+    import time as _t
+
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.jit.api import _collect_state, _Swap
+    from paddle_tpu.models import UNet2DConditionModel, UNetConfig
+
+    if on_tpu:
+        # fp32: the UNet's conv/groupnorm path is fp32 (XLA runs fp32 conv
+        # on the MXU with 3-pass decomposition); coverage line, not headline
+        cfg = UNetConfig(block_channels=(128, 256, 512), layers_per_block=2,
+                         num_heads=8, cross_attention_dim=768)
+        b, hw, ctx_len, iters = 8, 32, 77, 8
+    else:
+        cfg = UNetConfig.tiny()
+        b, hw, ctx_len, iters = 2, 16, 6, 2
+    model = UNet2DConditionModel(cfg)
+    _, tensors = _collect_state(model)
+    params = [t._data for t in tensors]
+    rng = np.random.default_rng(0)
+    batch = {
+        "sample": jnp.asarray(rng.standard_normal((b, 4, hw, hw)),
+                              jnp.float32),
+        "timesteps": jnp.asarray(rng.integers(0, 1000, (b,)), jnp.int32),
+        "context": jnp.asarray(
+            rng.standard_normal((b, ctx_len, cfg.cross_attention_dim)),
+            jnp.float32),
+        "noise": jnp.asarray(rng.standard_normal((b, 4, hw, hw)),
+                             jnp.float32),
+    }
+
+    def loss_of(ps):
+        with _Swap(tensors, ps):
+            return model.loss_fn(batch)
+
+    @jax.jit
+    def step(ps):
+        l, g = jax.value_and_grad(loss_of)(ps)
+        return l, [p - 1e-4 * gg.astype(p.dtype) for p, gg in zip(ps, g)]
+
+    loss, params = step(params)
+    jax.device_get(loss)
+    t0 = _t.perf_counter()
+    for _ in range(iters):
+        loss, params = step(params)
+    jax.device_get(loss)
+    dt = _t.perf_counter() - t0
+    _emit("sd_unet_latents_per_sec", b * iters / dt,
+          f"latents/s (UNet ch{cfg.block_channels} ctx {ctx_len}x"
+          f"{cfg.cross_attention_dim}, {hw}x{hw} latents, fp32 "
+          f"fwd+bwd+sgd, loss {float(loss):.3f})", None)
+
+
 def main():
     import jax
 
@@ -271,6 +330,11 @@ def main():
         bench_serving(dev, on_tpu)
     except Exception as e:
         print(f"# serving bench failed: {e!r}", flush=True)
+    gc.collect()
+    try:
+        bench_unet(dev, on_tpu)
+    except Exception as e:
+        print(f"# unet bench failed: {e!r}", flush=True)
     gc.collect()
 
     if on_tpu:

@@ -88,6 +88,15 @@ long long ptpu_input_numel(const void* h, int i) {
 static int run_impl(Handle* h, const float* const* inputs, int first_input,
                     char* err, int errlen) {
   try {
+    if (first_input < 0 || (size_t)first_input > h->program.args.size()) {
+      set_err(err, errlen, "first_input out of range");
+      return -1;
+    }
+    if (!h->env_ready && first_input > 0) {
+      // reject BEFORE allocating: a retry must still upload everything
+      set_err(err, errlen, "first run must upload all inputs");
+      return -1;
+    }
     if (!h->env_ready) {
       for (const auto& arg : h->program.args) {
         shlo::Tensor t;
@@ -96,22 +105,25 @@ static int run_impl(Handle* h, const float* const* inputs, int first_input,
         h->env[arg.first] = std::move(t);
       }
       h->env_ready = true;
-      if (first_input > 0) {
-        set_err(err, errlen, "first run must upload all inputs");
-        return -1;
-      }
     }
     // overwrite in place from first_input on (weights uploaded once can be
-    // skipped on later runs); intermediate values from the previous run are
-    // recomputed by shlo::run, inputs persist
+    // skipped on later runs); inputs persist across runs
     for (size_t i = (size_t)first_input; i < h->program.args.size(); ++i) {
       shlo::Tensor& t = h->env[h->program.args[i].first];
       std::memcpy(t.data.data(), inputs[i - (size_t)first_input],
                   t.data.size() * sizeof(float));
     }
     shlo::run(h->program, h->env);
+    // MOVE outputs out and drop every non-input intermediate: steady-state
+    // memory is weights + inputs + outputs, not the whole value graph
     h->outputs.clear();
-    for (const auto& name : h->rets) h->outputs.push_back(h->env.at(name));
+    for (const auto& name : h->rets)
+      h->outputs.push_back(std::move(h->env.at(name)));
+    for (auto it = h->env.begin(); it != h->env.end();) {
+      bool is_arg = false;
+      for (const auto& arg : h->program.args) is_arg |= (arg.first == it->first);
+      it = is_arg ? std::next(it) : h->env.erase(it);
+    }
     return 0;
   } catch (const std::exception& e) {
     set_err(err, errlen, e.what());
@@ -130,21 +142,32 @@ int ptpu_run_partial(void* hp, const float* const* inputs, int first_input,
   return run_impl(static_cast<Handle*>(hp), inputs, first_input, err, errlen);
 }
 
+// output accessors are valid only AFTER a successful ptpu_run (output
+// shapes are runtime values in this interpreter); out-of-range or
+// run-before queries return -1 / leave buffers untouched instead of UB
 long long ptpu_output_numel(const void* h, int k) {
-  return static_cast<const Handle*>(h)->outputs[(size_t)k].numel();
+  const auto& outs = static_cast<const Handle*>(h)->outputs;
+  if (k < 0 || (size_t)k >= outs.size()) return -1;
+  return outs[(size_t)k].numel();
 }
 
 int ptpu_output_rank(const void* h, int k) {
-  return (int)static_cast<const Handle*>(h)->outputs[(size_t)k].shape.size();
+  const auto& outs = static_cast<const Handle*>(h)->outputs;
+  if (k < 0 || (size_t)k >= outs.size()) return -1;
+  return (int)outs[(size_t)k].shape.size();
 }
 
 void ptpu_output_shape(const void* h, int k, long long* dims) {
-  const auto& s = static_cast<const Handle*>(h)->outputs[(size_t)k].shape;
+  const auto& outs = static_cast<const Handle*>(h)->outputs;
+  if (k < 0 || (size_t)k >= outs.size()) return;
+  const auto& s = outs[(size_t)k].shape;
   for (size_t d = 0; d < s.size(); ++d) dims[d] = (long long)s[d];
 }
 
 void ptpu_get_output(const void* h, int k, float* buf) {
-  const auto& t = static_cast<const Handle*>(h)->outputs[(size_t)k];
+  const auto& outs = static_cast<const Handle*>(h)->outputs;
+  if (k < 0 || (size_t)k >= outs.size()) return;
+  const auto& t = outs[(size_t)k];
   std::memcpy(buf, t.data.data(), t.data.size() * sizeof(float));
 }
 

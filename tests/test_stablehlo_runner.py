@@ -229,3 +229,44 @@ def test_capi_library_matches_python(capi_lib, tmp_path):
     assert not lib.ptpu_load(str(bad).encode(), err, 256)
     assert b"main" in err.value
     lib.ptpu_free(h)
+
+
+def test_capi_guards(capi_lib, tmp_path):
+    """C-API hardening: output queries before a run and bad first_input
+    must fail loudly, never UB (round-4 review findings)."""
+    import ctypes
+
+    paddle.seed(5)
+    net = _Net()
+    path = str(tmp_path / "net")
+    paddle.jit.save(net, path,
+                    input_spec=[paddle.static.InputSpec([4, 8], "float32")])
+    lib = ctypes.CDLL(capi_lib)
+    lib.ptpu_load.restype = ctypes.c_void_p
+    lib.ptpu_load.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
+    lib.ptpu_output_numel.restype = ctypes.c_longlong
+    lib.ptpu_output_numel.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.ptpu_run_partial.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+        ctypes.c_int, ctypes.c_char_p, ctypes.c_int]
+    lib.ptpu_num_inputs.argtypes = [ctypes.c_void_p]
+    lib.ptpu_free.argtypes = [ctypes.c_void_p]
+
+    err = ctypes.create_string_buffer(256)
+    h = lib.ptpu_load((path + ".mlir").encode(), err, 256)
+    assert h
+    # outputs before any run: -1, no crash
+    assert lib.ptpu_output_numel(h, 0) == -1
+    # partial before full run: error, and a RETRY must still error (the
+    # env must not be half-initialized by the rejected call)
+    x = np.zeros(32, np.float32)
+    one = (ctypes.POINTER(ctypes.c_float) * 1)(
+        x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+    assert lib.ptpu_run_partial(h, one, lib.ptpu_num_inputs(h) - 1,
+                                err, 256) == -1
+    assert lib.ptpu_run_partial(h, one, lib.ptpu_num_inputs(h) - 1,
+                                err, 256) == -1
+    # out-of-range first_input
+    assert lib.ptpu_run_partial(h, one, -1, err, 256) == -1
+    assert b"range" in err.value
+    lib.ptpu_free(h)
