@@ -250,8 +250,8 @@ def bench_serving(dev, on_tpu):
 def bench_unet(dev, on_tpu):
     """Stable-Diffusion-class UNet train step (BASELINE config #5: conv +
     cross-attention through the compiler path). One jitted
-    value_and_grad+SGD step, fp32 (the UNet conv/groupnorm path is fp32);
-    reports latents/s."""
+    value_and_grad+SGD step, bf16 params/activations (fp32 groupnorm
+    statistics inside); reports latents/s."""
     import time as _t
 
     import jax
@@ -261,10 +261,9 @@ def bench_unet(dev, on_tpu):
     from paddle_tpu.models import UNet2DConditionModel, UNetConfig
 
     if on_tpu:
-        # fp32: the UNet's conv/groupnorm path is fp32 (XLA runs fp32 conv
-        # on the MXU with 3-pass decomposition); coverage line, not headline
         cfg = UNetConfig(block_channels=(128, 256, 512), layers_per_block=2,
-                         num_heads=8, cross_attention_dim=768)
+                         num_heads=8, cross_attention_dim=768,
+                         dtype="bfloat16")
         b, hw, ctx_len, iters = 8, 32, 77, 8
     else:
         cfg = UNetConfig.tiny()
@@ -302,8 +301,61 @@ def bench_unet(dev, on_tpu):
     dt = _t.perf_counter() - t0
     _emit("sd_unet_latents_per_sec", b * iters / dt,
           f"latents/s (UNet ch{cfg.block_channels} ctx {ctx_len}x"
-          f"{cfg.cross_attention_dim}, {hw}x{hw} latents, fp32 "
+          f"{cfg.cross_attention_dim}, {hw}x{hw} latents, {cfg.dtype} "
           f"fwd+bwd+sgd, loss {float(loss):.3f})", None)
+
+
+def bench_vit(dev, on_tpu):
+    """ViT-L/16 bf16 classification train step (BASELINE config #5's second
+    model). One jitted value_and_grad+SGD step; reports images/s + MFU."""
+    import time as _t
+
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.jit.api import _collect_state, _Swap
+    from paddle_tpu.vision.models import ViTConfig, VisionTransformer, vit_l_16
+
+    if on_tpu:
+        model = vit_l_16(dtype="bfloat16")
+        b, iters = 32, 8
+    else:
+        model = VisionTransformer(ViTConfig.tiny())
+        b, iters = 4, 2
+    cfg = model.config
+    _, tensors = _collect_state(model)
+    params = [t._data for t in tensors]
+    n_params = sum(int(np.prod(t.shape)) for t in tensors)
+    rng = np.random.default_rng(0)
+    imgs = jnp.asarray(rng.standard_normal(
+        (b, cfg.in_channels, cfg.image_size, cfg.image_size)),
+        jnp.bfloat16 if on_tpu else jnp.float32)
+    labels = jnp.asarray(rng.integers(0, cfg.num_classes, (b,)), jnp.int32)
+
+    def loss_of(ps):
+        with _Swap(tensors, ps):
+            return model.loss_fn(imgs, labels)  # the model's canonical CE
+
+    @jax.jit
+    def step(ps):
+        l, g = jax.value_and_grad(loss_of)(ps)
+        return l, [p - 1e-4 * gg.astype(p.dtype) for p, gg in zip(ps, g)]
+
+    loss, params = step(params)
+    jax.device_get(loss)
+    t0 = _t.perf_counter()
+    for _ in range(iters):
+        loss, params = step(params)
+    jax.device_get(loss)
+    dt = _t.perf_counter() - t0
+    ips = b * iters / dt
+    n_tok = cfg.num_patches + 1
+    flops_per_img = 6.0 * n_params * n_tok + 12.0 * cfg.num_layers *         cfg.hidden_size * n_tok * n_tok
+    mfu = ips * flops_per_img / _device_peak(dev)
+    _emit("vit_l16_images_per_sec", ips,
+          f"images/s (ViT-L/16 {n_params/1e6:.0f}M {cfg.dtype} "
+          f"{cfg.image_size}px batch {b} fwd+bwd+sgd, loss "
+          f"{float(loss):.3f}, mfu {mfu:.3f})", None)
 
 
 def main():
@@ -335,6 +387,11 @@ def main():
         bench_unet(dev, on_tpu)
     except Exception as e:
         print(f"# unet bench failed: {e!r}", flush=True)
+    gc.collect()
+    try:
+        bench_vit(dev, on_tpu)
+    except Exception as e:
+        print(f"# vit bench failed: {e!r}", flush=True)
     gc.collect()
 
     if on_tpu:

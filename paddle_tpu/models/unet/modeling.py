@@ -17,6 +17,7 @@ from typing import Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as _np
 
 from ...core.tensor import Tensor
 from ...nn import initializer as I
@@ -209,10 +210,13 @@ class UNet2DConditionModel(Layer):
 
     def forward(self, sample, timesteps, encoder_hidden_states):
         cfg = self.config
-        x = _unwrap(sample)
+        dt = self.conv_in_w._data.dtype
+        # activations follow the parameter dtype (bf16 training runs the
+        # convs/matmuls on the MXU bf16 path; groupnorm stays fp32 inside)
+        x = _unwrap(sample).astype(dt)
         t = _unwrap(timesteps)
-        ctx = _unwrap(encoder_hidden_states)
-        temb = timestep_embedding(t, self.temb_dim0)
+        ctx = _unwrap(encoder_hidden_states).astype(dt)
+        temb = timestep_embedding(t, self.temb_dim0).astype(dt)
         temb = jnp.matmul(jax.nn.silu(
             jnp.matmul(temb, self.temb_w1._data) + self.temb_b1._data),
             self.temb_w2._data) + self.temb_b2._data
@@ -227,9 +231,11 @@ class UNet2DConditionModel(Layer):
                 skips.append(x)
                 li += 1
             if self.downsamplers[i]:
+                # init must be a CONCRETE scalar (reduce_window's vjp
+                # rejects traced inits) of the activation dtype
                 x = jax.lax.reduce_window(
-                    x, 0.0, jax.lax.add, (1, 1, 2, 2), (1, 1, 2, 2),
-                    "VALID") / 4.0
+                    x, _np.zeros((), x.dtype)[()], jax.lax.add, (1, 1, 2, 2),
+                    (1, 1, 2, 2), "VALID") / jnp.asarray(4.0, x.dtype)
 
         x = self.mid1(x, temb)
         x = self.mid_attn(x, ctx)

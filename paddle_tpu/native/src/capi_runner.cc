@@ -22,6 +22,7 @@
 //   ptpu_free(h)
 
 #include <memory>
+#include <set>
 
 #include "stablehlo_interp.h"
 
@@ -30,6 +31,7 @@ namespace {
 struct Handle {
   shlo::Program program;
   std::vector<std::string> rets;
+  std::set<std::string> arg_names;   // membership test for env cleanup
   std::vector<shlo::Tensor> outputs;
   // persistent per-run environment: input tensors are allocated once and
   // overwritten in place each run (no per-call map rebuild / realloc); a
@@ -54,6 +56,7 @@ void* ptpu_load(const char* mlir_path, char* err, int errlen) {
     auto h = std::make_unique<Handle>();
     h->program = shlo::parse(shlo::slurp(mlir_path));
     h->rets = shlo::parse_operands(h->program.ret_line);
+    for (const auto& arg : h->program.args) h->arg_names.insert(arg.first);
     return h.release();
   } catch (const std::exception& e) {
     set_err(err, errlen, e.what());
@@ -114,16 +117,22 @@ static int run_impl(Handle* h, const float* const* inputs, int first_input,
                   t.data.size() * sizeof(float));
     }
     shlo::run(h->program, h->env);
-    // MOVE outputs out and drop every non-input intermediate: steady-state
-    // memory is weights + inputs + outputs, not the whole value graph
+    // extract outputs and drop every non-input intermediate: steady-state
+    // memory is weights + inputs + outputs, not the whole value graph.
+    // COPY (don't move) when a return aliases an argument or repeats — a
+    // moved-from arg tensor would silently drop that input on later runs.
     h->outputs.clear();
-    for (const auto& name : h->rets)
-      h->outputs.push_back(std::move(h->env.at(name)));
-    for (auto it = h->env.begin(); it != h->env.end();) {
-      bool is_arg = false;
-      for (const auto& arg : h->program.args) is_arg |= (arg.first == it->first);
-      it = is_arg ? std::next(it) : h->env.erase(it);
+    std::set<std::string> taken;
+    for (const auto& name : h->rets) {
+      if (h->arg_names.count(name) || taken.count(name)) {
+        h->outputs.push_back(h->env.at(name));
+      } else {
+        h->outputs.push_back(std::move(h->env.at(name)));
+        taken.insert(name);
+      }
     }
+    for (auto it = h->env.begin(); it != h->env.end();)
+      it = h->arg_names.count(it->first) ? std::next(it) : h->env.erase(it);
     return 0;
   } catch (const std::exception& e) {
     set_err(err, errlen, e.what());
