@@ -51,6 +51,7 @@ class LlamaConfig:
         sequence_parallel: bool = False,
         num_experts: int = 1,
         moe_topk: int = 2,
+        moe_dispatch: str = "auto",
         moe_gate: str = "gshard",
         moe_aux_weight: float = 0.01,
         moe_capacity_factor: float = 1.25,
@@ -86,6 +87,7 @@ class LlamaConfig:
         self.sequence_parallel = sequence_parallel
         self.num_experts = num_experts
         self.moe_topk = moe_topk
+        self.moe_dispatch = moe_dispatch
         self.moe_gate = moe_gate
         self.moe_aux_weight = moe_aux_weight
         self.moe_capacity_factor = moe_capacity_factor
@@ -371,7 +373,8 @@ class LlamaDecoderLayer(Layer):
                                         dtype=config.dtype,
                                         initializer_range=config.initializer_range),
                 gate=config.moe_gate, top_k=config.moe_topk,
-                capacity_factor=config.moe_capacity_factor)
+                capacity_factor=config.moe_capacity_factor,
+                dispatch_mode=getattr(config, "moe_dispatch", "auto"))
         else:
             self.mlp = LlamaMLP(config)
 

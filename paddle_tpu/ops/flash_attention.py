@@ -17,8 +17,9 @@ Forward:
     (Pallas skips re-fetch when consecutive steps map to the same block).
   - GQA: q-head → kv-head mapping folded into the BlockSpec index_map, so
     K/V are never materialized per-q-head (the XLA fallback repeats them)
-  - train path emits logsumexp [b, h, s_q, LSE_LANES] so backward can
-    recompute P row-stably; the primal/inference path skips the write
+  - train path emits logsumexp [b, h, LSE_LANES, s_q] (lanes SECOND-minor
+    so the tiled HBM layout pads nothing — lanes-minor cost 16x padding) so
+    backward can recompute P row-stably; inference skips the write
 
 Backward (FlashAttention-2 style, two kernels sharing the saved lse):
   - dQ kernel: grid (b, hq, q_blocks, kv_blocks), same kv streaming/clamping
@@ -201,7 +202,8 @@ def _fa_fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal,
             # the array dim — 8 lanes beats the library kernel's 128-lane
             # padding on HBM traffic 16x).
             lse = jnp.where(l > 0, m + jnp.log(l_safe), NEG_INF)
-            lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:])
+            lse_ref[0, 0] = jnp.broadcast_to(jnp.swapaxes(lse, 0, 1),
+                                             lse_ref.shape[2:])
 
 
 def _seg_lanes(seg, s):
@@ -214,7 +216,8 @@ def _pallas_forward(q, k, v, causal, scale, block_q, block_k, interpret,
                     with_lse=True, q_seg=None, kv_seg=None,
                     row_start=None, row_end=None):
     """q,k,v in [b, s, h, d]. Returns (out [b,s,h,d],
-    lse [b, hq, s_q, LSE_LANES] fp32 — or None when with_lse=False, the
+    lse [b, hq, LSE_LANES, s_q] fp32 (lane-broadcast, lanes second-minor so
+    the tiled HBM layout pads nothing) — or None when with_lse=False, the
     primal/inference path, which skips the lse HBM write entirely)."""
     from jax.experimental.pallas import tpu as pltpu
 
@@ -242,10 +245,14 @@ def _pallas_forward(q, k, v, causal, scale, block_q, block_k, interpret,
     ]
     out_shape = [jax.ShapeDtypeStruct(qt.shape, q.dtype)]
     if with_lse:
-        out_specs.append(pl.BlockSpec((1, 1, block_q, LSE_LANES),
-                                      lambda bi, hi, qi, ki: (bi, hi, qi, 0)))
+        # lanes SECOND-minor ([b, h, LANES, s]): the (8,128)-tiled HBM layout
+        # then pads nothing, vs 16x expansion for a lanes-minor [.., s, 8]
+        # buffer (measured 120MB of padding per 8MB of lse on a 2048-seq
+        # batch-8 run — and remat keeps one per layer alive all backward)
+        out_specs.append(pl.BlockSpec((1, 1, LSE_LANES, block_q),
+                                      lambda bi, hi, qi, ki: (bi, hi, 0, qi)))
         out_shape.append(
-            jax.ShapeDtypeStruct((b, hq, s_q, LSE_LANES), jnp.float32))
+            jax.ShapeDtypeStruct((b, hq, LSE_LANES, s_q), jnp.float32))
     in_specs = [
         pl.BlockSpec((1, 1, block_q, d),
                      lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
@@ -327,10 +334,12 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, *refs,
         delta = jnp.sum(do0 * o0, axis=-1, keepdims=True)  # [BQ, 1]
         if with_glse:
             # ring attention's lse cotangent folds into delta: ds = p·(dp−δ+l̄)
-            delta = delta - glse_ref[0, 0][:, :1]
+            delta = delta - jnp.swapaxes(glse_ref[0, 0][:1, :], 0, 1)
         dq_sc[...] = jnp.zeros_like(dq_sc)
         delta_sc[...] = jnp.broadcast_to(delta, delta_sc.shape)
-        delta_ref[0, 0] = jnp.broadcast_to(delta, delta_ref.shape[2:])
+        # delta output is lanes-second-minor [LANES, BQ] like lse
+        delta_ref[0, 0] = jnp.broadcast_to(jnp.swapaxes(delta, 0, 1),
+                                           delta_ref.shape[2:])
 
     offset = kv_len - q_len
     run = True
@@ -341,7 +350,7 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, *refs,
     def _():
         q = q_ref[0, 0].astype(jnp.float32)                # [BQ, d]
         do = do_ref[0, 0].astype(jnp.float32)              # [BQ, d]
-        lse = lse_ref[0, 0][:, :1]                         # [BQ, 1]
+        lse = jnp.swapaxes(lse_ref[0, 0][:1, :], 0, 1)     # [BQ, 1]
         delta = delta_sc[...][:, :1]                       # [BQ, 1]
         kb = k_ref[0, 0].astype(jnp.float32)               # [BK, d]
         vb = v_ref[0, 0].astype(jnp.float32)
@@ -417,8 +426,8 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         for g in range(group):                             # static unroll (GQA)
             q = q_ref[0, g].astype(jnp.float32)            # [BQ, d]
             do = do_ref[0, g].astype(jnp.float32)          # [BQ, d]
-            lse = lse_ref[0, g][:, :1]                     # [BQ, 1]
-            delta = delta_ref[0, g][:, :1]                 # [BQ, 1]
+            lse = jnp.swapaxes(lse_ref[0, g][:1, :], 0, 1)     # [BQ, 1]
+            delta = jnp.swapaxes(delta_ref[0, g][:1, :], 0, 1)  # [BQ, 1]
             s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32) * scale
             if causal:
@@ -461,9 +470,10 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _pallas_backward(q, k, v, o, lse, do, causal, scale, block_q, block_k,
                      interpret, g_lse=None, q_seg=None, kv_seg=None,
                      row_start=None, row_end=None):
-    """All arrays in the public [b, s, h, d] layout; lse is the forward's
-    [b, hq, s_q, LSE_LANES] output (value broadcast across the lane dim).
+    """All arrays in the public [b, s, h, d] layout.
 
+    lse is the forward's [b, hq, LSE_LANES, s_q] output (lanes second-minor,
+    value broadcast across the lane dim).
     ``g_lse`` [b, hq, s_q] is an optional cotangent on the lse OUTPUT (ring
     attention's merge differentiates through it): with l̄ present the score
     gradient becomes ds = p·(dp − delta + l̄), i.e. l̄ just shifts delta."""
@@ -502,8 +512,8 @@ def _pallas_backward(q, k, v, o, lse, do, causal, scale, block_q, block_k,
         with_glse=with_glse, with_seg=with_seg, with_rowmask=with_rowmask)
     _qb = pl.BlockSpec((1, 1, block_q, d),
                        lambda bi, hi, qi, ki: (bi, hi, qi, 0))
-    _qlanes = pl.BlockSpec((1, 1, block_q, LSE_LANES),
-                           lambda bi, hi, qi, ki: (bi, hi, qi, 0))
+    _qlanes = pl.BlockSpec((1, 1, LSE_LANES, block_q),
+                           lambda bi, hi, qi, ki: (bi, hi, 0, qi))
     _kvb = pl.BlockSpec((1, 1, block_k, d),
                         lambda bi, hi, qi, ki: (bi, hi // group,
                                                 _kv_idx(qi, ki), 0))
@@ -512,8 +522,8 @@ def _pallas_backward(q, k, v, o, lse, do, causal, scale, block_q, block_k,
     if with_glse:
         dq_in_specs.append(_qlanes)
         glse_lanes = jnp.broadcast_to(
-            g_lse.astype(jnp.float32)[..., None],
-            g_lse.shape + (LSE_LANES,))
+            g_lse.astype(jnp.float32)[:, :, None, :],
+            g_lse.shape[:2] + (LSE_LANES,) + g_lse.shape[2:])
         dq_ops.append(glse_lanes)
     if with_seg:
         dq_in_specs += [
@@ -538,7 +548,7 @@ def _pallas_backward(q, k, v, o, lse, do, causal, scale, block_q, block_k,
         out_specs=[_qb, _qlanes],
         out_shape=[
             jax.ShapeDtypeStruct(qt.shape, q.dtype),
-            jax.ShapeDtypeStruct((b, hq, s_q, LSE_LANES), jnp.float32),
+            jax.ShapeDtypeStruct((b, hq, LSE_LANES, s_q), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),          # dq accumulator
@@ -564,10 +574,10 @@ def _pallas_backward(q, k, v, o, lse, do, causal, scale, block_q, block_k,
                      lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
         pl.BlockSpec((1, group, block_q, d),
                      lambda bi, hi, ki, qi: (bi, hi, _q_idx(ki, qi), 0)),
-        pl.BlockSpec((1, group, block_q, LSE_LANES),
-                     lambda bi, hi, ki, qi: (bi, hi, _q_idx(ki, qi), 0)),
-        pl.BlockSpec((1, group, block_q, LSE_LANES),
-                     lambda bi, hi, ki, qi: (bi, hi, _q_idx(ki, qi), 0)),
+        pl.BlockSpec((1, group, LSE_LANES, block_q),
+                     lambda bi, hi, ki, qi: (bi, hi, 0, _q_idx(ki, qi))),
+        pl.BlockSpec((1, group, LSE_LANES, block_q),
+                     lambda bi, hi, ki, qi: (bi, hi, 0, _q_idx(ki, qi))),
     ]
     if with_seg:
         dkv_in_specs += [
@@ -614,9 +624,12 @@ def _pallas_backward(q, k, v, o, lse, do, causal, scale, block_q, block_k,
 
 def _use_pallas(q, k, block_q, block_k, interpret):
     # shape guards apply in interpret mode too — a non-divisible seq would leave
-    # output rows unwritten / drop kv tokens silently
+    # output rows unwritten / drop kv tokens silently. block_q additionally
+    # sits in the MINOR dim of the lse/delta blocks ([.., LANES, block_q]),
+    # so it must be 128-divisible or the whole sequence (Mosaic tiling).
     s_q, s_kv = q.shape[1], k.shape[1]
     shapes_ok = (s_q % block_q == 0 and s_kv % block_k == 0
+                 and (block_q % 128 == 0 or block_q == s_q)
                  and q.shape[2] % k.shape[2] == 0)
     if interpret:
         return shapes_ok
@@ -690,7 +703,7 @@ def flash_attention_with_lse(q, k, v, causal, scale, block_q, block_k,
     if _use_pallas(q, k, block_q, block_k, interpret):
         out, lse4 = _pallas_forward(q, k, v, causal, scale, block_q, block_k,
                                     interpret, with_lse=True)
-        return out, lse4[..., 0]
+        return out, lse4[:, :, 0, :]
     return _xla_reference_lse(q, k, v, causal, scale)
 
 
@@ -698,7 +711,7 @@ def _fwl_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
     if _use_pallas(q, k, block_q, block_k, interpret):
         out, lse4 = _pallas_forward(q, k, v, causal, scale, block_q, block_k,
                                     interpret, with_lse=True)
-        return (out, lse4[..., 0]), (q, k, v, out, lse4)
+        return (out, lse4[:, :, 0, :]), (q, k, v, out, lse4)
     out, lse = _xla_reference_lse(q, k, v, causal, scale)
     return (out, lse), (q, k, v, None, None)
 
