@@ -210,18 +210,17 @@ def bench_serving(dev, on_tpu):
     new_toks = [(i % 4 + 1) * max_new // 4 for i in range(n_req)]
     useful = sum(new_toks)
 
-    # dense-cache generate() baseline: full batches, every row decoded to the
-    # batch max (the dense API has one max_new per call)
+    # dense-cache generate() baseline: full batches, every row decoded to
+    # the batch max (the dense API has one max_new per call)
     ids = np.stack(prompts[:slots])
     np.asarray(model.generate(ids, max_new_tokens=max_new,
                               temperature=0.0).numpy())  # compile
-    t0 = _t.perf_counter()
-    for lo in range(0, n_req, slots):
-        out = model.generate(np.stack(prompts[lo:lo + slots]),
-                             max_new_tokens=max_new, temperature=0.0)
-        np.asarray(out.numpy())
-    dt_dense = _t.perf_counter() - t0
-    dense_tps = useful / dt_dense
+
+    def dense_wave():
+        for lo in range(0, n_req, slots):
+            out = model.generate(np.stack(prompts[lo:lo + slots]),
+                                 max_new_tokens=max_new, temperature=0.0)
+            np.asarray(out.numpy())
 
     # ONE engine for warmup + timing: jit caches key on the engine's closures,
     # so a fresh engine would re-trace/compile inside the timed window
@@ -236,9 +235,20 @@ def bench_serving(dev, on_tpu):
         eng.run_until_done()
 
     run_wave()                                     # compile both programs
-    t0 = _t.perf_counter()
-    run_wave()
-    dt = _t.perf_counter() - t0
+
+    def timed(fn):
+        t0 = _t.perf_counter()
+        fn()
+        return _t.perf_counter() - t0
+
+    # best-of-2, INTERLEAVED dense/engine so monotone chip-state drift hits
+    # both sides equally (single-shot decode timings through the remote
+    # runtime swing 2x+; recorded ratios were 1.1x-2.0x for identical code)
+    dt_dense, dt = float("inf"), float("inf")
+    for _ in range(2):
+        dt_dense = min(dt_dense, timed(dense_wave))
+        dt = min(dt, timed(run_wave))
+    dense_tps = useful / dt_dense
     eng_tps = useful / dt
     _emit("serving_tokens_per_sec", eng_tps,
           f"useful tok/s (llama-750M bf16, {slots} slots, prompt "
