@@ -7,7 +7,7 @@ jit, static, distributed, incubate, profiler, metric, vision.
 
 from __future__ import annotations
 
-__version__ = "0.1.0"
+from .version import full_version as __version__  # noqa: E402
 
 from . import flags as _flags_mod
 from .core import dtype as _dtype_mod
@@ -63,6 +63,8 @@ from . import signal  # noqa: F401,E402
 from . import sparse  # noqa: F401,E402
 from . import static  # noqa: F401,E402
 from . import text  # noqa: F401,E402
+from . import utils  # noqa: F401,E402
+from . import version  # noqa: F401,E402
 from . import vision  # noqa: F401,E402
 from .hapi.model import Model  # noqa: F401,E402
 from .jit.api import to_static  # noqa: F401,E402

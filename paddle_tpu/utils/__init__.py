@@ -3,7 +3,7 @@
 from . import cpp_extension  # noqa: F401
 from .custom_op import custom_op  # noqa: F401
 
-__all__ = ["cpp_extension", "custom_op"]
+__all__ = ["cpp_extension", "custom_op", "run_check", "try_import"]
 
 
 def try_import(name: str):
@@ -13,3 +13,45 @@ def try_import(name: str):
         return importlib.import_module(name)
     except ImportError:
         return None
+
+
+def run_check() -> None:
+    """Sanity-check the installation end-to-end (reference:
+    utils/install_check.py:215 run_check): train a tiny linear model for a
+    few steps on the active backend and report the device.
+    """
+    import jax
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    print("Running verify paddle_tpu program ... ")
+    devices = jax.devices()
+    dev = devices[0]
+    # a diagnostic must not clobber the process RNG stream: save + restore
+    rng_state = paddle.get_rng_state()
+    paddle.seed(0)
+    lin = paddle.nn.Linear(4, 1)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=lin.parameters())
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((16, 4)).astype(np.float32))
+    y = paddle.to_tensor(rng.standard_normal((16, 1)).astype(np.float32))
+    first = last = None
+    for _ in range(5):
+        loss = ((lin(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        last = float(loss)
+        first = first if first is not None else last
+    if not (np.isfinite(last) and last < first):
+        raise RuntimeError(
+            f"verification train loop failed to improve: {first} -> {last}")
+    paddle.set_rng_state(rng_state)
+    kind = getattr(dev, "device_kind", dev.platform)
+    n = len(devices)
+    extra = "" if n == 1 else f" ({n} devices visible; exercised device 0)"
+    print(f"paddle_tpu works well on 1 {kind}{extra}.")
+    print("paddle_tpu is installed successfully! Let's start deep learning "
+          "with paddle_tpu now.")

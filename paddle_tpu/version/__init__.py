@@ -1,0 +1,28 @@
+"""paddle_tpu.version (reference: the generated python/paddle/version.py —
+full_version/major/minor/patch/rc + show()). Version is sourced from the
+installed package metadata (pyproject's single source of truth)."""
+
+from __future__ import annotations
+
+full_version = "0.2.0"
+try:  # installed: prefer the package metadata
+    from importlib.metadata import version as _v
+
+    full_version = _v("paddle-tpu")
+except Exception:
+    pass
+
+_parts = (full_version.split("+")[0].split(".") + ["0", "0", "0"])[:3]
+major, minor, patch = _parts[0], _parts[1], _parts[2]
+rc = "0"
+
+__all__ = ["full_version", "major", "minor", "patch", "rc", "show"]
+
+
+def show() -> None:
+    """Print the version breakdown (reference version.py show())."""
+    print(f"full_version: {full_version}")
+    print(f"major: {major}")
+    print(f"minor: {minor}")
+    print(f"patch: {patch}")
+    print(f"rc: {rc}")

@@ -312,3 +312,17 @@ class TestLegacyDataset:
         img, lbl = next(paddle.dataset.cifar.train10(8)())
         assert img.shape == (3072,)
         assert 0.0 <= img.min() and img.max() <= 1.0
+
+
+def test_run_check_and_version():
+    """paddle.utils.run_check (install_check.py:215) + paddle.version."""
+    import io
+    from contextlib import redirect_stdout
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        paddle.utils.run_check()
+    out = buf.getvalue()
+    assert "works well on 1" in out and "installed successfully" in out
+    assert paddle.version.full_version.count(".") >= 2
+    assert paddle.version.major.isdigit()
