@@ -270,3 +270,82 @@ def test_capi_guards(capi_lib, tmp_path):
     assert lib.ptpu_run_partial(h, one, -1, err, 256) == -1
     assert b"range" in err.value
     lib.ptpu_free(h)
+
+
+def test_capi_passthrough_return_survives_reruns(capi_lib, tmp_path):
+    """A return value that aliases an input (pass-through) must be COPIED
+    out of the env, not moved — a moved-from input would be silently empty
+    on the next run (round-4 review finding)."""
+    import ctypes
+
+    class Echo(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x):
+            return x, self.fc(x)
+
+    paddle.seed(9)
+    net = Echo()
+    path = str(tmp_path / "echo")
+    paddle.jit.save(net, path,
+                    input_spec=[paddle.static.InputSpec([2, 4], "float32")])
+
+    lib = ctypes.CDLL(capi_lib)
+    lib.ptpu_load.restype = ctypes.c_void_p
+    lib.ptpu_load.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
+    lib.ptpu_num_inputs.argtypes = [ctypes.c_void_p]
+    lib.ptpu_num_outputs.argtypes = [ctypes.c_void_p]
+    lib.ptpu_input_numel.restype = ctypes.c_longlong
+    lib.ptpu_input_numel.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.ptpu_run.argtypes = [ctypes.c_void_p,
+                             ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+                             ctypes.c_char_p, ctypes.c_int]
+    lib.ptpu_run_partial.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+        ctypes.c_int, ctypes.c_char_p, ctypes.c_int]
+    lib.ptpu_output_numel.restype = ctypes.c_longlong
+    lib.ptpu_output_numel.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.ptpu_get_output.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                    ctypes.POINTER(ctypes.c_float)]
+    lib.ptpu_free.argtypes = [ctypes.c_void_p]
+
+    err = ctypes.create_string_buffer(256)
+    h = lib.ptpu_load((path + ".mlir").encode(), err, 256)
+    assert h, err.value
+    n_in = lib.ptpu_num_inputs(h)
+
+    from paddle_tpu.jit.api import _collect_state
+
+    _, tensors = _collect_state(net)
+    x1 = np.random.default_rng(0).standard_normal((2, 4)).astype(np.float32)
+    bufs = [np.ascontiguousarray(np.asarray(t.numpy(), np.float32)
+                                 .reshape(-1)) for t in tensors]
+    bufs.append(np.ascontiguousarray(x1.reshape(-1)))
+    arr_t = ctypes.POINTER(ctypes.c_float) * n_in
+    ins = arr_t(*[b.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+                  for b in bufs])
+    assert lib.ptpu_run(h, ins, err, 256) == 0, err.value
+    assert lib.ptpu_num_outputs(h) == 2
+
+    def out(k, shape):
+        n = lib.ptpu_output_numel(h, k)
+        buf = np.zeros(n, np.float32)
+        lib.ptpu_get_output(h, k, buf.ctypes.data_as(
+            ctypes.POINTER(ctypes.c_float)))
+        return buf.reshape(shape)
+
+    np.testing.assert_allclose(out(0, (2, 4)), x1, rtol=1e-6)
+
+    # second run via run_partial (weights persist): the pass-through input
+    # must still be alive server-side and reflect the NEW activation
+    x2 = np.random.default_rng(1).standard_normal((2, 4)).astype(np.float32)
+    x2in = np.ascontiguousarray(x2.reshape(-1))
+    one = (ctypes.POINTER(ctypes.c_float) * 1)(
+        x2in.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+    assert lib.ptpu_run_partial(h, one, n_in - 1, err, 256) == 0, err.value
+    np.testing.assert_allclose(out(0, (2, 4)), x2, rtol=1e-6)
+    ref2 = np.asarray((net(paddle.to_tensor(x2))[1]).numpy())
+    np.testing.assert_allclose(out(1, (2, 4)), ref2, rtol=1e-5, atol=1e-6)
+    lib.ptpu_free(h)
