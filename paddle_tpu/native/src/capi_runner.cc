@@ -21,6 +21,7 @@
 //   ptpu_get_output(h, k, buf)            -> copy output k into caller buf
 //   ptpu_free(h)
 
+#include <map>
 #include <memory>
 #include <set>
 
@@ -31,6 +32,7 @@ namespace {
 struct Handle {
   shlo::Program program;
   std::vector<std::string> rets;
+  std::map<std::string, int> ret_count;  // duplicate-return occurrence count
   std::set<std::string> arg_names;   // membership test for env cleanup
   std::vector<shlo::Tensor> outputs;
   // persistent per-run environment: input tensors are allocated once and
@@ -56,6 +58,7 @@ void* ptpu_load(const char* mlir_path, char* err, int errlen) {
     auto h = std::make_unique<Handle>();
     h->program = shlo::parse(shlo::slurp(mlir_path));
     h->rets = shlo::parse_operands(h->program.ret_line);
+    for (const auto& name : h->rets) ++h->ret_count[name];
     for (const auto& arg : h->program.args) h->arg_names.insert(arg.first);
     return h.release();
   } catch (const std::exception& e) {
@@ -120,15 +123,16 @@ static int run_impl(Handle* h, const float* const* inputs, int first_input,
     // extract outputs and drop every non-input intermediate: steady-state
     // memory is weights + inputs + outputs, not the whole value graph.
     // COPY (don't move) when a return aliases an argument or repeats — a
-    // moved-from arg tensor would silently drop that input on later runs.
+    // moved-from arg tensor would silently drop that input on later runs,
+    // and moving the first of N duplicate returns would leave the later
+    // occurrences copying an empty husk.
     h->outputs.clear();
-    std::set<std::string> taken;
+    std::map<std::string, int> remaining = h->ret_count;
     for (const auto& name : h->rets) {
-      if (h->arg_names.count(name) || taken.count(name)) {
+      if (h->arg_names.count(name) || --remaining[name] > 0) {
         h->outputs.push_back(h->env.at(name));
       } else {
         h->outputs.push_back(std::move(h->env.at(name)));
-        taken.insert(name);
       }
     }
     for (auto it = h->env.begin(); it != h->env.end();)

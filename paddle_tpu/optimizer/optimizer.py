@@ -121,9 +121,10 @@ class Optimizer:
         optimizer-level one, matching the reference's precedence
         (regularizer.py: 'ParamAttr has higher priority than optimizer').
         Decoupled-decay optimizers (AdamW) handle decay inside _update."""
+        from ..regularizer import WeightDecayRegularizer
+
         if isinstance(self, _DecoupledWeightDecay):
             return None
-        from ..regularizer import WeightDecayRegularizer
 
         wd = getattr(param, "regularizer", None)
         if not isinstance(wd, WeightDecayRegularizer):
@@ -342,7 +343,45 @@ class AdamW(Adam, _DecoupledWeightDecay):
                  lazy_mode=False, multi_precision=False, amsgrad=False, name=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters, None, grad_clip,
                          lazy_mode, multi_precision, amsgrad=amsgrad, name=name)
-        self._wd_coeff = weight_decay if isinstance(weight_decay, float) else 0.01
+        from ..regularizer import L2Decay, WeightDecayRegularizer
+
+        if isinstance(weight_decay, L2Decay):
+            # decoupled decay IS multiplicative L2-style decay; the coeff maps
+            weight_decay = weight_decay._coeff
+        elif isinstance(weight_decay, WeightDecayRegularizer):
+            raise TypeError(
+                f"AdamW weight_decay must be a number or L2Decay, got "
+                f"{weight_decay}: L1 sign semantics cannot be expressed as "
+                "decoupled (multiplicative) decay — use Adam with an L1Decay "
+                "regularizer instead")
+        if weight_decay is None:
+            self._wd_coeff = 0.0
+        elif isinstance(weight_decay, (str, bytes)):
+            raise TypeError(
+                f"AdamW weight_decay must be a number or L2Decay, got "
+                f"{type(weight_decay).__name__}")
+        else:
+            try:
+                # accepts numpy scalars / 0-d tensors via __float__
+                self._wd_coeff = float(weight_decay)
+            except (TypeError, ValueError):
+                raise TypeError(
+                    f"AdamW weight_decay must be a number or L2Decay, got "
+                    f"{type(weight_decay).__name__}") from None
+        # per-param regularizers don't compose with decoupled decay — L1's
+        # sign semantics can't ride the multiplicative path; say so once here
+        # rather than silently dropping them at step time
+        for p in self._parameter_list or []:
+            if isinstance(getattr(p, "regularizer", None),
+                          WeightDecayRegularizer):
+                import warnings
+
+                warnings.warn(
+                    f"ParamAttr regularizer on {getattr(p, 'name', '?')} is "
+                    "ignored by decoupled-decay optimizers (AdamW); use a "
+                    "coupled optimizer (Adam + weight_decay) to apply it",
+                    stacklevel=2)
+                break
         self._apply_decay_param_fun = apply_decay_param_fun
 
     def _update(self, g, val, p, lr):

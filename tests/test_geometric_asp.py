@@ -228,6 +228,39 @@ class TestRegularizerHub:
             np.testing.assert_allclose(lin.weight.numpy(), w0 - decay,
                                        rtol=1e-5, atol=1e-6)
 
+    def test_adamw_rejects_l1_warns_param_regularizer(self):
+        """Decoupled-decay optimizers must not silently reinterpret L1 as
+        multiplicative decay: AdamW(weight_decay=L1Decay) raises, L2Decay
+        maps to its coefficient, and a per-param ParamAttr regularizer
+        warns that it is ignored (round-5 advisor finding)."""
+        import warnings
+
+        from paddle_tpu.regularizer import L1Decay, L2Decay
+
+        paddle.seed(4)
+        lin = paddle.nn.Linear(4, 4)
+        with pytest.raises(TypeError, match="L1"):
+            paddle.optimizer.AdamW(parameters=lin.parameters(),
+                                   weight_decay=L1Decay(0.1))
+        opt = paddle.optimizer.AdamW(parameters=lin.parameters(),
+                                     weight_decay=L2Decay(0.125))
+        assert opt._wd_coeff == 0.125
+
+        with pytest.raises(TypeError, match="number or L2Decay"):
+            paddle.optimizer.AdamW(parameters=lin.parameters(),
+                                   weight_decay="0.01")
+        # None disables decay rather than silently applying the 0.01 default
+        assert paddle.optimizer.AdamW(parameters=lin.parameters(),
+                                      weight_decay=None)._wd_coeff == 0.0
+
+        lin2 = paddle.nn.Linear(
+            4, 4, weight_attr=paddle.ParamAttr(regularizer=L1Decay(0.25)))
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            paddle.optimizer.AdamW(parameters=lin2.parameters(),
+                                   weight_decay=0.01)
+        assert any("decoupled" in str(w.message) for w in rec)
+
     def test_hub_local_roundtrip(self, tmp_path):
         (tmp_path / "hubconf.py").write_text(
             "dependencies = ['numpy']\n"
