@@ -1,0 +1,99 @@
+"""In-process interleaved A/B of flash backward block configurations.
+
+Kernel-level fwd+bwd attention at the training shapes (GQA 16/4, d 128),
+scan-chained so there is no per-call dispatch floor. Variants mutate
+ops.flash_attention.BWD_ROW_CAP before tracing (read at trace time):
+
+  rows1024 : folded dQ/dKV rows capped at 1024 (bq 256 at group 4)
+  rows512  : cap 512 (bq 128)
+  rows2048 : cap 2048 (bq 512, bk halved to 256 by the VMEM guard)
+
+Usage: python benchmarks/flash_block_ab.py [seq] [rounds]
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import importlib
+
+# ops/__init__ rebinds the `flash_attention` attribute to the FUNCTION, which
+# shadows the submodule for plain `import ... as` — resolve via sys.modules
+fa = importlib.import_module("paddle_tpu.ops.flash_attention")
+
+SEQ = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+ROUNDS = int(sys.argv[2]) if len(sys.argv) > 2 else 3
+B = 2 if SEQ <= 4096 else 1
+HQ, HKV, D = 16, 4, 128
+ITERS = 8
+
+
+def main():
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((B, SEQ, HQ, D)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((B, SEQ, HKV, D)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((B, SEQ, HKV, D)), jnp.bfloat16)
+
+    def make(cap):
+        def chain(q0, k0, v0):
+            fa.BWD_ROW_CAP[0] = cap          # baked at trace time
+
+            def body(c, _):
+                qq, kk, vv = c
+
+                def loss(a, b, cdv):
+                    return jnp.sum(
+                        fa.flash_attention(a, b, cdv, causal=True)
+                        .astype(jnp.float32) ** 2)
+
+                l, (dq, dk, dv) = jax.value_and_grad(
+                    loss, argnums=(0, 1, 2))(qq, kk, vv)
+                eps = jnp.bfloat16(1e-12)
+                return (qq + eps * dq.astype(qq.dtype),
+                        kk + eps * dk.astype(kk.dtype),
+                        vv + eps * dv.astype(vv.dtype)), l
+
+            (_, _, _), ls = jax.lax.scan(body, (q0, k0, v0), None,
+                                         length=ITERS)
+            return ls.sum()
+
+        return jax.jit(chain)
+
+    variants = {"rows1024": make(1024), "rows512": make(512),
+                "rows2048": make(2048)}
+    # causal fwd+bwd model flops: fwd 2 matmuls + bwd 5 (dq:3 shared s/dp
+    # counted once... use 3.5x fwd convention) — report RELATIVE ms only plus
+    # an absolute TF/s using the 3.5x-fwd convention
+    fwd_flops = 2 * 2 * B * HQ * SEQ * SEQ * D / 2  # causal half
+    tot = 3.5 * fwd_flops
+
+    best = {}
+    for name, fn in variants.items():
+        t0 = time.perf_counter()
+        jax.device_get(fn(q, k, v).reshape(1))
+        print(f"# {name}: compiled+warm {time.perf_counter()-t0:.1f}s",
+              flush=True)
+        best[name] = float("inf")
+
+    for r in range(ROUNDS):
+        for name, fn in variants.items():
+            t0 = time.perf_counter()
+            jax.device_get(fn(q, k, v).reshape(1))
+            dt = (time.perf_counter() - t0) / ITERS
+            best[name] = min(best[name], dt)
+            print(f"round {r} {name:9s}: {dt*1e3:7.2f} ms  "
+                  f"{tot/dt/1e12:5.1f} TF/s", flush=True)
+
+    print(f"\n== best-of-{ROUNDS} seq {SEQ} (b{B} h{HQ}/{HKV} d{D}) ==")
+    for name, dt in best.items():
+        print(f"{name:9s}: {dt*1e3:7.2f} ms  {tot/dt/1e12:5.1f} TF/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -196,7 +196,7 @@ def interleaved_schedule(stage_fn: Callable, n_stages: int, interleave: int,
 
 def zb_schedule(layer_fn, n_stages: int, interleave: int, lc: int,
                 axis_name: str = "pp", bargs=(), remat: bool = False,
-                with_aux: bool = False):
+                with_aux: bool = False, remat_policy=None):
     """Zero-bubble (ZBH1-class) W/B-split schedule, run INSIDE shard_map.
 
     Parity anchor: the reference's zero-bubble pipeline passes
@@ -232,14 +232,23 @@ def zb_schedule(layer_fn, n_stages: int, interleave: int, lc: int,
     Memory regimes (the ZB paper's memory/bubble tradeoff axis):
       - ``remat=False`` (ZB-∞): step 1 saves full linearization residuals
         (incl. the tick's param slice) for every tick — fastest, most memory.
-      - ``remat=True`` (memory-bounded, ZBH1's regime): step 1 saves ONLY
-        each layer's boundary input activation; step 2 recomputes the layer
-        under ``jax.vjp`` w.r.t. activations only (the weight half is never
-        traced); step 3 recomputes once more w.r.t. weights only. Memory
-        drops to the boundary-activations class (same as GPipe+remat); the
-        extra cost is one more in-layer forward in the W drain — which runs
-        OFF the permute critical path, exactly where ZBH1 hides work.
-    Gradient equality vs sequential is exact in both regimes
+      - ``remat=True, remat_policy=None`` (memory-bounded, ZBH1's regime):
+        step 1 saves ONLY each layer's boundary input activation; step 2
+        recomputes the layer under ``jax.vjp`` w.r.t. activations only (the
+        weight half is never traced); step 3 recomputes once more w.r.t.
+        weights only. Memory drops to the boundary-activations class (same
+        as GPipe+remat); the extra cost is one more in-layer forward in the
+        W drain — which runs OFF the permute critical path, exactly where
+        ZBH1 hides work.
+      - ``remat=True, remat_policy=<jax.checkpoint policy>`` (selective):
+        step 1 runs the vjp over the POLICY-checkpointed layer, so the
+        stacked pullbacks hold only the policy-saved residuals (e.g.
+        flash_out/flash_lse — backward skips re-running the flash forward
+        kernel in BOTH the B scan and the W drain) plus the vjp inputs.
+        Memory sits between the other two regimes: the policy-saved tensors
+        AND the tick's param slice are stacked per tick (like ZB-∞); for
+        models whose per-stage params dwarf activations prefer policy=None.
+    Gradient equality vs sequential is exact in all regimes
     (tests/test_pipeline.py).
 
     ``layer_fn(per_layer_params, h, *bargs)`` runs ONE block (``-> (y,
@@ -255,6 +264,11 @@ def zb_schedule(layer_fn, n_stages: int, interleave: int, lc: int,
     vp = v * p
     perm_f = [(i, (i + 1) % p) for i in range(p)]
     perm_b = [(i, (i - 1) % p) for i in range(p)]
+    # remat regimes: boundary (input-only storage, recompute-twice) vs
+    # selective (vjp over the policy-checkpointed layer — pullbacks carry the
+    # policy-saved residuals, e.g. flash out/lse, and recompute the rest)
+    boundary = remat and remat_policy is None
+    selective = remat and remat_policy is not None
 
     def _chunk(params, c):
         # chunk c's [lc, ...] slice of each local [v*lc, ...] param stack
@@ -298,7 +312,7 @@ def zb_schedule(layer_fn, n_stages: int, interleave: int, lc: int,
             h = jnp.where(inj_here, inj, buf)
             wls = _chunk(params, c)
 
-            if remat:
+            if boundary:
                 # memory-bounded: stack each layer's INPUT activation only
                 def layer_step(carry_l, wl):
                     hh, asum = carry_l
@@ -306,12 +320,18 @@ def zb_schedule(layer_fn, n_stages: int, interleave: int, lc: int,
                     y, auxl = res if with_aux else (res, 0.0)
                     return (y, asum + auxl), hh
             else:
-                # ZB-∞: stack the full per-layer pullback (vjp closures are
-                # pytrees, so lax.scan stacks their residuals)
+                # ZB-∞ / selective: stack the per-layer pullback (vjp
+                # closures are pytrees, so lax.scan stacks their residuals).
+                # Under `selective` the vjp runs over the policy-checkpointed
+                # layer, so the pullback carries only policy-saved residuals
+                # (flash out/lse etc.) and recomputes the rest when applied.
+                vfn = (jax.checkpoint(_fn, policy=remat_policy) if selective
+                       else _fn)
+
                 def layer_step(carry_l, wl):
                     hh, asum = carry_l
                     res, pb = jax.vjp(
-                        lambda w_, h_: _fn(w_, h_, *bargs), wl, hh)
+                        lambda w_, h_: vfn(w_, h_, *bargs), wl, hh)
                     y, auxl = res if with_aux else (res, 0.0)
                     return (y, asum + auxl), pb
 
@@ -381,7 +401,7 @@ def zb_schedule(layer_fn, n_stages: int, interleave: int, lc: int,
             def _cot(dh):
                 return (dh, daux) if with_aux else dh
 
-            if remat:
+            if boundary:
                 # recompute the layer fwd from its saved INPUT, differentiate
                 # w.r.t. activations only (weight half never traced); the
                 # INCOMING dh is this layer's output cotangent — saved for W
@@ -439,7 +459,7 @@ def zb_schedule(layer_fn, n_stages: int, interleave: int, lc: int,
                                                        keepdims=False), pbs)
             dys_t = jax.lax.dynamic_index_in_dim(dys, t, 0, keepdims=False)
 
-            if remat:
+            if boundary:
                 # recompute the layer fwd once more from its saved input,
                 # differentiate w.r.t. WEIGHTS only — pure local matmuls off
                 # the permute chain, exactly the work ZBH1 defers
@@ -521,8 +541,11 @@ def pipeline_call(
       remat: rematerialise each block in backward (fleet/recompute parity).
       schedule: "auto" (GPipe for interleave=1, interleaved VPP otherwise) or
         "zb" — the zero-bubble W/B-split schedule (see :func:`zb_schedule`;
-        ``remat=True`` selects its memory-bounded boundary-storage regime,
-        ``remat=False`` the ZB-∞ residual-saving regime; ``broadcast_args``
+        ``remat=True`` selects its memory-bounded boundary-storage regime
+        (``remat_policy=None``) or the selective policy regime (pullbacks
+        keep the policy-saved residuals, e.g. flash out/lse, skipping the
+        flash fwd recompute in B and W), ``remat=False`` the ZB-∞
+        residual-saving regime; ``broadcast_args``
         are non-differentiable (a grad w.r.t. one raises at trace time);
         ``with_aux`` is supported — MoE gate losses ride the zb schedule).
 
@@ -532,16 +555,9 @@ def pipeline_call(
     n_stages = mesh.shape[axis_name]
     if schedule not in ("auto", "zb"):
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
-    # zb handles remat via its own boundary-storage regime (see zb_schedule);
+    # zb handles remat itself (boundary-storage when remat_policy is None,
+    # selective policy-checkpointed pullbacks otherwise — see zb_schedule);
     # jax.checkpoint wrapping applies to the grad-of-scan schedules only.
-    # policy=None is jax.checkpoint's default (plain full remat)
-    if schedule == "zb" and remat and remat_policy is not None:
-        import warnings
-
-        warnings.warn(
-            "schedule='zb' with remat=True always recomputes the full layer "
-            "in B and W (boundary-activation storage); the selective "
-            "remat_policy is ignored on this schedule")
     blk = (jax.checkpoint(block_fn, policy=remat_policy)
            if remat and schedule != "zb" else block_fn)
 
@@ -595,7 +611,8 @@ def pipeline_call(
             # bargs are closed over by the zb custom_vjp: differentiating
             # w.r.t. them raises at trace time (vs. silent zero cotangents)
             zb = zb_schedule(blk, n_stages, interleave, lc, axis_name,
-                             bargs=bargs, remat=remat, with_aux=with_aux)
+                             bargs=bargs, remat=remat, with_aux=with_aux,
+                             remat_policy=remat_policy)
             return zb(params, micro_in)
     elif interleave > 1:
         pipeline = interleaved_schedule(

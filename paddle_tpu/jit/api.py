@@ -99,7 +99,13 @@ class StaticFunction:
         self._full_graph = full_graph
         self._fallback_eager = False
         self._split_plan = None  # SOT-style partial graphs (partial_graph.py)
+        self._bound_sig = None   # lazy inspect.signature for plan calls
         functools.update_wrapper(self, self._orig_fn)
+
+    @property
+    def _has_defaults(self):
+        f = getattr(self._orig_fn, "__func__", self._orig_fn)
+        return bool(getattr(f, "__defaults__", None))
 
     @property
     def forward(self):
@@ -124,15 +130,46 @@ class StaticFunction:
 
         return pure
 
+    def _positional(self, args, kwargs):
+        """Normalize a call to positional order (the split plan's calling
+        convention), applying signature defaults. Raises TypeError on
+        signatures the splitter rejected anyway (*args/**kwargs)."""
+        import inspect
+
+        if self._bound_sig is None:
+            self._bound_sig = inspect.signature(self._orig_fn)
+        ba = self._bound_sig.bind(*args, **kwargs)
+        ba.apply_defaults()
+        return tuple(ba.arguments[p] for p in self._bound_sig.parameters)
+
+    def _run_plan(self, args, kwargs):
+        """Run the split plan; a NameError/UnboundLocalError from a
+        synthesized piece (a prefix-stored name that this input path never
+        defined, or a loop-carried var with no pre-loop binding) permanently
+        reverts to whole-function eager (ADVICE r4)."""
+        if kwargs or self._has_defaults:
+            # a TypeError here is a genuinely malformed call — same error
+            # the eager function would raise; let it propagate
+            args = self._positional(args, kwargs)
+            kwargs = {}
+        try:
+            return self._split_plan(*args)
+        except (NameError, UnboundLocalError) as e:
+            import warnings
+
+            warnings.warn(
+                f"to_static: partial-graph plan for "
+                f"{getattr(self._orig_fn, '__name__', '?')} failed at run "
+                f"time ({type(e).__name__}: {e}) — reverting to eager.")
+            self._split_plan = None
+            self._fallback_eager = True
+            return self._orig_fn(*args, **kwargs)
+
     def __call__(self, *args, **kwargs):
         if self._fallback_eager or not _to_static_enabled[0]:
             return self._orig_fn(*args, **kwargs)
         if self._split_plan is not None:
-            if kwargs:
-                # the split plan is positional-only; kwarg call sites keep
-                # the original (eager) semantics rather than crashing
-                return self._orig_fn(*args, **kwargs)
-            return self._split_plan(*args)
+            return self._run_plan(args, kwargs)
         try:
             return self._compiled_call(*args, **kwargs)
         except (jax.errors.TracerBoolConversionError,
@@ -142,27 +179,29 @@ class StaticFunction:
             # graph break: value-dependent Python control flow inside the
             # traced region. The reference's SOT splits the bytecode at the
             # break and resumes compiled execution (sot/translate.py:31);
-            # the jax-native equivalent splits the AST at a breaking top-
-            # level `if`: prefix-jit -> eager condition -> per-branch
-            # suffix-jit (jit/partial_graph.py). Breaks the splitter cannot
-            # express fall back to whole-function eager execution.
+            # the jax-native equivalent splits the AST at the breaking top-
+            # level statement: if -> eager condition bridge + per-branch
+            # suffix graphs; while/for -> lax.while_loop lowering or an
+            # eager loop bridge driving a compiled body subgraph
+            # (jit/partial_graph.py). Breaks the splitter cannot express
+            # fall back to whole-function eager execution.
             if self._full_graph:
                 raise
             import warnings
 
-            if self._layer is None and not kwargs:
-                from .partial_graph import break_lineno_of, try_split
+            from .partial_graph import break_lineno_of, try_split
 
-                plan = try_split(self._orig_fn, break_lineno_of(e, self._orig_fn))
-                if plan is not None:
-                    warnings.warn(
-                        f"to_static: graph break in "
-                        f"{getattr(self._orig_fn, '__name__', '?')} "
-                        f"({type(e).__name__}) — split into prefix/suffix "
-                        "compiled subgraphs with an eager bridge at the "
-                        "breaking condition (SOT-style partial graphs).")
-                    self._split_plan = plan
-                    return plan(*args)
+            fn = self._orig_fn
+            plan = try_split(fn, break_lineno_of(e, fn), layer=self._layer)
+            if plan is not None:
+                warnings.warn(
+                    f"to_static: graph break in "
+                    f"{getattr(self._orig_fn, '__name__', '?')} "
+                    f"({type(e).__name__}) — split into compiled subgraphs "
+                    "with an eager bridge at the breaking statement "
+                    "(SOT-style partial graphs).")
+                self._split_plan = plan
+                return self._run_plan(args, kwargs)
             warnings.warn(
                 f"to_static: graph break in {getattr(self._orig_fn, '__name__', '?')} "
                 f"({type(e).__name__}) — falling back to eager execution. "

@@ -3,10 +3,13 @@
 Parity anchor: the reference's SOT resumes COMPILED execution after a graph
 break instead of abandoning compilation (jit/sot/translate.py:31 — the
 opcode translator splits the bytecode at the break and stitches compiled
-subgraphs with an eager bridge).
+subgraphs with an eager bridge; loops resume via FOR_ITER handling,
+jit/sot/opcode_translator/executor/opcode_executor.py:1694).
 
 TPU-native redesign: instead of bytecode surgery, the function's AST is
-split at the breaking ``if`` statement:
+split at the breaking statement:
+
+``if`` break::
 
     prefix  = statements before the if           -> one jitted graph
     bridge  = the if CONDITION, evaluated eagerly on the prefix's concrete
@@ -14,16 +17,40 @@ split at the breaking ``if`` statement:
     suffix  = branch body + remaining statements -> one jitted graph per
               taken branch (compiled lazily, only for branches that run)
 
-Each suffix is itself a ``full_graph=False`` StaticFunction, so a second
-break inside it splits again (elif chains are nested ifs and recurse
-naturally). When the break is not an ``if`` at the top level of the function
-body — while-on-tensor, tensor-int conversion in indexing, breaks inside
-loops — :func:`try_split` returns None and the caller keeps the
-whole-function eager fallback.
+``while`` break (tensor condition, or a deeper break inside the body)::
 
-Bounds (documented, not silent): plain functions only (no *args/**kwargs,
-no Layer state), source must be available, and the breaking statement must
-be a top-level ``if``.
+    prefix -> whole-loop ``lax.while_loop`` lowering when the body traces
+    with a stable carry (ONE compiled graph for the entire loop); otherwise
+    an eager bridge drives the loop — condition evaluated eagerly per
+    iteration, body a compiled subgraph reused across iterations -> suffix.
+
+``for`` break (break inside the body)::
+
+    prefix -> iterable evaluated eagerly -> compiled body subgraph per
+    iteration (loop-carried vars threaded as a live tuple) -> suffix.
+
+Each synthesized piece is itself a ``full_graph=False`` StaticFunction, so a
+second break inside it splits again (elif chains, an ``if`` inside a loop
+body, and nested loops all recurse naturally). Layer methods are supported:
+``self`` is bound into the synthesized functions' namespace and parameters
+are functionalized through the sub-StaticFunctions (grads flow like any
+to_static Layer call). Keyword calls and defaults are normalized to
+positional by the caller (jit/api.py) before entering the plan.
+
+Bounds (documented, not silent):
+  - the function signature may not use *args/**kwargs/keyword-only args;
+  - the breaking statement must sit at the TOP LEVEL of the function body
+    (a break buried in a nested statement splits at the enclosing top-level
+    statement when that is an if/for/while, else falls back);
+  - loop bodies containing ``break``/``continue``/``return`` (or loop
+    ``else:`` clauses) fall back to whole-function eager;
+  - loop-carried variables must be defined before the loop (Python allows a
+    body-defined name to escape; the synthesized prefix raises NameError and
+    api.py falls back to eager permanently);
+  - when the function has closure nonlocals (or is a Layer method, whose
+    ``self`` is injected), the synthesized functions see a SNAPSHOT of those
+    bindings taken at split time; plain module-global functions read their
+    module globals LIVE (rebinding a global after the split is visible).
 """
 
 from __future__ import annotations
@@ -73,6 +100,16 @@ def _names(nodes):
     return v
 
 
+def _has_flow_escape(stmts):
+    """break/continue/return anywhere inside (incl. nested) — the loop
+    splitters can't express these; fall back."""
+    for stmt in stmts:
+        for n in ast.walk(stmt):
+            if isinstance(n, (ast.Break, ast.Continue, ast.Return)):
+                return True
+    return False
+
+
 _SYNTH_COUNT = [0]
 
 
@@ -100,33 +137,135 @@ def _make_fn(name, arg_names, body_stmts, globs):
 
 
 class SplitPlan:
-    """Callable implementing prefix-jit -> eager condition -> suffix-jit.
+    """Callable stitching compiled subgraphs with eager bridges.
 
-    The prefix returns EVERY value the suffix reads (including reassigned
-    parameters — `x = x * 2` before the break must reach the suffix as the
-    doubled value, not the caller's argument), so the condition and branches
-    take only the live tuple."""
+    stages: a list of callables run in sequence over the live tuple —
+      - ``("jit", sf)``: live = sf(*live) (sf returns the next live tuple)
+      - ``("if", cond_fn, true_sf, false_sf)``: eager bool bridge, then the
+        taken branch CONSUMES the rest of the function (it is the final
+        stage; its return value is the function's return value)
+      - ``("while", cond_sf, body_sf, whole_sf)``: loop bridge (see
+        _WhileStage)
+      - ``("for", iter_fn, body_sf, n_target)``: eager iteration bridge
+    The final stage returns the function's result; non-final stages return
+    live tuples."""
 
-    def __init__(self, prefix_sf, cond_fn, true_sf, false_sf, live):
+    def __init__(self, prefix_sf, stage, live):
         self._prefix = prefix_sf
+        self._stage = stage
+        self._live = live
+
+    def _live_tuple(self, vals):
+        return vals if isinstance(vals, tuple) else (vals,)
+
+    def __call__(self, *args):
+        live = self._live_tuple(self._prefix(*args))
+        return self._stage(live)
+
+
+class _IfStage:
+    def __init__(self, cond_fn, true_sf, false_sf):
         self._cond = cond_fn
         self._true = true_sf
         self._false = false_sf
-        self._live = live
 
-    def __call__(self, *args):
-        live_vals = self._prefix(*args)
-        if not isinstance(live_vals, tuple):
-            live_vals = (live_vals,)
-        cond = bool(self._cond(*live_vals))
-        branch = self._true if cond else self._false
-        return branch(*live_vals)
+    def __call__(self, live):
+        cond = bool(self._cond(*live))
+        return (self._true if cond else self._false)(*live)
 
 
-def try_split(fn, lineno: Optional[int]) -> Optional[SplitPlan]:
-    """Build a SplitPlan for a break at ``lineno`` (file line), or None."""
+class _WhileStage:
+    """Tensor-condition (or breaking-body) while: try ONE fully-compiled
+    ``lax.while_loop`` over the carry first; if that traces, the whole loop
+    is a single graph. Otherwise drive eagerly: condition bridge per
+    iteration, compiled body subgraph (reused executable) per iteration."""
+
+    def __init__(self, cond_sf, body_sf, suffix_sf):
+        self._cond = cond_sf
+        self._body = body_sf
+        self._suffix = suffix_sf
+        self._lax_ok: Optional[bool] = None
+        self._lax_fn = None
+
+    def _try_lax(self, live):
+        import jax
+
+        from ..core.tensor import Tensor
+
+        cond_fn, body_fn = self._cond._orig_fn, self._body._orig_fn
+
+        def wrap(c):
+            return tuple(Tensor(x) if not isinstance(x, Tensor) else x
+                         for x in c)
+
+        def whole(*carry):
+            def c(state):
+                out = cond_fn(*wrap(state))
+                return out._data if isinstance(out, Tensor) else out
+
+            def b(state):
+                out = body_fn(*wrap(state))
+                out = out if isinstance(out, tuple) else (out,)
+                return tuple(o._data if isinstance(o, Tensor) else o
+                             for o in out)
+
+            init = tuple(o._data if isinstance(o, Tensor) else o
+                         for o in carry)
+            return jax.lax.while_loop(c, b, init)
+
+        from .api import StaticFunction
+
+        fn = StaticFunction(whole, full_graph=True)
+        fn(*live)  # probe: trace errors (unstable carry etc.) raise here
+        return fn
+
+    def __call__(self, live):
+        if self._lax_ok is None:
+            try:
+                self._lax_fn = self._try_lax(live)
+                self._lax_ok = True
+            except Exception:
+                self._lax_ok = False
+        if self._lax_ok:
+            out = self._lax_fn(*live)
+            live = out if isinstance(out, tuple) else (out,)
+        else:
+            while bool(self._cond(*live)):
+                out = self._body(*live)
+                live = out if isinstance(out, tuple) else (out,)
+        return self._suffix(*live)
+
+
+class _ForStage:
+    def __init__(self, iter_fn, body_sf, suffix_sf):
+        self._iter = iter_fn
+        self._body = body_sf
+        self._suffix = suffix_sf
+
+    def __call__(self, live):
+        for item in self._iter(*live):
+            out = self._body(*live, *(item if self._body._pg_targets > 1
+                                      else (item,)))
+            live = out if isinstance(out, tuple) else (out,)
+        return self._suffix(*live)
+
+
+def _sub_static(fn, layer):
     from .api import StaticFunction
 
+    sf = StaticFunction(fn, full_graph=False)
+    if layer is not None:
+        sf._layer = layer  # functionalize params/buffers + grad recording
+    return sf
+
+
+def try_split(fn, lineno: Optional[int], layer=None) -> Optional[SplitPlan]:
+    """Build a SplitPlan for a break at ``lineno`` (file line), or None.
+
+    ``layer``: when ``fn`` is a Layer method, the owning Layer — ``self`` is
+    bound into the synthesized namespace and every compiled piece
+    functionalizes the layer's state (grads flow exactly like the unsplit
+    to_static call)."""
     if lineno is None:
         return None
     try:
@@ -138,22 +277,31 @@ def try_split(fn, lineno: Optional[int]) -> Optional[SplitPlan]:
     if not isinstance(fdef, ast.FunctionDef):
         return None
     a = fdef.args
-    if (a.vararg or a.kwarg or a.kwonlyargs or a.posonlyargs or a.defaults):
+    if a.vararg or a.kwarg or a.kwonlyargs or a.posonlyargs:
         return None
     arg_names = [x.arg for x in a.args]
+    self_name = None
+    if layer is not None:
+        if not arg_names:
+            return None
+        self_name = arg_names[0]  # bound through globals, not as an arg
+        arg_names = arg_names[1:]
     # map the file lineno onto the dedented source's linenos: getsource
     # starts at co_firstlineno (the first decorator when decorated), which
     # is line 1 of the parsed source
-    rel = lineno - fn.__code__.co_firstlineno + 1
+    code = fn.__code__ if not inspect.ismethod(fn) else fn.__func__.__code__
+    rel = lineno - code.co_firstlineno + 1
     idx = None
     for i, stmt in enumerate(fdef.body):
         if stmt.lineno <= rel <= (stmt.end_lineno or stmt.lineno):
             idx = i
             break
-    if idx is None or not isinstance(fdef.body[idx], ast.If):
+    if idx is None:
+        return None
+    brk = fdef.body[idx]
+    if not isinstance(brk, (ast.If, ast.While, ast.For)):
         return None
     prefix_stmts = fdef.body[:idx]
-    if_stmt = fdef.body[idx]
     rest = fdef.body[idx + 1:]
     # an early `return` anywhere in the prefix (e.g. a static guard) would
     # be swallowed by the synthesized live-tuple return — don't split
@@ -161,33 +309,90 @@ def try_split(fn, lineno: Optional[int]) -> Optional[SplitPlan]:
            for stmt in prefix_stmts for n in ast.walk(stmt)):
         return None
 
-    # live set: everything the suffix reads that exists at the break —
-    # arguments INCLUDED (a reassigned parameter must flow through the
-    # prefix's return, not the caller's original value)
-    produced = _names(prefix_stmts).stores | set(arg_names)
-    needed = _names([if_stmt] + rest).loads
-    live = sorted(produced & needed)
+    # ADVICE r4: plain module-level functions exec against fn.__globals__
+    # ITSELF so later global rebinds stay visible; closures and Layer methods
+    # need an overlay namespace -> documented snapshot (module Bounds)
+    nonlocals = inspect.getclosurevars(fn).nonlocals
+    if not nonlocals and layer is None:
+        globs = fn.__globals__
+    else:
+        globs = dict(fn.__globals__)
+        globs.update(nonlocals)
+        if layer is not None:
+            globs[self_name] = layer
 
-    globs = dict(fn.__globals__)
-    globs.update(inspect.getclosurevars(fn).nonlocals)
+    avail = _names(prefix_stmts).stores | set(arg_names)
 
-    ret_live = ast.Return(ast.Tuple(
-        [ast.Name(n, ast.Load()) for n in live], ast.Load()))
+    def ret_tuple(names):
+        return ast.Return(ast.Tuple(
+            [ast.Name(n, ast.Load()) for n in names], ast.Load()))
+
+    if isinstance(brk, ast.If):
+        needed = _names([brk] + rest).loads
+        live = sorted(avail & needed)
+        prefix_fn = _make_fn("__pg_prefix", arg_names,
+                             prefix_stmts + [ret_tuple(live)], globs)
+        cond_fn = _make_fn("__pg_cond", live,
+                           [ast.Return(brk.test)], globs)
+        true_fn = _make_fn("__pg_true", live, brk.body + rest, globs)
+        false_fn = _make_fn("__pg_false", live, (brk.orelse or []) + rest,
+                            globs)
+        stage = _IfStage(cond_fn,
+                         _sub_static(true_fn, layer),
+                         _sub_static(false_fn, layer))
+        return SplitPlan(_sub_static(prefix_fn, layer), stage, live)
+
+    if isinstance(brk, ast.While):
+        if brk.orelse or _has_flow_escape(brk.body):
+            return None
+        body_n = _names(brk.body)
+        cond_loads = _names([ast.Expr(brk.test)]).loads
+        rest_loads = _names(rest).loads
+        # loop-carried live set: read by the condition/body/rest AND defined
+        # before the loop (body-only names are per-iteration temps; a
+        # body-defined name escaping into rest -> prefix NameError -> eager)
+        live = sorted(avail & (cond_loads | body_n.loads | rest_loads
+                               | (body_n.stores & rest_loads)))
+        prefix_fn = _make_fn("__pg_prefix", arg_names,
+                             prefix_stmts + [ret_tuple(live)], globs)
+        cond_fn = _make_fn("__pg_wcond", live,
+                           [ast.Return(brk.test)], globs)
+        body_fn = _make_fn("__pg_wbody", live,
+                           list(brk.body) + [ret_tuple(live)], globs)
+        suffix_fn = _make_fn("__pg_suffix", live, rest or [ast.Pass()],
+                             globs)
+        stage = _WhileStage(_sub_static(cond_fn, layer),
+                            _sub_static(body_fn, layer),
+                            _sub_static(suffix_fn, layer))
+        return SplitPlan(_sub_static(prefix_fn, layer), stage, live)
+
+    # ast.For
+    if brk.orelse or _has_flow_escape(brk.body):
+        return None
+    tgt = brk.target
+    if isinstance(tgt, ast.Name):
+        targets = [tgt.id]
+    elif isinstance(tgt, ast.Tuple) and all(
+            isinstance(e, ast.Name) for e in tgt.elts):
+        targets = [e.id for e in tgt.elts]
+    else:
+        return None
+    body_n = _names(brk.body)
+    rest_loads = _names(rest).loads
+    if set(targets) & rest_loads:
+        # Python leaks the loop variable; the splitter doesn't — fall back
+        return None
+    iter_loads = _names([ast.Expr(brk.iter)]).loads
+    live = sorted((avail - set(targets))
+                  & (iter_loads | body_n.loads | rest_loads
+                     | (body_n.stores & rest_loads)))
     prefix_fn = _make_fn("__pg_prefix", arg_names,
-                         prefix_stmts + [ret_live], globs)
-    cond_fn = _make_fn("__pg_cond", live,
-                       [ast.Return(if_stmt.test)], globs)
-    true_fn = _make_fn("__pg_true", live,
-                       if_stmt.body + rest, globs)
-    false_fn = _make_fn("__pg_false", live,
-                        (if_stmt.orelse or []) + rest, globs)
-
-    # prefix: one jitted graph (a break before the if would have surfaced
-    # earlier, but keep the eager safety net); suffixes: full_graph=False so
-    # a second break splits again
-    return SplitPlan(
-        StaticFunction(prefix_fn, full_graph=False),
-        cond_fn,
-        StaticFunction(true_fn, full_graph=False),
-        StaticFunction(false_fn, full_graph=False),
-        live)
+                         prefix_stmts + [ret_tuple(live)], globs)
+    iter_fn = _make_fn("__pg_iter", live, [ast.Return(brk.iter)], globs)
+    body_fn = _make_fn("__pg_fbody", live + targets,
+                       list(brk.body) + [ret_tuple(live)], globs)
+    suffix_fn = _make_fn("__pg_suffix", live, rest or [ast.Pass()], globs)
+    body_sf = _sub_static(body_fn, layer)
+    body_sf._pg_targets = len(targets)
+    stage = _ForStage(iter_fn, body_sf, _sub_static(suffix_fn, layer))
+    return SplitPlan(_sub_static(prefix_fn, layer), stage, live)

@@ -71,9 +71,9 @@ class LlamaConfig:
         self.tie_word_embeddings = tie_word_embeddings
         self.dtype = dtype
         self.recompute = recompute
-        if remat_policy not in ("flash", "flash_mlp", "full"):
-            raise ValueError(f"remat_policy must be 'flash', 'flash_mlp' or "
-                             f"'full', got {remat_policy!r}")
+        if remat_policy not in ("flash", "flash_qkv", "flash_mlp", "full"):
+            raise ValueError(f"remat_policy must be 'flash', 'flash_qkv', "
+                             f"'flash_mlp' or 'full', got {remat_policy!r}")
         self.remat_policy = remat_policy
         # partial remat: layer i is rematerialized iff i % remat_every == 0
         # (1 = every layer, the reference recompute default; 2 = half the
@@ -178,6 +178,14 @@ class LlamaAttention(Layer):
         k = constrain(k, "batch", "seq", "kv_heads", "head_dim")
         v = constrain(v, "batch", "seq", "kv_heads", "head_dim")
         q, k = apply_rotary_pos_emb(q, k, cos, sin)
+        # named for the 'flash_qkv' remat policy: saving the rope'd q/k/v
+        # (~100MB/layer at the 853M b4 seq-4096 shape) lets backward skip the
+        # qkv-projection + rope + input-norm recompute entirely
+        from jax.ad_checkpoint import checkpoint_name
+
+        q = checkpoint_name(q, "attn_q")
+        k = checkpoint_name(k, "attn_k")
+        v = checkpoint_name(v, "attn_v")
         out = _attention(q, k, v, self.config, attn_bias)
         out = out.reshape(b, s, self.num_heads * hd)
         out = jnp.matmul(out, self.o_proj_weight._data)
@@ -488,6 +496,12 @@ def remat_policy_of(cfg):
     if p == "flash":
         return jax.checkpoint_policies.save_only_these_names(
             "flash_out", "flash_lse")
+    if p == "flash_qkv":
+        # additionally saves the rope'd q/k/v heads — kills the qkv-proj +
+        # rope + input-norm recompute for ~100MB/layer (853M b4 seq-4096);
+        # the remat tax then reduces to o-proj + MLP recompute
+        return jax.checkpoint_policies.save_only_these_names(
+            "flash_out", "flash_lse", "attn_q", "attn_k", "attn_v")
     if p == "flash_mlp":
         # additionally saves the swiglu product — measured OOM on the 853M
         # seq-4096 batch-4 config (16.8G > 15.75G hbm); viable for smaller

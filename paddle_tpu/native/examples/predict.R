@@ -1,0 +1,41 @@
+# R inference client over libpaddle_tpu_infer.so.
+#
+# Parity anchor: the reference's R client (r/example/mobilenet.r) over its
+# C predictor API. Here the artifact is the StableHLO .mlir from
+# paddle.jit.save; weights load from the raw .bin companion (see predict.c
+# for the layout). The handle-passing entry points go through the tiny
+# .Call shim (r_shim.c) because base-R .C cannot carry opaque pointers.
+#
+# Build the shim against the inference library:
+#   R CMD SHLIB r_shim.c -L. -lpaddle_tpu_infer
+# Run:
+#   Rscript predict.R model.mlir weights.bin input.f32 output.f32
+
+args <- commandArgs(trailingOnly = TRUE)
+if (length(args) != 4) {
+  stop("usage: Rscript predict.R model.mlir weights.bin input.f32 output.f32")
+}
+dyn.load(file.path(dirname(sys.frame(1)$ofile %||% "."), "r_shim.so"))
+
+`%||%` <- function(a, b) if (is.null(a)) b else a
+
+h <- .Call("R_ptpu_load", args[1])
+n_in <- .Call("R_ptpu_num_inputs", h)
+
+wf <- file(args[2], "rb")
+inputs <- vector("list", n_in)
+for (i in seq_len(n_in)) {
+  n <- .Call("R_ptpu_input_numel", h, i - 1L)
+  src <- if (i < n_in) wf else file(args[3], "rb")
+  inputs[[i]] <- readBin(src, what = "numeric", n = n, size = 4,
+                         endian = "little")
+  if (i == n_in) close(src)
+}
+close(wf)
+
+out <- .Call("R_ptpu_run", h, inputs)   # list of f32 output vectors
+con <- file(args[4], "wb")
+for (o in out) writeBin(o, con, size = 4, endian = "little")
+close(con)
+.Call("R_ptpu_free", h)
+cat("wrote", length(out), "output tensor(s)\n")

@@ -1,0 +1,87 @@
+/* Minimal C inference client over libpaddle_tpu_infer.so.
+ *
+ * Parity anchor: the reference's C clients over
+ * paddle/fluid/inference/capi_exp/pd_inference_api.h. Here the artifact is
+ * the StableHLO .mlir that paddle.jit.save emits; the weights ship in the
+ * companion .pdiparams (this demo reads them from a raw .bin the exporter
+ * writes — see tests/test_capi_examples.py — since pickle is a Python
+ * format).
+ *
+ * Build:
+ *   gcc -O2 -o predict predict.c -L. -lpaddle_tpu_infer -lm
+ * Run:
+ *   ./predict model.mlir weights.bin  < input.f32 > output.f32
+ * where weights.bin is the concatenation of every signature input except
+ * the last (f32, row-major, signature order) and stdin carries the final
+ * (activation) input.
+ */
+
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+/* --- the full C surface of libpaddle_tpu_infer.so --- */
+void* ptpu_load(const char* mlir_path, char* err, int errlen);
+int ptpu_num_inputs(const void* h);
+int ptpu_num_outputs(const void* h);
+int ptpu_input_rank(const void* h, int i);
+void ptpu_input_shape(const void* h, int i, long long* dims);
+long long ptpu_input_numel(const void* h, int i);
+int ptpu_run(void* h, const float* const* inputs, char* err, int errlen);
+int ptpu_run_partial(void* h, const float* const* inputs, int first_input,
+                     char* err, int errlen);
+long long ptpu_output_numel(const void* h, int k);
+int ptpu_output_rank(const void* h, int k);
+void ptpu_output_shape(const void* h, int k, long long* dims);
+void ptpu_get_output(const void* h, int k, float* buf);
+void ptpu_free(void* h);
+
+int main(int argc, char** argv) {
+  if (argc != 3) {
+    fprintf(stderr, "usage: %s model.mlir weights.bin\n", argv[0]);
+    return 2;
+  }
+  char err[256] = {0};
+  void* h = ptpu_load(argv[1], err, sizeof(err));
+  if (!h) {
+    fprintf(stderr, "load failed: %s\n", err);
+    return 1;
+  }
+  int n_in = ptpu_num_inputs(h);
+
+  /* weights.bin = inputs [0, n_in-1) concatenated; stdin = input n_in-1 */
+  FILE* wf = fopen(argv[2], "rb");
+  if (!wf) {
+    fprintf(stderr, "cannot open %s\n", argv[2]);
+    return 1;
+  }
+  const float** bufs = (const float**)malloc(sizeof(float*) * n_in);
+  for (int i = 0; i < n_in; ++i) {
+    long long n = ptpu_input_numel(h, i);
+    float* b = (float*)malloc(sizeof(float) * n);
+    size_t got = fread(b, sizeof(float), (size_t)n,
+                       i + 1 < n_in ? wf : stdin);
+    if ((long long)got != n) {
+      fprintf(stderr, "input %d: expected %lld floats, got %zu\n", i, n, got);
+      return 1;
+    }
+    bufs[i] = b;
+  }
+  fclose(wf);
+
+  if (ptpu_run(h, bufs, err, sizeof(err)) != 0) {
+    fprintf(stderr, "run failed: %s\n", err);
+    return 1;
+  }
+  for (int k = 0; k < ptpu_num_outputs(h); ++k) {
+    long long n = ptpu_output_numel(h, k);
+    float* out = (float*)malloc(sizeof(float) * n);
+    ptpu_get_output(h, k, out);
+    fwrite(out, sizeof(float), (size_t)n, stdout);
+    free(out);
+  }
+  for (int i = 0; i < n_in; ++i) free((void*)bufs[i]);
+  free(bufs);
+  ptpu_free(h);
+  return 0;
+}

@@ -1,0 +1,107 @@
+// Go inference client over libpaddle_tpu_infer.so via cgo.
+//
+// Parity anchor: the reference's Go API (fluid/inference/goapi) over its C
+// predictor. Here the artifact is the StableHLO .mlir from paddle.jit.save;
+// weights load from the raw .bin companion (see predict.c for the layout).
+//
+// Build:
+//   CGO_LDFLAGS="-L. -lpaddle_tpu_infer" go build -o predict_go predict.go
+// Run:
+//   LD_LIBRARY_PATH=. ./predict_go model.mlir weights.bin < in.f32 > out.f32
+
+package main
+
+/*
+#cgo LDFLAGS: -lpaddle_tpu_infer
+#include <stdlib.h>
+
+void* ptpu_load(const char* mlir_path, char* err, int errlen);
+int ptpu_num_inputs(const void* h);
+int ptpu_num_outputs(const void* h);
+long long ptpu_input_numel(const void* h, int i);
+int ptpu_run(void* h, const float* const* inputs, char* err, int errlen);
+long long ptpu_output_numel(const void* h, int k);
+void ptpu_get_output(const void* h, int k, float* buf);
+void ptpu_free(void* h);
+*/
+import "C"
+
+import (
+	"encoding/binary"
+	"fmt"
+	"io"
+	"math"
+	"os"
+	"unsafe"
+)
+
+func readFloats(r io.Reader, n int64) ([]float32, error) {
+	raw := make([]byte, 4*n)
+	if _, err := io.ReadFull(r, raw); err != nil {
+		return nil, err
+	}
+	out := make([]float32, n)
+	for i := range out {
+		out[i] = math.Float32frombits(
+			binary.LittleEndian.Uint32(raw[4*i : 4*i+4]))
+	}
+	return out, nil
+}
+
+func main() {
+	if len(os.Args) != 3 {
+		fmt.Fprintf(os.Stderr, "usage: %s model.mlir weights.bin\n", os.Args[0])
+		os.Exit(2)
+	}
+	errBuf := make([]byte, 256)
+	cpath := C.CString(os.Args[1])
+	defer C.free(unsafe.Pointer(cpath))
+	h := C.ptpu_load(cpath, (*C.char)(unsafe.Pointer(&errBuf[0])), 256)
+	if h == nil {
+		fmt.Fprintf(os.Stderr, "load failed: %s\n", errBuf)
+		os.Exit(1)
+	}
+	defer C.ptpu_free(h)
+
+	nIn := int(C.ptpu_num_inputs(h))
+	wf, err := os.Open(os.Args[2])
+	if err != nil {
+		fmt.Fprintln(os.Stderr, err)
+		os.Exit(1)
+	}
+	defer wf.Close()
+
+	bufs := make([][]float32, nIn)
+	ptrs := make([]*C.float, nIn)
+	for i := 0; i < nIn; i++ {
+		n := int64(C.ptpu_input_numel(h, C.int(i)))
+		src := io.Reader(wf)
+		if i == nIn-1 {
+			src = os.Stdin
+		}
+		b, err := readFloats(src, n)
+		if err != nil {
+			fmt.Fprintf(os.Stderr, "input %d: %v\n", i, err)
+			os.Exit(1)
+		}
+		bufs[i] = b
+		ptrs[i] = (*C.float)(unsafe.Pointer(&b[0]))
+	}
+	rc := C.ptpu_run(h, (**C.float)(unsafe.Pointer(&ptrs[0])),
+		(*C.char)(unsafe.Pointer(&errBuf[0])), 256)
+	if rc != 0 {
+		fmt.Fprintf(os.Stderr, "run failed: %s\n", errBuf)
+		os.Exit(1)
+	}
+	for k := 0; k < int(C.ptpu_num_outputs(h)); k++ {
+		n := int64(C.ptpu_output_numel(h, C.int(k)))
+		out := make([]float32, n)
+		C.ptpu_get_output(h, C.int(k), (*C.float)(unsafe.Pointer(&out[0])))
+		raw := make([]byte, 4*n)
+		for i, v := range out {
+			binary.LittleEndian.PutUint32(raw[4*i:], math.Float32bits(v))
+		}
+		os.Stdout.Write(raw)
+	}
+	_ = bufs
+}

@@ -1,0 +1,76 @@
+/* .Call shim exposing libpaddle_tpu_infer.so to R (predict.R).
+ *
+ * Base-R .C cannot carry opaque handles; this shim wraps the C ABI in
+ * SEXP externalptr + numeric vectors. Build:
+ *   R CMD SHLIB r_shim.c -L. -lpaddle_tpu_infer
+ */
+
+#include <R.h>
+#include <Rinternals.h>
+#include <stdlib.h>
+#include <string.h>
+
+void* ptpu_load(const char* mlir_path, char* err, int errlen);
+int ptpu_num_inputs(const void* h);
+int ptpu_num_outputs(const void* h);
+long long ptpu_input_numel(const void* h, int i);
+int ptpu_run(void* h, const float* const* inputs, char* err, int errlen);
+long long ptpu_output_numel(const void* h, int k);
+void ptpu_get_output(const void* h, int k, float* buf);
+void ptpu_free(void* h);
+
+SEXP R_ptpu_load(SEXP path) {
+  char err[256] = {0};
+  void* h = ptpu_load(CHAR(STRING_ELT(path, 0)), err, sizeof(err));
+  if (!h) error("ptpu_load: %s", err);
+  return R_MakeExternalPtr(h, R_NilValue, R_NilValue);
+}
+
+SEXP R_ptpu_num_inputs(SEXP hp) {
+  return ScalarInteger(ptpu_num_inputs(R_ExternalPtrAddr(hp)));
+}
+
+SEXP R_ptpu_input_numel(SEXP hp, SEXP i) {
+  return ScalarReal(
+      (double)ptpu_input_numel(R_ExternalPtrAddr(hp), asInteger(i)));
+}
+
+SEXP R_ptpu_run(SEXP hp, SEXP inputs) {
+  void* h = R_ExternalPtrAddr(hp);
+  int n_in = ptpu_num_inputs(h);
+  if (LENGTH(inputs) != n_in) error("expected %d inputs", n_in);
+  const float** bufs = (const float**)malloc(sizeof(float*) * n_in);
+  for (int i = 0; i < n_in; ++i) {
+    long long n = ptpu_input_numel(h, i);
+    SEXP v = VECTOR_ELT(inputs, i);
+    if (LENGTH(v) != (int)n) error("input %d: expected %lld elements", i, n);
+    float* b = (float*)malloc(sizeof(float) * n);
+    for (long long j = 0; j < n; ++j) b[j] = (float)REAL(v)[j];
+    bufs[i] = b;
+  }
+  char err[256] = {0};
+  int rc = ptpu_run(h, bufs, err, sizeof(err));
+  for (int i = 0; i < n_in; ++i) free((void*)bufs[i]);
+  free(bufs);
+  if (rc != 0) error("ptpu_run: %s", err);
+  int n_out = ptpu_num_outputs(h);
+  SEXP out = PROTECT(allocVector(VECSXP, n_out));
+  for (int k = 0; k < n_out; ++k) {
+    long long n = ptpu_output_numel(h, k);
+    float* buf = (float*)malloc(sizeof(float) * n);
+    ptpu_get_output(h, k, buf);
+    SEXP v = allocVector(REALSXP, (R_xlen_t)n);
+    for (long long j = 0; j < n; ++j) REAL(v)[j] = buf[j];
+    free(buf);
+    SET_VECTOR_ELT(out, k, v);
+  }
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP R_ptpu_free(SEXP hp) {
+  void* h = R_ExternalPtrAddr(hp);
+  if (h) ptpu_free(h);
+  R_ClearExternalPtr(hp);
+  return R_NilValue;
+}

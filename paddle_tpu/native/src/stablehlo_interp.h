@@ -206,13 +206,15 @@ struct Program {
   std::vector<std::pair<std::string, std::vector<int64_t>>> args;
   std::vector<std::string> body;   // op lines, in order
   std::string ret_line;
+  // every OTHER func.func in the module, by name — `call @fn(...)` lines
+  // (jax emits private helper functions for nested jits, e.g. relu) execute
+  // these recursively. Populated on the module's @main Program only.
+  std::map<std::string, Program> subfuncs;
 };
 
-inline Program parse(const std::string& text) {
+inline Program parse_one(const std::string& text, size_t fpos,
+                         const std::string& fname) {
   Program p;
-  size_t fpos = text.find("func.func public @main(");
-  if (fpos == std::string::npos) fpos = text.find("func.func @main(");
-  if (fpos == std::string::npos) fail("no @main function found");
   // signature runs until the '{' that opens the body
   size_t open = text.find('{', fpos);
   std::string sig = text.substr(fpos, open - fpos);
@@ -228,7 +230,7 @@ inline Program parse(const std::string& text) {
       p.args.emplace_back(name, parse_tensor_type(sig, tpos));
     ap = e;
   }
-  // body: lines up to the matching close of @main's block
+  // body: lines up to the matching close of the block
   size_t pos = open + 1;
   std::stringstream ss(text.substr(pos));
   std::string line;
@@ -239,14 +241,44 @@ inline Program parse(const std::string& text) {
       break;
     }
     if (t.find("= stablehlo.") != std::string::npos ||
-        t.find("= mhlo.") != std::string::npos)
+        t.find("= mhlo.") != std::string::npos ||
+        t.find("= call @") != std::string::npos ||
+        t.find("= func.call @") != std::string::npos)
       p.body.push_back(t);
   }
-  if (p.ret_line.empty()) fail("no return found in @main");
+  if (p.ret_line.empty()) fail("no return found in @" + fname);
   return p;
 }
 
+inline Program parse(const std::string& text) {
+  size_t fpos = text.find("func.func public @main(");
+  if (fpos == std::string::npos) fpos = text.find("func.func @main(");
+  if (fpos == std::string::npos) fail("no @main function found");
+  Program p = parse_one(text, fpos, "main");
+  // collect every other function for call-site resolution
+  size_t q = 0;
+  while ((q = text.find("func.func", q)) != std::string::npos) {
+    size_t at = text.find('@', q);
+    size_t lp = at == std::string::npos ? std::string::npos
+                                        : text.find('(', at);
+    if (at == std::string::npos || lp == std::string::npos) break;
+    std::string name = text.substr(at + 1, lp - at - 1);
+    if (name != "main") p.subfuncs[name] = parse_one(text, q, name);
+    q = lp;
+  }
+  return p;
+}
+
+inline void run_impl(const Program& p, std::map<std::string, Tensor>& env,
+                     const std::map<std::string, Program>& funcs);
+
+// public entry: @main executes with its module's function table in scope
 inline void run(const Program& p, std::map<std::string, Tensor>& env) {
+  run_impl(p, env, p.subfuncs);
+}
+
+inline void run_impl(const Program& p, std::map<std::string, Tensor>& env,
+                     const std::map<std::string, Program>& funcs) {
   auto ew1 = [&](const std::string& lhs, const Tensor& a,
                  float (*f)(float)) {
     Tensor out = a;
@@ -266,6 +298,35 @@ inline void run(const Program& p, std::map<std::string, Tensor>& env) {
     size_t eq = line.find(" = ");
     std::string lhs = strip(line.substr(0, eq));
     std::string rest = line.substr(eq + 3);
+    if (rest.rfind("call @", 0) == 0 || rest.rfind("func.call @", 0) == 0) {
+      // nested-jit helper function (e.g. jax's private @relu): execute the
+      // callee with a fresh env over the SAME module function table
+      if (lhs.find(':') != std::string::npos)
+        fail("multi-result call unsupported (restricted interpreter)");
+      size_t at = rest.find('@');
+      size_t lp = rest.find('(', at);
+      std::string callee = rest.substr(at + 1, lp - at - 1);
+      auto fit = funcs.find(callee);
+      if (fit == funcs.end()) fail("call to unknown function @" + callee);
+      const Program& cp = fit->second;
+      auto cops = parse_operands(rest.substr(lp));
+      if (cops.size() != cp.args.size())
+        fail("call arity mismatch @" + callee);
+      std::map<std::string, Tensor> sub;
+      for (size_t i = 0; i < cops.size(); ++i) {
+        auto it = env.find(cops[i]);
+        if (it == env.end()) fail("undefined value " + cops[i]);
+        sub[cp.args[i].first] = it->second;
+      }
+      run_impl(cp, sub, funcs);
+      auto rets = parse_operands(cp.ret_line);
+      if (rets.size() != 1)
+        fail("multi-result call unsupported @" + callee);
+      auto rit = sub.find(rets[0]);
+      if (rit == sub.end()) fail("undefined return " + rets[0]);
+      env[lhs] = std::move(rit->second);
+      continue;
+    }
     size_t dot = rest.find('.');
     size_t sp = rest.find_first_of(" (", dot);
     std::string op = rest.substr(dot + 1, sp - dot - 1);

@@ -22,16 +22,19 @@ Forward:
     backward can recompute P row-stably; inference skips the write
 
 Backward (FlashAttention-2 style, two kernels sharing the saved lse):
-  - dQ kernel: grid (b, hq, q_blocks, kv_blocks), same kv streaming/clamping
-    as forward; dS = P*(dP-delta), dQ accumulates in VMEM scratch. delta =
-    rowsum(dO * O) is FUSED into kv step 0 (dO and O are already VMEM-resident
-    there) and emitted as a lane-broadcast side output — no separate XLA pass
-    over dO/O and no extra HBM round-trip for delta.
+  - dQ kernel: grid (b, kv_heads, q_blocks, kv_blocks), same kv
+    streaming/clamping as forward; dS = P*(dP-delta), dQ accumulates in VMEM
+    scratch. delta = rowsum(dO * O) is FUSED into kv step 0 (dO and O are
+    already VMEM-resident there) and emitted as a lane-broadcast side output
+    — no separate XLA pass over dO/O and no extra HBM round-trip for delta.
   - dK/dV kernel: grid (b, kv_heads, k_blocks, q_blocks) — q innermost so the
-    fp32 VMEM accumulators persist across q steps; the GQA head group is a
-    static python loop (all q-heads of one kv-head arrive in one block via
-    a `group`-sized head block in the BlockSpec). Causal skip is a pl.when.
+    fp32 VMEM accumulators persist across q steps. Causal skip is a pl.when.
     Consumes the dQ kernel's delta output.
+  - GQA batching (both kernels): all `group` q-heads of one kv-head arrive in
+    one head-blocked q/do/lse block and are FOLDED into the matmul M dim —
+    [group, BQ, d] -> [group*BQ, d] — so each program issues one large MXU
+    contraction instead of `group` small ones, and K/V blocks stream from HBM
+    once per kv-head (not once per q-head).
 
 Layouts: public API is [batch, seq, heads, head_dim] (reference layout);
 kernels run on [batch, heads, seq, head_dim].
@@ -51,6 +54,9 @@ DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 NEG_INF = -1e30
 LSE_LANES = 8  # trailing lane dim for lse/delta storage (TPU tiling)
+# folded-row cap for the GQA-batched backward kernels (see _pallas_backward;
+# mutable for in-process block-size A/Bs — value read at TRACE time)
+BWD_ROW_CAP = [int(os.environ.get("PADDLE_TPU_FLASH_BWD_ROWCAP", "1024"))]
 
 
 def _xla_reference(q, k, v, causal, scale):
@@ -306,13 +312,37 @@ def _pallas_forward(q, k, v, causal, scale, block_q, block_k, interpret,
 # backward kernels
 # ---------------------------------------------------------------------------
 
+def _fold_heads(x):
+    """[group, rows, d] -> [group*rows, d] (contiguous collapse of the two
+    leading dims — free on TPU, rows stay sublane-major)."""
+    g, r, d = x.shape
+    return x.reshape(g * r, d)
+
+
+def _fold_lanes(ref_slice):
+    """[group, LANES, BQ] lane-broadcast lse/delta block -> [group*BQ, 1]
+    column (one small [1, BQ] -> [BQ, 1] relayout per group, batched)."""
+    g, _, bq = ref_slice.shape
+    col = jnp.swapaxes(ref_slice[:, :1, :], 1, 2)          # [g, BQ, 1]
+    return col.reshape(g * bq, 1)
+
+
+def _row_positions(qi, block_q, group, block_k):
+    """Absolute q positions for the folded [group*BQ, BK] score rows: row r
+    of the fold is q row (r % BQ) of q block qi (heads repeat the rows)."""
+    r = jax.lax.broadcasted_iota(jnp.int32, (group * block_q, block_k), 0)
+    return qi * block_q + r % block_q
+
+
 def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, *refs,
                       scale, causal, block_q, block_k, kv_len, q_len, n_kv,
-                      with_glse=False, with_seg=False, with_rowmask=False):
-    """dQ for one (batch, q_head, q_block, kv_block); K/V stream through the
-    innermost grid dim like forward. delta = rowsum(dO*O) [− l̄] is computed
-    at kv step 0 (dO/O are VMEM-resident) into scratch and emitted as a
-    lane-broadcast side output for the dK/dV kernel — the separate XLA
+                      group, with_glse=False, with_seg=False,
+                      with_rowmask=False):
+    """dQ for one (batch, KV head, q_block, kv_block); K/V stream through the
+    innermost grid dim like forward, fetched ONCE per kv-head (all `group`
+    q-heads fold into the matmul M dim). delta = rowsum(dO*O) [− l̄] is
+    computed at kv step 0 (dO/O are VMEM-resident) into scratch and emitted
+    as a lane-broadcast side output for the dK/dV kernel — the separate XLA
     delta pass and its HBM round-trip are gone."""
     if with_glse:
         glse_ref = refs[0]
@@ -329,17 +359,17 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, *refs,
 
     @pl.when(ki == 0)
     def _():
-        do0 = do_ref[0, 0].astype(jnp.float32)
-        o0 = o_ref[0, 0].astype(jnp.float32)
-        delta = jnp.sum(do0 * o0, axis=-1, keepdims=True)  # [BQ, 1]
+        do0 = _fold_heads(do_ref[0].astype(jnp.float32))   # [G*BQ, d]
+        o0 = _fold_heads(o_ref[0].astype(jnp.float32))
+        delta = jnp.sum(do0 * o0, axis=-1, keepdims=True)  # [G*BQ, 1]
         if with_glse:
             # ring attention's lse cotangent folds into delta: ds = p·(dp−δ+l̄)
-            delta = delta - jnp.swapaxes(glse_ref[0, 0][:1, :], 0, 1)
+            delta = delta - _fold_lanes(glse_ref[0])
         dq_sc[...] = jnp.zeros_like(dq_sc)
         delta_sc[...] = jnp.broadcast_to(delta, delta_sc.shape)
-        # delta output is lanes-second-minor [LANES, BQ] like lse
-        delta_ref[0, 0] = jnp.broadcast_to(jnp.swapaxes(delta, 0, 1),
-                                           delta_ref.shape[2:])
+        # delta output is lanes-second-minor [group, LANES, BQ] like lse
+        dcol = jnp.swapaxes(delta.reshape(group, block_q, 1), 1, 2)
+        delta_ref[0] = jnp.broadcast_to(dcol, delta_ref.shape[1:])
 
     offset = kv_len - q_len
     run = True
@@ -348,44 +378,43 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, *refs,
 
     @pl.when(run)
     def _():
-        q = q_ref[0, 0].astype(jnp.float32)                # [BQ, d]
-        do = do_ref[0, 0].astype(jnp.float32)              # [BQ, d]
-        lse = jnp.swapaxes(lse_ref[0, 0][:1, :], 0, 1)     # [BQ, 1]
-        delta = delta_sc[...][:, :1]                       # [BQ, 1]
+        q = _fold_heads(q_ref[0].astype(jnp.float32))      # [G*BQ, d]
+        do = _fold_heads(do_ref[0].astype(jnp.float32))
+        lse = _fold_lanes(lse_ref[0])                      # [G*BQ, 1]
+        delta = delta_sc[...][:, :1]                       # [G*BQ, 1]
         kb = k_ref[0, 0].astype(jnp.float32)               # [BK, d]
         vb = v_ref[0, 0].astype(jnp.float32)
         s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
+            q_pos = _row_positions(qi, block_q, group, block_k)
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
+                jnp.int32, (group * block_q, block_k), 1)
             s = jnp.where(q_pos + offset >= k_pos, s, NEG_INF)
         if with_seg:
             qs = qseg_ref[0][:, 0]                         # [BQ]
             ks = kseg_ref[0][:, 0]
+            qs = jnp.broadcast_to(qs[None, :], (group, block_q)).reshape(-1)
             s = jnp.where(qs[:, None] == ks[None, :], s, NEG_INF)
         if with_rowmask:
             st = start_ref[0, 0][:, 0]
             en = end_ref[0, 0][:, 0]
-            rows = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
+            rows = _row_positions(qi, block_q, group, block_k)
             s = jnp.where((rows >= st[None, :]) & (rows < en[None, :]),
                           NEG_INF, s)
         # rows with no valid keys store lse = NEG_INF; exp(s - lse) would give
         # p = 1 there (s is NEG_INF too) — force those rows to zero instead
-        p = jnp.where(lse > NEG_INF / 2, jnp.exp(s - lse), 0.0)   # [BQ, BK]
+        p = jnp.where(lse > NEG_INF / 2, jnp.exp(s - lse), 0.0)  # [G*BQ, BK]
         dp = jax.lax.dot_general(do, vb, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale                      # [BQ, BK]
+        ds = p * (dp - delta) * scale                      # [G*BQ, BK]
         dq_sc[...] = dq_sc[...] + jax.lax.dot_general(
             ds, kb, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(ki == n_kv - 1)
     def _():
-        dq_ref[0, 0] = dq_sc[...].astype(dq_ref.dtype)
+        dq_ref[0] = dq_sc[...].reshape(group, block_q, -1).astype(dq_ref.dtype)
 
 
 def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -394,7 +423,9 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                        with_rowmask=False):
     """dK/dV for one (batch, kv_head, k_block); q_blocks is the innermost grid
     dim so dk_acc/dv_acc VMEM scratch persists and accumulates across q steps.
-    All `group` q-heads of this kv-head arrive in one head-blocked q block."""
+    All `group` q-heads of this kv-head arrive in one head-blocked q block and
+    fold into the contraction dims: one [G*BQ, BK] score matrix, dV/dK as
+    single G*BQ-deep contractions (vs `group` small ones)."""
     if with_seg:
         qseg_ref, kseg_ref = refs[0], refs[1]
         refs = refs[2:]
@@ -421,45 +452,41 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _():
         kb = k_ref[0, 0].astype(jnp.float32)               # [BK, d]
         vb = v_ref[0, 0].astype(jnp.float32)               # [BK, d]
-        dk = dk_acc[...]
-        dv = dv_acc[...]
-        for g in range(group):                             # static unroll (GQA)
-            q = q_ref[0, g].astype(jnp.float32)            # [BQ, d]
-            do = do_ref[0, g].astype(jnp.float32)          # [BQ, d]
-            lse = jnp.swapaxes(lse_ref[0, g][:1, :], 0, 1)     # [BQ, 1]
-            delta = jnp.swapaxes(delta_ref[0, g][:1, :], 0, 1)  # [BQ, 1]
-            s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
-                                    preferred_element_type=jnp.float32) * scale
-            if causal:
-                q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_k), 0)
-                k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_k), 1)
-                s = jnp.where(q_pos + offset >= k_pos, s, NEG_INF)
-            if with_seg:
-                qsg = qseg_ref[0][:, 0]
-                ksg = kseg_ref[0][:, 0]
-                s = jnp.where(qsg[:, None] == ksg[None, :], s, NEG_INF)
-            if with_rowmask:
-                st = start_ref[0, 0][:, 0]
-                en = end_ref[0, 0][:, 0]
-                rows = qi * block_q + jax.lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_k), 0)
-                s = jnp.where((rows >= st[None, :]) & (rows < en[None, :]),
-                              NEG_INF, s)
-            # see dq kernel: fully-masked rows (lse == NEG_INF) must give p = 0
-            p = jnp.where(lse > NEG_INF / 2, jnp.exp(s - lse), 0.0)
-            # dV += P^T · dO
-            dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
-                                          preferred_element_type=jnp.float32)
-            dp = jax.lax.dot_general(do, vb, (((1,), (1,)), ((), ())),
-                                     preferred_element_type=jnp.float32)
-            ds = p * (dp - delta) * scale                  # [BQ, BK]
-            # dK += dS^T · Q
-            dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
-                                          preferred_element_type=jnp.float32)
-        dk_acc[...] = dk
-        dv_acc[...] = dv
+        q = _fold_heads(q_ref[0].astype(jnp.float32))      # [G*BQ, d]
+        do = _fold_heads(do_ref[0].astype(jnp.float32))
+        lse = _fold_lanes(lse_ref[0])                      # [G*BQ, 1]
+        delta = _fold_lanes(delta_ref[0])
+        s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = _row_positions(qi, block_q, group, block_k)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (group * block_q, block_k), 1)
+            s = jnp.where(q_pos + offset >= k_pos, s, NEG_INF)
+        if with_seg:
+            qsg = qseg_ref[0][:, 0]
+            ksg = kseg_ref[0][:, 0]
+            qsg = jnp.broadcast_to(qsg[None, :], (group, block_q)).reshape(-1)
+            s = jnp.where(qsg[:, None] == ksg[None, :], s, NEG_INF)
+        if with_rowmask:
+            st = start_ref[0, 0][:, 0]
+            en = end_ref[0, 0][:, 0]
+            rows = _row_positions(qi, block_q, group, block_k)
+            s = jnp.where((rows >= st[None, :]) & (rows < en[None, :]),
+                          NEG_INF, s)
+        # see dq kernel: fully-masked rows (lse == NEG_INF) must give p = 0
+        p = jnp.where(lse > NEG_INF / 2, jnp.exp(s - lse), 0.0)  # [G*BQ, BK]
+        # dV += P^T · dO — one G*BQ-deep contraction
+        dv_acc[...] = dv_acc[...] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, vb, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale                      # [G*BQ, BK]
+        # dK += dS^T · Q
+        dk_acc[...] = dk_acc[...] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
     @pl.when(qi == nq - 1)
     def _():
@@ -491,8 +518,6 @@ def _pallas_backward(q, k, v, o, lse, do, causal, scale, block_q, block_k,
 
     n_kv = s_kv // block_k
     offset = s_kv - s_q
-    _kv_idx = _make_kv_idx(causal, block_q, offset, block_k, n_kv)
-    _q_idx = _make_q_idx(causal, block_q, offset, block_k, s_q // block_q)
 
     with_glse = g_lse is not None
     with_seg = q_seg is not None
@@ -504,19 +529,52 @@ def _pallas_backward(q, k, v, o, lse, do, causal, scale, block_q, block_k,
                     _seg_lanes(row_end.astype(jnp.int32), s_kv)]
         hm = row_start.shape[1]
 
+    # GQA folding multiplies the score-matrix rows by `group`; bound the
+    # folded [rows, block_k] f32 score/p/dp/ds working set (it must fit the
+    # ~16MB scoped-VMEM stack: 2048 rows x 512 cols OOMed). First shrink the
+    # q block toward rows <= 1024 (still ≥128: block_q is minor in the lse
+    # layout), then — for very wide groups (MQA, group > 8) where even
+    # bq=128 exceeds the row cap — shrink the backward's k block so
+    # rows * block_k stays <= 1024 * 512.
+    # on-chip A/B (benchmarks/flash_block_ab.py, GQA 16/4 d128): folded-row
+    # cap 1024 is fastest at seq 4096 (33.6 vs 25.2 TF/s for 2048), while
+    # long context flips — at seq 16384 cap 2048 (bq 512, bk auto-halved to
+    # 256) wins 67.4 vs 64.7 TF/s. Default: 1024 short, 2048 at >= 8k.
+    row_cap = BWD_ROW_CAP[0]
+    if s_q >= 8192 and row_cap == 1024:
+        row_cap = 2048
+    bq_dq = block_q
+    for c in (512, 256, 128):
+        if group * c <= row_cap and c <= block_q and s_q % c == 0:
+            bq_dq = c
+            break
+    else:
+        if 128 <= block_q and s_q % 128 == 0:
+            bq_dq = 128
+    bk_dq = block_k
+    while (group * bq_dq * bk_dq > row_cap * 512 and bk_dq > 128
+           and bk_dq % 2 == 0 and s_kv % (bk_dq // 2) == 0):
+        bk_dq //= 2
+
     # ---- dQ (+ fused delta side output) ----
-    grid_dq = (b, hq, s_q // block_q, n_kv)
+    # grid is over KV heads: all `group` q-heads of a kv-head are handled by
+    # one program (folded into the matmul M dim), so K/V stream once per
+    # kv-head instead of once per q-head.
+    n_kv_b = s_kv // bk_dq
+    grid_dq = (b, hkv, s_q // bq_dq, n_kv_b)
     dq_kernel = functools.partial(
         _fa_bwd_dq_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, kv_len=s_kv, q_len=s_q, n_kv=n_kv,
-        with_glse=with_glse, with_seg=with_seg, with_rowmask=with_rowmask)
-    _qb = pl.BlockSpec((1, 1, block_q, d),
+        block_q=bq_dq, block_k=bk_dq, kv_len=s_kv, q_len=s_q, n_kv=n_kv_b,
+        group=group, with_glse=with_glse, with_seg=with_seg,
+        with_rowmask=with_rowmask)
+    _kv_idx_dq = _make_kv_idx(causal, bq_dq, offset, bk_dq, n_kv_b)
+    _qb = pl.BlockSpec((1, group, bq_dq, d),
                        lambda bi, hi, qi, ki: (bi, hi, qi, 0))
-    _qlanes = pl.BlockSpec((1, 1, LSE_LANES, block_q),
+    _qlanes = pl.BlockSpec((1, group, LSE_LANES, bq_dq),
                            lambda bi, hi, qi, ki: (bi, hi, 0, qi))
-    _kvb = pl.BlockSpec((1, 1, block_k, d),
-                        lambda bi, hi, qi, ki: (bi, hi // group,
-                                                _kv_idx(qi, ki), 0))
+    _kvb = pl.BlockSpec((1, 1, bk_dq, d),
+                        lambda bi, hi, qi, ki: (bi, hi,
+                                                _kv_idx_dq(qi, ki), 0))
     dq_in_specs = [_qb, _kvb, _kvb, _qb, _qb, _qlanes]
     dq_ops = [qt, kt, vt, dot, ot, lse]
     if with_glse:
@@ -527,19 +585,19 @@ def _pallas_backward(q, k, v, o, lse, do, causal, scale, block_q, block_k,
         dq_ops.append(glse_lanes)
     if with_seg:
         dq_in_specs += [
-            pl.BlockSpec((1, block_q, LSE_LANES),
+            pl.BlockSpec((1, bq_dq, LSE_LANES),
                          lambda bi, hi, qi, ki: (bi, qi, 0)),
-            pl.BlockSpec((1, block_k, LSE_LANES),
-                         lambda bi, hi, qi, ki: (bi, _kv_idx(qi, ki), 0)),
+            pl.BlockSpec((1, bk_dq, LSE_LANES),
+                         lambda bi, hi, qi, ki: (bi, _kv_idx_dq(qi, ki), 0)),
         ]
     if with_rowmask:
         dq_in_specs += [
-            pl.BlockSpec((1, 1, block_k, LSE_LANES),
-                         lambda bi, hi, qi, ki: (bi, (hi // group) % hm,
-                                                 _kv_idx(qi, ki), 0)),
-            pl.BlockSpec((1, 1, block_k, LSE_LANES),
-                         lambda bi, hi, qi, ki: (bi, (hi // group) % hm,
-                                                 _kv_idx(qi, ki), 0)),
+            pl.BlockSpec((1, 1, bk_dq, LSE_LANES),
+                         lambda bi, hi, qi, ki: (bi, hi % hm,
+                                                 _kv_idx_dq(qi, ki), 0)),
+            pl.BlockSpec((1, 1, bk_dq, LSE_LANES),
+                         lambda bi, hi, qi, ki: (bi, hi % hm,
+                                                 _kv_idx_dq(qi, ki), 0)),
         ]
     dq, delta = pl.pallas_call(
         dq_kernel,
@@ -551,8 +609,8 @@ def _pallas_backward(q, k, v, o, lse, do, causal, scale, block_q, block_k,
             jax.ShapeDtypeStruct((b, hq, LSE_LANES, s_q), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((block_q, d), jnp.float32),          # dq accumulator
-            pltpu.VMEM((block_q, LSE_LANES), jnp.float32),  # delta
+            pltpu.VMEM((group * bq_dq, d), jnp.float32),          # dq acc
+            pltpu.VMEM((group * bq_dq, LSE_LANES), jnp.float32),  # delta
         ],
         interpret=interpret,
     )(*dq_ops, *seg_ops)
@@ -560,37 +618,38 @@ def _pallas_backward(q, k, v, o, lse, do, causal, scale, block_q, block_k,
     # ---- dK / dV ----
     # q-heads blocked by `group` so one program sees every q-head of its
     # kv-head; q_blocks innermost so VMEM accumulators carry across q steps.
-    grid_dkv = (b, hkv, s_kv // block_k, s_q // block_q)
+    _q_idx = _make_q_idx(causal, bq_dq, offset, bk_dq, s_q // bq_dq)
+    grid_dkv = (b, hkv, s_kv // bk_dq, s_q // bq_dq)
     dkv_kernel = functools.partial(
         _fa_bwd_dkv_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, kv_len=s_kv, q_len=s_q, group=group,
+        block_q=bq_dq, block_k=bk_dq, kv_len=s_kv, q_len=s_q, group=group,
         with_seg=with_seg, with_rowmask=with_rowmask)
     dkv_in_specs = [
-        pl.BlockSpec((1, group, block_q, d),
+        pl.BlockSpec((1, group, bq_dq, d),
                      lambda bi, hi, ki, qi: (bi, hi, _q_idx(ki, qi), 0)),
-        pl.BlockSpec((1, 1, block_k, d),
+        pl.BlockSpec((1, 1, bk_dq, d),
                      lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
-        pl.BlockSpec((1, 1, block_k, d),
+        pl.BlockSpec((1, 1, bk_dq, d),
                      lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
-        pl.BlockSpec((1, group, block_q, d),
+        pl.BlockSpec((1, group, bq_dq, d),
                      lambda bi, hi, ki, qi: (bi, hi, _q_idx(ki, qi), 0)),
-        pl.BlockSpec((1, group, LSE_LANES, block_q),
+        pl.BlockSpec((1, group, LSE_LANES, bq_dq),
                      lambda bi, hi, ki, qi: (bi, hi, 0, _q_idx(ki, qi))),
-        pl.BlockSpec((1, group, LSE_LANES, block_q),
+        pl.BlockSpec((1, group, LSE_LANES, bq_dq),
                      lambda bi, hi, ki, qi: (bi, hi, 0, _q_idx(ki, qi))),
     ]
     if with_seg:
         dkv_in_specs += [
-            pl.BlockSpec((1, block_q, LSE_LANES),
+            pl.BlockSpec((1, bq_dq, LSE_LANES),
                          lambda bi, hi, ki, qi: (bi, _q_idx(ki, qi), 0)),
-            pl.BlockSpec((1, block_k, LSE_LANES),
+            pl.BlockSpec((1, bk_dq, LSE_LANES),
                          lambda bi, hi, ki, qi: (bi, ki, 0)),
         ]
     if with_rowmask:
         dkv_in_specs += [
-            pl.BlockSpec((1, 1, block_k, LSE_LANES),
+            pl.BlockSpec((1, 1, bk_dq, LSE_LANES),
                          lambda bi, hi, ki, qi: (bi, hi % hm, ki, 0)),
-            pl.BlockSpec((1, 1, block_k, LSE_LANES),
+            pl.BlockSpec((1, 1, bk_dq, LSE_LANES),
                          lambda bi, hi, ki, qi: (bi, hi % hm, ki, 0)),
         ]
     dk, dv = pl.pallas_call(
@@ -598,9 +657,9 @@ def _pallas_backward(q, k, v, o, lse, do, causal, scale, block_q, block_k,
         grid=grid_dkv,
         in_specs=dkv_in_specs,
         out_specs=[
-            pl.BlockSpec((1, 1, block_k, d),
+            pl.BlockSpec((1, 1, bk_dq, d),
                          lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
-            pl.BlockSpec((1, 1, block_k, d),
+            pl.BlockSpec((1, 1, bk_dq, d),
                          lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
         ],
         out_shape=[
@@ -608,8 +667,8 @@ def _pallas_backward(q, k, v, o, lse, do, causal, scale, block_q, block_k,
             jax.ShapeDtypeStruct(vt.shape, v.dtype),
         ],
         scratch_shapes=[
-            pltpu.VMEM((block_k, d), jnp.float32),
-            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((bk_dq, d), jnp.float32),
+            pltpu.VMEM((bk_dq, d), jnp.float32),
         ],
         interpret=interpret,
     )(qt, kt, vt, dot, lse, delta, *seg_ops)
@@ -730,13 +789,19 @@ def _fwl_bwd(causal, scale, block_q, block_k, interpret, res, cots):
 flash_attention_with_lse.defvjp(_fwl_fwd, _fwl_bwd)
 
 
-def _tuned_block(n: int) -> int:
+def _tuned_block(n: int, kv: bool = False) -> int:
     """Largest of 512/256/128 dividing n (v5e-profiled: 512 blocks reach
     ~25 TF/s fwd+bwd at head_dim 128 vs ~8 TF/s at the library defaults).
-    Sequences shorter than 128 use one whole-sequence block; longer sequences
-    not divisible by 128 get the default block, which fails the
-    divisibility guard in _use_pallas and routes to the XLA fallback
-    (a whole-sequence block there would materialize [s, s] scores in VMEM)."""
+    Long-context KV side: 1024 at seq >= 8192 — halves the kv grid steps and
+    their DMA issue overhead (on-chip A/B at 16k GQA 16/4: 50.4 vs 54.2 ms
+    fwd+bwd, +7.5%; the backward's VMEM guard re-halves its own k block, so
+    only the forward stream widens). Sequences shorter than 128 use one
+    whole-sequence block; longer sequences not divisible by 128 get the
+    default block, which fails the divisibility guard in _use_pallas and
+    routes to the XLA fallback (a whole-sequence block there would
+    materialize [s, s] scores in VMEM)."""
+    if kv and n >= 8192 and n % 1024 == 0:
+        return 1024
     for b in (512, 256, 128):
         if n % b == 0:
             return b
@@ -785,7 +850,7 @@ def flash_attention(q, k, v, causal: bool = False, scale=None,
             and q.shape[2] == k.shape[2]):
         return _jax_tuned_flash(q, k, v, causal, scale)
     bq = min(block_q or _tuned_block(q.shape[1]), q.shape[1])
-    bk = min(block_k or _tuned_block(k.shape[1]), k.shape[1])
+    bk = min(block_k or _tuned_block(k.shape[1], kv=True), k.shape[1])
     return _flash(q, k, v, causal, float(scale), bq, bk, interpret)
 
 
@@ -836,7 +901,7 @@ def flash_attention_varlen(q, k, v, q_seg, kv_seg, causal=True, scale=None,
     if scale is None:
         scale = q.shape[-1] ** -0.5
     bq = min(block_q or _tuned_block(q.shape[1]), q.shape[1])
-    bk = min(block_k or _tuned_block(k.shape[1]), k.shape[1])
+    bk = min(block_k or _tuned_block(k.shape[1], kv=True), k.shape[1])
     if _use_pallas(q, k, bq, bk, interpret):
         return _pallas_forward(q, k, v, causal, float(scale), bq, bk,
                                interpret, with_lse=False,
@@ -849,7 +914,7 @@ def _fav_fwd(q, k, v, q_seg, kv_seg, causal, scale, block_q, block_k,
     if scale is None:
         scale = q.shape[-1] ** -0.5
     bq = min(block_q or _tuned_block(q.shape[1]), q.shape[1])
-    bk = min(block_k or _tuned_block(k.shape[1]), k.shape[1])
+    bk = min(block_k or _tuned_block(k.shape[1], kv=True), k.shape[1])
     if _use_pallas(q, k, bq, bk, interpret):
         out, lse = _pallas_forward(q, k, v, causal, float(scale), bq, bk,
                                    interpret, with_lse=True,
@@ -865,7 +930,7 @@ def _fav_bwd(causal, scale, block_q, block_k, interpret, res, g):
         scale = q.shape[-1] ** -0.5
     if lse is not None:
         bq = min(block_q or _tuned_block(q.shape[1]), q.shape[1])
-        bk = min(block_k or _tuned_block(k.shape[1]), k.shape[1])
+        bk = min(block_k or _tuned_block(k.shape[1], kv=True), k.shape[1])
         dq, dk, dv = _pallas_backward(q, k, v, o, lse, g, causal, float(scale),
                                       bq, bk, interpret,
                                       q_seg=q_seg, kv_seg=kv_seg)
@@ -926,7 +991,7 @@ def flash_attention_rowmask(q, k, v, row_start, row_end, causal=True,
     if scale is None:
         scale = q.shape[-1] ** -0.5
     bq = min(block_q or _tuned_block(q.shape[1]), q.shape[1])
-    bk = min(block_k or _tuned_block(k.shape[1]), k.shape[1])
+    bk = min(block_k or _tuned_block(k.shape[1], kv=True), k.shape[1])
     if _use_pallas(q, k, bq, bk, interpret):
         return _pallas_forward(q, k, v, causal, float(scale), bq, bk,
                                interpret, with_lse=False,
@@ -940,7 +1005,7 @@ def _far_fwd(q, k, v, row_start, row_end, causal, scale, block_q, block_k,
     if scale is None:
         scale = q.shape[-1] ** -0.5
     bq = min(block_q or _tuned_block(q.shape[1]), q.shape[1])
-    bk = min(block_k or _tuned_block(k.shape[1]), k.shape[1])
+    bk = min(block_k or _tuned_block(k.shape[1], kv=True), k.shape[1])
     if _use_pallas(q, k, bq, bk, interpret):
         out, lse = _pallas_forward(q, k, v, causal, float(scale), bq, bk,
                                    interpret, with_lse=True,
@@ -957,7 +1022,7 @@ def _far_bwd(causal, scale, block_q, block_k, interpret, res, g):
         scale = q.shape[-1] ** -0.5
     if lse is not None:
         bq = min(block_q or _tuned_block(q.shape[1]), q.shape[1])
-        bk = min(block_k or _tuned_block(k.shape[1]), k.shape[1])
+        bk = min(block_k or _tuned_block(k.shape[1], kv=True), k.shape[1])
         dq, dk, dv = _pallas_backward(q, k, v, o, lse, g, causal,
                                       float(scale), bq, bk, interpret,
                                       row_start=row_start, row_end=row_end)

@@ -122,28 +122,83 @@ class RecordEvent:
         return False
 
 
+def _device_mem_stats():
+    """bytes_in_use / peak_bytes_in_use of device 0, or None when the
+    backend exposes no allocator stats (virtual CPU devices)."""
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    return (int(stats.get("bytes_in_use", 0)),
+            int(stats.get("peak_bytes_in_use", 0)))
+
+
 class _HostEvents:
+    """Per-name host wall-clock stats + optional per-region device-memory
+    brackets (reference: profiler_statistic.py:856 StatisticData — the
+    EventSummary's per-op items track calls/total/avg/max/min; :630 memory
+    items track allocation peaks per scope)."""
+
     def __init__(self):
         self.totals = defaultdict(float)
         self.counts = defaultdict(int)
+        self.maxs = defaultdict(float)
+        self.mins = defaultdict(lambda: float("inf"))
         self._open = {}
+        # memory brackets: name -> [increase_bytes_total, peak_bytes max]
+        self.mem_enabled = False
+        self.mem_delta = defaultdict(int)
+        self.mem_peak = defaultdict(int)
+        self._mem_open = {}
 
     def start(self, name, ts):
         self._open.setdefault(name, []).append(ts)
+        if self.mem_enabled:
+            self._mem_open.setdefault(name, []).append(_device_mem_stats())
 
     def stop(self, name, ts):
         if self._open.get(name):
             t0 = self._open[name].pop()
-            self.totals[name] += ts - t0
+            dt = ts - t0
+            self.totals[name] += dt
             self.counts[name] += 1
+            self.maxs[name] = max(self.maxs[name], dt)
+            self.mins[name] = min(self.mins[name], dt)
+        if self.mem_enabled and self._mem_open.get(name):
+            before = self._mem_open[name].pop()
+            after = _device_mem_stats()
+            if before is not None and after is not None:
+                self.mem_delta[name] += after[0] - before[0]
+                self.mem_peak[name] = max(self.mem_peak[name], after[1])
 
     def reset(self):
         self.totals.clear()
         self.counts.clear()
+        self.maxs.clear()
+        self.mins.clear()
         self._open.clear()
+        self.mem_delta.clear()
+        self.mem_peak.clear()
+        self._mem_open.clear()
 
 
 _host_events = _HostEvents()
+
+
+def _format_table(title, headers, rows):
+    """Aligned ASCII table in the reference's _build_table style
+    (profiler_statistic.py:874)."""
+    widths = [max(len(str(h)), *(len(str(r[i])) for r in rows))
+              if rows else len(str(h)) for i, h in enumerate(headers)]
+    sep = "-" * (sum(widths) + 2 * len(widths))
+    out = [sep, title, sep,
+           "  ".join(str(h).ljust(w) for h, w in zip(headers, widths))]
+    for r in rows:
+        out.append("  ".join(str(c).ljust(w) for c, w in zip(r, widths)))
+    out.append(sep)
+    return "\n".join(out)
 
 
 class Profiler:
@@ -152,6 +207,7 @@ class Profiler:
     def __init__(self, *, targets=None, scheduler=None, on_trace_ready=None,
                  record_shapes=False, profile_memory=False, timer_only=False,
                  emit_nvtx=False, custom_device_types=None, with_flops=False):
+        _host_events.mem_enabled = bool(profile_memory)
         self._scheduler = scheduler if callable(scheduler) else (
             make_scheduler(record=scheduler[1] - scheduler[0], closed=scheduler[0])
             if isinstance(scheduler, (tuple, list)) else (lambda step: ProfilerState.RECORD)
@@ -213,16 +269,68 @@ class Profiler:
             export_host_chrome_trace(os.path.join(path, "host_trace.json"))
         return self._trace_dir
 
-    def summary(self, sorted_by=None, op_detail=True, thread_sep=False, time_unit="ms"):
-        lines = ["---- host op summary (wall) ----"]
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        """Sorted per-op statistic tables + memory summary (reference:
+        profiler_statistic.py:856 StatisticData / :874 _build_table).
+
+        Views emitted: OverView (step timing), OperatorView (host RecordEvent
+        scopes: Calls/Total/Avg/Max/Min/Ratio, sorted by ``sorted_by`` —
+        SortedKeys.CPUTotal/CPUAvg/CPUMax/CPUMin), and with
+        ``profile_memory=True`` a MemoryView (per-scope device-HBM increase +
+        peak bytes-in-use, from device memory_stats brackets)."""
         scale = {"ms": 1e3, "s": 1.0, "us": 1e6}[time_unit]
-        for name, total in sorted(_host_events.totals.items(), key=lambda kv: -kv[1]):
-            n = _host_events.counts[name]
-            lines.append(f"{name:<48} calls={n:<8} total={total * scale:.3f}{time_unit} avg={total / n * scale:.3f}{time_unit}")
+        he = _host_events
+        key = {
+            None: lambda n: -he.totals[n],
+            SortedKeys.CPUTotal: lambda n: -he.totals[n],
+            SortedKeys.CPUAvg: lambda n: -he.totals[n] / max(he.counts[n], 1),
+            SortedKeys.CPUMax: lambda n: -he.maxs[n],
+            SortedKeys.CPUMin: lambda n: he.mins[n],
+        }.get(sorted_by, lambda n: -he.totals[n])
+        grand = sum(he.totals.values()) or 1.0
+        rows = []
+        for name in sorted(he.totals, key=key):
+            n = he.counts[name]
+            tot = he.totals[name]
+            rows.append((
+                name, n,
+                f"{tot * scale:.3f}",
+                f"{tot / max(n, 1) * scale:.3f}",
+                f"{he.maxs[name] * scale:.3f}",
+                f"{(0.0 if he.mins[name] == float('inf') else he.mins[name]) * scale:.3f}",
+                f"{tot / grand * 100:.1f}%",
+            ))
+        parts = []
         if self._step_times:
             ts = [t for t, _ in self._step_times]
-            lines.append(f"steps={len(ts)} avg_step={sum(ts) / len(ts) * 1e3:.2f}ms")
-        table = "\n".join(lines)
+            parts.append(_format_table(
+                "OverView", ("Metric", "Value"),
+                [("steps", len(ts)),
+                 (f"avg_step ({time_unit})",
+                  f"{sum(ts) / len(ts) * scale:.3f}"),
+                 (f"max_step ({time_unit})", f"{max(ts) * scale:.3f}"),
+                 (f"min_step ({time_unit})", f"{min(ts) * scale:.3f}")]))
+        parts.append(_format_table(
+            f"OperatorView (host, unit: {time_unit})",
+            ("Name", "Calls", "Total", "Avg", "Max", "Min", "Ratio"),
+            rows))
+        if he.mem_enabled:
+            mem_rows = [(name,
+                         f"{he.mem_delta[name] / 2**20:.2f}",
+                         f"{he.mem_peak[name] / 2**20:.2f}")
+                        for name in sorted(set(he.mem_delta)
+                                           | set(he.mem_peak),
+                                           key=lambda n: -he.mem_peak[n])]
+            cur = _device_mem_stats()
+            if cur is not None:
+                mem_rows.append(("[device now]", f"{cur[0] / 2**20:.2f}",
+                                 f"{cur[1] / 2**20:.2f}"))
+            parts.append(_format_table(
+                "MemoryView (device HBM, MB)",
+                ("Name", "Increase", "PeakInUse"),
+                mem_rows))
+        table = "\n".join(parts)
         print(table)
         return table
 

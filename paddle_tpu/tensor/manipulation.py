@@ -236,8 +236,13 @@ def index_sample(x, index, name=None):
 
 
 def index_add(x, index, axis, value, name=None):
+    import builtins
+
     def fn(a, i, v):
-        return a.at[(slice(None),) * (axis % a.ndim) + (i.reshape(-1),)].add(v)
+        # builtins.slice: this module's own `slice` op shadows the builtin
+        # (caught by the round-5 numeric op sweep — TypeError at call time)
+        full = builtins.slice(None)
+        return a.at[(full,) * (axis % a.ndim) + (i.reshape(-1),)].add(v)
 
     return apply_fn("index_add", fn, x, index, value)
 

@@ -90,18 +90,19 @@ def mode(x, axis=-1, keepdim=False, name=None):
     # most frequent value per slice
     from scipy import stats  # available via numpy ecosystem; fallback below if missing
 
-    raise_scipy = False
+    # compute with keepdims=True unconditionally: mixing scipy's squeezed
+    # output with a second squeeze raised AxisError for keepdim=False on
+    # 2-D inputs (caught by the round-5 numeric op sweep)
     try:
-        m = stats.mode(a, axis=ax, keepdims=keepdim)
-        vals = m.mode
+        vals_k = np.asarray(stats.mode(a, axis=ax, keepdims=True).mode)
     except Exception:
-        raise_scipy = True
-    if raise_scipy:
-        vals = np.apply_along_axis(lambda v: np.bincount(v.astype(np.int64)).argmax(), ax, a)
-    idx = np.argmax(a == np.expand_dims(np.asarray(vals).squeeze(ax) if not keepdim else vals, ax), axis=ax)
+        vals_k = np.expand_dims(np.apply_along_axis(
+            lambda v: np.bincount(v.astype(np.int64)).argmax(), ax, a), ax)
+    idx = np.argmax(a == vals_k, axis=ax)
+    vals = vals_k if keepdim else np.squeeze(vals_k, ax)
     if keepdim:
         idx = np.expand_dims(idx, ax)
-    return Tensor(jnp.asarray(vals)), Tensor(jnp.asarray(idx.astype(np.int64)))
+    return Tensor(jnp.asarray(vals.astype(a.dtype))), Tensor(jnp.asarray(idx.astype(np.int64)))
 
 
 def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):

@@ -88,3 +88,19 @@ def test_eager_dispatch_overhead_bounded():
     res = measure(n_ops=400)
     assert res["eager_tape_x_raw"] < 25.0, res
     assert res["eager_no_grad_x_raw"] < 15.0, res
+
+
+def test_op_sweep_coverage_gate():
+    """Numeric-coverage ratchet (round 5, VERDICT "numeric op-test breadth"):
+    the op sweep must keep >= 400 distinct manifest symbols under
+    check_output and >= 60 differentiable specs under check_grad. Coverage
+    can only go up — lowering either count fails CI here AND in
+    tests/test_op_sweep.py."""
+    sys.path.insert(0, os.path.join(ROOT, "tests"))
+    try:
+        from op_sweep_specs import SPECS, distinct_symbols, grad_specs
+    finally:
+        sys.path.pop(0)
+    assert len(distinct_symbols()) >= 400
+    assert len(grad_specs()) >= 60
+    assert len(SPECS) >= 340

@@ -101,7 +101,7 @@ class TestPartialGraph:
 
         xp = paddle.to_tensor(np.asarray([1., 2.], np.float32))
         xn = paddle.to_tensor(np.asarray([-1., -2.], np.float32))
-        with pytest.warns(UserWarning, match="split into prefix/suffix"):
+        with pytest.warns(UserWarning, match="split into compiled subgraphs"):
             rp = f(xp)
         rn = f(xn)
         np.testing.assert_allclose(rp.numpy(), (np.asarray([1., 2.]) * 2 + 1) * 3)
@@ -109,8 +109,8 @@ class TestPartialGraph:
         plan = f._split_plan
         assert plan is not None and not f._fallback_eager
         # the halves genuinely compiled (jit cache entries exist)
-        assert plan._prefix._fwd_cache and plan._true._fwd_cache \
-            and plan._false._fwd_cache
+        assert plan._prefix._fwd_cache and plan._stage._true._fwd_cache \
+            and plan._stage._false._fwd_cache
 
     def test_second_break_splits_again(self):
         from paddle_tpu.jit.api import to_static
@@ -139,23 +139,162 @@ class TestPartialGraph:
                 g(paddle.to_tensor(a)).numpy(), ref(a), rtol=1e-6)
         # the true-branch suffix hit the SECOND if and split recursively
         assert g._split_plan is not None
-        assert g._split_plan._true._split_plan is not None
+        assert g._split_plan._stage._true._split_plan is not None
 
     def test_unsplittable_break_falls_back_eager(self):
+        """A loop body with `break` is beyond the splitter — eager fallback."""
         from paddle_tpu.jit.api import to_static
 
         @to_static(full_graph=False)
         def h(x):
             n = 0
-            while (x.sum() > 0):   # while-on-tensor: not an if split
+            while (x.sum() > 0):
                 x = x - 1.0
                 n += 1
+                if n > 100:
+                    break          # flow escape: splitter refuses the loop
             return x
 
         with pytest.warns(UserWarning, match="falling back to eager"):
             out = h(paddle.to_tensor(np.asarray([2.5], np.float32)))
         np.testing.assert_allclose(out.numpy(), [-0.5])
         assert h._fallback_eager
+
+    def test_while_on_tensor_splits_compiled(self):
+        """while-on-tensor (round 5): prefix jits, the loop lowers to ONE
+        compiled lax.while_loop over the carry (reference resumes compiled
+        execution across loops — sot opcode_executor.py:1694 FOR_ITER)."""
+        from paddle_tpu.jit.api import to_static
+
+        @to_static(full_graph=False)
+        def h(x):
+            n = x.sum() * 0.0
+            while (x.sum() > 0):
+                x = x - 1.0
+                n = n + 1.0
+            return x + n * 0.0
+
+        with pytest.warns(UserWarning, match="split into compiled subgraphs"):
+            out = h(paddle.to_tensor(np.asarray([2.5], np.float32)))
+        np.testing.assert_allclose(out.numpy(), [-0.5])
+        assert not h._fallback_eager and h._split_plan is not None
+        stage = h._split_plan._stage
+        assert stage._lax_ok is True      # whole loop compiled as while_loop
+        # repeat call reuses the plan
+        np.testing.assert_allclose(
+            h(paddle.to_tensor(np.asarray([1.25], np.float32))).numpy(),
+            [-0.75])
+
+    def test_while_unstable_carry_uses_eager_bridge(self):
+        """When the body can't lower to lax.while_loop (carry changes
+        python-type across iterations), the loop still runs as compiled body
+        subgraphs stitched by an eager condition bridge."""
+        from paddle_tpu.jit.api import to_static
+
+        @to_static(full_graph=False)
+        def h(x, lst):
+            while (x.sum() > 0):
+                x = x - 1.0
+                lst = lst + [1]    # python list append: not lax-lowerable
+            return x
+
+        with pytest.warns(UserWarning, match="split into compiled subgraphs"):
+            out = h(paddle.to_tensor(np.asarray([2.5], np.float32)), [])
+        np.testing.assert_allclose(out.numpy(), [-0.5])
+        stage = h._split_plan._stage
+        assert stage._lax_ok is False and stage._body._fwd_cache
+
+    def test_for_loop_with_inner_break_splits(self):
+        """A tensor-`if` INSIDE a for body: the loop is driven eagerly, the
+        body is a compiled subgraph that itself split at the inner if."""
+        from paddle_tpu.jit.api import to_static
+
+        @to_static(full_graph=False)
+        def h(x):
+            acc = x * 0.0
+            for i in range(3):
+                if (x.sum() > 0):
+                    acc = acc + x
+                else:
+                    acc = acc - x
+                x = x - 1.0
+            return acc
+
+        def ref(a):
+            acc = a * 0.0
+            for _ in range(3):
+                acc = acc + a if a.sum() > 0 else acc - a
+                a = a - 1.0
+            return acc
+
+        a = np.asarray([1.5], np.float32)
+        with pytest.warns(UserWarning):
+            out = h(paddle.to_tensor(a))
+        np.testing.assert_allclose(out.numpy(), ref(a.copy()))
+        assert not h._fallback_eager and h._split_plan is not None
+        # the body subgraph recursively split at the inner tensor-if
+        body_sf = h._split_plan._stage._body
+        assert body_sf._split_plan is not None
+
+    def test_layer_forward_splits_with_grads(self):
+        """Layer.forward with a tensor-if (round 5): the split functionalizes
+        params through the sub-StaticFunctions — forward results AND grads
+        match eager."""
+        from paddle_tpu.jit.api import to_static
+
+        class Net(paddle.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = paddle.nn.Linear(3, 3)
+
+            def forward(self, x):
+                y = self.lin(x)
+                if (y.sum() > 0):
+                    return y * 2.0
+                return y * -1.0
+
+        net = Net()
+        x = paddle.to_tensor(np.asarray([[1., 2., 3.]], np.float32))
+        eager_out = net(x)
+
+        snet = Net()
+        snet.set_state_dict(net.state_dict())
+        snet.forward = to_static(snet.forward, full_graph=False)
+        with pytest.warns(UserWarning, match="split into compiled subgraphs"):
+            out = snet.forward(x)
+        np.testing.assert_allclose(out.numpy(), eager_out.numpy(), rtol=1e-6)
+        assert snet.forward._split_plan is not None
+
+        # grads flow through the split pieces like the unsplit call
+        loss = snet.forward(x).sum()
+        loss.backward()
+        ref_loss = net(x).sum()
+        ref_loss.backward()
+        gw = snet.lin.weight.grad
+        assert gw is not None
+        np.testing.assert_allclose(np.asarray(gw.numpy()),
+                                   np.asarray(net.lin.weight.grad.numpy()),
+                                   rtol=1e-5)
+
+    def test_split_plan_handles_kwargs_and_defaults(self):
+        """Keyword calls and defaulted params normalize to positional before
+        entering the plan (previously kwargs bypassed the plan entirely)."""
+        from paddle_tpu.jit.api import to_static
+
+        @to_static(full_graph=False)
+        def h(x, scale=3.0):
+            y = x * scale
+            if (y.sum() > 0):
+                return y + 1.0
+            return y - 1.0
+
+        a = np.asarray([1., 1.], np.float32)
+        with pytest.warns(UserWarning):
+            out = h(paddle.to_tensor(a))
+        np.testing.assert_allclose(out.numpy(), a * 3 + 1)
+        out2 = h(x=paddle.to_tensor(a), scale=-5.0)
+        np.testing.assert_allclose(out2.numpy(), a * -5 - 1)
+        assert h._split_plan is not None and not h._fallback_eager
 
     def test_split_with_reassigned_argument(self):
         """A parameter reassigned before the break must flow through the

@@ -1,0 +1,77 @@
+"""Profiler statistics tables + memory summary (round 5, VERDICT item 8).
+
+Reference: profiler_statistic.py:856 StatisticData / :874 _build_table —
+sorted per-op tables (Calls/Total/Avg/Max/Min/Ratio) and a memory summary.
+The summary() OUTPUT FORMAT is pinned here.
+"""
+
+import time
+
+import paddle_tpu.profiler as prof
+from paddle_tpu.profiler import Profiler, RecordEvent, SortedKeys
+
+
+def _run_profiled(profile_memory=False):
+    prof._host_events.reset()
+    p = Profiler(timer_only=True, profile_memory=profile_memory)
+    p.start()
+    for _ in range(3):
+        with RecordEvent("op.matmul"):
+            time.sleep(0.004)
+        with RecordEvent("op.norm"):
+            time.sleep(0.001)
+        p.step()
+    out = p.summary()
+    p.stop()
+    return p, out
+
+
+def test_operator_view_sorted_table():
+    _, out = _run_profiled()
+    assert "OperatorView" in out and "OverView" in out
+    # column headers of the reference's _build_table layout
+    for col in ("Name", "Calls", "Total", "Avg", "Max", "Min", "Ratio"):
+        assert col in out
+    # rows present with call counts
+    lines = out.splitlines()
+    mm = next(ln for ln in lines if ln.startswith("op.matmul"))
+    nm = next(ln for ln in lines if ln.startswith("op.norm"))
+    assert mm.split()[1] == "3" and nm.split()[1] == "3"
+    # sorted by CPUTotal descending: matmul (3x4ms) above norm (3x1ms)
+    assert lines.index(mm) < lines.index(nm)
+    # ratio column sums to ~100%
+    ratios = [float(ln.split()[-1].rstrip("%")) for ln in (mm, nm)]
+    assert abs(sum(ratios) - 100.0) < 1.0
+    # step stats emitted
+    assert "avg_step" in out and "max_step" in out
+
+
+def test_sort_keys_change_order():
+    p, _ = _run_profiled()
+    by_min = p.summary(sorted_by=SortedKeys.CPUMin)
+    lines = by_min.splitlines()
+    mm = next(i for i, ln in enumerate(lines) if ln.startswith("op.matmul"))
+    nm = next(i for i, ln in enumerate(lines) if ln.startswith("op.norm"))
+    assert nm < mm  # ascending min: the 1ms scope first
+
+
+def test_memory_view_present_with_profile_memory():
+    _, out = _run_profiled(profile_memory=True)
+    assert "MemoryView" in out
+    assert "PeakInUse" in out and "Increase" in out
+
+
+def test_max_min_tracked():
+    prof._host_events.reset()
+    p = Profiler(timer_only=True)
+    p.start()
+    with RecordEvent("op.var"):
+        time.sleep(0.001)
+    with RecordEvent("op.var"):
+        time.sleep(0.006)
+    out = p.summary(time_unit="ms")
+    p.stop()
+    row = next(ln for ln in out.splitlines() if ln.startswith("op.var"))
+    cols = row.split()
+    mx, mn = float(cols[4]), float(cols[5])
+    assert mx >= 5.0 and 0.0 < mn < mx
