@@ -93,6 +93,7 @@ class Engine:
         pp_remat: Optional[bool] = None,
         pp_interleave: int = 1,
         pp_schedule: str = "auto",
+        pp_remat_policy="auto",
         optimizer=None,
         abstract_state: bool = False,
     ):
@@ -128,8 +129,19 @@ class Engine:
         # the model's remat policy (e.g. save flash out+lse) applies to the
         # pipelined block remat too — same knob, both paths. Models expose it
         # via a ``remat_policy()`` hook (no model-specific imports here).
+        # EXCEPT on the zb schedule: zb's selective regime stacks the tick's
+        # param slice per microbatch (see zb_schedule's memory-regime notes),
+        # so zb + pp_remat keeps the round-4 boundary-storage default; pass
+        # pp_remat_policy="model" (or a policy) to opt into selective zb.
         pol_fn = getattr(model, "remat_policy", None)
-        self._pp_remat_policy = pol_fn() if callable(pol_fn) else None
+        model_policy = pol_fn() if callable(pol_fn) else None
+        if pp_remat_policy == "auto":
+            self._pp_remat_policy = (None if pp_schedule == "zb"
+                                     else model_policy)
+        elif pp_remat_policy == "model":
+            self._pp_remat_policy = model_policy
+        else:
+            self._pp_remat_policy = pp_remat_policy
         block_param_ids = {id(t) for b in self._blocks for _, t in b.named_parameters()}
 
         # --- functionalize: ordered trainable params (non-block "rest" first) ---

@@ -146,7 +146,10 @@ class StaticFunction:
         """Run the split plan; a NameError/UnboundLocalError from a
         synthesized piece (a prefix-stored name that this input path never
         defined, or a loop-carried var with no pre-loop binding) permanently
-        reverts to whole-function eager (ADVICE r4)."""
+        reverts to whole-function eager (ADVICE r4). The failed partial
+        execution is then re-run eagerly from the top — Python-level side
+        effects it performed before failing repeat (side effects inside
+        to_static functions are unsupported, as in the reference's SOT)."""
         if kwargs or self._has_defaults:
             # a TypeError here is a genuinely malformed call — same error
             # the eager function would raise; let it propagate

@@ -186,6 +186,7 @@ class _WhileStage:
         self._suffix = suffix_sf
         self._lax_ok: Optional[bool] = None
         self._lax_fn = None
+        self._probe_out = None  # first lax run's result (don't run twice)
 
     def _try_lax(self, live):
         import jax
@@ -216,18 +217,37 @@ class _WhileStage:
         from .api import StaticFunction
 
         fn = StaticFunction(whole, full_graph=True)
-        fn(*live)  # probe: trace errors (unstable carry etc.) raise here
+        # probe: trace errors (unstable carry etc.) raise here; the result is
+        # kept so the first successful call doesn't execute the loop twice
+        self._probe_out = fn(*live)
         return fn
 
     def __call__(self, live):
-        if self._lax_ok is None:
-            try:
-                self._lax_fn = self._try_lax(live)
-                self._lax_ok = True
-            except Exception:
-                self._lax_ok = False
-        if self._lax_ok:
-            out = self._lax_fn(*live)
+        # Grad-requiring inputs must take the eager bridge EVERY call —
+        # lax.while_loop has no reverse-mode derivative (the bridge's
+        # compiled body subgraphs record the tape normally). Decided per
+        # call, not cached: a warmup pass without grads must not pin a
+        # training pass onto the lax path. Layer methods always bridge (the
+        # raw cond/body close over `self`, so the whole-loop jit would bake
+        # parameters in as trace-time CONSTANTS).
+        from ..core.tensor import Tensor
+
+        needs_grad = any(isinstance(v, Tensor) and not v.stop_gradient
+                         for v in live)
+        use_lax = False
+        if not needs_grad and self._cond._layer is None:
+            if self._lax_ok is None:
+                try:
+                    self._lax_fn = self._try_lax(live)
+                    self._lax_ok = True
+                except Exception:
+                    self._lax_ok = False
+            use_lax = bool(self._lax_ok)
+        if use_lax:
+            if self._probe_out is not None:
+                out, self._probe_out = self._probe_out, None
+            else:
+                out = self._lax_fn(*live)
             live = out if isinstance(out, tuple) else (out,)
         else:
             while bool(self._cond(*live)):
@@ -351,8 +371,7 @@ def try_split(fn, lineno: Optional[int], layer=None) -> Optional[SplitPlan]:
         # loop-carried live set: read by the condition/body/rest AND defined
         # before the loop (body-only names are per-iteration temps; a
         # body-defined name escaping into rest -> prefix NameError -> eager)
-        live = sorted(avail & (cond_loads | body_n.loads | rest_loads
-                               | (body_n.stores & rest_loads)))
+        live = sorted(avail & (cond_loads | body_n.loads | rest_loads))
         prefix_fn = _make_fn("__pg_prefix", arg_names,
                              prefix_stmts + [ret_tuple(live)], globs)
         cond_fn = _make_fn("__pg_wcond", live,
@@ -384,8 +403,7 @@ def try_split(fn, lineno: Optional[int], layer=None) -> Optional[SplitPlan]:
         return None
     iter_loads = _names([ast.Expr(brk.iter)]).loads
     live = sorted((avail - set(targets))
-                  & (iter_loads | body_n.loads | rest_loads
-                     | (body_n.stores & rest_loads)))
+                  & (iter_loads | body_n.loads | rest_loads))
     prefix_fn = _make_fn("__pg_prefix", arg_names,
                          prefix_stmts + [ret_tuple(live)], globs)
     iter_fn = _make_fn("__pg_iter", live, [ast.Return(brk.iter)], globs)
