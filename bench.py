@@ -383,6 +383,10 @@ def bench_moe(dev, on_tpu):
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
 
     if on_tpu:
+        # dispatch stays "auto" (scatter at this shape): the round-5
+        # interleaved A/B (benchmarks/moe_ab.py) measured the dropless
+        # grouped alternatives SLOWER at E=8 — scatter 0.409 vs megablox-gmm
+        # ragged 0.344 vs in-repo pgmm 0.294 activated-MFU (docs/MOE_AB.md)
         cfg = LlamaConfig(
             vocab_size=32000, hidden_size=1024, intermediate_size=2816,
             num_hidden_layers=8, num_attention_heads=16,
@@ -477,12 +481,16 @@ def main():
         gc.collect()
 
         # secondary: the round-2 north-star operating point (batch 4, remat
-        # ON) kept for continuity/regression comparison
+        # ON) kept for continuity/regression comparison. Round 5: the
+        # flash_qkv policy additionally saves rope'd q/k/v (~1.6G at this
+        # shape), killing the qkv-proj+rope+norm1 recompute — measured
+        # remat tax 15.5% -> 10.7% vs no-remat in-process (benchmarks/
+        # remat_ab.py)
         ns_remat = LlamaConfig(
             vocab_size=32000, hidden_size=2048, intermediate_size=5632,
             num_hidden_layers=16, num_attention_heads=16,
             num_key_value_heads=4, max_position_embeddings=4096,
-            dtype="bfloat16", recompute=True)
+            dtype="bfloat16", recompute=True, remat_policy="flash_qkv")
         try:
             bench_llama("llama_853M_seq4096_remat_tokens_per_sec", ns_remat,
                         batch=4, seq=4096, iters=8, dev=dev)

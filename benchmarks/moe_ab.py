@@ -1,0 +1,106 @@
+"""In-process interleaved A/B of MoE dispatch modes on the bench shape
+(MoE llama 8 experts top-2, b8 seq2048, bf16).
+
+Variants share one param set (pure fwd+bwd — no optimizer state):
+  - scatter : capacity-bounded segment-sum dispatch (round-4 state)
+  - ragged  : dropless jax.lax.ragged_dot grouped matmuls (round 5)
+  - einsum  : GShard dense one-hot dispatch (reference formulation)
+
+Same methodology as remat_ab.py: jitted lax.scan chain over fresh batches,
+params as arguments, grads kept live via a probe, interleaved rounds,
+best-of-N. Usage: python benchmarks/moe_ab.py [rounds]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.jit.api import _collect_state, _Swap
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+ROUNDS = int(sys.argv[1]) if len(sys.argv) > 1 else 3
+BATCH, SEQ, ITERS = 8, 2048, 4
+
+
+def main():
+    dev = jax.devices()[0]
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+        num_hidden_layers=8, num_attention_heads=16, num_key_value_heads=4,
+        max_position_embeddings=2048, dtype="bfloat16", num_experts=8,
+        moe_topk=2)
+    model = LlamaForCausalLM(cfg)
+    _, tensors = _collect_state(model)
+    params = [t._data for t in tensors]
+
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (ITERS, BATCH, SEQ)),
+                      jnp.int32)
+
+    def make_step(mode):
+        def step(ps, batch_ids):
+            def loss_of(ps_):
+                with _Swap(tensors, ps_):
+                    return model.loss_fn(batch_ids, batch_ids)
+
+            l, g = jax.value_and_grad(loss_of)(ps)
+            probe = sum(gg.ravel()[0].astype(jnp.float32) for gg in g)
+            ps = [p_ + 0.0 * gg.astype(p_.dtype) for p_, gg in zip(ps, g)]
+            return ps, l.astype(jnp.float32) + 0.0 * probe
+
+        def chain(ps, ids_stack):
+            cfg.moe_dispatch = mode          # baked at trace time
+            for layer in model.model.layers:
+                layer.mlp.dispatch_mode = mode
+            _, losses = jax.lax.scan(step, list(ps), ids_stack)
+            return losses.sum()
+
+        return jax.jit(chain)
+
+    variants = {m: make_step(m) for m in ("scatter", "pgmm", "ragged")}
+
+    n_total = sum(int(np.prod(p.shape)) for p in model.parameters())
+    n_exp = sum(int(np.prod(p.shape)) for name, p in model.named_parameters()
+                if ".experts." in name)
+    n_act = n_total - n_exp * (1.0 - cfg.moe_topk / cfg.num_experts)
+    fpt = 6.0 * n_act + 6.0 * cfg.num_hidden_layers * cfg.hidden_size * SEQ
+    peak = 197e12 if "v5 lite" in dev.device_kind.lower() else 459e12
+
+    best = {}
+    for name, fn in variants.items():
+        try:
+            t0 = time.perf_counter()
+            jax.device_get(fn(params, ids))
+            print(f"# {name}: compiled+warm in {time.perf_counter()-t0:.1f}s",
+                  flush=True)
+            best[name] = float("inf")
+        except Exception as e:
+            print(f"# {name}: FAILED {e!r}", flush=True)
+
+    for r in range(ROUNDS):
+        for name, fn in variants.items():
+            if name not in best:
+                continue
+            t0 = time.perf_counter()
+            jax.device_get(fn(params, ids))
+            dt = (time.perf_counter() - t0) / ITERS
+            best[name] = min(best[name], dt)
+            tok = BATCH * SEQ / dt
+            print(f"round {r} {name:8s}: {dt*1e3:7.1f} ms/step "
+                  f"{tok:9.0f} tok/s  activated-mfu {tok*fpt/peak:.3f}",
+                  flush=True)
+
+    print("\n== best-of-%d (fwd+bwd only) ==" % ROUNDS)
+    for name, dt in best.items():
+        tok = BATCH * SEQ / dt
+        print(f"{name:8s}: {dt*1e3:7.1f} ms/step {tok:9.0f} tok/s  "
+              f"activated-mfu {tok*fpt/peak:.3f}")
+
+
+if __name__ == "__main__":
+    main()

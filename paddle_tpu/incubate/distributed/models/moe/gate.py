@@ -8,7 +8,8 @@ TPU-native: gates here return dense dispatch/combine tensors (GShard einsum
 formulation) instead of the reference's index/position buffers — index_select/
 scatter dispatch is a dynamic-shape pattern XLA can't tile; the dense one-hot
 formulation keeps every shape static and lets GSPMD turn the dispatch einsum
-into an all_to_all over the ``ep`` mesh axis.
+into cross-device dispatch collectives over the ``ep`` mesh axis (this
+XLA version picks all-reduce of per-expert partials — see docs/MOE_AB.md).
 """
 
 from __future__ import annotations

@@ -304,3 +304,198 @@ class TestScatterDispatch:
         for _ in range(3):
             l = float(eng.step(xd, yd))
         assert np.isfinite(l) and l < l0, (l0, l)
+
+
+class TestRaggedDispatch:
+    """Dropless grouped-matmul dispatch over jax.lax.ragged_dot (round 5,
+    VERDICT "MoE fused expert matmuls"): no capacity padding, no [E, C, d]
+    staging buffers. With a capacity large enough that nothing drops, the
+    scatter path computes the identical function — fwd, aux, and grads must
+    match it."""
+
+    def test_ragged_matches_scatter_no_drop(self):
+        from paddle_tpu.incubate.distributed.models.moe.moe_layer import (
+            SwiGLUExpertFFN, routed_ffn)
+
+        rng = np.random.default_rng(3)
+        n, e, d, k = 48, 8, 16, 2
+        tokens = jnp.asarray(rng.standard_normal((n, d)), jnp.float32)
+        probs = jax.nn.softmax(
+            jnp.asarray(rng.standard_normal((n, e)), jnp.float32), -1)
+        paddle.seed(0)
+        experts = SwiGLUExpertFFN(e, d, 2 * d)
+
+        def run(mode, t, p):
+            # capacity n*k: the scatter path provably drops nothing, so it
+            # computes the same dropless function as ragged
+            return routed_ffn(t, p, experts, k, n * k, True,
+                              dispatch_mode=mode)
+
+        o1, a1 = run("scatter", tokens, probs)
+        o2, a2 = run("ragged", tokens, probs)
+        np.testing.assert_allclose(np.asarray(o1), np.asarray(o2),
+                                   rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(float(a1), float(a2), rtol=1e-5)
+        g1 = jax.grad(lambda t, p: run("scatter", t, p)[0].sum(),
+                      argnums=(0, 1))(tokens, probs)
+        g2 = jax.grad(lambda t, p: run("ragged", t, p)[0].sum(),
+                      argnums=(0, 1))(tokens, probs)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-3, atol=2e-4)
+
+    def test_ragged_biased_expert_ffn(self):
+        """ExpertFFN (per-expert biases) ragged path: bias rows follow the
+        per-row expert id."""
+        from paddle_tpu.incubate.distributed.models.moe.moe_layer import (
+            ExpertFFN, routed_ffn)
+
+        rng = np.random.default_rng(4)
+        n, e, d, k = 32, 4, 8, 2
+        tokens = jnp.asarray(rng.standard_normal((n, d)), jnp.float32)
+        probs = jax.nn.softmax(
+            jnp.asarray(rng.standard_normal((n, e)), jnp.float32), -1)
+        paddle.seed(1)
+        experts = ExpertFFN(e, d, 2 * d)
+        # give the biases distinct values so a mis-gathered bias shows
+        for i, (name, p) in enumerate(experts.named_parameters()):
+            if name in ("b1", "b2"):
+                p.set_value(np.full(p.shape, 0.1 * (i + 1), np.float32)
+                            * np.arange(1, p.shape[0] + 1,
+                                        dtype=np.float32)[:, None])
+        o1, a1 = routed_ffn(tokens, probs, experts, k, n * k, True,
+                            dispatch_mode="scatter")
+        o2, a2 = routed_ffn(tokens, probs, experts, k, n * k, True,
+                            dispatch_mode="ragged")
+        np.testing.assert_allclose(np.asarray(o1), np.asarray(o2),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_moe_layer_ragged_mode_trains(self):
+        """MoELayer(dispatch_mode='ragged') end to end: loss finite, grads
+        flow to experts and gate."""
+        from paddle_tpu.incubate.distributed.models.moe import MoELayer
+
+        paddle.seed(0)
+        layer = MoELayer(16, 4, d_hidden=32, gate="gshard",
+                         dispatch_mode="ragged")
+        x = paddle.to_tensor(
+            np.random.default_rng(0).standard_normal((2, 8, 16))
+            .astype(np.float32))
+        out = layer(x)
+        loss = out.sum() + 0.01 * layer.get_loss()
+        loss.backward()
+        got_grad = [p.grad is not None for _, p in layer.named_parameters()]
+        assert all(got_grad), got_grad
+
+
+class TestPgmmDispatch:
+    """Pallas padded-grouped-matmul dispatch (ops/grouped_matmul.py):
+    megablocks-class expert FFN — tile-aligned sorted layout, one kernel per
+    matmul, custom_vjp for dx/dw. Equality vs the dropless scatter function
+    in interpret mode."""
+
+    def test_pgmm_kernel_matches_dense(self):
+        from paddle_tpu.ops.grouped_matmul import (padded_group_layout, pgmm)
+
+        rng = np.random.default_rng(5)
+        n, e, d, m, tm = 40, 3, 16, 24, 8
+        flat_e = jnp.asarray(rng.integers(0, e, (n,)), jnp.int32)
+        x = jnp.asarray(rng.standard_normal((n, d)), jnp.float32)
+        w = jnp.asarray(rng.standard_normal((e, d, m)), jnp.float32)
+        order, pos, gids, P = padded_group_layout(flat_e, e, n, tile_m=tm)
+        xp = jnp.zeros((P, d), jnp.float32).at[pos].set(x[order])
+        out = pgmm(xp, w, gids, tm, True)          # interpret mode
+        got = np.asarray(jnp.take(out, pos, axis=0))
+        ref = np.stack([np.asarray(x[order][i]) @ np.asarray(w[int(flat_e[order][i])])
+                        for i in range(n)])
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+        # grads: dx/dw vs a dense einsum formulation
+        oh = jax.nn.one_hot(flat_e[order], e, dtype=jnp.float32)
+
+        def loss_pgmm(xs, ws):
+            xp = jnp.zeros((P, d), jnp.float32).at[pos].set(xs)
+            return (jnp.take(pgmm(xp, ws, gids, tm, True), pos, axis=0)
+                    ** 2).sum()
+
+        def loss_ref(xs, ws):
+            y = jnp.einsum("nd,ne,edm->nm", xs, oh, ws)
+            return (y ** 2).sum()
+
+        g1 = jax.grad(loss_pgmm, argnums=(0, 1))(x[order], w)
+        g2 = jax.grad(loss_ref, argnums=(0, 1))(x[order], w)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-3, atol=1e-4)
+
+    def test_pgmm_routed_matches_scatter_no_drop(self):
+        from paddle_tpu.incubate.distributed.models.moe import moe_layer as ml
+        from paddle_tpu.incubate.distributed.models.moe.moe_layer import (
+            SwiGLUExpertFFN, routed_ffn)
+        from paddle_tpu.ops import grouped_matmul as gm
+
+        rng = np.random.default_rng(6)
+        n, e, d, k = 48, 4, 16, 2
+        tokens = jnp.asarray(rng.standard_normal((n, d)), jnp.float32)
+        probs = jax.nn.softmax(
+            jnp.asarray(rng.standard_normal((n, e)), jnp.float32), -1)
+        paddle.seed(2)
+        experts = SwiGLUExpertFFN(e, d, 2 * d)
+        old_tm = gm.TILE_M
+        gm.TILE_M = 16    # small tiles so the interpret kernel stays tiny
+        # interpret-mode call path: patch forward_pgmm to pass interpret=True
+        orig = SwiGLUExpertFFN.forward_pgmm
+
+        def fp(self, xp, gids, tile_m=None, interpret=False):
+            return orig(self, xp, gids, tile_m=tile_m, interpret=True)
+
+        SwiGLUExpertFFN.forward_pgmm = fp
+        try:
+            o1, a1 = routed_ffn(tokens, probs, experts, k, n * k, True,
+                                dispatch_mode="scatter")
+            o2, a2 = routed_ffn(tokens, probs, experts, k, n * k, True,
+                                dispatch_mode="pgmm")
+        finally:
+            SwiGLUExpertFFN.forward_pgmm = orig
+            gm.TILE_M = old_tm
+        np.testing.assert_allclose(np.asarray(o1), np.asarray(o2),
+                                   rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(float(a1), float(a2), rtol=1e-5)
+
+
+def test_moe_ep_hlo_alltoall():
+    """Dispatch-cost evidence (docs/MOE_AB.md): under an ep-sharded mesh the
+    dispatch einsum lowers to GSPMD cross-device collectives playing the
+    role of the reference's NCCL global_scatter/global_gather
+    (moe/utils.py:32). Pins that the lowering actually communicates (this
+    XLA version picks all-reduce of per-expert partials / all-gather of the
+    token shard rather than a literal all-to-all — recorded in the doc)."""
+    from paddle_tpu.distributed.auto_parallel import axis_rules, make_mesh
+    from paddle_tpu.distributed.auto_parallel.logical_sharding import \
+        shard_params
+    from paddle_tpu.incubate.distributed.models.moe import MoELayer
+    from paddle_tpu.jit.api import _Swap
+
+    mesh = make_mesh({"ep": 4, "dp": 2})
+    with axis_rules(mesh):
+        paddle.seed(7)
+        layer = MoELayer(32, num_experts=4, d_hidden=64, gate="gshard",
+                         capacity_factor=2.0, dispatch_mode="einsum")
+        shard_params(layer, mesh)
+    tensors = [t for _, t in layer.named_parameters()]
+    params = [t._data for t in tensors]
+    x = jnp.asarray(np.random.default_rng(7).standard_normal((16, 32)),
+                    jnp.float32)
+
+    def fwd(params, x):
+        from paddle_tpu.core import autograd_engine
+
+        with autograd_engine.no_grad(), _Swap(tensors, params), \
+                axis_rules(mesh):
+            return layer(x)
+
+    hlo = jax.jit(fwd).lower(params, x).compile().as_text()
+    import re
+
+    colls = set(re.findall(
+        r"(all-to-all|all-gather|all-reduce|reduce-scatter)", hlo))
+    assert colls, "ep dispatch lowered without any cross-device collective"
