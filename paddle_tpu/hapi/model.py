@@ -314,3 +314,10 @@ class Model:
         from .summary import summary
 
         return summary(self.network, input_size)
+
+    def flops(self, input_size, print_detail=False):
+        """FLOPs of one forward at ``input_size`` (XLA cost model — see
+        ``paddle.flops``)."""
+        from .. import flops as _flops
+
+        return _flops(self.network, input_size, print_detail=print_detail)

@@ -15,9 +15,9 @@ args <- commandArgs(trailingOnly = TRUE)
 if (length(args) != 4) {
   stop("usage: Rscript predict.R model.mlir weights.bin input.f32 output.f32")
 }
-dyn.load(file.path(dirname(sys.frame(1)$ofile %||% "."), "r_shim.so"))
-
-`%||%` <- function(a, b) if (is.null(a)) b else a
+# shim next to the working directory by default; override via PTPU_R_SHIM
+shim <- Sys.getenv("PTPU_R_SHIM", "r_shim.so")
+dyn.load(shim)
 
 h <- .Call("R_ptpu_load", args[1])
 n_in <- .Call("R_ptpu_num_inputs", h)

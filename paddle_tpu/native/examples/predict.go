@@ -71,8 +71,12 @@ func main() {
 	}
 	defer wf.Close()
 
-	bufs := make([][]float32, nIn)
-	ptrs := make([]*C.float, nIn)
+	// cgo pointer rules: the pointer ARRAY passed to C may not live in Go
+	// memory while holding Go pointers — C-allocate both the array and the
+	// input buffers
+	ptrs := (**C.float)(C.malloc(C.size_t(nIn) * C.size_t(unsafe.Sizeof(uintptr(0)))))
+	defer C.free(unsafe.Pointer(ptrs))
+	ptrSlice := unsafe.Slice((**C.float)(unsafe.Pointer(ptrs)), nIn)
 	for i := 0; i < nIn; i++ {
 		n := int64(C.ptpu_input_numel(h, C.int(i)))
 		src := io.Reader(wf)
@@ -84,11 +88,13 @@ func main() {
 			fmt.Fprintf(os.Stderr, "input %d: %v\n", i, err)
 			os.Exit(1)
 		}
-		bufs[i] = b
-		ptrs[i] = (*C.float)(unsafe.Pointer(&b[0]))
+		cbuf := (*C.float)(C.malloc(C.size_t(4 * n)))
+		defer C.free(unsafe.Pointer(cbuf))
+		cs := unsafe.Slice((*float32)(unsafe.Pointer(cbuf)), n)
+		copy(cs, b)
+		ptrSlice[i] = cbuf
 	}
-	rc := C.ptpu_run(h, (**C.float)(unsafe.Pointer(&ptrs[0])),
-		(*C.char)(unsafe.Pointer(&errBuf[0])), 256)
+	rc := C.ptpu_run(h, ptrs, (*C.char)(unsafe.Pointer(&errBuf[0])), 256)
 	if rc != 0 {
 		fmt.Fprintf(os.Stderr, "run failed: %s\n", errBuf)
 		os.Exit(1)
@@ -103,5 +109,4 @@ func main() {
 		}
 		os.Stdout.Write(raw)
 	}
-	_ = bufs
 }

@@ -147,8 +147,10 @@ class _HostEvents:
         self.maxs = defaultdict(float)
         self.mins = defaultdict(lambda: float("inf"))
         self._open = {}
-        # memory brackets: name -> [increase_bytes_total, peak_bytes max]
+        # memory brackets: name -> [increase_bytes_total, peak_bytes max];
+        # enabled while any started Profiler(profile_memory=True) is live
         self.mem_enabled = False
+        self.mem_refs = 0
         self.mem_delta = defaultdict(int)
         self.mem_peak = defaultdict(int)
         self._mem_open = {}
@@ -207,7 +209,11 @@ class Profiler:
     def __init__(self, *, targets=None, scheduler=None, on_trace_ready=None,
                  record_shapes=False, profile_memory=False, timer_only=False,
                  emit_nvtx=False, custom_device_types=None, with_flops=False):
-        _host_events.mem_enabled = bool(profile_memory)
+        # memory brackets are refcounted on start()/stop(): overlapping
+        # profilers don't disable each other, and a constructed-but-never-
+        # started profiler doesn't turn on device memory_stats() process-wide
+        self._mem_owner = bool(profile_memory)
+        self._mem_active = False
         self._scheduler = scheduler if callable(scheduler) else (
             make_scheduler(record=scheduler[1] - scheduler[0], closed=scheduler[0])
             if isinstance(scheduler, (tuple, list)) else (lambda step: ProfilerState.RECORD)
@@ -222,6 +228,10 @@ class Profiler:
         self._last_step_ts = None
 
     def start(self):
+        if self._mem_owner and not self._mem_active:
+            self._mem_active = True
+            _host_events.mem_refs += 1
+            _host_events.mem_enabled = True
         self._state = self._scheduler(self.step_num)
         self._maybe_toggle()
         self._last_step_ts = time.perf_counter()
@@ -229,6 +239,10 @@ class Profiler:
     def stop(self):
         self._state = ProfilerState.CLOSED
         self._maybe_toggle()
+        if self._mem_active:
+            self._mem_active = False
+            _host_events.mem_refs = max(0, _host_events.mem_refs - 1)
+            _host_events.mem_enabled = _host_events.mem_refs > 0
         if self._on_trace_ready:
             self._on_trace_ready(self)
 
@@ -315,7 +329,7 @@ class Profiler:
             f"OperatorView (host, unit: {time_unit})",
             ("Name", "Calls", "Total", "Avg", "Max", "Min", "Ratio"),
             rows))
-        if he.mem_enabled:
+        if self._mem_owner or he.mem_enabled or he.mem_peak:
             mem_rows = [(name,
                          f"{he.mem_delta[name] / 2**20:.2f}",
                          f"{he.mem_peak[name] / 2**20:.2f}")

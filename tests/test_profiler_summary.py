@@ -75,3 +75,18 @@ def test_max_min_tracked():
     cols = row.split()
     mx, mn = float(cols[4]), float(cols[5])
     assert mx >= 5.0 and 0.0 < mn < mx
+
+
+def test_memory_view_survives_stop():
+    """summary() AFTER stop() (the reference usage pattern) must still emit
+    MemoryView when the profiler owned memory profiling (round-5 review
+    finding: stop() cleared the global flag summary gated on)."""
+    prof._host_events.reset()
+    p = Profiler(timer_only=True, profile_memory=True)
+    p.start()
+    with RecordEvent("op.post"):
+        time.sleep(0.001)
+    p.step()
+    p.stop()
+    out = p.summary()
+    assert "MemoryView" in out
