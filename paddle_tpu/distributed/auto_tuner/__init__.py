@@ -17,11 +17,76 @@ step time through the Engine for the top-K analytic candidates.
 from __future__ import annotations
 
 import itertools
+import json
 import math
+import os
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
-__all__ = ["TuneConfig", "Candidate", "AutoTuner"]
+__all__ = ["TuneConfig", "Candidate", "AutoTuner", "Recorder"]
+
+
+class Recorder:
+    """Persistent trial history (reference: auto_tuner/recorder.py History
+    — the tuner's record store, resumable across runs).
+
+    Each record: {"key", "axes", "n_micro", "cost", "memory_gb", "metric"
+    (step seconds; None for failures), "status" ("ok"|"error")}. Stored as
+    JSONL when ``path`` is given, else in-memory. Keys embed a FINGERPRINT
+    of the tuned config so one history file shared across different models
+    never cross-reuses metrics. Malformed trailing lines (a trial process
+    killed mid-append) are skipped, not fatal — resumability must survive
+    exactly the crashes it exists for.
+    """
+
+    def __init__(self, path: Optional[str] = None, fingerprint: str = ""):
+        self.path = path
+        self.fingerprint = fingerprint
+        self.records: List[dict] = []
+        if path and os.path.exists(path):
+            with open(path) as f:
+                for ln in f:
+                    if not ln.strip():
+                        continue
+                    try:
+                        self.records.append(json.loads(ln))
+                    except json.JSONDecodeError:
+                        continue  # truncated tail from a killed trial
+
+    def key_of(self, c: "Candidate") -> str:
+        axes = "x".join(f"{k}{v}" for k, v in sorted(c.axes.items()))
+        return f"{self.fingerprint}|{axes}@m{c.n_micro}"
+
+    def seen(self, c: "Candidate") -> bool:
+        k = self.key_of(c)
+        return any(r.get("key") == k for r in self.records)
+
+    def metric_for(self, c: "Candidate") -> Optional[float]:
+        k = self.key_of(c)
+        for r in self.records:
+            if r.get("key") == k and r.get("status") == "ok":
+                return float(r["metric"])
+        return None
+
+    def store(self, c: "Candidate", metric: Optional[float],
+              status: str = "ok", **extra) -> dict:
+        rec = {"key": self.key_of(c), "axes": dict(c.axes),
+               "n_micro": c.n_micro, "cost": c.cost,
+               "memory_gb": c.memory_gb, "metric": metric,
+               "status": status, **extra}
+        self.records.append(rec)
+        if self.path:
+            with open(self.path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        return rec
+
+    def sorted(self) -> List[dict]:
+        ok = [r for r in self.records if r.get("status") == "ok"]
+        return sorted(ok, key=lambda r: r["metric"])
+
+    def get_best(self) -> Optional[dict]:
+        s = self.sorted()
+        return s[0] if s else None
 
 
 @dataclass
@@ -92,6 +157,12 @@ class AutoTuner:
     def __init__(self, config: TuneConfig):
         self.cfg = config
         self.history: List[Tuple[Candidate, float]] = []
+        self.recorder: Optional[Recorder] = None
+
+    def _fingerprint(self) -> str:
+        c = self.cfg
+        return (f"n{c.n_devices}-L{c.num_layers}-h{c.hidden_size}"
+                f"-H{c.num_heads}-s{c.seq_len}-b{c.global_batch}")
 
     # -- candidate generation (reference: search.py GridSearch) --
     def candidates(self) -> List[Candidate]:
@@ -184,23 +255,68 @@ class AutoTuner:
         return cost
 
     # -- search driver (reference: tuner.py AutoTuner.search_once loop) --
+    def _trial(self, c: Candidate, run_fn, recorder: Recorder):
+        """One error-tolerant trial with history reuse + recording."""
+        cached = recorder.metric_for(c)
+        if cached is not None:
+            return cached
+        if recorder.seen(c):
+            return None  # previously failed — don't retry (reference prune)
+        try:
+            t = float(run_fn(c))
+        except Exception as e:
+            recorder.store(c, None, status="error", error=repr(e)[:200])
+            return None
+        recorder.store(c, t)
+        self.history.append((c, t))
+        return t
+
+    def _neighbors(self, best: Candidate,
+                   cands: List[Candidate]) -> List[Candidate]:
+        """Local refinement set around the measured best: candidates one
+        MOVE away — a factor shifted between two axes (the device product is
+        fixed, so the minimal mesh change touches exactly two axes), or the
+        same mesh at a different n_micro. The greedy neighborhood step the
+        reference's tuner walks after its grid pass."""
+        out = []
+        for c in cands:
+            diff_axes = [k for k in c.axes if c.axes[k] != best.axes[k]]
+            # exactly two axes change in a factor move (the device product
+            # is fixed, so a single-axis change is impossible)
+            if len(diff_axes) == 2 or (not diff_axes
+                                       and c.n_micro != best.n_micro):
+                out.append(c)
+        return out
+
     def search(self, run_fn: Optional[Callable[[Candidate], float]] = None,
-               max_trials: int = 4) -> Candidate:
+               max_trials: int = 4, history_path: Optional[str] = None,
+               refine: bool = True) -> Candidate:
+        """Analytic ranking; with ``run_fn``, live trials of the top-K
+        followed by a one-axis neighborhood refinement around the measured
+        best. Trials are RECORDED (``history_path`` -> JSONL, resumable:
+        already-measured candidates reuse their stored metric, failed ones
+        are not retried — reference recorder.py semantics)."""
         cands = self.candidates()
         if not cands:
             raise ValueError("no feasible parallel config for this model/mesh")
         if run_fn is None:
             return cands[0]
+        recorder = self.recorder = Recorder(history_path,
+                                            fingerprint=self._fingerprint())
         best, best_t = None, math.inf
         for c in cands[:max_trials]:
-            try:
-                t = float(run_fn(c))
-            except Exception:
-                continue  # OOM/compile failure: skip, like the reference's
-                # error-tolerant trial loop
-            self.history.append((c, t))
-            if t < best_t:
+            t = self._trial(c, run_fn, recorder)
+            if t is not None and t < best_t:
                 best, best_t = c, t
+        if best is not None and refine:
+            ranked = {id(c): i for i, c in enumerate(cands)}
+            neigh = [c for c in self._neighbors(best, cands)
+                     if ranked.get(id(c), 0) >= max_trials]
+            neigh.sort(key=lambda c: c.cost)
+            for c in neigh[:max_trials]:
+                t = self._trial(c, run_fn, recorder)
+                if t is not None and t < best_t:
+                    best, best_t = c, t
         if best is None:
             raise RuntimeError("every live trial failed")
         return best
