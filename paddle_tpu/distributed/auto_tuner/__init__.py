@@ -160,9 +160,14 @@ class AutoTuner:
         self.recorder: Optional[Recorder] = None
 
     def _fingerprint(self) -> str:
-        c = self.cfg
-        return (f"n{c.n_devices}-L{c.num_layers}-h{c.hidden_size}"
-                f"-H{c.num_heads}-s{c.seq_len}-b{c.global_batch}")
+        """Stable digest over EVERY TuneConfig field — any field can change
+        trial outcomes (remat, vocab, hardware caps, ...), so any change
+        must invalidate history reuse."""
+        import dataclasses
+        import hashlib
+
+        blob = json.dumps(dataclasses.asdict(self.cfg), sort_keys=True)
+        return hashlib.sha1(blob.encode()).hexdigest()[:12]
 
     # -- candidate generation (reference: search.py GridSearch) --
     def candidates(self) -> List[Candidate]:
@@ -259,6 +264,7 @@ class AutoTuner:
         """One error-tolerant trial with history reuse + recording."""
         cached = recorder.metric_for(c)
         if cached is not None:
+            self.history.append((c, cached))  # resumed runs keep history
             return cached
         if recorder.seen(c):
             return None  # previously failed — don't retry (reference prune)

@@ -9,9 +9,11 @@ Scope note (TPU-native): the reference's PS mode exists for CPU-cluster
 trillion-parameter embedding models. On TPU pods the same workload is served
 by sharded embedding tables over ICI (expert/embedding sharding in the SPMD
 engine). This module provides a functional host-side PS — dense/sparse tables
-with server-side SGD/Adagrad, push/pull over the RPC layer — so PS-paradigm
+with server-side SGD/Adagrad, push/pull over the RPC layer, and (round 5)
+``ShardedPsClient``: sparse feature ids sharded ``fid % n_servers`` across
+multiple server processes with per-shard async fan-out — so PS-paradigm
 programs port and small-scale PS jobs run; it intentionally does not
-reimplement brpc/heter-PS scale-out. Cf. SURVEY.md §2 #30/#31.
+reimplement brpc/heter-PS CLUSTER scale-out. Cf. SURVEY.md §2 #30/#31.
 """
 
 from __future__ import annotations
@@ -24,8 +26,8 @@ import numpy as np
 from . import _tables
 from .. import rpc
 
-__all__ = ["ParameterServer", "PsWorker", "DenseTable", "SparseTable",
-           "run_server", "stop_server"]
+__all__ = ["ParameterServer", "PsWorker", "ShardedPsClient", "DenseTable",
+           "SparseTable", "run_server", "stop_server"]
 
 DenseTable = _tables.DenseTable
 SparseTable = _tables.SparseTable
@@ -154,3 +156,117 @@ class PsWorker:
 
     def stat(self):
         return self._call("stat")
+
+
+class ShardedPsClient:
+    """Trainer-side handle over MULTIPLE parameter servers (round 5 —
+    reference: the brpc PS shards sparse feature ids across server
+    instances, ps/service/brpc_ps_client with per-shard request fan-out).
+
+    Sharding scheme (the reference's):
+      - sparse tables exist on EVERY server; feature id ``fid`` lives on
+        server ``fid % n_servers`` — pull/push fan out per-shard and
+        reassemble in request order.
+      - dense tables live on one server each, ``hash(name) % n_servers``
+        (dense state is small next to sparse embeddings).
+    ``push_*_async`` returns a future-like list; ``wait()`` drains every
+    outstanding push — the reference's async push + barrier pattern.
+    """
+
+    def __init__(self, servers: Sequence[str]):
+        if not servers:
+            raise ValueError("need at least one server name")
+        self.servers = list(servers)
+        self.workers = [PsWorker(s) for s in self.servers]
+        self._pending: List[object] = []
+
+    # -- placement --
+    def _dense_worker(self, name: str) -> PsWorker:
+        import zlib
+
+        return self.workers[zlib.adler32(name.encode()) % len(self.workers)]
+
+    def _shard_ids(self, ids: Sequence[int]):
+        """Group ids by owning server, remembering original positions."""
+        n = len(self.workers)
+        groups: Dict[int, List[int]] = {}
+        pos: Dict[int, List[int]] = {}
+        for i, fid in enumerate(ids):
+            s = int(fid) % n
+            groups.setdefault(s, []).append(int(fid))
+            pos.setdefault(s, []).append(i)
+        return groups, pos
+
+    # -- tables --
+    def create_dense_table(self, name, shape, **kw):
+        return self._dense_worker(name).create_dense_table(name, shape, **kw)
+
+    def create_sparse_table(self, name, emb_dim, **kw):
+        # sparse tables exist on every shard
+        return all(w.create_sparse_table(name, emb_dim, **kw)
+                   for w in self.workers)
+
+    # -- dense --
+    def pull_dense(self, name) -> np.ndarray:
+        return self._dense_worker(name).pull_dense(name)
+
+    def push_dense(self, name, grad) -> bool:
+        return self._dense_worker(name).push_dense(name, grad)
+
+    # -- sparse (per-shard fan-out) --
+    def pull_sparse(self, name, ids) -> np.ndarray:
+        if len(ids) == 0:
+            # preserve the single-server (0, emb_dim) contract
+            return self.workers[0].pull_sparse(name, [])
+        groups, pos = self._shard_ids(ids)
+        futs = {s: rpc.rpc_async(self.servers[s], _dispatch,
+                                 args=("pull_sparse", name, fids))
+                for s, fids in groups.items()}
+        out: Optional[np.ndarray] = None
+        for s, fut in futs.items():
+            rows = np.asarray(fut.result())
+            if out is None:
+                out = np.zeros((len(ids), rows.shape[-1]), rows.dtype)
+            out[pos[s]] = rows
+        return out
+
+    def push_sparse(self, name, ids, grads) -> bool:
+        futs = self.push_sparse_async(name, ids, grads)
+        self._drain(futs)
+        self._pending = [f for f in self._pending if f not in futs]
+        return True
+
+    def push_sparse_async(self, name, ids, grads):
+        """Fire the per-shard pushes without blocking; drain via wait()."""
+        grads = np.asarray(grads)
+        groups, pos = self._shard_ids(ids)
+        futs = [rpc.rpc_async(self.servers[s], _dispatch,
+                              args=("push_sparse", name, fids,
+                                    grads[pos[s]]))
+                for s, fids in groups.items()]
+        self._pending.extend(futs)
+        return futs
+
+    @staticmethod
+    def _drain(futs):
+        """Await EVERY future even when some fail, then re-raise the first
+        error — a barrier that abandons in-flight pushes on the error path
+        would let the caller race still-mutating shards."""
+        first_err = None
+        for f in futs:
+            try:
+                f.result()
+            except Exception as e:
+                if first_err is None:
+                    first_err = e
+        if first_err is not None:
+            raise first_err
+
+    def wait(self):
+        """Barrier for every outstanding async push (reference: the PS
+        client's flush before pull/evaluation)."""
+        pending, self._pending = self._pending, []
+        self._drain(pending)
+
+    def stat(self):
+        return {s: w.stat() for s, w in zip(self.servers, self.workers)}

@@ -72,6 +72,8 @@ class SparseTable:
         return r
 
     def pull(self, ids: Sequence[int]) -> np.ndarray:
+        if len(ids) == 0:
+            return np.zeros((0, self.emb_dim), np.float32)
         with self._lock:
             return np.stack([self._row(int(i)) for i in ids])
 
