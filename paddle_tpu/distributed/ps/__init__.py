@@ -167,8 +167,10 @@ class ShardedPsClient:
       - sparse tables exist on EVERY server; feature id ``fid`` lives on
         server ``fid % n_servers`` — pull/push fan out per-shard and
         reassemble in request order.
-      - dense tables live on one server each, ``hash(name) % n_servers``
-        (dense state is small next to sparse embeddings).
+      - dense tables live on one server each, placed by
+        ``zlib.adler32(name) % n_servers`` (deterministic across processes,
+        unlike Python's salted str hash; dense state is small next to
+        sparse embeddings).
     ``push_*_async`` returns a future-like list; ``wait()`` drains every
     outstanding push — the reference's async push + barrier pattern.
     """
@@ -232,8 +234,13 @@ class ShardedPsClient:
 
     def push_sparse(self, name, ids, grads) -> bool:
         futs = self.push_sparse_async(name, ids, grads)
-        self._drain(futs)
-        self._pending = [f for f in self._pending if f not in futs]
+        try:
+            self._drain(futs)
+        finally:
+            # drained (or failed) futures must leave the barrier set either
+            # way — a later wait() must not re-raise this call's error
+            fset = set(map(id, futs))
+            self._pending = [f for f in self._pending if id(f) not in fset]
         return True
 
     def push_sparse_async(self, name, ids, grads):

@@ -493,9 +493,18 @@ def histogram_bin_edges(x, bins=100, min=0.0, max=0.0, name=None):
 
 def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
     wv = unwrap(weights) if weights is not None else None
+    # reference API: ``ranges`` is a FLAT [min0, max0, min1, max1, ...] list
+    # (tensor/linalg.py histogramdd); jnp wants (min, max) pairs — caught by
+    # the round-5 numeric sweep
+    rng_pairs = None
+    if ranges is not None:
+        flat = [float(v) for v in ranges]
+        if len(flat) % 2:
+            raise ValueError("ranges must hold min/max pairs, got odd length")
+        rng_pairs = [(flat[i], flat[i + 1]) for i in range(0, len(flat), 2)]
 
     def fn(a):
-        return jnp.histogramdd(a, bins=bins, range=ranges, density=density,
+        return jnp.histogramdd(a, bins=bins, range=rng_pairs, density=density,
                                weights=wv)
 
     return apply_fn("histogramdd", fn, x)

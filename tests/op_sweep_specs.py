@@ -1168,3 +1168,324 @@ def distinct_symbols():
 
 def grad_specs():
     return [s for s in SPECS if s.grad_idx is not None]
+
+
+# ---------------------------------------------------------------------------
+# 13) round-5 second batch: remaining numerically-checkable manifest symbols
+# ---------------------------------------------------------------------------
+
+op("add_n", lambda a, b, c: paddle.add_n([a, b, c]),
+   lambda a, b, c: a + b + c, [F(3, 4), F(3, 4), F(3, 4)],
+   ["paddle:add_n"], grad_idx=0, grad_inputs=[F(2, 3), F(2, 3), F(2, 3)])
+op("mm", lambda a, b: paddle.mm(a, b), lambda a, b: a @ b,
+   [F(3, 4), F(4, 5)], ["paddle:mm", "method:mm"], rtol=1e-4, atol=1e-5)
+op("negative", lambda x: paddle.negative(x), np.negative, [F(3, 4)],
+   ["paddle:negative"])
+op("floor_mod", lambda a, b: paddle.floor_mod(a, b),
+   lambda a, b: np.mod(a, b), [F(3, 4), POS(3, 4)],
+   ["paddle:floor_mod", "method:floor_mod"])
+op("swapaxes", lambda x: paddle.swapaxes(x, 0, 1),
+   lambda x: np.swapaxes(x, 0, 1), [F(3, 4)],
+   ["paddle:swapaxes", "method:swapaxes"])
+op("swapdims", lambda x: paddle.swapdims(x, 0, 2),
+   lambda x: np.swapaxes(x, 0, 2), [F(2, 3, 4)],
+   ["paddle:swapdims", "method:swapdims"])
+op("tensordot", lambda a, b: paddle.tensordot(a, b, axes=2),
+   lambda a, b: np.tensordot(a, b, axes=2), [F(3, 4, 5), F(4, 5, 2)],
+   ["paddle:tensordot"], rtol=1e-4, atol=1e-4)
+op("einsum.matmul", lambda a, b: paddle.einsum("ij,jk->ik", a, b),
+   lambda a, b: a @ b, [F(3, 4), F(4, 5)], ["paddle:einsum"],
+   rtol=1e-4, atol=1e-5)
+op("einsum.trace_batch",
+   lambda x: paddle.einsum("bii->b", x),
+   lambda x: np.trace(x, axis1=1, axis2=2), [F(2, 4, 4)],
+   ["paddle:einsum"], rtol=1e-5)
+op("expand_as", lambda x, y: paddle.expand_as(x, y),
+   lambda x, y: np.broadcast_to(x, y.shape), [F(1, 4), F(3, 4)],
+   ["paddle:expand_as", "method:expand_as"])
+op("unflatten", lambda x: paddle.unflatten(x, 1, [2, 3]),
+   lambda x: x.reshape(4, 2, 3), [F(4, 6)],
+   ["paddle:unflatten", "method:unflatten"])
+op("tensor_split",
+   lambda x: paddle.tensor_split(x, 3, axis=1),
+   lambda x: np.array_split(x, 3, axis=1), [F(2, 9)],
+   ["paddle:tensor_split", "method:tensor_split"])
+op("hsplit", lambda x: paddle.hsplit(x, 2),
+   lambda x: np.hsplit(x, 2), [F(4, 6)], ["paddle:hsplit"])
+op("vsplit", lambda x: paddle.vsplit(x, 2),
+   lambda x: np.vsplit(x, 2), [F(4, 6)], ["paddle:vsplit"])
+op("dsplit", lambda x: paddle.dsplit(x, 2),
+   lambda x: np.dsplit(x, 2), [F(2, 3, 4)], ["paddle:dsplit"])
+op("reverse", lambda x: paddle.reverse(x, axis=[1]),
+   lambda x: np.flip(x, axis=1), [F(3, 4)], ["paddle:reverse"])
+op("isneginf", lambda x: paddle.isneginf(x), np.isneginf,
+   [np.asarray([1.0, -np.inf, np.inf, np.nan], np.float32)],
+   ["paddle:isneginf"])
+op("isposinf", lambda x: paddle.isposinf(x), np.isposinf,
+   [np.asarray([1.0, -np.inf, np.inf, np.nan], np.float32)],
+   ["paddle:isposinf"])
+op("isreal", lambda x: paddle.isreal(x), np.isreal, [F(3, 4)],
+   ["paddle:isreal"])
+op("signbit", lambda x: paddle.signbit(x), np.signbit, [F(3, 4)],
+   ["paddle:signbit", "method:signbit"])
+op("sinc", lambda x: paddle.sinc(x), np.sinc, [F(3, 4)],
+   ["paddle:sinc", "method:sinc"], rtol=1e-4, atol=1e-5)
+op("stanh", lambda x: paddle.stanh(x, 0.67, 1.7159),
+   lambda x: 1.7159 * np.tanh(0.67 * x), [F(3, 4)],
+   ["paddle:stanh", "method:stanh"], rtol=1e-4, atol=1e-5)
+op("ldexp", lambda a, b: paddle.ldexp(a, b),
+   lambda a, b: np.ldexp(a, b.astype(np.int64)),
+   [F(3, 4), I32(3, 4, lo=-3, hi=4).astype(np.float32)],
+   ["paddle:ldexp", "method:ldexp"], rtol=1e-5)
+op("frexp",
+   lambda x: list(paddle.frexp(x)),
+   lambda x: list(np.frexp(x)), [POS(3, 4)],
+   ["paddle:frexp", "method:frexp"], rtol=1e-6)
+op("polar", lambda r, t: paddle.real(paddle.polar(r, t)),
+   lambda r, t: r * np.cos(t), [POS(3, 4), F(3, 4)],
+   ["paddle:polar"], rtol=1e-5)
+op("as_complex_real_roundtrip",
+   lambda x: paddle.as_real(paddle.as_complex(x)),
+   lambda x: x, [F(3, 4, 2)],
+   ["paddle:as_complex", "paddle:as_real"])
+op("broadcast_shape",
+   lambda: paddle.to_tensor(np.asarray(
+       paddle.broadcast_shape([3, 1, 4], [5, 1]))),
+   lambda: np.asarray([3, 5, 4]), [], ["paddle:broadcast_shape"])
+op("broadcast_tensors",
+   lambda a, b: paddle.broadcast_tensors([a, b]),
+   lambda a, b: list(np.broadcast_arrays(a, b)), [F(1, 4), F(3, 1)],
+   ["paddle:broadcast_tensors"])
+op("cartesian_prod",
+   lambda a, b: paddle.cartesian_prod([a, b]),
+   lambda a, b: np.stack(np.meshgrid(a, b, indexing="ij"),
+                         axis=-1).reshape(-1, 2),
+   [F(3), F(4)], ["paddle:cartesian_prod"])
+op("combinations",
+   lambda x: paddle.combinations(x, 2),
+   lambda x: np.asarray([[x[i], x[j]] for i in range(4)
+                         for j in range(i + 1, 4)], np.float32),
+   [F(4)], ["paddle:combinations"])
+op("cdist", lambda a, b: paddle.cdist(a, b),
+   lambda a, b: np.sqrt(((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)),
+   [F(4, 3), F(5, 3)], ["paddle:cdist"], rtol=1e-4, atol=1e-4)
+op("pdist", lambda x: paddle.pdist(x),
+   lambda x: np.asarray([np.linalg.norm(x[i] - x[j])
+                         for i in range(4) for j in range(i + 1, 4)],
+                        np.float32),
+   [F(4, 3)], ["paddle:pdist"], rtol=1e-4, atol=1e-4)
+op("dist", lambda a, b: paddle.dist(a, b, p=2),
+   lambda a, b: np.linalg.norm((a - b).ravel()), [F(3, 4), F(3, 4)],
+   ["paddle:dist", "method:dist"], rtol=1e-4, atol=1e-5)
+op("cov", lambda x: paddle.linalg.cov(x),
+   lambda x: np.cov(x), [F(3, 8)], ["linalg:cov", "paddle:cov"],
+   rtol=1e-4, atol=1e-4)
+op("corrcoef", lambda x: paddle.linalg.corrcoef(x),
+   lambda x: np.corrcoef(x), [F(3, 8)],
+   ["linalg:corrcoef", "paddle:corrcoef"], rtol=1e-4, atol=1e-4)
+op("vander", lambda x: paddle.vander(x, 4),
+   lambda x: np.vander(x, 4), [F(5)],
+   ["paddle:vander"], rtol=1e-4, atol=1e-4)
+op("quantile",
+   lambda x: paddle.quantile(x.flatten(), 0.5),
+   lambda x: np.quantile(x.reshape(-1), 0.5), [F(3, 7)],
+   ["paddle:quantile", "method:quantile"], rtol=1e-5)
+op("nanquantile",
+   lambda x: paddle.nanquantile(x.flatten(), 0.25),
+   lambda x: np.nanquantile(x.reshape(-1), 0.25), [F(3, 7)],
+   ["paddle:nanquantile", "method:nanquantile"], rtol=1e-5)
+op("histogram_bin_edges",
+   lambda x: paddle.histogram_bin_edges(x, bins=5, min=-2.0, max=2.0),
+   lambda x: np.histogram_bin_edges(x, bins=5, range=(-2, 2))
+   .astype(np.float32), [F(20)], ["paddle:histogram_bin_edges"],
+   rtol=1e-6)
+op("histogramdd",
+   lambda x: paddle.histogramdd(x, bins=[3, 3],
+                                ranges=[-2.0, 2.0, -2.0, 2.0])[0],
+   lambda x: np.histogramdd(x, bins=[3, 3],
+                            range=[(-2, 2), (-2, 2)])[0],
+   [F(30, 2)], ["paddle:histogramdd"], modes=("eager",))
+op("index_sample",
+   lambda x, i: paddle.index_sample(x, i),
+   lambda x, i: np.take_along_axis(x, i, axis=1),
+   [F(3, 6), I64(3, 2, hi=6)], ["paddle:index_sample"])
+op("multiplex",
+   lambda a, b, i: paddle.multiplex([a, b], i),
+   lambda a, b, i: np.where(i == 0, a, b),
+   [F(4, 3), F(4, 3), I32(4, 1, hi=2)], ["paddle:multiplex"])
+op("masked_scatter",
+   lambda x, m, v: paddle.masked_scatter(x, m, v),
+   lambda x, m, v: _masked_scatter_ref(x, m, v),
+   [F(3, 4), BOOL(3, 4), F(12)],
+   ["paddle:masked_scatter", "method:masked_scatter"],
+   modes=("eager",))
+op("diagonal_scatter",
+   lambda x, v: paddle.diagonal_scatter(x, v),
+   lambda x, v: _diag_scatter_ref(x, v), [F(4, 4), F(4)],
+   ["paddle:diagonal_scatter", "method:diagonal_scatter"])
+op("select_scatter",
+   lambda x, v: paddle.select_scatter(x, v, axis=1, index=2),
+   lambda x, v: _select_scatter_ref(x, v), [F(3, 5), F(3)],
+   ["paddle:select_scatter"])
+op("slice_scatter",
+   lambda x, v: paddle.slice_scatter(x, v, axes=[1], starts=[1],
+                                     ends=[3], strides=[1]),
+   lambda x, v: _slice_scatter_ref(x, v), [F(3, 5), F(3, 2)],
+   ["paddle:slice_scatter"])
+op("scatter_nd",
+   lambda i, u: paddle.scatter_nd(i, u, [5, 3]),
+   lambda i, u: _scatter_nd_ref(i, u),
+   [np.asarray([[1], [3]], np.int64), F(2, 3)], ["paddle:scatter_nd"])
+op("renorm",
+   lambda x: paddle.renorm(x, p=2.0, axis=0, max_norm=1.0),
+   lambda x: _renorm_ref(x), [F(3, 4) * 2], ["paddle:renorm"],
+   rtol=1e-4, atol=1e-5)
+op("linalg.inverse", lambda x: paddle.inverse(x), np.linalg.inv,
+   [SPD(4)], ["paddle:inverse", "method:inverse"], rtol=1e-3, atol=1e-4)
+op("linalg.cholesky_solve",
+   lambda b, l: paddle.cholesky_solve(b, l, upper=False),
+   lambda b, l: np.linalg.solve(l @ l.T, b),
+   [F(4, 2), np.linalg.cholesky(SPD(4))],
+   ["paddle:cholesky_solve", "linalg:cholesky_solve"],
+   rtol=1e-3, atol=1e-3)
+op("linalg.cholesky_inverse",
+   lambda l: paddle.cholesky_inverse(l, upper=False),
+   lambda l: np.linalg.inv(l @ l.T), [np.linalg.cholesky(SPD(4))],
+   ["paddle:cholesky_inverse", "linalg:cholesky_inverse"],
+   rtol=1e-3, atol=1e-3)
+op("linalg.matrix_exp",
+   lambda x: paddle.linalg.matrix_exp(x),
+   lambda x: _scipy_linalg().expm(x.astype(np.float64)).astype(np.float32),
+   [F(4, 4) * 0.3], ["paddle:matrix_exp", "linalg:matrix_exp"],
+   rtol=1e-3, atol=1e-4)
+op("linalg.matrix_norm",
+   lambda x: [paddle.linalg.matrix_norm(x, p="fro"),
+              paddle.linalg.matrix_norm(x, p=np.inf)],
+   lambda x: [np.linalg.norm(x, "fro"), np.linalg.norm(x, np.inf)],
+   [F(4, 4)], ["linalg:matrix_norm"], rtol=1e-4, atol=1e-5)
+op("linalg.vector_norm",
+   lambda x: [paddle.linalg.vector_norm(x),
+              paddle.linalg.vector_norm(x, p=1)],
+   lambda x: [np.linalg.norm(x.ravel()),
+              np.abs(x).sum()],
+   [F(3, 4)], ["linalg:vector_norm"], rtol=1e-4, atol=1e-5)
+op("linalg.svdvals",
+   lambda x: paddle.linalg.svdvals(x),
+   lambda x: np.linalg.svd(x, compute_uv=False), [F(4, 3)],
+   ["linalg:svdvals", "paddle:svdvals"], rtol=1e-3, atol=1e-3)
+op("linalg.eigvals.abs",
+   lambda x: paddle.sort(paddle.abs(paddle.eigvals(x))),
+   lambda x: np.sort(np.abs(np.linalg.eigvals(x))), [SPD(3)],
+   ["paddle:eigvals", "linalg:eigvals"], rtol=1e-3, atol=1e-3,
+   modes=("eager",))
+op("linalg.lu_reconstruct",
+   lambda x: _lu_reconstruct(x),
+   lambda x: x, [SPD(4)], ["paddle:lu", "paddle:lu_unpack",
+                           "linalg:lu", "linalg:lu_unpack"],
+   rtol=1e-3, atol=1e-3)
+op("multigammaln",
+   lambda x: paddle.multigammaln(x, 2),
+   lambda x: _scipy_special().multigammaln(x, 2), [POS(3, 4) + 2.0],
+   ["paddle:multigammaln", "method:multigammaln"], rtol=1e-4, atol=1e-4)
+op("gammainc",
+   lambda a, x: paddle.gammainc(a, x),
+   lambda a, x: sps.gammainc(a, x), [POS(3, 4), POS(3, 4)],
+   ["paddle:gammainc"], rtol=1e-4, atol=1e-5)
+op("gammaincc",
+   lambda a, x: paddle.gammaincc(a, x),
+   lambda a, x: sps.gammaincc(a, x), [POS(3, 4), POS(3, 4)],
+   ["paddle:gammaincc"], rtol=1e-4, atol=1e-5)
+op("polygamma",
+   lambda x: paddle.polygamma(x, 1),
+   lambda x: sps.polygamma(1, x), [POS(3, 4)],
+   ["paddle:polygamma", "method:polygamma"], rtol=1e-4, atol=1e-4)
+op("tolist", lambda x: paddle.to_tensor(np.asarray(x.tolist())),
+   lambda x: x, [F(3, 4)], ["method:tolist"], modes=("eager",))
+op("view", lambda x: x.view([4, 3]), lambda x: x.reshape(4, 3),
+   [F(3, 4)], ["method:view"], modes=("eager",))
+op("view_as", lambda x, y: x.view_as(y), lambda x, y: x.reshape(y.shape),
+   [F(3, 4), F(4, 3)], ["method:view_as"], modes=("eager",))
+
+# random ops: property checks (shape/dtype/range/permutation), seeded
+op("randperm.is_permutation",
+   lambda: paddle.to_tensor(np.sort(np.asarray(
+       paddle.randperm(16).numpy()))),
+   lambda: np.arange(16), [], ["paddle:randperm"], modes=("eager",))
+op("randint.range",
+   lambda: paddle.to_tensor(np.asarray([
+       int(paddle.randint(3, 9, [64]).numpy().min() >= 3),
+       int(paddle.randint(3, 9, [64]).numpy().max() < 9)])),
+   lambda: np.asarray([1, 1]), [], ["paddle:randint"], modes=("eager",))
+op("rand.range",
+   lambda: paddle.to_tensor(np.asarray(
+       [float(paddle.rand([64]).numpy().min() >= 0.0),
+        float(paddle.rand([64]).numpy().max() < 1.0)], np.float32)),
+   lambda: np.asarray([1.0, 1.0], np.float32), [], ["paddle:rand"],
+   modes=("eager",))
+op("randn.shape",
+   lambda: paddle.to_tensor(np.asarray(paddle.randn([4, 5]).shape)),
+   lambda: np.asarray([4, 5]), [], ["paddle:randn"], modes=("eager",))
+op("bernoulli.binary",
+   lambda: paddle.to_tensor(np.asarray(float(np.isin(
+       paddle.bernoulli(paddle.full([32], 0.5)).numpy(),
+       [0.0, 1.0]).all()), np.float32)),
+   lambda: np.float32(1.0), [], ["paddle:bernoulli"], modes=("eager",))
+op("multinomial.range",
+   lambda: paddle.to_tensor(np.asarray(float(
+       paddle.multinomial(paddle.to_tensor(
+           np.asarray([0.2, 0.3, 0.5], np.float32)), 16,
+           replacement=True).numpy().max() < 3), np.float32)),
+   lambda: np.float32(1.0), [], ["paddle:multinomial"], modes=("eager",))
+
+
+def _masked_scatter_ref(x, m, v):
+    out = x.copy()
+    out[m] = v[:int(m.sum())]
+    return out
+
+
+def _diag_scatter_ref(x, v):
+    out = x.copy()
+    np.fill_diagonal(out, v)
+    return out
+
+
+def _select_scatter_ref(x, v):
+    out = x.copy()
+    out[:, 2] = v
+    return out
+
+
+def _slice_scatter_ref(x, v):
+    out = x.copy()
+    out[:, 1:3] = v
+    return out
+
+
+def _scatter_nd_ref(i, u):
+    out = np.zeros((5, 3), np.float32)
+    for row, upd in zip(i[:, 0], u):
+        out[row] += upd
+    return out
+
+
+def _renorm_ref(x):
+    norms = np.linalg.norm(x.reshape(x.shape[0], -1), axis=1)
+    scale = np.minimum(1.0, 1.0 / np.maximum(norms, 1e-7))
+    return x * scale[:, None]
+
+
+def _scipy_linalg():
+    import scipy.linalg
+    return scipy.linalg
+
+
+def _scipy_special():
+    import scipy.special
+    return scipy.special
+
+
+def _lu_reconstruct(x):
+    lu_mat, pivots = paddle.linalg.lu(x)
+    p, l, u = paddle.linalg.lu_unpack(lu_mat, pivots)
+    return paddle.matmul(paddle.matmul(p, l), u)

@@ -20,7 +20,7 @@ import pytest
 from op_test import check_grad, check_output
 from op_sweep_specs import SPECS, distinct_symbols, grad_specs
 
-MIN_DISTINCT_SYMBOLS = 400
+MIN_DISTINCT_SYMBOLS = 650
 MIN_GRAD_SPECS = 60
 
 
