@@ -55,7 +55,11 @@ class Variable(Tensor):
         v = cls.__new__(cls)
         shape = tuple(-1 if s is None else int(s) for s in shape)
         adv = tuple(1 if s == -1 else s for s in shape)
-        v._data = jax.ShapeDtypeStruct(adv, jax.numpy.dtype(dtype))
+        try:
+            dt = jax.numpy.dtype(dtype)
+        except TypeError:
+            dt = dtype  # jax extended dtype (PRNG key avals from traced imports)
+        v._data = jax.ShapeDtypeStruct(adv, dt)
         v.stop_gradient = True
         v._grad = None
         v._node = None
@@ -102,14 +106,15 @@ class Operation:
     """
 
     __slots__ = ("idx", "type", "fn", "args", "kwargs", "inputs", "captured",
-                 "outputs")
+                 "outputs", "src")
 
-    def __init__(self, idx, type, fn, args, kwargs):
+    def __init__(self, idx, type, fn, args, kwargs, src=None):
         self.idx = idx
         self.type = type
         self.fn = fn
         self.args = args
         self.kwargs = kwargs
+        self.src = src  # "file:line" of the recording call site (diagnostics)
         self.inputs: List[Variable] = [a for a in args if isinstance(a, Variable)]
         self.captured: List[Tensor] = [
             a for a in args if isinstance(a, Tensor) and not isinstance(a, Variable)]
@@ -125,6 +130,7 @@ class Operation:
         op.fn = fn
         op.args = self.args
         op.kwargs = self.kwargs
+        op.src = self.src
         op.inputs = self.inputs
         op.captured = self.captured
         op.outputs = self.outputs
@@ -204,6 +210,10 @@ class Program:
         # minimize()d program training)
         p._aliases = dict(getattr(self, "_aliases", {}))
         p._folded = dict(getattr(self, "_folded", {}))
+        p._seed_stamps = dict(getattr(self, "_seed_stamps", {}))
+        # analysis liveness roots (trace imports) travel with the clone too
+        if getattr(self, "_outputs", None):
+            p._outputs = list(self._outputs)
         if not for_test:
             # a test clone must never train: leaving loss/optimizer behind
             # keeps Executor.run on the inference path (no grads, no step())
@@ -251,6 +261,15 @@ class Program:
                     seen.add(id(t))
                     out.append(t)
         return out
+
+    def diagnose(self, targets=None, parameters=None):
+        """Run the full program-level analysis suite (static/analysis) and
+        return the AnalysisReport: shape/dtype verification, trace hazards,
+        SPMD consistency, graph health (dead ops, duplicate subgraphs, unused
+        parameters). Reports only — the program is never mutated."""
+        from ..static.analysis import run_analysis
+
+        return run_analysis(self, targets=targets, parameters=parameters)
 
 
 # ---------------------------------------------------------------------------
@@ -337,6 +356,40 @@ def _adv_struct(a):
     return a
 
 
+_PKG_DIR = None
+_EXTERNAL_FILE: Dict[str, bool] = {}  # co_filename -> outside paddle_tpu?
+
+
+def _caller_src():
+    """file:line of the first stack frame outside paddle_tpu — the user call
+    site that recorded the op. Lets diagnostics name the offending source line
+    (cf. the reference's op attrs op_callstack). Runs per recorded op, so the
+    inside/outside-package verdict is cached per co_filename."""
+    global _PKG_DIR
+    import os
+    import sys
+
+    if _PKG_DIR is None:
+        _PKG_DIR = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))) + os.sep
+    try:
+        f = sys._getframe(2)
+        depth = 0
+        while f is not None and depth < 32:
+            fn = f.f_code.co_filename
+            ext = _EXTERNAL_FILE.get(fn)
+            if ext is None:
+                ext = not os.path.abspath(fn).startswith(_PKG_DIR)
+                _EXTERNAL_FILE[fn] = ext
+            if ext:
+                return f"{fn}:{f.f_lineno}"
+            f = f.f_back
+            depth += 1
+    except Exception:
+        pass
+    return None
+
+
 def record_op(name: str, fn, args, kwargs):
     """Append an Operation to the current program; return symbolic outputs."""
     prog = None
@@ -347,9 +400,21 @@ def record_op(name: str, fn, args, kwargs):
     if prog is None:
         prog = current_program()
     blk = prog.current_block()
-    op = Operation(len(blk.ops), name, fn, list(args), dict(kwargs))
+    op = Operation(len(blk.ops), name, fn, list(args), dict(kwargs),
+                   src=_caller_src())
     blk.ops.append(op)
     prog._version += 1
+    if any(k in name for k in STOCHASTIC_KEYWORDS):
+        # stamp seededness AT RECORD TIME, per op: a later unrelated
+        # paddle.seed() must not launder an unreproducible recording past the
+        # trace linter, and an op with no stamp (hand-built) falls back to
+        # process state there.
+        from ..framework.random import explicitly_seeded
+
+        if not hasattr(prog, "_seed_stamps"):
+            prog._seed_stamps = {}
+        prog._seed_stamps[id(op)] = not (explicitly_seeded()
+                                         or prog.random_seed)
 
     # advisory shape/dtype inference == InferMeta, via the op's own function
     def pure(*sym_args):

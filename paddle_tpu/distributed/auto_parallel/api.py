@@ -26,9 +26,24 @@ def _named_sharding(mesh: ProcessMesh, placements: Sequence[Placement], ndim: in
     return NamedSharding(mesh.to_jax(), spec)
 
 
+def _validate_placements(shape, mesh, placements):
+    """Pre-lowering SPMD consistency check (static/analysis): an invalid axis
+    or uneven shard diagnosed HERE has a name; at pjit time it is an opaque
+    XLA sharding error or a silent dim-wrap."""
+    import warnings
+
+    # submodule import on purpose: spmd_check is dependency-light; pulling
+    # the whole analysis package here would defeat its lazy loading
+    from ...static.analysis.spmd_check import check_placements
+
+    for d in check_placements(shape, mesh, placements):
+        warnings.warn(d.format(), UserWarning, stacklevel=3)
+
+
 def shard_tensor(data, mesh: ProcessMesh, placements: Sequence[Placement], dtype=None, stop_gradient=None):
     """Place a tensor onto a mesh with given placements (reference api.py:181)."""
     t = data if isinstance(data, Tensor) else Tensor(data, dtype=dtype)
+    _validate_placements(tuple(t._data.shape), mesh, placements)
     sharding = _named_sharding(mesh, placements, t._data.ndim)
     arr = t._data
     if isinstance(arr, jax.core.Tracer):

@@ -1,0 +1,40 @@
+"""Version gates for jax APIs that moved between releases.
+
+The tree targets the current jax surface (top-level ``jax.shard_map`` with the
+``axis_names=`` kwarg); older jax (< 0.5) only ships
+``jax.experimental.shard_map.shard_map`` with the complementary ``auto=``
+kwarg (axes NOT named manual). This shim presents the new calling convention
+on either version so kernel/distributed code is written once. No new
+dependencies — gating only, per the container contract.
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["shard_map"]
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _experimental_shard_map
+
+    def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                  axis_names=None, **kwargs):
+        auto = None
+        if axis_names is not None:
+            # new API: `axis_names` = mesh axes f is manual over;
+            # old API: `auto` = mesh axes left automatic — the complement
+            auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+            kwargs["auto"] = auto
+        if "check_vma" in kwargs:  # renamed from check_rep
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        mapped = _experimental_shard_map(f, mesh=mesh, in_specs=in_specs,
+                                         out_specs=out_specs, **kwargs)
+        if auto:
+            # old experimental shard_map supports nonempty `auto` only under
+            # jit (eager call raises NotImplementedError) — wrap it. The pp
+            # schedules may still hit this jaxlib's "PartitionId unsupported"
+            # wall at compile time on CPU; that limit is gated in tests.
+            mapped = jax.jit(mapped)
+        return mapped

@@ -17,7 +17,7 @@ import jax
 _state = threading.local()
 # key created LAZILY: building it at import would initialize the XLA backend,
 # which must not happen before jax.distributed.initialize in multi-host boot
-_global = {"key": None, "seed": 0}
+_global = {"key": None, "seed": 0, "seeded": False}
 _host_counter = [0]
 
 
@@ -31,8 +31,16 @@ def seed(s: int):
     """Set the global RNG seed (paddle.seed)."""
     _global["key"] = jax.random.key(int(s))
     _global["seed"] = int(s)
+    _global["seeded"] = True
     _host_counter[0] = 0  # next_host_seed() restarts: re-seeding reproduces runs
     return _global["seed"]
+
+
+def explicitly_seeded() -> bool:
+    """Has paddle.seed() ever been called in this process? Stochastic ops
+    recorded without an explicit seed are not reproducible run-to-run — the
+    trace-hazard linter flags them (PT-TRACE-003)."""
+    return bool(_global["seeded"])
 
 
 def get_rng_state():
@@ -41,6 +49,9 @@ def get_rng_state():
 
 def set_rng_state(key):
     _global["key"] = key
+    # restoring a saved key is an explicit seeding decision — the run is
+    # reproducible, so the trace linter must not flag PT-TRACE-003
+    _global["seeded"] = True
 
 
 def _ctx_stack():

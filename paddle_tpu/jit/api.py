@@ -274,6 +274,13 @@ class StaticFunction:
 
         return jax.tree_util.tree_unflatten(out_tree, out_tensors)
 
+    def cache_keys(self):
+        """Introspection for the trace-hazard linter: one
+        ``(n_state, static_kwargs)`` key per compiled variant. Many variants
+        differing only in Python-scalar kwarg values mean the scalar is being
+        captured by value and forcing a recompile per call (PT-TRACE-002)."""
+        return list(self._fwd_cache.keys())
+
     def concrete_program(self):
         return self._last_concrete
 

@@ -299,7 +299,7 @@ def _attention(q, k, v, config, attn_bias=None):
             from ...ops.ring_attention import ring_attention
 
             return ring_attention(q, k, v, mesh, axis_name="sep", causal=True)
-        from jax import shard_map
+        from ...framework.jax_compat import shard_map
         from ...distributed.auto_parallel.logical_sharding import logical_to_spec
 
         tp = mesh.shape.get("tp", 1)

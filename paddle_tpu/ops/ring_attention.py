@@ -30,8 +30,9 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
+
+from ..framework.jax_compat import shard_map
 
 NEG_INF = -1e30
 

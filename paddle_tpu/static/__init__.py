@@ -30,12 +30,24 @@ from .passes import (  # noqa: F401
 )
 from . import nn  # noqa: F401
 
+
+def __getattr__(name):
+    # PEP 562 lazy submodule: the analysis package (6 modules) loads on first
+    # use, not at `import paddle_tpu` time
+    if name == "analysis":
+        import importlib
+
+        mod = importlib.import_module(".analysis", __name__)
+        globals()["analysis"] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
 __all__ = [
     "InputSpec", "Program", "Variable", "Executor", "Scope", "global_scope",
     "program_guard", "default_main_program", "default_startup_program",
     "data", "CompiledProgram", "BuildStrategy", "ExecutionStrategy",
     "append_backward", "name_scope", "PassManager", "apply_default_passes",
-    "nn",
+    "nn", "analysis",
 ]
 
 

@@ -1,0 +1,80 @@
+"""paddle_tpu.static.analysis — diagnostics over the recorded Program IR.
+
+The reference's L1 layer ships verifiers and analysis passes alongside its
+transforms (pir/include/pass/pass_manager.h:35); this package is the
+TPU-native analogue: a non-mutating ``AnalysisPass`` kind that composes with
+the existing ``PassManager`` and reports findings (``Diagnostic`` with stable
+PT-* codes, severity, op + source-line provenance) instead of rewriting the
+graph. See docs/STATIC_ANALYSIS.md for the code catalogue.
+
+Four analyzers ship:
+- ShapeDtypeVerifier    — forward shape/dtype re-inference vs the recorded
+                          graph; fp64 leaks; promotion surprises
+- TraceHazardLinter     — recompile hazards (feed-signature churn, Python
+                          scalars captured by value), unseeded stochastic
+                          ops, host syncs in traced source, lenient-scope
+                          reads
+- SpmdConsistencyChecker — placements vs mesh (invalid axis, uneven shards,
+                          conflicting shardings) before pjit lowering
+- GraphHealthReporter   — dead ops, duplicate subgraphs, unused parameters
+                          (``Program.diagnose()``)
+
+``trace_to_program`` / ``layer_to_program`` import any traceable callable —
+including every in-repo model family — into the Program IR so the analyzers
+(and tools/lint_graph.py) can run over real models.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from ...core.static_graph import Program
+from ..passes import PassManager
+from .diagnostics import AnalysisPass, AnalysisReport, Diagnostic, Severity
+from .graph_health import GraphHealthReporter
+from .shape_check import ShapeDtypeVerifier
+from .spmd_check import SpmdConsistencyChecker, check_axis_names, check_placements
+from .trace_import import layer_to_program, trace_to_program
+from .trace_lint import (TraceHazardLinter, lint_executor, lint_scope,
+                         lint_static_function)
+
+__all__ = [
+    "Severity", "Diagnostic", "AnalysisReport", "AnalysisPass",
+    "ShapeDtypeVerifier", "TraceHazardLinter", "SpmdConsistencyChecker",
+    "GraphHealthReporter", "run_analysis", "default_analysis_passes",
+    "trace_to_program", "layer_to_program",
+    "lint_executor", "lint_static_function", "lint_scope",
+    "check_placements", "check_axis_names",
+]
+
+
+def default_analysis_passes(targets=None, parameters=None, suppress=(),
+                            executors=(), static_fns=(), scopes=(),
+                            assume_seeded=None):
+    return [
+        ShapeDtypeVerifier(suppress=suppress),
+        TraceHazardLinter(suppress=suppress, executors=executors,
+                          static_fns=static_fns, scopes=scopes,
+                          assume_seeded=assume_seeded),
+        SpmdConsistencyChecker(suppress=suppress),
+        GraphHealthReporter(targets=targets, parameters=parameters,
+                            suppress=suppress),
+    ]
+
+
+def run_analysis(program: Program, passes: Optional[Sequence[AnalysisPass]] = None,
+                 targets=None, parameters=None, suppress=(),
+                 executors=(), static_fns=(), scopes=(),
+                 assume_seeded=None) -> AnalysisReport:
+    """Run the analyzer suite over a Program; return the combined report.
+    Composes through the ordinary PassManager — analysis passes are regular
+    passes that happen not to mutate."""
+    passes = list(passes if passes is not None else default_analysis_passes(
+        targets=targets, parameters=parameters, suppress=suppress,
+        executors=executors, static_fns=static_fns, scopes=scopes,
+        assume_seeded=assume_seeded))
+    PassManager(passes).run(program)
+    report = AnalysisReport()
+    for p in passes:
+        report.extend(p.report)
+    return report

@@ -1,0 +1,150 @@
+"""Diagnostic framework for program analysis.
+
+Parity anchors: the reference's PIR verifiers and analysis passes
+(pir/include/pass/pass_manager.h:35 — pass_manager composes verification
+between transforms; pir/include/core/verify.h) which reject malformed
+programs before execution. Here the same idea runs over the recorded
+``Program`` IR: analyzers walk the op list and *report* findings instead of
+mutating, so a bad graph is named at record time — with the offending op and
+source line — instead of surfacing as an opaque XLA error inside
+``Executor.run``.
+
+Every finding carries a stable diagnostic code (``PT-<AREA>-<NNN>``, see
+docs/STATIC_ANALYSIS.md) so CI gates can suppress or ratchet per-code.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Iterable, List, Optional, Sequence
+
+from ..passes import Pass
+
+__all__ = ["Severity", "Diagnostic", "AnalysisReport", "AnalysisPass"]
+
+
+class Severity(enum.IntEnum):
+    INFO = 0
+    WARNING = 1
+    ERROR = 2
+
+    def __str__(self):
+        return self.name.lower()
+
+
+@dataclass
+class Diagnostic:
+    """One analyzer finding, anchored to an op when possible."""
+
+    code: str                       # e.g. "PT-SHAPE-001"
+    severity: Severity
+    message: str
+    op_type: Optional[str] = None   # offending op's type
+    op_idx: Optional[int] = None    # its index in the block
+    source: Optional[str] = None    # "file:line" provenance
+    analyzer: Optional[str] = None  # producing pass name
+
+    def format(self) -> str:
+        loc = ""
+        if self.op_idx is not None or self.op_type:
+            loc = f" op#{self.op_idx if self.op_idx is not None else '?'}" \
+                  f" {self.op_type or ''}".rstrip()
+        src = f" @{self.source}" if self.source else ""
+        return f"{self.code} [{self.severity}]{loc}{src}: {self.message}"
+
+    __str__ = format
+
+
+def _from_op(code, severity, message, op=None, analyzer=None):
+    """Diagnostic constructor taking provenance straight off an Operation."""
+    return Diagnostic(
+        code=code, severity=Severity(severity), message=message,
+        op_type=getattr(op, "type", None),
+        op_idx=getattr(op, "idx", None),
+        source=getattr(op, "src", None),
+        analyzer=analyzer,
+    )
+
+
+class AnalysisReport:
+    """Ordered collection of findings with severity queries."""
+
+    def __init__(self, findings: Optional[Iterable[Diagnostic]] = None):
+        self.findings: List[Diagnostic] = list(findings or [])
+
+    def extend(self, more: Iterable[Diagnostic]) -> "AnalysisReport":
+        self.findings.extend(more)
+        return self
+
+    def at_least(self, severity: Severity) -> List[Diagnostic]:
+        return [d for d in self.findings if d.severity >= severity]
+
+    def errors(self) -> List[Diagnostic]:
+        return self.at_least(Severity.ERROR)
+
+    def warnings(self) -> List[Diagnostic]:
+        return [d for d in self.findings if d.severity == Severity.WARNING]
+
+    def by_code(self, code: str) -> List[Diagnostic]:
+        return [d for d in self.findings if d.code == code]
+
+    def codes(self) -> List[str]:
+        return sorted({d.code for d in self.findings})
+
+    @property
+    def ok(self) -> bool:
+        """No error-severity findings."""
+        return not self.errors()
+
+    def __len__(self):
+        return len(self.findings)
+
+    def __iter__(self):
+        return iter(self.findings)
+
+    def __bool__(self):
+        # truthiness == "has findings", so `if report:` reads naturally
+        return bool(self.findings)
+
+    def summary(self) -> str:
+        n_e, n_w = len(self.errors()), len(self.warnings())
+        head = f"{len(self.findings)} finding(s): {n_e} error, {n_w} warning"
+        return "\n".join([head] + ["  " + d.format() for d in self.findings])
+
+    __str__ = summary
+
+
+class AnalysisPass(Pass):
+    """A Pass that reports findings instead of mutating — composes with the
+    existing PassManager (its run() stat is the finding count; the program
+    version is NOT bumped, so compiled Executor plans stay valid).
+
+    Subclasses implement ``analyze(program) -> list[Diagnostic]``. ``suppress``
+    drops findings by exact code (docs/STATIC_ANALYSIS.md documents each)."""
+
+    name = "analysis"
+    mutates = False
+
+    def __init__(self, suppress: Sequence[str] = ()):
+        self.suppress = frozenset(suppress)
+        self.report: AnalysisReport = AnalysisReport()
+
+    def analyze(self, program) -> List[Diagnostic]:
+        raise NotImplementedError
+
+    def diag(self, code, severity, message, op=None) -> Diagnostic:
+        return _from_op(code, severity, message, op=op, analyzer=self.name)
+
+    def apply(self, program) -> int:
+        findings = [d for d in self.analyze(program)
+                    if d.code not in self.suppress]
+        self.report = AnalysisReport(findings)
+        # latest report per pass name lives on the program (inspectable after
+        # PassManager-driven runs; keyed so repeated diagnose() calls on a
+        # long-lived program replace instead of accumulate)
+        reports = getattr(program, "_analysis_reports", None)
+        if reports is None:
+            reports = program._analysis_reports = {}
+        reports[self.name] = self.report
+        return len(findings)

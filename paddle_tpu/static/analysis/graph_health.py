@@ -1,0 +1,155 @@
+"""Graph-health reporter (tentpole analyzer #4) — exposed as
+``Program.diagnose()``.
+
+Reports the structural smells the transform passes would act on, without
+mutating: dead ops (what DCE would remove), duplicate subgraphs (what CSE
+would merge), and unused parameters (weights the program captures — or was
+handed — but never reads).
+
+Codes: PT-GRAPH-001 (dead op, warning), PT-GRAPH-002 (duplicate subgraph,
+warning), PT-GRAPH-003 (unused parameter, error).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from ...core.static_graph import Program, Variable
+from ..passes import cse_key, live_ops
+from .diagnostics import AnalysisPass, Diagnostic, Severity
+
+__all__ = ["GraphHealthReporter"]
+
+_MAX_PER_CODE = 25  # cap repeated findings so huge graphs stay readable
+
+
+class GraphHealthReporter(AnalysisPass):
+    """``targets`` define liveness roots (defaults to the program's recorded
+    outputs / loss; with neither, terminal ops are the roots and nothing is
+    dead). ``parameters`` optionally hands in the model's full parameter list
+    so weights that never even reach the program are flagged too."""
+
+    name = "graph_health_reporter"
+
+    def __init__(self, targets: Optional[Sequence[Variable]] = None,
+                 parameters: Optional[Sequence] = None, suppress=()):
+        super().__init__(suppress)
+        self.targets = targets
+        self.parameters = parameters
+
+    def _roots(self, program: Program):
+        targets = list(self.targets or [])
+        if not targets:
+            targets = list(getattr(program, "_outputs", []) or [])
+        if program._loss is not None:
+            targets.append(program._loss)
+        return targets
+
+    def analyze(self, program: Program) -> List[Diagnostic]:
+        out: List[Diagnostic] = []
+        ops = program.global_block().ops
+        aliases = getattr(program, "_aliases", {})
+        roots = self._roots(program)
+
+        # -- dead ops (what DCE would remove) -------------------------------
+        live = set(map(id, ops))
+        if roots:
+            live = set(map(id, live_ops(ops, [id(v) for v in roots],
+                                        aliases)))
+            n_dead = 0
+            for op in ops:
+                if id(op) in live:
+                    continue
+                n_dead += 1
+                if n_dead <= _MAX_PER_CODE:
+                    out.append(self.diag(
+                        "PT-GRAPH-001", Severity.WARNING,
+                        f"op is dead — no path from its outputs "
+                        f"({', '.join(v.name for v in op.outputs[:3])}) to "
+                        f"the fetch targets; DCE would remove it", op=op))
+            if n_dead > _MAX_PER_CODE:
+                out.append(Diagnostic(
+                    "PT-GRAPH-001", Severity.WARNING,
+                    f"... and {n_dead - _MAX_PER_CODE} more dead ops",
+                    analyzer=self.name))
+
+        # -- duplicate subgraphs (what CSE would merge) ---------------------
+        seen = {}
+        n_dup = 0
+        for op in ops:
+            key = cse_key(op, aliases)
+            if key is None:
+                continue
+            prev = seen.get(key)
+            if prev is not None and len(prev.outputs) == len(op.outputs):
+                n_dup += 1
+                if n_dup <= _MAX_PER_CODE:
+                    out.append(self.diag(
+                        "PT-GRAPH-002", Severity.WARNING,
+                        f"duplicate of op#{prev.idx} '{prev.type}' — same "
+                        f"fn/inputs/kwargs; CSE would merge them", op=op))
+            else:
+                seen[key] = op
+        if n_dup > _MAX_PER_CODE:
+            out.append(Diagnostic(
+                "PT-GRAPH-002", Severity.WARNING,
+                f"... and {n_dup - _MAX_PER_CODE} more duplicate ops",
+                analyzer=self.name))
+
+        # -- unused parameters ---------------------------------------------
+        out.extend(self._unused_params(program, ops, live))
+        return out
+
+    def _unused_params(self, program, ops, live) -> List[Diagnostic]:
+        out: List[Diagnostic] = []
+        # captured parameters whose every capturing op is dead
+        cap_live = {}
+        for op in ops:
+            for t in op.captured:
+                if getattr(t, "is_parameter", False):
+                    cap_live[id(t)] = cap_live.get(id(t), False) or (
+                        id(op) in live)
+        by_id = {id(t): t for op in ops for t in op.captured}
+        for tid, is_live in cap_live.items():
+            if not is_live:
+                t = by_id[tid]
+                out.append(Diagnostic(
+                    "PT-GRAPH-003", Severity.ERROR,
+                    f"parameter '{getattr(t, 'name', '?')}' "
+                    f"{list(t._data.shape)} is captured only by dead ops — "
+                    f"it never influences the program's outputs",
+                    analyzer=self.name))
+
+        # parameter-valued feed Variables (traced imports) consumed by no op
+        consumed = {id(v) for op in ops for v in op.inputs}
+        for v in program.list_vars():
+            if getattr(v, "is_parameter", False) and id(v) not in consumed:
+                out.append(Diagnostic(
+                    "PT-GRAPH-003", Severity.ERROR,
+                    f"parameter '{v.name}' {list(v._data.shape)} is an "
+                    f"input of the program but no op consumes it",
+                    analyzer=self.name))
+
+        # externally-supplied parameter list: anything that never reached the
+        # program at all
+        if self.parameters:
+            reached = set()
+            for op in ops:
+                for t in op.captured:
+                    reached.add(id(t))
+                    reached.add(id(t._data))
+            for v in program.list_vars():
+                pt = getattr(v, "_param", None)  # traced-import param link
+                if pt is not None:
+                    reached.add(id(pt))
+                    reached.add(id(getattr(pt, "_data", pt)))
+            for p in self.parameters:
+                arr = getattr(p, "_data", p)
+                if id(arr) not in reached and id(p) not in reached:
+                    out.append(Diagnostic(
+                        "PT-GRAPH-003", Severity.ERROR,
+                        f"parameter '{getattr(p, 'name', '?')}' "
+                        f"{list(arr.shape)} does not appear in the recorded "
+                        f"program at all — the traced forward never reads it",
+                        analyzer=self.name))
+        return out

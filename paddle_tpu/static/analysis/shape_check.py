@@ -1,0 +1,150 @@
+"""Shape/dtype inference verifier (tentpole analyzer #1).
+
+Walks ``Program.ops`` forward, re-runs shape/dtype inference per op (the same
+``jax.eval_shape``-over-the-op-fn contract record_op used — one source of
+truth, cf. the reference's InferMeta/phi infermeta verifiers) and flags
+disagreements with what the graph actually records, fp64 leaks that a TPU
+backend cannot execute natively, and int→float promotion surprises.
+
+Codes: PT-SHAPE-001 (shape/rank mismatch, error), PT-SHAPE-002 (dtype
+mismatch, error), PT-SHAPE-003 (op no longer type-checks, error),
+PT-DTYPE-001 (fp64/complex128 leak, error), PT-DTYPE-002 (implicit int→float
+promotion, warning).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import numpy as np
+
+from ...core.static_graph import Program, Variable
+from ...core.tensor import Tensor
+from .diagnostics import AnalysisPass, Diagnostic, Severity
+
+__all__ = ["ShapeDtypeVerifier"]
+
+# op types where an int input legitimately produces a float output
+_PROMOTION_OK = ("cast", "astype", "convert_element_type", "div", "mean",
+                 "average", "softmax", "normalize", "linspace", "to_tensor",
+                 "exp", "log", "sqrt", "rsqrt", "sin", "cos", "erf", "pow",
+                 "sigmoid", "tanh", "random", "uniform", "normal", "dropout")
+
+
+def _is_extended(dt) -> bool:
+    """jax extended dtype (PRNG key avals) — numpy can't represent these;
+    skip numeric checks on them."""
+    try:
+        return jax.dtypes.issubdtype(dt, jax.dtypes.extended)
+    except Exception:  # pragma: no cover - defensive vs jax version drift
+        return False
+
+
+def _struct_of(a):
+    if isinstance(a, Variable):
+        return a._data
+    if isinstance(a, Tensor):
+        return jax.ShapeDtypeStruct(tuple(a._data.shape), a._data.dtype)
+    return None
+
+
+class ShapeDtypeVerifier(AnalysisPass):
+    name = "shape_dtype_verifier"
+
+    def analyze(self, program: Program) -> List[Diagnostic]:
+        out: List[Diagnostic] = []
+        for op in program.global_block().ops:
+            out.extend(self._check_op(op))
+        return out
+
+    # -- per-op checks ------------------------------------------------------
+    def _check_op(self, op) -> List[Diagnostic]:
+        found: List[Diagnostic] = []
+
+        # 1) dtype-hygiene over the RECORDED outputs (independent of
+        #    re-inference, so a tampered/stale graph is still caught)
+        for v in op.outputs:
+            dt = v._data.dtype
+            if _is_extended(dt):
+                continue
+            if np.dtype(dt) in (np.float64, np.complex128):
+                found.append(self.diag(
+                    "PT-DTYPE-001", Severity.ERROR,
+                    f"output '{v.name}' is {np.dtype(dt).name} — TPUs have no "
+                    f"native fp64; cast to float32/bfloat16 before recording",
+                    op=op))
+
+        # 2) re-run inference and compare against the recorded outputs
+        structs, has_ext = [], False
+        for a in op.args:
+            s = _struct_of(a)
+            if s is not None:
+                structs.append(s)
+                has_ext = has_ext or _is_extended(s.dtype)
+        has_ext = has_ext or any(_is_extended(v._data.dtype)
+                                 for v in op.outputs)
+        if not has_ext:
+            found.extend(self._reinfer(op, structs))
+
+        # 3) promotion surprise: every tensor input integral, output floating
+        in_dts = [s.dtype for s in structs if not _is_extended(s.dtype)]
+        if in_dts and all(np.issubdtype(np.dtype(d), np.integer)
+                          for d in in_dts):
+            for v in op.outputs:
+                dt = v._data.dtype
+                if _is_extended(dt) or not np.issubdtype(np.dtype(dt),
+                                                         np.floating):
+                    continue
+                if any(k in (op.type or "") for k in _PROMOTION_OK):
+                    continue
+                found.append(self.diag(
+                    "PT-DTYPE-002", Severity.WARNING,
+                    f"op promotes all-integer inputs to "
+                    f"{np.dtype(dt).name} output '{v.name}' — implicit "
+                    f"int→float promotion; make the cast explicit",
+                    op=op))
+        return found
+
+    def _reinfer(self, op, structs) -> List[Diagnostic]:
+        args, kwargs = op.args, op.kwargs
+
+        def pure(*sym):
+            full = list(args)
+            it = iter(sym)
+            for i, a in enumerate(full):
+                if isinstance(a, (Variable, Tensor)):
+                    full[i] = next(it)
+            return op.fn(*full, **kwargs)
+
+        try:
+            inferred = jax.eval_shape(pure, *structs)
+        except Exception as e:  # the op itself no longer type-checks
+            return [self.diag(
+                "PT-SHAPE-003", Severity.ERROR,
+                f"op no longer type-checks against its recorded inputs: "
+                f"{type(e).__name__}: {str(e).splitlines()[0][:200]}",
+                op=op)]
+        inf_list = (list(inferred) if isinstance(inferred, (tuple, list))
+                    else [inferred])
+        found: List[Diagnostic] = []
+        if len(inf_list) != len(op.outputs):
+            return [self.diag(
+                "PT-SHAPE-001", Severity.ERROR,
+                f"op records {len(op.outputs)} output(s) but inference "
+                f"produces {len(inf_list)}", op=op)]
+        for v, s in zip(op.outputs, inf_list):
+            rec = v._data
+            if tuple(rec.shape) != tuple(s.shape):
+                kind = ("rank" if len(rec.shape) != len(s.shape) else "shape")
+                found.append(self.diag(
+                    "PT-SHAPE-001", Severity.ERROR,
+                    f"{kind} mismatch on '{v.name}': recorded "
+                    f"{list(rec.shape)}, inference gives {list(s.shape)}",
+                    op=op))
+            elif rec.dtype != s.dtype:
+                found.append(self.diag(
+                    "PT-SHAPE-002", Severity.ERROR,
+                    f"dtype mismatch on '{v.name}': recorded {rec.dtype}, "
+                    f"inference gives {s.dtype}", op=op))
+        return found

@@ -1,0 +1,179 @@
+"""Trace-hazard linter (tentpole analyzer #2).
+
+Catches the defect classes that surface as silent recompiles or frozen
+randomness instead of errors:
+
+- PT-TRACE-001 (error): an Executor accumulating many compiled plans for ONE
+  program with per-step-varying feed signatures — each step pays a fresh XLA
+  compile (reference: the _ExecutorCache growing unboundedly,
+  python/paddle/base/executor.py:847).
+- PT-TRACE-002 (error): a ``to_static`` function recompiled per call because a
+  Python scalar kwarg is captured by value into the cache key — pass a tensor
+  instead (reference: jit/sot guard churn).
+- PT-TRACE-003 (error): a stochastic op (STOCHASTIC_KEYWORDS) recorded without
+  an explicit seed — results are not reproducible run-to-run.
+- PT-TRACE-004 (warning): ``.numpy()`` / ``.item()`` in the source of a traced
+  callable — a host sync that breaks (or silently graph-breaks) tracing.
+- PT-SCOPE-001 (warning): a Scope read of a never-written variable that
+  silently materialized a ()-shaped float32 zero.
+"""
+
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+from collections import defaultdict
+from typing import List, Optional
+
+from ...core.static_graph import STOCHASTIC_KEYWORDS, Program
+from .diagnostics import AnalysisPass, Diagnostic, Severity
+
+__all__ = ["TraceHazardLinter", "lint_executor", "lint_static_function",
+           "lint_scope"]
+
+# distinct compiled variants of one program/function before we call it churn
+RECOMPILE_THRESHOLD = 3
+
+
+def _is_stochastic_type(op_type) -> bool:
+    return any(k in (op_type or "") for k in STOCHASTIC_KEYWORDS)
+
+
+class TraceHazardLinter(AnalysisPass):
+    """Program-level hazards; optionally also lints live Executor /
+    StaticFunction caches handed in as context."""
+
+    name = "trace_hazard_linter"
+
+    def __init__(self, suppress=(), executors=(), static_fns=(), scopes=(),
+                 assume_seeded: Optional[bool] = None):
+        super().__init__(suppress)
+        self.executors = list(executors)
+        self.static_fns = list(static_fns)
+        self.scopes = list(scopes)
+        self.assume_seeded = assume_seeded
+
+    def _op_unseeded(self, program: Program, op) -> bool:
+        """Was this stochastic op recorded without a seed? Prefers the
+        record-time stamp (record_op) — a later unrelated paddle.seed() must
+        not launder an unreproducible recording — and falls back to current
+        process state for hand-built ops that carry no stamp."""
+        if self.assume_seeded is not None:
+            return not self.assume_seeded
+        stamp = getattr(program, "_seed_stamps", {}).get(id(op))
+        if stamp is not None:
+            # the record-time stamp wins: setting program.random_seed (or
+            # paddle.seed) AFTER recording must not launder the recording
+            return stamp
+        if getattr(program, "random_seed", 0):
+            return False
+        from ...framework import random as frandom
+
+        return not frandom.explicitly_seeded()
+
+    def analyze(self, program: Program) -> List[Diagnostic]:
+        out: List[Diagnostic] = []
+        for op in program.global_block().ops:
+            if getattr(op.fn, "_jaxpr_import", False):
+                # jaxpr-imported op: any PRNG key is a baked constant of the
+                # trace — replays are bit-identical regardless of paddle.seed
+                continue
+            if _is_stochastic_type(op.type) and self._op_unseeded(program, op):
+                out.append(self.diag(
+                    "PT-TRACE-003", Severity.ERROR,
+                    f"stochastic op recorded without an explicit seed — "
+                    f"call paddle.seed(...) (or set program.random_seed) "
+                    f"before recording '{op.type}' for reproducible replays",
+                    op=op))
+        for exe in self.executors:
+            out.extend(lint_executor(exe, analyzer=self.name))
+        for sf in self.static_fns:
+            out.extend(lint_static_function(sf, analyzer=self.name))
+        for sc in self.scopes:
+            out.extend(lint_scope(sc, analyzer=self.name))
+        return out
+
+
+def lint_executor(executor, threshold: int = RECOMPILE_THRESHOLD,
+                  analyzer: str = "trace_hazard_linter") -> List[Diagnostic]:
+    """Flag programs whose compiled-plan cache shows per-step feed churn."""
+    sigs_by_prog = defaultdict(set)
+    for key in executor.cache_signatures():
+        prog_id, _version, sig = key[0], key[1], key[2]
+        sigs_by_prog[prog_id].add(sig)
+    out: List[Diagnostic] = []
+    for prog_id, sigs in sigs_by_prog.items():
+        if len(sigs) >= threshold:
+            shapes = sorted(str([(n, list(s)) for n, s, _ in sig])
+                            for sig in sigs)[:4]
+            out.append(Diagnostic(
+                "PT-TRACE-001", Severity.ERROR,
+                f"program {prog_id} compiled {len(sigs)} variants for "
+                f"distinct feed signatures — the feed shape/dtype varies per "
+                f"step and forces an XLA recompile each time (pad or bucket "
+                f"the batch); e.g. {shapes}",
+                analyzer=analyzer))
+    return out
+
+
+def lint_static_function(sf, threshold: int = RECOMPILE_THRESHOLD,
+                         analyzer: str = "trace_hazard_linter"
+                         ) -> List[Diagnostic]:
+    """Flag to_static callables recompiled per call + host syncs in source."""
+    out: List[Diagnostic] = []
+    name = getattr(sf, "__name__", None) or getattr(
+        getattr(sf, "_orig_fn", None), "__name__", "<fn>")
+
+    keys = list(sf.cache_keys()) if hasattr(sf, "cache_keys") else []
+    # keys are (n_state, sorted static_kwargs): variants differing only in
+    # kwarg VALUES mean a Python scalar is baked into the executable
+    by_kwnames = defaultdict(set)
+    for _n_state, kw in keys:
+        by_kwnames[tuple(k for k, _ in kw)].add(kw)
+    for kwnames, variants in by_kwnames.items():
+        if len(variants) >= threshold:
+            out.append(Diagnostic(
+                "PT-TRACE-002", Severity.ERROR,
+                f"to_static '{name}' compiled {len(variants)} variants "
+                f"driven by Python-scalar kwarg(s) {list(kwnames)} captured "
+                f"by value — pass a tensor (traced) argument instead",
+                analyzer=analyzer))
+
+    # host-sync scan over the traced source
+    fn = getattr(sf, "_orig_fn", sf)
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+        base = max(inspect.getsourcelines(fn)[1], 1)
+        srcfile = inspect.getsourcefile(fn) or "<source>"
+    except (OSError, TypeError, SyntaxError):
+        return out
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("numpy", "item")):
+            out.append(Diagnostic(
+                "PT-TRACE-004", Severity.WARNING,
+                f"'.{node.func.attr}()' inside traced '{name}' is a host "
+                f"sync — it breaks tracing (or forces an eager graph break)",
+                source=f"{srcfile}:{base + node.lineno - 1}",
+                analyzer=analyzer))
+    return out
+
+
+def lint_scope(scope, analyzer: str = "trace_hazard_linter"
+               ) -> List[Diagnostic]:
+    """Warn for every scope variable read before (and never) written — the
+    lenient ``Scope.var`` materialized a ()-shaped float32 zero for it."""
+    out: List[Diagnostic] = []
+    for name, n in sorted(getattr(scope, "_lazy_reads", {}).items()):
+        if name in getattr(scope, "_written", ()):
+            continue
+        out.append(Diagnostic(
+            "PT-SCOPE-001", Severity.WARNING,
+            f"scope variable '{name}' read {n}x but never written — the "
+            f"lenient lookup materialized a ()-float32 zero; use "
+            f"scope.var(name, strict=True) to fail fast",
+            analyzer=analyzer))
+    return out

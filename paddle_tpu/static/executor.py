@@ -30,18 +30,35 @@ __all__ = ["Executor", "Scope", "global_scope"]
 
 
 class Scope:
-    """Name → Tensor map (reference: paddle/fluid/framework/scope.h)."""
+    """Name → Tensor map (reference: paddle/fluid/framework/scope.h).
+
+    ``var(name)`` keeps Paddle's lenient contract — an unknown name silently
+    materializes a ()-shaped float32 zero — but every such lazy materialization
+    is tracked so the analyzer can flag reads of never-written variables
+    (PT-SCOPE-001). ``var(name, strict=True)`` raises instead."""
 
     def __init__(self):
         self._vars: Dict[str, Tensor] = {}
+        self._written: set = set()
+        self._lazy_reads: Dict[str, int] = {}
 
-    def var(self, name):
-        return self._vars.setdefault(name, Tensor(np.zeros((), np.float32)))
+    def var(self, name, strict: bool = False):
+        # _vars is populated only by set() (-> _written) or the lazy branch
+        # below (-> _lazy_reads), so this single check covers both
+        if name not in self._written:
+            if strict:
+                raise KeyError(
+                    f"scope variable '{name}' was never written "
+                    f"(strict lookup); known: {sorted(self._written)[:10]}")
+            self._lazy_reads[name] = self._lazy_reads.get(name, 0) + 1
+            self._vars.setdefault(name, Tensor(np.zeros((), np.float32)))
+        return self._vars[name]
 
     def find_var(self, name):
         return self._vars.get(name)
 
     def set(self, name, t: Tensor):
+        self._written.add(name)
         self._vars[name] = t
 
 
@@ -66,9 +83,16 @@ class Executor:
     def close(self):
         self._cache.clear()
 
+    def cache_signatures(self):
+        """Introspection for the trace-hazard linter: one
+        ``(program_id, version, feed_sig, fetch_ids, train)`` tuple per
+        compiled plan. A program id accumulating many distinct feed signatures
+        is recompiling every step (PT-TRACE-001)."""
+        return list(self._cache.keys())
+
     # -- replay construction ------------------------------------------------
     def _build(self, program: Program, feed_vars, fetch_vars, train: bool):
-        from .passes import live_ops
+        from .passes import live_ops, resolve_alias
 
         aliases = getattr(program, "_aliases", {})
         targets = list(fetch_vars) + ([program._loss] if train else [])
@@ -95,7 +119,9 @@ class Executor:
 
         has_stochastic = any(_is_stochastic_type(op.type) for op in ops)
         feed_ids = [id(v) for v in feed_vars]
-        fetch_ids = [aliases.get(id(v), id(v)) for v in fetch_vars]
+        # chain-resolve like live_ops does, so a multi-hop alias map (stacked
+        # view passes) fetches the true canonical producer's value
+        fetch_ids = [resolve_alias(aliases, id(v)) for v in fetch_vars]
 
         def lookup(env, vid):
             if vid in env:
@@ -116,7 +142,7 @@ class Executor:
 
             def resolve(a):
                 if isinstance(a, Variable):
-                    vid = aliases.get(id(a), id(a))
+                    vid = resolve_alias(aliases, id(a))
                     if vid in env:
                         return env[vid]
                     if vid in folded:
@@ -149,7 +175,7 @@ class Executor:
 
             return jax.jit(fwd), caps, diff_params
 
-        loss_id = aliases.get(id(program._loss), id(program._loss))
+        loss_id = resolve_alias(aliases, id(program._loss))
 
         def loss_and_fetch(diff_arrs, feed_arrs, cap_arrs, seed):
             env = replay(feed_arrs, cap_arrs, diff_arrs, seed)

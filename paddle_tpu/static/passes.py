@@ -24,7 +24,7 @@ from ..core.tensor import Tensor
 
 __all__ = ["Pass", "PassManager", "DeadCodeEliminationPass",
            "ConstantFoldingPass", "CommonSubexpressionEliminationPass",
-           "apply_default_passes"]
+           "apply_default_passes", "live_ops", "resolve_alias", "cse_key"]
 
 from ..core.static_graph import STOCHASTIC_KEYWORDS
 
@@ -33,23 +33,41 @@ def _is_stochastic(op: Operation) -> bool:
     return any(k in (op.type or "") for k in STOCHASTIC_KEYWORDS)
 
 
+def resolve_alias(aliases, vid):
+    """Follow an alias chain to its canonical id. CSE flattens as it inserts,
+    but view-op chains built elsewhere (or merged alias maps) may be multi-hop
+    — a one-step lookup would drop the producing op from the live set."""
+    hops = 0
+    while vid in aliases and aliases[vid] != vid:
+        vid = aliases[vid]
+        hops += 1
+        if hops > len(aliases):  # defensive: cyclic map
+            break
+    return vid
+
+
 def live_ops(ops, target_ids, aliases=None):
     """Reverse liveness sweep: the subsequence of ``ops`` whose outputs reach
-    ``target_ids`` (ids pre-resolved through ``aliases``). Shared by the DCE
-    pass and the Executor's replay builder."""
+    ``target_ids`` (ids resolved through ``aliases``, chains included). Shared
+    by the DCE pass, the Executor's replay builder, and the graph-health
+    analyzer."""
     aliases = aliases or {}
-    needed = {aliases.get(t, t) for t in target_ids}
+    needed = {resolve_alias(aliases, t) for t in target_ids}
     keep = []
     for op in reversed(ops):
         if any(id(o) in needed for o in op.outputs):
             keep.append(op)
-            needed.update(aliases.get(id(v), id(v)) for v in op.inputs)
+            needed.update(resolve_alias(aliases, id(v)) for v in op.inputs)
     keep.reverse()
     return keep
 
 
 class Pass:
     name = "pass"
+    # transform passes mutate the program; analysis passes (static/analysis)
+    # set mutates=False — they report findings and must not invalidate the
+    # Executor's compiled-plan cache
+    mutates = True
 
     def apply(self, program: Program) -> int:
         """Mutate program; return number of changes."""
@@ -57,7 +75,9 @@ class Pass:
 
 
 class PassManager:
-    """Ordered pass pipeline (cf. pir::PassManager::Run)."""
+    """Ordered pass pipeline (cf. pir::PassManager::Run). Composes transform
+    passes (DCE/CSE/fold) with non-mutating AnalysisPass instances; the stat
+    for an analysis pass is its finding count."""
 
     def __init__(self, passes: Optional[Sequence[Pass]] = None):
         self.passes: List[Pass] = list(passes or [])
@@ -70,7 +90,8 @@ class PassManager:
         stats = {}
         for p in self.passes:
             stats[p.name] = p.apply(program)
-            program._version += 1
+            if p.mutates:
+                program._version += 1
         return stats
 
 
@@ -164,6 +185,36 @@ def _closure_fingerprint(fn):
         for c in code.co_consts) else None, cells)
 
 
+def cse_key(op: Operation, aliases: Dict[int, int]):
+    """Hashable merge key for an op, or None when the op must never merge
+    (stochastic, unfingerprintable closure, array-literal args). Shared by the
+    CSE pass and the graph-health duplicate-subgraph reporter."""
+    if _is_stochastic(op):
+        return None
+    fp = _closure_fingerprint(op.fn)
+    if fp is None:
+        return None
+    try:
+        kw = tuple(sorted((k, repr(v)) for k, v in op.kwargs.items()))
+    except Exception:
+        return None
+    in_key = []
+    for a in op.args:
+        if isinstance(a, Variable):
+            in_key.append(("v", resolve_alias(aliases, id(a))))
+        elif isinstance(a, Tensor):
+            in_key.append(("c", id(a)))
+        elif isinstance(a, (int, float, bool, str, bytes, type(None))):
+            # key the TYPE too: True == 1 == 1.0 under dict equality, but
+            # merging ops whose scalar differs only in type changes dtypes
+            in_key.append(("l", type(a).__name__, a))
+        else:
+            # repr() of arrays/objects can truncate ("...") and collide
+            # across different values — never CSE on it
+            return None
+    return (op.type, fp, tuple(in_key), kw)
+
+
 class CommonSubexpressionEliminationPass(Pass):
     """Merge duplicate recorded ops (same fn fingerprint, same inputs, same
     kwargs) — reference: common_subexpression_elimination_pass.cc. Duplicate
@@ -177,40 +228,14 @@ class CommonSubexpressionEliminationPass(Pass):
         seen: Dict[tuple, Operation] = {}
         kept, n = [], 0
         for op in blk.ops:
-            if _is_stochastic(op):
+            key = cse_key(op, aliases)
+            if key is None:
                 kept.append(op)
                 continue
-            fp = _closure_fingerprint(op.fn)
-            if fp is None:
-                kept.append(op)
-                continue
-            try:
-                kw = tuple(sorted((k, repr(v)) for k, v in op.kwargs.items()))
-            except Exception:
-                kept.append(op)
-                continue
-            in_key = []
-            for a in op.args:
-                if isinstance(a, Variable):
-                    in_key.append(("v", aliases.get(id(a), id(a))))
-                elif isinstance(a, Tensor):
-                    in_key.append(("c", id(a)))
-                elif isinstance(a, (int, float, bool, str, bytes, type(None))):
-                    in_key.append(("l", a))
-                else:
-                    # repr() of arrays/objects can truncate ("...") and collide
-                    # across different values — never CSE on it
-                    in_key = None
-                    break
-            if in_key is None:
-                kept.append(op)
-                continue
-            in_key = tuple(in_key)
-            key = (op.type, fp, in_key, kw)
             prev = seen.get(key)
             if prev is not None and len(prev.outputs) == len(op.outputs):
                 for dup, canon in zip(op.outputs, prev.outputs):
-                    aliases[id(dup)] = aliases.get(id(canon), id(canon))
+                    aliases[id(dup)] = resolve_alias(aliases, id(canon))
                 n += 1
             else:
                 seen[key] = op

@@ -51,7 +51,9 @@ def main():
 
     @jax.jit
     def reduce_all(x):
-        return jax.shard_map(
+        from paddle_tpu.framework.jax_compat import shard_map
+
+        return shard_map(
             lambda s: jax.lax.psum(s, "dp"), mesh=mesh,
             in_specs=P("dp"), out_specs=P("dp"))(x)
 

@@ -1,0 +1,635 @@
+"""Program-analysis suite tests: shape/dtype verifier, trace-hazard linter,
+SPMD consistency checker, graph-health reporter, traced-program import, and
+the satellite guarantees (pass idempotence, strict Scope lookup, alias-chain
+liveness).
+
+Each analyzer gets PAIRED tests: a seeded defect of its class is detected
+with the right diagnostic code, and the clean program produces zero
+error-severity findings (the CLI-level equivalent lives in
+tools/lint_graph.py --selftest, gated by test_ci_gates.py).
+"""
+
+import warnings
+
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+from paddle_tpu.static import Executor, program_guard
+from paddle_tpu.static.analysis import (
+    AnalysisReport,
+    GraphHealthReporter,
+    Severity,
+    ShapeDtypeVerifier,
+    SpmdConsistencyChecker,
+    TraceHazardLinter,
+    check_placements,
+    layer_to_program,
+    lint_executor,
+    lint_scope,
+    lint_static_function,
+    run_analysis,
+)
+from paddle_tpu.static.passes import apply_default_passes, live_ops
+
+
+@pytest.fixture(autouse=True)
+def _static_mode():
+    paddle.enable_static()
+    yield
+    paddle.disable_static()
+
+
+def _record_linear():
+    main = static.Program()
+    with program_guard(main):
+        x = static.data("x", [4, 8], "float32")
+        lin = paddle.nn.Linear(8, 2)
+        out = lin(x)
+    return main, out, lin
+
+
+# ---------------------------------------------------------------------------
+# shape/dtype verifier
+# ---------------------------------------------------------------------------
+
+class TestShapeDtypeVerifier:
+    def test_clean_program_no_findings(self):
+        main, out, _ = _record_linear()
+        rep = AnalysisReport(ShapeDtypeVerifier().analyze(main))
+        assert rep.ok and len(rep) == 0
+
+    def test_shape_mismatch_detected_with_provenance(self):
+        main, out, _ = _record_linear()
+        op = next(o for o in main.global_block().ops if o.outputs)
+        v = op.outputs[0]
+        v._data = jax.ShapeDtypeStruct(tuple(v._data.shape) + (1,),
+                                       v._data.dtype)
+        rep = AnalysisReport(ShapeDtypeVerifier().analyze(main))
+        hits = rep.by_code("PT-SHAPE-001")
+        assert hits and hits[0].severity == Severity.ERROR
+        assert hits[0].op_type == op.type and hits[0].op_idx == op.idx
+        assert hits[0].source and "test_analysis" in hits[0].source
+
+    def test_dtype_mismatch_detected(self):
+        main, out, _ = _record_linear()
+        op = next(o for o in main.global_block().ops if o.outputs)
+        v = op.outputs[0]
+        v._data = jax.ShapeDtypeStruct(tuple(v._data.shape), np.int32)
+        rep = AnalysisReport(ShapeDtypeVerifier().analyze(main))
+        assert rep.by_code("PT-SHAPE-002")
+
+    def test_fp64_leak_detected(self):
+        main, out, _ = _record_linear()
+        op = next(o for o in main.global_block().ops if o.outputs)
+        v = op.outputs[0]
+        v._data = jax.ShapeDtypeStruct(tuple(v._data.shape), np.float64)
+        rep = AnalysisReport(ShapeDtypeVerifier().analyze(main))
+        hits = rep.by_code("PT-DTYPE-001")
+        assert hits and hits[0].severity == Severity.ERROR
+        assert "fp64" in hits[0].message or "float64" in hits[0].message
+
+    def test_promotion_surprise_is_warning(self):
+        main = static.Program()
+        with program_guard(main):
+            i = static.data("i", [4], "int32")
+            j = static.data("j", [4], "int32")
+            # an op whose kernel silently promotes ints to float
+            from paddle_tpu.core.op_registry import apply_fn
+
+            out = apply_fn("promote_surprise",
+                           lambda a, b: (a + b) * np.float32(1.0), i, j)
+        rep = AnalysisReport(ShapeDtypeVerifier().analyze(main))
+        hits = rep.by_code("PT-DTYPE-002")
+        assert hits and hits[0].severity == Severity.WARNING
+        assert rep.ok  # warning-severity only: no errors
+
+    def test_broken_op_flagged_not_raised(self):
+        main, out, _ = _record_linear()
+        op = next(o for o in main.global_block().ops if o.inputs)
+        op.kwargs["nonsense_kwarg"] = object()
+        rep = AnalysisReport(ShapeDtypeVerifier().analyze(main))
+        assert rep.by_code("PT-SHAPE-003")
+
+
+# ---------------------------------------------------------------------------
+# trace-hazard linter
+# ---------------------------------------------------------------------------
+
+class TestTraceHazardLinter:
+    def test_unseeded_stochastic_detected(self):
+        main = static.Program()
+        with program_guard(main):
+            x = static.data("x", [8], "float32")
+            y = paddle.nn.functional.dropout(x, p=0.5, training=True)
+        rep = AnalysisReport(TraceHazardLinter(
+            assume_seeded=False).analyze(main))
+        hits = rep.by_code("PT-TRACE-003")
+        assert hits and hits[0].severity == Severity.ERROR
+        assert "dropout" in (hits[0].op_type or "")
+
+    def test_unseeded_recording_not_laundered_by_later_seed(self):
+        # seededness is stamped at RECORD time: seeding after the fact must
+        # not hide that the recording itself was unreproducible
+        from paddle_tpu.framework import random as frandom
+
+        frandom._global["seeded"] = False
+        main = static.Program()
+        with program_guard(main):
+            x = static.data("x", [8], "float32")
+            paddle.nn.functional.dropout(x, p=0.5, training=True)
+        paddle.seed(7)  # later, unrelated
+        rep = AnalysisReport(TraceHazardLinter().analyze(main))
+        assert rep.by_code("PT-TRACE-003")
+        # post-hoc program.random_seed must not launder it either (the
+        # Executor never consumes it; replays stay unreproducible)
+        main.random_seed = 1
+        rep2 = AnalysisReport(TraceHazardLinter().analyze(main))
+        assert rep2.by_code("PT-TRACE-003")
+
+    def test_set_rng_state_counts_as_seeded(self):
+        # restoring a saved key is an explicit seeding decision: no
+        # false-positive PT-TRACE-003 for resumed runs
+        from paddle_tpu.framework import random as frandom
+
+        frandom._global["seeded"] = False
+        frandom.set_rng_state(jax.random.key(5))
+        assert frandom.explicitly_seeded()
+
+    def test_seeded_stochastic_clean(self):
+        main = static.Program()
+        with program_guard(main):
+            x = static.data("x", [8], "float32")
+            paddle.nn.functional.dropout(x, p=0.5, training=True)
+        # conftest autouse fixture calls paddle.seed → explicitly seeded
+        rep = AnalysisReport(TraceHazardLinter().analyze(main))
+        assert not rep.by_code("PT-TRACE-003")
+
+    def test_feed_signature_churn_detected(self):
+        main = static.Program()
+        with program_guard(main):
+            x = static.data("x", [None, 4], "float32")
+            y = x * 2.0
+        exe = Executor()
+        for b in (1, 2, 3):
+            exe.run(main, feed={"x": np.ones((b, 4), np.float32)},
+                    fetch_list=[y])
+        hits = [d for d in lint_executor(exe) if d.code == "PT-TRACE-001"]
+        assert hits and hits[0].severity == Severity.ERROR
+
+    def test_stable_feed_signature_clean(self):
+        main = static.Program()
+        with program_guard(main):
+            x = static.data("x", [None, 4], "float32")
+            y = x * 2.0
+        exe = Executor()
+        for _ in range(4):
+            exe.run(main, feed={"x": np.ones((2, 4), np.float32)},
+                    fetch_list=[y])
+        assert not lint_executor(exe)
+
+    def test_scalar_kwarg_capture_detected(self):
+        paddle.disable_static()
+        try:
+            @paddle.jit.to_static(full_graph=True)
+            def f(x, scale=1.0):
+                return x * 2.0
+
+            xv = paddle.to_tensor(np.ones(3, np.float32))
+            for s in (0.1, 0.2, 0.3):  # python scalar varies per call
+                f(xv, scale=s)
+            hits = [d for d in lint_static_function(f)
+                    if d.code == "PT-TRACE-002"]
+            assert hits and "scale" in hits[0].message
+        finally:
+            paddle.enable_static()
+
+    def test_stable_kwargs_clean_and_host_sync_warns(self):
+        paddle.disable_static()
+        try:
+            @paddle.jit.to_static(full_graph=True)
+            def g(x):
+                y = x * 2.0
+                _ = float(np.float32(1.0))  # benign host math, not a sync
+                return y
+
+            @paddle.jit.to_static(full_graph=False)
+            def h(x):
+                return float(x.sum().numpy()) + 0 * x  # host sync in source
+
+            xv = paddle.to_tensor(np.ones(3, np.float32))
+            g(xv)
+            assert not lint_static_function(g)
+            hits = [d for d in lint_static_function(h)
+                    if d.code == "PT-TRACE-004"]
+            assert hits and hits[0].severity == Severity.WARNING
+            assert hits[0].source  # names the file:line
+        finally:
+            paddle.enable_static()
+
+
+# ---------------------------------------------------------------------------
+# SPMD consistency checker
+# ---------------------------------------------------------------------------
+
+class TestSpmdChecker:
+    def _mesh(self, shape, names):
+        from paddle_tpu.distributed.auto_parallel import ProcessMesh
+
+        return ProcessMesh(shape=shape, dim_names=names)
+
+    def test_valid_placement_clean(self):
+        from paddle_tpu.distributed.auto_parallel import Replicate, Shard
+
+        mesh = self._mesh([2, 4], ["dp", "mp"])
+        assert check_placements((8, 6), mesh, [Shard(0), Replicate()]) == []
+
+    def test_invalid_shard_dim_detected(self):
+        from paddle_tpu.distributed.auto_parallel import Replicate, Shard
+
+        mesh = self._mesh([2, 4], ["dp", "mp"])
+        out = check_placements((8, 6), mesh, [Shard(5), Replicate()])
+        assert out and out[0].code == "PT-SPMD-001"
+        assert "wrap" in out[0].message  # names the silent-wrap hazard
+
+    def test_placement_count_rules(self):
+        from paddle_tpu.distributed.auto_parallel import Replicate, Shard
+
+        mesh = self._mesh([2, 4], ["dp", "mp"])
+        # FEWER placements than mesh axes is valid: the rest replicate
+        # (matches placements_to_spec's zip semantics)
+        assert check_placements((8, 8), mesh, [Shard(0)]) == []
+        # MORE placements are silently dropped at lowering — flagged
+        out = check_placements((8, 8), mesh,
+                               [Shard(0), Replicate(), Shard(1)])
+        assert out and out[0].code == "PT-SPMD-001"
+        assert "dropped" in out[0].message
+
+    def test_uneven_shard_detected(self):
+        from paddle_tpu.distributed.auto_parallel import Replicate, Shard
+
+        mesh = self._mesh([2, 4], ["dp", "mp"])
+        out = check_placements((8, 6), mesh, [Replicate(), Shard(1)])
+        assert out and out[0].code == "PT-SPMD-002"  # 6 % 4 != 0
+
+    def test_dynamic_dim_skipped(self):
+        from paddle_tpu.distributed.auto_parallel import Replicate, Shard
+
+        mesh = self._mesh([2, 4], ["dp", "mp"])
+        assert check_placements((-1, 8), mesh, [Shard(0), Replicate()]) == []
+
+    def test_shard_tensor_warns_before_lowering(self):
+        from paddle_tpu.distributed.auto_parallel import (Replicate, Shard,
+                                                          shard_tensor)
+
+        paddle.disable_static()
+        try:
+            mesh = self._mesh([8], ["mp"])
+            # uneven shard: the named diagnostic precedes jax's opaque error
+            with pytest.warns(UserWarning, match="PT-SPMD-002"):
+                with pytest.raises(ValueError, match="divisible"):
+                    shard_tensor(paddle.to_tensor(
+                        np.zeros((6, 4), np.float32)), mesh, [Shard(0)])
+            # out-of-range dim: placements_to_spec silently WRAPS it, so the
+            # warning is the only signal at all
+            with pytest.warns(UserWarning, match="PT-SPMD-001"):
+                shard_tensor(paddle.to_tensor(
+                    np.zeros((16, 4), np.float32)), mesh, [Shard(6)])
+        finally:
+            paddle.enable_static()
+
+    def test_conflicting_shardings_on_one_op(self):
+        from paddle_tpu.distributed.auto_parallel import (ProcessMesh,
+                                                          Replicate, Shard)
+
+        main = static.Program()
+        with program_guard(main):
+            a = static.data("a", [8, 4], "float32")
+            b = static.data("b", [8, 4], "float32")
+            c = a + b
+        mesh = ProcessMesh(shape=[2], dim_names=["dp"])
+        a.process_mesh = mesh
+        a.placements = [Shard(0)]
+        b.process_mesh = mesh
+        b.placements = [Replicate()]
+        rep = AnalysisReport(SpmdConsistencyChecker().analyze(main))
+        hits = rep.by_code("PT-SPMD-003")
+        assert hits and "conflicting" in hits[0].message
+
+    def test_aligned_shardings_clean(self):
+        from paddle_tpu.distributed.auto_parallel import ProcessMesh, Shard
+
+        main = static.Program()
+        with program_guard(main):
+            a = static.data("a", [8, 4], "float32")
+            b = static.data("b", [8, 4], "float32")
+            c = a + b
+        mesh = ProcessMesh(shape=[2], dim_names=["dp"])
+        for t in (a, b):
+            t.process_mesh = mesh
+            t.placements = [Shard(0)]
+        assert not SpmdConsistencyChecker().analyze(main)
+
+
+# ---------------------------------------------------------------------------
+# graph health / Program.diagnose
+# ---------------------------------------------------------------------------
+
+class TestGraphHealth:
+    def test_dead_op_and_duplicate_reported(self):
+        main = static.Program()
+        with program_guard(main):
+            x = static.data("x", [4], "float32")
+            a = paddle.exp(x)
+            b = paddle.exp(x)      # duplicate (CSE candidate)
+            used = a + 1.0
+            _dead = x * 5.0        # dead relative to targets
+        rep = main.diagnose(targets=[used])
+        assert rep.by_code("PT-GRAPH-001")  # dead op
+        assert rep.by_code("PT-GRAPH-002")  # duplicate subgraph
+
+    def test_unused_parameter_detected(self):
+        main, out, lin = _record_linear()
+        ghost = paddle.Tensor(np.zeros((3, 3), np.float32))
+        ghost.is_parameter = True
+        ghost.name = "ghost"
+        rep = run_analysis(main, targets=[out],
+                           parameters=list(lin.parameters()) + [ghost])
+        hits = rep.by_code("PT-GRAPH-003")
+        assert hits and hits[0].severity == Severity.ERROR
+        assert "ghost" in hits[0].message
+
+    def test_used_parameters_clean(self):
+        main, out, lin = _record_linear()
+        rep = run_analysis(main, targets=[out],
+                           parameters=list(lin.parameters()))
+        assert not rep.by_code("PT-GRAPH-003")
+
+    def test_diagnose_clean_program_ok(self):
+        main, out, _ = _record_linear()
+        rep = main.diagnose(targets=[out])
+        assert rep.ok
+
+    def test_analysis_does_not_mutate_or_invalidate_cache(self):
+        main, out, _ = _record_linear()
+        n_ops, version = main.num_ops, main._version
+        exe = Executor()
+        exe.run(main, feed={"x": np.ones((4, 8), np.float32)},
+                fetch_list=[out])
+        main.diagnose(targets=[out])
+        assert main.num_ops == n_ops and main._version == version
+        exe.run(main, feed={"x": np.ones((4, 8), np.float32)},
+                fetch_list=[out])
+        assert len(exe._cache) == 1  # compiled plan survived the analysis
+
+
+# ---------------------------------------------------------------------------
+# traced-program import (model families)
+# ---------------------------------------------------------------------------
+
+class TestTraceImport:
+    def test_layer_imports_and_lints_clean(self):
+        paddle.disable_static()
+        try:
+            lin = paddle.nn.Linear(4, 2)
+            prog = layer_to_program(
+                lin, jax.ShapeDtypeStruct((3, 4), np.float32),
+                input_names=["x"])
+        finally:
+            paddle.enable_static()
+        assert prog.num_ops >= 2
+        params = [v for v in prog.list_vars()
+                  if getattr(v, "is_parameter", False)]
+        assert len(params) == 2  # weight + bias, named
+        assert any("weight" in v.name for v in params)
+        rep = run_analysis(prog, targets=prog._outputs,
+                           parameters=list(lin.parameters()))
+        assert rep.ok, rep.summary()
+
+    def test_imported_program_replays_in_executor(self):
+        paddle.disable_static()
+        try:
+            lin = paddle.nn.Linear(4, 2)
+            prog = layer_to_program(
+                lin, jax.ShapeDtypeStruct((3, 4), np.float32),
+                input_names=["x"])
+        finally:
+            paddle.enable_static()
+        exe = Executor()
+        xv = np.random.rand(3, 4).astype(np.float32)
+        feed = {"x": xv}
+        for v in prog.list_vars():
+            if getattr(v, "is_parameter", False):
+                feed[v.name] = v._param.numpy()
+        (got,) = exe.run(prog, feed=feed, fetch_list=[prog._outputs[0]])
+        ref = xv @ lin.weight.numpy() + lin.bias.numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+    def test_imported_random_ops_not_flagged_unseeded(self):
+        # a traced jax.random draw bakes its key into the jaxpr: replays are
+        # bit-identical, so PT-TRACE-003 must not fire even when the process
+        # never called paddle.seed
+        from paddle_tpu.static.analysis import trace_to_program
+
+        prog = trace_to_program(
+            lambda x: jax.random.uniform(jax.random.key(0), (4,)) + x,
+            jax.ShapeDtypeStruct((4,), np.float32))
+        assert any("rand" in op.type for op in prog.global_block().ops)
+        rep = AnalysisReport(TraceHazardLinter(
+            assume_seeded=False).analyze(prog))
+        assert not rep.by_code("PT-TRACE-003")
+
+    def test_import_carries_source_provenance(self):
+        paddle.disable_static()
+        try:
+            lin = paddle.nn.Linear(4, 2)
+            prog = layer_to_program(
+                lin, jax.ShapeDtypeStruct((3, 4), np.float32))
+        finally:
+            paddle.enable_static()
+        assert any(op.src for op in prog.global_block().ops)
+
+
+# ---------------------------------------------------------------------------
+# satellites: Scope strict lookup, pass idempotence, alias-chain liveness
+# ---------------------------------------------------------------------------
+
+class TestScopeStrict:
+    def test_strict_raises_on_unknown(self):
+        from paddle_tpu.static import Scope
+
+        sc = Scope()
+        with pytest.raises(KeyError, match="never written"):
+            sc.var("missing", strict=True)
+
+    def test_lenient_read_is_tracked_and_linted(self):
+        from paddle_tpu.static import Scope
+
+        sc = Scope()
+        t = sc.var("phantom")  # silently materialized ()-float32 zero
+        sc.var("phantom")      # second read of a still-never-written name
+        assert t.shape == []
+        assert sc._lazy_reads["phantom"] == 2
+        hits = [d for d in lint_scope(sc) if d.code == "PT-SCOPE-001"]
+        assert hits and hits[0].severity == Severity.WARNING
+        assert "phantom" in hits[0].message and "2x" in hits[0].message
+        # strict lookup still fails on the materialized-but-never-written name
+        with pytest.raises(KeyError, match="never written"):
+            sc.var("phantom", strict=True)
+        # a later write cures it
+        sc.set("phantom", paddle.Tensor(np.ones((), np.float32)))
+        sc.var("phantom", strict=True)
+        assert not lint_scope(sc)
+
+    def test_written_then_read_clean(self):
+        from paddle_tpu.static import Scope
+
+        sc = Scope()
+        sc.set("x", paddle.Tensor(np.ones(2, np.float32)))
+        sc.var("x")
+        sc.var("x", strict=True)  # strict lookup of a written var is fine
+        assert not lint_scope(sc)
+
+    def test_executor_fetch_writes_scope(self):
+        main = static.Program()
+        with program_guard(main):
+            x = static.data("x", [2], "float32")
+            y = x + 1.0
+        exe = Executor()
+        sc = static.Scope()
+        exe.run(main, feed={"x": np.zeros(2, np.float32)}, fetch_list=[y],
+                scope=sc)
+        assert not lint_scope(sc)  # fetched var was WRITTEN, not lazy-read
+
+
+class TestPassIdempotence:
+    def test_default_passes_reach_fixpoint_in_one_run(self):
+        main = static.Program()
+        with program_guard(main):
+            x = static.data("x", [4], "float32")
+            c = paddle.ones([4]) * 3.0 + 1.0   # foldable
+            a = paddle.exp(x)
+            b = paddle.exp(x)                  # CSE duplicate
+            used = a + b + c
+            _dead = x * 7.0                    # DCE target
+        stats1 = apply_default_passes(main, targets=[used])
+        assert sum(stats1.values()) > 0
+        stats2 = apply_default_passes(main, targets=[used])
+        assert sum(stats2.values()) == 0, (
+            f"second pass run must be a no-op, got {stats2}")
+        # and the program still computes the right thing
+        exe = Executor()
+        (o,) = exe.run(main, feed={"x": np.zeros(4, np.float32)},
+                       fetch_list=[used])
+        np.testing.assert_allclose(o, 2 * np.exp(0.0) + 4.0)
+
+
+class TestLiveOpsAliasChain:
+    def test_chain_of_aliased_views_keeps_producer_alive(self):
+        main = static.Program()
+        with program_guard(main):
+            x = static.data("x", [4], "float32")
+            base = paddle.exp(x)       # producer
+            v1 = paddle.reshape(base, [4])
+            v2 = paddle.reshape(v1, [2, 2])
+        ops = main.global_block().ops
+        base_op = next(o for o in ops if o.type == "exp")
+        # simulate a view-op alias CHAIN: v2 -> v1 -> base (multi-hop)
+        aliases = {id(v2): id(v1), id(v1): id(base)}
+        kept = live_ops(ops, [id(v2)], aliases)
+        assert base_op in kept, "alias chain dropped the producing op"
+
+    def test_resolve_alias_follows_chain_and_tolerates_cycles(self):
+        from paddle_tpu.static.passes import resolve_alias
+
+        assert resolve_alias({1: 2, 2: 3}, 1) == 3
+        assert resolve_alias({}, 7) == 7
+        assert resolve_alias({1: 2, 2: 1}, 1) in (1, 2)  # no infinite loop
+
+    def test_executor_fetch_through_alias_chain(self):
+        main = static.Program()
+        with program_guard(main):
+            x = static.data("x", [4], "float32")
+            y = paddle.exp(x)
+            z = paddle.exp(x)
+        from paddle_tpu.static.passes import (
+            CommonSubexpressionEliminationPass)
+
+        CommonSubexpressionEliminationPass().apply(main)
+        exe = Executor()
+        xv = np.random.rand(4).astype(np.float32)
+        (o,) = exe.run(main, feed={"x": xv}, fetch_list=[z])
+        np.testing.assert_allclose(o, np.exp(xv), rtol=1e-6)
+
+    def test_executor_fetch_through_multi_hop_alias_chain(self):
+        # liveness (live_ops) and replay (fetch/resolve) must agree on the
+        # canonical id when the alias map is MULTI-hop (stacked view passes)
+        main = static.Program()
+        with program_guard(main):
+            x = static.data("x", [4], "float32")
+            base = paddle.exp(x)
+            v1 = paddle.reshape(base, [4])
+            v2 = paddle.reshape(v1, [4])
+        blk = main.global_block()
+        # drop the view ops and alias their outputs back to the producer,
+        # exactly what a view-collapsing pass would record
+        blk.ops = [op for op in blk.ops
+                   if not any(o is v1 or o is v2 for o in op.outputs)]
+        main._aliases = {id(v2): id(v1), id(v1): id(base)}
+        exe = Executor()
+        xv = np.random.rand(4).astype(np.float32)
+        (o,) = exe.run(main, feed={"x": xv}, fetch_list=[v2])
+        np.testing.assert_allclose(o, np.exp(xv), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# run_analysis composition through PassManager
+# ---------------------------------------------------------------------------
+
+def test_analysis_passes_compose_with_pass_manager():
+    from paddle_tpu.static import PassManager
+    from paddle_tpu.static.passes import ConstantFoldingPass
+
+    main = static.Program()
+    with program_guard(main):
+        x = static.data("x", [2], "float32")
+        c = paddle.ones([2]) * 3.0
+        y = x + c
+    pm = PassManager([ConstantFoldingPass(), ShapeDtypeVerifier(),
+                      GraphHealthReporter(targets=[y])])
+    stats = pm.run(main)
+    assert stats["constant_folding"] >= 1
+    assert stats["shape_dtype_verifier"] == 0  # clean after folding
+    assert stats["graph_health_reporter"] == 0
+    # latest analysis report per pass name lives on the program
+    assert set(main._analysis_reports) == {
+        "shape_dtype_verifier", "graph_health_reporter"}
+    # repeated runs replace, not accumulate
+    pm.run(main)
+    assert len(main._analysis_reports) == 2
+
+
+def test_cse_key_distinguishes_literal_types():
+    # True == 1 == 1.0 under dict equality; merging on it would change dtypes
+    from paddle_tpu.core.static_graph import Operation
+    from paddle_tpu.static.passes import cse_key
+
+    def fn(a):
+        return a
+
+    k_float = cse_key(Operation(0, "add", fn, [1.0], {}), {})
+    k_bool = cse_key(Operation(1, "add", fn, [True], {}), {})
+    k_int = cse_key(Operation(2, "add", fn, [1], {}), {})
+    assert len({k_float, k_bool, k_int}) == 3
+
+
+def test_suppress_drops_findings_by_code():
+    main = static.Program()
+    with program_guard(main):
+        x = static.data("x", [4], "float32")
+        used = paddle.exp(x)
+        _dead = x * 5.0
+    rep = run_analysis(main, targets=[used], suppress=("PT-GRAPH-001",))
+    assert not rep.by_code("PT-GRAPH-001")

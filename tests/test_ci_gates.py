@@ -65,13 +65,93 @@ def test_bench_regression_gate_logic(tmp_path):
     assert run(0.90) == 1          # >5% drop fails
 
 
-def test_pip_installable_metadata():
-    import tomllib
+def test_bench_regression_gate_missing_metric_key(tmp_path):
+    """A BENCH_*.json missing a metric key must exit non-zero with a readable
+    message, not raise KeyError/TypeError."""
+    gate = os.path.join(ROOT, "tools", "check_bench_regression.py")
+    g2 = tmp_path / "tools" / "check_bench_regression.py"
+    g2.parent.mkdir(exist_ok=True)
+    g2.write_text(open(gate).read())
+    good = {"metric": "llama_pretrain_tokens_per_sec_per_chip",
+            "value": 100.0, "unit": "tok/s", "vs_baseline": 1.0}
+    fresh = tmp_path / "fresh.txt"
+    fresh.write_text(json.dumps(good) + "\n")
 
-    with open(os.path.join(ROOT, "pyproject.toml"), "rb") as f:
-        meta = tomllib.load(f)
-    assert meta["project"]["name"] == "paddle-tpu"
-    assert "jax" in meta["project"]["dependencies"]
+    def run(baseline_obj):
+        (tmp_path / "BENCH_r01.json").write_text(json.dumps(baseline_obj))
+        return subprocess.run([sys.executable, str(g2), str(fresh)],
+                              capture_output=True, text=True)
+
+    # baseline missing vs_baseline: readable FAIL naming the key, not a crash
+    bad = {k: v for k, v in good.items() if k != "vs_baseline"}
+    r = run(bad)
+    assert r.returncode != 0
+    assert "vs_baseline" in r.stdout and "Traceback" not in r.stderr
+    # non-object baseline: also a readable failure
+    (tmp_path / "BENCH_r01.json").write_text("[1, 2, 3]")
+    r2 = subprocess.run([sys.executable, str(g2), str(fresh)],
+                        capture_output=True, text=True)
+    assert r2.returncode != 0 and "Traceback" not in r2.stderr
+    # explicit null unit: no TypeError crash (config falls back to blank)
+    r3 = run({**good, "unit": None})
+    assert "Traceback" not in r3.stderr, r3.stderr
+    # intact baseline still passes
+    assert run(good).returncode == 0
+
+
+def test_graph_lint_gate_model_zoo_clean():
+    """Analyzer-cleanliness ratchet (docs/STATIC_ANALYSIS.md): every in-repo
+    model-family program must lint clean at error severity, and the family
+    count can only go up (>= 5: bert/gpt/llama/vit/unet)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "lint_graph.py")],
+        capture_output=True, text=True, env=env, cwd=ROOT, timeout=500)
+    assert r.returncode == 0, r.stdout + r.stderr
+    import re
+
+    m = re.search(r"LINTED (\d+) program", r.stdout)
+    assert m and int(m.group(1)) >= 5, r.stdout
+
+
+def test_graph_lint_gate_detects_seeded_defects():
+    """Every seeded-defect class must flip the lint gate to a non-zero exit
+    with its expected diagnostic code (lint_graph --selftest pins the
+    class->code map in-process; one end-to-end --inject run pins the exit
+    code path itself)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "lint_graph.py"),
+         "--selftest", "--family", "bert"],
+        capture_output=True, text=True, env=env, cwd=ROOT, timeout=500)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "SELFTEST OK: 7 defect classes detected" in r.stdout
+    r2 = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "lint_graph.py"),
+         "--inject", "shape_mismatch", "--family", "bert"],
+        capture_output=True, text=True, env=env, cwd=ROOT, timeout=500)
+    assert r2.returncode != 0
+    assert "PT-SHAPE-001" in r2.stdout  # names op + code in the output
+
+
+def test_pip_installable_metadata():
+    try:
+        import tomllib  # py311+
+    except ModuleNotFoundError:
+        tomllib = None
+    path = os.path.join(ROOT, "pyproject.toml")
+    if tomllib is not None:
+        with open(path, "rb") as f:
+            meta = tomllib.load(f)
+        assert meta["project"]["name"] == "paddle-tpu"
+        assert "jax" in meta["project"]["dependencies"]
+    else:  # py3.10: textual check, no toml parser in the container
+        import re
+
+        text = open(path).read()
+        assert 'name = "paddle-tpu"' in text
+        deps = re.search(r"dependencies = \[(.*?)\]", text, re.S)
+        assert deps and '"jax"' in deps.group(1)
 
 
 def test_eager_dispatch_overhead_bounded():

@@ -11,6 +11,10 @@ import pytest
 
 from paddle_tpu.ops.flash_attention import _xla_reference, flash_attention
 
+# Heavyweight numeric suite: minutes of CPU compute. Excluded from the
+# tier-1 fast gate (-m "not slow"); run explicitly or in the nightly pass.
+pytestmark = pytest.mark.slow
+
 
 def _rand(shape, seed):
     return jax.random.normal(jax.random.PRNGKey(seed), shape, jnp.float32)

@@ -22,6 +22,10 @@ from paddle_tpu.ops.paged_attention import (
     paged_decode_reference,
 )
 
+# Heavyweight numeric suite: minutes of CPU compute. Excluded from the
+# tier-1 fast gate (-m "not slow"); run explicitly or in the nightly pass.
+pytestmark = pytest.mark.slow
+
 
 def _rand(shape, seed, dtype=jnp.float32):
     return jax.random.normal(jax.random.PRNGKey(seed), shape, dtype)

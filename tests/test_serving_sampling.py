@@ -15,6 +15,10 @@ from paddle_tpu.inference.serving import (ContinuousBatchingEngine, Request,
                                           sample_rows, _fold_keys)
 from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
 
+# Heavyweight numeric suite: minutes of CPU compute. Excluded from the
+# tier-1 fast gate (-m "not slow"); run explicitly or in the nightly pass.
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def model():

@@ -39,10 +39,31 @@ def parse_lines(path):
 def last_recorded(root):
     files = sorted(glob.glob(os.path.join(root, "BENCH_r*.json")))
     if not files:
-        return None
-    d = json.load(open(files[-1]))
+        return None, None
+    path = files[-1]
+    try:
+        d = json.load(open(path))
+    except json.JSONDecodeError as e:
+        print(f"FAIL: baseline {os.path.basename(path)} is not valid JSON "
+              f"({e})")
+        sys.exit(1)
+    if not isinstance(d, dict):
+        print(f"FAIL: baseline {os.path.basename(path)} is not a JSON "
+              f"object (got {type(d).__name__})")
+        sys.exit(1)
     # driver records either the raw line or a {"parsed": {...}} wrapper
-    return d.get("parsed", d)
+    return d.get("parsed", d), path
+
+
+def require(d, key, where):
+    """Readable gate failure instead of a KeyError/TypeError deep in the
+    comparison when a recorded BENCH file is missing (or nulls out) a metric
+    key — missing and null are rejected identically on both sides."""
+    if not isinstance(d, dict) or d.get(key) is None:
+        print(f"FAIL: {where} is missing metric key '{key}' — "
+              f"re-record the benchmark (bench.py emits it)")
+        sys.exit(1)
+    return d[key]
 
 
 def main():
@@ -54,7 +75,8 @@ def main():
         if a == "--tolerance":
             tol = float(sys.argv[i + 1])
     now = parse_lines(sys.argv[1])
-    base = last_recorded(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    base, base_path = last_recorded(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     if base is None:
         print("no recorded BENCH_r*.json baseline — gate passes vacuously")
         return 0
@@ -62,15 +84,14 @@ def main():
     if cur is None:
         print(f"FAIL: fresh output has no '{PRIMARY}' line")
         return 1
-    prev_vs, cur_vs = base.get("vs_baseline"), cur.get("vs_baseline")
-    if prev_vs is None:
-        print("baseline has no vs_baseline — gate passes vacuously")
-        return 0
+    where = os.path.basename(base_path)
+    prev_vs = require(base, "vs_baseline", f"baseline {where}")
+    cur_vs = require(cur, "vs_baseline", "fresh output")
     # the measured CONFIG lives in the unit string ("tokens/s (<config>, ...")
     # — comparing across a config change (e.g. the round-2 switch to the
     # honest seq-4096 GQA shape) is not a regression signal
     def config_of(d):
-        u = d.get("unit", "")
+        u = d.get("unit") or ""  # explicit null unit reads as no config
         return u.split("(", 1)[-1].split(",", 1)[0] if "(" in u else u
 
     if config_of(base) != config_of(cur):
