@@ -260,6 +260,24 @@ def bench_serving(dev, on_tpu):
           f"dense generate batch-{slots} decode-to-max: "
           f"{dense_tps:.0f} useful tok/s)", eng_tps / dense_tps)
 
+    # p99 per-step latency WITH request deadlines enabled (deadlines far
+    # beyond the wave length, so the scan runs but never evicts): pins the
+    # resilience hooks — deadline/eviction bookkeeping, queue accounting —
+    # as overhead-neutral on the serving hot path. Compared against the
+    # recorded baseline by tools/check_bench_regression.py (SECONDARY).
+    for p, k in zip(prompts, new_toks):
+        eng.add_request(Request(p, max_new_tokens=k, deadline_s=3600.0))
+    step_s = []
+    while eng.has_work():
+        t0 = _t.perf_counter()
+        eng.step()
+        step_s.append(_t.perf_counter() - t0)
+    eng.finished()
+    p99 = float(np.quantile(np.asarray(step_s), 0.99)) * 1e3
+    _emit("serving_p99_step_latency_ms", p99,
+          f"ms (p99 engine step, deadlines enabled, {len(step_s)} steps, "
+          f"{slots} slots)", None)
+
 
 def bench_unet(dev, on_tpu):
     """Stable-Diffusion-class UNet train step (BASELINE config #5: conv +

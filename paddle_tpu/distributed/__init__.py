@@ -5,8 +5,13 @@ replace ProcessGroupNCCL/TCPStore; GSPMD + NamedSharding replace DistTensor's SP
 rules and reshard functions; fleet engines become shard_map programs.
 """
 
-from . import checkpoint, fleet, ps, rpc, sharding, utils  # noqa: F401
-from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
+from . import checkpoint, fleet, ps, resilience, rpc, sharding, utils  # noqa: F401
+from .checkpoint import (  # noqa: F401
+    CheckpointCorruptionError,
+    load_state_dict,
+    save_state_dict,
+    wait_async_save,
+)
 from .auto_parallel import (  # noqa: F401
     Partial,
     Placement,

@@ -512,5 +512,50 @@ class Engine:
             out["v"] = {n: jnp.copy(a) for n, a in zip(self._param_names, self.v)}
         return out
 
+    def set_state_dict(self, state_dict):
+        """Resume-in-place from a ``state_dict()`` snapshot (params,
+        optimizer accumulators, step count) — the counterpart
+        ``ResilientTrainer`` calls after a checkpoint ``load_state_dict``
+        reshards the snapshot onto THIS engine's mesh. Arrays are
+        device_put to the engine's shardings, so a snapshot from a
+        different mesh resumes bit-for-bit on the new one."""
+        if self._pp:
+            raise NotImplementedError(
+                "set_state_dict with pipeline-stacked params is not "
+                "supported yet — rebuild the Engine and load via "
+                "model.set_state_dict")
+        self.model.set_state_dict(state_dict["model"])
+        rep = (NamedSharding(self.mesh, P()) if self.mesh is not None else None)
+
+        def put(a, sh):
+            arr = a._data if isinstance(a, Tensor) else jnp.asarray(a)
+            return jax.device_put(arr, sh) if sh is not None else jnp.asarray(arr)
+
+        shardings = self._shardings or [None] * len(self._param_tensors)
+        for t, sh in zip(self._param_tensors, shardings):
+            t._data = put(t, sh)
+        self.params = [t._data for t in self._param_tensors]
+        if self._optimizer is not None:
+            opt = state_dict["opt"]
+            name2idx = {n: i for i, n in enumerate(self._param_names)}
+            self.opt_state = {
+                acc: {name2idx[n]: put(a, self._opt_state_shardings[acc]
+                                       [name2idx[n]] if self.mesh is not None
+                                       else None)
+                      for n, a in d.items()}
+                for acc, d in opt.items()}
+        else:
+            ms, vs = state_dict["m"], state_dict["v"]
+            missing = [n for n in self._param_names if n not in ms or n not in vs]
+            if missing:
+                raise KeyError(f"optimizer state missing for params {missing}")
+            self.m = [put(ms[n], sh) for n, sh in zip(self._param_names, shardings)]
+            self.v = [put(vs[n], sh) for n, sh in zip(self._param_names, shardings)]
+        step = state_dict["step"]
+        step = step._data if isinstance(step, Tensor) else jnp.asarray(step)
+        self.step_count = (jax.device_put(step.astype(jnp.int32), rep)
+                           if rep is not None else step.astype(jnp.int32))
+        return self
+
 
 ShardedTrainer = Engine

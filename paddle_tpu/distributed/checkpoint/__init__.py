@@ -1,6 +1,10 @@
 """paddle.distributed.checkpoint parity — sharded save/load with
-reshard-on-load (reference: python/paddle/distributed/checkpoint/)."""
+reshard-on-load (reference: python/paddle/distributed/checkpoint/), plus
+integrity: atomic shard writes, per-shard checksums verified at load
+(CheckpointCorruptionError names the bad shard), replica recovery, and
+async saves flushed by wait_async_save (docs/RESILIENCE.md)."""
 
+from .integrity import CheckpointCorruptionError  # noqa: F401
 from .load_state_dict import get_state_dict_shapes, load_state_dict  # noqa: F401
 from .metadata import ChunkRecord, Metadata, TensorMetadata  # noqa: F401
-from .save_state_dict import save_state_dict  # noqa: F401
+from .save_state_dict import save_state_dict, wait_async_save  # noqa: F401

@@ -35,6 +35,10 @@ class TensorMetadata:
 class Metadata:
     tensors: Dict[str, TensorMetadata]
     flat_mapping: Optional[Dict[str, str]] = None  # user key -> storage key
+    # integrity records per data file: {fname: {"size", "crc32", "sha256"}} —
+    # written by save_state_dict, verified by load_state_dict (PT-CKPT codes,
+    # docs/RESILIENCE.md). Optional so pre-integrity checkpoints still load.
+    files: Optional[Dict[str, Dict]] = None
 
     def to_json(self) -> str:
         return json.dumps(
@@ -48,6 +52,7 @@ class Metadata:
                     for name, tm in self.tensors.items()
                 },
                 "flat_mapping": self.flat_mapping,
+                "files": self.files,
             },
             indent=1,
         )
@@ -63,7 +68,8 @@ class Metadata:
             )
             for name, t in obj["tensors"].items()
         }
-        return cls(tensors=tensors, flat_mapping=obj.get("flat_mapping"))
+        return cls(tensors=tensors, flat_mapping=obj.get("flat_mapping"),
+                   files=obj.get("files"))
 
 
 def index_to_offsets(index: Tuple, shape: Tuple[int, ...]) -> Tuple[List[int], List[int]]:

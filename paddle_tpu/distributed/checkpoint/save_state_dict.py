@@ -8,18 +8,37 @@ process saves exactly the chunks it owns (deduped by replica id) to its own
 ``<process_index>_0.distcp`` (an .npz); process 0 writes ``0.metadata`` after a
 metadata all-gather via jax.experimental.multihost_utils when running
 multi-process, or directly in single-controller mode.
+
+Integrity (docs/RESILIENCE.md): shard bytes are serialized in memory, their
+digests (size/crc32/sha256) recorded in ``0.metadata``, and every file —
+shards and metadata alike — lands via tempfile + ``os.replace``, so a crash
+mid-save can never leave a torn file, and the metadata (written last) is the
+checkpoint's commit record. ``replica=True`` writes a ``.replica`` copy of
+each shard for load-time recovery from single-copy corruption.
+``async_save=True`` snapshots the device arrays synchronously and moves the
+file IO to a background thread; ``wait_async_save()`` (also run at
+interpreter exit, and before any new save to the same path) flushes it.
+Fault site ``checkpoint.shard`` corrupts the primary shard bytes after
+digest recording — how the corruption drills are seeded.
 """
 
 from __future__ import annotations
 
+import atexit
+import io
 import os
-from typing import Dict
+import threading
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 
 from ...core.tensor import Tensor
+from ..resilience import faults as _faults
+from .integrity import REPLICA_SUFFIX, atomic_write_bytes, file_digests
 from .metadata import ChunkRecord, Metadata, TensorMetadata, index_to_offsets
+
+__all__ = ["save_state_dict", "wait_async_save"]
 
 
 def _raw(v):
@@ -39,15 +58,53 @@ def _flatten_state_dict(state_dict, prefix=""):
     return flat
 
 
+# in-flight async saves: (path, thread, error-holder)
+_ASYNC: List[Tuple[str, threading.Thread, list]] = []
+_ASYNC_LOCK = threading.Lock()
+
+
+def wait_async_save(path: Optional[str] = None) -> None:
+    """Block until pending ``async_save`` writes (to ``path``, or all of
+    them) are durable; re-raises the first writer error. Registered at
+    interpreter exit so a save in flight at shutdown still completes —
+    without this flush an elastic restart could resume from a checkpoint
+    whose metadata never landed."""
+    with _ASYNC_LOCK:
+        mine = [rec for rec in _ASYNC
+                if path is None or rec[0] == os.path.abspath(path)]
+        for rec in mine:
+            _ASYNC.remove(rec)
+    first_err = None
+    for _, thread, err in mine:
+        thread.join()
+        if err and first_err is None:
+            first_err = err[0]
+    if first_err is not None:
+        raise first_err
+
+
+atexit.register(wait_async_save)
+
+
+def _write_files(path: str, fname: str, blob: bytes, replica: bool) -> None:
+    # the fault site corrupts the PRIMARY copy only — digests were recorded
+    # from the clean bytes, so load-time verification must catch this
+    primary = _faults.corrupt("checkpoint.shard", fname, blob)
+    atomic_write_bytes(os.path.join(path, fname), primary)
+    if replica:
+        atomic_write_bytes(os.path.join(path, fname + REPLICA_SUFFIX), blob)
+
+
 def save_state_dict(state_dict: Dict, path: str, process_group=None,
                     coordinator_rank: int = 0, unique_id=None,
-                    async_save: bool = False) -> None:
+                    async_save: bool = False, replica: bool = False) -> None:
     """Save a (possibly sharded) state_dict to ``path``.
 
     Every value may be a Tensor/jax.Array with any NamedSharding; only locally
     addressable, first-replica chunks are written by this process, so the total
     bytes across hosts equal one copy of the model.
     """
+    wait_async_save(path)               # never interleave saves to one dir
     flat = _flatten_state_dict(state_dict)
     proc = jax.process_index()
     os.makedirs(path, exist_ok=True)
@@ -87,30 +144,63 @@ def save_state_dict(state_dict: Dict, path: str, process_group=None,
                                        file=fname, key=key))
         meta_tensors[name] = TensorMetadata(
             global_shape=list(arr.shape), dtype=str(arr.dtype), chunks=records)
-    with open(os.path.join(path, fname), "wb") as f:
-        np.savez(f, **chunks_out)
+    # serialize in memory: digests come from the exact bytes that hit disk,
+    # and async mode ships bytes (not live device arrays) to the writer
+    buf = io.BytesIO()
+    np.savez(buf, **chunks_out)
+    blob = buf.getvalue()
+    digests = {fname: file_digests(blob)}
 
     if jax.process_count() > 1:
         # shared-FS protocol (like the reference): every process writes a
-        # partial metadata file, barrier, coordinator merges them
-        with open(os.path.join(path, f"{proc}.metadata.part"), "w") as f:
-            f.write(Metadata(meta_tensors).to_json())
+        # partial metadata file, barrier, coordinator merges them.
+        # async_save is demoted to sync here — the two sync_global_devices
+        # fences below ARE the durability barrier for the job.
+        _write_files(path, fname, blob, replica)
+        atomic_write_bytes(
+            os.path.join(path, f"{proc}.metadata.part"),
+            Metadata(meta_tensors, files=digests).to_json().encode())
         from jax.experimental import multihost_utils
 
         multihost_utils.sync_global_devices("ckpt_meta_parts")
         if proc == coordinator_rank:
             merged: Dict[str, TensorMetadata] = {}
+            merged_files: Dict[str, Dict] = {}
             for p in range(jax.process_count()):
                 with open(os.path.join(path, f"{p}.metadata.part")) as f:
                     m = Metadata.from_json(f.read())
+                merged_files.update(m.files or {})
                 for name, tm in m.tensors.items():
                     if name in merged:
                         merged[name].chunks.extend(tm.chunks)
                     else:
                         merged[name] = tm
-            with open(os.path.join(path, "0.metadata"), "w") as f:
-                f.write(Metadata(merged).to_json())
+            atomic_write_bytes(
+                os.path.join(path, "0.metadata"),
+                Metadata(merged, files=merged_files).to_json().encode())
         multihost_utils.sync_global_devices("ckpt_meta_merged")
-    else:
-        with open(os.path.join(path, "0.metadata"), "w") as f:
-            f.write(Metadata(meta_tensors).to_json())
+        return
+
+    meta_blob = Metadata(meta_tensors, files=digests).to_json().encode()
+
+    def write():
+        _write_files(path, fname, blob, replica)
+        # metadata last: its (atomic) appearance commits the checkpoint
+        atomic_write_bytes(os.path.join(path, "0.metadata"), meta_blob)
+
+    if not async_save:
+        write()
+        return
+    err: list = []
+
+    def runner():
+        try:
+            write()
+        except BaseException as e:  # surfaced by wait_async_save
+            err.append(e)
+
+    thread = threading.Thread(target=runner, daemon=False,
+                              name=f"pt-ckpt-save:{os.path.basename(path)}")
+    with _ASYNC_LOCK:
+        _ASYNC.append((os.path.abspath(path), thread, err))
+    thread.start()

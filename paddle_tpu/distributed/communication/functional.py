@@ -324,6 +324,11 @@ def batch_isend_irecv(p2p_op_list):
 
 
 def barrier(group=None):
+    from ..resilience import faults as _faults
+
+    # fault site: a delayed collective (docs/RESILIENCE.md) — the watchdog
+    # and retry drills inject here to model a straggling/partitioned rank
+    _faults.maybe_inject("collective", "barrier")
     jax.effects_barrier()
     return _task()
 

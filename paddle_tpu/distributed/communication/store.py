@@ -10,11 +10,21 @@ exchange NCCL ids — it bootstraps the *job*: rendezvous for launch/elastic
 for the DataLoader and checkpoint writers. Backed by the C++ daemon in
 paddle_tpu/native/src/tcp_store.cc; a pure-Python server/client fallback keeps
 the API alive when no toolchain exists (PT_DISABLE_NATIVE=1).
+
+Resilience (docs/RESILIENCE.md): every client op runs under a shared
+retry/backoff policy — a transport failure (EOF, socket timeout, injected
+fault) reconnects and retries instead of killing the job on the first EOF;
+exhaustion raises RetryError with a PT-RETRY code. Protocol-level outcomes
+(missing key, logical wait timeout) are decided *outside* the retried
+region and are never retried. Fault-injection sites: ``store.client``
+(before each client op) and ``store.daemon`` (pure-Python server, before
+serving a command).
 """
 
 from __future__ import annotations
 
 import ctypes
+import os
 import pickle
 import socket
 import socketserver
@@ -24,11 +34,26 @@ import time
 from typing import Optional
 
 from ... import native
+from ..resilience import faults as _faults
+from ..resilience.retry import RetryPolicy, retry_call
 
-__all__ = ["TCPStore", "MasterDaemon"]
+__all__ = ["TCPStore", "MasterDaemon", "StoreRequestLost",
+           "StoreAmbiguousError"]
+
+
+class StoreRequestLost(ConnectionError):
+    """Transport failed AFTER the request bytes were sent — the daemon may
+    or may not have applied the op. Safe to retry only for idempotent ops."""
+
+
+class StoreAmbiguousError(RuntimeError):
+    """A non-idempotent op (add, compare_set) hit a post-send transport
+    failure: retrying could double-apply (e.g. releasing a barrier early),
+    so the ambiguity surfaces to the caller instead. Non-retryable."""
 
 _CMD = {"set": 1, "get": 2, "add": 3, "check": 4, "delete": 5, "wait": 6,
         "num_keys": 7, "ping": 8, "wait_ge": 9, "compare_set": 10}
+_CMD_NAME = {v: k for k, v in _CMD.items()}
 _OK, _NOTFOUND, _TIMEOUT, _ERROR = 0, 1, 2, 3
 
 
@@ -75,6 +100,10 @@ class _Handler(socketserver.BaseRequestHandler):
                 key = self._read_blob().decode()
                 val = self._read_blob()
                 (arg,) = struct.unpack("<q", self._read(8))
+                # fault site: a stalled/killed daemon op (outside the lock so
+                # an injected stall never blocks other clients)
+                _faults.maybe_inject(
+                    "store.daemon", f"{_CMD_NAME.get(cmd, cmd)}:{key}")
                 with st.cond:
                     if cmd == _CMD["set"]:
                         st.data[key] = val
@@ -198,16 +227,29 @@ class _PyClient:
         self.sock.settimeout(None)
         self._lock = threading.Lock()
 
-    def request(self, cmd, key=b"", val=b"", arg=0):
+    def request(self, cmd, key=b"", val=b"", arg=0, timeout_s=None):
+        """One wire round trip. ``timeout_s`` bounds the whole exchange so a
+        hung daemon surfaces as a retryable socket timeout, not a dead job;
+        after any transport error the connection state is undefined — the
+        owner must reconnect."""
         with self._lock:
+            self.sock.settimeout(timeout_s)
             msg = (struct.pack("<B", cmd) + struct.pack("<I", len(key)) + key +
                    struct.pack("<I", len(val)) + val + struct.pack("<q", arg))
-            self.sock.sendall(msg)
-            status = self._read(1)[0]
-            (n,) = struct.unpack("<I", self._read(4))
-            payload = self._read(n) if n else b""
-            (num,) = struct.unpack("<q", self._read(8))
-            return status, payload, num
+            sent = False
+            try:
+                self.sock.sendall(msg)
+                sent = True
+                status = self._read(1)[0]
+                (n,) = struct.unpack("<I", self._read(4))
+                payload = self._read(n) if n else b""
+                (num,) = struct.unpack("<q", self._read(8))
+                return status, payload, num
+            except (ConnectionError, OSError) as e:
+                if sent and not isinstance(e, StoreRequestLost):
+                    # the daemon may have applied the op before the link died
+                    raise StoreRequestLost(str(e) or type(e).__name__) from e
+                raise
 
     def _read(self, n):
         buf = b""
@@ -228,13 +270,34 @@ class TCPStore:
     >>> store = TCPStore("127.0.0.1", 0, is_master=True, world_size=1)
     >>> store.set("k", b"v"); store.get("k")
     b'v'
+
+    Transport failures reconnect + retry under ``self._retry``. Idempotent
+    ops (set/get/check/wait/...) retry unconditionally; non-idempotent ops
+    (``add``, ``compare_set``) never retry a post-send failure — the
+    outcome is unknown, so they raise StoreAmbiguousError instead of
+    risking a double-apply (an over-counted barrier releases early).
+    ``add(..., on_ambiguous="retry")`` opts back in for counters that
+    tolerate over-count (heartbeats). Native-path caveat: pt_store_add /
+    pt_store_wait_ge report io errors in-band as -1; a same-connection
+    probe disambiguates a genuine -1 value, so negative counters are safe
+    but cost one extra round trip when they hit exactly -1.
     """
 
     def __init__(self, host: str, port: int, is_master: bool = False,
-                 world_size: int = 1, timeout: float = 300.0):
+                 world_size: int = 1, timeout: float = 300.0,
+                 op_timeout: Optional[float] = None):
         self.host = _resolve(host)
         self.world_size = world_size
         self.timeout = timeout
+        # bound on one non-waiting wire op (pure-Python client only — the
+        # native client has no recv timeout, see docs/RESILIENCE.md; a hung
+        # native daemon belongs to the CommTaskManager watchdog). Wait-style
+        # ops add their logical timeout on top.
+        self.op_timeout = (min(30.0, timeout) if op_timeout is None
+                           else float(op_timeout))
+        self._retry = RetryPolicy(
+            max_attempts=int(os.environ.get("PT_STORE_RETRIES", "3")),
+            base_delay=0.05, max_delay=1.0, deadline=timeout)
         self._daemon: Optional[MasterDaemon] = MasterDaemon(port) if is_master else None
         self.port = self._daemon.port if self._daemon else port
         self._lib = native.load()
@@ -249,100 +312,239 @@ class TCPStore:
             self._client = None
             self._py = _PyClient(self.host, self.port, int(timeout * 1000))
 
+    # -- transport resilience ----------------------------------------------
+    def _reconnect(self):
+        if self._lib is not None:
+            if self._client:
+                try:
+                    self._lib.pt_store_client_free(self._client)
+                except Exception:
+                    pass
+            # short per-attempt connect window (vs the generous bootstrap
+            # `timeout` in __init__): retry_call owns the overall deadline,
+            # so each reconnect attempt must fail fast, not block for 300s
+            self._client = self._lib.pt_store_client_new(
+                self.host.encode(), self.port, int(self.op_timeout * 1000))
+            if not self._client:
+                raise ConnectionError(
+                    f"store reconnect to {self.host}:{self.port} failed")
+        else:
+            if self._py is not None:
+                try:
+                    self._py.close()
+                except OSError:
+                    pass
+            self._py = _PyClient(self.host, self.port,
+                                 int(self.op_timeout * 1000))
+
+    def _op(self, name: str, key: str, fn, ambiguous_ok: bool = True):
+        """Run one client op under the retry policy. ``fn`` must raise
+        ConnectionError/OSError/socket-timeout for transport failures only —
+        protocol outcomes are returned and judged by the caller.
+
+        ``ambiguous_ok=False`` (non-idempotent ops): a post-send transport
+        failure (StoreRequestLost — the daemon may already have applied the
+        op) is NOT retried; it surfaces as StoreAmbiguousError so e.g. a
+        barrier arrival can never be double-counted into an early release.
+        Pre-send failures are always safely retryable."""
+
+        def attempt():
+            _faults.maybe_inject("store.client", f"{name}:{key}")
+            # a previous attempt's reconnect may have failed and left no
+            # client at all — re-establish (raises ConnectionError while the
+            # daemon is down, which retry_call treats like any transport
+            # failure) so fn() never dispatches against a missing backend
+            if (self._client is None if self._lib is not None
+                    else self._py is None):
+                self._reconnect()
+            try:
+                return fn()
+            except (ConnectionError, OSError) as e:
+                ambiguous = isinstance(e, StoreRequestLost)
+                try:
+                    self._reconnect()
+                except Exception:
+                    pass        # next attempt (or the caller) fails fast
+                if ambiguous and not ambiguous_ok:
+                    raise StoreAmbiguousError(
+                        f"store {name}({key}): transport failed after send; "
+                        "the op may or may not have been applied") from e
+                raise
+
+        return retry_call(attempt, policy=self._retry,
+                          what=f"store.{name}({key})")
+
     # -- core ops ----------------------------------------------------------
     def set(self, key: str, value) -> None:
         v = value if isinstance(value, (bytes, bytearray)) else pickle.dumps(value)
-        if self._client:
-            rc = self._lib.pt_store_set(self._client, key.encode(), bytes(v), len(v))
-            if rc != 0:
-                raise RuntimeError(f"store set({key}) failed rc={rc}")
-        else:
-            self._py.request(_CMD["set"], key.encode(), bytes(v))
+
+        def do():
+            if self._client:
+                rc = self._lib.pt_store_set(self._client, key.encode(),
+                                            bytes(v), len(v))
+                if rc == -1:            # native io error: retryable
+                    raise ConnectionError(f"store set({key}) io error")
+                if rc != 0:
+                    raise RuntimeError(f"store set({key}) failed rc={rc}")
+                return None
+            self._py.request(_CMD["set"], key.encode(), bytes(v),
+                             timeout_s=self.op_timeout)
+
+        self._op("set", key, do)
 
     def get(self, key: str, wait: bool = True) -> Optional[bytes]:
         if wait and not self.wait([key]):
             raise TimeoutError(f"store get({key}) timed out after {self.timeout}s")
-        if self._client:
-            p = ctypes.POINTER(ctypes.c_uint8)()
-            n = ctypes.c_int()
-            st = self._lib.pt_store_get(self._client, key.encode(),
-                                        ctypes.byref(p), ctypes.byref(n))
-            data = native.take_bytes(self._lib, p, n)
-            return data if st == _OK else None
-        st, payload, _ = self._py.request(_CMD["get"], key.encode())
-        return payload if st == _OK else None
 
-    def add(self, key: str, amount: int = 1) -> int:
-        if self._client:
-            return int(self._lib.pt_store_add(self._client, key.encode(), amount))
-        _, _, num = self._py.request(_CMD["add"], key.encode(), arg=amount)
-        return num
+        def do():
+            if self._client:
+                p = ctypes.POINTER(ctypes.c_uint8)()
+                n = ctypes.c_int()
+                st = self._lib.pt_store_get(self._client, key.encode(),
+                                            ctypes.byref(p), ctypes.byref(n))
+                data = native.take_bytes(self._lib, p, n)
+                if st == -1:            # io error, NOT "key missing"
+                    raise ConnectionError(f"store get({key}) io error")
+                return data if st == _OK else None
+            st, payload, _ = self._py.request(_CMD["get"], key.encode(),
+                                              timeout_s=self.op_timeout)
+            return payload if st == _OK else None
+
+        return self._op("get", key, do)
+
+    def add(self, key: str, amount: int = 1, *,
+            on_ambiguous: str = "raise") -> int:
+        """Atomic server-side increment. NOT idempotent: by default a
+        post-send transport failure raises StoreAmbiguousError instead of
+        retrying (a re-applied +1 could release a barrier early). Callers
+        whose counters tolerate over-count (heartbeats, monotone progress
+        markers) pass ``on_ambiguous="retry"``."""
+
+        def do():
+            if self._client:
+                v = int(self._lib.pt_store_add(self._client, key.encode(),
+                                               amount))
+                if v == -1:
+                    # -1 is in-band: io error OR a genuine counter value.
+                    # Probe the same connection — a dead fd fails again, a
+                    # healthy one proves -1 was the real value.
+                    if int(self._lib.pt_store_num_keys(self._client)) == -1:
+                        raise StoreRequestLost(f"store add({key}) io error")
+                    return v
+                return v
+            _, _, num = self._py.request(_CMD["add"], key.encode(), arg=amount,
+                                         timeout_s=self.op_timeout)
+            return num
+
+        return self._op("add", key, do,
+                        ambiguous_ok=(on_ambiguous == "retry"))
 
     def check(self, keys) -> bool:
         keys = [keys] if isinstance(keys, str) else keys
         for k in keys:
-            if self._client:
-                if self._lib.pt_store_check(self._client, k.encode()) != 1:
-                    return False
-            else:
-                _, _, num = self._py.request(_CMD["check"], k.encode())
-                if not num:
-                    return False
+            def do(k=k):
+                if self._client:
+                    rc = self._lib.pt_store_check(self._client, k.encode())
+                    if rc == -1:
+                        raise ConnectionError(f"store check({k}) io error")
+                    return rc == 1
+                _, _, num = self._py.request(_CMD["check"], k.encode(),
+                                             timeout_s=self.op_timeout)
+                return bool(num)
+
+            if not self._op("check", k, do):
+                return False
         return True
 
     def delete_key(self, key: str) -> bool:
-        if self._client:
-            return self._lib.pt_store_delete(self._client, key.encode()) == 1
-        _, _, num = self._py.request(_CMD["delete"], key.encode())
-        return bool(num)
+        def do():
+            if self._client:
+                rc = self._lib.pt_store_delete(self._client, key.encode())
+                if rc == -1:
+                    raise ConnectionError(f"store delete({key}) io error")
+                return rc == 1
+            _, _, num = self._py.request(_CMD["delete"], key.encode(),
+                                         timeout_s=self.op_timeout)
+            return bool(num)
+
+        return self._op("delete", key, do)
 
     def wait(self, keys, timeout: Optional[float] = None) -> bool:
         keys = [keys] if isinstance(keys, str) else keys
         tmo = int((self.timeout if timeout is None else timeout) * 1000)
+        sock_tmo = None if tmo < 0 else tmo / 1000 + self.op_timeout
         for k in keys:
-            if self._client:
-                if self._lib.pt_store_wait(self._client, k.encode(), tmo) != _OK:
-                    return False
-            else:
-                st, _, _ = self._py.request(_CMD["wait"], k.encode(), arg=tmo)
-                if st != _OK:
-                    return False
+            def do(k=k):
+                if self._client:
+                    st = self._lib.pt_store_wait(self._client, k.encode(),
+                                                 tmo)
+                    if st == -1:        # io error != logical timeout
+                        raise ConnectionError(f"store wait({k}) io error")
+                    return st
+                st, _, _ = self._py.request(_CMD["wait"], k.encode(), arg=tmo,
+                                            timeout_s=sock_tmo)
+                return st
+
+            if self._op("wait", k, do) != _OK:
+                return False            # logical timeout: an answer, no retry
         return True
 
     def wait_ge(self, key: str, target: int, timeout: Optional[float] = None) -> int:
         """Block until int(store[key]) >= target; returns the value seen."""
         tmo = int((self.timeout if timeout is None else timeout) * 1000)
-        if self._client:
-            v = int(self._lib.pt_store_wait_ge(self._client, key.encode(), target, tmo))
-            if v == -2:
-                raise TimeoutError(f"wait_ge({key}, {target}) timed out")
-            if v < 0:
-                raise RuntimeError(f"wait_ge({key}) io error")
-            return v
-        st, _, num = self._py.request(_CMD["wait_ge"], key.encode(),
-                                      struct.pack("<q", tmo), target)
-        if st == _TIMEOUT:
+        sock_tmo = None if tmo < 0 else tmo / 1000 + self.op_timeout
+
+        def do():
+            if self._client:
+                v = int(self._lib.pt_store_wait_ge(self._client,
+                                                   key.encode(), target, tmo))
+                if v == -1:             # in-band: io error or real value -1
+                    if int(self._lib.pt_store_num_keys(self._client)) == -1:
+                        raise ConnectionError(
+                            f"store wait_ge({key}) io error")
+                return v
+            st, _, num = self._py.request(_CMD["wait_ge"], key.encode(),
+                                          struct.pack("<q", tmo), target,
+                                          timeout_s=sock_tmo)
+            return -2 if st == _TIMEOUT else num
+
+        v = self._op("wait_ge", key, do)
+        if v == -2:
             raise TimeoutError(f"wait_ge({key}, {target}) timed out")
-        return num
+        return v
 
     def compare_set(self, key: str, expected: bytes, desired: bytes) -> bool:
-        if self._client:
-            p = ctypes.POINTER(ctypes.c_uint8)()
-            n = ctypes.c_int()
-            rc = self._lib.pt_store_compare_set(
-                self._client, key.encode(), expected, len(expected),
-                desired, len(desired), ctypes.byref(p), ctypes.byref(n))
-            native.take_bytes(self._lib, p, n)
-            return rc == 1
-        st, _, num = self._py.request(_CMD["compare_set"], key.encode(),
-                                      expected + b"\x00" + desired)
-        return bool(num)
+        def do():
+            if self._client:
+                p = ctypes.POINTER(ctypes.c_uint8)()
+                n = ctypes.c_int()
+                rc = self._lib.pt_store_compare_set(
+                    self._client, key.encode(), expected, len(expected),
+                    desired, len(desired), ctypes.byref(p), ctypes.byref(n))
+                native.take_bytes(self._lib, p, n)
+                if rc == -1:
+                    raise StoreRequestLost(
+                        f"store compare_set({key}) io error")
+                return rc == 1
+            st, _, num = self._py.request(_CMD["compare_set"], key.encode(),
+                                          expected + b"\x00" + desired,
+                                          timeout_s=self.op_timeout)
+            return bool(num)
+
+        return self._op("compare_set", key, do, ambiguous_ok=False)
 
     def num_keys(self) -> int:
-        if self._client:
-            return int(self._lib.pt_store_num_keys(self._client))
-        _, _, num = self._py.request(_CMD["num_keys"])
-        return num
+        def do():
+            if self._client:
+                v = int(self._lib.pt_store_num_keys(self._client))
+                if v == -1:
+                    raise ConnectionError("store num_keys io error")
+                return v
+            _, _, num = self._py.request(_CMD["num_keys"],
+                                         timeout_s=self.op_timeout)
+            return num
+
+        return self._op("num_keys", "", do)
 
     # -- composite ---------------------------------------------------------
     def barrier(self, name: str = "default", world_size: Optional[int] = None,

@@ -4,9 +4,21 @@ Reference: python/paddle/distributed/fleet/elastic/manager.py:125 — ranks
 register in etcd with TTL leases (manager.py:248-293), watch callbacks detect
 node join/loss, and the job relaunches between min/max nranks (fault tolerance
 = restart from checkpoint). TPU-native: the lease is a heartbeat key
-``elastic/{job}/beat/{node_id}`` holding a wall-clock stamp refreshed by a
-daemon thread; peers whose stamp goes stale past ``ttl`` are dead. No etcd —
-the native TCPStore daemon is the registry.
+``elastic/{job}/beat/{node_id}`` refreshed by a daemon thread; peers whose
+beat goes stale past ``ttl`` are dead. No etcd — the native TCPStore daemon
+is the registry.
+
+Clock discipline: the heartbeat is a **server-side counter** (``store.add``)
+— the store daemon is the single ordering authority — and staleness is
+measured by each observer's local ``time.monotonic()`` since the peer's
+counter last advanced. Wall-clock (``time.time``) never crosses hosts, so
+NTP skew can neither kill a live peer nor keep a dead one alive
+(tests/test_resilience.py pins the skew regression).
+
+Fault site ``elastic.heartbeat`` (docs/RESILIENCE.md): a ``kill`` fault
+raised before a beat terminates the heartbeat thread — the injected
+equivalent of node death, used by tools/fault_drill.py to exercise the
+save/reshard/resume path.
 """
 
 from __future__ import annotations
@@ -14,7 +26,9 @@ from __future__ import annotations
 import threading
 import time
 from enum import Enum
-from typing import List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ...resilience import faults as _faults
 
 
 class ElasticStatus(Enum):
@@ -25,16 +39,34 @@ class ElasticStatus(Enum):
     ERROR = "error"
 
 
+def _decode_count(raw: bytes) -> Optional[int]:
+    """Beat counters arrive as the store's 8-byte little-endian int (from
+    ``add``); tolerate the legacy ``repr(time.time())`` float-string beats
+    (a mixed-version job mid-rolling-restart) by folding them into the
+    staleness counter — any change still reads as an advance."""
+    import struct
+
+    if len(raw) == 8:
+        return struct.unpack("<q", raw)[0]
+    try:
+        return int(float(raw.decode()))
+    except (ValueError, UnicodeDecodeError):
+        return None
+
+
 class ElasticManager:
     def __init__(self, store, job_id: str, node_id: str,
                  expected: Sequence[str], heartbeat_interval: float = 3.0,
-                 ttl: float = 9.0):
+                 ttl: float = 9.0, clock: Callable[[], float] = time.monotonic):
         self.store = store
         self.job_id = job_id
         self.node_id = node_id
         self.expected = list(expected)
         self.interval = heartbeat_interval
         self.ttl = ttl
+        self._clock = clock
+        # node_id -> [last counter seen, local monotonic time it advanced]
+        self._seen: Dict[str, list] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -43,22 +75,48 @@ class ElasticManager:
         return f"elastic/{self.job_id}/beat/{node_id}"
 
     def _beat(self) -> None:
-        self.store.set(self._beat_key(self.node_id), repr(time.time()).encode())
+        _faults.maybe_inject("elastic.heartbeat", self.node_id)
+        # monotone server-side counter: the store daemon is the clock
+        # authority, never this host's wall clock. Over-count is harmless
+        # for a staleness counter, so ambiguous transport outcomes retry.
+        try:
+            self.store.add(self._beat_key(self.node_id), 1,
+                           on_ambiguous="retry")
+        except TypeError:   # duck-typed store without the kwarg
+            self.store.add(self._beat_key(self.node_id), 1)
 
     def start(self) -> None:
         if self.store is None:
             return
         self._beat()
+        self._prime()
 
         def loop():
             while not self._stop.wait(self.interval):
                 try:
                     self._beat()
+                except _faults.FaultInjected:
+                    return      # injected node death (fault drill)
                 except Exception:
-                    return  # store gone — controller is shutting down
+                    # transient store failure: the next interval IS the
+                    # retry — one missed beat must not silently kill a
+                    # healthy node's lease (peers allow ttl >> interval)
+                    continue
 
         self._thread = threading.Thread(target=loop, daemon=True)
         self._thread.start()
+
+    def _prime(self) -> None:
+        """Record every expected peer's current counter NOW, so staleness
+        for a peer that never beats again is measured from manager start —
+        a fresh observer grants a dead-but-persisted beat key at most one
+        ``ttl`` of grace, instead of ttl from whenever it first looks."""
+        now = self._clock()
+        for nid in self.expected:
+            raw = self.store.get(self._beat_key(nid), wait=False)
+            cnt = _decode_count(raw) if raw is not None else None
+            if cnt is not None:
+                self._seen.setdefault(nid, [cnt, now])
 
     def stop(self) -> None:
         self._stop.set()
@@ -68,28 +126,44 @@ class ElasticManager:
 
     # -- watch -------------------------------------------------------------
     def alive_peers(self) -> List[str]:
+        """Expected peers whose beat counter advanced within ``ttl`` of this
+        observer's monotonic clock. A peer never seen to beat is dead."""
         if self.store is None:
             return [self.node_id]
-        now = time.time()
+        now = self._clock()
         alive = []
         for nid in self.expected:
             raw = self.store.get(self._beat_key(nid), wait=False)
             if raw is None:
                 continue
-            try:
-                stamp = float(raw.decode())
-            except ValueError:
+            cnt = _decode_count(raw)
+            if cnt is None:
                 continue
-            if now - stamp <= self.ttl:
+            rec = self._seen.get(nid)
+            if rec is None or cnt != rec[0]:
+                self._seen[nid] = [cnt, now]
+                alive.append(nid)
+            elif now - rec[1] <= self.ttl:
                 alive.append(nid)
         return alive
 
     def peers_changed(self) -> bool:
-        """True when a registered peer died (scale-in signal). Scale-out is
-        noticed at the next rendezvous generation, not here."""
+        """True when a registered PEER died (scale-in signal). This node's
+        own beat lag never counts — a local store blip delaying our own
+        heartbeat is not a peer loss, and treating it as one would burn an
+        elastic restart on a healthy job. Scale-out is noticed at the next
+        rendezvous generation, not here."""
         if self.store is None:
             return False
-        return len(self.alive_peers()) < len(self.expected)
+        alive = set(self.alive_peers())
+        alive.add(self.node_id)
+        return len(alive & set(self.expected)) < len(self.expected)
+
+    def reset_expected(self, nodes: Sequence[str]) -> None:
+        """Re-arm the watch for a new generation (post-reshard): only the
+        surviving nodes are expected from now on."""
+        self.expected = list(nodes)
+        self._seen = {n: v for n, v in self._seen.items() if n in self.expected}
 
 
 def enable_elastic(args=None, distribute_mode=None) -> bool:

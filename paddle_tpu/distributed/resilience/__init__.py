@@ -1,0 +1,53 @@
+"""paddle_tpu.distributed.resilience — fault injection, retry, auto-resume.
+
+The fault-tolerance layer spanning the store (communication/store.py), the
+elastic manager (fleet/elastic/manager.py), distributed checkpointing
+(distributed/checkpoint/), and the serving engine (inference/serving.py).
+See docs/RESILIENCE.md for the fault model, the injection-site catalogue,
+and the PT-RETRY / PT-CKPT diagnostic codes.
+
+Import discipline: this package sits *below* those subsystems (they import
+it at module load), so ``faults``/``retry`` are stdlib-only; the trainer —
+which pulls in the auto-parallel Engine stack — loads lazily.
+"""
+
+from .faults import (  # noqa: F401
+    FaultInjected,
+    FaultPlan,
+    FaultSpec,
+    active_plan,
+    corrupt,
+    maybe_inject,
+)
+from .retry import (  # noqa: F401
+    DEFAULT_POLICY,
+    RetryError,
+    RetryPolicy,
+    retries_disabled,
+    retry_call,
+)
+
+__all__ = [
+    "FaultInjected", "FaultPlan", "FaultSpec", "active_plan", "corrupt",
+    "maybe_inject", "DEFAULT_POLICY", "RetryError", "RetryPolicy",
+    "retries_disabled", "retry_call", "ResilientTrainer",
+    "CheckpointCorruptionError", "EngineSaturated",
+]
+
+
+def __getattr__(name):
+    # lazy: these pull in jax / the Engine stack, which would cycle with
+    # distributed/__init__ if imported eagerly here
+    if name == "ResilientTrainer":
+        from .trainer import ResilientTrainer
+
+        return ResilientTrainer
+    if name == "CheckpointCorruptionError":
+        from ..checkpoint.integrity import CheckpointCorruptionError
+
+        return CheckpointCorruptionError
+    if name == "EngineSaturated":
+        from ...inference.serving import EngineSaturated
+
+        return EngineSaturated
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,184 @@
+"""Deterministic fault injection — failure as a testable input.
+
+Reference posture: the survey's elastic layer (fleet/elastic/manager.py:125)
+defines fault tolerance as "restart from checkpoint between min/max nranks"
+but offers no way to *exercise* the recovery paths. This module makes every
+failure mode a seeded, step-indexed plan so recovery is proven by tests and
+by ``tools/fault_drill.py``, not assumed.
+
+A :class:`FaultPlan` is a list of :class:`FaultSpec` entries. Each spec
+watches one injection *site* (a short dotted name, e.g. ``store.client``)
+and fires on the ``at``-th matching event for ``count`` events. Sites are
+consulted by production code through two hooks:
+
+- :func:`maybe_inject` — control-flow faults: ``kill`` (raises
+  :class:`FaultInjected`, a ``ConnectionError``), ``stall``/``delay``
+  (sleeps ``arg`` seconds), ``error`` (raises ``RuntimeError``).
+- :func:`corrupt` — data faults applied to a byte payload: ``bitflip``
+  (flips ``arg`` pseudo-random bits, positions drawn from the plan's seeded
+  RNG), ``truncate`` (drops the last ``arg`` bytes), ``garbage`` (replaces
+  the payload with seeded random bytes of the same length).
+
+Known sites (see docs/RESILIENCE.md for the catalogue):
+
+====================  =====================================================
+``store.client``      before every TCPStore client op (detail ``op:key``)
+``store.daemon``      pure-Python store daemon, before serving a command
+``elastic.heartbeat`` before each heartbeat write (detail = node_id)
+``checkpoint.shard``  shard bytes about to be written (detail = file name)
+``collective``        blocking collective entry (detail = op name)
+``rpc.connect``       before an rpc client connection (detail = worker)
+====================  =====================================================
+
+With no plan installed every hook is a cheap no-op (one global read), so
+production paths carry no overhead when fault injection is off.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import threading
+import time
+from typing import List, Optional, Sequence
+
+__all__ = ["FaultSpec", "FaultPlan", "FaultInjected", "maybe_inject",
+           "corrupt", "active_plan"]
+
+
+class FaultInjected(ConnectionError):
+    """Raised by a ``kill`` fault — a ConnectionError so transport-level
+    retry paths treat it exactly like a real peer loss / EOF."""
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    """One planned fault: ``action`` at the ``at``-th matching event of
+    ``site`` (events whose detail contains ``match``), for ``count`` events
+    (-1 = every event from ``at`` on)."""
+
+    site: str
+    action: str            # kill | stall | delay | error | bitflip | truncate | garbage
+    at: int = 0
+    count: int = 1
+    arg: float = 0.0       # seconds (stall/delay) or bytes/bits (data faults)
+    match: str = ""
+
+    _CONTROL = ("kill", "stall", "delay", "error")
+    _DATA = ("bitflip", "truncate", "garbage")
+
+    def __post_init__(self):
+        if self.action not in self._CONTROL + self._DATA:
+            raise ValueError(f"unknown fault action {self.action!r} "
+                             f"(choose: {self._CONTROL + self._DATA})")
+
+
+class FaultPlan:
+    """Seeded, step-indexed fault schedule.
+
+    >>> plan = FaultPlan(seed=7, specs=[
+    ...     FaultSpec("store.client", "kill", at=3, count=1)])
+    >>> plan.install()         # hooks consult it from now on
+    >>> ...
+    >>> plan.uninstall()
+
+    Determinism: event counters are per-spec, advancing only on matching
+    events, and every random choice (bit positions, garbage bytes) comes
+    from ``random.Random(seed)`` — the same plan over the same event stream
+    injects byte-identical faults.
+    """
+
+    def __init__(self, seed: int = 0, specs: Sequence[FaultSpec] = ()):
+        self.seed = int(seed)
+        self.specs = list(specs)
+        self.rng = random.Random(self.seed)
+        self.log: List[tuple] = []          # (site, detail, action) fired
+        self._counts = [0] * len(self.specs)
+        self._lock = threading.Lock()
+
+    # -- event matching ----------------------------------------------------
+    def fire(self, site: str, detail: str = "") -> List[FaultSpec]:
+        """Advance counters for this event; return the specs due now."""
+        due = []
+        with self._lock:
+            for i, s in enumerate(self.specs):
+                if s.site != site or (s.match and s.match not in detail):
+                    continue
+                idx = self._counts[i]
+                self._counts[i] = idx + 1
+                if idx >= s.at and (s.count < 0 or idx < s.at + s.count):
+                    due.append(s)
+                    self.log.append((site, detail, s.action))
+        return due
+
+    # -- lifecycle ---------------------------------------------------------
+    def install(self) -> "FaultPlan":
+        global _ACTIVE
+        _ACTIVE = self
+        return self
+
+    def uninstall(self) -> None:
+        global _ACTIVE
+        if _ACTIVE is self:
+            _ACTIVE = None
+
+    def __enter__(self) -> "FaultPlan":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+
+_ACTIVE: Optional[FaultPlan] = None
+
+
+def active_plan() -> Optional[FaultPlan]:
+    return _ACTIVE
+
+
+def maybe_inject(site: str, detail: str = "") -> None:
+    """Control-flow hook: no-op without a plan; otherwise sleep/raise per
+    the specs due at this event."""
+    plan = _ACTIVE
+    if plan is None:
+        return
+    for s in plan.fire(site, detail):
+        if s.action in ("stall", "delay"):
+            time.sleep(s.arg)
+        elif s.action == "kill":
+            raise FaultInjected(
+                f"fault injected: kill at {site} ({detail})")
+        elif s.action == "error":
+            raise RuntimeError(f"fault injected: error at {site} ({detail})")
+        # data actions at a control-only site are ignored
+
+
+def corrupt(site: str, detail: str, data: bytes) -> bytes:
+    """Data hook: return ``data`` with any due data faults applied."""
+    plan = _ACTIVE
+    if plan is None:
+        return data
+    for s in plan.fire(site, detail):
+        if s.action == "truncate":
+            n = int(s.arg) or max(1, len(data) // 2)
+            data = data[: max(0, len(data) - n)]
+        elif s.action == "bitflip":
+            buf = bytearray(data)
+            nbits = int(s.arg) or 1
+            # flip bits in the middle half of the payload: past container
+            # headers, before trailing indexes — the silent-corruption zone
+            lo, hi = len(buf) // 4, max(len(buf) // 4 + 1, (3 * len(buf)) // 4)
+            for _ in range(nbits):
+                pos = plan.rng.randrange(lo, hi)
+                buf[pos] ^= 1 << plan.rng.randrange(8)
+            data = bytes(buf)
+        elif s.action == "garbage":
+            data = bytes(plan.rng.getrandbits(8) for _ in range(len(data)))
+        elif s.action in ("stall", "delay"):
+            time.sleep(s.arg)
+        elif s.action == "kill":
+            raise FaultInjected(
+                f"fault injected: kill at {site} ({detail})")
+        elif s.action == "error":
+            raise RuntimeError(f"fault injected: error at {site} ({detail})")
+    return data

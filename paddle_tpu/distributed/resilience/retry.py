@@ -1,0 +1,124 @@
+"""Shared retry/timeout/backoff — one policy object for every control-plane
+transport (TCPStore client ops, rpc connections, ps push/pull fan-out).
+
+Replaces raise-on-first-EOF: a transient transport failure (peer restarting,
+store daemon momentarily unreachable, injected fault) is retried with
+exponential backoff + jitter under an overall deadline; exhaustion raises a
+:class:`RetryError` carrying a stable ``PT-RETRY-xxx`` diagnostic code so
+logs and tests can assert on the failure class, not a message string.
+
+Diagnostic codes (catalogued in docs/RESILIENCE.md):
+
+- ``PT-RETRY-001`` — overall deadline exhausted while retrying
+- ``PT-RETRY-002`` — attempt budget exhausted
+- Non-retryable exceptions propagate unchanged (a typed ``KeyError`` from a
+  store miss must stay a ``KeyError``).
+
+``PT_RETRY_DISABLE=1`` collapses every policy to a single attempt — the
+switch ``tools/fault_drill.py`` uses to prove each injected transport fault
+flips the exit code when retry is off.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import time
+from typing import Callable, Optional, Tuple, Type
+
+__all__ = ["RetryPolicy", "RetryError", "retry_call", "DEFAULT_POLICY",
+           "retries_disabled"]
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Exponential backoff with full jitter under an overall deadline.
+
+    Delay before attempt ``k`` (1-based, first retry is k=2):
+    ``min(max_delay, base_delay * multiplier**(k-2))`` scaled by a uniform
+    jitter in ``[1-jitter, 1+jitter]``, truncated so the sleep never crosses
+    ``deadline``.
+    """
+
+    max_attempts: int = 4
+    base_delay: float = 0.05
+    multiplier: float = 2.0
+    max_delay: float = 2.0
+    jitter: float = 0.25
+    deadline: Optional[float] = None       # seconds across ALL attempts
+    retry_on: Tuple[Type[BaseException], ...] = (
+        ConnectionError, TimeoutError, OSError)
+
+
+DEFAULT_POLICY = RetryPolicy()
+
+
+class RetryError(RuntimeError):
+    """Terminal retry failure with a stable diagnostic code.
+
+    Attributes: ``code`` (PT-RETRY-xxx), ``what`` (operation label),
+    ``attempts``, ``elapsed``, ``last`` (the final underlying exception).
+    """
+
+    def __init__(self, code: str, what: str, attempts: int, elapsed: float,
+                 last: BaseException):
+        self.code = code
+        self.what = what
+        self.attempts = attempts
+        self.elapsed = elapsed
+        self.last = last
+        super().__init__(
+            f"{code}: {what} failed after {attempts} attempt(s) in "
+            f"{elapsed:.2f}s: {last!r}")
+
+
+def retries_disabled() -> bool:
+    return os.environ.get("PT_RETRY_DISABLE") == "1"
+
+
+def backoff_delays(policy: RetryPolicy, rng: Optional[random.Random] = None):
+    """The delay sequence a policy produces (attempt 2, 3, ...) — exposed so
+    tests can pin the schedule without sleeping."""
+    r = rng or random
+    d = policy.base_delay
+    for _ in range(max(0, policy.max_attempts - 1)):
+        j = 1.0 + policy.jitter * (2.0 * r.random() - 1.0) if policy.jitter else 1.0
+        yield min(policy.max_delay, d) * j
+        d *= policy.multiplier
+
+
+def retry_call(fn: Callable, *args, policy: Optional[RetryPolicy] = None,
+               what: str = "call", on_retry: Optional[Callable] = None,
+               rng: Optional[random.Random] = None, sleep=time.sleep, **kwargs):
+    """Call ``fn(*args, **kwargs)``, retrying ``policy.retry_on`` failures.
+
+    ``on_retry(attempt, exc, delay)`` is invoked before each backoff sleep
+    (reconnect hooks, logging). ``sleep`` is injectable for tests.
+    """
+    pol = policy or DEFAULT_POLICY
+    attempts = 1 if retries_disabled() else max(1, pol.max_attempts)
+    start = time.monotonic()
+    delays = backoff_delays(pol, rng)
+    last: Optional[BaseException] = None
+    for attempt in range(1, attempts + 1):
+        try:
+            return fn(*args, **kwargs)
+        except pol.retry_on as e:
+            last = e
+            elapsed = time.monotonic() - start
+            if attempt >= attempts:
+                if attempts == 1:
+                    raise        # retries disabled/single-shot: raw failure
+                raise RetryError("PT-RETRY-002", what, attempt, elapsed, e) from e
+            delay = next(delays, pol.max_delay)
+            if pol.deadline is not None:
+                remain = pol.deadline - elapsed
+                if remain <= 0:
+                    raise RetryError("PT-RETRY-001", what, attempt, elapsed,
+                                     e) from e
+                delay = min(delay, remain)
+            if on_retry is not None:
+                on_retry(attempt, e, delay)
+            sleep(max(0.0, delay))
+    raise AssertionError("unreachable")  # loop always returns or raises

@@ -30,9 +30,17 @@ from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional
 
 from ..communication.store import TCPStore
+from ..resilience import faults as _faults
+from ..resilience.retry import RetryPolicy, retry_call
 
 __all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
            "get_worker_info", "get_all_worker_infos", "WorkerInfo"]
+
+# connection establishment is retried (a peer mid-restart, an injected
+# fault); the request/response exchange itself is NOT — rpc calls are not
+# idempotent in general (ps push applies gradients), so a post-send failure
+# must surface to the caller rather than silently re-execute.
+_CONNECT_RETRY = RetryPolicy(max_attempts=4, base_delay=0.05, max_delay=1.0)
 
 
 @dataclass
@@ -138,7 +146,13 @@ class RpcAgent:
     def call(self, to: str, fn: Callable, args=(), kwargs=None,
              timeout: Optional[float] = None):
         w = self.worker(to)
-        with socket.create_connection((w.ip, w.port), timeout=timeout) as s:
+
+        def connect():
+            _faults.maybe_inject("rpc.connect", to)
+            return socket.create_connection((w.ip, w.port), timeout=timeout)
+
+        with retry_call(connect, policy=_CONNECT_RETRY,
+                        what=f"rpc.connect({to})") as s:
             _send_msg(s, pickle.dumps((fn, tuple(args), dict(kwargs or {}))))
             s.settimeout(timeout)
             ok, result = pickle.loads(_recv_msg(s))

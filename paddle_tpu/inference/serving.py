@@ -61,6 +61,12 @@ from ..models.generation_utils import (fold_keys as _fold_keys,
                                        sample_rows, validate_sampling)
 
 
+class EngineSaturated(RuntimeError):
+    """add_request refused: the engine's wait queue is at its high-water
+    mark (``max_queue``). Admission control — callers shed load, retry with
+    backoff, or scale out; the engine never hides an unbounded backlog."""
+
+
 class Request:
     """One generation request tracked by the engine.
 
@@ -68,6 +74,12 @@ class Request:
     greedy; otherwise temperature + optional top-p (nucleus) + top-k filter.
     ``seed`` (default: the request id) makes the request's sample stream
     reproducible regardless of batching or arrival order.
+
+    ``deadline_s`` (measured from enqueue) bounds the request's total life
+    — queue wait plus decode. A request past its deadline is evicted at the
+    next engine step: ``done=True, failed=True``, ``error`` names the
+    deadline, its slot/pages are freed, and other slots are untouched.
+    Eviction latency is bounded by one decode block.
     """
 
     _counter = [0]
@@ -75,7 +87,8 @@ class Request:
     def __init__(self, prompt_ids, max_new_tokens: int = 32,
                  eos_token_id: Optional[int] = None,
                  temperature: float = 0.0, top_p: float = 1.0,
-                 top_k: int = 0, seed: Optional[int] = None):
+                 top_k: int = 0, seed: Optional[int] = None,
+                 deadline_s: Optional[float] = None):
         validate_sampling(temperature, top_p, top_k)
         Request._counter[0] += 1
         self.rid = Request._counter[0]
@@ -88,8 +101,12 @@ class Request:
         self.top_p = float(top_p)
         self.top_k = int(top_k)
         self.seed = int(seed if seed is not None else self.rid)
+        self.deadline_s = None if deadline_s is None else float(deadline_s)
         self.output: List[int] = []
         self.done = False
+        self.failed = False
+        self.error: Optional[str] = None
+        self._enqueued_at: Optional[float] = None  # set by add_request
         # tokens SCHEDULED so far (device-side results may still be pending
         # materialization — without eos the schedule is deterministic, so the
         # engine books progress before reading any token value)
@@ -119,12 +136,16 @@ class Request:
 class ContinuousBatchingEngine:
     def __init__(self, model, max_batch: int = 8, max_len: int = 512,
                  page_size: int = 64, block_size: int = 8,
-                 prompt_buckets: Optional[Sequence[int]] = None):
+                 prompt_buckets: Optional[Sequence[int]] = None,
+                 max_queue: Optional[int] = None):
         self.model = model
         self.max_batch = max_batch
         self.max_len = max_len
         self.page_size = page_size
         self.block_size = max(1, int(block_size))
+        # bounded-queue backpressure: add_request raises EngineSaturated
+        # past this many waiting requests (None = unbounded, legacy)
+        self.max_queue = None if max_queue is None else max(0, int(max_queue))
         self.prompt_buckets = (sorted(int(b) for b in prompt_buckets)
                                if prompt_buckets else None)
         if self.prompt_buckets and self.prompt_buckets[-1] > max_len:
@@ -163,6 +184,11 @@ class ContinuousBatchingEngine:
 
     # ---- public API ----
     def add_request(self, req: Request) -> int:
+        if self.max_queue is not None and len(self._queue) >= self.max_queue:
+            raise EngineSaturated(
+                f"engine queue at high-water mark ({self.max_queue} waiting, "
+                f"{sum(s is not None for s in self._slots)}/{self.max_batch} "
+                "slots busy) — shed load or scale out")
         if len(req.prompt) + req.max_new_tokens > self.max_len:
             raise ValueError(
                 f"prompt {len(req.prompt)} + max_new {req.max_new_tokens} "
@@ -177,6 +203,9 @@ class ContinuousBatchingEngine:
         if validate is not None:
             validate(len(req.prompt), len(req.prompt) + req.max_new_tokens)
         req._engine = weakref.ref(self)
+        import time as _time
+
+        req._enqueued_at = _time.monotonic()
         self._queue.append(req)
         return req.rid
 
@@ -211,6 +240,7 @@ class ContinuousBatchingEngine:
         at any workload."""
         import time as _time
 
+        self._evict_expired()
         if not any(s is not None for s in self._slots):
             t0 = _time.perf_counter()
             self._admit()
@@ -221,6 +251,42 @@ class ContinuousBatchingEngine:
         t0 = _time.perf_counter()
         self._admit()
         self.stats["admit_host_s"] += _time.perf_counter() - t0
+
+    def _evict_expired(self):
+        """Deadline enforcement: fail-and-free requests past ``deadline_s``
+        (active slots AND still-queued requests) so a straggler can neither
+        hog a slot forever nor hang its caller. Tokens already scheduled for
+        an evicted slot stay in the pending readbacks — ``tokens`` remains
+        complete up to the eviction point."""
+        import time as _time
+
+        now = _time.monotonic()
+
+        def expired(r):
+            return (r.deadline_s is not None and r._enqueued_at is not None
+                    and now - r._enqueued_at > r.deadline_s)
+
+        def fail(r):
+            r.done = True
+            r.failed = True
+            r.error = (f"deadline exceeded: {now - r._enqueued_at:.3f}s > "
+                       f"{r.deadline_s:.3f}s ({r._n_out} tokens scheduled)")
+            self._finished[r.rid] = r
+
+        for i, req in enumerate(self._slots):
+            if req is not None and expired(req):
+                fail(req)
+                self._slots[i] = None   # slot + its pages are free again
+                self._pos[i] = 0
+                self._temps[i] = 0.0
+        if any(expired(r) for r in self._queue):
+            keep = collections.deque()
+            for r in self._queue:
+                if expired(r):
+                    fail(r)
+                else:
+                    keep.append(r)
+            self._queue = keep
 
     def _decode_block(self):
         import time as _time

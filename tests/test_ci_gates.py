@@ -34,7 +34,8 @@ def test_manifest_counts_cover_reference_parity():
         "paddle.nn.functional": 156,
         "paddle.linalg": 46,
         "paddle.tensor_methods": 359,
-        "paddle.distributed": 67,
+        "paddle.distributed": 70,    # resilience PR: + resilience module,
+                                     # CheckpointCorruptionError, wait_async_save
         "paddle.optimizer": 17,
         "paddle.incubate.nn.functional": 23,
         "paddle.geometric": 11,
@@ -132,6 +133,75 @@ def test_graph_lint_gate_detects_seeded_defects():
         capture_output=True, text=True, env=env, cwd=ROOT, timeout=500)
     assert r2.returncode != 0
     assert "PT-SHAPE-001" in r2.stdout  # names op + code in the output
+
+
+def test_fault_drill_matrix():
+    """Resilience gate (docs/RESILIENCE.md): the seeded fault matrix —
+    heartbeat loss, store stall, shard corruption, engine saturation,
+    serving deadline — must be absorbed with recovery enabled AND flip the
+    exit code with recovery disabled. Runs in a subprocess (the drill
+    forces the pure-Python store daemon for server-side faults)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "fault_drill.py"),
+         "--selftest"],
+        capture_output=True, text=True, env=env, cwd=ROOT, timeout=500)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "FAULT DRILL OK: 5 fault classes" in r.stdout, r.stdout
+
+
+def test_fault_drill_single_drill_exit_codes():
+    """One end-to-end pin of the flip itself: store_stall passes with
+    recovery, fails with --no-recover (raise-on-first-EOF restored)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    drill = os.path.join(ROOT, "tools", "fault_drill.py")
+    r = subprocess.run([sys.executable, drill, "--drill", "store_stall"],
+                       capture_output=True, text=True, env=env, cwd=ROOT,
+                       timeout=200)
+    assert r.returncode == 0, r.stdout + r.stderr
+    r2 = subprocess.run([sys.executable, drill, "--drill", "store_stall",
+                         "--no-recover"],
+                        capture_output=True, text=True, env=env, cwd=ROOT,
+                        timeout=200)
+    assert r2.returncode != 0, r2.stdout + r2.stderr
+
+
+def test_bench_regression_gate_secondary_latency(tmp_path):
+    """Secondary-metric logic: serving p99 latency compared only when both
+    sides record it; >2x regression fails, absence passes vacuously."""
+    gate = os.path.join(ROOT, "tools", "check_bench_regression.py")
+    g2 = tmp_path / "tools" / "check_bench_regression.py"
+    g2.parent.mkdir(exist_ok=True)
+    g2.write_text(open(gate).read())
+    primary = {"metric": "llama_pretrain_tokens_per_sec_per_chip",
+               "value": 100.0, "unit": "tok/s", "vs_baseline": 1.0}
+
+    def run(baseline, fresh_lines):
+        (tmp_path / "BENCH_r01.json").write_text(json.dumps(baseline))
+        fresh = tmp_path / "fresh.txt"
+        fresh.write_text("\n".join(json.dumps(d) for d in fresh_lines) + "\n")
+        return subprocess.run([sys.executable, str(g2), str(fresh)],
+                              capture_output=True, text=True)
+
+    p99 = {"metric": "serving_p99_step_latency_ms", "value": 10.0,
+           "unit": "ms", "vs_baseline": None}
+    with_sec = {**primary, "secondary": {"serving_p99_step_latency_ms": p99}}
+    # both sides present, within 2x: OK
+    assert run(with_sec, [primary, {**p99, "value": 15.0}]).returncode == 0
+    # >2x latency regression: FAIL naming the metric
+    r = run(with_sec, [primary, {**p99, "value": 25.0}])
+    assert r.returncode == 1 and "serving_p99_step_latency_ms" in r.stdout
+    # baseline predates the metric: vacuous pass
+    assert run(primary, [primary, {**p99, "value": 25.0}]).returncode == 0
+    # fresh output dropped the metric: vacuous pass (guard, not a ratchet)
+    assert run(with_sec, [primary]).returncode == 0
+    # flat driver shape: the secondary baseline as its own BENCH_r*.json
+    # (older than the primary's file) must arm the guard too
+    (tmp_path / "BENCH_r00.json").write_text(json.dumps(p99))
+    r_flat = run(primary, [primary, {**p99, "value": 25.0}])
+    assert r_flat.returncode == 1
+    assert "serving_p99_step_latency_ms" in r_flat.stdout
+    assert run(primary, [primary, {**p99, "value": 12.0}]).returncode == 0
 
 
 def test_pip_installable_metadata():

@@ -1,0 +1,125 @@
+"""Serving-engine degradation: request deadlines (eviction, not hung
+slots), bounded-queue backpressure (EngineSaturated), and Request.tokens
+behavior around pending device readbacks."""
+
+import gc
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.serving import (ContinuousBatchingEngine,
+                                          EngineSaturated, Request)
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(21)
+    cfg = LlamaConfig.tiny(num_hidden_layers=1)
+    return cfg, LlamaForCausalLM(cfg)
+
+
+def _prompt(cfg, n, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+
+
+class TestDeadlines:
+    def test_deadline_eviction_keeps_other_slots_decoding(self, model):
+        cfg, m = model
+        eng = ContinuousBatchingEngine(m, max_batch=2, max_len=64, page_size=8)
+        fast = Request(_prompt(cfg, 4, 1), max_new_tokens=12)
+        doomed = Request(_prompt(cfg, 5, 2), max_new_tokens=30,
+                         deadline_s=0.15)
+        eng.add_request(fast)
+        eng.add_request(doomed)
+        eng.step()
+        time.sleep(0.2)
+        done = eng.run_until_done(max_steps=200)
+        assert doomed.failed and doomed.done
+        assert "deadline" in doomed.error
+        assert doomed.rid in done
+        assert len(doomed.tokens) < 30
+        # healthy slot untouched by the eviction
+        assert fast.done and not fast.failed
+        assert len(fast.tokens) == 12
+
+    def test_tokens_complete_after_deadline_eviction(self, model):
+        """Every token the engine *scheduled* before eviction is
+        materialized by .tokens — no silent truncation."""
+        cfg, m = model
+        eng = ContinuousBatchingEngine(m, max_batch=1, max_len=64, page_size=8)
+        r = Request(_prompt(cfg, 4, 3), max_new_tokens=24, deadline_s=0.05)
+        eng.add_request(r)
+        eng.step()                      # admit + first decode block
+        time.sleep(0.1)
+        eng.step()                      # deadline check -> eviction
+        assert r.failed
+        assert len(r.tokens) == r._n_out
+        assert r._n_out >= 1            # the prefill token was scheduled
+
+    def test_expired_in_queue_never_occupies_a_slot(self, model):
+        cfg, m = model
+        eng = ContinuousBatchingEngine(m, max_batch=1, max_len=32, page_size=8)
+        blocker = Request(_prompt(cfg, 4, 4), max_new_tokens=6)
+        queued = Request(_prompt(cfg, 4, 5), max_new_tokens=6,
+                         deadline_s=0.02)
+        eng.add_request(blocker)
+        eng.add_request(queued)
+        eng.step()                      # blocker takes the only slot
+        time.sleep(0.05)
+        eng.run_until_done(max_steps=100)
+        assert queued.failed and queued.done and queued.output == []
+        assert blocker.done and not blocker.failed
+        assert len(blocker.tokens) == 6
+
+
+class TestBackpressure:
+    def test_engine_saturated_at_high_water(self, model):
+        cfg, m = model
+        eng = ContinuousBatchingEngine(m, max_batch=1, max_len=32,
+                                       page_size=8, max_queue=2)
+        reqs = [Request(_prompt(cfg, 4, 10 + i), max_new_tokens=2)
+                for i in range(5)]
+        admitted, rejected = [], []
+        for r in reqs:
+            try:
+                eng.add_request(r)
+                admitted.append(r)
+            except EngineSaturated:
+                rejected.append(r)
+        assert len(admitted) == 2 and len(rejected) == 3
+        eng.run_until_done()
+        assert all(r.done and len(r.tokens) == 2 for r in admitted)
+        # a drained queue admits again
+        late = Request(_prompt(cfg, 4, 99), max_new_tokens=2)
+        eng.add_request(late)
+        eng.run_until_done()
+        assert late.done
+
+
+class TestTokensLifecycle:
+    def test_tokens_raises_when_engine_gcd_with_pending(self, model):
+        cfg, m = model
+        eng = ContinuousBatchingEngine(m, max_batch=1, max_len=32, page_size=8)
+        r = Request(_prompt(cfg, 4, 6), max_new_tokens=4)   # no eos -> async
+        eng.add_request(r)
+        while eng.has_work():
+            eng.step()
+        assert r.done and len(r.output) < r._n_out  # readbacks still pending
+        del eng
+        gc.collect()
+        with pytest.raises(RuntimeError, match="garbage-collected"):
+            r.tokens
+        assert r._n_out == 4
+
+    def test_tokens_drains_pending_while_engine_alive(self, model):
+        cfg, m = model
+        eng = ContinuousBatchingEngine(m, max_batch=1, max_len=32, page_size=8)
+        r = Request(_prompt(cfg, 4, 7), max_new_tokens=4)
+        eng.add_request(r)
+        while eng.has_work():
+            eng.step()
+        assert r.tokens == r.output and len(r.tokens) == 4

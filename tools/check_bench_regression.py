@@ -20,6 +20,14 @@ import sys
 
 PRIMARY = "llama_pretrain_tokens_per_sec_per_chip"
 
+# secondary guards, compared only when BOTH sides recorded them (so adding a
+# new metric never fails the gate retroactively). "lower" = smaller is
+# better. serving_p99_step_latency_ms is measured with request deadlines
+# enabled — it pins the resilience hooks (deadline scan, queue bookkeeping)
+# as overhead-neutral on the serving hot path; the generous 2x tolerance
+# guards against accidental O(n)/sync work, not CI jitter.
+SECONDARY = {"serving_p99_step_latency_ms": ("lower", 1.0)}
+
 
 def parse_lines(path):
     out = {}
@@ -75,8 +83,8 @@ def main():
         if a == "--tolerance":
             tol = float(sys.argv[i + 1])
     now = parse_lines(sys.argv[1])
-    base, base_path = last_recorded(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    base, base_path = last_recorded(root)
     if base is None:
         print("no recorded BENCH_r*.json baseline — gate passes vacuously")
         return 0
@@ -102,7 +110,55 @@ def main():
         print(f"FAIL: {PRIMARY} vs_baseline {cur_vs:.4f} < "
               f"{prev_vs:.4f} * (1 - {tol}) — perf regression")
         return 1
+    rc = check_secondary(base, now, root)
+    if rc:
+        return rc
     print(f"OK: {PRIMARY} vs_baseline {cur_vs:.4f} (baseline {prev_vs:.4f})")
+    return 0
+
+
+def recorded_secondary(root, base):
+    """Baselines for SECONDARY metrics, from either shape a driver may
+    record: a ``{"secondary": {name: record}}`` dict nested in the primary
+    baseline, or a flat per-metric object in any ``BENCH_r*.json`` (newest
+    file wins). Unparseable or foreign files are skipped — the primary
+    gate's own validation already covers the newest file."""
+    out = {}
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        try:
+            d = json.load(open(path))
+        except (OSError, json.JSONDecodeError):
+            continue
+        if isinstance(d, dict):
+            d = d.get("parsed", d)
+        if isinstance(d, dict) and d.get("metric") in SECONDARY:
+            out[d["metric"]] = d
+    nested = base.get("secondary") if isinstance(base, dict) else None
+    if isinstance(nested, dict):
+        out.update({k: v for k, v in nested.items() if isinstance(v, dict)})
+    return out
+
+
+def check_secondary(base, now, root):
+    """Guard-rail metrics (SECONDARY), compared only when both a recorded
+    baseline and the fresh output carry them — a metric that predates the
+    baseline passes vacuously."""
+    recorded = recorded_secondary(root, base)
+    for name, (direction, tol) in SECONDARY.items():
+        prev = recorded.get(name)
+        cur = now.get(name)
+        if not isinstance(prev, dict) or not isinstance(cur, dict):
+            continue
+        pv, cv = prev.get("value"), cur.get("value")
+        if pv is None or cv is None:
+            continue
+        worse = (cv > pv * (1.0 + tol) if direction == "lower"
+                 else cv < pv * (1.0 - tol))
+        if worse:
+            print(f"FAIL: secondary {name} {cv:.4g} vs baseline {pv:.4g} "
+                  f"(tolerance {tol:.0%}, {direction} is better)")
+            return 1
+        print(f"ok: secondary {name} {cv:.4g} (baseline {pv:.4g})")
     return 0
 
 

@@ -1,0 +1,421 @@
+"""Fault drill: prove every recovery path by injecting its fault.
+
+Each drill runs a small end-to-end scenario twice: with its recovery path
+enabled (the injected fault must be absorbed) and with it disabled (the
+same fault must flip the exit code). ``--selftest`` runs the whole seeded
+matrix — heartbeat loss, store stall, checkpoint shard corruption, serving
+engine saturation, serving deadline — and exits 0 iff every fault class
+recovers when enabled AND fails when its recovery is off. Recovery is
+proven by tests, not prayer (docs/RESILIENCE.md).
+
+Usage:
+    python tools/fault_drill.py --selftest
+    python tools/fault_drill.py --drill heartbeat            # expect exit 0
+    python tools/fault_drill.py --drill heartbeat --no-recover   # expect != 0
+
+Faults come from seeded, step-indexed FaultPlans
+(paddle_tpu/distributed/resilience/faults.py), so every run injects the
+same faults at the same events.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+import threading
+import time
+
+# pure-Python store daemon so server-side faults (store.daemon stalls) are
+# real, not simulated; CPU jax with 8 host devices for the elastic meshes
+os.environ["PT_DISABLE_NATIVE"] = "1"
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags +
+                               " --xla_force_host_platform_device_count=8")
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+# ---------------------------------------------------------------------------
+# shared fixtures
+# ---------------------------------------------------------------------------
+
+def _toy_model(d=8):
+    import paddle_tpu as paddle
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.nn.layer.layers import Layer
+
+    class Toy(Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = paddle.nn.Linear(d, d)
+
+        def loss_fn(self, x, y):
+            out = self.fc(Tensor(x))
+            diff = out._data - y
+            return (diff * diff).mean()
+
+    return Toy()
+
+
+_SERVING = {}
+
+
+def _serving_model():
+    """One tiny llama shared by the serving drills (build once)."""
+    if "model" not in _SERVING:
+        import paddle_tpu as paddle
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+        paddle.seed(11)
+        cfg = LlamaConfig.tiny(num_hidden_layers=1)
+        _SERVING["model"] = (cfg, LlamaForCausalLM(cfg))
+    return _SERVING["model"]
+
+
+# ---------------------------------------------------------------------------
+# drill: heartbeat loss -> elastic save/reshard/resume
+# ---------------------------------------------------------------------------
+
+def drill_heartbeat(recover: bool):
+    """2-node elastic run loses its peer mid-run. Recovery = detect the
+    stale heartbeat, checkpoint, rebuild the mesh over the survivor,
+    resume at the recorded step; the final loss must match an uninterrupted
+    run (deterministic per-step data => replay-exact trajectory)."""
+    import numpy as np
+    import jax
+    from jax.sharding import Mesh
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.auto_parallel import Engine
+    from paddle_tpu.distributed.communication.store import TCPStore
+    from paddle_tpu.distributed.fleet.elastic import ElasticManager
+    from paddle_tpu.distributed.resilience import (FaultPlan, FaultSpec,
+                                                   ResilientTrainer)
+
+    D, B, STEPS = 8, 8, 8
+
+    def data_fn(step):
+        rng = np.random.default_rng(1000 + step)
+        return (rng.standard_normal((B, D)).astype(np.float32),
+                rng.standard_normal((B, D)).astype(np.float32))
+
+    def build(alive):
+        n = 8 if len(alive) >= 2 else 4
+        mesh = Mesh(np.array(jax.devices()[:n]), ("dp",))
+        paddle.seed(0)
+        return Engine(_toy_model(D), mesh, lr=0.05, clip_norm=None)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        # uninterrupted reference trajectory (2-node mesh, no faults)
+        ref = ResilientTrainer(lambda alive: build(["a", "b"]),
+                               os.path.join(tmp, "ref"), elastic=None,
+                               save_every=100, async_save=False
+                               ).fit(data_fn, STEPS)
+        ref_final = ref["losses"][STEPS]
+
+        store = TCPStore("127.0.0.1", 0, is_master=True, world_size=1,
+                         timeout=20.0)
+        store_b = TCPStore("127.0.0.1", store.port, world_size=1,
+                           timeout=20.0)
+        plan = FaultPlan(seed=7, specs=[
+            FaultSpec("elastic.heartbeat", "kill", at=3, count=-1,
+                      match="nodeB")])
+        mgr_b = ElasticManager(store_b, "drill", "nodeB",
+                               expected=["nodeA", "nodeB"],
+                               heartbeat_interval=0.1, ttl=0.45)
+        mgr_a = ElasticManager(store, "drill", "nodeA",
+                               expected=["nodeA", "nodeB"],
+                               heartbeat_interval=0.1, ttl=0.45) \
+            if recover else None
+        b_stop = threading.Event()
+
+        def node_b_loop():
+            i = 0
+            while not b_stop.is_set():
+                if mgr_b._thread is None or not mgr_b._thread.is_alive():
+                    return              # heartbeat killed -> node is dead
+                if i >= 3:
+                    # deterministic backstop: whatever the thread-scheduling
+                    # weather, node B is dead by step 3 — its lease counter
+                    # stops advancing and it leaves the per-step barriers,
+                    # so A's recovery path MUST engage (wall-clock-only
+                    # death made this drill flake under heavy CI load)
+                    mgr_b.stop()
+                    return
+                try:
+                    store_b.barrier(f"g2s{i}", world_size=2, timeout=3.0)
+                except Exception:
+                    return
+                i += 1
+
+        def coop_data_fn(step):
+            # the job's per-step cross-node sync: a dead peer turns this
+            # into a timeout — exactly how peer loss surfaces in real runs
+            ws = len(mgr_a.expected) if mgr_a is not None else 2
+            if ws > 1:
+                store.barrier(f"g2s{step}", world_size=ws, timeout=1.5)
+            time.sleep(0.05)
+            return data_fn(step)
+
+        plan.install()
+        try:
+            mgr_b.start()
+            if mgr_a is not None:
+                mgr_a.start()
+            b_thread = threading.Thread(target=node_b_loop, daemon=True)
+            b_thread.start()
+            trainer = ResilientTrainer(build, os.path.join(tmp, "job"),
+                                       elastic=mgr_a, save_every=2)
+            try:
+                out = trainer.fit(coop_data_fn, STEPS)
+            except Exception as e:
+                return False, f"run died without recovery: {type(e).__name__}: {e}"
+            finally:
+                b_stop.set()
+                if mgr_a is not None:
+                    mgr_a.stop()
+                mgr_b.stop()
+        finally:
+            plan.uninstall()
+            store_b.close()
+            store.close()
+        if out["restarts"] < 1:
+            return False, "peer loss never detected (no restart)"
+        final = out["losses"][STEPS]
+        if not np.allclose(final, ref_final, rtol=1e-3):
+            return (False, f"post-resume trajectory diverged: {final} vs "
+                    f"uninterrupted {ref_final}")
+        return True, (f"peer lost, resumed at step {out['resumed_at']}, "
+                      f"final loss {final:.6f} == uninterrupted {ref_final:.6f}")
+
+
+# ---------------------------------------------------------------------------
+# drill: store stall -> retry/timeout/backoff
+# ---------------------------------------------------------------------------
+
+def drill_store_stall(recover: bool):
+    """The store daemon stalls one op past the client's op deadline.
+    Recovery = socket timeout -> reconnect -> retry (PT-RETRY policy);
+    without retry the first stalled op raises."""
+    from paddle_tpu.distributed.communication.store import TCPStore
+    from paddle_tpu.distributed.resilience import FaultPlan, FaultSpec
+
+    plan = FaultPlan(seed=3, specs=[
+        FaultSpec("store.daemon", "stall", at=2, count=1, arg=1.2)])
+    prev = os.environ.get("PT_RETRY_DISABLE")
+    if not recover:
+        os.environ["PT_RETRY_DISABLE"] = "1"
+    store = TCPStore("127.0.0.1", 0, is_master=True, world_size=1,
+                     timeout=10.0, op_timeout=0.4)
+    try:
+        with plan:
+            for i in range(6):
+                store.set(f"k{i}", str(i).encode())
+                got = store.get(f"k{i}", wait=False)
+                if got != str(i).encode():
+                    return False, f"k{i}: got {got!r}"
+        stalled = [e for e in plan.log if e[2] == "stall"]
+        if not stalled:
+            return False, "fault never fired"
+        return True, f"rode through daemon stall at {stalled[0][1]!r}"
+    except Exception as e:
+        return False, f"store op failed: {type(e).__name__}: {e}"
+    finally:
+        store.close()
+        if prev is None:
+            os.environ.pop("PT_RETRY_DISABLE", None)
+        else:
+            os.environ["PT_RETRY_DISABLE"] = prev
+
+
+# ---------------------------------------------------------------------------
+# drill: checkpoint shard corruption -> checksum detect + replica recover
+# ---------------------------------------------------------------------------
+
+def drill_shard_corruption(recover: bool):
+    """A shard is truncated on disk after its digests were recorded.
+    Recovery = load-time verification raises CheckpointCorruptionError
+    *naming the shard*, and a replica copy restores the data. With
+    verification off the corruption surfaces as an untyped decoder error
+    (or silently wrong weights)."""
+    import numpy as np
+
+    from paddle_tpu.distributed.checkpoint import (CheckpointCorruptionError,
+                                                   load_state_dict,
+                                                   save_state_dict)
+    from paddle_tpu.distributed.resilience import FaultPlan, FaultSpec
+
+    w = np.arange(4096, dtype=np.float32)
+
+    def fault():
+        return FaultPlan(seed=5, specs=[
+            FaultSpec("checkpoint.shard", "truncate", at=0, count=1, arg=64)])
+
+    with tempfile.TemporaryDirectory() as tmp:
+        p1 = os.path.join(tmp, "c1")
+        with fault():
+            save_state_dict({"w": w}, p1)
+        target = {"w": np.zeros_like(w)}
+        if not recover:
+            try:
+                load_state_dict(target, p1, verify=False)
+            except CheckpointCorruptionError:
+                return True, "unexpected: typed error with verification off"
+            except Exception as e:
+                return (False, "verification off: untyped failure "
+                        f"{type(e).__name__} (shard not named)")
+            if np.array_equal(np.asarray(target["w"]), w):
+                return False, "truncated shard read back clean?!"
+            return False, "corrupt shard loaded silently"
+        try:
+            load_state_dict(target, p1)
+            return False, "corruption not detected"
+        except CheckpointCorruptionError as e:
+            if "0_0.distcp" not in str(e):
+                return False, f"bad shard not named: {e}"
+            detected = str(e)
+        # replica copy -> transparent recovery
+        p2 = os.path.join(tmp, "c2")
+        with fault():
+            save_state_dict({"w": w}, p2, replica=True)
+        target2 = {"w": np.zeros_like(w)}
+        load_state_dict(target2, p2)
+        if not np.array_equal(np.asarray(target2["w"]), w):
+            return False, "replica recovery returned wrong data"
+        return True, f"detected ({detected.split(':')[0]}), replica recovered"
+
+
+# ---------------------------------------------------------------------------
+# drill: serving engine saturation -> bounded-queue backpressure
+# ---------------------------------------------------------------------------
+
+def drill_engine_saturation(recover: bool):
+    """Admission flood past the queue high-water mark. Recovery =
+    EngineSaturated backpressure keeps the queue bounded while admitted
+    requests decode to completion; without it the queue grows unbounded."""
+    import numpy as np
+
+    from paddle_tpu.inference.serving import (ContinuousBatchingEngine,
+                                              EngineSaturated, Request)
+
+    cfg, m = _serving_model()
+    eng = ContinuousBatchingEngine(m, max_batch=1, max_len=32, page_size=8,
+                                   max_queue=2 if recover else None)
+    rng = np.random.default_rng(0)
+    reqs = [Request(rng.integers(0, cfg.vocab_size, (4,)).astype(np.int32),
+                    max_new_tokens=2) for _ in range(6)]
+    admitted, rejected = [], 0
+    for r in reqs:
+        try:
+            eng.add_request(r)
+            admitted.append(r)
+        except EngineSaturated:
+            rejected += 1
+    depth = len(eng._queue)
+    eng.run_until_done()
+    if rejected == 0:
+        return False, f"no backpressure: queue grew to {depth}"
+    if depth > 2:
+        return False, f"queue exceeded high-water mark: {depth}"
+    bad = [r.rid for r in admitted
+           if not r.done or r.failed or len(r.tokens) != 2]
+    if bad:
+        return False, f"admitted requests did not complete: {bad}"
+    return True, (f"{rejected} rejected at high-water 2, "
+                  f"{len(admitted)} admitted all completed")
+
+
+# ---------------------------------------------------------------------------
+# drill: serving deadline -> eviction, not a hung slot
+# ---------------------------------------------------------------------------
+
+def drill_serving_deadline(recover: bool):
+    """One slot's request exceeds its deadline mid-decode. Recovery = the
+    slot is evicted and the request reported failed while the other slot
+    keeps decoding to completion."""
+    import numpy as np
+
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine, Request
+
+    cfg, m = _serving_model()
+    eng = ContinuousBatchingEngine(m, max_batch=2, max_len=64, page_size=8)
+    rng = np.random.default_rng(1)
+    fast = Request(rng.integers(0, cfg.vocab_size, (4,)).astype(np.int32),
+                   max_new_tokens=12)
+    doomed = Request(rng.integers(0, cfg.vocab_size, (5,)).astype(np.int32),
+                     max_new_tokens=30,
+                     deadline_s=0.15 if recover else None)
+    eng.add_request(fast)
+    eng.add_request(doomed)
+    eng.step()
+    time.sleep(0.2)                     # doomed's deadline expires mid-run
+    eng.run_until_done(max_steps=200)
+    if not recover:
+        if doomed.failed:
+            return True, "unexpected: evicted without a deadline"
+        return False, ("no deadline: slow request ran to completion "
+                       f"({len(doomed.tokens)} tokens), slot hogged")
+    if not doomed.failed or not doomed.done:
+        return False, "deadline-exceeded request not marked failed"
+    if doomed.error is None or "deadline" not in doomed.error:
+        return False, f"failure not attributed to deadline: {doomed.error!r}"
+    if len(doomed.tokens) >= 30:
+        return False, "evicted request decoded to completion anyway"
+    if fast.failed or not fast.done or len(fast.tokens) != 12:
+        return False, "healthy slot disturbed by the eviction"
+    return True, (f"evicted after {len(doomed.tokens)} tokens "
+                  f"({doomed.error}); other slot finished 12/12")
+
+
+DRILLS = {
+    "heartbeat": drill_heartbeat,
+    "store_stall": drill_store_stall,
+    "shard_corruption": drill_shard_corruption,
+    "engine_saturation": drill_engine_saturation,
+    "serving_deadline": drill_serving_deadline,
+}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--drill", choices=sorted(DRILLS))
+    ap.add_argument("--no-recover", action="store_true",
+                    help="disable the drill's recovery path (must flip rc)")
+    ap.add_argument("--selftest", action="store_true",
+                    help="run the full matrix, both recovery modes")
+    args = ap.parse_args(argv)
+
+    if args.selftest:
+        failures = 0
+        for name, drill in DRILLS.items():
+            ok, info = drill(recover=True)
+            print(f"[{'ok' if ok else 'FAIL'}] {name} (recovery on): {info}")
+            if not ok:
+                failures += 1
+            ok2, info2 = drill(recover=False)
+            print(f"[{'ok' if not ok2 else 'FAIL'}] {name} (recovery off, "
+                  f"fault must bite): {info2}")
+            if ok2:
+                failures += 1
+        if failures:
+            print(f"FAULT DRILL FAIL: {failures} expectation(s) violated")
+            return 1
+        print(f"FAULT DRILL OK: {len(DRILLS)} fault classes recovered, "
+              "each flips the gate without its recovery path")
+        return 0
+
+    if not args.drill:
+        print(__doc__)
+        return 2
+    ok, info = DRILLS[args.drill](recover=not args.no_recover)
+    print(f"[{'ok' if ok else 'FAIL'}] {args.drill}: {info}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
