@@ -443,6 +443,60 @@ def bench_moe(dev, on_tpu):
           f"loss {float(loss):.3f}, activated-mfu {mfu:.3f})", None)
 
 
+def bench_guard(dev, on_tpu):
+    """Numeric-guard overhead: guarded vs unguarded fused train step.
+
+    The guard adds one on-device health word (aggregated nan/inf reductions
+    + EMA spike state) and a scalar-predicated zero-apply to the jitted
+    step — docs/NUMERIC_GUARD.md budgets it at noise level. Interleaved
+    best-of-3 (same discipline as bench_serving) so chip-state drift hits
+    both variants equally; guarded as a secondary gate in
+    tools/check_bench_regression.py."""
+    import jax
+
+    from paddle_tpu.distributed.auto_parallel import Engine
+    from paddle_tpu.framework.numeric_guard import GuardPolicy
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_hidden_layers=8, num_attention_heads=16,
+            num_key_value_heads=4, max_position_embeddings=2048,
+            dtype="bfloat16")
+        batch, seq, iters = 8, 1024, 8
+    else:
+        cfg = LlamaConfig.tiny()
+        batch, seq, iters = 2, 32, 4
+    rng = np.random.default_rng(0)
+    batches = [rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+               for _ in range(iters)]
+
+    def make(guard):
+        eng = Engine(LlamaForCausalLM(cfg), mesh=None, lr=1e-4,
+                     clip_norm=1.0, guard=guard)
+        jax.device_get(eng.step(batches[0], batches[0]))   # compile
+        return eng
+
+    def wave(eng):
+        t0 = time.perf_counter()
+        for ids in batches:
+            loss = eng.step(ids, ids)
+        jax.device_get(loss)
+        return time.perf_counter() - t0
+
+    plain, guarded = make(None), make(GuardPolicy())
+    dt_plain = dt_guard = float("inf")
+    for _ in range(3):
+        dt_plain = min(dt_plain, wave(plain))
+        dt_guard = min(dt_guard, wave(guarded))
+    pct = (dt_guard - dt_plain) / dt_plain * 100.0
+    n_params = cfg.num_params()
+    _emit("guard_overhead_pct", pct,
+          f"% (guarded vs unguarded fused step, llama {n_params/1e6:.0f}M "
+          f"seq{seq} batch {batch}, {iters} steps best-of-3)", None)
+
+
 def main():
     import jax
 
@@ -482,6 +536,11 @@ def main():
         bench_moe(dev, on_tpu)
     except Exception as e:
         print(f"# moe bench failed: {e!r}", flush=True)
+    gc.collect()
+    try:
+        bench_guard(dev, on_tpu)
+    except Exception as e:
+        print(f"# guard bench failed: {e!r}", flush=True)
     gc.collect()
 
     if on_tpu:

@@ -12,13 +12,28 @@ from ..core.tensor import Tensor
 
 
 def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
-    """Check a tensor for nan/inf (reference: debugging.py check_numerics)."""
+    """Check a tensor for nan/inf (reference: debugging.py check_numerics).
+
+    Detections report into the shared numeric health word
+    (framework/numeric_guard.py: NAN_GRAD / INF_GRAD bits, PT-NUM-001/002)
+    and then abort or warn per ``debug_mode`` (falling back to the
+    ``check_nan_inf_level`` flag the tensor checker sets): ABORT raises a
+    FloatingPointError naming the op and var; CHECK_NAN_INF warns."""
     arr = tensor._data if isinstance(tensor, Tensor) else tensor
     num_nan = int(jnp.isnan(arr).sum())
     num_inf = int(jnp.isinf(arr).sum())
     if num_nan or num_inf:
+        from ..framework import numeric_guard
+
+        numeric_guard.report_nan_inf(num_nan, num_inf,
+                                     source=f"{op_type}:{var_name}")
         msg = f"[check_numerics] op={op_type} var={var_name}: {num_nan} nan, {num_inf} inf"
-        if flags.get_flag("check_nan_inf_level") == 0:
+        mode = debug_mode
+        if mode is None:
+            mode = (DebugMode.CHECK_NAN_INF_AND_ABORT
+                    if flags.get_flag("check_nan_inf_level") == 0
+                    else DebugMode.CHECK_NAN_INF)
+        if mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
             raise FloatingPointError(msg)
         import warnings
 
@@ -52,12 +67,43 @@ class DebugMode:
     CHECK_ALL = 4
 
 
+_checker_config = None
+_saved_level = None
+
+
 def enable_tensor_checker(checker_config=None):
-    flags.set_flags({"check_nan_inf": True})
+    """Arm the eager-dispatch nan/inf checker per the config's debug mode:
+    CHECK_NAN_INF_AND_ABORT raises on the first anomalous op output (the
+    error names the op), CHECK_NAN_INF warns and keeps going; both report
+    into the shared numeric health word."""
+    global _checker_config, _saved_level
+    cfg = checker_config if checker_config is not None else TensorCheckerConfig()
+    if not cfg.enable:
+        disable_tensor_checker()
+        return
+    if _checker_config is None:     # stash the pre-checker level once
+        _saved_level = flags.get_flag("check_nan_inf_level")
+    _checker_config = cfg
+    level = (0 if cfg.debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT else 1)
+    flags.set_flags({"check_nan_inf": True, "check_nan_inf_level": level})
 
 
 def disable_tensor_checker():
-    flags.set_flags({"check_nan_inf": False})
+    """Disarm the checker and restore the pre-enable ``check_nan_inf_level``
+    — a warn-mode checker must not permanently downgrade later direct
+    check_numerics calls from raise to warn."""
+    global _checker_config, _saved_level
+    _checker_config = None
+    restore = {"check_nan_inf": False}
+    if _saved_level is not None:
+        restore["check_nan_inf_level"] = _saved_level
+        _saved_level = None
+    flags.set_flags(restore)
+
+
+def tensor_checker_config():
+    """The active TensorCheckerConfig (None when the checker is off)."""
+    return _checker_config
 
 
 class TensorCheckerConfig:

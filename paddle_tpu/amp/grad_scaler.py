@@ -31,14 +31,25 @@ class AmpScaler:
         return var * self._scale
 
     def _unscale_and_check(self, optimizer):
-        self._found_inf = False
+        """Unscale every grad and set ``found_inf`` with ONE aggregated
+        check: per-tensor finiteness reductions stay on device and fold
+        into a single scalar — one host sync for the whole parameter list,
+        not a round-trip per parameter. A detected overflow reports the
+        OVERFLOW bit into the shared numeric health word (PT-NUM-005)."""
+        flags = []
         for p in optimizer._parameter_list or []:
             if p.grad is None:
                 continue
             g = p.grad._data.astype(jnp.float32) / self._scale
-            if not bool(jnp.isfinite(g).all()):
-                self._found_inf = True
+            flags.append(jnp.logical_not(jnp.isfinite(g).all()))
             p.grad._data = g.astype(p.grad.dtype)
+        found = bool(jnp.stack(flags).any()) if flags else False
+        self._found_inf = found
+        if found:
+            from ..framework import numeric_guard
+
+            numeric_guard.record_health(numeric_guard.OVERFLOW,
+                                        source="amp.grad_scaler")
 
     def minimize(self, optimizer, loss):
         loss.backward()

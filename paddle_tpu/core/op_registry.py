@@ -81,15 +81,25 @@ def _amp_cast_preserving_graph(a: Tensor, tgt):
 
 
 def _check_nan_inf(name, arrays):
+    num_nan = num_inf = 0
     for a in arrays:
         if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating):
             if not bool(jnp.isfinite(a).all()):
-                msg = f"Operator {name} output contains NaN/Inf"
-                if flags.get_flag("check_nan_inf_level") == 0:
-                    raise FloatingPointError(msg)
-                import warnings
+                num_nan += int(jnp.isnan(a).sum())
+                num_inf += int(jnp.isinf(a).sum())
+    if num_nan or num_inf:
+        # report into the shared numeric health word (PT-NUM-001/002) so
+        # eager detections land beside the jitted guard's and AMP's
+        from ..framework import numeric_guard
 
-                warnings.warn(msg)
+        numeric_guard.report_nan_inf(num_nan, num_inf, source=f"op:{name}")
+        msg = (f"Operator {name} output contains {num_nan} nan / "
+               f"{num_inf} inf values")
+        if flags.get_flag("check_nan_inf_level") == 0:
+            raise FloatingPointError(msg)
+        import warnings
+
+        warnings.warn(msg)
 
 
 def apply(name: str, *args, **kwargs):

@@ -6,12 +6,18 @@ rules and reshard functions; fleet engines become shard_map programs.
 """
 
 from . import checkpoint, fleet, ps, resilience, rpc, sharding, utils  # noqa: F401
+from ..framework.numeric_guard import (  # noqa: F401
+    BadBatchRecorder,
+    GuardPolicy,
+    NumericAnomalyError,
+)
 from .checkpoint import (  # noqa: F401
     CheckpointCorruptionError,
     load_state_dict,
     save_state_dict,
     wait_async_save,
 )
+from .resilience import NumericWatchdog  # noqa: F401
 from .auto_parallel import (  # noqa: F401
     Partial,
     Placement,

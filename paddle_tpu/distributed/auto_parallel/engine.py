@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ...core.tensor import Tensor
+from ...framework import numeric_guard
 from ...nn.layer.layers import Layer
 from .logical_sharding import (
     DEFAULT_RULES,
@@ -96,6 +97,7 @@ class Engine:
         pp_remat_policy="auto",
         optimizer=None,
         abstract_state: bool = False,
+        guard=None,
     ):
         self.model = model
         self.mesh = mesh if mesh is not None else current_mesh()
@@ -248,6 +250,24 @@ class Engine:
         self._jit_step = None
         self._jit_loss = None
 
+        # --- numeric guard (framework/numeric_guard.py): checkify-style
+        # health word computed inside the jitted step; the host reads ONE
+        # aggregated int32 scalar per step (rides the loss's sync).
+        self.guard = guard
+        self.guard_state = None
+        self.last_health = None     # int32 device scalar after each step
+        self.lr_scale = 1.0         # LR re-warm multiplier (watchdog-driven)
+        self._host_step = 0         # host mirror of step_count (fault detail)
+        if guard is not None:
+            if optimizer is not None:
+                raise ValueError(
+                    "numeric guard supports the built-in AdamW path only "
+                    "(pass guard=None with a pluggable optimizer)")
+            state = numeric_guard.guard_init_state()
+            if self.mesh is not None:
+                state = jax.device_put(state, NamedSharding(self.mesh, P()))
+            self.guard_state = state
+
     # ---- pluggable-optimizer state ----
     def _init_opt_state(self):
         """Discover the optimizer's accumulator pytree and materialize it sharded.
@@ -368,9 +388,11 @@ class Engine:
             out = fn(input_ids, labels)
         return out._data if isinstance(out, Tensor) else out
 
-    def _adamw(self, params, m, v, grads, step):
+    def _adamw(self, params, m, v, grads, step, lr_scale=None):
         b1, b2, eps, wd = self.beta1, self.beta2, self.epsilon, self.weight_decay
         lr = self.lr(step) if callable(self.lr) else self.lr
+        if lr_scale is not None:
+            lr = lr * lr_scale      # post-rollback re-warm (traced scalar arg)
         stepf = step.astype(jnp.float32)
         bc1 = 1.0 - b1 ** stepf
         bc2 = 1.0 - b2 ** stepf
@@ -406,6 +428,57 @@ class Engine:
             kw["out_shardings"] = (sh, sh, sh, rep, rep)
         if self._donate:
             kw["donate_argnums"] = (0, 1, 2, 3)
+        return jax.jit(train_step, **kw)
+
+    def _build_guard_step(self):
+        """Guarded train step: same fused fwd/bwd/clip/AdamW program plus a
+        checkify-style health word (one int32 scalar, no per-tensor host
+        syncs) and an in-graph zero-apply — an anomalous step advances the
+        step counter but leaves params and optimizer moments untouched.
+
+        ``inject`` (faults.numeric_inject_code) and ``lr_scale`` (re-warm)
+        arrive as traced scalars, so neither fault drills nor the warmup
+        ramp ever retrace."""
+        pol = self.guard
+        skip_mask = pol.skip_mask
+        ng = numeric_guard
+
+        def train_step(params, m, v, step, gstate, input_ids, labels,
+                       inject, lr_scale):
+            step = step + 1
+
+            def lossf(ps):
+                l = self._pure_loss(ps, input_ids, labels)
+                spike = jnp.where(inject == ng.INJECT_LOSS_SPIKE,
+                                  ng.SPIKE_INJECT_FACTOR, 1.0)
+                return (l.astype(jnp.float32) * spike).astype(l.dtype)
+
+            loss, grads = jax.value_and_grad(lossf)(params)
+            nan = jnp.where(inject == ng.INJECT_NAN_GRAD,
+                            jnp.float32(jnp.nan), jnp.float32(0.0))
+            grads = [g + nan.astype(g.dtype) for g in grads]
+            word, new_state = ng.guard_step(
+                loss, grads, gstate, spike_factor=pol.spike_factor,
+                warmup_steps=pol.warmup_steps)
+            new_p, new_m, new_v = self._adamw(params, m, v, grads, step,
+                                              lr_scale)
+            bad = (word & skip_mask) != 0
+
+            def pick(news, olds):
+                return [jnp.where(bad, o, n) for n, o in zip(news, olds)]
+
+            return (pick(new_p, params), pick(new_m, m), pick(new_v, v),
+                    step, new_state, loss, word)
+
+        kw = {}
+        if self.mesh is not None:
+            sh = self._shardings
+            bsh = _batch_sharding(self.mesh)
+            rep = NamedSharding(self.mesh, P())
+            kw["in_shardings"] = (sh, sh, sh, rep, rep, bsh, bsh, rep, rep)
+            kw["out_shardings"] = (sh, sh, sh, rep, rep, rep, rep)
+        if self._donate:
+            kw["donate_argnums"] = (0, 1, 2, 3, 4)
         return jax.jit(train_step, **kw)
 
     def _build_opt_step(self):
@@ -459,6 +532,21 @@ class Engine:
                 "execute — use _build_step().lower(...) instead")
         ids = input_ids._data if isinstance(input_ids, Tensor) else jnp.asarray(input_ids)
         lbl = labels._data if isinstance(labels, Tensor) else jnp.asarray(labels)
+        if self.guard is not None:
+            if self._jit_step is None:
+                self._jit_step = self._build_guard_step()
+            self._host_step += 1
+            from ..resilience.faults import numeric_inject_code
+
+            inject = numeric_inject_code(str(self._host_step))
+            (self.params, self.m, self.v, self.step_count, self.guard_state,
+             loss, health) = self._jit_step(
+                self.params, self.m, self.v, self.step_count,
+                self.guard_state, ids, lbl,
+                jnp.asarray(inject, jnp.int32),
+                jnp.asarray(self.lr_scale, jnp.float32))
+            self.last_health = health
+            return loss
         if self._optimizer is not None:
             if self._jit_step is None:
                 self._jit_step = self._build_opt_step()

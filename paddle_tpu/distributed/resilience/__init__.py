@@ -18,6 +18,8 @@ from .faults import (  # noqa: F401
     active_plan,
     corrupt,
     maybe_inject,
+    numeric_inject_code,
+    poison_arrays,
 )
 from .retry import (  # noqa: F401
     DEFAULT_POLICY,
@@ -26,12 +28,16 @@ from .retry import (  # noqa: F401
     retries_disabled,
     retry_call,
 )
+# eager is safe here: the watchdog consumes framework.numeric_guard, which
+# is numpy+stdlib only — no jax/Engine import at load (unlike the trainer)
+from .watchdog import NumericWatchdog  # noqa: F401
 
 __all__ = [
     "FaultInjected", "FaultPlan", "FaultSpec", "active_plan", "corrupt",
-    "maybe_inject", "DEFAULT_POLICY", "RetryError", "RetryPolicy",
+    "maybe_inject", "numeric_inject_code", "poison_arrays",
+    "DEFAULT_POLICY", "RetryError", "RetryPolicy",
     "retries_disabled", "retry_call", "ResilientTrainer",
-    "CheckpointCorruptionError", "EngineSaturated",
+    "NumericWatchdog", "CheckpointCorruptionError", "EngineSaturated",
 ]
 
 

@@ -19,6 +19,12 @@ consulted by production code through two hooks:
   RNG), ``truncate`` (drops the last ``arg`` bytes), ``garbage`` (replaces
   the payload with seeded random bytes of the same length).
 
+- :func:`numeric_inject_code` / :func:`poison_arrays` — numeric faults
+  (PR-3, docs/NUMERIC_GUARD.md): ``nan_grad`` and ``loss_spike`` resolve to
+  an in-graph injection code the guarded Engine step consumes as a traced
+  scalar (no retrace, detectable only by the on-device health word);
+  ``poison_batch`` NaNs seeded positions of the host batch before it ships.
+
 Known sites (see docs/RESILIENCE.md for the catalogue):
 
 ====================  =====================================================
@@ -28,6 +34,8 @@ Known sites (see docs/RESILIENCE.md for the catalogue):
 ``checkpoint.shard``  shard bytes about to be written (detail = file name)
 ``collective``        blocking collective entry (detail = op name)
 ``rpc.connect``       before an rpc client connection (detail = worker)
+``numeric.step``      guarded Engine train step (detail = host step index)
+``data.batch``        trainer data path, batch about to ship (detail = step)
 ====================  =====================================================
 
 With no plan installed every hook is a cheap no-op (one global read), so
@@ -43,7 +51,7 @@ import time
 from typing import List, Optional, Sequence
 
 __all__ = ["FaultSpec", "FaultPlan", "FaultInjected", "maybe_inject",
-           "corrupt", "active_plan"]
+           "corrupt", "active_plan", "numeric_inject_code", "poison_arrays"]
 
 
 class FaultInjected(ConnectionError):
@@ -66,11 +74,13 @@ class FaultSpec:
 
     _CONTROL = ("kill", "stall", "delay", "error")
     _DATA = ("bitflip", "truncate", "garbage")
+    _NUMERIC = ("nan_grad", "loss_spike", "poison_batch")
 
     def __post_init__(self):
-        if self.action not in self._CONTROL + self._DATA:
-            raise ValueError(f"unknown fault action {self.action!r} "
-                             f"(choose: {self._CONTROL + self._DATA})")
+        if self.action not in self._CONTROL + self._DATA + self._NUMERIC:
+            raise ValueError(
+                f"unknown fault action {self.action!r} "
+                f"(choose: {self._CONTROL + self._DATA + self._NUMERIC})")
 
 
 class FaultPlan:
@@ -182,3 +192,56 @@ def corrupt(site: str, detail: str, data: bytes) -> bytes:
         elif s.action == "error":
             raise RuntimeError(f"fault injected: error at {site} ({detail})")
     return data
+
+
+def numeric_inject_code(detail: str = "") -> int:
+    """Numeric hook consulted by the guarded Engine step, once per step.
+
+    Resolves the ``numeric.step`` site's due specs to an in-graph injection
+    code (framework.numeric_guard INJECT_*): ``nan_grad`` -> 1 poisons every
+    gradient with NaN, ``loss_spike`` -> 2 scales the loss (and therefore
+    the gradients) by SPIKE_INJECT_FACTOR *inside* the differentiated
+    function. The code rides into jit as a traced scalar — injection never
+    recompiles and is observable only through the health word, exactly like
+    a real anomaly. No plan -> 0 (one global read)."""
+    plan = _ACTIVE
+    if plan is None:
+        return 0
+    for s in plan.fire("numeric.step", detail):
+        if s.action == "nan_grad":
+            return 1
+        if s.action == "loss_spike":
+            return 2
+    return 0
+
+
+def poison_arrays(detail, arrays):
+    """Data-plane numeric hook: apply due ``poison_batch`` specs to a host
+    batch (tuple of numpy arrays) before it ships to the device.
+
+    NaNs ``arg`` seeded positions (default 1%% of elements, at least one)
+    in each floating array — integer arrays (token ids) pass through
+    untouched. Returns the batch unchanged when no plan is installed."""
+    plan = _ACTIVE
+    if plan is None:
+        return arrays
+    due = [s for s in plan.fire("data.batch", str(detail))
+           if s.action == "poison_batch"]
+    if not due:
+        return arrays
+    import numpy as np
+
+    out = []
+    for a in arrays:
+        a = np.asarray(a)
+        if not np.issubdtype(a.dtype, np.floating) or a.size == 0:
+            out.append(a)
+            continue
+        a = np.array(a, copy=True)
+        flat = a.reshape(-1)
+        for s in due:
+            n = int(s.arg) or max(1, flat.size // 100)
+            for _ in range(n):
+                flat[plan.rng.randrange(flat.size)] = np.nan
+        out.append(a)
+    return tuple(out)

@@ -16,7 +16,17 @@ that contract end to end on the TPU-native stack:
   loss that first surfaces as a *step exception* (collective timeout, store
   EOF) takes the same path minus the save — the in-flight state is suspect,
   so training resumes from the last durable checkpoint;
-- bounds disk usage by keeping the newest ``keep`` checkpoints.
+- bounds disk usage by keeping the newest ``keep`` checkpoints;
+- consumes the engine's numeric :class:`GuardPolicy` (PR-3,
+  docs/NUMERIC_GUARD.md): when ``build_engine`` returns an Engine with
+  ``guard=GuardPolicy(...)``, every step's on-device health word is routed
+  through a :class:`NumericWatchdog` — SKIP_STEP steps were already
+  zero-applied in-graph (moments untouched, step counter advanced) and are
+  counted against the skip window; ROLLBACK restores the last committed
+  checkpoint from the same ring, deterministically re-seeds (the builder
+  re-runs), re-warms LR per the policy, and replays; ABORT raises
+  :class:`NumericAnomalyError`. Offending batches are captured to
+  ``ckpt_dir/badbatch/`` for ``tools/replay_batch.py``.
 
 The loop is deliberately synchronous and host-driven: recovery decisions
 are control-plane, and one decision per step costs nothing next to a fused
@@ -65,6 +75,8 @@ class ResilientTrainer:
         self.async_save = bool(async_save)
         self.restarts = 0
         self.resumed_at: List[int] = []
+        self.numeric_rollbacks = 0
+        self.rollback_at: List[int] = []
         self._pending_commit: Optional[int] = None
         os.makedirs(self.ckpt_dir, exist_ok=True)
 
@@ -219,17 +231,22 @@ class ResilientTrainer:
         uninterrupted trajectory. Returns ``{"engine", "losses", "restarts",
         "resumed_at", "final_step"}``.
         """
+        from .faults import poison_arrays
+
         engine = self.build_engine(self._alive())
         step = self.resume(engine)
         losses = {}
+        watchdog, recorder = self._arm_guard(engine)
         while step < steps:
             if self._scale_event():
                 engine, step = self._reshard(save_from=engine, step=step)
                 continue
             try:
-                ids, lbl = data_fn(step)
+                ids, lbl = poison_arrays(step, data_fn(step))
                 batch = (engine.shard_batch(ids, lbl)
                          if shard and engine.mesh is not None else (ids, lbl))
+                if watchdog is not None:
+                    engine.lr_scale = watchdog.lr_scale(step)
                 loss = engine.step(*batch)
             except Exception:
                 # a dead peer often surfaces as a collective/store failure
@@ -241,10 +258,63 @@ class ResilientTrainer:
                     engine, step = self._reshard()
                     continue
                 raise
+            if watchdog is not None:
+                word = (int(engine.last_health)
+                        if engine.last_health is not None else 0)
+                if word:
+                    decision = watchdog.observe(step + 1, word)
+                    if recorder is not None:
+                        recorder.record(
+                            step + 1, word,
+                            {"input_ids": ids, "labels": lbl},
+                            extra={"decision": decision,
+                                   "lr_scale": float(engine.lr_scale)})
+                    if decision == "abort":
+                        from ...framework.numeric_guard import \
+                            NumericAnomalyError
+
+                        raise NumericAnomalyError(
+                            word, step=step + 1,
+                            detail="guard budgets exhausted"
+                            if engine.guard.action != "abort" else "")
+                    if decision == "rollback":
+                        # the anomalous update was zero-applied in-graph,
+                        # but a streak (or explicit policy) means the
+                        # trajectory is suspect: restore the last COMMITTED
+                        # ring entry, re-seed via the builder, re-warm LR.
+                        self.commit()
+                        engine = self.build_engine(self._alive())
+                        step = self.resume(engine)
+                        watchdog.note_rollback(step)
+                        self.numeric_rollbacks += 1
+                        self.rollback_at.append(step)
+                        continue
+                    # "warn" applied the update; "skip_step" zero-applied —
+                    # either way the step counter advances below.
             step += 1
             losses[step] = float(loss)
             if step % self.save_every == 0 and step < steps:
                 self.save(engine, step)
         self.save(engine, steps, sync=True)
         return {"engine": engine, "losses": losses, "restarts": self.restarts,
-                "resumed_at": list(self.resumed_at), "final_step": step}
+                "resumed_at": list(self.resumed_at), "final_step": step,
+                "numeric_rollbacks": self.numeric_rollbacks,
+                "rollback_at": list(self.rollback_at),
+                "numeric_skips": (list(watchdog.skipped_steps)
+                                  if watchdog is not None else []),
+                "numeric_events": (list(watchdog.events)
+                                   if watchdog is not None else [])}
+
+    def _arm_guard(self, engine):
+        """Build the watchdog + bad-batch recorder when the engine carries a
+        numeric GuardPolicy (guard state survives engine rebuilds on the
+        watchdog, not the engine)."""
+        guard = getattr(engine, "guard", None)
+        if guard is None:
+            return None, None
+        from ...framework.numeric_guard import BadBatchRecorder
+        from .watchdog import NumericWatchdog
+
+        recorder = (BadBatchRecorder(os.path.join(self.ckpt_dir, "badbatch"))
+                    if guard.record_bad_batches else None)
+        return NumericWatchdog(guard), recorder

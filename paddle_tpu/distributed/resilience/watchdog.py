@@ -1,0 +1,95 @@
+"""Loss-spike / NaN watchdog — host-side policy over the device health word.
+
+The jitted train step computes one int32 health word per step
+(``framework.numeric_guard.guard_step``); this watchdog is the control
+plane that decides what the word *means* for the run, per the engine's
+:class:`~paddle_tpu.framework.numeric_guard.GuardPolicy`:
+
+- ``warn``       — log and continue (the update was applied);
+- ``skip_step``  — the in-graph zero-apply already protected params and
+  optimizer moments; count the skip against ``max_skips_per_window`` and
+  escalate to rollback when the window's budget is blown (an isolated bad
+  batch is skippable; a *streak* means the trajectory is sick);
+- ``rollback``   — restore the last committed checkpoint (the PR-2 ring in
+  ``ResilientTrainer``), deterministically re-seed, re-warm the LR over
+  ``rewarm_steps``; bounded by ``max_rollbacks`` then abort;
+- ``abort``      — raise :class:`NumericAnomalyError`.
+
+Large-model practice (OPT-175B / BLOOM training logs) is exactly this
+skip-and-rollback-with-LR-rewarm loop; here it is a policy object with a
+seeded fault drill proving each path (``tools/fault_drill.py``).
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import List, Optional, Tuple
+
+from ...framework.numeric_guard import GuardPolicy, describe_health
+
+__all__ = ["NumericWatchdog"]
+
+
+class NumericWatchdog:
+    """Per-run anomaly bookkeeping. ``observe`` returns the decision for one
+    step: ``"ok" | "warn" | "skip_step" | "rollback" | "abort"``."""
+
+    def __init__(self, policy: GuardPolicy):
+        self.policy = policy
+        self.events: List[Tuple[int, int]] = []      # (step, health_word)
+        self.skipped_steps: List[int] = []
+        self.rollbacks = 0
+        self._window_skips: List[int] = []
+        self._rewarm_from: Optional[int] = None
+
+    # -- decisions ---------------------------------------------------------
+    def observe(self, step: int, word: int) -> str:
+        word = int(word)
+        if word == 0:
+            return "ok"
+        self.events.append((int(step), word))
+        act = self.policy.action
+        if act == GuardPolicy.WARN:
+            warnings.warn(
+                f"[numeric_guard] step {step}: {describe_health(word)} "
+                "(policy=warn, update applied)")
+            return "warn"
+        if act == GuardPolicy.ABORT:
+            return "abort"
+        if act == GuardPolicy.ROLLBACK:
+            return self._rollback_or_abort()
+        # SKIP_STEP: prune the window, then charge this skip against it
+        lo = int(step) - self.policy.window
+        self._window_skips = [s for s in self._window_skips if s > lo]
+        self._window_skips.append(int(step))
+        if len(self._window_skips) > self.policy.max_skips_per_window:
+            return self._rollback_or_abort()
+        self.skipped_steps.append(int(step))
+        return "skip_step"
+
+    def _rollback_or_abort(self) -> str:
+        return ("abort" if self.rollbacks >= self.policy.max_rollbacks
+                else "rollback")
+
+    # -- rollback / LR re-warm bookkeeping ---------------------------------
+    def note_rollback(self, resumed_step: int) -> None:
+        """Called after the trainer restored a checkpoint at
+        ``resumed_step``: charges the rollback budget, clears the skip
+        window (the streak's cause was discarded with the state), and arms
+        the LR re-warm ramp."""
+        self.rollbacks += 1
+        self._window_skips = []
+        if self.policy.rewarm_steps > 0:
+            self._rewarm_from = int(resumed_step)
+
+    def lr_scale(self, step: int) -> float:
+        """LR multiplier for ``step``: a linear 1/k .. k/k ramp over the
+        ``rewarm_steps`` steps after a rollback, 1.0 otherwise."""
+        if self._rewarm_from is None:
+            return 1.0
+        k = self.policy.rewarm_steps
+        i = int(step) - self._rewarm_from
+        if i >= k:
+            self._rewarm_from = None
+            return 1.0
+        return float(max(0, i) + 1) / float(k)

@@ -1,0 +1,329 @@
+"""Jit-safe numeric guard — a checkify-style on-device health word.
+
+The most common way a long TPU run dies is numerical: a NaN gradient, a
+loss spike, or a poisoned batch silently corrupts optimizer state thousands
+of steps before anyone looks at a curve. A naive per-step host-side
+``isnan`` check serializes the device; JAX's ``checkify`` shows the fix —
+functionalize the error flags so detection stays on-device and the host
+reads ONE aggregated scalar per step.
+
+This module is the device side of that contract:
+
+- :func:`guard_step` — a pure combinator traced into the jitted train step.
+  It folds every per-tensor reduction into a single int32 *health word*
+  (bitmask below) and advances an EMA/deviation loss-spike detector carried
+  as a tiny state vector. No host syncs happen inside; the single transfer
+  is the caller fetching the word (which rides the same sync as the loss).
+- :class:`GuardPolicy` — what the host does about a non-zero word
+  (WARN / SKIP_STEP / ROLLBACK / ABORT, skip budget, LR re-warm after
+  rollback). Consumed by ``distributed.resilience.watchdog.NumericWatchdog``.
+- the *eager* health word — a process-global bitmask that host-side checks
+  (``AmpScaler``'s overflow scan, ``amp.debugging.check_numerics``, the
+  eager dispatcher's ``check_nan_inf``) report into, so eager and jitted
+  anomalies land in one place.
+- :class:`BadBatchRecorder` — dumps the offending batch + step + rng seed +
+  health word to ``<dir>/step_<n>/`` for ``tools/replay_batch.py``.
+
+Health-word bits and their diagnostic codes (docs/NUMERIC_GUARD.md):
+
+=========  ===  ==========  ================================================
+bit        val  code        meaning
+=========  ===  ==========  ================================================
+NAN_GRAD   1    PT-NUM-001  NaN in gradients (or eager op outputs)
+INF_GRAD   2    PT-NUM-002  Inf in gradients (or eager op outputs)
+NAN_LOSS   4    PT-NUM-003  loss is NaN/Inf
+SPIKE      8    PT-NUM-004  loss exceeded EMA + k * deviation (post-warmup)
+OVERFLOW   16   PT-NUM-005  AMP loss-scale overflow (``found_inf``)
+=========  ===  ==========  ================================================
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "NAN_GRAD", "INF_GRAD", "NAN_LOSS", "SPIKE", "OVERFLOW", "ALL_BITS",
+    "BIT_NAMES", "BIT_CODES", "describe_health", "health_codes",
+    "guard_init_state", "guard_step", "GuardPolicy", "NumericAnomalyError",
+    "record_health", "consume_health", "peek_health", "health_events",
+    "BadBatchRecorder", "INJECT_NONE", "INJECT_NAN_GRAD",
+    "INJECT_LOSS_SPIKE", "SPIKE_INJECT_FACTOR",
+]
+
+NAN_GRAD = 1
+INF_GRAD = 2
+NAN_LOSS = 4
+SPIKE = 8
+OVERFLOW = 16
+ALL_BITS = NAN_GRAD | INF_GRAD | NAN_LOSS | SPIKE | OVERFLOW
+
+BIT_NAMES = {
+    NAN_GRAD: "NAN_GRAD",
+    INF_GRAD: "INF_GRAD",
+    NAN_LOSS: "NAN_LOSS",
+    SPIKE: "SPIKE",
+    OVERFLOW: "OVERFLOW",
+}
+BIT_CODES = {
+    NAN_GRAD: "PT-NUM-001",
+    INF_GRAD: "PT-NUM-002",
+    NAN_LOSS: "PT-NUM-003",
+    SPIKE: "PT-NUM-004",
+    OVERFLOW: "PT-NUM-005",
+}
+
+# in-graph fault-injection codes (distributed.resilience.faults maps the
+# FaultPlan actions nan_grad/loss_spike onto these; 0 = no fault). The codes
+# arrive as a traced scalar argument, so injection never retraces.
+INJECT_NONE = 0
+INJECT_NAN_GRAD = 1
+INJECT_LOSS_SPIKE = 2
+SPIKE_INJECT_FACTOR = 1024.0
+
+
+def describe_health(word: int) -> str:
+    """``"NAN_GRAD|SPIKE (PT-NUM-001, PT-NUM-004)"`` for a non-zero word."""
+    word = int(word)
+    if not word:
+        return "healthy"
+    names = [n for b, n in BIT_NAMES.items() if word & b]
+    codes = [c for b, c in BIT_CODES.items() if word & b]
+    return "|".join(names) + " (" + ", ".join(codes) + ")"
+
+
+def health_codes(word: int) -> List[str]:
+    return [c for b, c in BIT_CODES.items() if int(word) & b]
+
+
+# ---------------------------------------------------------------------------
+# on-device guard (traced into the jitted train step)
+# ---------------------------------------------------------------------------
+
+def guard_init_state():
+    """Fresh spike-detector state: ``[loss_ema, dev_ema, n_healthy]``."""
+    import jax.numpy as jnp
+
+    return jnp.zeros((3,), jnp.float32)
+
+
+def guard_step(loss, grads, state, *, spike_factor: float = 10.0,
+               warmup_steps: int = 5, ema_beta: float = 0.9):
+    """Compute the step's health word on device; returns ``(word, state')``.
+
+    Pure and jit-traceable: the per-tensor nan/inf reductions fold into one
+    int32 scalar (under pjit that is one aggregated all-reduce — no
+    per-tensor host syncs), and the EMA/deviation spike detector advances
+    only on healthy steps so an anomalous loss can never poison its own
+    detector. ``spike_factor``/``warmup_steps`` are trace-time constants.
+    """
+    import jax.numpy as jnp
+
+    loss32 = jnp.asarray(loss, jnp.float32)
+    nan_loss = jnp.logical_not(jnp.isfinite(loss32))
+
+    has_nan = jnp.zeros((), bool)
+    has_inf = jnp.zeros((), bool)
+    for g in grads:
+        g32 = jnp.asarray(g, jnp.float32) if g.dtype != jnp.float32 else g
+        has_nan = jnp.logical_or(has_nan, jnp.isnan(g32).any())
+        has_inf = jnp.logical_or(has_inf, jnp.isinf(g32).any())
+
+    ema, dev, n = state[0], state[1], state[2]
+    warm = n >= float(warmup_steps)
+    # deviation floor: a perfectly flat loss must not make every wiggle a
+    # spike — scale-relative epsilon keeps the threshold meaningful
+    dev_floor = jnp.maximum(dev, 0.01 * jnp.abs(ema) + 1e-6)
+    spike = jnp.logical_and(
+        jnp.logical_and(warm, jnp.isfinite(loss32)),
+        loss32 > ema + float(spike_factor) * dev_floor)
+
+    word = (has_nan.astype(jnp.int32) * NAN_GRAD
+            + has_inf.astype(jnp.int32) * INF_GRAD
+            + nan_loss.astype(jnp.int32) * NAN_LOSS
+            + spike.astype(jnp.int32) * SPIKE)
+
+    healthy = word == 0
+    first = n == 0
+    beta = float(ema_beta)
+    upd_ema = jnp.where(first, loss32, beta * ema + (1.0 - beta) * loss32)
+    upd_dev = jnp.where(first, jnp.zeros((), jnp.float32),
+                        beta * dev + (1.0 - beta) * jnp.abs(loss32 - ema))
+    new_ema = jnp.where(healthy, upd_ema, ema)
+    new_dev = jnp.where(healthy, upd_dev, dev)
+    new_n = n + healthy.astype(jnp.float32)
+    return word, jnp.stack([new_ema, new_dev, new_n])
+
+
+# ---------------------------------------------------------------------------
+# policy
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class GuardPolicy:
+    """What to do when the health word is non-zero.
+
+    ``action`` is the first response; SKIP_STEP escalates to ROLLBACK once
+    more than ``max_skips_per_window`` anomalies land inside ``window``
+    steps, and ROLLBACK escalates to ABORT after ``max_rollbacks``. After a
+    rollback the learning rate re-warms linearly over ``rewarm_steps``
+    steps (0 = no re-warm — required when a drill asserts the post-rollback
+    trajectory matches an uninterrupted run).
+    """
+
+    WARN = "warn"
+    SKIP_STEP = "skip_step"
+    ROLLBACK = "rollback"
+    ABORT = "abort"
+
+    action: str = "skip_step"
+    max_skips_per_window: int = 3
+    window: int = 100
+    max_rollbacks: int = 3
+    rewarm_steps: int = 0
+    spike_factor: float = 10.0
+    warmup_steps: int = 5
+    record_bad_batches: bool = True
+
+    def __post_init__(self):
+        if self.action not in (self.WARN, self.SKIP_STEP, self.ROLLBACK,
+                               self.ABORT):
+            raise ValueError(f"unknown guard action {self.action!r}")
+
+    @property
+    def skip_mask(self) -> int:
+        """Bits that zero-apply the update in-graph. WARN observes only —
+        the anomalous update is applied, everything else protects state."""
+        return 0 if self.action == self.WARN else ALL_BITS
+
+
+class NumericAnomalyError(RuntimeError):
+    """A numeric anomaly escalated past its policy (ABORT, or budgets
+    exhausted). Carries the health ``word`` and its PT-NUM ``codes``."""
+
+    def __init__(self, word: int, step: Optional[int] = None, detail: str = ""):
+        self.word = int(word)
+        self.step = step
+        self.codes = health_codes(word)
+        at = f" at step {step}" if step is not None else ""
+        extra = f": {detail}" if detail else ""
+        super().__init__(
+            f"numeric anomaly{at}: {describe_health(word)}{extra}")
+
+
+# ---------------------------------------------------------------------------
+# eager health word (host-side checks report here)
+# ---------------------------------------------------------------------------
+
+_EAGER_LOCK = threading.Lock()
+_EAGER: Dict[str, object] = {"word": 0, "events": []}
+_MAX_EVENTS = 256
+
+
+def record_health(bits: int, source: str = "") -> None:
+    """OR ``bits`` into the process-global eager health word. Called by
+    AmpScaler's overflow scan, check_numerics, and the eager dispatcher's
+    check_nan_inf so every detection channel lands in one word."""
+    with _EAGER_LOCK:
+        _EAGER["word"] = int(_EAGER["word"]) | int(bits)
+        ev: List = _EAGER["events"]  # type: ignore[assignment]
+        if len(ev) < _MAX_EVENTS:
+            ev.append((int(bits), source))
+
+
+def report_nan_inf(num_nan: int, num_inf: int, source: str = "") -> int:
+    """Map host-side nan/inf counts onto the PT-NUM-001/002 bits and record
+    them — the one home for the eager-check -> health-word mapping (used by
+    the eager dispatcher's check_nan_inf and amp.debugging.check_numerics).
+    Returns the bits (0 when both counts are zero)."""
+    bits = (NAN_GRAD if num_nan else 0) | (INF_GRAD if num_inf else 0)
+    if bits:
+        record_health(bits, source)
+    return bits
+
+
+def consume_health() -> int:
+    """Read-and-clear the eager health word (one consumer per step)."""
+    with _EAGER_LOCK:
+        word = int(_EAGER["word"])
+        _EAGER["word"] = 0
+        _EAGER["events"] = []
+    return word
+
+
+def peek_health() -> int:
+    with _EAGER_LOCK:
+        return int(_EAGER["word"])
+
+
+def health_events() -> List[Tuple[int, str]]:
+    with _EAGER_LOCK:
+        return list(_EAGER["events"])  # type: ignore[arg-type]
+
+
+# ---------------------------------------------------------------------------
+# bad-batch capture
+# ---------------------------------------------------------------------------
+
+class BadBatchRecorder:
+    """Dump an offending batch for offline replay.
+
+    Each capture lands in ``<root>/step_<n>/`` as ``batch.npz`` (the raw
+    host arrays) plus ``meta.json`` (step, health word, bit names, PT-NUM
+    codes, rng seed, free-form extra). ``tools/replay_batch.py`` consumes
+    the pair to reproduce the anomaly in isolation.
+    """
+
+    def __init__(self, root: str):
+        self.root = str(root)
+
+    def _dir(self, step: int) -> str:
+        return os.path.join(self.root, f"step_{int(step):08d}")
+
+    def record(self, step: int, word: int, arrays: Dict[str, object], *,
+               rng_seed: Optional[int] = None, extra: Optional[dict] = None
+               ) -> str:
+        d = self._dir(step)
+        os.makedirs(d, exist_ok=True)
+        np.savez(os.path.join(d, "batch.npz"),
+                 **{k: np.asarray(v) for k, v in arrays.items()})
+        meta = {
+            "step": int(step),
+            "health_word": int(word),
+            "bits": [n for b, n in BIT_NAMES.items() if int(word) & b],
+            "codes": health_codes(word),
+            "rng_seed": rng_seed,
+            "arrays": sorted(arrays),
+            "extra": extra or {},
+        }
+        tmp = os.path.join(d, ".meta.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(meta, f, indent=1)
+        os.replace(tmp, os.path.join(d, "meta.json"))  # meta lands last, atomically
+        return d
+
+    def steps(self) -> List[int]:
+        if not os.path.isdir(self.root):
+            return []
+        out = []
+        for name in os.listdir(self.root):
+            if name.startswith("step_") and os.path.exists(
+                    os.path.join(self.root, name, "meta.json")):
+                try:
+                    out.append(int(name[len("step_"):]))
+                except ValueError:
+                    pass
+        return sorted(out)
+
+    def load(self, step: int) -> Tuple[dict, Dict[str, np.ndarray]]:
+        d = self._dir(step)
+        with open(os.path.join(d, "meta.json")) as f:
+            meta = json.load(f)
+        with np.load(os.path.join(d, "batch.npz")) as z:
+            arrays = {k: z[k] for k in z.files}
+        return meta, arrays

@@ -17,7 +17,12 @@ from .dataset import (  # noqa: F401
     TensorDataset,
     random_split,
 )
-from .dataloader import DataLoader, default_collate_fn, get_worker_info  # noqa: F401
+from .dataloader import (  # noqa: F401
+    DataLoader,
+    DataLoaderWorkerError,
+    default_collate_fn,
+    get_worker_info,
+)
 from .sampler import (  # noqa: F401
     BatchSampler,
     DistributedBatchSampler,

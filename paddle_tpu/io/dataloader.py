@@ -27,6 +27,18 @@ from .sampler import BatchSampler
 WorkerInfo = namedtuple("WorkerInfo", ["id", "num_workers", "dataset"])
 _worker_info: Optional[WorkerInfo] = None
 
+# sentinel batch payload: every sample in the batch was corrupt and
+# skip_corrupt dropped them — the parent skips the batch index entirely
+_BATCH_SKIPPED = "__PT_DATA_BATCH_SKIPPED__"
+
+
+class DataLoaderWorkerError(RuntimeError):
+    """PT-DATA-001: a DataLoader worker process died unexpectedly (and its
+    respawn budget is exhausted). Before this error existed a dead worker
+    wedged ``_MultiProcessIter._recv`` forever."""
+
+    code = "PT-DATA-001"
+
 
 def get_worker_info():
     return _worker_info
@@ -77,8 +89,15 @@ def _to_tensor(obj):
     return obj
 
 
+def _np_sample(s):
+    if isinstance(s, tuple):
+        return tuple(np.asarray(t._data) if isinstance(t, Tensor) else t
+                     for t in s)
+    return np.asarray(s._data) if isinstance(s, Tensor) else s
+
+
 def _worker_loop(dataset, index_queue, result_queue, collate_fn, worker_id, num_workers,
-                 init_fn, shm_name=None):
+                 init_fn, shm_name=None, skip_corrupt=False):
     global _worker_info
     _worker_info = WorkerInfo(worker_id, num_workers, dataset)
     if init_fn is not None:
@@ -109,16 +128,34 @@ def _worker_loop(dataset, index_queue, result_queue, collate_fn, worker_id, num_
             break
         batch_idx, indices = item
         try:
-            samples = [dataset[i] for i in indices]
-            samples = [
-                tuple(np.asarray(t._data) if isinstance(t, Tensor) else t for t in s)
-                if isinstance(s, tuple) else (np.asarray(s._data) if isinstance(s, Tensor) else s)
-                for s in samples
-            ]
+            if skip_corrupt:
+                # PT-DATA-002: log-and-skip samples whose __getitem__
+                # raises instead of killing the epoch
+                samples = []
+                for i in indices:
+                    try:
+                        samples.append(_np_sample(dataset[i]))
+                    except Exception as e:
+                        import warnings
+
+                        warnings.warn(f"[PT-DATA-002] DataLoader worker "
+                                      f"{worker_id} skipped sample {i}: {e!r}")
+                if not samples:
+                    emit(batch_idx, _BATCH_SKIPPED, None)
+                    continue
+            else:
+                samples = [_np_sample(dataset[i]) for i in indices]
             data = collate_fn(samples) if collate_fn is not _np_collate else _np_collate(samples)
             emit(batch_idx, data, None)
         except Exception as e:  # surface worker errors to the parent
-            emit(batch_idx, None, repr(e))
+            if skip_corrupt:    # collate on a corrupt survivor set
+                import warnings
+
+                warnings.warn(f"[PT-DATA-002] DataLoader worker {worker_id} "
+                              f"skipped batch {batch_idx} (collate): {e!r}")
+                emit(batch_idx, _BATCH_SKIPPED, None)
+            else:
+                emit(batch_idx, None, repr(e))
     if shm is not None:
         shm.detach()
 
@@ -127,7 +164,8 @@ class DataLoader:
     def __init__(self, dataset, feed_list=None, places=None, return_list=True,
                  batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
                  collate_fn=None, num_workers=0, use_buffer_reader=True, prefetch_factor=2,
-                 use_shared_memory=True, timeout=0, worker_init_fn=None, persistent_workers=False):
+                 use_shared_memory=True, timeout=0, worker_init_fn=None, persistent_workers=False,
+                 skip_corrupt=False, worker_respawn_limit=1):
         self.dataset = dataset
         self.num_workers = num_workers
         self.collate_fn = collate_fn
@@ -135,6 +173,13 @@ class DataLoader:
         self.prefetch_factor = max(prefetch_factor, 2)
         self.worker_init_fn = worker_init_fn
         self.timeout = timeout
+        # robustness policies (docs/NUMERIC_GUARD.md PT-DATA-001/002):
+        # skip_corrupt logs-and-skips samples whose __getitem__/collate
+        # raises; a dead worker is respawned worker_respawn_limit times
+        # (its in-flight batches re-dispatched) before the typed
+        # DataLoaderWorkerError surfaces.
+        self.skip_corrupt = bool(skip_corrupt)
+        self.worker_respawn_limit = max(0, int(worker_respawn_limit))
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_size = batch_size
@@ -178,6 +223,26 @@ class DataLoader:
     def _iter_single(self):
         cf = self.collate_fn or default_collate_fn
         for indices in self.batch_sampler:
+            if self.skip_corrupt:
+                samples = []
+                for i in indices:
+                    try:
+                        samples.append(self.dataset[i])
+                    except Exception as e:
+                        import warnings
+
+                        warnings.warn(
+                            f"[PT-DATA-002] DataLoader skipped sample {i}: {e!r}")
+                if not samples:
+                    continue
+                try:
+                    yield cf(samples)
+                except Exception as e:
+                    import warnings
+
+                    warnings.warn(
+                        f"[PT-DATA-002] DataLoader skipped batch (collate): {e!r}")
+                continue
             samples = [self.dataset[i] for i in indices]
             yield cf(samples)
 
@@ -200,14 +265,15 @@ class _MultiProcessIter:
         self.loader = loader
         self.collate = loader.collate_fn or _np_collate
         self.num_workers = loader.num_workers
-        ctx = mp.get_context("fork")
+        self._ctx = mp.get_context("fork")
+        ctx = self._ctx
         self.index_queues = [ctx.Queue() for _ in range(self.num_workers)]
         self.result_queue = ctx.Queue()
         # Shared-memory ring transport (native shm_ring.cc) keeps bulk array
         # bytes out of the pickle pipe — reference dataloader_iter.py:370's
         # LoDTensorBlockingQueue role.
         self.shm = None
-        shm_name = None
+        self._shm_name = None
         if loader.use_shared_memory:
             from .shm_channel import ShmChannel
 
@@ -215,70 +281,124 @@ class _MultiProcessIter:
                 shm_name = f"/pt_dl_{os.getpid()}_{id(self) & 0xFFFFFF:x}"
                 try:
                     self.shm = ShmChannel(shm_name, capacity=64 << 20, create=True)
+                    self._shm_name = shm_name
                 except RuntimeError:
-                    self.shm, shm_name = None, None
+                    self.shm = None
         self.workers = []
         for wid in range(self.num_workers):
-            w = ctx.Process(
-                target=_worker_loop,
-                args=(loader.dataset, self.index_queues[wid], self.result_queue,
-                      self.collate, wid, self.num_workers, loader.worker_init_fn,
-                      shm_name),
-                daemon=True,
-            )
-            w.start()
-            self.workers.append(w)
+            self.workers.append(self._spawn_worker(wid))
         self.batches = list(loader.batch_sampler)
         self.send_idx = 0
         self.rcv_idx = 0
         self.cache = {}
+        self._owner = {}                    # batch idx -> worker id
+        self.respawns = [0] * self.num_workers
         # prime the pipeline
         for _ in range(self.num_workers * loader.prefetch_factor):
             self._dispatch()
 
+    def _spawn_worker(self, wid):
+        w = self._ctx.Process(
+            target=_worker_loop,
+            args=(self.loader.dataset, self.index_queues[wid],
+                  self.result_queue, self.collate, wid, self.num_workers,
+                  self.loader.worker_init_fn, self._shm_name,
+                  self.loader.skip_corrupt),
+            daemon=True,
+        )
+        w.start()
+        return w
+
     def _dispatch(self):
         if self.send_idx >= len(self.batches):
             return
-        wid = self.send_idx % self.num_workers
+        # round-robin over LIVE workers: a reaped-without-respawn slot
+        # (workers[wid] is None) must not swallow batches
+        start = self.send_idx % self.num_workers
+        for off in range(self.num_workers):
+            wid = (start + off) % self.num_workers
+            if self.workers[wid] is not None:
+                break
+        else:
+            raise DataLoaderWorkerError(
+                "[PT-DATA-001] no live DataLoader workers left to dispatch to")
         self.index_queues[wid].put((self.send_idx, self.batches[self.send_idx]))
+        self._owner[self.send_idx] = wid
         self.send_idx += 1
 
     def __iter__(self):
         return self
 
     def __next__(self):
-        if self.rcv_idx >= len(self.batches):
-            self._shutdown()
-            raise StopIteration
-        while self.rcv_idx not in self.cache:
-            idx, data, err = self._recv()
-            if err is not None:
+        while True:
+            if self.rcv_idx >= len(self.batches):
                 self._shutdown()
-                raise RuntimeError(f"DataLoader worker failed: {err}")
-            self.cache[idx] = data
-        data = self.cache.pop(self.rcv_idx)
-        self.rcv_idx += 1
-        self._dispatch()
-        return _to_tensor(data)
+                raise StopIteration
+            while self.rcv_idx not in self.cache:
+                idx, data, err = self._recv()
+                self._owner.pop(idx, None)
+                if err is not None:
+                    self._shutdown()
+                    raise RuntimeError(f"DataLoader worker failed: {err}")
+                self.cache[idx] = data
+            data = self.cache.pop(self.rcv_idx)
+            self.rcv_idx += 1
+            self._dispatch()
+            if isinstance(data, str) and data == _BATCH_SKIPPED:
+                continue        # every sample was corrupt (PT-DATA-002)
+            return _to_tensor(data)
 
     def _recv(self):
-        """Next (idx, data, err) from the shm ring or, failing that, the queue."""
-        if self.shm is None:
-            return self.result_queue.get()
-        stale = 0.0
+        """Next (idx, data, err) from the shm ring or the queue — polling,
+        so a dead worker is detected (PT-DATA-001) instead of wedging the
+        epoch in a blocking get."""
         while True:
-            # Queue first (non-blocking): oversized batches and attach-failed
-            # workers use it, and it must not pay the shm wait per batch.
-            try:
-                return self.result_queue.get_nowait()
-            except queue.Empty:
-                pass
-            try:
-                return self.shm.get(timeout=0.1)
-            except TimeoutError:
-                stale += 0.1
-            if stale > 5.0 and not any(w.is_alive() for w in self.workers):
-                raise RuntimeError("all DataLoader workers exited unexpectedly")
+            if self.shm is not None:
+                # Queue first (non-blocking): oversized batches and
+                # attach-failed workers use it, and it must not pay the
+                # shm wait per batch.
+                try:
+                    return self.result_queue.get_nowait()
+                except queue.Empty:
+                    pass
+                try:
+                    return self.shm.get(timeout=0.1)
+                except TimeoutError:
+                    pass
+            else:
+                try:
+                    return self.result_queue.get(timeout=0.1)
+                except queue.Empty:
+                    pass
+            self._reap_dead_workers()
+
+    def _reap_dead_workers(self):
+        """Detect worker death: respawn (once, by default) re-dispatching
+        the dead worker's in-flight batches, or raise the typed
+        DataLoaderWorkerError when the respawn budget is spent. A worker
+        that died idle is respawned too (or its slot retired so _dispatch
+        routes around it) — an idle death must not swallow future batches."""
+        for wid, w in enumerate(self.workers):
+            if w is None or w.is_alive():
+                continue
+            pending = sorted(i for i, o in self._owner.items() if o == wid)
+            exitcode = w.exitcode
+            if self.respawns[wid] >= self.loader.worker_respawn_limit:
+                if pending:
+                    self._shutdown()
+                    raise DataLoaderWorkerError(
+                        f"[PT-DATA-001] DataLoader worker {wid} died "
+                        f"(exitcode {exitcode}) with batches {pending} in "
+                        f"flight and no respawn budget left")
+                self.workers[wid] = None    # retired; _dispatch skips it
+                continue
+            self.respawns[wid] += 1
+            # fresh queue: the dead process may have left the old one in an
+            # inconsistent state (feeder thread mid-pickle)
+            self.index_queues[wid] = self._ctx.Queue()
+            self.workers[wid] = self._spawn_worker(wid)
+            for idx in pending:
+                self.index_queues[wid].put((idx, self.batches[idx]))
 
     def _shutdown(self):
         for q in self.index_queues:
@@ -292,6 +412,8 @@ class _MultiProcessIter:
             self.shm.close()
             self.shm = None
         for w in self.workers:
+            if w is None:
+                continue
             w.join(timeout=1)
             if w.is_alive():
                 w.terminate()

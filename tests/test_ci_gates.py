@@ -34,8 +34,11 @@ def test_manifest_counts_cover_reference_parity():
         "paddle.nn.functional": 156,
         "paddle.linalg": 46,
         "paddle.tensor_methods": 359,
-        "paddle.distributed": 70,    # resilience PR: + resilience module,
-                                     # CheckpointCorruptionError, wait_async_save
+        "paddle.distributed": 74,    # resilience PR: + resilience module,
+                                     # CheckpointCorruptionError, wait_async_save;
+                                     # numeric-guard PR: + GuardPolicy,
+                                     # NumericWatchdog, NumericAnomalyError,
+                                     # BadBatchRecorder
         "paddle.optimizer": 17,
         "paddle.incubate.nn.functional": 23,
         "paddle.geometric": 11,
@@ -136,18 +139,19 @@ def test_graph_lint_gate_detects_seeded_defects():
 
 
 def test_fault_drill_matrix():
-    """Resilience gate (docs/RESILIENCE.md): the seeded fault matrix —
-    heartbeat loss, store stall, shard corruption, engine saturation,
-    serving deadline — must be absorbed with recovery enabled AND flip the
-    exit code with recovery disabled. Runs in a subprocess (the drill
-    forces the pure-Python store daemon for server-side faults)."""
+    """Resilience gate (docs/RESILIENCE.md + docs/NUMERIC_GUARD.md): the
+    seeded fault matrix — heartbeat loss, store stall, shard corruption,
+    engine saturation, serving deadline, NaN gradient, loss spike, poisoned
+    batch — must be absorbed with recovery enabled AND flip the exit code
+    with recovery disabled. Runs in a subprocess (the drill forces the
+    pure-Python store daemon for server-side faults)."""
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     r = subprocess.run(
         [sys.executable, os.path.join(ROOT, "tools", "fault_drill.py"),
          "--selftest"],
         capture_output=True, text=True, env=env, cwd=ROOT, timeout=500)
     assert r.returncode == 0, r.stdout + r.stderr
-    assert "FAULT DRILL OK: 5 fault classes" in r.stdout, r.stdout
+    assert "FAULT DRILL OK: 8 fault classes" in r.stdout, r.stdout
 
 
 def test_fault_drill_single_drill_exit_codes():
@@ -202,6 +206,51 @@ def test_bench_regression_gate_secondary_latency(tmp_path):
     assert r_flat.returncode == 1
     assert "serving_p99_step_latency_ms" in r_flat.stdout
     assert run(primary, [primary, {**p99, "value": 12.0}]).returncode == 0
+
+
+def test_bench_regression_gate_guard_overhead(tmp_path):
+    """guard_overhead_pct secondary logic: the baseline is clamped to the
+    5%% floor, so a near-zero (or negative-noise) recorded overhead doesn't
+    hair-trigger the relative gate, while a real regression (a host sync
+    creeping into the guarded step) past 2x max(baseline, 5) fails."""
+    gate = os.path.join(ROOT, "tools", "check_bench_regression.py")
+    g2 = tmp_path / "tools" / "check_bench_regression.py"
+    g2.parent.mkdir(exist_ok=True)
+    g2.write_text(open(gate).read())
+    primary = {"metric": "llama_pretrain_tokens_per_sec_per_chip",
+               "value": 100.0, "unit": "tok/s", "vs_baseline": 1.0}
+    guard = {"metric": "guard_overhead_pct", "value": 0.5, "unit": "%",
+             "vs_baseline": None}
+
+    def run(baseline, fresh_lines):
+        (tmp_path / "BENCH_r01.json").write_text(json.dumps(baseline))
+        fresh = tmp_path / "fresh.txt"
+        fresh.write_text("\n".join(json.dumps(d) for d in fresh_lines) + "\n")
+        return subprocess.run([sys.executable, str(g2), str(fresh)],
+                              capture_output=True, text=True)
+
+    base = {**primary, "secondary": {"guard_overhead_pct": guard}}
+    # tiny recorded baseline + jittery-but-small fresh value: floor saves it
+    assert run(base, [primary, {**guard, "value": 8.0}]).returncode == 0
+    # past 2x the floor: a real guarded-step regression fails, named
+    r = run(base, [primary, {**guard, "value": 12.0}])
+    assert r.returncode == 1 and "guard_overhead_pct" in r.stdout
+    # metric absent on either side: vacuous pass (guard, not a ratchet)
+    assert run(primary, [primary, {**guard, "value": 50.0}]).returncode == 0
+    assert run(base, [primary]).returncode == 0
+
+
+def test_replay_batch_selftest():
+    """The bad-batch replay loop (docs/NUMERIC_GUARD.md): capture a
+    poisoned batch via BadBatchRecorder, replay it in isolation, reproduce
+    the health word."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "replay_batch.py"),
+         "--selftest"],
+        capture_output=True, text=True, env=env, cwd=ROOT, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "SELFTEST OK" in r.stdout and "REPRODUCED" in r.stdout
 
 
 def test_pip_installable_metadata():

@@ -21,12 +21,22 @@ import sys
 PRIMARY = "llama_pretrain_tokens_per_sec_per_chip"
 
 # secondary guards, compared only when BOTH sides recorded them (so adding a
-# new metric never fails the gate retroactively). "lower" = smaller is
-# better. serving_p99_step_latency_ms is measured with request deadlines
-# enabled — it pins the resilience hooks (deadline scan, queue bookkeeping)
-# as overhead-neutral on the serving hot path; the generous 2x tolerance
-# guards against accidental O(n)/sync work, not CI jitter.
-SECONDARY = {"serving_p99_step_latency_ms": ("lower", 1.0)}
+# new metric never fails the gate retroactively): name -> (direction,
+# tolerance, floor). "lower" = smaller is better; the baseline is clamped to
+# at least `floor` before the relative comparison, so metrics that sit near
+# zero when healthy (guard_overhead_pct can even be negative noise) don't
+# turn the relative gate into a hair trigger.
+# - serving_p99_step_latency_ms: measured with request deadlines enabled —
+#   pins the resilience hooks (deadline scan, queue bookkeeping) as
+#   overhead-neutral on the serving hot path; 2x tolerance guards against
+#   accidental O(n)/sync work, not CI jitter.
+# - guard_overhead_pct: guarded vs unguarded fused train step
+#   (docs/NUMERIC_GUARD.md) — fails only past max(baseline, 5%) * 2, i.e.
+#   the health word grew a real host sync or per-tensor transfer.
+SECONDARY = {
+    "serving_p99_step_latency_ms": ("lower", 1.0, 0.0),
+    "guard_overhead_pct": ("lower", 1.0, 5.0),
+}
 
 
 def parse_lines(path):
@@ -144,7 +154,7 @@ def check_secondary(base, now, root):
     baseline and the fresh output carry them — a metric that predates the
     baseline passes vacuously."""
     recorded = recorded_secondary(root, base)
-    for name, (direction, tol) in SECONDARY.items():
+    for name, (direction, tol, floor) in SECONDARY.items():
         prev = recorded.get(name)
         cur = now.get(name)
         if not isinstance(prev, dict) or not isinstance(cur, dict):
@@ -152,11 +162,13 @@ def check_secondary(base, now, root):
         pv, cv = prev.get("value"), cur.get("value")
         if pv is None or cv is None:
             continue
-        worse = (cv > pv * (1.0 + tol) if direction == "lower"
-                 else cv < pv * (1.0 - tol))
+        ref = max(pv, floor) if direction == "lower" else pv
+        worse = (cv > ref * (1.0 + tol) if direction == "lower"
+                 else cv < ref * (1.0 - tol))
         if worse:
             print(f"FAIL: secondary {name} {cv:.4g} vs baseline {pv:.4g} "
-                  f"(tolerance {tol:.0%}, {direction} is better)")
+                  f"(tolerance {tol:.0%} over max(baseline, {floor:g}), "
+                  f"{direction} is better)")
             return 1
         print(f"ok: secondary {name} {cv:.4g} (baseline {pv:.4g})")
     return 0

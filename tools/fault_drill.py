@@ -4,9 +4,13 @@ Each drill runs a small end-to-end scenario twice: with its recovery path
 enabled (the injected fault must be absorbed) and with it disabled (the
 same fault must flip the exit code). ``--selftest`` runs the whole seeded
 matrix — heartbeat loss, store stall, checkpoint shard corruption, serving
-engine saturation, serving deadline — and exits 0 iff every fault class
-recovers when enabled AND fails when its recovery is off. Recovery is
-proven by tests, not prayer (docs/RESILIENCE.md).
+engine saturation, serving deadline, plus the numeric classes (NaN
+gradient, loss spike, poisoned batch — docs/NUMERIC_GUARD.md) — and exits
+0 iff every fault class recovers when enabled AND fails when its recovery
+is off. For the numeric drills "recovery off" means GuardPolicy(action=
+"warn"): detection stays on but the anomalous update is applied — exactly
+the run an unguarded job would have. Recovery is proven by tests, not
+prayer (docs/RESILIENCE.md).
 
 Usage:
     python tools/fault_drill.py --selftest
@@ -372,12 +376,185 @@ def drill_serving_deadline(recover: bool):
                   f"({doomed.error}); other slot finished 12/12")
 
 
+# ---------------------------------------------------------------------------
+# numeric drills: health word + GuardPolicy (docs/NUMERIC_GUARD.md)
+# ---------------------------------------------------------------------------
+
+def _guarded_fixture(policy):
+    """Toy guarded trainer pieces shared by the numeric drills."""
+    import numpy as np
+    import jax
+    from jax.sharding import Mesh
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.auto_parallel import Engine
+
+    D, B = 8, 8
+
+    def data_fn(step):
+        rng = np.random.default_rng(1000 + step)
+        return (rng.standard_normal((B, D)).astype(np.float32),
+                rng.standard_normal((B, D)).astype(np.float32))
+
+    def build(alive):
+        mesh = Mesh(np.array(jax.devices()[:4]), ("dp",))
+        paddle.seed(0)
+        return Engine(_toy_model(D), mesh, lr=0.05, clip_norm=None,
+                      guard=policy)
+
+    return build, data_fn
+
+
+def _numeric_policy(recover, action):
+    """Recovery on = the requested policy; recovery off = WARN (detection
+    stays armed, the anomalous update is applied — an unguarded run)."""
+    from paddle_tpu.framework.numeric_guard import GuardPolicy
+
+    kw = dict(warmup_steps=3, spike_factor=50.0)
+    return (GuardPolicy(action=action, **kw) if recover
+            else GuardPolicy(action="warn", **kw))
+
+
+def drill_nan_grad(recover: bool):
+    """A NaN gradient at one step. Recovery = the health word (computed
+    on-device, one scalar) flags PT-NUM-001, the in-graph zero-apply skips
+    the update (step counter advances, optimizer moments untouched), and
+    training continues finite. Without recovery the NaN lands in the
+    optimizer state and every later loss is NaN."""
+    import warnings
+
+    import numpy as np
+
+    from paddle_tpu.distributed.resilience import (FaultPlan, FaultSpec,
+                                                   ResilientTrainer)
+
+    build, data_fn = _guarded_fixture(_numeric_policy(recover, "skip_step"))
+    plan = FaultPlan(seed=13, specs=[
+        FaultSpec("numeric.step", "nan_grad", at=3, count=1)])
+    with tempfile.TemporaryDirectory() as tmp:
+        trainer = ResilientTrainer(build, tmp, save_every=100,
+                                   async_save=False)
+        with plan, warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            out = trainer.fit(data_fn, 8)
+    if not plan.log:
+        return False, "nan_grad fault never fired"
+    final = out["losses"][8]
+    if not recover:
+        if np.isfinite(final):
+            return True, ("unexpected: NaN grads absorbed without the "
+                          "skip policy")
+        return False, ("no guard action: NaN reached the optimizer state, "
+                       f"final loss {final}")
+    if out["numeric_skips"] != [4]:
+        return False, f"expected skip at step 4, got {out['numeric_skips']}"
+    if not np.isfinite(final):
+        return False, f"skip failed to protect state: final loss {final}"
+    return True, (f"PT-NUM-001 at step 4 skipped in-graph, moments "
+                  f"untouched, final loss {final:.6f} finite")
+
+
+def drill_loss_spike(recover: bool):
+    """A 1024x loss spike mid-run. Recovery = the EMA/deviation detector
+    flags PT-NUM-004 and the ROLLBACK policy restores the last committed
+    ring entry, deterministically re-seeds and replays — the final loss
+    must MATCH the uninterrupted seeded run. Without recovery the spiked
+    gradients wreck the trajectory."""
+    import warnings
+
+    import numpy as np
+
+    from paddle_tpu.distributed.resilience import (FaultPlan, FaultSpec,
+                                                   ResilientTrainer)
+
+    build, data_fn = _guarded_fixture(_numeric_policy(True, "rollback"))
+    with tempfile.TemporaryDirectory() as tmp:
+        ref = ResilientTrainer(build, os.path.join(tmp, "ref"),
+                               save_every=100, async_save=False
+                               ).fit(data_fn, 8)
+        ref_final = ref["losses"][8]
+
+        build2, _ = _guarded_fixture(_numeric_policy(recover, "rollback"))
+        plan = FaultPlan(seed=13, specs=[
+            FaultSpec("numeric.step", "loss_spike", at=5, count=1)])
+        trainer = ResilientTrainer(build2, os.path.join(tmp, "job"),
+                                   save_every=2, async_save=False)
+        with plan, warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            out = trainer.fit(data_fn, 8)
+        if not plan.log:
+            return False, "loss_spike fault never fired"
+        final = out["losses"][8]
+        if not recover:
+            if np.allclose(final, ref_final, rtol=1e-3):
+                return True, ("unexpected: 1024x spiked step left the "
+                              "trajectory intact")
+            return False, (f"no rollback: spiked update applied, final "
+                           f"{final:.4f} vs uninterrupted {ref_final:.4f}")
+        if out["numeric_rollbacks"] < 1:
+            return False, "spike never triggered a rollback"
+        if not np.allclose(final, ref_final, rtol=1e-3):
+            return False, (f"post-rollback trajectory diverged: {final} vs "
+                           f"uninterrupted {ref_final}")
+        return True, (f"PT-NUM-004 at step 6, rolled back to "
+                      f"{out['rollback_at'][0]}, replay matches "
+                      f"uninterrupted ({final:.6f})")
+
+
+def drill_poison_batch(recover: bool):
+    """A seeded NaN-poisoned batch from the data pipeline. Recovery = skip
+    the step AND capture the batch to ckpt_dir/badbatch/ where
+    tools/replay_batch.py reproduces the anomaly in isolation. Without
+    recovery the poisoned batch NaNs the run."""
+    import warnings
+
+    import numpy as np
+
+    from paddle_tpu.distributed.resilience import (FaultPlan, FaultSpec,
+                                                   ResilientTrainer)
+
+    build, data_fn = _guarded_fixture(_numeric_policy(recover, "skip_step"))
+    plan = FaultPlan(seed=5, specs=[
+        FaultSpec("data.batch", "poison_batch", at=4, count=1, arg=4)])
+    with tempfile.TemporaryDirectory() as tmp:
+        trainer = ResilientTrainer(build, tmp, save_every=100,
+                                   async_save=False)
+        with plan, warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            out = trainer.fit(data_fn, 8)
+        if not plan.log:
+            return False, "poison_batch fault never fired"
+        final = out["losses"][8]
+        if not recover:
+            if np.isfinite(final):
+                return True, "unexpected: poisoned batch absorbed under warn"
+            return False, f"no guard action: poisoned batch NaN'd the run"
+        if not np.isfinite(final):
+            return False, f"skip failed: final loss {final}"
+        if out["numeric_skips"] != [5]:
+            return False, f"expected skip at step 5, got {out['numeric_skips']}"
+        from paddle_tpu.framework.numeric_guard import BadBatchRecorder
+
+        rec = BadBatchRecorder(os.path.join(tmp, "badbatch"))
+        if rec.steps() != [5]:
+            return False, f"bad batch not captured: {rec.steps()}"
+        meta, arrays = rec.load(5)
+        if not np.isnan(arrays["input_ids"]).any() and \
+                not np.isnan(arrays["labels"]).any():
+            return False, "captured batch carries no NaN"
+        return True, (f"poisoned batch skipped at step 5, captured "
+                      f"({'|'.join(meta['bits'])}) for replay_batch.py")
+
+
 DRILLS = {
     "heartbeat": drill_heartbeat,
     "store_stall": drill_store_stall,
     "shard_corruption": drill_shard_corruption,
     "engine_saturation": drill_engine_saturation,
     "serving_deadline": drill_serving_deadline,
+    "nan_grad": drill_nan_grad,
+    "loss_spike": drill_loss_spike,
+    "poison_batch": drill_poison_batch,
 }
 
 

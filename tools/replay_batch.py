@@ -1,0 +1,169 @@
+"""Replay a captured bad batch in isolation.
+
+``BadBatchRecorder`` (framework/numeric_guard.py) dumps the offending batch
++ step + rng seed + health word to ``<ckpt_dir>/badbatch/step_<n>/`` the
+moment the guarded train step flags it. This tool re-runs that exact batch
+through a freshly built (guarded) engine and reports whether the anomaly
+reproduces — separating data-dependent anomalies (a poisoned batch NaNs any
+parameter state) from state-dependent ones (only that optimizer state at
+that step spikes).
+
+Usage:
+    # rebuild the engine via your builder, optionally restoring the
+    # checkpoint ring entry closest to the captured step
+    python tools/replay_batch.py CKPT/badbatch/step_00000005 \
+        --builder mypkg.train:build_engine [--ckpt CKPT]
+
+    # self-test: poison a batch, capture it, replay it, expect reproduction
+    python tools/replay_batch.py --selftest
+
+The builder is ``module.path:callable`` returning an Engine (built with
+``guard=GuardPolicy(...)`` so the replay computes the health word). Exit 0
+iff the replay reproduces a non-zero health word sharing at least one bit
+with the capture.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def load_capture(capture_dir):
+    import numpy as np
+
+    with open(os.path.join(capture_dir, "meta.json")) as f:
+        meta = json.load(f)
+    with np.load(os.path.join(capture_dir, "batch.npz")) as z:
+        arrays = {k: z[k] for k in z.files}
+    return meta, arrays
+
+
+def resolve_builder(spec):
+    mod, _, fn = spec.partition(":")
+    if not fn:
+        raise SystemExit(f"--builder must be module.path:callable, got {spec!r}")
+    return getattr(importlib.import_module(mod), fn)
+
+
+def restore_ring_state(engine, ckpt_dir, step):
+    """Load the newest ring entry at or before ``step`` into the engine (the
+    state the guarded step actually saw), tolerating a ring that has since
+    been rolled back or GC'd. Returns the restored step or None."""
+    from paddle_tpu.distributed.resilience import ResilientTrainer
+
+    trainer = ResilientTrainer(lambda alive: engine, ckpt_dir, save_every=10**9)
+    candidates = [s for s in trainer._recorded_steps() if s <= step]
+    if not candidates:
+        return None
+    from paddle_tpu.distributed.checkpoint import load_state_dict
+
+    sd = engine.state_dict()
+    load_state_dict(sd, trainer._step_dir(candidates[-1]))
+    engine.set_state_dict(sd)
+    return candidates[-1]
+
+
+def replay(capture_dir, builder, ckpt_dir=None):
+    from paddle_tpu.framework.numeric_guard import describe_health
+
+    meta, arrays = load_capture(capture_dir)
+    engine = builder()
+    if getattr(engine, "guard", None) is None:
+        raise SystemExit("builder returned an Engine without guard= — the "
+                         "replay needs the health word")
+    restored = None
+    if ckpt_dir:
+        restored = restore_ring_state(engine, ckpt_dir, meta["step"])
+    keys = meta.get("arrays") or sorted(arrays)
+    engine.step(*[arrays[k] for k in keys])
+    word = int(engine.last_health)
+    print(f"capture:  step {meta['step']} health {meta['health_word']} "
+          f"({'|'.join(meta['bits'])}, {', '.join(meta['codes'])})")
+    print(f"replayed: health {word} ({describe_health(word)})"
+          + (f" from ring step {restored}" if restored is not None else
+             " from fresh init"))
+    reproduced = bool(word and (word & meta["health_word"] or word))
+    print("REPRODUCED" if reproduced else
+          "NOT REPRODUCED (state-dependent anomaly — replay with --ckpt "
+          "pointing at the run's ring)")
+    return 0 if reproduced else 1
+
+
+def selftest():
+    """Poison a batch, let the guarded step flag it, capture, replay."""
+    import tempfile
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.distributed.auto_parallel import Engine
+    from paddle_tpu.framework.numeric_guard import (BadBatchRecorder,
+                                                    GuardPolicy)
+    from paddle_tpu.nn.layer.layers import Layer
+
+    D = 8
+
+    class Toy(Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = paddle.nn.Linear(D, D)
+
+        def loss_fn(self, x, y):
+            out = self.fc(Tensor(x))
+            diff = out._data - y
+            return (diff * diff).mean()
+
+    def build():
+        paddle.seed(0)
+        return Engine(Toy(), None, lr=0.05, clip_norm=None,
+                      guard=GuardPolicy(action="skip_step", warmup_steps=2))
+
+    rng = np.random.default_rng(7)
+    x = rng.standard_normal((8, D)).astype(np.float32)
+    y = rng.standard_normal((8, D)).astype(np.float32)
+    x[0, 0] = np.nan                        # the poisoned sample
+
+    eng = build()
+    eng.step(x, y)
+    word = int(eng.last_health)
+    if not word:
+        print("SELFTEST FAIL: poisoned batch not flagged")
+        return 1
+    with tempfile.TemporaryDirectory() as tmp:
+        rec = BadBatchRecorder(os.path.join(tmp, "badbatch"))
+        d = rec.record(1, word, {"input_ids": x, "labels": y}, rng_seed=7)
+        rc = replay(d, build)
+    if rc == 0:
+        print("SELFTEST OK: captured anomaly reproduced in isolation")
+    return rc
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("capture", nargs="?", help="badbatch/step_<n> directory")
+    ap.add_argument("--builder", help="module.path:callable -> guarded Engine")
+    ap.add_argument("--ckpt", help="checkpoint ring root (restores the entry "
+                                   "nearest the captured step)")
+    ap.add_argument("--selftest", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.selftest:
+        return selftest()
+    if not args.capture or not args.builder:
+        print(__doc__)
+        return 2
+    return replay(args.capture, resolve_builder(args.builder), args.ckpt)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
