@@ -36,6 +36,9 @@ Known sites (see docs/RESILIENCE.md for the catalogue):
 ``rpc.connect``       before an rpc client connection (detail = worker)
 ``numeric.step``      guarded Engine train step (detail = host step index)
 ``data.batch``        trainer data path, batch about to ship (detail = step)
+``serving.block_pool``  serving admission, before block allocation
+                        (detail = ``rid:<id>``; ``exhaust`` holds ``arg``
+                        free KV blocks — seeded pool exhaustion)
 ====================  =====================================================
 
 With no plan installed every hook is a cheap no-op (one global read), so
@@ -51,7 +54,8 @@ import time
 from typing import List, Optional, Sequence
 
 __all__ = ["FaultSpec", "FaultPlan", "FaultInjected", "maybe_inject",
-           "corrupt", "active_plan", "numeric_inject_code", "poison_arrays"]
+           "corrupt", "active_plan", "numeric_inject_code", "poison_arrays",
+           "resource_hold"]
 
 
 class FaultInjected(ConnectionError):
@@ -75,12 +79,13 @@ class FaultSpec:
     _CONTROL = ("kill", "stall", "delay", "error")
     _DATA = ("bitflip", "truncate", "garbage")
     _NUMERIC = ("nan_grad", "loss_spike", "poison_batch")
+    _RESOURCE = ("exhaust",)
 
     def __post_init__(self):
-        if self.action not in self._CONTROL + self._DATA + self._NUMERIC:
+        known = self._CONTROL + self._DATA + self._NUMERIC + self._RESOURCE
+        if self.action not in known:
             raise ValueError(
-                f"unknown fault action {self.action!r} "
-                f"(choose: {self._CONTROL + self._DATA + self._NUMERIC})")
+                f"unknown fault action {self.action!r} (choose: {known})")
 
 
 class FaultPlan:
@@ -192,6 +197,21 @@ def corrupt(site: str, detail: str, data: bytes) -> bytes:
         elif s.action == "error":
             raise RuntimeError(f"fault injected: error at {site} ({detail})")
     return data
+
+
+def resource_hold(site: str, detail: str = "") -> int:
+    """Resource hook: number of pool units (serving KV blocks) the due
+    ``exhaust`` specs remove from circulation at this event — seeded,
+    deterministic pool exhaustion (``serving.block_pool`` site, consulted
+    by the serving engine's admission path). No plan -> 0."""
+    plan = _ACTIVE
+    if plan is None:
+        return 0
+    total = 0
+    for s in plan.fire(site, detail):
+        if s.action == "exhaust":
+            total += max(0, int(s.arg))
+    return total
 
 
 def numeric_inject_code(detail: str = "") -> int:

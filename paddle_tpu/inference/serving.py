@@ -40,13 +40,28 @@ Numerics: with default greedy sampling the engine is EXACTLY equal to
 versus the dense-cache generate it matches exactly in fp32 (CPU tests) while
 bf16-on-TPU tokens may diverge at softmax near-ties between the two attention
 kernels — the standard cross-kernel serving caveat.
+
+``prefix_cache=PrefixCacheConfig(...)`` switches admission to a radix
+prefix cache over a refcounted block pool with chunked prefill
+(docs/SERVING.md): prompts sharing a system-prompt/few-shot prefix map the
+already-filled KV blocks into their table and only prefill the uncached
+suffix, one ``prefill_chunk`` per step interleaved with the decode batch;
+a full-prompt hit copy-on-writes its last block before the first-token
+re-step. For any given prompt, warm and cold admissions emit bit-identical
+token streams (greedy and seeded sampling — see
+``paged_prefill_attention``). One scoping note: a cached chain's final
+block holds position L-1 k/v written by the first-token re-step's decode
+program, so a LONGER prompt extending that chain reads re-step k/v where
+its own cold prefill would have run the chunk-prefill program — the values
+are mathematically equal but may differ in the last ulp under bf16 on TPU.
 """
 
 from __future__ import annotations
 
 import collections
+import dataclasses
 import weakref
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
@@ -59,12 +74,35 @@ from ..core.tensor import Tensor
 # implementation; re-exported here for the serving-facing API surface.
 from ..models.generation_utils import (fold_keys as _fold_keys,
                                        sample_rows, validate_sampling)
+# host-side page bookkeeping lives next to the paged kernels; re-exported
+# here as the serving-facing API surface
+from ..ops.paged_attention import BlockAllocator, RadixPrefixCache
+
+__all__ = ["BlockAllocator", "ContinuousBatchingEngine", "EngineSaturated",
+           "PrefixCacheConfig", "RadixPrefixCache", "Request"]
 
 
 class EngineSaturated(RuntimeError):
     """add_request refused: the engine's wait queue is at its high-water
     mark (``max_queue``). Admission control — callers shed load, retry with
     backoff, or scale out; the engine never hides an unbounded backlog."""
+
+
+@dataclasses.dataclass
+class PrefixCacheConfig:
+    """Knobs for the paged-KV prefix cache + chunked prefill
+    (``ContinuousBatchingEngine(prefix_cache=...)`` — docs/SERVING.md).
+
+    - ``prefill_chunk``: tokens prefilled per engine step per admitted slot
+      (rounded up to a page multiple; default ``min(max_len, 8 * page)``).
+      Long prompts advance one chunk per step INTERLEAVED with the decode
+      batch, so a 2k-token admit no longer stalls every decoding slot.
+    - ``extra_blocks``: pool headroom beyond the ``max_batch *
+      pages_per_seq`` working set, retained for cached prefixes (0 still
+      caches — prefix SHARING itself frees blocks)."""
+
+    prefill_chunk: Optional[int] = None
+    extra_blocks: int = 0
 
 
 class Request:
@@ -137,7 +175,10 @@ class ContinuousBatchingEngine:
     def __init__(self, model, max_batch: int = 8, max_len: int = 512,
                  page_size: int = 64, block_size: int = 8,
                  prompt_buckets: Optional[Sequence[int]] = None,
-                 max_queue: Optional[int] = None):
+                 max_queue: Optional[int] = None,
+                 prefix_cache: Union[bool, PrefixCacheConfig, None] = False,
+                 compile_cache_cap: int = 64,
+                 _unsafe_overcommit: bool = False):
         self.model = model
         self.max_batch = max_batch
         self.max_len = max_len
@@ -151,7 +192,43 @@ class ContinuousBatchingEngine:
         if self.prompt_buckets and self.prompt_buckets[-1] > max_len:
             raise ValueError(f"prompt bucket {self.prompt_buckets[-1]} "
                              f"exceeds max_len {max_len}")
-        self.caches = model._init_paged_caches(max_batch, max_len, page_size)
+        self.compile_cache_cap = max(1, int(compile_cache_cap))
+        if prefix_cache is True:
+            prefix_cache = PrefixCacheConfig()
+        elif not prefix_cache:
+            prefix_cache = None
+        self.prefix_cache = prefix_cache
+        self._maxp = -(-max_len // page_size)
+        # DRILL-ONLY knob (tools/fault_drill.py prefix_cache_exhaustion):
+        # allocate past pool capacity by ripping blocks out of the radix
+        # cache while live tables still map them — demonstrates the
+        # corruption the refcounted path exists to prevent. Never enable.
+        self._overcommit = bool(_unsafe_overcommit)
+        if prefix_cache is not None:
+            c = prefix_cache.prefill_chunk or min(max_len, 8 * page_size)
+            self._chunk_tokens = -(-int(c) // page_size) * page_size
+            n_blocks = (max_batch * self._maxp
+                        + max(0, int(prefix_cache.extra_blocks)))
+            # +1 page: parked decode rows (free / still-prefilling slots)
+            # write their dummy token into a dedicated parking page, never
+            # into a block another request may share
+            self.caches = model._init_paged_caches(
+                max_batch, max_len, page_size, num_blocks=n_blocks + 1)
+            self._park = n_blocks
+            self._alloc = BlockAllocator(n_blocks)
+            self._radix = RadixPrefixCache(page_size, self._alloc)
+            self._tables_host = np.full((max_batch, self._maxp), self._park,
+                                        np.int32)
+            self._tables_dirty = True
+            self._slot_rows: List[Optional[np.ndarray]] = [None] * max_batch
+            self._slot_blocks: List[Optional[List[int]]] = [None] * max_batch
+            self._prefill_next: Dict[int, int] = {}
+            self._jit_chunk: Dict[int, object] = {}
+            self._jit_first: Dict[tuple, object] = {}
+            self._cow_fn = None
+        else:
+            self.caches = model._init_paged_caches(max_batch, max_len,
+                                                   page_size)
         self._slots: List[Optional[Request]] = [None] * max_batch
         # per-slot NEXT write position (== tokens currently in the slot's cache)
         self._pos = np.zeros(max_batch, np.int32)
@@ -171,8 +248,16 @@ class ContinuousBatchingEngine:
         self._queue: collections.deque = collections.deque()
         self._finished: Dict[int, Request] = {}
         # host-side accounting: admission vs decode dispatch time (the
-        # admission-stall share is stats["admit_host_s"] / wall)
-        self.stats = {"admit_host_s": 0.0, "decode_host_s": 0.0}
+        # admission-stall share is stats["admit_host_s"] / wall) plus the
+        # prefix-cache counters (docs/SERVING.md: hit_tokens / miss_tokens
+        # feed serving_prefix_hit_rate; cow_copies / evictions expose block
+        # lifecycle; compile_cache_entries is the bounded-compile-cache
+        # telemetry, warned past ``compile_cache_cap``)
+        self.stats = {"admit_host_s": 0.0, "decode_host_s": 0.0,
+                      "compile_cache_entries": 0}
+        if self.prefix_cache is not None:
+            self.stats.update(hit_tokens=0, miss_tokens=0, cow_copies=0,
+                              evictions=0, prefill_host_s=0.0)
 
         from ..jit.api import _collect_state
 
@@ -197,6 +282,13 @@ class ContinuousBatchingEngine:
             raise ValueError(
                 f"prompt {len(req.prompt)} exceeds largest prompt bucket "
                 f"{self.prompt_buckets[-1]}")
+        if self.prefix_cache is not None:
+            need = self._pages_needed(len(req.prompt), req.max_new_tokens)
+            if need > self._alloc.num_blocks:
+                raise ValueError(
+                    f"request needs {need} KV blocks but the pool holds "
+                    f"{self._alloc.num_blocks} — raise "
+                    "PrefixCacheConfig.extra_blocks or shrink the request")
         # family-specific length limits (e.g. GPT's learned position table) —
         # the same validation generate() applies
         validate = getattr(self.model, "_validate_generate", None)
@@ -241,6 +333,22 @@ class ContinuousBatchingEngine:
         import time as _time
 
         self._evict_expired()
+        if self.prefix_cache is not None:
+            # chunked-prefill budget: the decode batch is dispatched first,
+            # then every mid-prefill slot advances by ONE chunk and newly
+            # complete prompts take their first token — a long admit costs
+            # each decode step one chunk of prefill, never a full prompt
+            decoding = any(r is not None and i not in self._prefill_next
+                           for i, r in enumerate(self._slots))
+            if decoding:
+                self._decode_block()
+            t0 = _time.perf_counter()
+            self._admit()
+            self._prefill_tick()
+            self.stats["admit_host_s"] += _time.perf_counter() - t0
+            if not decoding:
+                self._decode_block()
+            return
         if not any(s is not None for s in self._slots):
             t0 = _time.perf_counter()
             self._admit()
@@ -276,9 +384,9 @@ class ContinuousBatchingEngine:
         for i, req in enumerate(self._slots):
             if req is not None and expired(req):
                 fail(req)
-                self._slots[i] = None   # slot + its pages are free again
-                self._pos[i] = 0
-                self._temps[i] = 0.0
+                # prefix mode: DECREFs (never frees) blocks other live
+                # tables or the radix cache still reference
+                self._release_slot(i)
         if any(expired(r) for r in self._queue):
             keep = collections.deque()
             for r in self._queue:
@@ -298,10 +406,26 @@ class ContinuousBatchingEngine:
             self.stats["decode_host_s"] += _time.perf_counter() - t0
 
     def _decode_block_inner(self):
-        live = [(i, r) for i, r in enumerate(self._slots) if r is not None]
+        if self.prefix_cache is not None and self._tables_dirty:
+            # dynamic block tables: rows for decode-ready slots map their
+            # allocated (possibly shared) pages; free and still-prefilling
+            # rows point at the parking page so the scan's dummy append can
+            # never touch a block another request shares. The .copy() is
+            # LOAD-BEARING: jax borrows the host buffer for an async
+            # transfer, and _release_slot mutates _tables_host — without a
+            # private snapshot the scan can observe post-mutation rows
+            # (measured ~1/30 runs decoding against parking-page tables)
+            self.caches = {"kv": self.caches["kv"],
+                           "tables": jnp.asarray(self._tables_host.copy())}
+            self._tables_dirty = False
+        live = [(i, r) for i, r in enumerate(self._slots)
+                if r is not None and not (self.prefix_cache is not None
+                                          and i in self._prefill_next)]
         if not live:
             return
-        active = np.array([s is not None for s in self._slots])
+        active = np.zeros(self.max_batch, bool)
+        for i, _ in live:
+            active[i] = True
         # block length: never decode past a request's max_new_tokens or the
         # engine max_len (pages beyond the table would clamp-corrupt)
         cap = min(min(r.max_new_tokens - r._n_out for _, r in live),
@@ -347,12 +471,15 @@ class ContinuousBatchingEngine:
 
             self._jit_step = jax.jit(run,
                                      static_argnames=("n_steps", "do_sample"))
+            self._note_compiled()
         do_sample = bool(any(self._temps[i] > 0.0 for i, _ in live))
         if self._samp_dev is None:
-            self._samp_dev = (jnp.asarray(self._seeds),
-                              jnp.asarray(self._temps),
-                              jnp.asarray(self._tops),
-                              jnp.asarray(self._topks))
+            # private snapshots: jax borrows host buffers for async
+            # transfers and these arrays mutate on admission/slot-release
+            self._samp_dev = (jnp.asarray(self._seeds.copy()),
+                              jnp.asarray(self._temps.copy()),
+                              jnp.asarray(self._tops.copy()),
+                              jnp.asarray(self._topks.copy()))
         seeds_d, temps_d, tops_d, topks_d = self._samp_dev
         out, self._last_tok, self.caches = self._jit_step(
             self._params, toks, self.caches, pos_vec,
@@ -368,9 +495,7 @@ class ContinuousBatchingEngine:
                 if req._n_out >= req.max_new_tokens:
                     req.done = True
                     self._finished[req.rid] = req
-                    self._slots[i] = None   # slot + its pages are free again
-                    self._pos[i] = 0
-                    self._temps[i] = 0.0
+                    self._release_slot(i)   # slot + its pages are free again
             self._pending.append((out, entries))
             return
         # eos path: materialize (in generation order — drain older pendings
@@ -391,9 +516,7 @@ class ContinuousBatchingEngine:
             self._pos[i] += took
             if req.done:
                 self._finished[req.rid] = req
-                self._slots[i] = None       # slot + its pages are free again
-                self._pos[i] = 0
-                self._temps[i] = 0.0
+                self._release_slot(i)       # slot + its pages are free again
 
     def run_until_done(self, max_steps: int = 100000):
         steps = 0
@@ -429,7 +552,300 @@ class ContinuousBatchingEngine:
         self._pending.clear()
 
     # ---- internals ----
+    def _release_slot(self, i: int):
+        """Free slot ``i``. Prefix mode DECREFS the slot's blocks (a shared
+        prefix block stays alive while any other table or the radix cache
+        references it — freeing it would corrupt the survivors) and parks
+        the slot's decode-table row."""
+        self._slots[i] = None
+        self._pos[i] = 0
+        self._temps[i] = 0.0
+        if self.prefix_cache is not None:
+            blocks = self._slot_blocks[i]
+            if blocks:
+                self._alloc.decref(blocks)
+            self._slot_blocks[i] = None
+            self._slot_rows[i] = None
+            self._prefill_next.pop(i, None)
+            self._tables_host[i] = self._park
+            self._tables_dirty = True
+
+    def _pages_needed(self, prompt_len: int, max_new: int) -> int:
+        return -(-(prompt_len + max_new) // self.page_size)
+
+    def _note_compiled(self):
+        """Bounded-compile-cache telemetry (PT 1's PT-TRACE-001 churn lint,
+        in-process): serving programs key on shapes — admission group size,
+        prompt bucket, chunk width, sampling mode — so a shape-churning
+        workload compiles without bound. Track the entry count and warn
+        past ``compile_cache_cap``. (``_jit_step`` counts as one entry; its
+        n_steps variants live in jax's own jit cache.)"""
+        n = len(self._jit_prefill) + (self._jit_step is not None)
+        if self.prefix_cache is not None:
+            n += (len(self._jit_chunk) + len(self._jit_first)
+                  + (self._cow_fn is not None))
+        self.stats["compile_cache_entries"] = n
+        if n > self.compile_cache_cap:
+            import warnings
+
+            warnings.warn(
+                f"PT-TRACE-001: serving engine holds {n} compiled programs "
+                f"(cap {self.compile_cache_cap}) — admission-shape churn is "
+                "recompiling per wave; pin prompt_buckets / prefill_chunk "
+                "or raise compile_cache_cap", RuntimeWarning, stacklevel=3)
+
     def _admit(self):
+        if self.prefix_cache is not None:
+            return self._admit_prefix()
+        return self._admit_legacy()
+
+    # -- prefix-cache admission + chunked prefill ---------------------------
+    def _admit_prefix(self):
+        """Admission with radix prefix matching over the refcounted pool.
+
+        FIFO with head-of-line blocking on pool exhaustion: when the queue
+        head cannot get its blocks (even after LRU eviction of idle cached
+        blocks) it stays queued and later arrivals wait behind it — the
+        queue then fills and ``add_request`` backpressures via
+        ``EngineSaturated``; the allocator never overcommits shared blocks
+        (tools/fault_drill.py drills exactly this)."""
+        from ..distributed.resilience.faults import resource_hold
+
+        free = [i for i in range(self.max_batch) if self._slots[i] is None]
+        while free and self._queue:
+            req = self._queue[0]
+            held = resource_hold("serving.block_pool", f"rid:{req.rid}")
+            if held:
+                self._alloc.hold(held)
+            if not self._try_admit_prefix(free[0], req):
+                break
+            self._queue.popleft()
+            free.pop(0)
+        self.stats["evictions"] = self._radix.evictions
+
+    def _try_admit_prefix(self, slot: int, req: "Request") -> bool:
+        page = self.page_size
+        prompt = req.prompt
+        n_full = len(prompt) // page
+        matched = (self._radix.match(prompt[: n_full * page])
+                   if n_full else [])
+        cow_src = None
+        if matched and len(matched) * page == len(prompt):
+            # FULL-prompt hit: nothing to prefill, but the first-token
+            # re-step rewrites position L-1 inside the last shared block —
+            # copy-on-write it into a private page first
+            cow_src = matched[-1]
+            matched = matched[:-1]
+        need = self._pages_needed(len(prompt), req.max_new_tokens)
+        fresh_n = need - len(matched)          # includes the COW copy
+        # Pin the matched chain (and the COW source) BEFORE the
+        # eviction-capable alloc: they are refcount-0 CACHED-IDLE until
+        # incref'd, so evict_lru under shortfall could reclaim them and
+        # alloc would hand the same pages back as `fresh` — double-mapping
+        # a block in this slot's table (decode appends into the suffix
+        # copy would clobber the shared prefix k/v).
+        pinned = matched + ([cow_src] if cow_src is not None else [])
+        self._alloc.incref(pinned)
+        fresh = self._alloc.alloc(fresh_n, evict=self._radix.evict_lru)
+        if fresh is None and self._overcommit:
+            fresh = self._steal_blocks(fresh_n, avoid=set(pinned))
+        if fresh is None:
+            self._alloc.decref(pinned)
+            return False                       # pool exhausted — defer
+        cached = len(matched) * page
+        if cow_src is not None:
+            dst = fresh[0]
+            self._cow_copy(cow_src, dst)
+            self.stats["cow_copies"] += 1
+            self._alloc.decref([cow_src])      # copy done — unpin the source
+            blocks = matched + [dst] + fresh[1:]
+            cached = len(prompt)
+        else:
+            blocks = matched + fresh
+        row = np.full(self._maxp, self._park, np.int32)
+        row[: len(blocks)] = blocks
+        self._slot_rows[slot] = row
+        self._slot_blocks[slot] = blocks
+        self._slots[slot] = req
+        # next uncached write position; == len(prompt) means straight to
+        # the first-token re-step. The slot joins the decode batch (and the
+        # device-side table) only once prefill completes.
+        self._prefill_next[slot] = cached
+        self.stats["hit_tokens"] += cached
+        self.stats["miss_tokens"] += len(prompt) - cached
+        return True
+
+    def _steal_blocks(self, n: int, avoid=()):
+        """DRILL-ONLY (``_unsafe_overcommit``): what a refcount-less
+        allocator does under exhaustion — rip LRU radix leaves out of the
+        cache and hand them to the new request even though live tables
+        still map them. The fault drill asserts the resulting shared-block
+        corruption; production admission defers instead."""
+        legit = list(self._alloc.alloc(min(n, self._alloc.free_blocks)) or [])
+        stolen = []
+        victims = sorted(self._radix._by_block.values(),
+                         key=lambda nd: nd.last_used)
+        for nd in victims:
+            if len(legit) + len(stolen) >= n:
+                break
+            if nd.block in avoid or nd.children:
+                continue
+            nd.parent.children.pop(nd.key, None)
+            del self._radix._by_block[nd.block]
+            self._alloc._ref[nd.block] = self._alloc._ref.get(nd.block, 0) + 1
+            stolen.append(nd.block)
+        if len(legit) + len(stolen) < n:
+            self._alloc.decref(stolen + legit)
+            return None
+        # stolen pages first: they become the thief's PROMPT blocks, so its
+        # very next prefill overwrites a page the victim still reads
+        return stolen + legit
+
+    def _cow_copy(self, src: int, dst: int):
+        if self._cow_fn is None:
+            from ..ops.paged_attention import copy_pages
+
+            def run(kv, src, dst):
+                return [copy_pages(k, v, src, dst) for (k, v) in kv]
+
+            self._cow_fn = jax.jit(run)
+            self._note_compiled()
+        self.caches = {"kv": self._cow_fn(self.caches["kv"], np.int32(src),
+                                          np.int32(dst)),
+                       "tables": self.caches["tables"]}
+
+    def _prefill_tick(self):
+        """One chunk of prefill per mid-prefill slot, then the first-token
+        re-step (+ radix registration) for slots whose prompts are fully
+        written. Chunks are batched across slots at per-row offsets; the
+        re-step runs through ``paged_token_step`` so warm (cache-hit) and
+        cold admissions share one program per shape — the warm==cold
+        bit-identity guarantee (see ops.paged_prefill_attention)."""
+        import time as _time
+
+        if not self._prefill_next:
+            return
+        t0 = _time.perf_counter()
+        try:
+            chunkers = [(s, self._slots[s]) for s in sorted(self._prefill_next)
+                        if self._prefill_next[s] < len(self._slots[s].prompt)]
+            if chunkers:
+                self._run_chunk(chunkers)
+            ready = [(s, self._slots[s]) for s in sorted(self._prefill_next)
+                     if self._prefill_next[s] >= len(self._slots[s].prompt)]
+            if ready:
+                self._first_token(ready)
+        finally:
+            self.stats["prefill_host_s"] += _time.perf_counter() - t0
+
+    def _run_chunk(self, group):
+        C = self._chunk_tokens
+        g = len(group)
+        ids = np.zeros((g, C), np.int32)
+        starts = np.zeros(g, np.int32)
+        rows = np.stack([self._slot_rows[s] for s, _ in group])
+        for r, (s, req) in enumerate(group):
+            nxt = self._prefill_next[s]
+            chunk = req.prompt[nxt: nxt + C]
+            ids[r, : len(chunk)] = chunk
+            starts[r] = nxt
+        fn = self._jit_chunk.get(g)
+        if fn is None:
+            from ..core import autograd_engine
+            from ..jit.api import _Swap
+
+            def run(params, ids, kv, rows, starts):
+                sub = {"kv": kv, "tables": rows}
+                with autograd_engine.no_grad(), _Swap(self._tensors, params):
+                    sub = self.model.paged_prefill_chunk(ids, sub, starts)
+                return sub["kv"]
+
+            fn = self._jit_chunk[g] = jax.jit(run)
+            self._note_compiled()
+        new_kv = fn(self._params, jnp.asarray(ids), self.caches["kv"],
+                    jnp.asarray(rows), jnp.asarray(starts))
+        self.caches = {"kv": new_kv, "tables": self.caches["tables"]}
+        for s, req in group:
+            self._prefill_next[s] = min(self._prefill_next[s] + C,
+                                        len(req.prompt))
+
+    def _first_token(self, ready):
+        """Re-step the last REAL prompt token at its true position (k/v
+        rewrite into a private/COW block, logits over exactly the real
+        prompt) and sample the first token — the chunked-path analogue of
+        the legacy bucketed re-step; then register the prompt's full blocks
+        in the radix cache and promote the slot into the decode batch."""
+        g = len(ready)
+        do_sample = any(r.temperature > 0.0 for _, r in ready)
+        last = np.array([r.prompt[-1] for _, r in ready], np.int32)
+        rows = np.stack([self._slot_rows[s] for s, _ in ready])
+        ints = np.asarray([[len(r.prompt), r.seed, r.top_k, s]
+                           for s, r in ready], np.int32)
+        floats = np.asarray([[r.temperature, r.top_p] for _, r in ready],
+                            np.float32)
+        fn = self._jit_first.get((g, do_sample))
+        if fn is None:
+            from ..core import autograd_engine
+            from ..jit.api import _Swap
+
+            def run(params, last, kv, rows, last_tok, ints, floats,
+                    _sample=do_sample):
+                true_len, seed, top_k, slots_ = (ints[:, 0], ints[:, 1],
+                                                 ints[:, 2], ints[:, 3])
+                temp, top_p = floats[:, 0], floats[:, 1]
+                sub = {"kv": kv, "tables": rows}
+                with autograd_engine.no_grad(), _Swap(self._tensors, params):
+                    logits, sub = self.model.paged_token_step(
+                        last, sub, true_len - 1)
+                if _sample:
+                    keys = _fold_keys(seed, true_len)
+                    nxt = sample_rows(logits, keys, temp, top_p, top_k)
+                else:
+                    nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+                return nxt, sub["kv"], last_tok.at[slots_].set(nxt)
+
+            fn = self._jit_first[(g, do_sample)] = jax.jit(run)
+            self._note_compiled()
+        firsts_dev, new_kv, self._last_tok = fn(
+            self._params, jnp.asarray(last), self.caches["kv"],
+            jnp.asarray(rows), self._last_tok, jnp.asarray(ints),
+            jnp.asarray(floats))
+        self.caches = {"kv": new_kv, "tables": self.caches["tables"]}
+        self._samp_dev = None   # sampling params change -> re-upload lazily
+        any_eos = any(r.eos_token_id is not None for _, r in ready)
+        firsts = np.asarray(firsts_dev) if any_eos else None
+        entries = []
+        for row, (slot, req) in enumerate(ready):
+            n_full = len(req.prompt) // self.page_size
+            if n_full:
+                # register AFTER the full prompt (incl. the re-step rewrite)
+                # is scheduled — later admissions are device-ordered behind
+                # these writes; first writer wins on duplicate chains
+                self._radix.insert(req.prompt[: n_full * self.page_size],
+                                   self._slot_blocks[slot][:n_full])
+            del self._prefill_next[slot]
+            self._temps[slot] = req.temperature
+            self._tops[slot] = req.top_p
+            self._topks[slot] = req.top_k
+            self._seeds[slot] = req.seed
+            req._n_out += 1
+            self._pos[slot] = len(req.prompt) + 1
+            self._tables_host[slot] = self._slot_rows[slot]
+            self._tables_dirty = True
+            if firsts is not None:
+                req.output.append(int(firsts[row]))
+            else:
+                entries.append((row, req, 1))
+            if ((firsts is not None and req.eos_token_id is not None
+                 and int(firsts[row]) == req.eos_token_id)
+                    or req._n_out >= req.max_new_tokens):
+                req.done = True
+                self._finished[req.rid] = req
+                self._release_slot(slot)
+        if entries:
+            self._pending.append((firsts_dev, entries))
+
+    def _admit_legacy(self):
         """Admit queued requests into free slots — ONE batched prefill call
         per prompt bucket (per-request prefills pay a full host round trip
         each through a remote runtime; batching amortizes it and runs the
@@ -475,9 +891,7 @@ class ContinuousBatchingEngine:
                         or req._n_out >= req.max_new_tokens):
                     req.done = True
                     self._finished[req.rid] = req
-                    self._slots[slot] = None
-                    self._pos[slot] = 0
-                    self._temps[slot] = 0.0
+                    self._release_slot(slot)
             if entries:
                 self._pending.append((firsts_dev, entries))
 
@@ -543,6 +957,7 @@ class ContinuousBatchingEngine:
                 return nxt, sub["kv"], last_tok.at[slots_].set(nxt)
 
             fn = self._jit_prefill[(padded, restep, do_sample)] = jax.jit(run)
+            self._note_compiled()
         ints = np.asarray([[len(r.prompt), r.seed, r.top_k, s]
                            for s, r in grp], np.int32)
         floats = np.asarray([[r.temperature, r.top_p] for _, r in grp],

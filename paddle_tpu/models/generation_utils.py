@@ -140,17 +140,23 @@ class GenerationMixin:
         cache[key] = (prefill, block)
         return prefill, block
 
-    def _init_paged_caches(self, b, max_len, page_size=64):
+    def _init_paged_caches(self, b, max_len, page_size=64, num_blocks=None):
         """Paged-KV pools (serving layout, ops/paged_attention.py): per-layer
         page pools + a shared block table with pages statically assigned per
-        sequence. Families with a different cache layout override this."""
+        sequence. ``num_blocks`` overrides the pool size (>= b * pages_per_
+        seq) for engines that manage pages dynamically — prefix caching
+        needs headroom for retained cache blocks plus a parking page.
+        Families with a different cache layout override this."""
         cfg = self.config
         kvh = getattr(cfg, "num_key_value_heads", cfg.num_attention_heads)
         hd = cfg.head_dim
         dtype = next(iter(p._data.dtype for _, p in self.named_parameters()))
         maxp = -(-max_len // page_size)
-        npages = b * maxp
-        tables = jnp.arange(npages, dtype=jnp.int32).reshape(b, maxp)
+        npages = b * maxp if num_blocks is None else int(num_blocks)
+        if npages < b * maxp:
+            raise ValueError(f"num_blocks {npages} < {b * maxp} — the pool "
+                             "cannot back every slot's table")
+        tables = jnp.arange(b * maxp, dtype=jnp.int32).reshape(b, maxp)
         kv = [(jnp.zeros((npages, kvh, page_size, hd), dtype),
                jnp.zeros((npages, kvh, page_size, hd), dtype))
               for _ in range(cfg.num_hidden_layers)]

@@ -122,6 +122,33 @@ class GPTAttention(Layer):
         return (jnp.matmul(out, self.out_weight._data)
                 + self.out_bias._data, k_pages, v_pages)
 
+    def paged_prefill_chunk(self, x, k_pages, v_pages, tables, starts):
+        """Prefill CHUNK at per-row absolute offsets over cached history
+        (prefix-cache / chunked-prefill serving path) — llama analogue."""
+        from ...ops.paged_attention import (append_paged_kv,
+                                            paged_prefill_attention)
+
+        x = _raw(x)
+        b, s, h = x.shape
+        hd = self.config.head_dim
+        page = k_pages.shape[2]
+        max_len = tables.shape[1] * page
+        qkv = jnp.matmul(x, self.qkv_weight._data) + self.qkv_bias._data
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, s, self.num_heads, hd)
+        k = k.reshape(b, s, self.num_heads, hd)
+        v = v.reshape(b, s, self.num_heads, hd)
+        seq_ids = jnp.repeat(jnp.arange(b, dtype=jnp.int32), s)
+        positions = jnp.clip(starts[:, None] + jnp.arange(s, dtype=jnp.int32),
+                             0, max_len - 1).reshape(-1)
+        k_pages, v_pages = append_paged_kv(
+            k_pages, v_pages, k.reshape(b * s, self.num_heads, hd),
+            v.reshape(b * s, self.num_heads, hd), tables, positions, seq_ids)
+        out = paged_prefill_attention(q, k_pages, v_pages, tables, starts)
+        out = out.reshape(b, s, h)
+        return (jnp.matmul(out, self.out_weight._data)
+                + self.out_bias._data, k_pages, v_pages)
+
     def paged_token_step(self, x, k_pages, v_pages, tables, pos_vec):
         """ONE token per row at PER-ROW positions (continuous batching)."""
         from ...ops.paged_attention import append_paged_kv, paged_decode_attention
@@ -225,6 +252,14 @@ class GPTDecoderLayer(Layer):
         x = x + _raw(self.mlp(self.ln_2(x)))
         return x, k_pages, v_pages
 
+    def paged_prefill_chunk(self, hidden, k_pages, v_pages, tables, starts):
+        x = _raw(hidden)
+        a, k_pages, v_pages = self.attn.paged_prefill_chunk(
+            self.ln_1(x), k_pages, v_pages, tables, starts)
+        x = x + a
+        x = x + _raw(self.mlp(self.ln_2(x)))
+        return x, k_pages, v_pages
+
     def decode_step(self, hidden, k_cache, v_cache, pos, pad_bias=None):
         x = _raw(hidden)
         a, k_cache, v_cache = self.attn.decode_step(
@@ -306,6 +341,22 @@ class GPTForCausalLM(GenerationMixin, Layer):
         hidden = _raw(self.gpt.ln_f(x))
         logits = jnp.matmul(hidden[:, -1], self.gpt.wte._data.T)
         return logits.astype(jnp.float32), {"kv": new_kv, "tables": tables}
+
+    def paged_prefill_chunk(self, ids, caches, starts):
+        """Serving hook (see the llama analogue): one prefill chunk per row
+        at per-row absolute offsets over cached history; returns caches."""
+        ids = _raw(ids)
+        b, s = ids.shape
+        positions = jnp.clip(starts[:, None] + jnp.arange(s)[None, :], 0,
+                             self.config.max_position_embeddings - 1)
+        x = (jnp.take(self.gpt.wte._data, ids, axis=0)
+             + self.gpt.wpe._data[positions])
+        tables = caches["tables"]
+        new_kv = []
+        for layer, (kp, vp) in zip(self.gpt.layers, caches["kv"]):
+            x, kp, vp = layer.paged_prefill_chunk(x, kp, vp, tables, starts)
+            new_kv.append((kp, vp))
+        return {"kv": new_kv, "tables": tables}
 
     def _decode_chunk(self, ids, caches, pos, pad_bias, pos_offset):
         ids = _raw(ids)

@@ -251,6 +251,41 @@ class LlamaAttention(Layer):
         out = out.reshape(b, s, self.num_heads * hd)
         return jnp.matmul(out, self.o_proj_weight._data), k_pages, v_pages
 
+    def paged_prefill_chunk(self, x, cos, sin, k_pages, v_pages, tables,
+                            starts):
+        """Prefill CHUNK at PER-ROW absolute offsets over cached history
+        (prefix-cache / chunked-prefill serving path). x: [b, s, h] — row b
+        holds tokens at absolute positions [starts[b], starts[b]+s);
+        cos/sin [b, s, d] gathered per row. The chunk's k/v scatter into the
+        pages first, then attention gathers the FULL table extent with an
+        absolute-position causal mask — see paged_prefill_attention for the
+        bit-identity-across-chunkings argument."""
+        from ...ops.paged_attention import (append_paged_kv,
+                                            paged_prefill_attention)
+
+        x = x._data if isinstance(x, Tensor) else x
+        b, s, _ = x.shape
+        hd = self.config.head_dim
+        page = k_pages.shape[2]
+        max_len = tables.shape[1] * page
+        q = jnp.matmul(x, self.q_proj_weight._data).reshape(b, s, self.num_heads, hd)
+        k = jnp.matmul(x, self.k_proj_weight._data).reshape(b, s, self.num_kv_heads, hd)
+        v = jnp.matmul(x, self.v_proj_weight._data).reshape(b, s, self.num_kv_heads, hd)
+        q, k = apply_rotary_pos_emb(q, k, cos, sin)
+        seq_ids = jnp.repeat(jnp.arange(b, dtype=jnp.int32), s)
+        # pad rows of a final chunk land past the prompt; clipping keeps the
+        # scatter in-table (garbage there is masked, then overwritten as
+        # decode advances — the standard padded-prefill invariant)
+        positions = jnp.clip(starts[:, None] + jnp.arange(s, dtype=jnp.int32),
+                             0, max_len - 1).reshape(-1)
+        k_pages, v_pages = append_paged_kv(
+            k_pages, v_pages, k.reshape(b * s, self.num_kv_heads, hd),
+            v.reshape(b * s, self.num_kv_heads, hd), tables, positions,
+            seq_ids)
+        out = paged_prefill_attention(q, k_pages, v_pages, tables, starts)
+        out = out.reshape(b, s, self.num_heads * hd)
+        return jnp.matmul(out, self.o_proj_weight._data), k_pages, v_pages
+
     def paged_token_step(self, x, cos, sin, k_pages, v_pages, tables, pos_vec):
         """ONE token per row at PER-ROW positions (continuous batching:
         every slot is at a different decode offset). x: [b, 1, h];
@@ -425,6 +460,17 @@ class LlamaDecoderLayer(Layer):
         a, k_pages, v_pages = self.self_attn.paged_token_step(
             self.input_layernorm(x), cos, sin, k_pages, v_pages, tables,
             pos_vec)
+        x = x + a
+        y = self.mlp(self.post_attention_layernorm(x))
+        x = x + (y._data if isinstance(y, Tensor) else y)
+        return x, k_pages, v_pages
+
+    def paged_prefill_chunk(self, hidden, cos, sin, k_pages, v_pages, tables,
+                            starts):
+        x = hidden._data if isinstance(hidden, Tensor) else hidden
+        a, k_pages, v_pages = self.self_attn.paged_prefill_chunk(
+            self.input_layernorm(x), cos, sin, k_pages, v_pages, tables,
+            starts)
         x = x + a
         y = self.mlp(self.post_attention_layernorm(x))
         x = x + (y._data if isinstance(y, Tensor) else y)
@@ -624,6 +670,33 @@ class LlamaForCausalLM(GenerationMixin, Layer):
         logits = self.logits(hidden[:, -1:])
         return logits[:, -1].astype(jnp.float32), {"kv": new_kv,
                                                    "tables": tables}
+
+    def paged_prefill_chunk(self, ids, caches, starts):
+        """Serving hook: prefill ONE chunk per row at per-row absolute
+        offsets, attending over the already-cached prefix (prefix-cache /
+        chunked-prefill path — inference/serving.py). ids [b, s] int32,
+        starts [b] int32; returns updated caches only (the first sampled
+        token comes from the subsequent paged_token_step re-step, so no
+        lm-head work here)."""
+        cfg = self.config
+        model = self.model
+        x = jnp.take(model.embed_tokens_weight._data, ids, axis=0)
+        tables = caches["tables"]
+        page = caches["kv"][0][0].shape[2]
+        max_len = tables.shape[1] * page
+        cos_full, sin_full = _rope_cos_sin(max_len, cfg.head_dim,
+                                           cfg.rope_theta, x.dtype)
+        s = ids.shape[1]
+        positions = jnp.clip(starts[:, None] + jnp.arange(s)[None, :],
+                             0, max_len - 1)
+        cos = cos_full[positions]
+        sin = sin_full[positions]
+        new_kv = []
+        for layer, (kp, vp) in zip(model.layers, caches["kv"]):
+            x, kp, vp = layer.paged_prefill_chunk(x, cos, sin, kp, vp,
+                                                  tables, starts)
+            new_kv.append((kp, vp))
+        return {"kv": new_kv, "tables": tables}
 
     def remat_policy(self):
         """Engine hook: the jax.checkpoint policy for this model's blocks."""

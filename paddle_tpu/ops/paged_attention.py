@@ -24,7 +24,9 @@ Decode kernel design (measured 435 GB/s-class architecture, v5e):
 
 from __future__ import annotations
 
+import collections
 import functools
+from typing import Callable, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -241,6 +243,277 @@ def paged_decode_attention(q, k_cache, v_cache, block_tables, context_lens,
       jnp.ones((1,), jnp.int32),    # init flag
       qr, k_cache, v_cache)
     return out.reshape(b, hq, d)
+
+
+# ---------------------------------------------------------------------------
+# chunk prefill over cached history (prefix cache / chunked-prefill path)
+# ---------------------------------------------------------------------------
+
+def paged_prefill_attention(q, k_cache, v_cache, block_tables, chunk_starts,
+                            scale=None):
+    """Attention for a prefill CHUNK whose rows sit at per-row absolute
+    offsets inside already-partially-filled paged caches.
+
+    q: [b, s, hq, d] — queries for tokens at absolute positions
+    ``chunk_starts[b] + i`` (i in [0, s)); the chunk's own k/v must already
+    be appended into the pages (append-then-gather, so within-chunk keys and
+    the cached prefix are read through ONE code path). Returns [b, s, hq, d].
+
+    Keys are gathered densely from the block table (full ``max_pages*page``
+    extent) and masked by absolute position: query at position p attends
+    keys at positions <= p. The mask depends only on ABSOLUTE positions and
+    the gathered extent is fixed per engine, so GIVEN the same cached k/v
+    bytes a token's output is bit-identical no matter how the prompt is
+    chunked or how much of it came from the prefix cache — the property the
+    serving engine's warm==cold token-equality guarantee rests on (the
+    engine's module docstring scopes what "same bytes" means at re-stepped
+    block-final positions). Stays an XLA gather+einsum (no Pallas
+    kernel): prefill is projection/MLP-bound at serving chunk sizes and this
+    runs once per admitted chunk, unlike the per-token decode kernel."""
+    b, s, hq, d = q.shape
+    n_pages, hkv, page, _ = k_cache.shape
+    group = hq // hkv
+    if scale is None:
+        scale = d ** -0.5
+    max_pages = block_tables.shape[1]
+    L = max_pages * page
+    safe_tables = jnp.maximum(block_tables, 0)
+    kg = jnp.swapaxes(k_cache[safe_tables], 2, 3).reshape(b, L, hkv, d)
+    vg = jnp.swapaxes(v_cache[safe_tables], 2, 3).reshape(b, L, hkv, d)
+    kg = jnp.swapaxes(kg, 1, 2).astype(jnp.float32)      # [b, hkv, L, d]
+    vg = jnp.swapaxes(vg, 1, 2).astype(jnp.float32)
+    qf = q.reshape(b, s, hkv, group, d).astype(jnp.float32)
+    qf = jnp.transpose(qf, (0, 2, 3, 1, 4))              # [b, hkv, g, s, d]
+    sc = jnp.einsum("bhgsd,bhld->bhgsl", qf, kg) * scale
+    q_pos = chunk_starts[:, None] + jnp.arange(s)        # [b, s] absolute
+    keep = (jnp.arange(L)[None, None, :]
+            <= q_pos[:, :, None])                        # [b, s, L]
+    sc = jnp.where(keep[:, None, None, :, :], sc, NEG_INF)
+    p = jax.nn.softmax(sc, axis=-1)
+    out = jnp.einsum("bhgsl,bhld->bhgsd", p, vg)
+    out = jnp.transpose(out, (0, 3, 1, 2, 4)).reshape(b, s, hq, d)
+    return out.astype(q.dtype)
+
+
+def copy_pages(k_cache, v_cache, src, dst):
+    """Copy ONE page ``src`` -> ``dst`` across a (k, v) pool pair — the
+    copy-on-write primitive for shared prefix blocks. Traced-index friendly:
+    one compiled program serves every (src, dst)."""
+    src = jnp.asarray(src)
+    k_cache = jax.lax.dynamic_update_index_in_dim(
+        k_cache, jax.lax.dynamic_index_in_dim(k_cache, src, 0, False), dst, 0)
+    v_cache = jax.lax.dynamic_update_index_in_dim(
+        v_cache, jax.lax.dynamic_index_in_dim(v_cache, src, 0, False), dst, 0)
+    return k_cache, v_cache
+
+
+# ---------------------------------------------------------------------------
+# refcounted block allocator + radix prefix cache (host-side bookkeeping)
+# ---------------------------------------------------------------------------
+
+class BlockAllocator:
+    """Refcounted allocator over the paged-KV pool's page ids.
+
+    Page states: FREE (in the free list), ACTIVE (refcount >= 1 — mapped
+    into at least one request's block table), CACHED-IDLE (refcount == 0 but
+    still registered in a :class:`RadixPrefixCache` — its KV content is
+    retained for future prefix hits and reclaimed lazily via LRU eviction),
+    or HELD (fault-drill resource exhaustion, ``hold()``).
+
+    Refcounts count REQUEST references only: ``alloc`` hands out fresh
+    blocks at refcount 1, every additional request sharing a block calls
+    ``incref``, and ``decref`` at request completion/eviction returns the
+    block to the free list ONLY when nothing else references it and no
+    prefix cache retains it — freeing a block another request still reads
+    is the corruption class the serving fault drill exercises."""
+
+    def __init__(self, num_blocks: int):
+        self.num_blocks = int(num_blocks)
+        self._free = collections.deque(range(self.num_blocks))
+        self._ref: Dict[int, int] = {}
+        self._held: List[int] = []
+        # wired by the owner after constructing the radix cache:
+        # is_cached(block) -> bool keeps refcount-0 blocks out of the free
+        # list while a prefix cache still maps them
+        self.is_cached: Callable[[int], bool] = lambda b: False
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def refcount(self, block: int) -> int:
+        return self._ref.get(block, 0)
+
+    def hold(self, n: int) -> int:
+        """Remove up to ``n`` free blocks from circulation (fault injection:
+        seeded pool exhaustion). Returns how many were actually held."""
+        took = 0
+        while took < n and self._free:
+            self._held.append(self._free.popleft())
+            took += 1
+        return took
+
+    def release_held(self) -> int:
+        n = len(self._held)
+        self._free.extend(self._held)
+        self._held.clear()
+        return n
+
+    def alloc(self, n: int,
+              evict: Optional[Callable[[int], int]] = None,
+              ) -> Optional[List[int]]:
+        """Allocate ``n`` blocks at refcount 1. When the free list is short,
+        ``evict(shortfall)`` (the radix cache's LRU reclaimer) may free
+        cached-idle blocks first. Returns None when the pool genuinely
+        cannot satisfy the request — callers defer/backpressure, they never
+        overcommit."""
+        if n <= 0:
+            return []
+        if len(self._free) < n and evict is not None:
+            evict(n - len(self._free))
+        if len(self._free) < n:
+            return None
+        out = [self._free.popleft() for _ in range(n)]
+        for b in out:
+            self._ref[b] = 1
+        return out
+
+    def incref(self, blocks: Sequence[int]) -> None:
+        for b in blocks:
+            rc = self._ref.get(b, 0)
+            if rc == 0 and not self.is_cached(b):
+                raise RuntimeError(
+                    f"incref of free block {b} — a prefix-cache hit mapped "
+                    "a block the allocator does not consider live")
+            # rc == 0 with is_cached: a CACHED-IDLE block coming back into
+            # active service on a prefix hit
+            self._ref[b] = rc + 1
+
+    def decref(self, blocks: Sequence[int]) -> None:
+        for b in blocks:
+            rc = self._ref.get(b, 0)
+            if rc <= 0:
+                raise RuntimeError(f"decref of free block {b} (double free)")
+            if rc == 1:
+                del self._ref[b]
+                if not self.is_cached(b):
+                    self._free.append(b)
+            else:
+                self._ref[b] = rc - 1
+
+    def free_cached(self, block: int) -> None:
+        """Return a CACHED-IDLE block to the free list — only the radix
+        cache's eviction path may call this, after unregistering it."""
+        if self._ref.get(block, 0):
+            raise RuntimeError(
+                f"evicting block {block} with refcount "
+                f"{self._ref[block]} — still mapped by a live request")
+        self._free.append(block)
+
+
+class _RadixNode:
+    __slots__ = ("children", "block", "parent", "key", "last_used")
+
+    def __init__(self, parent=None, key=None, block=None):
+        self.children: Dict[tuple, "_RadixNode"] = {}
+        self.parent = parent
+        self.key = key
+        self.block = block
+        self.last_used = 0
+
+
+class RadixPrefixCache:
+    """Radix/trie over page-sized prompt-token chunks -> filled KV blocks.
+
+    Each node maps ONE full block of ``page_size`` prompt tokens to the page
+    holding that block's k/v (page ids are shared by every layer's pool, so
+    one id is the whole transformer's prefix block). ``match`` walks the
+    longest fully-cached prefix; ``insert`` registers a request's freshly
+    prefilled full prompt blocks (first writer wins — a duplicate chain from
+    a same-wave miss simply stays private to its request). Eviction is LRU
+    over leaf nodes whose blocks have refcount 0, cascading upward, so a
+    cached chain is never broken in the middle."""
+
+    def __init__(self, page_size: int, allocator: BlockAllocator):
+        self.page_size = int(page_size)
+        self.allocator = allocator
+        self.root = _RadixNode()
+        self._by_block: Dict[int, _RadixNode] = {}
+        self._tick = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        allocator.is_cached = self.has_block
+
+    def __len__(self) -> int:
+        return len(self._by_block)
+
+    def has_block(self, block: int) -> bool:
+        return block in self._by_block
+
+    def _chunks(self, tokens) -> List[tuple]:
+        p = self.page_size
+        n = len(tokens) // p
+        return [tuple(int(t) for t in tokens[i * p:(i + 1) * p])
+                for i in range(n)]
+
+    def match(self, tokens) -> List[int]:
+        """Longest-prefix match over FULL blocks; returns the cached block
+        ids in order (possibly empty). Bumps LRU recency along the path;
+        the caller increfs before mapping them into a table."""
+        self._tick += 1
+        node = self.root
+        out: List[int] = []
+        for key in self._chunks(tokens):
+            child = node.children.get(key)
+            if child is None:
+                break
+            child.last_used = self._tick
+            out.append(child.block)
+            node = child
+        if out:
+            self.hits += 1
+        else:
+            self.misses += 1
+        return out
+
+    def insert(self, tokens, blocks: Sequence[int]) -> List[int]:
+        """Register ``blocks[i]`` as the cache entry for the i-th full block
+        of ``tokens``. Existing nodes keep their block (the duplicate stays
+        private to the inserting request). Returns the block ids newly
+        registered."""
+        self._tick += 1
+        node = self.root
+        registered: List[int] = []
+        for key, block in zip(self._chunks(tokens), blocks):
+            child = node.children.get(key)
+            if child is None:
+                child = _RadixNode(parent=node, key=key, block=int(block))
+                node.children[key] = child
+                self._by_block[child.block] = child
+                registered.append(child.block)
+            child.last_used = self._tick
+            node = child
+        return registered
+
+    def evict_lru(self, n: int) -> int:
+        """Evict up to ``n`` blocks — LRU over refcount-0 LEAVES, cascading
+        to parents as they become leaves. Returns how many blocks went back
+        to the free list."""
+        freed = 0
+        while freed < n:
+            victims = [nd for nd in self._by_block.values()
+                       if not nd.children
+                       and self.allocator.refcount(nd.block) == 0]
+            if not victims:
+                break
+            victim = min(victims, key=lambda nd: nd.last_used)
+            victim.parent.children.pop(victim.key)
+            del self._by_block[victim.block]
+            self.allocator.free_cached(victim.block)
+            self.evictions += 1
+            freed += 1
+        return freed
 
 
 # ---------------------------------------------------------------------------

@@ -29,7 +29,10 @@ def test_manifest_counts_cover_reference_parity():
     means updating both the manifest and this pin in the same change."""
     m = json.load(open(os.path.join(ROOT, "tools", "api_manifest.json")))
     exact = {
-        "paddle": 533,       # round 4: + geometric/hub/onnx/regularizer/dataset/utils/version
+        "paddle": 535,       # round 4: + geometric/hub/onnx/regularizer/dataset/utils/version;
+                             # prefix-cache PR: + models/ops submodule attrs
+                             # (the gate imports inference.serving, which
+                             # binds them on the package)
         "paddle.nn": 154,
         "paddle.nn.functional": 156,
         "paddle.linalg": 46,
@@ -43,6 +46,10 @@ def test_manifest_counts_cover_reference_parity():
         "paddle.incubate.nn.functional": 23,
         "paddle.geometric": 11,
         "paddle.incubate.asp": 15,
+        # prefix-cache PR (docs/SERVING.md): the serving engine surface —
+        # ContinuousBatchingEngine, Request, EngineSaturated,
+        # PrefixCacheConfig, BlockAllocator, RadixPrefixCache
+        "paddle.inference.serving": 6,
     }
     for k, n in exact.items():
         assert len(m[k]) == n, (k, len(m[k]), n)
@@ -139,9 +146,10 @@ def test_graph_lint_gate_detects_seeded_defects():
 
 
 def test_fault_drill_matrix():
-    """Resilience gate (docs/RESILIENCE.md + docs/NUMERIC_GUARD.md): the
-    seeded fault matrix — heartbeat loss, store stall, shard corruption,
-    engine saturation, serving deadline, NaN gradient, loss spike, poisoned
+    """Resilience gate (docs/RESILIENCE.md + docs/NUMERIC_GUARD.md +
+    docs/SERVING.md): the seeded fault matrix — heartbeat loss, store
+    stall, shard corruption, engine saturation, serving deadline,
+    prefix-cache block-pool exhaustion, NaN gradient, loss spike, poisoned
     batch — must be absorbed with recovery enabled AND flip the exit code
     with recovery disabled. Runs in a subprocess (the drill forces the
     pure-Python store daemon for server-side faults)."""
@@ -151,7 +159,7 @@ def test_fault_drill_matrix():
          "--selftest"],
         capture_output=True, text=True, env=env, cwd=ROOT, timeout=500)
     assert r.returncode == 0, r.stdout + r.stderr
-    assert "FAULT DRILL OK: 8 fault classes" in r.stdout, r.stdout
+    assert "FAULT DRILL OK: 9 fault classes" in r.stdout, r.stdout
 
 
 def test_fault_drill_single_drill_exit_codes():
@@ -237,6 +245,39 @@ def test_bench_regression_gate_guard_overhead(tmp_path):
     assert r.returncode == 1 and "guard_overhead_pct" in r.stdout
     # metric absent on either side: vacuous pass (guard, not a ratchet)
     assert run(primary, [primary, {**guard, "value": 50.0}]).returncode == 0
+    assert run(base, [primary]).returncode == 0
+
+
+def test_bench_regression_gate_secondary_prefix_cache(tmp_path):
+    """serving_prefix_hit_rate / serving_prefill_tokens_per_sec secondary
+    logic ('higher' direction): a hit-rate collapse past 20% fails naming
+    the metric; small jitter and metric absence pass."""
+    gate = os.path.join(ROOT, "tools", "check_bench_regression.py")
+    g2 = tmp_path / "tools" / "check_bench_regression.py"
+    g2.parent.mkdir(exist_ok=True)
+    g2.write_text(open(gate).read())
+    primary = {"metric": "llama_pretrain_tokens_per_sec_per_chip",
+               "value": 100.0, "unit": "tok/s", "vs_baseline": 1.0}
+    hit = {"metric": "serving_prefix_hit_rate", "value": 0.75,
+           "unit": "fraction", "vs_baseline": None}
+
+    def run(baseline, fresh_lines):
+        (tmp_path / "BENCH_r01.json").write_text(json.dumps(baseline))
+        fresh = tmp_path / "fresh.txt"
+        fresh.write_text("\n".join(json.dumps(d) for d in fresh_lines) + "\n")
+        return subprocess.run([sys.executable, str(g2), str(fresh)],
+                              capture_output=True, text=True)
+
+    base = {**primary, "secondary": {"serving_prefix_hit_rate": hit}}
+    # small jitter below baseline: within 20% tolerance
+    assert run(base, [primary, {**hit, "value": 0.65}]).returncode == 0
+    # cache effectively off (0.2 << 0.75 * 0.8): FAIL naming the metric
+    r = run(base, [primary, {**hit, "value": 0.2}])
+    assert r.returncode == 1 and "serving_prefix_hit_rate" in r.stdout
+    # IMPROVED hit rate never fails a 'higher' metric
+    assert run(base, [primary, {**hit, "value": 0.95}]).returncode == 0
+    # metric absent on either side: vacuous pass
+    assert run(primary, [primary, {**hit, "value": 0.2}]).returncode == 0
     assert run(base, [primary]).returncode == 0
 
 

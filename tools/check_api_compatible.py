@@ -23,6 +23,7 @@ def live_surfaces():
 
     jax.config.update("jax_platforms", "cpu")
     import paddle_tpu as paddle
+    from paddle_tpu.inference import serving as _serving
 
     def names(mod):
         all_ = getattr(mod, "__all__", None)
@@ -31,6 +32,7 @@ def live_surfaces():
         return sorted(n for n in dir(mod) if not n.startswith("_"))
 
     return {
+        "paddle.inference.serving": names(_serving),
         "paddle": names(paddle),
         "paddle.tensor_methods": sorted(
             n for n in dir(paddle.Tensor) if not n.startswith("_")),

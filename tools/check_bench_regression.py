@@ -33,9 +33,19 @@ PRIMARY = "llama_pretrain_tokens_per_sec_per_chip"
 # - guard_overhead_pct: guarded vs unguarded fused train step
 #   (docs/NUMERIC_GUARD.md) — fails only past max(baseline, 5%) * 2, i.e.
 #   the health word grew a real host sync or per-tensor transfer.
+# - serving_prefix_hit_rate: fraction of prompt tokens served from the
+#   radix prefix cache on the repeated-system-prompt workload
+#   (docs/SERVING.md) — a drop past 20% means matching/registration broke
+#   (e.g. blocks evicted while reusable, or insert stopped firing).
+# - serving_prefill_tokens_per_sec: warm-cache prefill throughput — guards
+#   the admission path (chunk programs, radix walk, COW) against host-side
+#   or recompile regressions; "higher is better", 30% tolerance rides out
+#   CI jitter on a sub-second wave.
 SECONDARY = {
     "serving_p99_step_latency_ms": ("lower", 1.0, 0.0),
     "guard_overhead_pct": ("lower", 1.0, 5.0),
+    "serving_prefix_hit_rate": ("higher", 0.2, 0.0),
+    "serving_prefill_tokens_per_sec": ("higher", 0.3, 0.0),
 }
 
 

@@ -4,8 +4,9 @@ Each drill runs a small end-to-end scenario twice: with its recovery path
 enabled (the injected fault must be absorbed) and with it disabled (the
 same fault must flip the exit code). ``--selftest`` runs the whole seeded
 matrix — heartbeat loss, store stall, checkpoint shard corruption, serving
-engine saturation, serving deadline, plus the numeric classes (NaN
-gradient, loss spike, poisoned batch — docs/NUMERIC_GUARD.md) — and exits
+engine saturation, serving deadline, prefix-cache block-pool exhaustion
+(docs/SERVING.md), plus the numeric classes (NaN gradient, loss spike,
+poisoned batch — docs/NUMERIC_GUARD.md) — and exits
 0 iff every fault class recovers when enabled AND fails when its recovery
 is off. For the numeric drills "recovery off" means GuardPolicy(action=
 "warn"): detection stays on but the anomalous update is applied — exactly
@@ -377,6 +378,88 @@ def drill_serving_deadline(recover: bool):
 
 
 # ---------------------------------------------------------------------------
+# drill: prefix-cache block-pool exhaustion -> backpressure, not corruption
+# ---------------------------------------------------------------------------
+
+def drill_prefix_cache_exhaustion(recover: bool):
+    """Seeded KV block-pool exhaustion mid-admission (docs/SERVING.md).
+
+    A request is decoding with its prompt blocks registered in the radix
+    prefix cache when the pool is exhausted under a second admission.
+    Recovery = the refcounted allocator DEFERS the admission (the queue
+    backs up into EngineSaturated) and serves it only once completed
+    requests release blocks — both token streams exactly match generate().
+    Without recovery (``_unsafe_overcommit``: what a refcount-less
+    allocator does) the second request is handed pages the first still
+    reads, and the survivor's tokens are silently corrupted."""
+    import numpy as np
+
+    from paddle_tpu.distributed.resilience import FaultPlan, FaultSpec
+    from paddle_tpu.inference.serving import (ContinuousBatchingEngine,
+                                              EngineSaturated, Request)
+
+    cfg, m = _serving_model()
+
+    def ref(prompt, n):
+        import paddle_tpu as paddle
+
+        out = m.generate(paddle.to_tensor(np.asarray(prompt)[None]),
+                         max_new_tokens=n, temperature=0.0).numpy()[0]
+        return [int(t) for t in out]
+
+    rng = np.random.default_rng(5)
+    pa = rng.integers(0, cfg.vocab_size, (8,)).astype(np.int32)
+    pb = rng.integers(0, cfg.vocab_size, (8,)).astype(np.int32)
+    # pool: 2 slots * 4 pages; each request needs 3 (8 prompt + 16 new).
+    # The fault holds 3 free blocks at B's admission -> 2 free + nothing
+    # evictable (A holds its blocks) < 3 -> a correct allocator must defer.
+    eng = ContinuousBatchingEngine(m, max_batch=2, max_len=32, page_size=8,
+                                   block_size=2, prefix_cache=True,
+                                   max_queue=1,
+                                   _unsafe_overcommit=not recover)
+    ra = Request(pa, max_new_tokens=16)
+    rb = Request(pb, max_new_tokens=16)
+    plan = FaultPlan(seed=9, specs=[
+        FaultSpec("serving.block_pool", "exhaust", at=1, count=1, arg=3)])
+    saturated = deferred = False
+    with plan:
+        eng.add_request(ra)
+        eng.step()                  # A admitted; prefix registered
+        eng.step()
+        eng.add_request(rb)
+        eng.step()                  # B's allocation hits the exhausted pool
+        deferred = rb._n_out == 0 and len(eng._queue) == 1
+        if deferred:
+            try:
+                eng.add_request(Request(pa, max_new_tokens=4))
+            except EngineSaturated:
+                saturated = True
+        eng.run_until_done(max_steps=300)
+    if not plan.log:
+        return False, "exhaust fault never fired"
+    ref_a = ref(pa, 16)
+    if not recover:
+        if ra.tokens == ref_a:
+            return True, ("unexpected: overcommitted pool left shared "
+                          "blocks intact")
+        return False, ("no refcounted admission: pool overcommit handed "
+                       "B pages A still reads — A's tokens corrupted "
+                       f"({sum(x != y for x, y in zip(ra.tokens, ref_a))}"
+                       f"/{len(ref_a)} wrong)")
+    if not deferred:
+        return False, "admission not deferred under exhaustion"
+    if not saturated:
+        return False, "backlog did not surface as EngineSaturated"
+    if ra.tokens != ref_a:
+        return False, "survivor's tokens corrupted despite refcounting"
+    if rb.tokens != ref(pb, 16):
+        return False, "deferred request served wrong tokens"
+    return True, ("admission deferred at exhaustion, EngineSaturated "
+                  "raised, both streams exact after blocks released "
+                  f"({eng.stats['evictions']} LRU evictions)")
+
+
+# ---------------------------------------------------------------------------
 # numeric drills: health word + GuardPolicy (docs/NUMERIC_GUARD.md)
 # ---------------------------------------------------------------------------
 
@@ -552,6 +635,7 @@ DRILLS = {
     "shard_corruption": drill_shard_corruption,
     "engine_saturation": drill_engine_saturation,
     "serving_deadline": drill_serving_deadline,
+    "prefix_cache_exhaustion": drill_prefix_cache_exhaustion,
     "nan_grad": drill_nan_grad,
     "loss_spike": drill_loss_spike,
     "poison_batch": drill_poison_batch,
