@@ -330,6 +330,108 @@ def bench_serving(dev, on_tpu):
           f"{slots} slots)", None)
 
 
+def bench_serving_recovery(dev, on_tpu):
+    """Serving resilience envelope (docs/SERVING.md): crash-recovery wall
+    time and overload shed rate.
+
+    - ``serving_recovery_time_s``: a FaultPlan ``serving.step`` kill lands
+      mid-decode; the ServingSupervisor rebuilds the engine from the
+      request journal and replays to the delivered high-water marks. The
+      metric is the supervisor's measured rebuild+replay time — dominated
+      by program recompiles on the fresh engine, which is exactly the cost
+      a production operator eats per crash. SECONDARY-guarded ("lower",
+      2s floor) by tools/check_bench_regression.py.
+    - ``serving_shed_rate``: a wave with deliberately infeasible deadlines
+      mixed in; the rate is shed/submitted. If feasibility shedding breaks,
+      the rate collapses toward 0 (infeasible requests queue and die by
+      deadline eviction instead) — guarded in the "higher" direction.
+    """
+    import os
+    import tempfile
+
+    from paddle_tpu.distributed.resilience import FaultPlan, FaultSpec
+    from paddle_tpu.inference.serving import (ContinuousBatchingEngine,
+                                              Request, RequestShed,
+                                              ServingSupervisor)
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=512, intermediate_size=1408,
+            num_hidden_layers=4, num_attention_heads=8,
+            num_key_value_heads=8, max_position_embeddings=512,
+            dtype="bfloat16")
+        slots, max_len, page, block, n_req, max_new = 4, 256, 16, 8, 8, 48
+    else:
+        cfg = LlamaConfig.tiny()
+        slots, max_len, page, block, n_req, max_new = 2, 32, 8, 2, 4, 8
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size, (page,)).astype(np.int32)
+               for _ in range(n_req)]
+
+    def build():
+        return ContinuousBatchingEngine(
+            model, max_batch=slots, max_len=max_len, page_size=page,
+            block_size=block, prefix_cache=True)
+
+    def wave(sup):
+        reqs = [Request(p, max_new_tokens=max_new, seed=10 + i)
+                for i, p in enumerate(prompts)]
+        for r in reqs:
+            sup.submit(r)
+        sup.run_until_done(max_steps=5000)
+        return reqs
+
+    with tempfile.TemporaryDirectory() as tmp:
+        sup = ServingSupervisor(build, os.path.join(tmp, "bench.jrnl"))
+        wave(sup)                               # warm + journal baseline
+        plan = FaultPlan(seed=7, specs=[
+            FaultSpec("serving.step", "kill", at=2, count=1)])
+        with plan:
+            reqs = wave(sup)
+        sup.close()
+        ok = all(r.done and not r.failed for r in reqs)
+        if sup.recoveries < 1 or not ok:
+            print(f"# serving recovery bench: no crash absorbed "
+                  f"(recoveries={sup.recoveries}, ok={ok})", flush=True)
+        else:
+            _emit("serving_recovery_time_s", sup.stats["recovery_s"],
+                  f"s (rebuild + replay-to-hwm after a mid-decode engine "
+                  f"kill; {sup.stats['replayed_requests']} request(s) "
+                  f"replayed, {slots} slots, prefix cache on)", None)
+
+    # shed rate: warm engine -> feasible load + infeasible-deadline burst
+    eng = ContinuousBatchingEngine(model, max_batch=slots, max_len=max_len,
+                                   page_size=page, block_size=block)
+    warm = Request(prompts[0], max_new_tokens=max_new)
+    eng.add_request(warm)
+    eng.run_until_done(max_steps=2000)          # compiles + measures rate
+    submitted = shed = 0
+    live = []
+    for i, p in enumerate(prompts):
+        feasible = Request(p, max_new_tokens=max_new, seed=30 + i)
+        submitted += 1
+        try:
+            eng.add_request(feasible)
+            live.append(feasible)
+        except RequestShed:
+            shed += 1
+        doomed = Request(p, max_new_tokens=max_new, deadline_s=1e-3,
+                         seed=60 + i)
+        submitted += 1
+        try:
+            eng.add_request(doomed)
+            live.append(doomed)
+        except RequestShed:
+            shed += 1
+    eng.run_until_done(max_steps=5000)
+    _emit("serving_shed_rate", shed / max(1, submitted),
+          f"fraction of submissions shed at submit (PT-SRV-003; "
+          f"{submitted} submitted, half with infeasible 1ms deadlines, "
+          f"{sum(r.done and not r.failed for r in live)} served)", None)
+
+
 def bench_unet(dev, on_tpu):
     """Stable-Diffusion-class UNet train step (BASELINE config #5: conv +
     cross-attention through the compiler path). One jitted
@@ -572,6 +674,11 @@ def main():
         bench_serving(dev, on_tpu)
     except Exception as e:
         print(f"# serving bench failed: {e!r}", flush=True)
+    gc.collect()
+    try:
+        bench_serving_recovery(dev, on_tpu)
+    except Exception as e:
+        print(f"# serving recovery bench failed: {e!r}", flush=True)
     gc.collect()
     try:
         bench_unet(dev, on_tpu)

@@ -25,19 +25,22 @@ from .retry import (  # noqa: F401
     DEFAULT_POLICY,
     RetryError,
     RetryPolicy,
+    reset_retry_stats,
     retries_disabled,
     retry_call,
+    retry_stats,
 )
-# eager is safe here: the watchdog consumes framework.numeric_guard, which
-# is numpy+stdlib only — no jax/Engine import at load (unlike the trainer)
-from .watchdog import NumericWatchdog  # noqa: F401
+# eager is safe here: the watchdogs consume framework.numeric_guard /
+# stdlib threading only — no jax/Engine import at load (unlike the trainer)
+from .watchdog import NumericWatchdog, StepWatchdog  # noqa: F401
 
 __all__ = [
     "FaultInjected", "FaultPlan", "FaultSpec", "active_plan", "corrupt",
     "maybe_inject", "numeric_inject_code", "poison_arrays",
     "DEFAULT_POLICY", "RetryError", "RetryPolicy",
-    "retries_disabled", "retry_call", "ResilientTrainer",
-    "NumericWatchdog", "CheckpointCorruptionError", "EngineSaturated",
+    "retries_disabled", "retry_call", "retry_stats", "reset_retry_stats",
+    "ResilientTrainer", "NumericWatchdog", "StepWatchdog",
+    "CheckpointCorruptionError", "EngineSaturated",
 ]
 
 

@@ -39,6 +39,12 @@ Known sites (see docs/RESILIENCE.md for the catalogue):
 ``serving.block_pool``  serving admission, before block allocation
                         (detail = ``rid:<id>``; ``exhaust`` holds ``arg``
                         free KV blocks — seeded pool exhaustion)
+``serving.step``      serving engine, top of every ``step()`` (detail =
+                      ``step:<n>``; ``kill`` crashes the engine mid-wave —
+                      the ServingSupervisor's rebuild-from-journal drill)
+``serving.stall``     same event stream as ``serving.step`` but consulted
+                      first (``stall`` hangs the step past its wall-clock
+                      budget — the StepWatchdog / PT-SRV-002 drill)
 ====================  =====================================================
 
 With no plan installed every hook is a cheap no-op (one global read), so

@@ -17,6 +17,13 @@ Diagnostic codes (catalogued in docs/RESILIENCE.md):
 ``PT_RETRY_DISABLE=1`` collapses every policy to a single attempt — the
 switch ``tools/fault_drill.py`` uses to prove each injected transport fault
 flips the exit code when retry is off.
+
+Every ``retry_call`` also feeds a module-level stats registry
+(:func:`retry_stats`): calls / attempts / retries / give-ups and cumulative
+latency, plus a bounded per-``what`` attempt breakdown — the raw material
+for the observability layer. The serving engine surfaces a snapshot in
+``ContinuousBatchingEngine.stats`` and ``tools/fault_drill.py`` prints it
+after the selftest matrix.
 """
 
 from __future__ import annotations
@@ -28,7 +35,38 @@ import time
 from typing import Callable, Optional, Tuple, Type
 
 __all__ = ["RetryPolicy", "RetryError", "retry_call", "DEFAULT_POLICY",
-           "retries_disabled"]
+           "retries_disabled", "retry_stats", "reset_retry_stats"]
+
+# -- stats registry ---------------------------------------------------------
+# plain dict mutations under the GIL: retry_call is a control-plane path
+# (store ops, rpc setup), never a per-token hot path, so a lock would buy
+# nothing. ``by_what`` is bounded so an unbounded label space (per-key store
+# ops) cannot grow the registry without limit.
+_BY_WHAT_CAP = 64
+
+_STATS = {"calls": 0, "attempts": 0, "retries": 0, "giveups": 0,
+          "latency_s": 0.0}
+_BY_WHAT: dict = {}
+
+
+def retry_stats() -> dict:
+    """Snapshot of the registry: aggregate counters plus the per-``what``
+    attempt counts (``by_what``, capped at 64 distinct labels)."""
+    out = dict(_STATS)
+    out["by_what"] = dict(_BY_WHAT)
+    return out
+
+
+def reset_retry_stats() -> None:
+    for k in _STATS:
+        _STATS[k] = 0.0 if k == "latency_s" else 0
+    _BY_WHAT.clear()
+
+
+def _note_attempt(what: str) -> None:
+    _STATS["attempts"] += 1
+    if what in _BY_WHAT or len(_BY_WHAT) < _BY_WHAT_CAP:
+        _BY_WHAT[what] = _BY_WHAT.get(what, 0) + 1
 
 
 @dataclasses.dataclass(frozen=True)
@@ -101,13 +139,19 @@ def retry_call(fn: Callable, *args, policy: Optional[RetryPolicy] = None,
     start = time.monotonic()
     delays = backoff_delays(pol, rng)
     last: Optional[BaseException] = None
+    _STATS["calls"] += 1
     for attempt in range(1, attempts + 1):
+        _note_attempt(what)
         try:
-            return fn(*args, **kwargs)
+            result = fn(*args, **kwargs)
+            _STATS["latency_s"] += time.monotonic() - start
+            return result
         except pol.retry_on as e:
             last = e
             elapsed = time.monotonic() - start
             if attempt >= attempts:
+                _STATS["giveups"] += 1
+                _STATS["latency_s"] += elapsed
                 if attempts == 1:
                     raise        # retries disabled/single-shot: raw failure
                 raise RetryError("PT-RETRY-002", what, attempt, elapsed, e) from e
@@ -115,9 +159,12 @@ def retry_call(fn: Callable, *args, policy: Optional[RetryPolicy] = None,
             if pol.deadline is not None:
                 remain = pol.deadline - elapsed
                 if remain <= 0:
+                    _STATS["giveups"] += 1
+                    _STATS["latency_s"] += elapsed
                     raise RetryError("PT-RETRY-001", what, attempt, elapsed,
                                      e) from e
                 delay = min(delay, remain)
+            _STATS["retries"] += 1
             if on_retry is not None:
                 on_retry(attempt, e, delay)
             sleep(max(0.0, delay))

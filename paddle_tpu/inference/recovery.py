@@ -1,0 +1,504 @@
+"""Crash-recoverable serving: request journal + supervisor (docs/SERVING.md).
+
+The continuous-batching engine is the most stateful component in the repo —
+a paged KV pool, a radix prefix cache, chunked-prefill slots, device-side
+token carries. None of that state is durable, and none of it needs to be:
+every admitted request is fully described by its prompt ids, sampling
+params, seed and deadline, and the engine's sample keys are stateless
+(``fold_in(key(seed), position)`` — models/generation_utils.py). So the
+recovery unit is the REQUEST, not the engine: journal what was admitted and
+how far each stream got, and an engine crash costs a rebuild + replay that
+is **bit-identical** to the uninterrupted run (greedy and seeded sampling,
+including requests past a copy-on-write divergence point — warm==cold
+bit-identity means a fresh pool and an empty radix cache cannot change a
+single token).
+
+Components:
+
+- :class:`RequestJournal` — append-only, per-record crc32-checked journal
+  (the same torn-write posture as distributed/checkpoint/integrity.py: a
+  crash mid-append leaves a torn TAIL, which loading tolerates; corruption
+  in the middle of the journal raises :class:`JournalCorrupt` naming the
+  record). Records: ``admit`` (full request parameters), ``prog`` (the
+  emitted-token high-water mark plus the token ids themselves, so replay
+  can verify bit-identity even across a process restart), ``fin``,
+  ``shed``, ``crash``/``recovered`` markers.
+- :class:`ServingSupervisor` — owns the engine via a ``build_engine``
+  factory. ``submit`` journals then admits; ``step`` arms a
+  :class:`~paddle_tpu.distributed.resilience.watchdog.StepWatchdog` around
+  the engine step and, on a crash (any exception out of ``step`` — e.g. the
+  ``serving.step`` ``kill`` fault) or a watchdog overrun (``serving.stall``),
+  rebuilds: fresh engine, fresh block pool, empty radix cache, every
+  unfinished journaled request re-admitted and replayed. Tokens already
+  delivered (journaled high-water mark) are NOT re-delivered: the replay
+  catches up to the mark, verifies the regenerated prefix matches the
+  delivered one byte-for-byte (PT-SRV-005 on divergence), and streams on
+  from there.
+
+Deadline semantics across recovery: a re-admitted request's deadline clock
+RESTARTS at re-admission (the journal stores the deadline *duration*) — an
+engine fault is the operator's problem, not the request's.
+
+PT-SRV diagnostic codes (docs/RESILIENCE.md):
+
+========== ==============================================================
+PT-SRV-001 engine crash absorbed — rebuilt from journal, requests replayed
+PT-SRV-002 step watchdog overrun (stall) — flagged mid-hang, then rebuilt
+PT-SRV-003 request shed at submit (``RequestShed`` — serving.py)
+PT-SRV-004 journal corruption (:class:`JournalCorrupt` names the record)
+PT-SRV-005 replay divergence: recovered prefix != delivered prefix
+PT-SRV-006 brownout entered/exited (engine stats — serving.py)
+========== ==============================================================
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import zlib
+from typing import Callable, Dict, List, Optional
+
+from .serving import ContinuousBatchingEngine, Request, RequestShed
+
+__all__ = ["JournalCorrupt", "RequestJournal", "ServingSupervisor"]
+
+
+class JournalCorrupt(RuntimeError):
+    """PT-SRV-004: a journal record failed its crc (or decode) somewhere
+    other than the torn tail — the file was damaged after it was written."""
+
+
+class RequestJournal:
+    """Append-only, crc-checked request journal.
+
+    One record per line: ``<crc32 of payload, 8 hex chars> <json payload>``.
+    Appends flush to the OS on every record (``fsync=True`` additionally
+    forces them to disk — crash-safe across power loss at a syscall per
+    record; the default survives process death, which is the serving
+    failure mode the supervisor drills).
+
+    Loading tolerates a torn final record (a crash mid-append) by
+    truncating to the last good record; a bad crc anywhere EARLIER raises
+    :class:`JournalCorrupt` naming the line — silent damage never replays.
+    """
+
+    def __init__(self, path: str, fsync: bool = False):
+        self.path = path
+        self.fsync = bool(fsync)
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        if os.path.exists(path):
+            self.records, good = self._load_bytes(path)
+            # drop a torn tail NOW: appending after partial bytes would
+            # weld the next record onto them — mid-file corruption on the
+            # following load instead of a tolerated torn append
+            if good < os.path.getsize(path):
+                with open(path, "r+b") as f:
+                    f.truncate(good)
+        else:
+            self.records = []
+        self._fh = open(path, "ab")
+
+    @staticmethod
+    def load(path: str) -> List[dict]:
+        return RequestJournal._load_bytes(path)[0]
+
+    @staticmethod
+    def _load_bytes(path: str):
+        """Parse the journal; returns ``(records, good_byte_length)`` where
+        the length covers every intact record (a torn tail is excluded)."""
+        out: List[dict] = []
+        good = 0
+        with open(path, "rb") as f:
+            lines = f.read().split(b"\n")
+        for i, line in enumerate(lines):
+            if not line:
+                # the split's final element (after the last newline) is
+                # always empty; a blank line with records AFTER it is
+                # damage — skipping it would make ``good`` undercount the
+                # file offset, and the constructor's truncate(good) would
+                # then chop bytes off a committed record
+                if any(lines[j] for j in range(i + 1, len(lines))):
+                    raise JournalCorrupt(
+                        f"PT-SRV-004: journal {path} record {i + 1}: blank "
+                        "line — records after it exist, so this is damage, "
+                        "not a torn append")
+                break
+            bad = None
+            if len(line) < 10 or line[8:9] != b" ":
+                bad = "malformed record"
+            else:
+                payload = line[9:]
+                try:
+                    want = int(line[:8], 16)
+                except ValueError:
+                    want, bad = -1, "malformed crc"
+                if bad is None and (zlib.crc32(payload) & 0xFFFFFFFF) != want:
+                    bad = "crc mismatch"
+                if bad is None:
+                    try:
+                        out.append(json.loads(payload.decode("utf-8")))
+                        good += len(line) + 1
+                        continue
+                    except (UnicodeDecodeError, json.JSONDecodeError):
+                        bad = "undecodable payload"
+            # damage in the tail record = torn append -> tolerated (the
+            # record never committed); damage earlier = corruption
+            if any(lines[j] for j in range(i + 1, len(lines))):
+                raise JournalCorrupt(
+                    f"PT-SRV-004: journal {path} record {i + 1}: {bad} — "
+                    "records after it exist, so this is damage, not a torn "
+                    "append")
+            break
+        return out, good
+
+    def append(self, kind: str, **fields) -> None:
+        rec = {"k": kind}
+        rec.update(fields)
+        payload = json.dumps(rec, separators=(",", ":")).encode("utf-8")
+        crc = zlib.crc32(payload) & 0xFFFFFFFF
+        self._fh.write(b"%08x " % crc + payload + b"\n")
+        self._fh.flush()
+        if self.fsync:
+            os.fsync(self._fh.fileno())
+        self.records.append(rec)
+
+    def unfinished(self) -> List[dict]:
+        """Admit records with no matching ``fin`` — the replay set."""
+        done = {r["rid"] for r in self.records if r["k"] == "fin"}
+        return [r for r in self.records
+                if r["k"] == "admit" and r["rid"] not in done]
+
+    def delivered(self, rid: int) -> List[int]:
+        """Token ids journaled as delivered for ``rid`` (concatenated
+        ``prog`` deltas) — the prefix replay must reproduce exactly."""
+        toks: List[int] = []
+        for r in self.records:
+            if r["k"] == "prog" and r["rid"] == rid:
+                toks.extend(r["toks"])
+        return toks
+
+    def close(self) -> None:
+        self._fh.close()
+
+
+def _admit_record(req: Request) -> dict:
+    return {"rid": req.rid, "prompt": [int(t) for t in req.prompt],
+            "max_new": req.max_new_tokens, "eos": req.eos_token_id,
+            "temp": req.temperature, "top_p": req.top_p, "top_k": req.top_k,
+            "seed": req.seed, "deadline_s": req.deadline_s,
+            "priority": req.priority}
+
+
+def _request_from(rec: dict) -> Request:
+    return Request(rec["prompt"], max_new_tokens=rec["max_new"],
+                   eos_token_id=rec["eos"], temperature=rec["temp"],
+                   top_p=rec["top_p"], top_k=rec["top_k"], seed=rec["seed"],
+                   deadline_s=rec["deadline_s"], priority=rec["priority"])
+
+
+class ServingSupervisor:
+    """Crash-recoverable driver over a :class:`ContinuousBatchingEngine`.
+
+    >>> sup = ServingSupervisor(lambda: ContinuousBatchingEngine(model, ...),
+    ...                         journal_path, step_budget_s=2.0)
+    >>> sup.submit(Request(prompt, max_new_tokens=64))
+    >>> done = sup.run_until_done()
+
+    The caller keeps its ``Request`` objects; across a crash their token
+    streams continue bit-identically (the supervisor replays on a rebuilt
+    engine, verifies the regenerated prefix against the journaled
+    high-water mark, and appends only the new tokens). A supervisor
+    constructed over an EXISTING journal (process restart) re-admits every
+    unfinished request automatically; their reconstructed ``Request``
+    objects live in :attr:`requests`.
+
+    ``max_recoveries`` bounds the rebuild budget (a crash loop must
+    eventually surface, not mask); ``max_recoveries=0`` disables recovery —
+    the fault-drill's control arm.
+
+    ``step_budget_s`` must comfortably exceed a WARM step (compile-heavy
+    first steps otherwise read as stalls, and every rebuild recompiles —
+    a false-positive cascade that burns the whole recovery budget). Warm
+    the engine first, then arm via :meth:`set_step_budget`.
+    """
+
+    #: exceptions that are caller errors, never engine-state damage
+    _SUBMIT_ERRORS = (ValueError,)
+
+    def __init__(self, build_engine: Callable[[], ContinuousBatchingEngine],
+                 journal_path: str, step_budget_s: Optional[float] = None,
+                 max_recoveries: int = 2, watchdog_grace_steps: int = 4,
+                 fsync: bool = False):
+        from ..distributed.resilience.watchdog import StepWatchdog
+
+        self._build = build_engine
+        # a rebuilt engine recompiles its programs, and a compile-heavy
+        # step is indistinguishable from a stall — without grace, one real
+        # stall cascades into false positives that burn the whole recovery
+        # budget. The first N steps after every rebuild run unarmed.
+        self.watchdog_grace_steps = int(watchdog_grace_steps)
+        self._grace = 0
+        self.journal = RequestJournal(journal_path, fsync=fsync)
+        self.requests: Dict[int, Request] = {}   # rid -> caller-facing req
+        self._live: Dict[int, Request] = {}      # rid -> object in engine
+        self._meta: Dict[int, dict] = {}         # rid -> admit record
+        self._hwm: Dict[int, int] = {}           # rid -> delivered tokens
+        self._done: set = set()
+        self._finished: Dict[int, Request] = {}
+        self.events: List[tuple] = []            # (code, message)
+        self.recoveries = 0
+        self.max_recoveries = int(max_recoveries)
+        self.watchdog = (StepWatchdog(step_budget_s)
+                         if step_budget_s is not None else None)
+        self.stats = {"shed": 0, "recoveries": 0, "recovery_s": 0.0,
+                      "replayed_requests": 0}
+        self.engine = build_engine()
+        # rids are assigned by a PER-PROCESS counter; a restart over an
+        # existing journal resets it, so a fresh submit could collide with
+        # a journaled rid (a stale "fin" would then mask the new request
+        # from replay, and delivered() would merge two requests' tokens).
+        # Bump the counter past every journaled rid before any submit.
+        if self.journal.records:
+            Request._counter[0] = max(
+                Request._counter[0],
+                max(r["rid"] for r in self.journal.records if "rid" in r))
+        pending = self.journal.unfinished()
+        if pending:
+            # process restart over a live journal: replay now. The caller's
+            # original Request objects are gone with the old process; the
+            # reconstructed ones (exposed via .requests) carry the streams.
+            for rec in pending:
+                self._meta[rec["rid"]] = rec
+                self._hwm[rec["rid"]] = len(self.journal.delivered(rec["rid"]))
+                self.requests[rec["rid"]] = None   # filled by _readmit
+            self._recover("PT-SRV-001",
+                          f"journal restart: {len(pending)} unfinished "
+                          "request(s) found", rebuild=False)
+
+    # -- public API --------------------------------------------------------
+    def submit(self, req: Request) -> int:
+        """Journal + admit. ``RequestShed`` / ``EngineSaturated`` propagate
+        (the journal records sheds; a saturated queue records nothing — the
+        request never entered the system)."""
+        try:
+            self.engine.add_request(req)
+        except RequestShed:
+            self.stats["shed"] += 1
+            self.journal.append("shed", rid=req.rid)
+            raise
+        self.journal.append("admit", **_admit_record(req))
+        self.requests[req.rid] = req
+        self._live[req.rid] = req
+        self._meta[req.rid] = _admit_record(req)
+        self._hwm[req.rid] = 0
+        return req.rid
+
+    def step(self) -> None:
+        armed = self.watchdog is not None and self._grace <= 0
+        if self._grace > 0:
+            self._grace -= 1
+        if armed:
+            self.watchdog.arm(f"step:{getattr(self.engine, '_step_idx', 0)}")
+        try:
+            self.engine.step()
+        except self._SUBMIT_ERRORS:
+            if armed:
+                self.watchdog.disarm()
+            raise
+        except Exception as e:  # engine state is untrusted from here on
+            if armed:
+                self.watchdog.disarm()
+            if self.recoveries >= self.max_recoveries:
+                raise
+            self._recover(
+                "PT-SRV-001",
+                f"engine step raised {type(e).__name__}: {e}")
+            return
+        overran = self.watchdog.disarm() if armed else False
+        if overran:
+            tag, elapsed = self.watchdog.overruns[-1]
+            if self.recoveries >= self.max_recoveries:
+                raise RuntimeError(
+                    f"PT-SRV-002: step {tag} stalled {elapsed:.3f}s past the "
+                    f"{self.watchdog.budget_s:.3f}s budget and the recovery "
+                    "budget is exhausted")
+            self._recover(
+                "PT-SRV-002",
+                f"step {tag} overran its {self.watchdog.budget_s:.3f}s "
+                f"budget ({elapsed:.3f}s) — engine presumed stuck")
+            return
+        self._sync_progress()
+
+    def has_work(self) -> bool:
+        return self.engine.has_work() or any(
+            rid not in self._done for rid in self.requests)
+
+    def run_until_done(self, max_steps: int = 100000) -> Dict[int, Request]:
+        steps = 0
+        while self.has_work() and steps < max_steps:
+            self.step()
+            steps += 1
+        return self.finished()
+
+    def finished(self) -> Dict[int, Request]:
+        self._sync_progress()
+        out, self._finished = self._finished, {}
+        return out
+
+    def set_step_budget(self, budget_s: Optional[float]) -> None:
+        """(Re)arm the step watchdog — typically after a warmup wave has
+        compiled the engine's programs, so the budget can be set from the
+        measured warm step time rather than the compile time."""
+        from ..distributed.resilience.watchdog import StepWatchdog
+
+        if self.watchdog is not None:
+            self.watchdog.close()
+        self.watchdog = (StepWatchdog(budget_s)
+                         if budget_s is not None else None)
+
+    def close(self) -> None:
+        if self.watchdog is not None:
+            self.watchdog.close()
+        self.journal.close()
+
+    # -- progress / recovery ----------------------------------------------
+    def _sync_progress(self) -> None:
+        """Materialize pending tokens, move the per-request high-water
+        marks forward in the journal, and surface completions. The journal
+        mark advances only over MATERIALIZED tokens — those are the ones a
+        streaming caller could have seen, so they are the ones recovery
+        must never re-deliver (and must reproduce exactly)."""
+        # drains pending readbacks AND the engine-side finished dict (kept
+        # bounded); completion itself is tracked via the supervisor's maps
+        self.engine.finished()
+        for rid, user in self.requests.items():
+            if rid in self._done or user is None:
+                continue
+            live = self._live.get(rid)
+            if live is None:
+                continue
+            if live is not user and len(live.output) > len(user.output):
+                user.output.extend(live.output[len(user.output):])
+                user._n_out = len(user.output)
+            n = len(user.output)
+            if n > self._hwm[rid]:
+                self.journal.append("prog", rid=rid, hwm=n,
+                                    toks=user.output[self._hwm[rid]:])
+                self._hwm[rid] = n
+            if live.done:
+                if live is not user:
+                    user.done, user.failed = live.done, live.failed
+                    user.error = live.error
+                self.journal.append("fin", rid=rid, failed=bool(user.failed))
+                self._done.add(rid)
+                self._finished[rid] = user
+                self._live.pop(rid, None)
+
+    def _recover(self, code: str, msg: str, rebuild: bool = True) -> None:
+        """Rebuild the engine and replay every unfinished journaled request
+        on it: fresh block pool, empty radix cache, deadline clocks reset.
+        Blocks until each replay has caught up to its delivered high-water
+        mark (verified bit-for-bit), then returns — the service is back to
+        its pre-crash state and normal stepping resumes."""
+        t0 = time.monotonic()
+        self.recoveries += 1
+        self.stats["recoveries"] += 1
+        self._grace = self.watchdog_grace_steps
+        self.events.append((code, msg))
+        if rebuild:
+            self.journal.append("crash", code=code, msg=msg)
+            self.engine = self._build()
+        replaying: List[int] = []
+        # backpressure was already charged at the original submit — a
+        # max_queue smaller than the in-flight count must not refuse the
+        # engine's own journaled work on replay
+        saved_max_queue = self.engine.max_queue
+        self.engine.max_queue = None
+        for rec in self.journal.unfinished():
+            rid = rec["rid"]
+            if rid in self._done or rid not in self._meta:
+                continue
+            twin = _request_from(self._meta[rid])
+            user = self.requests.get(rid)
+            if user is None:
+                # restart path: the twin IS the caller-facing object
+                user = self.requests[rid] = twin
+            else:
+                # keep only the delivered prefix; the replay regenerates
+                # (and must match) everything past it
+                hwm = self._hwm.get(rid, 0)
+                del user.output[hwm:]
+                user._n_out = len(user.output)
+                user.done = user.failed = False
+                user.error = None
+                user._engine = None
+            self._live[rid] = twin
+            self.engine.add_request(twin)
+            replaying.append(rid)
+        self.engine.max_queue = saved_max_queue
+        self.stats["replayed_requests"] += len(replaying)
+        # catch up to the delivered marks before declaring recovery done
+        guard = 0
+        while any(self._live[rid]._n_out < self._hwm.get(rid, 0)
+                  and not self._live[rid].done for rid in replaying):
+            try:
+                self.engine.step()
+            except Exception as e:
+                # a crash DURING the replay itself still draws on the same
+                # recovery budget — a back-to-back double fault must be
+                # absorbed, not escape half-replayed
+                if self.recoveries >= self.max_recoveries:
+                    raise
+                self._recover(
+                    code, f"engine crashed again during replay "
+                    f"({type(e).__name__}: {e})")
+                return
+            guard += 1
+            if guard > 100000:
+                raise RuntimeError(
+                    "recovery replay did not reach the journaled high-water "
+                    "marks — engine is not making progress")
+        self.engine._drain_pending()
+        for rid in replaying:
+            twin, user = self._live[rid], self.requests[rid]
+            hwm = self._hwm.get(rid, 0)
+            delivered = list(user.output[:hwm] if user is not twin
+                             else self.journal.delivered(rid))
+            # a twin that failed short of the mark (e.g. its deadline
+            # expired AGAIN during the compile-heavy catch-up) is an
+            # ordinary request failure, not a data-integrity alarm — so
+            # only the prefix it actually regenerated is held to the
+            # bit-identity contract; ending early WITHOUT failing, or
+            # emitting different tokens, is real divergence
+            n = min(len(twin.output), hwm)
+            if (twin.output[:n] != delivered[:n]
+                    or (twin.done and not twin.failed
+                        and len(twin.output) < hwm)):
+                user.done = user.failed = True
+                user.error = (
+                    f"PT-SRV-005: replay diverged from the delivered stream "
+                    f"at rid={rid} — {twin.output[:hwm][:8]}... vs "
+                    f"{delivered[:8]}...")
+                self.events.append(("PT-SRV-005", user.error))
+                self.journal.append("fin", rid=rid, failed=True)
+                self._done.add(rid)
+                self._finished[rid] = user
+                self._live.pop(rid, None)
+            elif twin.failed:
+                if user is not twin:
+                    user.done, user.failed = True, True
+                    user.error = twin.error
+                self.journal.append("fin", rid=rid, failed=True)
+                self._done.add(rid)
+                self._finished[rid] = user
+                self._live.pop(rid, None)
+            elif user is twin and hwm:
+                # restart path: the twin regenerated the delivered prefix
+                # itself; nothing to splice
+                pass
+        dt = time.monotonic() - t0
+        self.stats["recovery_s"] += dt
+        self.journal.append("recovered", code=code, n=len(replaying),
+                            seconds=round(dt, 6))

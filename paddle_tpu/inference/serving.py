@@ -78,14 +78,41 @@ from ..models.generation_utils import (fold_keys as _fold_keys,
 # here as the serving-facing API surface
 from ..ops.paged_attention import BlockAllocator, RadixPrefixCache
 
-__all__ = ["BlockAllocator", "ContinuousBatchingEngine", "EngineSaturated",
-           "PrefixCacheConfig", "RadixPrefixCache", "Request"]
+__all__ = ["BlockAllocator", "BrownoutConfig", "ContinuousBatchingEngine",
+           "EngineSaturated", "PrefixCacheConfig", "RadixPrefixCache",
+           "Request", "RequestJournal", "RequestShed", "ServingSupervisor",
+           "StepWatchdog"]
+
+
+def __getattr__(name):
+    # crash-recovery layer (recovery.py) re-exported lazily: it imports the
+    # resilience stack, which must not load just because serving was
+    # imported (same discipline as the faults/retry lazy imports below)
+    if name in ("ServingSupervisor", "RequestJournal"):
+        from . import recovery
+
+        return getattr(recovery, name)
+    if name == "StepWatchdog":
+        from ..distributed.resilience.watchdog import StepWatchdog
+
+        return StepWatchdog
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 class EngineSaturated(RuntimeError):
     """add_request refused: the engine's wait queue is at its high-water
     mark (``max_queue``). Admission control — callers shed load, retry with
     backoff, or scale out; the engine never hides an unbounded backlog."""
+
+
+class RequestShed(RuntimeError):
+    """add_request refused at SUBMIT time (PT-SRV-003): the request's
+    ``deadline_s`` cannot be met at the engine's current decode throughput,
+    so admitting it would only let it time out after queuing — wasting queue
+    capacity and deadline-eviction work while helping nobody. Shedding
+    happens before the request touches any engine state, so concurrently
+    running requests' token streams are byte-identical to a run without the
+    shed request. Callers route to another replica or degrade gracefully."""
 
 
 @dataclasses.dataclass
@@ -105,6 +132,27 @@ class PrefixCacheConfig:
     extra_blocks: int = 0
 
 
+@dataclasses.dataclass
+class BrownoutConfig:
+    """Hysteretic degraded mode under sustained KV-pool pressure
+    (``ContinuousBatchingEngine(brownout=...)`` — docs/SERVING.md).
+
+    After ``enter_after`` consecutive steps with a deferred admission (the
+    pool could not serve the queue head even after LRU eviction) the engine
+    enters **brownout**: idle cached blocks are flushed back to the pool,
+    prefix-cache admission stops matching/registering chains, and chunked
+    prefill collapses to whole-prompt prefill — the byte-identical legacy
+    serving behavior (warm==cold bit-identity means token streams cannot
+    change, only memory/throughput shape). Brownout exits only after
+    ``exit_after`` consecutive pressure-free steps with at least
+    ``exit_free_frac`` of the pool free — hysteresis, so a workload
+    oscillating at the edge does not flap the cache on and off."""
+
+    enter_after: int = 2
+    exit_free_frac: float = 0.5
+    exit_after: int = 4
+
+
 class Request:
     """One generation request tracked by the engine.
 
@@ -117,8 +165,20 @@ class Request:
     — queue wait plus decode. A request past its deadline is evicted at the
     next engine step: ``done=True, failed=True``, ``error`` names the
     deadline, its slot/pages are freed, and other slots are untouched.
-    Eviction latency is bounded by one decode block.
+    Eviction latency is bounded by one decode block. A deadline the engine
+    can already see is infeasible at submit time is refused with
+    :class:`RequestShed` instead of queuing (PT-SRV-003).
+
+    ``priority`` orders admission: lower values admit first (0 = highest);
+    within a class, arrival order (FIFO) is preserved. Priorities reorder
+    the WAIT QUEUE only — already-admitted slots are never preempted, so a
+    late high-priority burst shortens queue wait without corrupting anyone's
+    stream.
     """
+
+    PRIORITY_HIGH = 0
+    PRIORITY_NORMAL = 1
+    PRIORITY_LOW = 2
 
     _counter = [0]
 
@@ -126,7 +186,8 @@ class Request:
                  eos_token_id: Optional[int] = None,
                  temperature: float = 0.0, top_p: float = 1.0,
                  top_k: int = 0, seed: Optional[int] = None,
-                 deadline_s: Optional[float] = None):
+                 deadline_s: Optional[float] = None,
+                 priority: int = PRIORITY_NORMAL):
         validate_sampling(temperature, top_p, top_k)
         Request._counter[0] += 1
         self.rid = Request._counter[0]
@@ -140,6 +201,7 @@ class Request:
         self.top_k = int(top_k)
         self.seed = int(seed if seed is not None else self.rid)
         self.deadline_s = None if deadline_s is None else float(deadline_s)
+        self.priority = int(priority)
         self.output: List[int] = []
         self.done = False
         self.failed = False
@@ -178,6 +240,8 @@ class ContinuousBatchingEngine:
                  max_queue: Optional[int] = None,
                  prefix_cache: Union[bool, PrefixCacheConfig, None] = False,
                  compile_cache_cap: int = 64,
+                 shed_infeasible: bool = True,
+                 brownout: Union[bool, BrownoutConfig, None] = None,
                  _unsafe_overcommit: bool = False):
         self.model = model
         self.max_batch = max_batch
@@ -198,6 +262,25 @@ class ContinuousBatchingEngine:
         elif not prefix_cache:
             prefix_cache = None
         self.prefix_cache = prefix_cache
+        # deadline-feasibility shedding (PT-SRV-003): armed once the engine
+        # has measured a decode rate; until then every deadline is admitted
+        # (a cold engine has no basis to refuse work)
+        self.shed_infeasible = bool(shed_infeasible)
+        if brownout is True:
+            brownout = BrownoutConfig()
+        elif not brownout:
+            brownout = None
+        self._brownout_cfg = brownout if prefix_cache is not None else None
+        self._brownout_active = False
+        self._pressure_steps = 0
+        self._clear_steps = 0
+        self._deferred_step = False
+        self._step_idx = 0
+        # EMA of scheduled-tokens/s across engine steps — the denominator of
+        # the feasibility estimate (updated only on steps that scheduled
+        # tokens, so idle ticks don't decay it toward zero)
+        self._ema_tok_s: Optional[float] = None
+        self._sched_tokens = 0
         self._maxp = -(-max_len // page_size)
         # DRILL-ONLY knob (tools/fault_drill.py prefix_cache_exhaustion):
         # allocate past pool capacity by ripping blocks out of the radix
@@ -254,10 +337,12 @@ class ContinuousBatchingEngine:
         # lifecycle; compile_cache_entries is the bounded-compile-cache
         # telemetry, warned past ``compile_cache_cap``)
         self.stats = {"admit_host_s": 0.0, "decode_host_s": 0.0,
-                      "compile_cache_entries": 0}
+                      "compile_cache_entries": 0, "shed": 0,
+                      "retry_attempts": 0, "retry_giveups": 0}
         if self.prefix_cache is not None:
             self.stats.update(hit_tokens=0, miss_tokens=0, cow_copies=0,
-                              evictions=0, prefill_host_s=0.0)
+                              evictions=0, prefill_host_s=0.0,
+                              brownouts=0, brownout_steps=0)
 
         from ..jit.api import _collect_state
 
@@ -294,12 +379,50 @@ class ContinuousBatchingEngine:
         validate = getattr(self.model, "_validate_generate", None)
         if validate is not None:
             validate(len(req.prompt), len(req.prompt) + req.max_new_tokens)
+        self._shed_check(req)
         req._engine = weakref.ref(self)
         import time as _time
 
         req._enqueued_at = _time.monotonic()
-        self._queue.append(req)
+        # weighted admission order: lower priority value admits first; FIFO
+        # within a class (insert behind every equal-or-higher-priority
+        # waiter). The queue HEAD keeps its head-of-line semantics in
+        # prefix mode — priorities only choose who the head is.
+        q = self._queue
+        i = len(q)
+        while i > 0 and q[i - 1].priority > req.priority:
+            i -= 1
+        if i == len(q):
+            q.append(req)
+        else:
+            q.insert(i, req)
         return req.rid
+
+    def _shed_check(self, req: "Request"):
+        """Deadline-feasibility admission control (PT-SRV-003): refuse at
+        SUBMIT a request whose deadline cannot be met at the measured decode
+        throughput — a typed :class:`RequestShed` now beats a deadline
+        eviction after seconds of queue wait. Conservative by construction:
+        no measured rate (cold engine) or no deadline means no shedding, and
+        the backlog estimate counts only decode tokens ahead of the request
+        (prefill compute is charged to the rate EMA, not the backlog)."""
+        if (not self.shed_infeasible or req.deadline_s is None
+                or self._ema_tok_s is None or self._ema_tok_s <= 0.0):
+            return
+        backlog = req.max_new_tokens
+        for r in self._queue:
+            if r.priority <= req.priority:
+                backlog += r.max_new_tokens - r._n_out
+        for r in self._slots:
+            if r is not None:
+                backlog += max(0, r.max_new_tokens - r._n_out)
+        est = backlog / self._ema_tok_s
+        if est > req.deadline_s:
+            self.stats["shed"] += 1
+            raise RequestShed(
+                f"PT-SRV-003: request rid={req.rid} shed at submit — "
+                f"{backlog} backlog tokens at {self._ema_tok_s:.1f} tok/s "
+                f"needs ~{est:.3f}s, past its {req.deadline_s:.3f}s deadline")
 
     def has_work(self) -> bool:
         return bool(self._queue) or any(s is not None for s in self._slots)
@@ -330,6 +453,70 @@ class ContinuousBatchingEngine:
         values). Host-side time is accounted in ``self.stats``
         (admit_host_s / decode_host_s) so the admission share is measurable
         at any workload."""
+        import time as _time
+
+        from ..distributed.resilience.faults import maybe_inject
+        from ..distributed.resilience.retry import retry_stats
+
+        self._step_idx += 1
+        # injection sites (docs/RESILIENCE.md): `serving.stall` sleeps the
+        # step past its wall-clock budget (StepWatchdog / PT-SRV-002);
+        # `serving.step` kills the engine mid-wave (ServingSupervisor
+        # rebuild-from-journal / PT-SRV-001). One global read each when no
+        # plan is installed.
+        maybe_inject("serving.stall", f"step:{self._step_idx}")
+        maybe_inject("serving.step", f"step:{self._step_idx}")
+        t0 = _time.perf_counter()
+        sched0 = self._sched_tokens
+        self._deferred_step = False
+        try:
+            self._step_inner()
+        finally:
+            dt = _time.perf_counter() - t0
+            d = self._sched_tokens - sched0
+            if d > 0 and dt > 0:
+                rate = d / dt
+                self._ema_tok_s = (rate if self._ema_tok_s is None
+                                   else 0.7 * self._ema_tok_s + 0.3 * rate)
+            if self._brownout_cfg is not None:
+                self._brownout_tick()
+            rs = retry_stats()
+            self.stats["retry_attempts"] = rs["attempts"]
+            self.stats["retry_giveups"] = rs["giveups"]
+
+    def _brownout_tick(self):
+        """Hysteretic brownout state machine (docs/SERVING.md), evaluated
+        once per step: sustained admission deferrals enter the degraded
+        mode (idle cached blocks flushed, matching/registration and chunked
+        prefill off); a sustained pressure-free streak with real pool
+        headroom exits it."""
+        cfg = self._brownout_cfg
+        if self._brownout_active:
+            self.stats["brownout_steps"] += 1
+            free_frac = self._alloc.free_blocks / max(1, self._alloc.num_blocks)
+            if not self._deferred_step and free_frac >= cfg.exit_free_frac:
+                self._clear_steps += 1
+                if self._clear_steps >= cfg.exit_after:
+                    self._brownout_active = False
+                    self._pressure_steps = self._clear_steps = 0
+            else:
+                self._clear_steps = 0
+            return
+        if self._deferred_step:
+            self._pressure_steps += 1
+            if self._pressure_steps >= cfg.enter_after:
+                self._brownout_active = True
+                self._clear_steps = 0
+                self.stats["brownouts"] += 1
+                # flush cached-idle blocks: under pressure the working set
+                # outranks reuse — reclaimed pages go straight back to the
+                # pool the deferred head is waiting on
+                self._radix.evict_lru(self._alloc.num_blocks)
+                self.stats["evictions"] = self._radix.evictions
+        else:
+            self._pressure_steps = 0
+
+    def _step_inner(self):
         import time as _time
 
         self._evict_expired()
@@ -491,6 +678,7 @@ class ContinuousBatchingEngine:
                 took = min(n, req.max_new_tokens - req._n_out)
                 entries.append((i, req, took))
                 req._n_out += took
+                self._sched_tokens += took
                 self._pos[i] += took
                 if req._n_out >= req.max_new_tokens:
                     req.done = True
@@ -514,6 +702,7 @@ class ContinuousBatchingEngine:
                     req.done = True
                     break
             self._pos[i] += took
+            self._sched_tokens += took
             if req.done:
                 self._finished[req.rid] = req
                 self._release_slot(i)       # slot + its pages are free again
@@ -618,6 +807,9 @@ class ContinuousBatchingEngine:
             if held:
                 self._alloc.hold(held)
             if not self._try_admit_prefix(free[0], req):
+                # deferral = the pool could not serve the head even after
+                # LRU eviction — the brownout pressure signal
+                self._deferred_step = True
                 break
             self._queue.popleft()
             free.pop(0)
@@ -627,8 +819,11 @@ class ContinuousBatchingEngine:
         page = self.page_size
         prompt = req.prompt
         n_full = len(prompt) // page
+        # brownout: admission stops consulting the radix cache entirely —
+        # every block is freshly allocated (still through the refcounted
+        # pool), which is exactly the cache-off working-set shape
         matched = (self._radix.match(prompt[: n_full * page])
-                   if n_full else [])
+                   if n_full and not self._brownout_active else [])
         cow_src = None
         if matched and len(matched) * page == len(prompt):
             # FULL-prompt hit: nothing to prefill, but the first-token
@@ -731,6 +926,16 @@ class ContinuousBatchingEngine:
                         if self._prefill_next[s] < len(self._slots[s].prompt)]
             if chunkers:
                 self._run_chunk(chunkers)
+                while self._brownout_active and any(
+                        self._prefill_next[s] < len(r.prompt)
+                        for s, r in chunkers):
+                    # brownout disables chunked INTERLEAVING: the whole
+                    # prompt prefills this tick (legacy admit-stalls-a-step
+                    # behavior), trading decode overlap for zero extra
+                    # mid-prefill state under pressure. Same compiled chunk
+                    # program, run to completion.
+                    self._run_chunk([(s, r) for s, r in chunkers
+                                     if self._prefill_next[s] < len(r.prompt)])
             ready = [(s, self._slots[s]) for s in sorted(self._prefill_next)
                      if self._prefill_next[s] >= len(self._slots[s].prompt)]
             if ready:
@@ -817,10 +1022,12 @@ class ContinuousBatchingEngine:
         entries = []
         for row, (slot, req) in enumerate(ready):
             n_full = len(req.prompt) // self.page_size
-            if n_full:
+            if n_full and not self._brownout_active:
                 # register AFTER the full prompt (incl. the re-step rewrite)
                 # is scheduled — later admissions are device-ordered behind
-                # these writes; first writer wins on duplicate chains
+                # these writes; first writer wins on duplicate chains.
+                # Brownout skips registration: blocks must return to the
+                # pool the moment the request finishes, not linger cached.
                 self._radix.insert(req.prompt[: n_full * self.page_size],
                                    self._slot_blocks[slot][:n_full])
             del self._prefill_next[slot]
@@ -829,6 +1036,7 @@ class ContinuousBatchingEngine:
             self._topks[slot] = req.top_k
             self._seeds[slot] = req.seed
             req._n_out += 1
+            self._sched_tokens += 1
             self._pos[slot] = len(req.prompt) + 1
             self._tables_host[slot] = self._slot_rows[slot]
             self._tables_dirty = True
@@ -881,6 +1089,7 @@ class ContinuousBatchingEngine:
                 self._seeds[slot] = req.seed
                 self._slots[slot] = req
                 req._n_out += 1
+                self._sched_tokens += 1
                 self._pos[slot] = len(req.prompt) + 1
                 if firsts is not None:
                     req.output.append(int(firsts[row]))

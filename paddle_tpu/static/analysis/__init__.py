@@ -35,8 +35,8 @@ from .graph_health import GraphHealthReporter
 from .shape_check import ShapeDtypeVerifier
 from .spmd_check import SpmdConsistencyChecker, check_axis_names, check_placements
 from .trace_import import layer_to_program, trace_to_program
-from .trace_lint import (TraceHazardLinter, lint_executor, lint_scope,
-                         lint_static_function)
+from .trace_lint import (TraceHazardLinter, lint_executor, lint_host_borrow,
+                         lint_scope, lint_static_function)
 
 __all__ = [
     "Severity", "Diagnostic", "AnalysisReport", "AnalysisPass",
@@ -44,17 +44,18 @@ __all__ = [
     "GraphHealthReporter", "run_analysis", "default_analysis_passes",
     "trace_to_program", "layer_to_program",
     "lint_executor", "lint_static_function", "lint_scope",
-    "check_placements", "check_axis_names",
+    "lint_host_borrow", "check_placements", "check_axis_names",
 ]
 
 
 def default_analysis_passes(targets=None, parameters=None, suppress=(),
                             executors=(), static_fns=(), scopes=(),
-                            assume_seeded=None):
+                            borrow_fns=(), assume_seeded=None):
     return [
         ShapeDtypeVerifier(suppress=suppress),
         TraceHazardLinter(suppress=suppress, executors=executors,
                           static_fns=static_fns, scopes=scopes,
+                          borrow_fns=borrow_fns,
                           assume_seeded=assume_seeded),
         SpmdConsistencyChecker(suppress=suppress),
         GraphHealthReporter(targets=targets, parameters=parameters,
@@ -65,14 +66,14 @@ def default_analysis_passes(targets=None, parameters=None, suppress=(),
 def run_analysis(program: Program, passes: Optional[Sequence[AnalysisPass]] = None,
                  targets=None, parameters=None, suppress=(),
                  executors=(), static_fns=(), scopes=(),
-                 assume_seeded=None) -> AnalysisReport:
+                 borrow_fns=(), assume_seeded=None) -> AnalysisReport:
     """Run the analyzer suite over a Program; return the combined report.
     Composes through the ordinary PassManager — analysis passes are regular
     passes that happen not to mutate."""
     passes = list(passes if passes is not None else default_analysis_passes(
         targets=targets, parameters=parameters, suppress=suppress,
         executors=executors, static_fns=static_fns, scopes=scopes,
-        assume_seeded=assume_seeded))
+        borrow_fns=borrow_fns, assume_seeded=assume_seeded))
     PassManager(passes).run(program)
     report = AnalysisReport()
     for p in passes:

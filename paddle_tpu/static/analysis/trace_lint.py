@@ -14,6 +14,11 @@ randomness instead of errors:
   an explicit seed — results are not reproducible run-to-run.
 - PT-TRACE-004 (warning): ``.numpy()`` / ``.item()`` in the source of a traced
   callable — a host sync that breaks (or silently graph-breaks) tracing.
+- PT-TRACE-005 (error): ``jnp.asarray(buf)`` on a host buffer that is
+  mutated later in the same scope — jax BORROWS the numpy buffer for an
+  async transfer, so the device can observe the post-mutation bytes
+  (the serving-engine bug class: ~1/30 runs decoded against post-mutation
+  block tables until ``.copy()`` snapshots were uploaded instead).
 - PT-SCOPE-001 (warning): a Scope read of a never-written variable that
   silently materialized a ()-shaped float32 zero.
 """
@@ -30,7 +35,7 @@ from ...core.static_graph import STOCHASTIC_KEYWORDS, Program
 from .diagnostics import AnalysisPass, Diagnostic, Severity
 
 __all__ = ["TraceHazardLinter", "lint_executor", "lint_static_function",
-           "lint_scope"]
+           "lint_scope", "lint_host_borrow"]
 
 # distinct compiled variants of one program/function before we call it churn
 RECOMPILE_THRESHOLD = 3
@@ -47,11 +52,12 @@ class TraceHazardLinter(AnalysisPass):
     name = "trace_hazard_linter"
 
     def __init__(self, suppress=(), executors=(), static_fns=(), scopes=(),
-                 assume_seeded: Optional[bool] = None):
+                 borrow_fns=(), assume_seeded: Optional[bool] = None):
         super().__init__(suppress)
         self.executors = list(executors)
         self.static_fns = list(static_fns)
         self.scopes = list(scopes)
+        self.borrow_fns = list(borrow_fns)
         self.assume_seeded = assume_seeded
 
     def _op_unseeded(self, program: Program, op) -> bool:
@@ -92,6 +98,8 @@ class TraceHazardLinter(AnalysisPass):
             out.extend(lint_static_function(sf, analyzer=self.name))
         for sc in self.scopes:
             out.extend(lint_scope(sc, analyzer=self.name))
+        for fn in self.borrow_fns:
+            out.extend(lint_host_borrow(fn, analyzer=self.name))
         return out
 
 
@@ -159,6 +167,127 @@ def lint_static_function(sf, threshold: int = RECOMPILE_THRESHOLD,
                 f"sync — it breaks tracing (or forces an eager graph break)",
                 source=f"{srcfile}:{base + node.lineno - 1}",
                 analyzer=analyzer))
+    return out
+
+
+_ASARRAY_MODS = ("jnp", "jax")       # jnp.asarray / jax.numpy.asarray
+
+
+def _buffer_expr(node):
+    """Dotted-name string for a Name/Attribute chain, else None (calls,
+    subscripts etc. are not trackable buffers)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _is_jnp_asarray(call: ast.Call) -> bool:
+    f = call.func
+    if not (isinstance(f, ast.Attribute) and f.attr == "asarray"):
+        return False
+    base = f.value
+    if isinstance(base, ast.Name):
+        return base.id in _ASARRAY_MODS
+    # jax.numpy.asarray
+    return (isinstance(base, ast.Attribute) and base.attr == "numpy"
+            and isinstance(base.value, ast.Name)
+            and base.value.id in _ASARRAY_MODS)
+
+
+# numpy methods that mutate the receiver in place — a post-upload call on
+# the uploaded buffer is the same hazard as a subscript store
+_MUTATORS = ("fill", "sort", "resize", "put", "partition", "setfield")
+
+
+def lint_host_borrow(fn, analyzer: str = "trace_hazard_linter"
+                     ) -> List[Diagnostic]:
+    """PT-TRACE-005: flag ``jnp.asarray(buf)`` on a host buffer mutated
+    later in the same scope.
+
+    ``jnp.asarray`` on a numpy array BORROWS the buffer for an async
+    host->device transfer; a later in-place mutation (``buf[i] = ...``,
+    ``buf += ...``, ``buf.fill(...)``) can land before the transfer drains,
+    and the device silently reads the post-mutation bytes. Upload
+    ``buf.copy()`` instead. "Later" means a mutation on a line after the
+    upload, or anywhere inside a loop that also contains the upload (the
+    next iteration's mutation races the previous iteration's transfer —
+    exactly how the serving engine hit it). ``fn`` may be a callable or a
+    source string."""
+    out: List[Diagnostic] = []
+    if isinstance(fn, str):
+        src, base, srcfile, name = fn, 1, "<source>", "<source>"
+    else:
+        try:
+            src = textwrap.dedent(inspect.getsource(fn))
+            base = max(inspect.getsourcelines(fn)[1], 1)
+            srcfile = inspect.getsourcefile(fn) or "<source>"
+        except (OSError, TypeError):
+            return out
+        name = getattr(fn, "__name__", "<fn>")
+    try:
+        tree = ast.parse(textwrap.dedent(src))
+    except SyntaxError:
+        return out
+
+    # uploads: buffer expr -> [(lineno, loop-ids containing the call)]
+    loops: List[ast.AST] = []
+
+    def loop_stack(target):
+        """ids of the loop nodes whose body contains ``target``."""
+        hits = []
+        for ln in loops:
+            for sub in ast.walk(ln):
+                if sub is target:
+                    hits.append(id(ln))
+                    break
+        return hits
+
+    loops = [n for n in ast.walk(tree) if isinstance(n, (ast.For, ast.While))]
+    uploads = []                      # (expr, lineno, set(loop ids))
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_jnp_asarray(node) and node.args:
+            expr = _buffer_expr(node.args[0])
+            if expr is not None:
+                uploads.append((expr, node.lineno, set(loop_stack(node))))
+    if not uploads:
+        return out
+    mutations = []                    # (expr, lineno, set(loop ids))
+    for node in ast.walk(tree):
+        tgt = None
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            tgts = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for t in tgts:
+                if isinstance(t, ast.Subscript):
+                    tgt = _buffer_expr(t.value)
+                elif isinstance(node, ast.AugAssign):
+                    # ``buf += 1`` is an IN-PLACE ndarray op (same buffer);
+                    # a plain ``buf = ...`` rebinds and is not a mutation
+                    tgt = _buffer_expr(t)
+        elif (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _MUTATORS):
+            tgt = _buffer_expr(node.func.value)
+        if tgt is not None:
+            mutations.append((tgt, node.lineno, set(loop_stack(node))))
+    for expr, up_line, up_loops in uploads:
+        for mexpr, m_line, m_loops in mutations:
+            if mexpr != expr:
+                continue
+            if m_line > up_line or (up_loops & m_loops):
+                out.append(Diagnostic(
+                    "PT-TRACE-005", Severity.ERROR,
+                    f"'{name}': jnp.asarray({expr}) borrows the host buffer "
+                    f"for an async transfer, but {expr} is mutated at line "
+                    f"{base + m_line - 1} — the device can read the "
+                    f"post-mutation bytes; upload {expr}.copy() instead",
+                    source=f"{srcfile}:{base + up_line - 1}",
+                    analyzer=analyzer))
+                break
     return out
 
 

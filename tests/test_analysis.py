@@ -231,6 +231,89 @@ class TestTraceHazardLinter:
 
 
 # ---------------------------------------------------------------------------
+# host-borrow lint (PT-TRACE-005 — the PR-4 serving bug class)
+# ---------------------------------------------------------------------------
+
+class TestHostBorrowLint:
+    def test_mutation_after_upload_flagged(self):
+        from paddle_tpu.static.analysis import lint_host_borrow
+
+        def dispatch(tables):
+            import jax.numpy as jnp
+
+            dev = jnp.asarray(tables)       # borrows the host buffer
+            tables[0] = -1                  # mutated while transfer in flight
+            return dev
+
+        hits = [d for d in lint_host_borrow(dispatch)
+                if d.code == "PT-TRACE-005"]
+        assert hits and hits[0].severity == Severity.ERROR
+        assert "tables" in hits[0].message and ".copy()" in hits[0].message
+
+    def test_loop_mutation_races_previous_iterations_upload(self):
+        from paddle_tpu.static.analysis import lint_host_borrow
+
+        # textually the mutation PRECEDES the upload, but inside a loop the
+        # next iteration's store races the previous iteration's transfer —
+        # exactly how the serving engine hit it
+        src = (
+            "def tick(buf):\n"
+            "    import jax.numpy as jnp\n"
+            "    for i in range(8):\n"
+            "        buf[i] = i\n"
+            "        dev = jnp.asarray(buf)\n"
+            "    return dev\n")
+        assert any(d.code == "PT-TRACE-005" for d in lint_host_borrow(src))
+
+    def test_whole_array_augassign_flagged_rebind_clean(self):
+        from paddle_tpu.static.analysis import lint_host_borrow
+
+        # ``buf += 1`` mutates the SAME ndarray in place — as much a race
+        # as a subscript store; a plain ``buf = ...`` rebinds and is clean
+        src = (
+            "def f(buf):\n"
+            "    import jax.numpy as jnp\n"
+            "    dev = jnp.asarray(buf)\n"
+            "    buf += 1\n"
+            "    return dev\n")
+        assert any(d.code == "PT-TRACE-005" for d in lint_host_borrow(src))
+        rebind = (
+            "def g(buf):\n"
+            "    import jax.numpy as jnp\n"
+            "    dev = jnp.asarray(buf)\n"
+            "    buf = make_fresh()\n"
+            "    return dev\n")
+        assert not lint_host_borrow(rebind)
+
+    def test_copy_upload_and_pre_mutation_clean(self):
+        from paddle_tpu.static.analysis import lint_host_borrow
+
+        def safe(tables):
+            import jax.numpy as jnp
+
+            tables[0] = -1                  # before the upload: sequenced
+            dev = jnp.asarray(tables.copy())   # snapshot, no borrow
+            return dev
+
+        assert not lint_host_borrow(safe)
+
+    def test_wired_through_trace_hazard_linter(self):
+        def bad(buf):
+            import jax.numpy as jnp
+
+            dev = jnp.asarray(buf)
+            buf.fill(0)                     # in-place mutator method
+            return dev
+
+        main = static.Program()
+        with program_guard(main):
+            static.data("x", [2], "float32")
+        rep = AnalysisReport(
+            TraceHazardLinter(borrow_fns=[bad]).analyze(main))
+        assert rep.by_code("PT-TRACE-005")
+
+
+# ---------------------------------------------------------------------------
 # SPMD consistency checker
 # ---------------------------------------------------------------------------
 

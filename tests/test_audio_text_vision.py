@@ -114,17 +114,25 @@ class TestVisionZoo:
         model.eval()
         return model(x)
 
+    # the zoo factories share no code with the engine/serving/training
+    # layers — these are pure architecture smoke tests, and their eager
+    # conv stacks are the heaviest single tests in the suite (~60s
+    # combined on CPU). Slow-marked to keep tier-1 inside its wall-clock
+    # budget (ROADMAP.md); tier-2 (`-m slow`) still runs them.
+    @pytest.mark.slow
     def test_vgg(self):
         # adaptive pool tolerates small inputs: 64px keeps the CPU test fast
         out = self._fwd(V.vgg11(num_classes=10), 64)
         assert out.shape == [1, 10]
 
+    @pytest.mark.slow
     def test_mobilenets(self):
         out = self._fwd(V.mobilenet_v1(num_classes=7), 64)
         assert out.shape == [1, 7]
         out = self._fwd(V.mobilenet_v2(num_classes=7), 64)
         assert out.shape == [1, 7]
 
+    @pytest.mark.slow
     def test_alexnet_squeezenet(self):
         out = self._fwd(V.alexnet(num_classes=5), 96)
         assert out.shape == [1, 5]

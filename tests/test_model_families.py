@@ -115,6 +115,8 @@ class TestUNet:
                               "context": ctx, "noise": noise})
         assert np.isfinite(float(loss))
 
+    @pytest.mark.slow   # full UNet backward on CPU ~17s; forward/loss and
+    #                     the bf16-parity test keep fast UNet coverage
     def test_grad_flows_through_unet(self):
         import jax
 

@@ -159,6 +159,32 @@ class TestRetry:
                    sleep=lambda s: None)
         assert seen == [(1, "ConnectionError"), (2, "ConnectionError")]
 
+    def test_stats_registry_counts_attempts_retries_giveups(self):
+        """Satellite (docs/RESILIENCE.md): every retry_call feeds the
+        module-level stats registry — the seed of the observability layer,
+        surfaced in ContinuousBatchingEngine.stats and fault_drill output."""
+        from paddle_tpu.distributed.resilience import (reset_retry_stats,
+                                                       retry_stats)
+
+        reset_retry_stats()
+        fn, _ = self._flaky(2)
+        pol = RetryPolicy(max_attempts=4, base_delay=0.001, jitter=0.0)
+        retry_call(fn, policy=pol, what="unit-ok", sleep=lambda s: None)
+        s = retry_stats()
+        assert (s["calls"], s["attempts"], s["retries"], s["giveups"]) \
+            == (1, 3, 2, 0)
+        assert s["by_what"]["unit-ok"] == 3 and s["latency_s"] >= 0.0
+        fn2, _ = self._flaky(99)
+        with pytest.raises(RetryError):
+            retry_call(fn2, policy=RetryPolicy(max_attempts=2,
+                                               base_delay=0.001, jitter=0.0),
+                       what="unit-dead", sleep=lambda s: None)
+        s = retry_stats()
+        assert s["giveups"] == 1 and s["calls"] == 2
+        assert s["by_what"]["unit-dead"] == 2
+        reset_retry_stats()
+        assert retry_stats()["attempts"] == 0
+
 
 # ---------------------------------------------------------------------------
 # TCPStore retry + fault sites

@@ -281,6 +281,57 @@ def test_deadline_eviction_decrefs_not_frees_shared_blocks(model, eng2):
     assert rA.tokens == refA              # survivor undisturbed
 
 
+def test_deadline_eviction_mid_chunked_prefill_releases_pages(model, eng2):
+    """Regression (satellite): a slot evicted MID-chunked-prefill must
+    release its parked/partial pages (all pages allocate at admission;
+    eviction before the prompt finishes prefilling returns every one) and
+    leave the prefill group — without disturbing the decoding row or the
+    next admission into the freed slot."""
+    cfg, m = model
+    e = eng2
+    # deterministic eviction: the feasibility shedder would refuse the
+    # doomed deadline at submit on a warm engine (that path has its own
+    # tests) — this test needs the request ADMITTED so eviction can bite
+    e.shed_infeasible = False
+    # start from a drained pool: leftover cached chains from earlier tests
+    # would make the conservation check depend on test history
+    e._radix.evict_lru(e._alloc.num_blocks)
+    assert e._alloc.free_blocks == e._alloc.num_blocks
+    try:
+        pa, pb, pc = _prompt(cfg, 6, 130), _prompt(cfg, 24, 131), \
+            _prompt(cfg, 6, 132)
+        refA = _ref(m, pa, 26)
+        rA = Request(pa, max_new_tokens=26)
+        e.add_request(rA)
+        e.step()                          # A decoding (4 pages)
+        rB = Request(pb, max_new_tokens=4, deadline_s=0.25)
+        e.add_request(rB)
+        e.step()                          # B admitted: ONE chunk prefilled
+        slot_b = next(iter(e._prefill_next))
+        assert e._prefill_next[slot_b] < len(pb)   # genuinely mid-prefill
+        blocks_b = list(e._slot_blocks[slot_b])    # all 4 pages parked
+        assert len(blocks_b) == 4 and e._alloc.free_blocks == 0
+        time.sleep(0.3)
+        e.step()                          # deadline tick evicts B
+        assert rB.failed and rB.done and "deadline" in rB.error
+        assert slot_b not in e._prefill_next       # out of the prefill group
+        assert e._slots[slot_b] is None
+        assert (e._tables_host[slot_b] == e._park).all()
+        # every parked/partial page back in the pool — B never registered,
+        # so nothing may linger cached-idle either
+        for b in blocks_b:
+            assert e._alloc.refcount(b) == 0
+        assert e._alloc.free_blocks >= len(blocks_b)
+        rC = Request(pc, max_new_tokens=4)         # freed slot is reusable
+        e.add_request(rC)
+        e.run_until_done(max_steps=300)
+        assert rA.tokens == refA                   # survivor undisturbed
+        assert rC.tokens == _ref(m, pc, 4)
+        assert e._alloc.free_blocks == e._alloc.num_blocks  # no page leaked
+    finally:
+        e.shed_infeasible = True
+
+
 @pytest.mark.slow   # the fault drill (CI-gated) covers this end-to-end
 def test_pool_exhaustion_defers_admission_and_recovers(model):
     """Seeded block-pool exhaustion (FaultPlan 'exhaust'): the queue head

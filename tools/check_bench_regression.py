@@ -41,11 +41,21 @@ PRIMARY = "llama_pretrain_tokens_per_sec_per_chip"
 #   the admission path (chunk programs, radix walk, COW) against host-side
 #   or recompile regressions; "higher is better", 30% tolerance rides out
 #   CI jitter on a sub-second wave.
+# - serving_recovery_time_s: supervisor rebuild+replay after a mid-decode
+#   engine kill (docs/SERVING.md) — dominated by recompiles on the fresh
+#   engine; the 2s floor keeps tiny-model CI noise from hair-triggering,
+#   while a real regression (replay doing quadratic journal work, rebuild
+#   re-running whole prompts it already delivered) fails past 2x.
+# - serving_shed_rate: fraction of an overload wave (half infeasible
+#   deadlines) refused at submit — if feasibility shedding breaks the rate
+#   collapses toward 0 ("higher" direction catches it).
 SECONDARY = {
     "serving_p99_step_latency_ms": ("lower", 1.0, 0.0),
     "guard_overhead_pct": ("lower", 1.0, 5.0),
     "serving_prefix_hit_rate": ("higher", 0.2, 0.0),
     "serving_prefill_tokens_per_sec": ("higher", 0.3, 0.0),
+    "serving_recovery_time_s": ("lower", 1.0, 2.0),
+    "serving_shed_rate": ("higher", 0.5, 0.0),
 }
 
 

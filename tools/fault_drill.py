@@ -629,6 +629,252 @@ def drill_poison_batch(recover: bool):
                       f"({'|'.join(meta['bits'])}) for replay_batch.py")
 
 
+# ---------------------------------------------------------------------------
+# serving supervisor drills: crash, stall, overload (docs/SERVING.md)
+# ---------------------------------------------------------------------------
+
+def _crash_wave():
+    """The crash drill wave: a short greedy request whose full-page prompt
+    registers in the radix cache, a long seeded sampled request, and a
+    repeat of the first prompt — admitted AFTER the first finished, so it
+    takes the full-prompt-hit COW path and is mid-decode PAST the
+    copy-on-write divergence point when the kill lands. Params only;
+    Request objects are built fresh per run."""
+    import numpy as np
+
+    cfg, _ = _serving_model()
+    rng = np.random.default_rng(17)
+    pa = rng.integers(0, cfg.vocab_size, (8,)).astype(np.int32)   # 1 full page
+    pb = rng.integers(0, cfg.vocab_size, (6,)).astype(np.int32)
+    return [
+        dict(prompt_ids=pa, max_new_tokens=4, seed=50),
+        dict(prompt_ids=pb, max_new_tokens=12, temperature=0.9, seed=77),
+        dict(prompt_ids=pa, max_new_tokens=8, seed=50),           # COW hit
+    ]
+
+
+def _crash_build():
+    _, m = _serving_model()
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+
+    return ContinuousBatchingEngine(m, max_batch=2, max_len=32, page_size=8,
+                                    block_size=2, prefix_cache=True)
+
+
+def _crash_refs():
+    """Uninterrupted supervisor reference streams (computed once, cached —
+    both recovery modes and the stall drill compare against them)."""
+    if "crash_refs" not in _SERVING:
+        from paddle_tpu.inference.serving import Request, ServingSupervisor
+
+        with tempfile.TemporaryDirectory() as tmp:
+            sup = ServingSupervisor(_crash_build,
+                                    os.path.join(tmp, "ref.jrnl"))
+            reqs = [Request(**kw) for kw in _crash_wave()]
+            for r in reqs:
+                sup.submit(r)
+            sup.run_until_done(max_steps=500)
+            sup.close()
+        _SERVING["crash_refs"] = [list(r.tokens) for r in reqs]
+    return _SERVING["crash_refs"]
+
+
+def drill_serving_crash(recover: bool):
+    """The engine process dies mid-decode (FaultPlan ``serving.step`` kill).
+    Recovery = the ServingSupervisor rebuilds a fresh engine (new block
+    pool, empty radix cache) and replays every journaled unfinished request
+    — token streams BIT-IDENTICAL to the uninterrupted run (greedy, seeded,
+    and across the COW divergence point). Without the supervisor's journal
+    the crash loses every in-flight request."""
+    from paddle_tpu.distributed.resilience import FaultPlan, FaultSpec
+    from paddle_tpu.inference.serving import Request, ServingSupervisor
+
+    refs = _crash_refs()
+    # at=3: the fourth engine step — the seeded request AND the COW-hit
+    # repeat are both mid-decode (the repeat already past its COW point)
+    plan = FaultPlan(seed=3, specs=[
+        FaultSpec("serving.step", "kill", at=3, count=1)])
+    with tempfile.TemporaryDirectory() as tmp:
+        sup = ServingSupervisor(_crash_build, os.path.join(tmp, "j.jrnl"),
+                                max_recoveries=2 if recover else 0)
+        reqs = [Request(**kw) for kw in _crash_wave()]
+        try:
+            with plan:
+                for r in reqs:
+                    sup.submit(r)
+                sup.run_until_done(max_steps=500)
+        except Exception as e:
+            if recover:
+                return False, f"supervisor did not absorb the crash: {e!r}"
+            lost = [r.rid for r in reqs if not r.done]
+            if not lost:
+                return True, "unexpected: crash raised but no request lost"
+            return False, (f"no journal/supervisor: engine crash lost "
+                           f"{len(lost)} in-flight request(s) {lost}")
+        finally:
+            sup.close()
+        if not plan.log:
+            return False, "serving.step kill never fired"
+        if not recover:
+            return True, "unexpected: crash absorbed without recovery"
+        if sup.recoveries < 1:
+            return False, "crash never triggered a rebuild"
+        streams = [list(r.tokens) for r in reqs]
+        if streams != refs:
+            bad = [i for i, (s, f) in enumerate(zip(streams, refs)) if s != f]
+            return False, (f"recovered stream(s) {bad} diverged from the "
+                           "uninterrupted run")
+        return True, (f"PT-SRV-001: crash at {plan.log[0][1]}, rebuilt + "
+                      f"replayed {sup.stats['replayed_requests']} request(s) "
+                      f"in {sup.stats['recovery_s']:.2f}s, all 3 streams "
+                      "bit-identical (incl. COW + seeded sampling)")
+
+
+def drill_serving_stall(recover: bool):
+    """One engine step hangs (FaultPlan ``serving.stall``). Recovery = the
+    threaded StepWatchdog flags PT-SRV-002 while the step is stuck and the
+    supervisor rebuilds-from-journal; streams stay bit-identical. Without
+    the watchdog the stall silently blows the per-step latency SLO.
+
+    Runs on the legacy (cache-off) engine, WARMED with an identical wave
+    first so every armed step reuses compiled programs — a compile-heavy
+    step is indistinguishable from a stall, which is exactly why the
+    supervisor warms before arming (and graces steps after a rebuild)."""
+    import time as _t
+
+    import numpy as np
+
+    from paddle_tpu.distributed.resilience import FaultPlan, FaultSpec
+    from paddle_tpu.inference.serving import (ContinuousBatchingEngine,
+                                              Request, ServingSupervisor)
+
+    BUDGET, STALL = 0.6, 1.5
+    cfg, m = _serving_model()
+    rng = np.random.default_rng(29)
+    ps = [rng.integers(0, cfg.vocab_size, (6,)).astype(np.int32)
+          for _ in range(2)]
+
+    def build():
+        return ContinuousBatchingEngine(m, max_batch=2, max_len=32,
+                                        page_size=8, block_size=2)
+
+    def wave(sup):
+        reqs = [Request(p, max_new_tokens=8, seed=60 + i)
+                for i, p in enumerate(ps)]
+        for r in reqs:
+            sup.submit(r)
+        return reqs
+
+    plan = FaultPlan(seed=4, specs=[
+        FaultSpec("serving.stall", "stall", at=2, count=1, arg=STALL)])
+    with tempfile.TemporaryDirectory() as tmp:
+        sup = ServingSupervisor(build, os.path.join(tmp, "j.jrnl"))
+        warm_reqs = wave(sup)              # identical wave: warms every
+        sup.run_until_done(max_steps=200)  # program the armed wave will run
+        refs = [list(r.tokens) for r in warm_reqs]
+        if recover:
+            sup.set_step_budget(BUDGET)
+        reqs = wave(sup)
+        step_s = []
+        try:
+            import warnings
+
+            with plan, warnings.catch_warnings():
+                warnings.simplefilter("ignore", RuntimeWarning)
+                while sup.has_work():
+                    t0 = _t.perf_counter()
+                    sup.step()
+                    step_s.append(_t.perf_counter() - t0)
+        finally:
+            sup.close()
+        if not plan.log:
+            return False, "serving.stall never fired"
+        streams = [list(r.tokens) for r in reqs]
+        if not recover:
+            worst = max(step_s)
+            if worst <= BUDGET:
+                return True, "unexpected: stall absorbed under budget"
+            return False, (f"no watchdog: a step silently took {worst:.2f}s "
+                           f"(budget {BUDGET}s) — stall undetected, SLO "
+                           "violated")
+        codes = [c for c, _ in sup.events]
+        if "PT-SRV-002" not in codes:
+            return False, f"watchdog never flagged the stall (events {codes})"
+        if streams != refs:
+            return False, "post-rebuild streams diverged"
+        return True, (f"PT-SRV-002: stall flagged mid-hang, rebuilt in "
+                      f"{sup.stats['recovery_s']:.2f}s, streams bit-identical")
+
+
+def drill_serving_overload_shed(recover: bool):
+    """An infeasible-deadline request arrives while the engine is busy.
+    Recovery = deadline-feasibility shedding refuses it AT SUBMIT with a
+    typed RequestShed (PT-SRV-003) — before it occupies a slot or queue
+    time — and the running requests' streams are byte-identical to a run
+    without it. Without shedding it queues, burns its wait, and dies by
+    deadline eviction after the fact."""
+    import numpy as np
+
+    from paddle_tpu.inference.serving import (ContinuousBatchingEngine,
+                                              Request, RequestShed)
+
+    cfg, m = _serving_model()
+    rng = np.random.default_rng(23)
+    ps = [rng.integers(0, cfg.vocab_size, (6,)).astype(np.int32)
+          for _ in range(2)]
+
+    def survivors_wave(e):
+        reqs = [Request(p, max_new_tokens=8, seed=100 + i)
+                for i, p in enumerate(ps)]
+        for r in reqs:
+            e.add_request(r)
+        return reqs
+
+    def make():
+        e = ContinuousBatchingEngine(m, max_batch=2, max_len=32, page_size=8,
+                                     block_size=2,
+                                     shed_infeasible=recover)
+        warm = Request(np.asarray([4, 5], np.int32), max_new_tokens=2)
+        e.add_request(warm)
+        e.run_until_done()          # compiles + measures the decode rate
+        return e
+
+    if "shed_refs" not in _SERVING:
+        eng0 = make()
+        reqs0 = survivors_wave(eng0)
+        eng0.run_until_done(max_steps=300)
+        _SERVING["shed_refs"] = [list(r.tokens) for r in reqs0]
+    refs = _SERVING["shed_refs"]
+
+    eng = make()
+    survivors = survivors_wave(eng)
+    eng.step()                       # survivors admitted and decoding
+    doomed = Request(ps[0], max_new_tokens=16, deadline_s=1e-3)
+    shed = False
+    try:
+        eng.add_request(doomed)
+    except RequestShed:
+        shed = True
+    eng.run_until_done(max_steps=300)
+    streams = [list(r.tokens) for r in survivors]
+    if not recover:
+        if shed:
+            return True, "unexpected: shed fired with shedding disabled"
+        if not doomed.failed or "deadline" not in (doomed.error or ""):
+            return False, ("no shedding: infeasible request neither shed "
+                           "nor deadline-evicted — it just hogged the queue")
+        return False, ("no shedding: infeasible request queued and died by "
+                       f"deadline eviction after the fact ({doomed.error})")
+    if not shed:
+        return False, "infeasible request was not shed at submit"
+    if doomed._n_out != 0 or doomed.rid in [r.rid for r in eng._queue]:
+        return False, "shed request occupied engine state"
+    if streams != refs:
+        return False, "survivors' streams changed by the shed request"
+    return True, (f"PT-SRV-003: infeasible deadline shed at submit "
+                  f"({eng.stats['shed']} shed), survivors byte-identical")
+
+
 DRILLS = {
     "heartbeat": drill_heartbeat,
     "store_stall": drill_store_stall,
@@ -636,6 +882,9 @@ DRILLS = {
     "engine_saturation": drill_engine_saturation,
     "serving_deadline": drill_serving_deadline,
     "prefix_cache_exhaustion": drill_prefix_cache_exhaustion,
+    "serving_crash": drill_serving_crash,
+    "serving_stall": drill_serving_stall,
+    "serving_overload_shed": drill_serving_overload_shed,
     "nan_grad": drill_nan_grad,
     "loss_spike": drill_loss_spike,
     "poison_batch": drill_poison_batch,
@@ -649,11 +898,26 @@ def main(argv=None):
                     help="disable the drill's recovery path (must flip rc)")
     ap.add_argument("--selftest", action="store_true",
                     help="run the full matrix, both recovery modes")
+    ap.add_argument("--only", default=None, metavar="A,B,...",
+                    help="selftest subset: run only these drills")
+    ap.add_argument("--skip", default=None, metavar="A,B,...",
+                    help="selftest subset: run all but these drills "
+                         "(local iteration on one drill family)")
     args = ap.parse_args(argv)
 
     if args.selftest:
+        selected = dict(DRILLS)
+        for flag, keep in ((args.only, True), (args.skip, False)):
+            if flag is None:
+                continue
+            names = [n.strip() for n in flag.split(",") if n.strip()]
+            unknown = [n for n in names if n not in DRILLS]
+            if unknown:
+                ap.error(f"unknown drill(s): {', '.join(unknown)}")
+            selected = {k: v for k, v in selected.items()
+                        if (k in names) == keep}
         failures = 0
-        for name, drill in DRILLS.items():
+        for name, drill in selected.items():
             ok, info = drill(recover=True)
             print(f"[{'ok' if ok else 'FAIL'}] {name} (recovery on): {info}")
             if not ok:
@@ -663,10 +927,16 @@ def main(argv=None):
                   f"fault must bite): {info2}")
             if ok2:
                 failures += 1
+        from paddle_tpu.distributed.resilience import retry_stats
+
+        rs = retry_stats()
+        print(f"retry stats: {rs['calls']} calls, {rs['attempts']} attempts, "
+              f"{rs['retries']} retries, {rs['giveups']} give-ups, "
+              f"{rs['latency_s']:.2f}s cumulative latency")
         if failures:
             print(f"FAULT DRILL FAIL: {failures} expectation(s) violated")
             return 1
-        print(f"FAULT DRILL OK: {len(DRILLS)} fault classes recovered, "
+        print(f"FAULT DRILL OK: {len(selected)} fault classes recovered, "
               "each flips the gate without its recovery path")
         return 0
 

@@ -34,7 +34,7 @@ import numpy as np  # noqa: E402
 
 DEFECTS = ("shape_mismatch", "fp64_leak", "recompile_key",
            "unseeded_stochastic", "bad_mesh_axis", "uneven_shard",
-           "unused_param")
+           "unused_param", "async_borrow")
 
 EXPECTED_CODE = {
     "shape_mismatch": "PT-SHAPE-001",
@@ -44,6 +44,7 @@ EXPECTED_CODE = {
     "bad_mesh_axis": "PT-SPMD-001",
     "uneven_shard": "PT-SPMD-002",
     "unused_param": "PT-GRAPH-003",
+    "async_borrow": "PT-TRACE-005",
 }
 
 
@@ -201,6 +202,18 @@ def inject(defect, prog, model, context):
         params = list(context.get("parameters") or [])
         params.append(ghost)
         context["parameters"] = params
+    elif defect == "async_borrow":
+        # the PR-4 serving bug class, reduced: upload a host buffer with
+        # jnp.asarray, then mutate it — the async transfer may read the
+        # post-mutation bytes (PT-TRACE-005; a .copy() upload lints clean)
+        def dispatch_tables(tables_host):
+            import jax.numpy as jnp
+
+            dev = jnp.asarray(tables_host)
+            tables_host[0] = -1          # parks the row AFTER the borrow
+            return dev
+
+        context["borrow_fns"] = [dispatch_tables]
     else:
         raise SystemExit(f"unknown defect {defect!r} (choose: {DEFECTS})")
     return context
@@ -229,6 +242,7 @@ def lint_family(name, defect=None, fail_on="error"):
         targets=context.get("targets"),
         parameters=context.get("parameters"),
         executors=context.get("executors", ()),
+        borrow_fns=context.get("borrow_fns", ()),
     )
     floor = Severity.ERROR if fail_on == "error" else Severity.WARNING
     return prog, report, report.at_least(floor)
