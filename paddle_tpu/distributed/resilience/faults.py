@@ -45,6 +45,13 @@ Known sites (see docs/RESILIENCE.md for the catalogue):
 ``serving.stall``     same event stream as ``serving.step`` but consulted
                       first (``stall`` hangs the step past its wall-clock
                       budget — the StepWatchdog / PT-SRV-002 drill)
+``fleet.replica_kill``  fleet router, before each replica's supervisor
+                        step (detail = ``replica:<i>:step:<n>``; ``kill``
+                        = replica process death — the journal-backed
+                        failover drill, PT-FLT-001)
+``fleet.drain``       fleet router, top of every fleet step per replica
+                      (same detail; ``kill`` = operator drain signal —
+                      the rolling drain/restart drill, PT-FLT-002)
 ====================  =====================================================
 
 With no plan installed every hook is a cheap no-op (one global read), so

@@ -22,18 +22,30 @@ Components:
   record). Records: ``admit`` (full request parameters), ``prog`` (the
   emitted-token high-water mark plus the token ids themselves, so replay
   can verify bit-identity even across a process restart), ``fin``,
-  ``shed``, ``crash``/``recovered`` markers.
+  ``migr`` (migrated to another replica — fleet drain), ``shed``,
+  ``crash``/``recovered`` markers. Appends can be BATCHED off the hot
+  path: ``defer`` buffers encoded records in memory and ``flush`` writes
+  them in one syscall — the supervisor defers its per-step ``prog``
+  records and flushes once per step, BEFORE any token is surfaced to a
+  caller's stream, so the on-disk journal always covers everything a
+  streaming client could have seen (the recovery guarantee is unchanged;
+  only the write count per step collapsed).
 - :class:`ServingSupervisor` — owns the engine via a ``build_engine``
-  factory. ``submit`` journals then admits; ``step`` arms a
-  :class:`~paddle_tpu.distributed.resilience.watchdog.StepWatchdog` around
-  the engine step and, on a crash (any exception out of ``step`` — e.g. the
-  ``serving.step`` ``kill`` fault) or a watchdog overrun (``serving.stall``),
-  rebuilds: fresh engine, fresh block pool, empty radix cache, every
-  unfinished journaled request re-admitted and replayed. Tokens already
-  delivered (journaled high-water mark) are NOT re-delivered: the replay
-  catches up to the mark, verifies the regenerated prefix matches the
-  delivered one byte-for-byte (PT-SRV-005 on divergence), and streams on
-  from there.
+  factory. The engine works on private TWIN request objects; the caller's
+  ``Request`` receives tokens only at the post-flush splice, which is what
+  makes the flush barrier real. ``submit`` journals then admits; ``step``
+  arms a :class:`~paddle_tpu.distributed.resilience.watchdog.StepWatchdog`
+  around the engine step and, on a crash (any exception out of ``step`` —
+  e.g. the ``serving.step`` ``kill`` fault) or a watchdog overrun
+  (``serving.stall``), rebuilds: fresh engine, fresh block pool, empty
+  radix cache, every unfinished journaled request re-admitted and
+  replayed. Tokens already delivered (journaled high-water mark) are NOT
+  re-delivered: the replay catches up to the mark, verifies the
+  regenerated prefix matches the delivered one byte-for-byte (PT-SRV-005
+  on divergence), and streams on from there. ``submit(req, resume=True)``
+  exposes the same dedup for requests arriving with an already-delivered
+  prefix from ANOTHER replica's journal — the fleet failover path
+  (inference/fleet.py).
 
 Deadline semantics across recovery: a re-admitted request's deadline clock
 RESTARTS at re-admission (the journal stores the deadline *duration*) — an
@@ -57,7 +69,7 @@ import json
 import os
 import time
 import zlib
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Set
 
 from .serving import ContinuousBatchingEngine, Request, RequestShed
 
@@ -73,10 +85,11 @@ class RequestJournal:
     """Append-only, crc-checked request journal.
 
     One record per line: ``<crc32 of payload, 8 hex chars> <json payload>``.
-    Appends flush to the OS on every record (``fsync=True`` additionally
-    forces them to disk — crash-safe across power loss at a syscall per
-    record; the default survives process death, which is the serving
-    failure mode the supervisor drills).
+    ``append`` flushes to the OS per record; ``defer`` + ``flush`` batch
+    many records into one write+flush — the hot-path mode (``fsync=True``
+    additionally forces flushes to disk — crash-safe across power loss; the
+    default survives process death, which is the serving failure mode the
+    supervisor drills).
 
     Loading tolerates a torn final record (a crash mid-append) by
     truncating to the last good record; a bad crc anywhere EARLIER raises
@@ -86,6 +99,7 @@ class RequestJournal:
     def __init__(self, path: str, fsync: bool = False):
         self.path = path
         self.fsync = bool(fsync)
+        self._buf: List[bytes] = []
         d = os.path.dirname(os.path.abspath(path))
         os.makedirs(d, exist_ok=True)
         if os.path.exists(path):
@@ -153,22 +167,46 @@ class RequestJournal:
             break
         return out, good
 
-    def append(self, kind: str, **fields) -> None:
+    def defer(self, kind: str, **fields) -> None:
+        """Buffer one record in memory (visible immediately via
+        :attr:`records` — in-process recovery always sees it). Nothing
+        reaches the file until :meth:`flush`; callers own the barrier:
+        flush BEFORE acting on anything a crash must be able to replay."""
         rec = {"k": kind}
         rec.update(fields)
         payload = json.dumps(rec, separators=(",", ":")).encode("utf-8")
         crc = zlib.crc32(payload) & 0xFFFFFFFF
-        self._fh.write(b"%08x " % crc + payload + b"\n")
+        self._buf.append(b"%08x " % crc + payload + b"\n")
+        self.records.append(rec)
+
+    def flush(self) -> None:
+        """Write every deferred record in ONE syscall and flush to the OS
+        (+fsync when configured) — the durability barrier."""
+        if not self._buf:
+            return
+        self._fh.write(b"".join(self._buf))
+        self._buf.clear()
         self._fh.flush()
         if self.fsync:
             os.fsync(self._fh.fileno())
-        self.records.append(rec)
+
+    def append(self, kind: str, **fields) -> None:
+        self.defer(kind, **fields)
+        self.flush()
+
+    @staticmethod
+    def pending(records: List[dict]) -> List[dict]:
+        """Admit records with no matching terminal (``fin``/``migr``)
+        record — the ONE definition of the replay set, shared by
+        :meth:`unfinished` and the fleet's journal-backed failover."""
+        done = {r["rid"] for r in records if r["k"] in ("fin", "migr")}
+        return [r for r in records
+                if r["k"] == "admit" and r["rid"] not in done]
 
     def unfinished(self) -> List[dict]:
-        """Admit records with no matching ``fin`` — the replay set."""
-        done = {r["rid"] for r in self.records if r["k"] == "fin"}
-        return [r for r in self.records
-                if r["k"] == "admit" and r["rid"] not in done]
+        """The replay set (a migrated request is another replica's
+        responsibility)."""
+        return self.pending(self.records)
 
     def delivered(self, rid: int) -> List[int]:
         """Token ids journaled as delivered for ``rid`` (concatenated
@@ -180,6 +218,15 @@ class RequestJournal:
         return toks
 
     def close(self) -> None:
+        self.flush()
+        self._fh.close()
+
+    def abandon(self) -> None:
+        """Close WITHOUT flushing — process-death simulation (fleet drills):
+        deferred-but-unflushed records die with the process, exactly like a
+        kill between defer and flush would lose them. The flush barrier
+        guarantees no surfaced token is among them."""
+        self._buf.clear()
         self._fh.close()
 
 
@@ -192,10 +239,14 @@ def _admit_record(req: Request) -> dict:
 
 
 def _request_from(rec: dict) -> Request:
-    return Request(rec["prompt"], max_new_tokens=rec["max_new"],
-                   eos_token_id=rec["eos"], temperature=rec["temp"],
-                   top_p=rec["top_p"], top_k=rec["top_k"], seed=rec["seed"],
-                   deadline_s=rec["deadline_s"], priority=rec["priority"])
+    r = Request(rec["prompt"], max_new_tokens=rec["max_new"],
+                eos_token_id=rec["eos"], temperature=rec["temp"],
+                top_p=rec["top_p"], top_k=rec["top_k"], seed=rec["seed"],
+                deadline_s=rec["deadline_s"], priority=rec["priority"])
+    # twins and restart-reconstructions carry the ORIGINAL rid: the journal,
+    # the engine bookkeeping and the fleet's routing table all key on it
+    r.rid = rec["rid"]
+    return r
 
 
 class ServingSupervisor:
@@ -206,13 +257,15 @@ class ServingSupervisor:
     >>> sup.submit(Request(prompt, max_new_tokens=64))
     >>> done = sup.run_until_done()
 
-    The caller keeps its ``Request`` objects; across a crash their token
-    streams continue bit-identically (the supervisor replays on a rebuilt
-    engine, verifies the regenerated prefix against the journaled
-    high-water mark, and appends only the new tokens). A supervisor
-    constructed over an EXISTING journal (process restart) re-admits every
-    unfinished request automatically; their reconstructed ``Request``
-    objects live in :attr:`requests`.
+    The engine decodes into private TWIN objects; the caller's ``Request``
+    receives tokens only after the step's journal records are flushed (the
+    barrier that makes the on-disk high-water mark always cover everything
+    a streaming client saw). Across a crash the streams continue
+    bit-identically (the supervisor replays on a rebuilt engine, verifies
+    the regenerated prefix against the delivered one, and appends only the
+    new tokens). A supervisor constructed over an EXISTING journal (process
+    restart) re-admits every unfinished request automatically; their
+    reconstructed ``Request`` objects live in :attr:`requests`.
 
     ``max_recoveries`` bounds the rebuild budget (a crash loop must
     eventually surface, not mask); ``max_recoveries=0`` disables recovery —
@@ -242,9 +295,12 @@ class ServingSupervisor:
         self._grace = 0
         self.journal = RequestJournal(journal_path, fsync=fsync)
         self.requests: Dict[int, Request] = {}   # rid -> caller-facing req
-        self._live: Dict[int, Request] = {}      # rid -> object in engine
+        self._live: Dict[int, Request] = {}      # rid -> twin in the engine
         self._meta: Dict[int, dict] = {}         # rid -> admit record
-        self._hwm: Dict[int, int] = {}           # rid -> delivered tokens
+        # rids whose twin started BEHIND the delivered mark (recovery or a
+        # resume submission): the regenerated prefix must byte-match the
+        # delivered one before anything new is surfaced (PT-SRV-005)
+        self._verify: Set[int] = set()
         self._done: set = set()
         self._finished: Dict[int, Request] = {}
         self.events: List[tuple] = []            # (code, message)
@@ -271,28 +327,56 @@ class ServingSupervisor:
             # reconstructed ones (exposed via .requests) carry the streams.
             for rec in pending:
                 self._meta[rec["rid"]] = rec
-                self._hwm[rec["rid"]] = len(self.journal.delivered(rec["rid"]))
-                self.requests[rec["rid"]] = None   # filled by _readmit
             self._recover("PT-SRV-001",
                           f"journal restart: {len(pending)} unfinished "
                           "request(s) found", rebuild=False)
 
     # -- public API --------------------------------------------------------
-    def submit(self, req: Request) -> int:
-        """Journal + admit. ``RequestShed`` / ``EngineSaturated`` propagate
-        (the journal records sheds; a saturated queue records nothing — the
-        request never entered the system)."""
-        try:
-            self.engine.add_request(req)
-        except RequestShed:
-            self.stats["shed"] += 1
-            self.journal.append("shed", rid=req.rid)
-            raise
-        self.journal.append("admit", **_admit_record(req))
+    def submit(self, req: Request, resume: bool = False) -> int:
+        """Journal + admit (a private twin carrying the same rid enters the
+        engine). ``RequestShed`` / ``EngineSaturated`` propagate (the
+        journal records sheds; a saturated queue records nothing — the
+        request never entered the system).
+
+        ``resume=True``: ``req.output`` already holds tokens delivered by a
+        previous engine/replica (fleet failover). They are journaled as
+        this supervisor's high-water mark, the twin regenerates them from
+        scratch, and nothing new surfaces until the regenerated prefix
+        byte-matches the delivered one (PT-SRV-005 on divergence) — the
+        caller's stream continues exactly where it left off."""
+        meta = _admit_record(req)
+        twin = _request_from(meta)
+        if resume:
+            # journaled work is never refused: backpressure AND feasibility
+            # shedding were already charged at the ORIGINAL submit — a
+            # busy survivor must absorb another replica's rescued request,
+            # not shed it (the deadline clock restarts at re-admission)
+            saved_q = self.engine.max_queue
+            saved_shed = self.engine.shed_infeasible
+            self.engine.max_queue = None
+            self.engine.shed_infeasible = False
+            try:
+                self.engine.add_request(twin)
+            finally:
+                self.engine.max_queue = saved_q
+                self.engine.shed_infeasible = saved_shed
+        else:
+            try:
+                self.engine.add_request(twin)
+            except RequestShed:
+                self.stats["shed"] += 1
+                self.journal.append("shed", rid=req.rid)
+                raise
+        self.journal.defer("admit", **meta)
+        if resume and req.output:
+            self.journal.defer("prog", rid=req.rid, hwm=len(req.output),
+                               toks=[int(t) for t in req.output])
+            self._verify.add(req.rid)
+        self.journal.flush()
+        req._n_out = len(req.output)
         self.requests[req.rid] = req
-        self._live[req.rid] = req
-        self._meta[req.rid] = _admit_record(req)
-        self._hwm[req.rid] = 0
+        self._live[req.rid] = twin
+        self._meta[req.rid] = meta
         return req.rid
 
     def step(self) -> None:
@@ -343,9 +427,47 @@ class ServingSupervisor:
         return self.finished()
 
     def finished(self) -> Dict[int, Request]:
+        # control-plane refresh: engine.finished() also snapshots the retry
+        # registry into engine.stats — here (per collection), not per step
+        self.engine.finished()
         self._sync_progress()
         out, self._finished = self._finished, {}
         return out
+
+    def load(self) -> int:
+        """Requests currently in this supervisor's engine (queued + slotted
+        + mid-prefill) — the fleet router's balancing signal."""
+        eng = self.engine
+        return (len(eng._queue)
+                + sum(s is not None for s in eng._slots))
+
+    def progress(self) -> tuple:
+        """Progress marker for the fleet heartbeat. Changes whenever any
+        stream advances, a request completes, the engine is rebuilt, or
+        the load changes (so an idle-to-busy transition resets the
+        staleness clock — idleness must not count against the wedge ttl);
+        a supervisor with work whose marker sits still is wedged in a way
+        step completion cannot show (e.g. every slot deferring forever on
+        a stuck admission)."""
+        return (id(self.engine), self.engine._sched_tokens,
+                len(self._done), self.load())
+
+    def withdraw(self, rid: int) -> Optional[dict]:
+        """Pull a still-QUEUED request out of the engine (fleet drain
+        migration): journals ``migr`` — this journal's responsibility for
+        the request ends — and returns its admit record so the caller can
+        resubmit it elsewhere. None when the request is already active
+        (in-flight work finishes on this replica) or done."""
+        twin = self._live.get(rid)
+        if rid in self._done or twin is None:
+            return None
+        if not self.engine.withdraw_queued(rid):
+            return None
+        self.journal.append("migr", rid=rid)
+        self._live.pop(rid, None)
+        self._verify.discard(rid)
+        self.requests.pop(rid, None)
+        return self._meta.pop(rid, None)
 
     def set_step_budget(self, budget_s: Optional[float]) -> None:
         """(Re)arm the step watchdog — typically after a warmup wave has
@@ -363,38 +485,84 @@ class ServingSupervisor:
             self.watchdog.close()
         self.journal.close()
 
+    def abandon(self) -> None:
+        """Process-death simulation (fleet replica kill): release the fd
+        and watchdog WITHOUT flushing deferred records — recovery must work
+        from what the flush barrier guaranteed is on disk."""
+        if self.watchdog is not None:
+            self.watchdog.close()
+        self.journal.abandon()
+
     # -- progress / recovery ----------------------------------------------
     def _sync_progress(self) -> None:
-        """Materialize pending tokens, move the per-request high-water
-        marks forward in the journal, and surface completions. The journal
-        mark advances only over MATERIALIZED tokens — those are the ones a
-        streaming caller could have seen, so they are the ones recovery
-        must never re-deliver (and must reproduce exactly)."""
+        """Advance the caller-visible streams: drain the engine into the
+        twins, journal the per-request deltas (ONE buffered write), flush,
+        and only then splice tokens / completion into the caller's
+        objects. The flush-before-surface ordering is the recovery
+        contract: every token a streaming caller could have seen is on
+        disk, so recovery never re-delivers and must reproduce exactly."""
         # drains pending readbacks AND the engine-side finished dict (kept
-        # bounded); completion itself is tracked via the supervisor's maps
-        self.engine.finished()
+        # bounded); completion itself is tracked via the supervisor's maps.
+        # Deliberately NOT engine.finished(): that also snapshots the retry
+        # registry — control-plane work this per-step path must not pay
+        self.engine._drain_pending()
+        self.engine._finished.clear()
+        updates: List[tuple] = []
         for rid, user in self.requests.items():
-            if rid in self._done or user is None:
+            if rid in self._done:
                 continue
-            live = self._live.get(rid)
-            if live is None:
+            twin = self._live.get(rid)
+            if twin is None:
                 continue
-            if live is not user and len(live.output) > len(user.output):
-                user.output.extend(live.output[len(user.output):])
+            n_user = len(user.output)
+            n_twin = len(twin.output)
+            if rid in self._verify:
+                if n_twin < n_user and not twin.done:
+                    continue            # still catching up: surface nothing
+                k = min(n_twin, n_user)
+                # a twin that failed short of the mark (e.g. its deadline
+                # expired AGAIN during the compile-heavy catch-up) is an
+                # ordinary request failure, not a data-integrity alarm — so
+                # only the prefix it actually regenerated is held to the
+                # bit-identity contract; ending early WITHOUT failing, or
+                # emitting different tokens, is real divergence
+                if (twin.output[:k] != user.output[:k]
+                        or (twin.done and not twin.failed
+                            and n_twin < n_user)):
+                    err = (f"PT-SRV-005: replay diverged from the delivered "
+                           f"stream at rid={rid} — {twin.output[:k][:8]}... "
+                           f"vs {user.output[:8]}...")
+                    self.events.append(("PT-SRV-005", err))
+                    self.journal.defer("fin", rid=rid, failed=True)
+                    updates.append((rid, user, [], True, True, err))
+                    continue
+                if n_twin >= n_user:
+                    self._verify.discard(rid)
+            new = twin.output[n_user:] if n_twin > n_user else []
+            if new:
+                self.journal.defer("prog", rid=rid, hwm=n_twin,
+                                   toks=[int(t) for t in new])
+            if twin.done:
+                self.journal.defer("fin", rid=rid, failed=bool(twin.failed))
+                updates.append((rid, user, new, True, twin.failed,
+                                twin.error))
+            elif new:
+                updates.append((rid, user, new, False, False, None))
+        # FLUSH BARRIER: nothing below becomes caller-visible until its
+        # journal record is past the OS write
+        self.journal.flush()
+        for rid, user, new, done, failed, error in updates:
+            if new:
+                user.output.extend(new)
                 user._n_out = len(user.output)
-            n = len(user.output)
-            if n > self._hwm[rid]:
-                self.journal.append("prog", rid=rid, hwm=n,
-                                    toks=user.output[self._hwm[rid]:])
-                self._hwm[rid] = n
-            if live.done:
-                if live is not user:
-                    user.done, user.failed = live.done, live.failed
-                    user.error = live.error
-                self.journal.append("fin", rid=rid, failed=bool(user.failed))
+            if done:
+                user.done = True
+                user.failed = bool(failed)
+                user.error = error
                 self._done.add(rid)
                 self._finished[rid] = user
                 self._live.pop(rid, None)
+                self._verify.discard(rid)
 
     def _recover(self, code: str, msg: str, rebuild: bool = True) -> None:
         """Rebuild the engine and replay every unfinished journaled request
@@ -411,38 +579,44 @@ class ServingSupervisor:
             self.journal.append("crash", code=code, msg=msg)
             self.engine = self._build()
         replaying: List[int] = []
-        # backpressure was already charged at the original submit — a
-        # max_queue smaller than the in-flight count must not refuse the
+        # backpressure and feasibility shedding were already charged at the
+        # original submit — neither a max_queue smaller than the in-flight
+        # count nor a cold post-rebuild decode-rate estimate may refuse the
         # engine's own journaled work on replay
         saved_max_queue = self.engine.max_queue
+        saved_shed = self.engine.shed_infeasible
         self.engine.max_queue = None
-        for rec in self.journal.unfinished():
-            rid = rec["rid"]
-            if rid in self._done or rid not in self._meta:
-                continue
-            twin = _request_from(self._meta[rid])
-            user = self.requests.get(rid)
-            if user is None:
-                # restart path: the twin IS the caller-facing object
-                user = self.requests[rid] = twin
-            else:
-                # keep only the delivered prefix; the replay regenerates
-                # (and must match) everything past it
-                hwm = self._hwm.get(rid, 0)
-                del user.output[hwm:]
-                user._n_out = len(user.output)
+        self.engine.shed_infeasible = False
+        try:
+            for rec in self.journal.unfinished():
+                rid = rec["rid"]
+                if rid in self._done or rid not in self._meta:
+                    continue
+                user = self.requests.get(rid)
+                if user is None:
+                    # restart path: reconstruct the caller-facing object;
+                    # its delivered prefix comes straight from the journal
+                    user = self.requests[rid] = _request_from(
+                        self._meta[rid])
+                    user.output.extend(self.journal.delivered(rid))
+                    user._n_out = len(user.output)
                 user.done = user.failed = False
                 user.error = None
-                user._engine = None
-            self._live[rid] = twin
-            self.engine.add_request(twin)
-            replaying.append(rid)
-        self.engine.max_queue = saved_max_queue
+                twin = _request_from(self._meta[rid])
+                self._live[rid] = twin
+                if user.output:
+                    self._verify.add(rid)
+                self.engine.add_request(twin)
+                replaying.append(rid)
+        finally:
+            self.engine.max_queue = saved_max_queue
+            self.engine.shed_infeasible = saved_shed
         self.stats["replayed_requests"] += len(replaying)
         # catch up to the delivered marks before declaring recovery done
         guard = 0
-        while any(self._live[rid]._n_out < self._hwm.get(rid, 0)
-                  and not self._live[rid].done for rid in replaying):
+        while any(self._live[rid]._n_out < len(self.requests[rid].output)
+                  and not self._live[rid].done for rid in replaying
+                  if rid in self._live):
             try:
                 self.engine.step()
             except Exception as e:
@@ -460,44 +634,8 @@ class ServingSupervisor:
                 raise RuntimeError(
                     "recovery replay did not reach the journaled high-water "
                     "marks — engine is not making progress")
-        self.engine._drain_pending()
-        for rid in replaying:
-            twin, user = self._live[rid], self.requests[rid]
-            hwm = self._hwm.get(rid, 0)
-            delivered = list(user.output[:hwm] if user is not twin
-                             else self.journal.delivered(rid))
-            # a twin that failed short of the mark (e.g. its deadline
-            # expired AGAIN during the compile-heavy catch-up) is an
-            # ordinary request failure, not a data-integrity alarm — so
-            # only the prefix it actually regenerated is held to the
-            # bit-identity contract; ending early WITHOUT failing, or
-            # emitting different tokens, is real divergence
-            n = min(len(twin.output), hwm)
-            if (twin.output[:n] != delivered[:n]
-                    or (twin.done and not twin.failed
-                        and len(twin.output) < hwm)):
-                user.done = user.failed = True
-                user.error = (
-                    f"PT-SRV-005: replay diverged from the delivered stream "
-                    f"at rid={rid} — {twin.output[:hwm][:8]}... vs "
-                    f"{delivered[:8]}...")
-                self.events.append(("PT-SRV-005", user.error))
-                self.journal.append("fin", rid=rid, failed=True)
-                self._done.add(rid)
-                self._finished[rid] = user
-                self._live.pop(rid, None)
-            elif twin.failed:
-                if user is not twin:
-                    user.done, user.failed = True, True
-                    user.error = twin.error
-                self.journal.append("fin", rid=rid, failed=True)
-                self._done.add(rid)
-                self._finished[rid] = user
-                self._live.pop(rid, None)
-            elif user is twin and hwm:
-                # restart path: the twin regenerated the delivered prefix
-                # itself; nothing to splice
-                pass
+        # verification + splicing run through the one sync path
+        self._sync_progress()
         dt = time.monotonic() - t0
         self.stats["recovery_s"] += dt
         self.journal.append("recovered", code=code, n=len(replaying),

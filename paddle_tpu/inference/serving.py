@@ -60,6 +60,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import time as _time
 import weakref
 from typing import Dict, List, Optional, Sequence, Union
 
@@ -79,8 +80,9 @@ from ..models.generation_utils import (fold_keys as _fold_keys,
 from ..ops.paged_attention import BlockAllocator, RadixPrefixCache
 
 __all__ = ["BlockAllocator", "BrownoutConfig", "ContinuousBatchingEngine",
-           "EngineSaturated", "PrefixCacheConfig", "RadixPrefixCache",
-           "Request", "RequestJournal", "RequestShed", "ServingSupervisor",
+           "EngineSaturated", "FleetConfig", "FleetRouter",
+           "PrefixCacheConfig", "RadixPrefixCache", "ReplicaState", "Request",
+           "RequestJournal", "RequestShed", "ServingSupervisor",
            "StepWatchdog"]
 
 
@@ -92,6 +94,10 @@ def __getattr__(name):
         from . import recovery
 
         return getattr(recovery, name)
+    if name in ("FleetRouter", "FleetConfig", "ReplicaState"):
+        from . import fleet
+
+        return getattr(fleet, name)
     if name == "StepWatchdog":
         from ..distributed.resilience.watchdog import StepWatchdog
 
@@ -330,6 +336,16 @@ class ContinuousBatchingEngine:
         self._samp_dev = None
         self._queue: collections.deque = collections.deque()
         self._finished: Dict[int, Request] = {}
+        # deadline-carrying requests currently in the system: the per-step
+        # expiry scan short-circuits to a single int check when zero (the
+        # common serving case) — the r05 throughput dip was exactly this
+        # class of always-on host work on the decode hot path
+        self._n_deadlined = 0
+        # resilience hooks cached at first step (module lookups + imports
+        # off the per-step path; the lazy-import discipline is preserved —
+        # nothing resilience-side loads until the engine actually steps)
+        self._fault_hook = None
+        self._retry_stats_fn = None
         # host-side accounting: admission vs decode dispatch time (the
         # admission-stall share is stats["admit_host_s"] / wall) plus the
         # prefix-cache counters (docs/SERVING.md: hit_tokens / miss_tokens
@@ -381,9 +397,9 @@ class ContinuousBatchingEngine:
             validate(len(req.prompt), len(req.prompt) + req.max_new_tokens)
         self._shed_check(req)
         req._engine = weakref.ref(self)
-        import time as _time
-
         req._enqueued_at = _time.monotonic()
+        if req.deadline_s is not None:
+            self._n_deadlined += 1
         # weighted admission order: lower priority value admits first; FIFO
         # within a class (insert behind every equal-or-higher-priority
         # waiter). The queue HEAD keeps its head-of-line semantics in
@@ -453,19 +469,18 @@ class ContinuousBatchingEngine:
         values). Host-side time is accounted in ``self.stats``
         (admit_host_s / decode_host_s) so the admission share is measurable
         at any workload."""
-        import time as _time
+        if self._fault_hook is None:
+            from ..distributed.resilience.faults import maybe_inject
 
-        from ..distributed.resilience.faults import maybe_inject
-        from ..distributed.resilience.retry import retry_stats
-
+            self._fault_hook = maybe_inject
         self._step_idx += 1
         # injection sites (docs/RESILIENCE.md): `serving.stall` sleeps the
         # step past its wall-clock budget (StepWatchdog / PT-SRV-002);
         # `serving.step` kills the engine mid-wave (ServingSupervisor
         # rebuild-from-journal / PT-SRV-001). One global read each when no
         # plan is installed.
-        maybe_inject("serving.stall", f"step:{self._step_idx}")
-        maybe_inject("serving.step", f"step:{self._step_idx}")
+        self._fault_hook("serving.stall", f"step:{self._step_idx}")
+        self._fault_hook("serving.step", f"step:{self._step_idx}")
         t0 = _time.perf_counter()
         sched0 = self._sched_tokens
         self._deferred_step = False
@@ -480,9 +495,6 @@ class ContinuousBatchingEngine:
                                    else 0.7 * self._ema_tok_s + 0.3 * rate)
             if self._brownout_cfg is not None:
                 self._brownout_tick()
-            rs = retry_stats()
-            self.stats["retry_attempts"] = rs["attempts"]
-            self.stats["retry_giveups"] = rs["giveups"]
 
     def _brownout_tick(self):
         """Hysteretic brownout state machine (docs/SERVING.md), evaluated
@@ -517,8 +529,6 @@ class ContinuousBatchingEngine:
             self._pressure_steps = 0
 
     def _step_inner(self):
-        import time as _time
-
         self._evict_expired()
         if self.prefix_cache is not None:
             # chunked-prefill budget: the decode batch is dispatched first,
@@ -552,9 +562,10 @@ class ContinuousBatchingEngine:
         (active slots AND still-queued requests) so a straggler can neither
         hog a slot forever nor hang its caller. Tokens already scheduled for
         an evicted slot stay in the pending readbacks — ``tokens`` remains
-        complete up to the eviction point."""
-        import time as _time
-
+        complete up to the eviction point. A single int check when no
+        deadline-carrying request is in the system."""
+        if not self._n_deadlined:
+            return
         now = _time.monotonic()
 
         def expired(r):
@@ -566,7 +577,7 @@ class ContinuousBatchingEngine:
             r.failed = True
             r.error = (f"deadline exceeded: {now - r._enqueued_at:.3f}s > "
                        f"{r.deadline_s:.3f}s ({r._n_out} tokens scheduled)")
-            self._finished[r.rid] = r
+            self._mark_done(r)
 
         for i, req in enumerate(self._slots):
             if req is not None and expired(req):
@@ -584,8 +595,6 @@ class ContinuousBatchingEngine:
             self._queue = keep
 
     def _decode_block(self):
-        import time as _time
-
         t0 = _time.perf_counter()
         try:
             self._decode_block_inner()
@@ -682,7 +691,7 @@ class ContinuousBatchingEngine:
                 self._pos[i] += took
                 if req._n_out >= req.max_new_tokens:
                     req.done = True
-                    self._finished[req.rid] = req
+                    self._mark_done(req)
                     self._release_slot(i)   # slot + its pages are free again
             self._pending.append((out, entries))
             return
@@ -704,7 +713,7 @@ class ContinuousBatchingEngine:
             self._pos[i] += took
             self._sched_tokens += took
             if req.done:
-                self._finished[req.rid] = req
+                self._mark_done(req)
                 self._release_slot(i)       # slot + its pages are free again
 
     def run_until_done(self, max_steps: int = 100000):
@@ -716,8 +725,37 @@ class ContinuousBatchingEngine:
 
     def finished(self) -> Dict[int, Request]:
         self._drain_pending()
+        # retry-registry snapshot rides here (control plane), NOT in step():
+        # a per-step dict copy was measurable on the decode hot path
+        if self._retry_stats_fn is None:
+            from ..distributed.resilience.retry import retry_stats
+
+            self._retry_stats_fn = retry_stats
+        rs = self._retry_stats_fn()
+        self.stats["retry_attempts"] = rs["attempts"]
+        self.stats["retry_giveups"] = rs["giveups"]
         out, self._finished = self._finished, {}
         return out
+
+    def _mark_done(self, req: "Request"):
+        """Single chokepoint for request completion: surfaces the request
+        in ``_finished`` and retires its deadline from the expiry-scan
+        counter."""
+        if req.deadline_s is not None:
+            self._n_deadlined = max(0, self._n_deadlined - 1)
+        self._finished[req.rid] = req
+
+    def withdraw_queued(self, rid: int) -> bool:
+        """Remove a still-WAITING request from the queue (never an admitted
+        slot) — the fleet's drain-migration primitive. Returns False when
+        the request is not in the queue."""
+        for i, r in enumerate(self._queue):
+            if r.rid == rid:
+                del self._queue[i]
+                if r.deadline_s is not None:
+                    self._n_deadlined = max(0, self._n_deadlined - 1)
+                return True
+        return False
 
     def _drain_pending(self):
         """Materialize deferred token blocks into request outputs.
@@ -916,8 +954,6 @@ class ContinuousBatchingEngine:
         re-step runs through ``paged_token_step`` so warm (cache-hit) and
         cold admissions share one program per shape — the warm==cold
         bit-identity guarantee (see ops.paged_prefill_attention)."""
-        import time as _time
-
         if not self._prefill_next:
             return
         t0 = _time.perf_counter()
@@ -1048,7 +1084,7 @@ class ContinuousBatchingEngine:
                  and int(firsts[row]) == req.eos_token_id)
                     or req._n_out >= req.max_new_tokens):
                 req.done = True
-                self._finished[req.rid] = req
+                self._mark_done(req)
                 self._release_slot(slot)
         if entries:
             self._pending.append((firsts_dev, entries))
@@ -1099,7 +1135,7 @@ class ContinuousBatchingEngine:
                      and int(firsts[row]) == req.eos_token_id)
                         or req._n_out >= req.max_new_tokens):
                     req.done = True
-                    self._finished[req.rid] = req
+                    self._mark_done(req)
                     self._release_slot(slot)
             if entries:
                 self._pending.append((firsts_dev, entries))

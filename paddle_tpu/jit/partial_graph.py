@@ -187,6 +187,13 @@ class _WhileStage:
         self._lax_ok: Optional[bool] = None
         self._lax_fn = None
         self._probe_out = None  # first lax run's result (don't run twice)
+        # carry signatures the whole-loop lowering FAILED for: `_lax_fn` is
+        # cached from the first (grad-free) call, but a later call with new
+        # carry shapes retraces it — and a body that was carry-stable at the
+        # probe's shapes may not be at these (ADVICE medium). Such calls fall
+        # back to the eager cond/body bridge, memoized per signature so the
+        # failed retrace isn't re-attempted every call.
+        self._lax_bad = set()
 
     def _try_lax(self, live):
         import jax
@@ -235,25 +242,53 @@ class _WhileStage:
         needs_grad = any(isinstance(v, Tensor) and not v.stop_gradient
                          for v in live)
         use_lax = False
+        sig = None
         if not needs_grad and self._cond._layer is None:
-            if self._lax_ok is None:
-                try:
-                    self._lax_fn = self._try_lax(live)
-                    self._lax_ok = True
-                except Exception:
-                    self._lax_ok = False
-            use_lax = bool(self._lax_ok)
+            sig = self._carry_sig(live)
+            if sig not in self._lax_bad:
+                if self._lax_ok is None:
+                    try:
+                        self._lax_fn = self._try_lax(live)
+                        self._lax_ok = True
+                    except Exception:
+                        self._lax_ok = False
+                        self._lax_bad.add(sig)
+                use_lax = bool(self._lax_ok)
         if use_lax:
             if self._probe_out is not None:
                 out, self._probe_out = self._probe_out, None
+                live = out if isinstance(out, tuple) else (out,)
             else:
-                out = self._lax_fn(*live)
-            live = out if isinstance(out, tuple) else (out,)
-        else:
+                try:
+                    out = self._lax_fn(*live)
+                except Exception:
+                    # new carry signature broke the whole-loop retrace (the
+                    # body is not shape-stable at THESE shapes): eager
+                    # bridge for this signature, lax stays live for the ones
+                    # that already lowered
+                    self._lax_bad.add(sig)
+                    use_lax = False
+                else:
+                    live = out if isinstance(out, tuple) else (out,)
+        if not use_lax:
             while bool(self._cond(*live)):
                 out = self._body(*live)
                 live = out if isinstance(out, tuple) else (out,)
         return self._suffix(*live)
+
+    @staticmethod
+    def _carry_sig(live):
+        """Abstract signature of a carry tuple: per-element (type, shape,
+        dtype). Python scalars key by type alone — their values don't change
+        what traces."""
+        sig = []
+        for v in live:
+            d = getattr(v, "_data", v)
+            shape = getattr(d, "shape", None)
+            sig.append((type(v).__name__,
+                        None if shape is None else tuple(shape),
+                        str(getattr(d, "dtype", ""))))
+        return tuple(sig)
 
 
 class _ForStage:

@@ -112,7 +112,30 @@ def _pgmm_dw_kernel(gids_ref, x_ref, g_ref, dw_ref, *, nm):
 
 def _pgmm_dw_raw(x, dout, tile_gids, e, tile_m, interpret=False):
     """dw[e] = sum over rows r with g(r)==e of x[r]^T dout[r].
-    x [P, k], dout [P, n] -> [E, k, n] fp32."""
+    x [P, k], dout [P, n] -> [E, k, n] fp32.
+
+    Experts owning NO m-tile (zero tokens this step under
+    ``padded_group_layout``, which gives an empty expert zero padded rows)
+    never run the kernel's init branch, so on real hardware their output
+    blocks would be whatever was in the buffer — uninitialized memory
+    flowing into dw (ADVICE round-5 high). ``_mask_unvisited_experts``
+    zeroes exactly those blocks; interpret mode happens to zero-fill
+    outputs, which is why the bug only bites in non-interpret mode."""
+    dw = _pgmm_dw_call(x, dout, tile_gids, e, tile_m, interpret)
+    return _mask_unvisited_experts(dw, tile_gids, e)
+
+
+def _mask_unvisited_experts(dw, tile_gids, e):
+    """Zero dw blocks of experts that own no m-tile (their correct gradient:
+    no rows routed to them contributes nothing). Tile counts come straight
+    from ``tile_gids`` — an expert absent from it was never visited by the
+    grid, so its block was never written."""
+    counts = jnp.zeros((e,), jnp.int32).at[tile_gids].add(1)
+    return jnp.where((counts > 0)[:, None, None], dw,
+                     jnp.zeros((), dw.dtype))
+
+
+def _pgmm_dw_call(x, dout, tile_gids, e, tile_m, interpret=False):
     from jax.experimental.pallas import tpu as pltpu
 
     p, kdim = x.shape
@@ -174,6 +197,17 @@ def _pgmm_bwd(tile_m, interpret, res, g):
 pgmm.defvjp(_pgmm_fwd, _pgmm_bwd)
 
 
+_GMM_FALLBACK_WARNED = [False]
+
+# what a missing/unsupported megablox path legitimately raises: the import
+# itself, shape/dtype validation, or an unimplemented lowering. Anything
+# else (a genuine kernel bug, a TPU runtime error) must propagate — a bare
+# ``except Exception`` was silently converting those into the slower
+# ragged_dot path (ADVICE low).
+_GMM_FALLBACK_ERRORS = (ImportError, AttributeError, NotImplementedError,
+                        TypeError, ValueError)
+
+
 def grouped_dot(x, w, group_sizes):
     """Grouped matmul over rows sorted by group (group_sizes [E] row
     counts): jax's megablox ``gmm`` Pallas kernel on TPU (the tuned
@@ -187,8 +221,15 @@ def grouped_dot(x, w, group_sizes):
             tiling = (512, _fit_tile(512, k), _fit_tile(512, n))
             return _mb.gmm(x, w, group_sizes,
                            preferred_element_type=x.dtype, tiling=tiling)
-        except Exception:
-            pass
+        except _GMM_FALLBACK_ERRORS as e:
+            if not _GMM_FALLBACK_WARNED[0]:
+                _GMM_FALLBACK_WARNED[0] = True
+                import warnings
+
+                warnings.warn(
+                    f"megablox gmm unavailable, falling back to "
+                    f"lax.ragged_dot: {type(e).__name__}: {e}",
+                    RuntimeWarning, stacklevel=2)
     return jax.lax.ragged_dot(x, w, group_sizes)
 
 

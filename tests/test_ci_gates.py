@@ -50,8 +50,9 @@ def test_manifest_counts_cover_reference_parity():
         # ContinuousBatchingEngine, Request, EngineSaturated,
         # PrefixCacheConfig, BlockAllocator, RadixPrefixCache;
         # resilient-serving PR: + ServingSupervisor, RequestJournal,
-        # RequestShed, BrownoutConfig, StepWatchdog
-        "paddle.inference.serving": 11,
+        # RequestShed, BrownoutConfig, StepWatchdog;
+        # fleet PR: + FleetRouter, FleetConfig, ReplicaState
+        "paddle.inference.serving": 14,
     }
     for k, n in exact.items():
         assert len(m[k]) == n, (k, len(m[k]), n)
@@ -147,14 +148,15 @@ def test_graph_lint_gate_detects_seeded_defects():
     assert "PT-SHAPE-001" in r2.stdout  # names op + code in the output
 
 
-@pytest.mark.slow   # ~2min of engine/train-loop compiles across 12 classes
+@pytest.mark.slow   # ~3min of engine/train-loop compiles across 15 classes
 def test_fault_drill_matrix():
     """Resilience gate (docs/RESILIENCE.md + docs/NUMERIC_GUARD.md +
     docs/SERVING.md): the seeded fault matrix — heartbeat loss, store
     stall, shard corruption, engine saturation, serving deadline,
     prefix-cache block-pool exhaustion, serving engine crash mid-decode,
-    serving step stall, overload shed, NaN gradient, loss spike, poisoned
-    batch — must be absorbed with recovery enabled AND flip the exit code
+    serving step stall, overload shed, fleet replica kill, fleet rolling
+    drain/restart, fleet overload brownout, NaN gradient, loss spike,
+    poisoned batch — must be absorbed with recovery enabled AND flip the exit code
     with recovery disabled. Runs in a subprocess (the drill forces the
     pure-Python store daemon for server-side faults).
 
@@ -170,7 +172,7 @@ def test_fault_drill_matrix():
          "--selftest"],
         capture_output=True, text=True, env=env, cwd=ROOT, timeout=500)
     assert r.returncode == 0, r.stdout + r.stderr
-    assert "FAULT DRILL OK: 12 fault classes" in r.stdout, r.stdout
+    assert "FAULT DRILL OK: 15 fault classes" in r.stdout, r.stdout
 
 
 def test_fault_drill_single_drill_exit_codes():

@@ -1,0 +1,437 @@
+"""Fleet-level serving resilience (inference/fleet.py — docs/SERVING.md).
+
+Covers the replica router (least-loaded spread, radix-affinity placement,
+warm-prefix hit rate vs a single replica), journal-backed failover with
+byte-identical streams (PT-FLT-001, greedy + seeded), rolling drain/restart
+with zero failed or duplicated tokens (PT-FLT-002), fleet brownout/shedding
+with hysteretic exit (PT-FLT-003/004), the progress-heartbeat wedge
+detector, and the drill control arms (failover off, hard restart).
+
+The end-to-end seeded drills (fleet_replica_kill / fleet_drain /
+fleet_overload, each flipping the exit code with recovery off) run in
+tools/fault_drill.py and are CI-gated via tests/test_ci_gates.py.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.resilience import FaultPlan, FaultSpec
+from paddle_tpu.inference.fleet import (FleetConfig, FleetRouter,
+                                        ReplicaState)
+from paddle_tpu.inference.serving import (ContinuousBatchingEngine,
+                                          EngineSaturated,
+                                          PrefixCacheConfig, Request,
+                                          RequestShed)
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(13)
+    cfg = LlamaConfig.tiny(num_hidden_layers=1)
+    return cfg, LlamaForCausalLM(cfg)
+
+
+def _prompt(cfg, n, seed):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+
+
+def _ref(m, prompt, n):
+    out = m.generate(paddle.to_tensor(np.asarray(prompt)[None]),
+                     max_new_tokens=n, temperature=0.0,
+                     max_length=32).numpy()[0]
+    return [int(t) for t in out]
+
+
+def _build(m, **kw):
+    def build():
+        return ContinuousBatchingEngine(m, max_batch=2, max_len=32,
+                                        page_size=8, block_size=2, **kw)
+    return build
+
+
+class TestRouting:
+    def test_least_loaded_spread(self, model, tmp_path):
+        """Hash-spread traffic balances: distinct-prompt requests land on
+        distinct replicas before any replica doubles up."""
+        cfg, m = model
+        fleet = FleetRouter(_build(m), str(tmp_path), num_replicas=3,
+                            config=FleetConfig(affinity=False))
+        for i in range(3):
+            fleet.submit(Request(_prompt(cfg, 6, i), max_new_tokens=2))
+        assert sorted(fleet.load().values()) == [1, 1, 1]
+        for i in range(3):
+            fleet.submit(Request(_prompt(cfg, 6, 10 + i), max_new_tokens=2))
+        assert sorted(fleet.load().values()) == [2, 2, 2]
+        fleet.run_until_done()
+        fleet.close()
+
+    def test_affinity_sticks_and_yields_to_balance(self, model, tmp_path):
+        """Same-prefix requests follow the replica that holds the chain,
+        UNLESS it is queue_slack deeper than the best candidate."""
+        cfg, m = model
+        fleet = FleetRouter(_build(m), str(tmp_path), num_replicas=2,
+                            config=FleetConfig(queue_slack=1))
+        shared = _prompt(cfg, 16, 3)        # two full 8-token pages
+        r0 = Request(shared, max_new_tokens=2)
+        fleet.submit(r0)
+        home = fleet._assigned[r0.rid]
+        # same-prefix request (prefix chain matches both pages) sticks
+        r1 = Request(np.concatenate([shared[:8], _prompt(cfg, 8, 4)]),
+                     max_new_tokens=2)
+        fleet.submit(r1)
+        assert fleet._assigned[r1.rid] == home
+        assert fleet.stats["affinity_hits"] == 1
+        # pile load onto the warm replica until affinity must yield
+        spread = []
+        for i in range(4):
+            r = Request(shared, max_new_tokens=2)
+            fleet.submit(r)
+            spread.append(fleet._assigned[r.rid])
+        assert any(idx != home for idx in spread), \
+            "affinity never yielded to queue_slack balance"
+        fleet.run_until_done()
+        fleet.close()
+
+    def test_no_alive_replica_raises(self, model, tmp_path):
+        cfg, m = model
+        fleet = FleetRouter(_build(m), str(tmp_path), num_replicas=1)
+        fleet.replicas[0].state = ReplicaState.DEAD
+        with pytest.raises(EngineSaturated, match="no alive replica"):
+            fleet.submit(Request(_prompt(cfg, 6, 5), max_new_tokens=2))
+        fleet.replicas[0].state = ReplicaState.ALIVE   # let close() flush
+        fleet.close()
+
+
+class TestFailover:
+    @pytest.mark.slow   # the CI-gated fleet_replica_kill drill covers this
+    #                     end-to-end; fast failover coverage lives in
+    #                     test_heartbeat_wedge_drives_failover + the
+    #                     journal-restart test below
+    def test_kill_one_of_three_byte_identical(self, model, tmp_path):
+        """Acceptance drill: kill 1 of 3 replicas mid-traffic — every
+        unfinished request completes with a stream byte-identical to an
+        uninterrupted run (greedy AND seeded sampling)."""
+        cfg, m = model
+        prompts = [_prompt(cfg, 6, 20 + i) for i in range(6)]
+        kws = [dict(max_new_tokens=8, seed=70 + i) for i in range(6)]
+        for i in (2, 5):                     # two seeded-sampled streams
+            kws[i].update(temperature=0.9, top_p=0.9)
+        # uninterrupted single-engine reference: per-request determinism
+        # (explicit seeds) makes any fleet placement reproduce it exactly
+        ref_eng = _build(m)()
+        ref_reqs = [Request(p, **kw) for p, kw in zip(prompts, kws)]
+        for r in ref_reqs:
+            ref_eng.add_request(r)
+        ref_eng.run_until_done(max_steps=500)
+        refs = [list(r.tokens) for r in ref_reqs]
+        plan = FaultPlan(seed=0, specs=[
+            FaultSpec("fleet.replica_kill", "kill", at=2, count=1,
+                      match="replica:0:")])
+        fleet = FleetRouter(_build(m), str(tmp_path), num_replicas=3)
+        reqs = [Request(p, **kw) for p, kw in zip(prompts, kws)]
+        with plan:
+            for r in reqs:
+                fleet.submit(r)
+            fleet.run_until_done(max_steps=500)
+        assert plan.log, "kill never fired"
+        assert fleet.stats["replica_deaths"] == 1
+        assert fleet.stats["failovers"] == 1
+        assert [c for c, _ in fleet.events].count("PT-FLT-001") >= 1
+        for r, e in zip(reqs, refs):
+            assert r.done and not r.failed, r.error
+            assert list(r.tokens) == e
+        # the dead replica can rejoin cold and serve again
+        dead = fleet.stats and fleet.replicas[0]
+        assert dead.state == ReplicaState.DEAD
+        fleet.restart(0)
+        assert fleet.replicas[0].state == ReplicaState.ALIVE
+        assert fleet.replicas[0].gen == 1
+        fleet.close()
+
+    def test_failover_disabled_control_arm(self, model, tmp_path):
+        """failover=False (the drill's control arm): a replica death
+        surfaces its in-flight requests as failures instead of hanging."""
+        cfg, m = model
+        plan = FaultPlan(seed=0, specs=[
+            FaultSpec("fleet.replica_kill", "kill", at=1, count=1,
+                      match="replica:0:")])
+        fleet = FleetRouter(_build(m), str(tmp_path), num_replicas=2,
+                            failover=False)
+        reqs = [Request(_prompt(cfg, 6, 30 + i), max_new_tokens=8)
+                for i in range(4)]
+        with plan:
+            for r in reqs:
+                fleet.submit(r)
+            fleet.run_until_done(max_steps=500)
+        lost = [r for r in reqs if r.failed]
+        assert lost, "replica death lost nothing with failover disabled"
+        assert all("PT-FLT-001" in r.error for r in lost)
+        survivors = [r for r in reqs if not r.failed]
+        assert all(r.done for r in survivors)
+        fleet.close()
+
+    def test_kill_sole_replica_fails_requests(self, model, tmp_path):
+        """No survivor to fail over to: requests surface as failed with
+        the PT-FLT-001 error instead of hanging the caller."""
+        cfg, m = model
+        plan = FaultPlan(seed=0, specs=[
+            FaultSpec("fleet.replica_kill", "kill", at=1, count=1)])
+        fleet = FleetRouter(_build(m), str(tmp_path), num_replicas=1)
+        r = Request(_prompt(cfg, 6, 40), max_new_tokens=8)
+        with plan:
+            fleet.submit(r)
+            fleet.run_until_done(max_steps=100)
+        assert r.failed and "no surviving replica" in r.error
+        fleet.close()
+
+    def test_heartbeat_wedge_drives_failover(self, model, tmp_path):
+        """A replica whose steps keep RETURNING without advancing any
+        stream (e.g. every slot deferring forever) is declared dead by the
+        progress heartbeat and its journaled work fails over."""
+        cfg, m = model
+        prompts = [_prompt(cfg, 6, 50 + i) for i in range(2)]
+        refs = [_ref(m, p, 6) for p in prompts]
+        fleet = FleetRouter(
+            _build(m), str(tmp_path), num_replicas=2,
+            config=FleetConfig(affinity=False, heartbeat_ttl_s=0.0))
+        reqs = [Request(p, max_new_tokens=6) for p in prompts]
+        for r in reqs:
+            fleet.submit(r)
+        wedged = fleet.replicas[0]
+        wedged.sup.engine.step = lambda: None      # steps "succeed", no work
+        fleet.run_until_done(max_steps=200)
+        assert fleet.stats["replica_deaths"] == 1
+        assert any("heartbeat stale" in msg for _, msg in fleet.events)
+        for r, e in zip(reqs, refs):
+            assert r.done and not r.failed, r.error
+            assert list(r.tokens) == e
+        # the dead journal was retired (migr records): a router restarted
+        # over this fleet_dir must not replay work survivors now own
+        from paddle_tpu.inference.recovery import RequestJournal
+        recs = RequestJournal.load(wedged.journal_path)
+        done = {r["rid"] for r in recs if r["k"] in ("fin", "migr")}
+        assert all(r["rid"] in done for r in recs if r["k"] == "admit")
+        fleet.close()
+
+
+class TestDrainRestart:
+    @pytest.mark.slow   # the CI-gated fleet_drain drill covers this
+    #                     end-to-end; fast drain coverage is
+    #                     test_drain_migrates_queued_keeps_inflight
+    def test_rolling_restart_zero_loss(self, model, tmp_path):
+        """Acceptance drill: rolling restart of ALL replicas under traffic
+        — zero failed requests, zero duplicated tokens, streams
+        byte-identical; every replica rebuilt with a fresh generation."""
+        cfg, m = model
+        prompts = [_prompt(cfg, 6, 60 + i) for i in range(6)]
+        refs = [_ref(m, p, 8) for p in prompts]     # greedy: seed-free
+        fleet = FleetRouter(_build(m), str(tmp_path), num_replicas=3)
+        reqs = [Request(p, max_new_tokens=8, seed=90 + i)
+                for i, p in enumerate(prompts)]
+        for r in reqs:
+            fleet.submit(r)
+        fleet.step()                        # work in flight everywhere
+        fleet.rolling_restart(max_steps=500)
+        fleet.run_until_done(max_steps=500)
+        assert fleet.stats["restarts"] == 3
+        assert all(rep.gen == 1 and rep.state == ReplicaState.ALIVE
+                   for rep in fleet.replicas)
+        # fresh journals: the generation-0 files are closed and done with
+        assert all(rep.journal_path.endswith(".g1.jrnl")
+                   for rep in fleet.replicas)
+        for r, e in zip(reqs, refs):
+            assert r.done and not r.failed, r.error
+            assert list(r.tokens) == e      # byte-identical => no dup/loss
+        fleet.close()
+
+    def test_drain_migrates_queued_keeps_inflight(self, model, tmp_path):
+        """drain(): still-QUEUED requests migrate to survivors (journaled
+        ``migr``); requests already in a slot finish on the draining
+        replica; the replica rebuilds once idle."""
+        cfg, m = model
+        fleet = FleetRouter(_build(m), str(tmp_path), num_replicas=2,
+                            config=FleetConfig(affinity=False))
+        # 6 requests -> 3 per replica: 2 slotted after a step, 1 queued
+        reqs = [Request(_prompt(cfg, 6, 70 + i), max_new_tokens=8)
+                for i in range(6)]
+        for r in reqs:
+            fleet.submit(r)
+        fleet.step()
+        fleet.drain(0)
+        assert fleet.replicas[0].state == ReplicaState.DRAINING
+        assert fleet.stats["migrated"] >= 1
+        recs = fleet.replicas[0].sup.journal.records
+        assert any(rec["k"] == "migr" for rec in recs)
+        with pytest.raises(EngineSaturated):     # draining: not routable
+            probe = Request(_prompt(cfg, 6, 99), max_new_tokens=2)
+            fleet.replicas[1].state = ReplicaState.DEAD   # force no target
+            try:
+                fleet.submit(probe)
+            finally:
+                fleet.replicas[1].state = ReplicaState.ALIVE
+        fleet.run_until_done(max_steps=500)
+        assert fleet.replicas[0].state == ReplicaState.ALIVE
+        assert fleet.replicas[0].gen == 1
+        assert all(r.done and not r.failed for r in reqs)
+        fleet.close()
+
+    def test_hard_restart_control_arm(self, model, tmp_path):
+        """graceful_drain=False models restart-without-drain deployments:
+        in-flight work is lost (the mode graceful drain exists to
+        prevent), and the replica comes back cold."""
+        cfg, m = model
+        fleet = FleetRouter(_build(m), str(tmp_path), num_replicas=2,
+                            graceful_drain=False)
+        reqs = [Request(_prompt(cfg, 6, 80 + i), max_new_tokens=8)
+                for i in range(4)]
+        for r in reqs:
+            fleet.submit(r)
+        fleet.step()
+        fleet.drain(0)
+        lost = [r for r in reqs if r.failed]
+        assert lost and all("PT-FLT-002" in r.error for r in lost)
+        assert fleet.replicas[0].state == ReplicaState.ALIVE   # respawned
+        assert fleet.replicas[0].gen == 1
+        fleet.run_until_done(max_steps=500)
+        assert all(r.done for r in reqs)
+        fleet.close()
+
+
+class TestBrownout:
+    def test_fleet_brownout_sheds_and_exits(self, model, tmp_path):
+        """PT-FLT-003/004: when EVERY alive replica sits at depth the
+        fleet sheds sheddable-priority traffic at submit with a typed
+        RequestShed; priority traffic still admits; the brownout exits
+        hysteretically once pressure clears."""
+        cfg, m = model
+        fleet = FleetRouter(
+            _build(m, max_queue=4), str(tmp_path), num_replicas=2,
+            config=FleetConfig(brownout_depth=1, brownout_enter_after=2,
+                               brownout_exit_after=2))
+        flood = [Request(_prompt(cfg, 6, 100 + i), max_new_tokens=4,
+                         priority=Request.PRIORITY_LOW) for i in range(8)]
+        shed = 0
+        for r in flood:
+            try:
+                fleet.submit(r)
+            except RequestShed as e:
+                assert "PT-FLT-003" in str(e)
+                shed += 1
+        assert fleet.stats["brownouts"] == 1
+        assert shed and fleet.stats["fleet_shed"] == shed
+        vip = Request(_prompt(cfg, 6, 120), max_new_tokens=4,
+                      priority=Request.PRIORITY_HIGH)
+        fleet.submit(vip)                   # priority bypasses the shed
+        fleet.run_until_done(max_steps=500)
+        assert vip.done and not vip.failed
+        for _ in range(3):                  # serving loops tick when idle —
+            fleet.step()                    # pressure-free events accumulate
+        assert not fleet._brownout_active   # hysteretic exit happened
+        assert any(c == "PT-FLT-004" and "exited" in msg
+                   for c, msg in fleet.events)
+        fleet.close()
+
+
+class TestAffinityHitRate:
+    def test_warm_prefix_hit_rate_vs_single_replica(self, model, tmp_path):
+        """Acceptance: the affinity router keeps the fleet's warm-prefix
+        hit rate at least at the single-replica baseline — same-prefix
+        sessions stick to the replica holding the blocks instead of
+        scattering to cold caches."""
+        cfg, m = model
+        build = _build(m, prefix_cache=PrefixCacheConfig(extra_blocks=4))
+        shared = _prompt(cfg, 16, 7)         # two full pages of prefix
+
+        def sessions():
+            # 6 same-prefix sessions in 3 arrival waves, decoded between
+            # waves so later sessions can hit blocks earlier ones cached
+            rng = np.random.default_rng(8)
+            return [np.concatenate(
+                [shared, rng.integers(0, cfg.vocab_size, (4,))
+                 .astype(np.int32)]) for _ in range(6)]
+
+        def hit_rate(fleet):
+            for wave in range(3):
+                for p in sessions()[wave * 2:(wave + 1) * 2]:
+                    fleet.submit(Request(p, max_new_tokens=2))
+                fleet.run_until_done(max_steps=500)
+            hits = misses = 0
+            for rep in fleet.replicas:
+                hits += rep.sup.engine.stats["hit_tokens"]
+                misses += rep.sup.engine.stats["miss_tokens"]
+            fleet.close()
+            return hits / max(1, hits + misses)
+
+        single = hit_rate(FleetRouter(build, str(tmp_path / "one"),
+                                      num_replicas=1))
+        fleet = hit_rate(FleetRouter(build, str(tmp_path / "three"),
+                                     num_replicas=3))
+        assert single > 0, "baseline never hit its own cache"
+        assert fleet >= single, (fleet, single)
+
+
+class TestFleetJournalRestart:
+    def test_router_restart_over_journals(self, model, tmp_path):
+        """A FleetRouter constructed over an existing fleet_dir finds each
+        replica's generation-0 journal; every supervisor re-admits its own
+        unfinished requests automatically and the reconstructed streams
+        complete byte-identically."""
+        cfg, m = model
+        prompts = [_prompt(cfg, 6, 130 + i) for i in range(2)]
+        refs = [_ref(m, p, 6) for p in prompts]
+        fleet = FleetRouter(_build(m), str(tmp_path), num_replicas=2,
+                            config=FleetConfig(affinity=False))
+        reqs = [Request(p, max_new_tokens=6) for p in prompts]
+        for r in reqs:
+            fleet.submit(r)
+        fleet.step()                        # some tokens delivered
+        for rep in fleet.replicas:          # process dies: no clean close
+            rep.sup.abandon()
+        fleet2 = FleetRouter(_build(m), str(tmp_path), num_replicas=2,
+                             config=FleetConfig(affinity=False))
+        fleet2.run_until_done(max_steps=500)
+        out = []
+        for rep in fleet2.replicas:
+            out.extend(rep.sup.requests.values())
+        assert sorted([r.rid for r in out]) == sorted(r.rid for r in reqs)
+        by_rid = {r.rid: r for r in out}
+        for r, e in zip(reqs, refs):
+            got = by_rid[r.rid]
+            assert got.done and not got.failed, got.error
+            assert [int(t) for t in got.output] == e
+        fleet2.close()
+
+    def test_router_restart_resumes_latest_generation(self, model, tmp_path):
+        """A rolling restart leaves g1 journals; a router restarted over
+        the fleet_dir must resume THOSE (replaying a superseded g0 would
+        lose the newer work)."""
+        cfg, m = model
+        fleet = FleetRouter(_build(m), str(tmp_path), num_replicas=2,
+                            config=FleetConfig(affinity=False))
+        fleet.rolling_restart()             # idle: drains instantly, g0->g1
+        assert all(rep.gen == 1 for rep in fleet.replicas)
+        prompts = [_prompt(cfg, 6, 140 + i) for i in range(2)]
+        refs = [_ref(m, p, 6) for p in prompts]
+        reqs = [Request(p, max_new_tokens=6) for p in prompts]
+        for r in reqs:
+            fleet.submit(r)
+        fleet.step()
+        for rep in fleet.replicas:
+            rep.sup.abandon()               # router process dies
+        fleet2 = FleetRouter(_build(m), str(tmp_path), num_replicas=2,
+                             config=FleetConfig(affinity=False))
+        assert all(rep.gen == 1 and rep.journal_path.endswith(".g1.jrnl")
+                   for rep in fleet2.replicas)
+        fleet2.run_until_done(max_steps=500)
+        out = {r.rid: r for rep in fleet2.replicas
+               for r in rep.sup.requests.values()}
+        for r, e in zip(reqs, refs):
+            got = out[r.rid]
+            assert got.done and not got.failed, got.error
+            assert [int(t) for t in got.output] == e
+        fleet2.close()

@@ -185,6 +185,40 @@ class TestPartialGraph:
             h(paddle.to_tensor(np.asarray([1.25], np.float32))).numpy(),
             [-0.75])
 
+    def test_while_lax_cache_falls_back_on_shape_changing_carry(self):
+        """ADVICE medium: ``_lax_fn`` is cached from the first grad-free
+        call; a later call with a different carry signature retraces it, and
+        a body that was shape-stable at the probe's shapes may not be at the
+        new ones. The stage must take the eager cond/body bridge for that
+        signature (memoized) instead of raising — and keep serving the
+        signatures that already lowered."""
+        from paddle_tpu.jit.api import to_static
+
+        @to_static(full_graph=False)
+        def h(x):
+            n = x.sum() * 0.0
+            while (x.sum() > 0):
+                x = paddle.concat([x, x])[:4]
+                x = x - 1.0
+                n = n + 1.0
+            return n + x.sum() * 0.0
+
+        with pytest.warns(UserWarning, match="split into compiled subgraphs"):
+            out4 = h(paddle.to_tensor(np.asarray([2.5] * 4, np.float32)))
+        stage = h._split_plan._stage
+        assert stage._lax_ok is True      # (4,) carry: whole-loop lowering
+        np.testing.assert_allclose(out4.numpy(), 3.0)
+        # (2,) carry: concat doubles it to (4,) mid-loop — not stable for
+        # lax.while_loop, so the cached _lax_fn's retrace fails; the call
+        # must fall back to the eager bridge, not raise
+        out2 = h(paddle.to_tensor(np.asarray([1.5] * 2, np.float32)))
+        np.testing.assert_allclose(out2.numpy(), 2.0)
+        assert stage._lax_ok is True and stage._lax_bad  # bad sig memoized
+        # ...while the good signature still takes the compiled loop
+        np.testing.assert_allclose(
+            h(paddle.to_tensor(np.asarray([0.5] * 4, np.float32))).numpy(),
+            1.0)
+
     def test_while_unstable_carry_uses_eager_bridge(self):
         """When the body can't lower to lax.while_loop (carry changes
         python-type across iterations), the loop still runs as compiled body

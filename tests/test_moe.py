@@ -431,6 +431,48 @@ class TestPgmmDispatch:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-3, atol=1e-4)
 
+    def test_pgmm_dw_zero_token_expert_masked_non_interpret(self, monkeypatch):
+        """ADVICE round-5 high: an expert with ZERO routed tokens owns no
+        m-tile (padded_group_layout gives it zero padded rows), so the dw
+        kernel's init branch never runs for its output block — on real
+        hardware that block is uninitialized memory. Interpret mode
+        zero-fills outputs, hiding the bug; this test reproduces the
+        NON-interpret semantics by poisoning exactly the unwritten blocks
+        (what uninitialized VMEM would hold) under the real kernel, and
+        fails on the unmasked kernel."""
+        from paddle_tpu.ops import grouped_matmul as gm
+        from paddle_tpu.ops.grouped_matmul import padded_group_layout
+
+        rng = np.random.default_rng(7)
+        n, e, d, m, tm = 16, 3, 16, 8, 8
+        # experts 0 and 2 only: expert 1 gets zero tokens -> zero tiles
+        flat_e = jnp.asarray(rng.choice([0, 2], (n,)), jnp.int32)
+        x = jnp.asarray(rng.standard_normal((n, d)), jnp.float32)
+        g = jnp.asarray(rng.standard_normal((n, m)), jnp.float32)
+        order, pos, gids, P = padded_group_layout(flat_e, e, n, tile_m=tm)
+        assert 1 not in np.asarray(gids), "layout must leave expert 1 tileless"
+        xp = jnp.zeros((P, d), jnp.float32).at[pos].set(x[order])
+        gp = jnp.zeros((P, m), jnp.float32).at[pos].set(g[order])
+
+        orig = gm._pgmm_dw_call
+
+        def uninit_semantics(x_, dout_, tile_gids, e_, tile_m, interpret=False):
+            dw = orig(x_, dout_, tile_gids, e_, tile_m, interpret=True)
+            visited = np.zeros(e_, bool)
+            visited[np.asarray(tile_gids)] = True
+            # blocks no grid step wrote: garbage on hardware, NaN here
+            return jnp.where(jnp.asarray(visited)[:, None, None], dw,
+                             jnp.nan)
+
+        monkeypatch.setattr(gm, "_pgmm_dw_call", uninit_semantics)
+        dw = np.asarray(gm._pgmm_dw_raw(xp, gp, gids, e, tm))
+        assert np.isfinite(dw).all(), \
+            "unvisited expert blocks leaked uninitialized memory into dw"
+        np.testing.assert_array_equal(dw[1], 0.0)   # empty expert: no grad
+        oh = np.asarray(jax.nn.one_hot(flat_e, e, dtype=jnp.float32))
+        ref = np.einsum("nd,ne,nm->edm", np.asarray(x), oh, np.asarray(g))
+        np.testing.assert_allclose(dw, ref, rtol=1e-4, atol=1e-5)
+
     def test_pgmm_routed_matches_scatter_no_drop(self):
         from paddle_tpu.incubate.distributed.models.moe import moe_layer as ml
         from paddle_tpu.incubate.distributed.models.moe.moe_layer import (

@@ -206,6 +206,34 @@ def test_shed_infeasible_at_submit_survivors_byte_identical(model):
     assert "retry_attempts" in e.stats and "retry_giveups" in e.stats
 
 
+def test_resume_submit_never_shed(model, tmp_path):
+    """Journaled work is never refused: ``submit(resume=True)`` (the fleet
+    failover / drain-migration path) bypasses feasibility shedding and
+    backpressure — both were charged at the ORIGINAL submit, and a busy
+    survivor shedding a rescued request would strand it (its journal of
+    record already handed it over)."""
+    cfg, m = model
+
+    def build():
+        return ContinuousBatchingEngine(m, max_batch=2, max_len=32,
+                                        page_size=8, block_size=2,
+                                        max_queue=1)
+
+    sup = ServingSupervisor(build, str(tmp_path / "j.jrnl"))
+    warm = Request(_prompt(cfg, 4, 240), max_new_tokens=2)
+    sup.submit(warm)
+    sup.run_until_done(max_steps=200)           # arms the decode-rate EMA
+    doomed_kw = dict(max_new_tokens=16, deadline_s=1e-3)
+    with pytest.raises(RequestShed):            # a NORMAL submit sheds it
+        sup.submit(Request(_prompt(cfg, 6, 241), **doomed_kw))
+    rescued = Request(_prompt(cfg, 6, 242), **doomed_kw)
+    sup.submit(rescued, resume=True)            # a rescued one must admit
+    assert rescued.rid in sup._live
+    assert sup.engine.shed_infeasible and sup.engine.max_queue == 1  # restored
+    sup.run_until_done(max_steps=300)           # (it may still deadline out
+    sup.close()                                 #  later — that's its own fate)
+
+
 # ---------------------------------------------------------------------------
 # supervisor: crash recovery, restart + backpressure, brownout
 # ---------------------------------------------------------------------------

@@ -49,6 +49,15 @@ PRIMARY = "llama_pretrain_tokens_per_sec_per_chip"
 # - serving_shed_rate: fraction of an overload wave (half infeasible
 #   deadlines) refused at submit — if feasibility shedding breaks the rate
 #   collapses toward 0 ("higher" direction catches it).
+# - fleet_tokens_per_sec: 3-replica FleetRouter useful tok/s on a mixed
+#   wave (docs/SERVING.md fleet section) — replicas share one device, so
+#   this guards the fleet-layer overhead (routing, per-replica journals,
+#   twin splicing) against growing a per-step host sync or O(requests)
+#   scan; 30% tolerance rides out CI jitter.
+# - fleet_failover_time_s: journal load + re-admit + replay-to-hwm after a
+#   mid-wave replica kill — same posture as serving_recovery_time_s (2s
+#   floor, recompile-dominated), fails past 2x when failover starts
+#   re-running work it already delivered.
 SECONDARY = {
     "serving_p99_step_latency_ms": ("lower", 1.0, 0.0),
     "guard_overhead_pct": ("lower", 1.0, 5.0),
@@ -56,6 +65,8 @@ SECONDARY = {
     "serving_prefill_tokens_per_sec": ("higher", 0.3, 0.0),
     "serving_recovery_time_s": ("lower", 1.0, 2.0),
     "serving_shed_rate": ("higher", 0.5, 0.0),
+    "fleet_tokens_per_sec": ("higher", 0.3, 0.0),
+    "fleet_failover_time_s": ("lower", 1.0, 2.0),
 }
 
 

@@ -875,6 +875,224 @@ def drill_serving_overload_shed(recover: bool):
                   f"({eng.stats['shed']} shed), survivors byte-identical")
 
 
+def _fleet_build():
+    _, m = _serving_model()
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+
+    return ContinuousBatchingEngine(m, max_batch=2, max_len=32, page_size=8,
+                                    block_size=2)
+
+
+def _fleet_wave_kwargs():
+    """Mixed fleet wave: greedy and seeded-sampled requests (params only;
+    Request objects are built fresh per run)."""
+    import numpy as np
+
+    cfg, _ = _serving_model()
+    rng = np.random.default_rng(41)
+    kws = []
+    for i in range(6):
+        p = rng.integers(0, cfg.vocab_size, (6,)).astype(np.int32)
+        kw = dict(prompt_ids=p, max_new_tokens=8, seed=200 + i)
+        if i % 3 == 2:
+            kw.update(temperature=0.9)
+        kws.append(kw)
+    return kws
+
+
+def _fleet_refs():
+    """Uninterrupted single-engine reference streams — per-request
+    determinism means any fleet placement must reproduce them exactly."""
+    if "fleet_refs" not in _SERVING:
+        from paddle_tpu.inference.serving import Request
+
+        eng = _fleet_build()
+        reqs = [Request(**kw) for kw in _fleet_wave_kwargs()]
+        for r in reqs:
+            eng.add_request(r)
+        eng.run_until_done(max_steps=500)
+        _SERVING["fleet_refs"] = [list(r.tokens) for r in reqs]
+    return _SERVING["fleet_refs"]
+
+
+def drill_fleet_replica_kill(recover: bool):
+    """One of three replicas dies mid-traffic (FaultPlan
+    ``fleet.replica_kill``). Recovery = the FleetRouter reads the dead
+    replica's ON-DISK journal, re-admits its unfinished requests on
+    survivors and catches them up to the delivered high-water marks —
+    every stream byte-identical to an uninterrupted run (PT-FLT-001).
+    Without failover the dead replica's in-flight requests are lost."""
+    from paddle_tpu.distributed.resilience import FaultPlan, FaultSpec
+    from paddle_tpu.inference.fleet import FleetRouter
+    from paddle_tpu.inference.serving import Request
+
+    refs = _fleet_refs()
+    plan = FaultPlan(seed=5, specs=[
+        FaultSpec("fleet.replica_kill", "kill", at=2, count=1,
+                  match="replica:0:")])
+    with tempfile.TemporaryDirectory() as tmp:
+        fleet = FleetRouter(_fleet_build, tmp, num_replicas=3,
+                            failover=recover)
+        reqs = [Request(**kw) for kw in _fleet_wave_kwargs()]
+        try:
+            with plan:
+                for r in reqs:
+                    fleet.submit(r)
+                fleet.run_until_done(max_steps=500)
+        finally:
+            fleet.close()
+    if not plan.log:
+        return False, "fleet.replica_kill never fired"
+    if fleet.stats["replica_deaths"] != 1:
+        return False, (f"expected exactly one replica death, saw "
+                       f"{fleet.stats['replica_deaths']}")
+    lost = [r.rid for r in reqs if r.failed or not r.done]
+    if not recover:
+        if not lost:
+            return True, "unexpected: replica death lost nothing"
+        return False, (f"no failover: replica 0 died and lost {len(lost)} "
+                       f"in-flight request(s) {lost}")
+    if lost:
+        return False, f"failover left request(s) {lost} failed/unfinished"
+    streams = [list(r.tokens) for r in reqs]
+    if streams != refs:
+        bad = [i for i, (s, f) in enumerate(zip(streams, refs)) if s != f]
+        return False, (f"failed-over stream(s) {bad} diverged from the "
+                       "uninterrupted run")
+    return True, (f"PT-FLT-001: replica 0 killed mid-traffic, "
+                  f"{fleet.stats['failover_requests']} journaled request(s) "
+                  f"re-admitted on survivors in "
+                  f"{fleet.stats['failover_s']:.2f}s, all "
+                  f"{len(reqs)} streams bit-identical (greedy + seeded)")
+
+
+def drill_fleet_drain(recover: bool):
+    """Rolling restart of every replica under traffic (the ``fleet.drain``
+    site drives the same path when planned). Recovery = graceful drain:
+    stop admitting, migrate still-queued requests, finish in-flight slots,
+    rebuild, rejoin — zero failed or duplicated tokens (PT-FLT-002).
+    The control arm models a deployment that hard-restarts replicas
+    without draining: in-flight work is lost."""
+    from paddle_tpu.inference.fleet import FleetRouter
+    from paddle_tpu.inference.serving import Request
+
+    refs = _fleet_refs()
+    with tempfile.TemporaryDirectory() as tmp:
+        fleet = FleetRouter(_fleet_build, tmp, num_replicas=2,
+                            graceful_drain=recover)
+        reqs = [Request(**kw) for kw in _fleet_wave_kwargs()]
+        try:
+            for r in reqs:
+                fleet.submit(r)
+            fleet.step()                    # traffic in flight
+            fleet.rolling_restart(max_steps=500)
+            fleet.run_until_done(max_steps=500)
+        finally:
+            fleet.close()
+    lost = [r.rid for r in reqs if r.failed or not r.done]
+    if not recover:
+        if not lost:
+            return True, "unexpected: hard restart lost nothing"
+        return False, (f"no graceful drain: hard replica restarts lost "
+                       f"{len(lost)} in-flight request(s) {lost}")
+    if lost:
+        return False, f"rolling restart left request(s) {lost} failed"
+    if fleet.stats["restarts"] < 2:
+        return False, "replicas were never rebuilt"
+    streams = [list(r.tokens) for r in reqs]
+    if streams != refs:
+        bad = [i for i, (s, f) in enumerate(zip(streams, refs)) if s != f]
+        return False, (f"stream(s) {bad} diverged across the rolling "
+                       "restart (lost or duplicated tokens)")
+    return True, (f"PT-FLT-002: rolling restart under traffic — "
+                  f"{fleet.stats['migrated']} queued request(s) migrated, "
+                  f"{fleet.stats['restarts']} replicas rebuilt, zero "
+                  "failed/duplicated tokens, streams bit-identical")
+
+
+def drill_fleet_overload(recover: bool):
+    """A sheddable low-priority flood hits every replica at once. Recovery
+    = fleet brownout: once EVERY alive replica sits at depth, sheddable
+    traffic is refused at submit with a typed ``RequestShed`` (PT-FLT-003)
+    BEFORE queues saturate, so priority traffic still admits everywhere;
+    the brownout exits hysteretically once pressure clears (PT-FLT-004).
+    Without it the flood saturates every queue and priority traffic is
+    refused with ``EngineSaturated``."""
+    import numpy as np
+
+    from paddle_tpu.inference.fleet import FleetConfig, FleetRouter
+    from paddle_tpu.inference.serving import (ContinuousBatchingEngine,
+                                              EngineSaturated, Request,
+                                              RequestShed)
+
+    cfg, m = _serving_model()
+    rng = np.random.default_rng(47)
+
+    def build():
+        return ContinuousBatchingEngine(m, max_batch=2, max_len=32,
+                                        page_size=8, block_size=2,
+                                        max_queue=2)
+
+    config = FleetConfig(brownout_depth=(2 if recover else 10 ** 9),
+                         brownout_enter_after=2, brownout_exit_after=2)
+    with tempfile.TemporaryDirectory() as tmp:
+        fleet = FleetRouter(build, tmp, num_replicas=3, config=config)
+        shed = saturated = 0
+        admitted = []
+        try:
+            for i in range(20):             # flood faster than service rate
+                p = rng.integers(0, cfg.vocab_size, (6,)).astype(np.int32)
+                low = Request(p, max_new_tokens=8, seed=300 + i,
+                              priority=Request.PRIORITY_LOW)
+                try:
+                    fleet.submit(low)
+                    admitted.append(low)
+                except RequestShed:
+                    shed += 1
+                except EngineSaturated:
+                    saturated += 1
+                if i % 3 == 2:              # service interleaves, but slower
+                    fleet.step()            # than the flood arrives
+            vip_refused = 0
+            vips = []
+            for i in range(3):              # priority traffic mid-flood
+                p = rng.integers(0, cfg.vocab_size, (6,)).astype(np.int32)
+                vip = Request(p, max_new_tokens=4, seed=400 + i,
+                              priority=Request.PRIORITY_HIGH)
+                try:
+                    fleet.submit(vip)
+                    vips.append(vip)
+                except (RequestShed, EngineSaturated):
+                    vip_refused += 1
+            fleet.run_until_done(max_steps=500)
+        finally:
+            fleet.close()
+    if not recover:
+        if not vip_refused:
+            return True, ("unexpected: priority traffic admitted through "
+                          "a saturating flood without fleet brownout")
+        return False, (f"no fleet brownout: the flood saturated every "
+                       f"replica ({saturated} EngineSaturated) and "
+                       f"{vip_refused}/3 priority request(s) were refused")
+    if fleet.stats["brownouts"] < 1:
+        return False, "fleet brownout never entered under the flood"
+    if not shed or fleet.stats["fleet_shed"] != shed:
+        return False, f"flood was not shed at submit (shed={shed})"
+    if saturated or vip_refused:
+        return False, (f"brownout failed to protect admission "
+                       f"(EngineSaturated={saturated}, vip_refused="
+                       f"{vip_refused})")
+    bad = [r.rid for r in vips + admitted if not r.done or r.failed]
+    if bad:
+        return False, f"admitted request(s) {bad} did not complete"
+    if fleet._brownout_active:
+        return False, "fleet brownout never exited after pressure cleared"
+    return True, (f"PT-FLT-003/004: flood shed {shed}/20 at submit once "
+                  f"every replica sat at depth, all 3 priority requests "
+                  f"admitted + completed, zero EngineSaturated, brownout "
+                  "exited hysteretically")
+
+
 DRILLS = {
     "heartbeat": drill_heartbeat,
     "store_stall": drill_store_stall,
@@ -885,6 +1103,9 @@ DRILLS = {
     "serving_crash": drill_serving_crash,
     "serving_stall": drill_serving_stall,
     "serving_overload_shed": drill_serving_overload_shed,
+    "fleet_replica_kill": drill_fleet_replica_kill,
+    "fleet_drain": drill_fleet_drain,
+    "fleet_overload": drill_fleet_overload,
     "nan_grad": drill_nan_grad,
     "loss_spike": drill_loss_spike,
     "poison_batch": drill_poison_batch,
