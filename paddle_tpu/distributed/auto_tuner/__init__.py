@@ -158,6 +158,7 @@ class AutoTuner:
         self.cfg = config
         self.history: List[Tuple[Candidate, float]] = []
         self.recorder: Optional[Recorder] = None
+        self._hist_keys: set = set()   # dedup across repeated search() calls
 
     def _fingerprint(self) -> str:
         """Stable digest over EVERY TuneConfig field — any field can change
@@ -260,11 +261,21 @@ class AutoTuner:
         return cost
 
     # -- search driver (reference: tuner.py AutoTuner.search_once loop) --
+    def _note_history(self, c: Candidate, t: float,
+                      recorder: Recorder) -> None:
+        """Append to ``self.history`` at most once per candidate key —
+        repeated ``search()`` calls re-walk the same cached trials, and
+        duplicating them would skew anything averaging over history."""
+        k = recorder.key_of(c)
+        if k not in self._hist_keys:
+            self._hist_keys.add(k)
+            self.history.append((c, t))
+
     def _trial(self, c: Candidate, run_fn, recorder: Recorder):
         """One error-tolerant trial with history reuse + recording."""
         cached = recorder.metric_for(c)
         if cached is not None:
-            self.history.append((c, cached))  # resumed runs keep history
+            self._note_history(c, cached, recorder)  # resumed: no dup
             return cached
         if recorder.seen(c):
             return None  # previously failed — don't retry (reference prune)
@@ -274,7 +285,7 @@ class AutoTuner:
             recorder.store(c, None, status="error", error=repr(e)[:200])
             return None
         recorder.store(c, t)
-        self.history.append((c, t))
+        self._note_history(c, t, recorder)
         return t
 
     def _neighbors(self, best: Candidate,
@@ -307,8 +318,21 @@ class AutoTuner:
             raise ValueError("no feasible parallel config for this model/mesh")
         if run_fn is None:
             return cands[0]
-        recorder = self.recorder = Recorder(history_path,
-                                            fingerprint=self._fingerprint())
+        if history_path is not None or self.recorder is None:
+            recorder = self.recorder = Recorder(
+                history_path, fingerprint=self._fingerprint())
+        elif self.recorder.path is not None:
+            # history_path=None after a FILE-backed search: keep the trial
+            # knowledge (failed candidates still not retried) but stop
+            # persisting — the caller asked for no file this time
+            mem = Recorder(None, fingerprint=self._fingerprint())
+            mem.records = list(self.recorder.records)
+            recorder = self.recorder = mem
+        else:
+            # history_path=None on a repeat search: REUSE the in-memory
+            # recorder — "failed candidates are not retried" must hold
+            # across calls, not just within one
+            recorder = self.recorder
         best, best_t = None, math.inf
         for c in cands[:max_trials]:
             t = self._trial(c, run_fn, recorder)

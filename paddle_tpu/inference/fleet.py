@@ -142,7 +142,8 @@ class FleetRouter:
                  step_budget_s: Optional[float] = None,
                  max_recoveries: int = 2, failover: bool = True,
                  graceful_drain: bool = True,
-                 config: Optional[FleetConfig] = None, fsync: bool = False):
+                 config: Optional[FleetConfig] = None, fsync: bool = False,
+                 tracer=None):
         if num_replicas < 1:
             raise ValueError("num_replicas must be >= 1")
         self._build = build_engine
@@ -151,6 +152,10 @@ class FleetRouter:
         self.config = config or FleetConfig()
         self.failover = bool(failover)
         self.graceful_drain = bool(graceful_drain)
+        # ONE TraceRecorder across the fleet: every supervisor/engine stamps
+        # with a replica tag (pid = replica in the chrome trace), and a
+        # failed-over request's spans continue in the same lane
+        self.tracer = tracer
         self._sup_kw = dict(step_budget_s=step_budget_s,
                             max_recoveries=max_recoveries, fsync=fsync)
         self.replicas: List[_Replica] = []
@@ -161,8 +166,8 @@ class FleetRouter:
             gen = self._latest_gen(i)
             path = os.path.join(fleet_dir, f"replica{i}.g{gen}.jrnl")
             self.replicas.append(_Replica(
-                i, ServingSupervisor(build_engine, path, **self._sup_kw),
-                path, gen=gen))
+                i, ServingSupervisor(build_engine, path,
+                                     **self._rep_kw(i)), path, gen=gen))
         self.requests: Dict[int, Request] = {}
         self._assigned: Dict[int, int] = {}          # rid -> replica idx
         self._returned: Set[int] = set()
@@ -178,6 +183,29 @@ class FleetRouter:
                       "restarts": 0, "brownouts": 0, "affinity_hits": 0}
         self._fault_hook = None
         self._fault_cls = None
+
+    def _trace_lost(self, rid: int, user: Request, replica: int) -> None:
+        """Terminal stamp for a lost request — guarded like recovery.py's
+        divergence path: the engine may have terminal'd the rid in the
+        very step the replica died (twin finished, result never spliced);
+        a second terminal would break the one-terminal invariant, so that
+        case records a non-terminal ``request_lost`` event instead."""
+        if self.tracer is None:
+            return
+        if self.tracer.is_open(rid):
+            self.tracer.finish(rid, len(user.output), failed=True,
+                               error=user.error, kind="fail",
+                               tags={"replica": replica})
+        else:
+            self.tracer.instant("request_lost", rid,
+                                tags={"replica": replica},
+                                error=(user.error or "")[:200])
+
+    def _rep_kw(self, idx: int) -> dict:
+        kw = dict(self._sup_kw)
+        if self.tracer is not None:
+            kw.update(tracer=self.tracer, trace_tags={"replica": idx})
+        return kw
 
     def _latest_gen(self, idx: int) -> int:
         best = 0
@@ -239,6 +267,10 @@ class FleetRouter:
         if (self._brownout_active
                 and req.priority >= self.config.shed_priority):
             self.stats["fleet_shed"] += 1
+            if self.tracer is not None:
+                # shed before any replica saw it — the tracer books the
+                # implicit submit so the lifecycle still closes
+                self.tracer.shed(req.rid, reason="fleet brownout")
             raise RequestShed(
                 f"PT-FLT-003: fleet brownout — priority {req.priority} "
                 f"request rid={req.rid} shed at submit (every replica at "
@@ -408,6 +440,7 @@ class FleetRouter:
             user.done = user.failed = True
             user.error = (f"PT-FLT-001: replica {rep.idx} died and failover "
                           "is disabled — request lost")
+            self._trace_lost(rid, user, rep.idx)
             lost.append(rid)
         self._retire_journal(rep.journal_path, [], lost)
 
@@ -447,10 +480,15 @@ class FleetRouter:
                 user.done = user.failed = True
                 user.error = ("PT-FLT-001: no surviving replica to fail "
                               f"over rid={rid} to")
+                self._trace_lost(rid, user, dead.idx)
                 continue
             # resume=True: journaled work is never refused — the supervisor
             # disables backpressure AND feasibility shedding for it (both
             # were charged at the original submit)
+            if self.tracer is not None:
+                # the failover EDGE: which journal the request came from
+                # and which survivor continues its stream
+                self.tracer.failover(rid, dead.idx, target.idx)
             target.sup.submit(user, resume=True)
             self._assigned[rid] = target.idx
             self._register_prefix(user.prompt, target.idx)
@@ -522,6 +560,7 @@ class FleetRouter:
                 user.done = user.failed = True
                 user.error = ("PT-FLT-002: replica hard-restarted without "
                               "drain — request lost")
+                self._trace_lost(rid, user, idx)
                 lost.append(rid)
             self._retire_journal(rep.journal_path, [], lost)
             self._respawn(rep)
@@ -565,7 +604,7 @@ class FleetRouter:
         rep.journal_path = os.path.join(
             self.fleet_dir, f"replica{rep.idx}.g{rep.gen}.jrnl")
         rep.sup = ServingSupervisor(self._build, rep.journal_path,
-                                    **self._sup_kw)
+                                    **self._rep_kw(rep.idx))
         rep.state = ReplicaState.ALIVE
         rep.progress = None
         rep.last_progress_t = time.monotonic()

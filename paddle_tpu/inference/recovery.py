@@ -283,10 +283,17 @@ class ServingSupervisor:
     def __init__(self, build_engine: Callable[[], ContinuousBatchingEngine],
                  journal_path: str, step_budget_s: Optional[float] = None,
                  max_recoveries: int = 2, watchdog_grace_steps: int = 4,
-                 fsync: bool = False):
+                 fsync: bool = False, tracer=None,
+                 trace_tags: Optional[dict] = None):
         from ..distributed.resilience.watchdog import StepWatchdog
 
         self._build = build_engine
+        # observability (docs/OBSERVABILITY.md): the supervisor owns the
+        # tracer attachment because the engine is factory-built (and
+        # REBUILT on recovery) — every new engine gets the same recorder,
+        # so one request's spans stay in one timeline across crashes
+        self.tracer = tracer
+        self.trace_tags = dict(trace_tags or {})
         # a rebuilt engine recompiles its programs, and a compile-heavy
         # step is indistinguishable from a stall — without grace, one real
         # stall cascades into false positives that burn the whole recovery
@@ -311,6 +318,7 @@ class ServingSupervisor:
         self.stats = {"shed": 0, "recoveries": 0, "recovery_s": 0.0,
                       "replayed_requests": 0}
         self.engine = build_engine()
+        self._attach_tracer()
         # rids are assigned by a PER-PROCESS counter; a restart over an
         # existing journal resets it, so a fresh submit could collide with
         # a journaled rid (a stale "fin" would then mask the new request
@@ -331,6 +339,11 @@ class ServingSupervisor:
                           f"journal restart: {len(pending)} unfinished "
                           "request(s) found", rebuild=False)
 
+    def _attach_tracer(self) -> None:
+        if self.tracer is not None:
+            self.engine.tracer = self.tracer
+            self.engine.trace_tags = dict(self.trace_tags)
+
     # -- public API --------------------------------------------------------
     def submit(self, req: Request, resume: bool = False) -> int:
         """Journal + admit (a private twin carrying the same rid enters the
@@ -346,6 +359,13 @@ class ServingSupervisor:
         caller's stream continues exactly where it left off."""
         meta = _admit_record(req)
         twin = _request_from(meta)
+        if resume and self.tracer is not None:
+            # raise the streamed-token dedup floor BEFORE the twin admits:
+            # catch-up regeneration below the delivered mark re-streams
+            # nothing the caller doesn't already have, and every span from
+            # here on carries recovered=true
+            self.tracer.mark_recovered(req.rid, len(req.output),
+                                       self.trace_tags)
         if resume:
             # journaled work is never refused: backpressure AND feasibility
             # shedding were already charged at the ORIGINAL submit — a
@@ -534,6 +554,22 @@ class ServingSupervisor:
                            f"vs {user.output[:8]}...")
                     self.events.append(("PT-SRV-005", err))
                     self.journal.defer("fin", rid=rid, failed=True)
+                    if self.tracer is not None:
+                        # a twin that never completed through the engine's
+                        # _mark_done needs its terminal stamped here or the
+                        # lane never closes; a twin that DID finish (done
+                        # but diverged, or ended early clean) already has
+                        # one — record the divergence without stamping a
+                        # second terminal
+                        if self.tracer.is_open(rid):
+                            self.tracer.finish(rid, len(user.output),
+                                               failed=True, error=err,
+                                               kind="fail",
+                                               tags=self.trace_tags)
+                        else:
+                            self.tracer.instant("replay_divergence", rid,
+                                                self.trace_tags,
+                                                error=err[:200])
                     updates.append((rid, user, [], True, True, err))
                     continue
                 if n_twin >= n_user:
@@ -571,6 +607,7 @@ class ServingSupervisor:
         mark (verified bit-for-bit), then returns — the service is back to
         its pre-crash state and normal stepping resumes."""
         t0 = time.monotonic()
+        t0_tr = None if self.tracer is None else self.tracer.now()
         self.recoveries += 1
         self.stats["recoveries"] += 1
         self._grace = self.watchdog_grace_steps
@@ -578,6 +615,7 @@ class ServingSupervisor:
         if rebuild:
             self.journal.append("crash", code=code, msg=msg)
             self.engine = self._build()
+            self._attach_tracer()
         replaying: List[int] = []
         # backpressure and feasibility shedding were already charged at the
         # original submit — neither a max_queue smaller than the in-flight
@@ -606,6 +644,9 @@ class ServingSupervisor:
                 self._live[rid] = twin
                 if user.output:
                     self._verify.add(rid)
+                if self.tracer is not None:
+                    self.tracer.mark_recovered(rid, len(user.output),
+                                               self.trace_tags)
                 self.engine.add_request(twin)
                 replaying.append(rid)
         finally:
@@ -638,5 +679,8 @@ class ServingSupervisor:
         self._sync_progress()
         dt = time.monotonic() - t0
         self.stats["recovery_s"] += dt
+        if self.tracer is not None:
+            self.tracer.recovery(t0_tr, code, len(replaying),
+                                 tags=self.trace_tags)
         self.journal.append("recovered", code=code, n=len(replaying),
                             seconds=round(dt, 6))

@@ -248,8 +248,16 @@ class ContinuousBatchingEngine:
                  compile_cache_cap: int = 64,
                  shed_infeasible: bool = True,
                  brownout: Union[bool, BrownoutConfig, None] = None,
+                 tracer=None, trace_tags: Optional[Dict] = None,
                  _unsafe_overcommit: bool = False):
         self.model = model
+        # per-request trace spans (observability.TraceRecorder — docs/
+        # OBSERVABILITY.md): every stamp site is host-side, behind a single
+        # `is not None` check, and records into a bounded buffer — nothing
+        # on the jitted step path. Assignable post-construction (the
+        # ServingSupervisor attaches one to factory-built engines).
+        self.tracer = tracer
+        self.trace_tags = dict(trace_tags or {})
         self.max_batch = max_batch
         self.max_len = max_len
         self.page_size = page_size
@@ -395,7 +403,19 @@ class ContinuousBatchingEngine:
         validate = getattr(self.model, "_validate_generate", None)
         if validate is not None:
             validate(len(req.prompt), len(req.prompt) + req.max_new_tokens)
-        self._shed_check(req)
+        if self.tracer is not None:
+            # stamp AFTER the caller-error validations (a ValueError'd
+            # request never entered the system) but BEFORE the shed check
+            # (a shed is a real terminal outcome of a real submission)
+            self.tracer.submit(req.rid, len(req.prompt), req.max_new_tokens,
+                               self.trace_tags)
+            try:
+                self._shed_check(req)
+            except RequestShed:
+                self.tracer.shed(req.rid, self.trace_tags)
+                raise
+        else:
+            self._shed_check(req)
         req._engine = weakref.ref(self)
         req._enqueued_at = _time.monotonic()
         if req.deadline_s is not None:
@@ -677,10 +697,14 @@ class ContinuousBatchingEngine:
                               jnp.asarray(self._tops.copy()),
                               jnp.asarray(self._topks.copy()))
         seeds_d, temps_d, tops_d, topks_d = self._samp_dev
+        t0_tr = None if self.tracer is None else self.tracer.now()
         out, self._last_tok, self.caches = self._jit_step(
             self._params, toks, self.caches, pos_vec,
             seeds_d, temps_d, tops_d, topks_d, n_steps=n,
             do_sample=do_sample)
+        if self.tracer is not None:
+            self.tracer.decode_block(t0_tr, n, len(live),
+                                     tags=self.trace_tags)
         if async_ok:
             entries = []
             for i, req in live:
@@ -688,6 +712,8 @@ class ContinuousBatchingEngine:
                 entries.append((i, req, took))
                 req._n_out += took
                 self._sched_tokens += took
+                if self.tracer is not None:
+                    self.tracer.tokens(req.rid, req._n_out, self.trace_tags)
                 self._pos[i] += took
                 if req._n_out >= req.max_new_tokens:
                     req.done = True
@@ -712,6 +738,8 @@ class ContinuousBatchingEngine:
                     break
             self._pos[i] += took
             self._sched_tokens += took
+            if self.tracer is not None:
+                self.tracer.tokens(req.rid, req._n_out, self.trace_tags)
             if req.done:
                 self._mark_done(req)
                 self._release_slot(i)       # slot + its pages are free again
@@ -739,11 +767,15 @@ class ContinuousBatchingEngine:
 
     def _mark_done(self, req: "Request"):
         """Single chokepoint for request completion: surfaces the request
-        in ``_finished`` and retires its deadline from the expiry-scan
-        counter."""
+        in ``_finished``, retires its deadline from the expiry-scan
+        counter, and stamps the terminal trace span (finish / evict /
+        fail — the tracer infers the kind from failed+error)."""
         if req.deadline_s is not None:
             self._n_deadlined = max(0, self._n_deadlined - 1)
         self._finished[req.rid] = req
+        if self.tracer is not None:
+            self.tracer.finish(req.rid, req._n_out, failed=req.failed,
+                               error=req.error, tags=self.trace_tags)
 
     def withdraw_queued(self, rid: int) -> bool:
         """Remove a still-WAITING request from the queue (never an admitted
@@ -906,6 +938,12 @@ class ContinuousBatchingEngine:
         self._prefill_next[slot] = cached
         self.stats["hit_tokens"] += cached
         self.stats["miss_tokens"] += len(prompt) - cached
+        if self.tracer is not None:
+            now = _time.monotonic()
+            self.tracer.admit(
+                req.rid, now - (req._enqueued_at or now),
+                hit_tokens=cached, miss_tokens=len(prompt) - cached,
+                tags=self.trace_tags)
         return True
 
     def _steal_blocks(self, n: int, avoid=()):
@@ -982,6 +1020,7 @@ class ContinuousBatchingEngine:
     def _run_chunk(self, group):
         C = self._chunk_tokens
         g = len(group)
+        t0_tr = None if self.tracer is None else self.tracer.now()
         ids = np.zeros((g, C), np.int32)
         starts = np.zeros(g, np.int32)
         rows = np.stack([self._slot_rows[s] for s, _ in group])
@@ -1007,8 +1046,14 @@ class ContinuousBatchingEngine:
                     jnp.asarray(rows), jnp.asarray(starts))
         self.caches = {"kv": new_kv, "tables": self.caches["tables"]}
         for s, req in group:
-            self._prefill_next[s] = min(self._prefill_next[s] + C,
-                                        len(req.prompt))
+            nxt = self._prefill_next[s]
+            self._prefill_next[s] = min(nxt + C, len(req.prompt))
+            if self.tracer is not None:
+                # one span per slot per chunk, host-dispatch window, with
+                # the real (unpadded) token count this chunk advanced
+                self.tracer.prefill_chunk(
+                    req.rid, t0_tr, self._prefill_next[s] - nxt,
+                    tags=self.trace_tags)
 
     def _first_token(self, ready):
         """Re-step the last REAL prompt token at its true position (k/v
@@ -1073,6 +1118,9 @@ class ContinuousBatchingEngine:
             self._seeds[slot] = req.seed
             req._n_out += 1
             self._sched_tokens += 1
+            if self.tracer is not None:
+                self.tracer.first_token(req.rid, self.trace_tags)
+                self.tracer.tokens(req.rid, req._n_out, self.trace_tags)
             self._pos[slot] = len(req.prompt) + 1
             self._tables_host[slot] = self._slot_rows[slot]
             self._tables_dirty = True
@@ -1114,7 +1162,12 @@ class ContinuousBatchingEngine:
             # the prefill program also scatters the group's first tokens into
             # the device-resident last-token carry (no eager device ops here:
             # each eager dispatch costs ~8 ms python-side through the tunnel)
+            t0_tr = None if self.tracer is None else self.tracer.now()
             firsts_dev = self._prefill_group(padded, grp)
+            if self.tracer is not None:
+                self.tracer.span("prefill_group", None, t0_tr,
+                                 tags=self.trace_tags,
+                                 tokens=padded * len(grp), slots=len(grp))
             any_eos = any(r.eos_token_id is not None for _, r in grp)
             firsts = np.asarray(firsts_dev) if any_eos else None
             entries = []
@@ -1126,6 +1179,14 @@ class ContinuousBatchingEngine:
                 self._slots[slot] = req
                 req._n_out += 1
                 self._sched_tokens += 1
+                if self.tracer is not None:
+                    now = _time.monotonic()
+                    self.tracer.admit(req.rid,
+                                      now - (req._enqueued_at or now),
+                                      miss_tokens=len(req.prompt),
+                                      tags=self.trace_tags)
+                    self.tracer.first_token(req.rid, self.trace_tags)
+                    self.tracer.tokens(req.rid, req._n_out, self.trace_tags)
                 self._pos[slot] = len(req.prompt) + 1
                 if firsts is not None:
                     req.output.append(int(firsts[row]))

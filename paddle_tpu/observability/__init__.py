@@ -1,0 +1,47 @@
+"""paddle_tpu.observability — production telemetry for the serving stack.
+
+Three pillars (docs/OBSERVABILITY.md; ROADMAP open item 5):
+
+1. **Metrics** — :class:`MetricsRegistry` with typed
+   :class:`Counter`/:class:`Gauge`/:class:`Histogram` (fixed buckets, no
+   unbounded state) and a pull-based collector protocol; the repo's
+   existing telemetry dicts (``engine.stats``, the ``retry_call``
+   registry, guard/watchdog escalation, pool/radix occupancy,
+   ``FleetRouter`` replica load) adapt in via
+   :func:`engine_collector` / :func:`retry_collector` /
+   :func:`guard_collector` / :func:`supervisor_collector` /
+   :func:`fleet_collector`. :class:`MetricsServer` serves the whole
+   registry in Prometheus text format from a stdlib ``http.server``
+   thread; ``registry.dump()`` is the one-shot scrape.
+2. **Tracing** — :class:`TraceRecorder` stamps host-side spans across the
+   request lifecycle (submit → admit → prefill chunks → first token →
+   decode → finish/evict/shed/failover), threaded through
+   ``inference/serving.py``, ``recovery.py`` (spans survive crash-replay
+   tagged ``recovered=true``, streamed tokens deduped against the journal
+   high-water mark) and ``fleet.py`` (replica ids + failover edges);
+   exports chrome-trace JSON readable in Perfetto.
+3. **SLO summaries** — per-window p50/p99 time-to-first-token,
+   inter-token latency, queue wait, shed/failover rates computed from the
+   histograms (``TraceRecorder.slo_summary``); surfaced by ``bench.py``
+   as ``serving_p50/p99_time_to_first_token_ms``.
+
+Discipline: ALL recording is host-side, buffered, and off the jitted
+step path — guarded by the ``observability_overhead_pct`` bench line
+(≤5%, same posture as ``guard_overhead_pct``). This package imports no
+jax and is safe to import anywhere.
+"""
+
+from .collectors import (engine_collector, fleet_collector,  # noqa: F401
+                         guard_collector, retry_collector,
+                         supervisor_collector)
+from .metrics import (Counter, Gauge, Histogram,  # noqa: F401
+                      MetricFamily, MetricsRegistry,
+                      parse_prometheus_text)
+from .server import MetricsServer  # noqa: F401
+from .tracing import TraceRecorder  # noqa: F401
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricFamily",
+           "MetricsRegistry", "MetricsServer", "TraceRecorder",
+           "engine_collector", "fleet_collector", "guard_collector",
+           "parse_prometheus_text", "retry_collector",
+           "supervisor_collector"]

@@ -1,0 +1,214 @@
+"""Collector adapters: the repo's existing ad-hoc telemetry dicts exposed
+as registry collectors (docs/OBSERVABILITY.md metric catalogue).
+
+Each adapter is a zero-arg callable returning fresh
+:class:`~paddle_tpu.observability.metrics.MetricFamily` objects built from
+LIVE state at scrape time — pull-based, so the instrumented objects pay
+nothing between scrapes, and adapters that wrap a rebuildable object (a
+supervisor's engine, a fleet's replica set) always read the current one,
+never a pre-rebuild corpse.
+
+Adapters (register with ``MetricsRegistry.register_collector``):
+
+- :func:`engine_collector` — ``ContinuousBatchingEngine``: stats dict,
+  queue depth / busy slots, KV pool + radix-cache occupancy, brownout.
+- :func:`retry_collector` — the ``retry_call`` module registry
+  (calls/attempts/retries/giveups/latency + bounded per-``what``).
+- :func:`guard_collector` — numeric-guard health events + an optional
+  ``NumericWatchdog``'s skip/rollback escalation counts.
+- :func:`supervisor_collector` — ``ServingSupervisor`` recovery stats +
+  its CURRENT engine's families.
+- :func:`fleet_collector` — ``FleetRouter``: router stats, per-replica
+  state/load, and each alive replica's supervisor+engine families with a
+  ``replica`` label.
+
+Nothing here imports jax or touches device state.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional
+
+from .metrics import MetricFamily
+
+__all__ = ["engine_collector", "fleet_collector", "guard_collector",
+           "retry_collector", "supervisor_collector"]
+
+
+def _stat_families(prefix: str, stats: dict, kinds: dict,
+                   **labels) -> List[MetricFamily]:
+    out = []
+    for key, val in stats.items():
+        if not isinstance(val, (int, float)):
+            continue
+        name = f"{prefix}_{key}"
+        kind = kinds.get(key, "counter")
+        out.append(MetricFamily(name, kind).add(float(val), **labels))
+    return out
+
+
+# stats-dict keys that are level readings, not monotonic totals
+_ENGINE_GAUGE_KEYS = {"compile_cache_entries"}
+# stats-dict keys NOT exported from engine.stats: "evictions" is a lagging
+# copy of radix.evictions (synced only at admit/brownout time) and the
+# collector already exports the live value as pt_radix_evictions_total —
+# two families for one quantity that disagree mid-flight is worse than one
+_ENGINE_SKIP_KEYS = {"evictions"}
+
+
+def engine_collector(engine, **labels):
+    """Families for one ``ContinuousBatchingEngine`` (pass ``labels`` such
+    as ``replica="0"`` when scraping several engines into one registry)."""
+
+    def collect() -> Iterable[MetricFamily]:
+        fams = _stat_families(
+            "pt_engine",
+            {k: v for k, v in engine.stats.items()
+             if k not in _ENGINE_SKIP_KEYS},
+            {k: "gauge" for k in _ENGINE_GAUGE_KEYS}, **labels)
+        fams.append(MetricFamily(
+            "pt_engine_queue_depth", "gauge",
+            "requests waiting for a slot").add(len(engine._queue), **labels))
+        fams.append(MetricFamily(
+            "pt_engine_busy_slots", "gauge").add(
+            sum(s is not None for s in engine._slots), **labels))
+        fams.append(MetricFamily("pt_engine_max_batch", "gauge").add(
+            engine.max_batch, **labels))
+        fams.append(MetricFamily(
+            "pt_engine_scheduled_tokens_total", "counter",
+            "tokens scheduled across all requests").add(
+            engine._sched_tokens, **labels))
+        fams.append(MetricFamily("pt_engine_steps_total", "counter").add(
+            engine._step_idx, **labels))
+        rate = MetricFamily("pt_engine_decode_tokens_per_sec", "gauge",
+                            "EMA of scheduled-tokens/s")
+        rate.add(engine._ema_tok_s or 0.0, **labels)
+        fams.append(rate)
+        if engine.prefix_cache is not None:
+            alloc, radix = engine._alloc, engine._radix
+            fams.append(MetricFamily(
+                "pt_pool_blocks_total", "gauge",
+                "KV pool capacity in pages").add(alloc.num_blocks, **labels))
+            fams.append(MetricFamily(
+                "pt_pool_free_blocks", "gauge").add(alloc.free_blocks,
+                                                    **labels))
+            fams.append(MetricFamily(
+                "pt_radix_cached_blocks", "gauge",
+                "pages registered in the radix prefix cache").add(
+                len(radix), **labels))
+            fams.append(MetricFamily(
+                "pt_radix_evictions_total", "counter").add(radix.evictions,
+                                                           **labels))
+        # _brownout_active exists on every engine (it just never flips
+        # without a prefix cache) — emit unconditionally so dashboards
+        # keyed on the gauge never see the family vanish
+        fams.append(MetricFamily(
+            "pt_engine_brownout_active", "gauge").add(
+            1.0 if engine._brownout_active else 0.0, **labels))
+        return fams
+
+    return collect
+
+
+def retry_collector():
+    """The ``retry_call`` module-level stats registry
+    (distributed/resilience/retry.py) — calls/attempts/retries/giveups,
+    cumulative latency, and the bounded per-``what`` attempt breakdown."""
+
+    def collect() -> Iterable[MetricFamily]:
+        from ..distributed.resilience.retry import retry_stats
+
+        rs = retry_stats()
+        fams = [
+            MetricFamily("pt_retry_calls_total", "counter").add(rs["calls"]),
+            MetricFamily("pt_retry_attempts_total", "counter").add(
+                rs["attempts"]),
+            MetricFamily("pt_retry_retries_total", "counter").add(
+                rs["retries"]),
+            MetricFamily("pt_retry_giveups_total", "counter").add(
+                rs["giveups"]),
+            MetricFamily("pt_retry_latency_seconds_total", "counter").add(
+                rs["latency_s"]),
+        ]
+        by = MetricFamily("pt_retry_attempts_by_what", "counter",
+                          "attempts per operation label (capped at 64)")
+        for what, n in rs.get("by_what", {}).items():
+            by.add(n, what=str(what))
+        if by.samples:
+            fams.append(by)
+        return fams
+
+    return collect
+
+
+def guard_collector(watchdog=None):
+    """Numeric-guard escalation surface: the eager health-event
+    accumulator (framework/numeric_guard.py) and, when a
+    ``NumericWatchdog`` is passed, its skip/rollback budgets."""
+
+    def collect() -> Iterable[MetricFamily]:
+        from ..framework.numeric_guard import health_events, peek_health
+
+        fams = [
+            MetricFamily("pt_guard_health_events_total", "counter",
+                         "eager health-word events recorded").add(
+                len(health_events())),
+            MetricFamily("pt_guard_health_word", "gauge",
+                         "current un-consumed health word").add(
+                peek_health()),
+        ]
+        if watchdog is not None:
+            fams.append(MetricFamily(
+                "pt_guard_rollbacks_total", "counter",
+                "watchdog rollback escalations").add(watchdog.rollbacks))
+            fams.append(MetricFamily(
+                "pt_guard_window_skips", "gauge",
+                "skips inside the current escalation window").add(
+                len(watchdog._window_skips)))
+        return fams
+
+    return collect
+
+
+def supervisor_collector(sup, **labels):
+    """``ServingSupervisor`` stats + its CURRENT engine's families (read
+    through ``sup.engine`` at scrape time — a rebuild swaps the engine out
+    from under any collector that captured it directly)."""
+
+    def collect() -> Iterable[MetricFamily]:
+        fams = _stat_families("pt_supervisor", sup.stats, {}, **labels)
+        fams.extend(engine_collector(sup.engine, **labels)())
+        return fams
+
+    return collect
+
+
+def fleet_collector(router):
+    """``FleetRouter``: router-level stats, per-replica state/load gauges,
+    and every non-dead replica's supervisor+engine families labeled
+    ``replica="<idx>"``."""
+
+    def collect() -> Iterable[MetricFamily]:
+        from ..inference.fleet import ReplicaState
+
+        fams = _stat_families("pt_fleet", router.stats, {})
+        fams.append(MetricFamily(
+            "pt_fleet_brownout_active", "gauge").add(
+            1.0 if router._brownout_active else 0.0))
+        state = MetricFamily("pt_fleet_replica_state", "gauge",
+                             "1=alive 0.5=draining 0=dead")
+        load = MetricFamily("pt_fleet_replica_load", "gauge",
+                            "queued + slotted requests per replica")
+        for rep in router.replicas:
+            state.add({ReplicaState.ALIVE: 1.0,
+                       ReplicaState.DRAINING: 0.5}.get(rep.state, 0.0),
+                      replica=str(rep.idx))
+            if rep.state != ReplicaState.DEAD:
+                load.add(rep.sup.load(), replica=str(rep.idx))
+                fams.extend(supervisor_collector(
+                    rep.sup, replica=str(rep.idx))())
+        fams.append(state)
+        fams.append(load)
+        return fams
+
+    return collect

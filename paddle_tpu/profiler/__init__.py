@@ -157,8 +157,13 @@ class _HostEvents:
 
     def start(self, name, ts):
         self._open.setdefault(name, []).append(ts)
-        if self.mem_enabled:
-            self._mem_open.setdefault(name, []).append(_device_mem_stats())
+        # push UNCONDITIONALLY (None when memory brackets are off): a
+        # profile_memory Profiler starting or stopping while RecordEvent
+        # scopes are open must not desync the bracket stack — a scope that
+        # began without a snapshot pops its own None, never a snapshot
+        # pushed by a different (post-toggle) invocation
+        self._mem_open.setdefault(name, []).append(
+            _device_mem_stats() if self.mem_enabled else None)
 
     def stop(self, name, ts):
         if self._open.get(name):
@@ -168,12 +173,16 @@ class _HostEvents:
             self.counts[name] += 1
             self.maxs[name] = max(self.maxs[name], dt)
             self.mins[name] = min(self.mins[name], dt)
-        if self.mem_enabled and self._mem_open.get(name):
+        if self._mem_open.get(name):
             before = self._mem_open[name].pop()
-            after = _device_mem_stats()
-            if before is not None and after is not None:
-                self.mem_delta[name] += after[0] - before[0]
-                self.mem_peak[name] = max(self.mem_peak[name], after[1])
+            # account only brackets whose scope RAN fully under memory
+            # profiling: a None push (disabled at begin) contributes
+            # nothing even if profiling turned on mid-scope
+            if self.mem_enabled and before is not None:
+                after = _device_mem_stats()
+                if after is not None:
+                    self.mem_delta[name] += after[0] - before[0]
+                    self.mem_peak[name] = max(self.mem_peak[name], after[1])
 
     def reset(self):
         self.totals.clear()

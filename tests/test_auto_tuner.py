@@ -164,3 +164,52 @@ def test_neighborhood_refinement_finds_better_offgrid():
     key = (tuple(sorted(best.axes.items())), best.n_micro)
     assert key not in topk_keys, f"refinement did not explore beyond top-K: {best}"
     assert tuner.recorder.get_best()["metric"] == 0.5
+
+
+def test_repeat_search_no_history_dup_and_recorder_reuse(tmp_path):
+    """ADVICE r6 low: repeated search() calls must not duplicate cached
+    trials into self.history, and with history_path=None the in-memory
+    Recorder is REUSED so 'failed candidates are not retried' holds across
+    calls, not just within one."""
+    cfg = TuneConfig(n_devices=8, num_layers=16, hidden_size=1024,
+                     num_heads=16, seq_len=2048, global_batch=32)
+    calls = []
+
+    def run_fn(c):
+        calls.append(c)
+        if c.axes.get("tp", 1) == 8:
+            raise RuntimeError("synthetic OOM")
+        return 1.0 + 0.01 * c.axes.get("pp", 1)
+
+    # persistent path: second search reuses cached metrics WITHOUT
+    # appending duplicates to history
+    hist = str(tmp_path / "t.jsonl")
+    t = AutoTuner(cfg)
+    t.search(run_fn=run_fn, max_trials=3, history_path=hist)
+    n_hist = len(t.history)
+    n_calls = len(calls)
+    t.search(run_fn=run_fn, max_trials=3, history_path=hist)
+    assert len(t.history) == n_hist          # no dup appends
+    assert len(calls) == n_calls             # nothing re-ran
+
+    # path then NO path: trial knowledge carries over but nothing more is
+    # written to the old file (the caller asked for no persistence)
+    n_lines = len(open(hist).readlines())
+    t.search(run_fn=run_fn, max_trials=3)          # history_path=None
+    assert t.recorder.path is None
+    assert len(open(hist).readlines()) == n_lines  # file untouched
+    assert len(calls) == n_calls                   # knowledge still reused
+
+    # in-memory: recorder survives across search() calls — failures are
+    # not retried and cached metrics are reused with no duplication
+    calls.clear()
+    t2 = AutoTuner(cfg)
+    t2.search(run_fn=run_fn, max_trials=3)
+    rec = t2.recorder
+    n_hist2 = len(t2.history)
+    n_calls2 = len(calls)
+    assert any(r["status"] == "error" for r in rec.records) or n_calls2 > 0
+    t2.search(run_fn=run_fn, max_trials=3)
+    assert t2.recorder is rec                # reused, not rebuilt
+    assert len(calls) == n_calls2            # no retries (incl. failures)
+    assert len(t2.history) == n_hist2        # no dup appends

@@ -29,10 +29,11 @@ def test_manifest_counts_cover_reference_parity():
     means updating both the manifest and this pin in the same change."""
     m = json.load(open(os.path.join(ROOT, "tools", "api_manifest.json")))
     exact = {
-        "paddle": 535,       # round 4: + geometric/hub/onnx/regularizer/dataset/utils/version;
+        "paddle": 536,       # round 4: + geometric/hub/onnx/regularizer/dataset/utils/version;
                              # prefix-cache PR: + models/ops submodule attrs
                              # (the gate imports inference.serving, which
-                             # binds them on the package)
+                             # binds them on the package);
+                             # observability PR: + observability subpackage
         "paddle.nn": 154,
         "paddle.nn.functional": 156,
         "paddle.linalg": 46,
@@ -53,6 +54,11 @@ def test_manifest_counts_cover_reference_parity():
         # RequestShed, BrownoutConfig, StepWatchdog;
         # fleet PR: + FleetRouter, FleetConfig, ReplicaState
         "paddle.inference.serving": 14,
+        # observability PR (docs/OBSERVABILITY.md): MetricsRegistry +
+        # Counter/Gauge/Histogram/MetricFamily, MetricsServer,
+        # TraceRecorder, parse_prometheus_text, and the five collector
+        # adapters (engine/retry/guard/supervisor/fleet)
+        "paddle.observability": 13,
     }
     for k, n in exact.items():
         assert len(m[k]) == n, (k, len(m[k]), n)
@@ -189,6 +195,29 @@ def test_fault_drill_single_drill_exit_codes():
                         capture_output=True, text=True, env=env, cwd=ROOT,
                         timeout=200)
     assert r2.returncode != 0, r2.stdout + r2.stderr
+
+
+@pytest.mark.slow   # subprocess jax import + engine compile (~10-15s) with
+#                     tier-1 at its 870s ceiling — same posture as
+#                     test_fault_drill_matrix: the gated BEHAVIORS all have
+#                     fast in-process pins (tests/test_observability.py:
+#                     traced wave lifecycle + crash-replay recovered/dedup,
+#                     registry parse roundtrip, HTTP scrape + healthz)
+def test_scrape_metrics_selftest():
+    """Observability gate (docs/OBSERVABILITY.md, beside lint_graph and
+    fault_drill): a live 1-replica fleet under load must expose the
+    engine/pool/radix/retry/guard/fleet metric families in parseable
+    Prometheus text over HTTP, and a traced request must export a
+    Perfetto-loadable chrome trace with a complete
+    submit->admit->first_token->finish span chain and exactly one terminal
+    span per request."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "scrape_metrics.py"),
+         "--selftest"],
+        capture_output=True, text=True, env=env, cwd=ROOT, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "SCRAPE SELFTEST OK" in r.stdout, r.stdout
 
 
 def test_bench_regression_gate_secondary_latency(tmp_path):

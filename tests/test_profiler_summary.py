@@ -90,3 +90,42 @@ def test_memory_view_survives_stop():
     p.stop()
     out = p.summary()
     assert "MemoryView" in out
+
+
+def test_memory_bracket_toggle_mid_scope_no_desync():
+    """ADVICE r6 low: _mem_open is pushed/popped UNCONDITIONALLY (None
+    sentinel when disabled) so a profile_memory Profiler starting or
+    stopping while RecordEvent scopes are open can neither leak bracket
+    entries nor pair snapshots from different invocations."""
+    prof._host_events.reset()
+    he = prof._host_events
+
+    # profiler turns ON mid-scope: the scope began without a snapshot and
+    # must pop its own None at exit — not a snapshot pushed later
+    outer = RecordEvent("op.toggle")
+    outer.begin()
+    p = Profiler(timer_only=True, profile_memory=True)
+    p.start()
+    with RecordEvent("op.toggle"):       # nested same-name, fully inside
+        time.sleep(0.0005)
+    outer.end()
+    assert len(he._mem_open.get("op.toggle", [])) == 0
+    delta_after_on = dict(he.mem_delta)
+
+    # profiler turns OFF mid-scope: the enabled-at-begin snapshot is still
+    # popped at exit (old code leaked it: stop() gated the pop on
+    # mem_enabled), and contributes nothing once profiling is off
+    outer2 = RecordEvent("op.toggle2")
+    outer2.begin()
+    p.stop()
+    outer2.end()
+    assert len(he._mem_open.get("op.toggle2", [])) == 0
+    # a later profile_memory run starts from a clean stack
+    p2 = Profiler(timer_only=True, profile_memory=True)
+    p2.start()
+    with RecordEvent("op.toggle2"):
+        time.sleep(0.0005)
+    p2.stop()
+    assert len(he._mem_open.get("op.toggle2", [])) == 0
+    assert he.mem_delta == delta_after_on or set(he.mem_delta) >= set(
+        delta_after_on)   # no negative cross-pairing blowups, only new keys

@@ -33,6 +33,7 @@ def live_surfaces():
 
     return {
         "paddle.inference.serving": names(_serving),
+        "paddle.observability": names(paddle.observability),
         "paddle": names(paddle),
         "paddle.tensor_methods": sorted(
             n for n in dir(paddle.Tensor) if not n.startswith("_")),

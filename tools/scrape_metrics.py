@@ -1,0 +1,216 @@
+"""Telemetry scrape gate (docs/OBSERVABILITY.md).
+
+Two modes:
+
+- ``--url http://host:port/metrics`` — scrape a live endpoint, parse it,
+  and print a per-family summary (operator smoke tool).
+- ``--selftest`` — CI gate (tests/test_ci_gates.py, beside lint_graph and
+  fault_drill): build a tiny 1-replica fleet (FleetRouter →
+  ServingSupervisor → prefix-cache ContinuousBatchingEngine) with a
+  TraceRecorder and a MetricsServer on an ephemeral port, put it under a
+  real serving load, scrape over HTTP, and assert
+
+  1. the scrape parses as Prometheus text and carries the engine / pool /
+     radix / retry / guard / fleet / serving-SLO metric families,
+  2. a traced request exports a Perfetto-loadable chrome-trace with a
+     complete submit → admit → first_token → finish span chain and every
+     submitted request reaching exactly ONE terminal span,
+  3. the SLO summary computes finite TTFT percentiles from the
+     histograms.
+
+Exit code 0 on success, 1 naming the first failed check.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import urllib.request
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+#: metric families a serving deployment must expose (one representative
+#: per source collector — the full catalogue is docs/OBSERVABILITY.md)
+REQUIRED_FAMILIES = (
+    # engine (ContinuousBatchingEngine.stats + schedule state)
+    "pt_engine_queue_depth",
+    "pt_engine_scheduled_tokens_total",
+    "pt_engine_hit_tokens",
+    # paged-KV pool + radix prefix cache occupancy
+    "pt_pool_blocks_total",
+    "pt_pool_free_blocks",
+    "pt_radix_cached_blocks",
+    # retry_call registry (distributed/resilience/retry.py)
+    "pt_retry_attempts_total",
+    # numeric guard escalation surface
+    "pt_guard_health_events_total",
+    # fleet router
+    "pt_fleet_submitted",
+    "pt_fleet_replica_load",
+    # supervisor recovery stats
+    "pt_supervisor_recoveries",
+    # serving SLO histograms (TraceRecorder)
+    "pt_serving_time_to_first_token_ms",
+    "pt_serving_requests_submitted_total",
+)
+
+#: the span chain a served request must produce, in order
+REQUIRED_CHAIN = ("submit", "admit", "first_token", "finish")
+
+
+def fail(msg: str) -> "NoReturn":   # noqa: F821
+    print(f"SCRAPE FAIL: {msg}")
+    sys.exit(1)
+
+
+def check_families(text: str, required=REQUIRED_FAMILIES) -> int:
+    from paddle_tpu.observability import parse_prometheus_text
+
+    fams = parse_prometheus_text(text)      # raises on malformed lines
+    missing = [name for name in required if name not in fams]
+    if missing:
+        fail(f"metric families missing from scrape: {missing}")
+    for name, fam in fams.items():
+        if not fam.samples:
+            fail(f"family {name} rendered with no samples")
+        if fam.kind == "histogram":
+            if not any(s[0] == "_bucket" and s[1].get("le") == "+Inf"
+                       for s in fam.samples):
+                fail(f"histogram {name} has no +Inf bucket")
+            if not any(s[0] == "_count" for s in fam.samples):
+                fail(f"histogram {name} has no _count sample")
+    return len(fams)
+
+
+def check_trace(doc: dict, rids) -> int:
+    events = doc.get("traceEvents")
+    if not isinstance(events, list) or not events:
+        fail("chrome trace has no traceEvents list")
+    for e in events:
+        if not isinstance(e, dict) or "name" not in e or "ph" not in e:
+            fail(f"malformed trace event: {e!r}")
+        if "ts" not in e or not isinstance(e["ts"], (int, float)):
+            fail(f"trace event without numeric ts: {e!r}")
+        if e["ph"] == "X" and not isinstance(e.get("dur"), (int, float)):
+            fail(f"complete span without dur: {e!r}")
+    for rid in rids:
+        names = [e["name"] for e in events if e.get("tid") == rid]
+        it = iter(names)
+        if not all(step in it for step in REQUIRED_CHAIN):
+            fail(f"rid {rid}: span chain {names} missing ordered "
+                 f"{REQUIRED_CHAIN}")
+        terminals = [n for n in names
+                     if n in ("finish", "evict", "shed", "fail")]
+        if len(terminals) != 1:
+            fail(f"rid {rid}: expected exactly one terminal span, got "
+                 f"{terminals}")
+    return len(events)
+
+
+def selftest() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.fleet import FleetConfig, FleetRouter
+    from paddle_tpu.inference.serving import (ContinuousBatchingEngine,
+                                              Request)
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.observability import (MetricsRegistry, MetricsServer,
+                                          TraceRecorder, fleet_collector,
+                                          guard_collector, retry_collector)
+
+    paddle.seed(11)
+    cfg = LlamaConfig.tiny(num_hidden_layers=1)
+    model = LlamaForCausalLM(cfg)
+    registry = MetricsRegistry()
+    tracer = TraceRecorder(registry=registry)
+    registry.register_collector(retry_collector())
+    registry.register_collector(guard_collector())
+
+    def build():
+        return ContinuousBatchingEngine(
+            model, max_batch=2, max_len=32, page_size=8, block_size=2,
+            prefix_cache=True)
+
+    rng = np.random.default_rng(0)
+    with tempfile.TemporaryDirectory() as tmp:
+        fleet = FleetRouter(build, tmp, num_replicas=1, tracer=tracer,
+                            config=FleetConfig(brownout_depth=10 ** 9))
+        registry.register_collector(fleet_collector(fleet))
+        server = MetricsServer(registry, port=0)
+        reqs = [Request(rng.integers(0, cfg.vocab_size, (8,))
+                        .astype(np.int32), max_new_tokens=4, seed=100 + i)
+                for i in range(4)]
+        for r in reqs:
+            fleet.submit(r)
+        # scrape MID-LOAD once (the endpoint must answer while the engine
+        # steps), then drain and scrape the settled state
+        fleet.step()
+        mid = urllib.request.urlopen(server.url, timeout=10).read()
+        if b"pt_engine_queue_depth" not in mid:
+            fail("mid-load scrape missing engine families")
+        fleet.run_until_done(max_steps=2000)
+        if not all(r.done and not r.failed for r in reqs):
+            fail("serving wave did not complete cleanly")
+        body = urllib.request.urlopen(server.url, timeout=10).read()
+        hz = urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/healthz", timeout=10).read()
+        if hz != b"ok":
+            fail("/healthz did not answer ok")
+        server.close()
+        fleet.close()
+
+    n_fams = check_families(body.decode("utf-8"))
+
+    trace_path = os.path.join(tempfile.gettempdir(),
+                              f"pt_scrape_selftest_{os.getpid()}.json")
+    tracer.export_chrome(trace_path)
+    try:
+        with open(trace_path) as f:
+            doc = json.load(f)      # must round-trip as plain JSON
+    finally:
+        os.unlink(trace_path)
+    n_events = check_trace(doc, [r.rid for r in reqs])
+    if tracer.incomplete():
+        fail(f"unterminated request lifecycles: {tracer.incomplete()}")
+
+    slo = tracer.slo_summary()
+    for key in ("p50_time_to_first_token_ms", "p99_time_to_first_token_ms"):
+        v = slo.get(key)
+        if not (isinstance(v, (int, float)) and v >= 0):
+            fail(f"SLO summary {key} not computed: {v!r}")
+    print(f"SCRAPE SELFTEST OK: {n_fams} metric families over HTTP, "
+          f"{n_events} trace events, complete "
+          f"{'->'.join(REQUIRED_CHAIN)} chains for {len(reqs)} requests, "
+          f"p50/p99 TTFT {slo['p50_time_to_first_token_ms']}/"
+          f"{slo['p99_time_to_first_token_ms']} ms")
+    return 0
+
+
+def main(argv) -> int:
+    if "--selftest" in argv:
+        return selftest()
+    url = None
+    for i, a in enumerate(argv):
+        if a == "--url" and i + 1 < len(argv):
+            url = argv[i + 1]
+    if url is None:
+        print(__doc__)
+        return 2
+    body = urllib.request.urlopen(url, timeout=10).read().decode("utf-8")
+    from paddle_tpu.observability import parse_prometheus_text
+
+    fams = parse_prometheus_text(body)
+    for name in sorted(fams):
+        fam = fams[name]
+        print(f"{name} [{fam.kind}] {len(fam.samples)} sample(s)")
+    print(f"OK: {len(fams)} families")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
