@@ -202,5 +202,10 @@ def save_state_dict(state_dict: Dict, path: str, process_group=None,
     thread = threading.Thread(target=runner, daemon=False,
                               name=f"pt-ckpt-save:{os.path.basename(path)}")
     with _ASYNC_LOCK:
+        # publish + start under ONE critical section: a concurrent
+        # wait_async_save taking the lock between them would pop the record
+        # and join() a never-started thread (RuntimeError) — found by the
+        # PT-RACE triage sweep; regression:
+        # tests/test_resilience.py::test_async_save_starts_inside_lock
         _ASYNC.append((os.path.abspath(path), thread, err))
-    thread.start()
+        thread.start()

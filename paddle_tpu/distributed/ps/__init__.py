@@ -75,7 +75,11 @@ class ParameterServer:
         return True
 
     def _table(self, name):
-        return self._tables[name]
+        # rpc handler threads race create_* (which mutates under the lock):
+        # the lookup takes it too so a resize/replace never hands out a
+        # half-registered table (PT-RACE-002, tools/lint_concurrency.py)
+        with self._lock:
+            return self._tables[name]
 
     # -- dense --
     def pull_dense(self, name: str) -> np.ndarray:

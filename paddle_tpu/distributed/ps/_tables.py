@@ -43,8 +43,9 @@ class DenseTable:
                 self.value -= self.lr * g
 
     def stat(self):
-        return {"kind": "dense", "shape": list(self.value.shape),
-                "optimizer": self.optimizer}
+        with self._lock:
+            return {"kind": "dense", "shape": list(self.value.shape),
+                    "optimizer": self.optimizer}
 
 
 class SparseTable:
@@ -90,5 +91,6 @@ class SparseTable:
                     row -= self.lr * g[i]
 
     def stat(self):
-        return {"kind": "sparse", "emb_dim": self.emb_dim,
-                "rows": len(self._rows), "optimizer": self.optimizer}
+        with self._lock:
+            return {"kind": "sparse", "emb_dim": self.emb_dim,
+                    "rows": len(self._rows), "optimizer": self.optimizer}

@@ -31,6 +31,7 @@ from __future__ import annotations
 import dataclasses
 import os
 import random
+import threading
 import time
 from typing import Callable, Optional, Tuple, Type
 
@@ -38,12 +39,18 @@ __all__ = ["RetryPolicy", "RetryError", "retry_call", "DEFAULT_POLICY",
            "retries_disabled", "retry_stats", "reset_retry_stats"]
 
 # -- stats registry ---------------------------------------------------------
-# plain dict mutations under the GIL: retry_call is a control-plane path
-# (store ops, rpc setup), never a per-token hot path, so a lock would buy
-# nothing. ``by_what`` is bounded so an unbounded label space (per-key store
-# ops) cannot grow the registry without limit.
+# retry_call runs CONCURRENTLY: fleet ``parallel_step`` replica threads,
+# the rpc ThreadPoolExecutor fan-out and the elastic heartbeat daemon all
+# funnel through it, so the read-modify-write counter updates need a real
+# lock — ``+=`` under the GIL loses increments across threads
+# (PT-RACE-001, tools/lint_concurrency.py; regression:
+# tests/test_resilience.py::test_retry_stats_concurrent_exact). Still a
+# control-plane path — the lock is ~100ns per attempt, invisible next to
+# a socket round trip. ``by_what`` is bounded so an unbounded label space
+# (per-key store ops) cannot grow the registry without limit.
 _BY_WHAT_CAP = 64
 
+_STATS_LOCK = threading.Lock()
 _STATS = {"calls": 0, "attempts": 0, "retries": 0, "giveups": 0,
           "latency_s": 0.0}
 _BY_WHAT: dict = {}
@@ -52,21 +59,29 @@ _BY_WHAT: dict = {}
 def retry_stats() -> dict:
     """Snapshot of the registry: aggregate counters plus the per-``what``
     attempt counts (``by_what``, capped at 64 distinct labels)."""
-    out = dict(_STATS)
-    out["by_what"] = dict(_BY_WHAT)
+    with _STATS_LOCK:
+        out = dict(_STATS)
+        out["by_what"] = dict(_BY_WHAT)
     return out
 
 
 def reset_retry_stats() -> None:
-    for k in _STATS:
-        _STATS[k] = 0.0 if k == "latency_s" else 0
-    _BY_WHAT.clear()
+    with _STATS_LOCK:
+        for k in _STATS:
+            _STATS[k] = 0.0 if k == "latency_s" else 0
+        _BY_WHAT.clear()
 
 
 def _note_attempt(what: str) -> None:
-    _STATS["attempts"] += 1
-    if what in _BY_WHAT or len(_BY_WHAT) < _BY_WHAT_CAP:
-        _BY_WHAT[what] = _BY_WHAT.get(what, 0) + 1
+    with _STATS_LOCK:
+        _STATS["attempts"] += 1
+        if what in _BY_WHAT or len(_BY_WHAT) < _BY_WHAT_CAP:
+            _BY_WHAT[what] = _BY_WHAT.get(what, 0) + 1
+
+
+def _note(key: str, amount=1) -> None:
+    with _STATS_LOCK:
+        _STATS[key] += amount
 
 
 @dataclasses.dataclass(frozen=True)
@@ -139,19 +154,19 @@ def retry_call(fn: Callable, *args, policy: Optional[RetryPolicy] = None,
     start = time.monotonic()
     delays = backoff_delays(pol, rng)
     last: Optional[BaseException] = None
-    _STATS["calls"] += 1
+    _note("calls")
     for attempt in range(1, attempts + 1):
         _note_attempt(what)
         try:
             result = fn(*args, **kwargs)
-            _STATS["latency_s"] += time.monotonic() - start
+            _note("latency_s", time.monotonic() - start)
             return result
         except pol.retry_on as e:
             last = e
             elapsed = time.monotonic() - start
             if attempt >= attempts:
-                _STATS["giveups"] += 1
-                _STATS["latency_s"] += elapsed
+                _note("giveups")
+                _note("latency_s", elapsed)
                 if attempts == 1:
                     raise        # retries disabled/single-shot: raw failure
                 raise RetryError("PT-RETRY-002", what, attempt, elapsed, e) from e
@@ -159,12 +174,12 @@ def retry_call(fn: Callable, *args, policy: Optional[RetryPolicy] = None,
             if pol.deadline is not None:
                 remain = pol.deadline - elapsed
                 if remain <= 0:
-                    _STATS["giveups"] += 1
-                    _STATS["latency_s"] += elapsed
+                    _note("giveups")
+                    _note("latency_s", elapsed)
                     raise RetryError("PT-RETRY-001", what, attempt, elapsed,
                                      e) from e
                 delay = min(delay, remain)
-            _STATS["retries"] += 1
+            _note("retries")
             if on_retry is not None:
                 on_retry(attempt, e, delay)
             sleep(max(0.0, delay))

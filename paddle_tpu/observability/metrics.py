@@ -147,7 +147,9 @@ class Counter(_Instrument):
             self._values[key] = self._values.get(key, 0.0) + amount
 
     def value(self, **labels) -> float:
-        return self._values.get(self._key(labels), 0.0)
+        key = self._key(labels)
+        with self._lock:
+            return self._values.get(key, 0.0)
 
 
 class Gauge(_Instrument):
@@ -158,7 +160,9 @@ class Gauge(_Instrument):
             self._values[self._key(labels)] = float(value)
 
     def value(self, **labels) -> float:
-        return self._values.get(self._key(labels), 0.0)
+        key = self._key(labels)
+        with self._lock:
+            return self._values.get(key, 0.0)
 
 
 class Histogram(_Instrument):
@@ -196,7 +200,9 @@ class Histogram(_Instrument):
             row[-1] += v
 
     def count(self, **labels) -> int:
-        row = self._values.get(self._key(labels))
+        key = self._key(labels)
+        with self._lock:
+            row = list(self._values.get(key) or ())
         return int(sum(row[:-1])) if row else 0
 
     def quantile(self, q: float, **labels) -> Optional[float]:
@@ -205,7 +211,11 @@ class Histogram(_Instrument):
         between its bounds. Observations past the last bound clamp to it
         (the standard Prometheus ``histogram_quantile`` posture). None when
         nothing was observed."""
-        row = self._values.get(self._key(labels))
+        key = self._key(labels)
+        with self._lock:
+            row = self._values.get(key)
+            row = list(row) if row else None   # engine threads keep
+            #                                    observing mid-walk
         if not row:
             return None
         total = sum(row[:-1])
@@ -285,7 +295,8 @@ class MetricsRegistry:
         return self._make(Histogram, name, help, buckets=buckets)
 
     def get(self, name: str) -> Optional[_Instrument]:
-        return self._instruments.get(name)
+        with self._lock:
+            return self._instruments.get(name)
 
     # -- collectors --------------------------------------------------------
     def register_collector(self, collector) -> None:
@@ -294,7 +305,10 @@ class MetricsRegistry:
         Called at every scrape — read live state, never cache objects that
         can be rebuilt out from under you."""
         fn = getattr(collector, "collect", None)
-        self._collectors.append(fn if callable(fn) else collector)
+        with self._lock:
+            # the scrape thread snapshots under this lock (collect());
+            # registration happens while serving traffic is live
+            self._collectors.append(fn if callable(fn) else collector)
 
     def collect(self) -> List[MetricFamily]:
         """All families: own instruments first, then each collector's. A

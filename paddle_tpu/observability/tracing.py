@@ -42,6 +42,7 @@ wait, shed/failover rates) are computed FROM the registry histograms
 from __future__ import annotations
 
 import json
+import threading
 import time
 from typing import Dict, List, Optional
 
@@ -84,6 +85,13 @@ class TraceRecorder:
         self.mirror_host_events = bool(mirror_host_events)
         self._clock = clock
         self._t0 = clock()
+        # ONE recorder is shared by every replica of a fleet — under
+        # ``parallel_step`` the stamping sites run on concurrent replica
+        # threads while the driver reads exports/summaries (PT-RACE-001,
+        # tools/lint_concurrency.py). Re-entrant because public stamps
+        # compose (finish -> tokens -> _terminal); host-side control
+        # plane, so the lock costs nothing measurable per stamp.
+        self._lock = threading.RLock()
         self.events: List[dict] = []
         self.dropped = 0
         # per-request bookkeeping (bounded: terminal rids are GC'd oldest
@@ -144,21 +152,23 @@ class TraceRecorder:
     def instant(self, name: str, rid: Optional[int] = None,
                 tags: Optional[dict] = None, **extra) -> None:
         tags = tags or {}
-        self._emit({"name": name, "ph": "i", "ts": self._us(self.now()),
-                    "pid": int(tags.get("replica", 0)),
-                    "tid": int(rid or 0), "s": "t",
-                    "args": self._args(rid, tags, extra)})
+        with self._lock:
+            self._emit({"name": name, "ph": "i", "ts": self._us(self.now()),
+                        "pid": int(tags.get("replica", 0)),
+                        "tid": int(rid or 0), "s": "t",
+                        "args": self._args(rid, tags, extra)})
 
     def span(self, name: str, rid: Optional[int], t0: float,
              t1: Optional[float] = None, tags: Optional[dict] = None,
              **extra) -> None:
         t1 = self.now() if t1 is None else t1
         tags = tags or {}
-        self._emit({"name": name, "ph": "X", "ts": self._us(t0),
-                    "dur": max(0.0, (t1 - t0) * 1e6),
-                    "pid": int(tags.get("replica", 0)),
-                    "tid": int(rid or 0),
-                    "args": self._args(rid, tags, extra)})
+        with self._lock:
+            self._emit({"name": name, "ph": "X", "ts": self._us(t0),
+                        "dur": max(0.0, (t1 - t0) * 1e6),
+                        "pid": int(tags.get("replica", 0)),
+                        "tid": int(rid or 0),
+                        "args": self._args(rid, tags, extra)})
         if self.mirror_host_events:
             from ..profiler import _host_events
 
@@ -191,34 +201,39 @@ class TraceRecorder:
         replay twin, fleet failover/migration) keeps the ORIGINAL submit
         timestamp — TTFT and queue wait stay caller-truthful — and
         re-opens a terminal'd request instead of double-counting it."""
-        known = rid in self._state
-        reopened = self._state.get(rid) in TERMINALS
-        self._track(rid)
-        if not known:
-            self._submit_ts[rid] = self.now()
-            self._c_submitted.inc()
-        else:
-            self.resubmits += 1
-        self.instant("submit" if not known else "resubmit", rid, tags,
-                     prompt_tokens=int(prompt_tokens), max_new=int(max_new),
-                     reopened=bool(reopened))
+        with self._lock:
+            known = rid in self._state
+            reopened = self._state.get(rid) in TERMINALS
+            self._track(rid)
+            if not known:
+                self._submit_ts[rid] = self.now()
+                self._c_submitted.inc()
+            else:
+                self.resubmits += 1
+            self.instant("submit" if not known else "resubmit", rid, tags,
+                         prompt_tokens=int(prompt_tokens),
+                         max_new=int(max_new), reopened=bool(reopened))
 
     def shed(self, rid: int, tags: Optional[dict] = None, **extra) -> None:
-        if rid not in self._state:   # shed before any engine saw it (fleet
-            self._track(rid)         # brownout): still a tracked lifecycle
-            self._submit_ts[rid] = self.now()
-            self._c_submitted.inc()
-        self._terminal(rid, "shed", tags, **extra)
+        with self._lock:
+            if rid not in self._state:   # shed before any engine saw it
+                self._track(rid)         # (fleet brownout): still tracked
+                self._submit_ts[rid] = self.now()
+                self._c_submitted.inc()
+            self._terminal(rid, "shed", tags, **extra)
 
     def admit(self, rid: int, queue_wait_s: float, hit_tokens: int = 0,
               miss_tokens: int = 0, tags: Optional[dict] = None) -> None:
         wait_ms = max(0.0, queue_wait_s * 1e3)
-        if rid not in self._recovered:
-            # a recovered/resumed re-admission's wait is operator cost, not
-            # caller-visible queue wait — keep the SLO histogram honest
-            self._h_qwait.observe(wait_ms)
-        self.instant("admit", rid, tags, queue_wait_ms=round(wait_ms, 3),
-                     hit_tokens=int(hit_tokens), miss_tokens=int(miss_tokens))
+        with self._lock:
+            if rid not in self._recovered:
+                # a recovered/resumed re-admission's wait is operator cost,
+                # not caller-visible queue wait — keep the SLO honest
+                self._h_qwait.observe(wait_ms)
+            self.instant("admit", rid, tags,
+                         queue_wait_ms=round(wait_ms, 3),
+                         hit_tokens=int(hit_tokens),
+                         miss_tokens=int(miss_tokens))
 
     def prefill_chunk(self, rid: int, t0: float, tokens: int,
                       t1: Optional[float] = None,
@@ -229,19 +244,20 @@ class TraceRecorder:
         """First scheduled token. First stamp wins: a crash-replay twin
         re-reaching its first token does NOT reset TTFT (the caller saw
         the original) — it records a tagged replay event instead."""
-        if rid in self._first_ts:
-            self.instant("first_token_replay", rid, tags)
-            return
-        ts = self.now()
-        self._first_ts[rid] = ts
-        sub = self._submit_ts.get(rid)
-        ttft_ms = None
-        if sub is not None:
-            ttft_ms = (ts - sub) * 1e3
-            self._h_ttft.observe(ttft_ms)
-        self.instant("first_token", rid, tags,
-                     **({"ttft_ms": round(ttft_ms, 3)}
-                        if ttft_ms is not None else {}))
+        with self._lock:
+            if rid in self._first_ts:
+                self.instant("first_token_replay", rid, tags)
+                return
+            ts = self.now()
+            self._first_ts[rid] = ts
+            sub = self._submit_ts.get(rid)
+            ttft_ms = None
+            if sub is not None:
+                ttft_ms = (ts - sub) * 1e3
+                self._h_ttft.observe(ttft_ms)
+            self.instant("first_token", rid, tags,
+                         **({"ttft_ms": round(ttft_ms, 3)}
+                            if ttft_ms is not None else {}))
 
     def tokens(self, rid: int, total: int,
                tags: Optional[dict] = None) -> None:
@@ -249,11 +265,12 @@ class TraceRecorder:
         cumulative scheduled-token count. Deduped against the journal
         high-water mark: during crash-replay catch-up the twin regenerates
         tokens the caller already has — those add nothing here."""
-        prev = self._streamed.get(rid, 0)
-        if total <= prev:
-            return
-        self._streamed[rid] = int(total)
-        self._c_tokens.inc(total - prev)
+        with self._lock:
+            prev = self._streamed.get(rid, 0)
+            if total <= prev:
+                return
+            self._streamed[rid] = int(total)
+            self._c_tokens.inc(total - prev)
 
     def decode_block(self, t0: float, n_steps: int, slots: int,
                      t1: Optional[float] = None,
@@ -272,20 +289,22 @@ class TraceRecorder:
         if kind is None:
             kind = ("evict" if failed and error and "deadline" in error
                     else "fail" if failed else "finish")
-        first = self._first_ts.get(rid)
-        if kind == "finish" and first is not None and n_out > 1:
-            self._h_itl.observe((self.now() - first) / (n_out - 1) * 1e3)
-        self.tokens(rid, int(n_out), tags)
-        self._terminal(rid, kind, tags, n_out=int(n_out),
-                       **({"error": str(error)[:200]} if error else {}))
+        with self._lock:
+            first = self._first_ts.get(rid)
+            if kind == "finish" and first is not None and n_out > 1:
+                self._h_itl.observe((self.now() - first) / (n_out - 1) * 1e3)
+            self.tokens(rid, int(n_out), tags)
+            self._terminal(rid, kind, tags, n_out=int(n_out),
+                           **({"error": str(error)[:200]} if error else {}))
 
     def _terminal(self, rid: int, kind: str, tags: Optional[dict],
                   **extra) -> None:
-        if rid not in self._state:
-            self._track(rid)
-        self._state[rid] = kind
-        self._c_terminal.inc(kind=kind)
-        self.instant(kind, rid, tags, **extra)
+        with self._lock:
+            if rid not in self._state:
+                self._track(rid)
+            self._state[rid] = kind
+            self._c_terminal.inc(kind=kind)
+            self.instant(kind, rid, tags, **extra)
 
     # -- recovery / fleet edges -------------------------------------------
     def mark_recovered(self, rid: int, hwm: int,
@@ -299,14 +318,16 @@ class TraceRecorder:
         rolling drain) has nothing to dedup and its wait on the new
         replica is real caller-visible queue wait — it stays untagged and
         fully counted."""
-        self._track(rid)
-        if rid not in self._submit_ts:
-            self._submit_ts[rid] = self.now()   # process-restart: best known
-        if hwm > 0:
-            self._recovered.add(rid)
-            self._streamed[rid] = max(self._streamed.get(rid, 0), int(hwm))
-        self.instant("recovered", rid, tags, hwm=int(hwm),
-                     recovered=hwm > 0)
+        with self._lock:
+            self._track(rid)
+            if rid not in self._submit_ts:
+                self._submit_ts[rid] = self.now()   # restart: best known
+            if hwm > 0:
+                self._recovered.add(rid)
+                self._streamed[rid] = max(self._streamed.get(rid, 0),
+                                          int(hwm))
+            self.instant("recovered", rid, tags, hwm=int(hwm),
+                         recovered=hwm > 0)
 
     def failover(self, rid: int, from_replica: int, to_replica: int,
                  tags: Optional[dict] = None) -> None:
@@ -327,25 +348,31 @@ class TraceRecorder:
         supervisor's replay-divergence path, where the twin may already
         have finished through ``_mark_done``) guard on this to preserve
         the one-terminal-per-lifecycle invariant."""
-        return self._state.get(rid) == "open"
+        with self._lock:
+            return self._state.get(rid) == "open"
 
     def incomplete(self) -> List[int]:
         """Submitted rids with no terminal span yet — empty once a served
         wave has fully drained (the lifecycle-completeness invariant)."""
-        return [rid for rid, st in self._state.items() if st == "open"]
+        with self._lock:
+            return [rid for rid, st in self._state.items() if st == "open"]
 
     def lifecycle(self, rid: int) -> List[str]:
         """Ordered event names for one request — what the tests assert the
         submit -> admit -> first_token -> finish chain on."""
-        return [e["name"] for e in self.events
-                if e.get("tid") == rid and rid != 0]
+        with self._lock:
+            return [e["name"] for e in self.events
+                    if e.get("tid") == rid and rid != 0]
 
     def export_chrome(self, path: Optional[str] = None) -> dict:
         """Chrome-trace JSON (Perfetto / chrome://tracing loadable):
         ``{"traceEvents": [...]}`` with request lanes (tid = rid) and the
         engine lane (tid 0), pid = replica."""
+        with self._lock:
+            events = list(self.events)
+            dropped = self.dropped
         meta = []
-        pids = sorted({e.get("pid", 0) for e in self.events})
+        pids = sorted({e.get("pid", 0) for e in events})
         for pid in pids:
             meta.append({"name": "process_name", "ph": "M", "ts": 0,
                          "pid": pid, "tid": 0,
@@ -353,9 +380,9 @@ class TraceRecorder:
             meta.append({"name": "thread_name", "ph": "M", "ts": 0,
                          "pid": pid, "tid": 0,
                          "args": {"name": "engine"}})
-        doc = {"traceEvents": meta + self.events,
+        doc = {"traceEvents": meta + events,
                "displayTimeUnit": "ms",
-               "otherData": {"dropped_events": self.dropped}}
+               "otherData": {"dropped_events": dropped}}
         if path is not None:
             with open(path, "w") as f:
                 json.dump(doc, f)

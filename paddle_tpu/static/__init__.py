@@ -32,13 +32,14 @@ from . import nn  # noqa: F401
 
 
 def __getattr__(name):
-    # PEP 562 lazy submodule: the analysis package (6 modules) loads on first
-    # use, not at `import paddle_tpu` time
-    if name == "analysis":
+    # PEP 562 lazy submodules: the analysis package (6 modules) and the
+    # concurrency analyzer (PT-RACE, pure-ast) load on first use, not at
+    # `import paddle_tpu` time
+    if name in ("analysis", "concurrency"):
         import importlib
 
-        mod = importlib.import_module(".analysis", __name__)
-        globals()["analysis"] = mod
+        mod = importlib.import_module("." + name, __name__)
+        globals()[name] = mod
         return mod
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
@@ -47,7 +48,7 @@ __all__ = [
     "program_guard", "default_main_program", "default_startup_program",
     "data", "CompiledProgram", "BuildStrategy", "ExecutionStrategy",
     "append_backward", "name_scope", "PassManager", "apply_default_passes",
-    "nn", "analysis",
+    "nn", "analysis", "concurrency",
 ]
 
 

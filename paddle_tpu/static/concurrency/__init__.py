@@ -1,0 +1,116 @@
+"""paddle_tpu.static.concurrency — PT-RACE: whole-package static
+concurrency analysis for the threaded host stack.
+
+PR 1 gave the DEVICE graph a lint layer (``static/analysis`` +
+``tools/lint_graph.py``); this package is the same idea for the HOST side —
+the ~15 thread entry points and ~12 locks that keep production serving
+alive (supervisor step watchdogs, fleet ``parallel_step``, metrics/HTTP
+server threads, heartbeat loops, async checkpoint writers, rpc handler
+pools). It is pure ``ast``: analyzing a module never imports it, never
+touches jax, and sweeps the whole package in well under a second.
+
+Pipeline (one module at a time):
+
+1. :func:`~paddle_tpu.static.concurrency.thread_model.build_module_model`
+   — discover thread entry points (``threading.Thread``, executor
+   ``submit``, ``atexit``, socketserver/http handler classes, plus
+   caller-supplied cross-module roots), propagate thread roles through
+   the intra-module call graph, and track the held-lock set at every
+   state access (``with self._lock:`` nesting, ``acquire``/``release``,
+   caller-held inheritance for helpers only ever called under a lock).
+2. :func:`~paddle_tpu.static.concurrency.shared_state.infer_shared_state`
+   — state keys (instance attrs / module globals / closure vars) touched
+   from more than one thread role, with happens-before exclusions
+   (``__init__``, pre-``start()`` writes, join-after-spawn closures).
+3. :func:`~paddle_tpu.static.concurrency.checks.run_checks` — the
+   PT-RACE-001..005 rule catalogue (docs/STATIC_ANALYSIS.md), emitting
+   the same :class:`~paddle_tpu.static.analysis.diagnostics.Diagnostic`
+   objects the graph analyzers use, each with a stable line-number-free
+   ``finding_id`` for the lint gate's reviewed baseline file.
+
+CI gate: ``tools/lint_concurrency.py`` (whole-package sweep + seeded
+defect ``--selftest``), registered in tests/test_ci_gates.py beside
+lint_graph / fault_drill / scrape_metrics.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from ..analysis.diagnostics import AnalysisReport, Diagnostic, Severity
+from .checks import finding_id, run_checks
+from .shared_state import SharedKey, infer_shared_state
+from .thread_model import (MAIN_ROLE, Access, ModuleModel, Spawn,
+                           build_module_model)
+
+__all__ = [
+    "analyze_source", "analyze_file", "analyze_paths",
+    "build_module_model", "infer_shared_state", "run_checks",
+    "finding_id", "ModuleModel", "SharedKey",
+]
+
+
+def analyze_source(source: str, relpath: str = "<string>",
+                   extra_roots: Sequence[str] = (),
+                   suppress: Sequence[str] = ()) -> AnalysisReport:
+    """Analyze one module's source text; returns an
+    :class:`~paddle_tpu.static.analysis.diagnostics.AnalysisReport`."""
+    model = build_module_model(source, relpath, extra_roots=extra_roots)
+    findings = [d for d in run_checks(model)
+                if d.code not in set(suppress)]
+    return AnalysisReport(findings)
+
+
+def analyze_file(path: str, relpath: Optional[str] = None,
+                 extra_roots: Sequence[str] = (),
+                 suppress: Sequence[str] = ()) -> AnalysisReport:
+    with open(path, "r", encoding="utf-8") as f:
+        src = f.read()
+    return analyze_source(src, relpath or path, extra_roots=extra_roots,
+                          suppress=suppress)
+
+
+def _iter_py_files(root: str) -> Iterable[str]:
+    if os.path.isfile(root):
+        yield root
+        return
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d not in ("__pycache__",))
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                yield os.path.join(dirpath, name)
+
+
+def analyze_paths(paths: Sequence[str], base: Optional[str] = None,
+                  thread_roots: Optional[Dict[str, Sequence[str]]] = None,
+                  suppress: Sequence[str] = ()
+                  ) -> Tuple[AnalysisReport, List[str]]:
+    """Whole-package sweep: analyze every ``.py`` under ``paths``.
+
+    ``thread_roots`` maps a base-relative path to extra thread-root
+    qualname patterns for that module (cross-module thread entries the
+    per-module AST cannot see). Returns ``(report, analyzed_relpaths)``.
+    """
+    report = AnalysisReport()
+    analyzed: List[str] = []
+    roots = thread_roots or {}
+    for p in paths:
+        for path in _iter_py_files(p):
+            rel = (os.path.relpath(path, base) if base else path)
+            rel = rel.replace(os.sep, "/")
+            try:
+                report.extend(analyze_file(
+                    path, relpath=rel,
+                    extra_roots=roots.get(rel, ()), suppress=suppress))
+            except SyntaxError as e:
+                d = Diagnostic(code="PT-RACE-000", severity=Severity.ERROR,
+                               message=f"module failed to parse: {e}",
+                               source=f"{rel}:{getattr(e, 'lineno', 0)}",
+                               analyzer="concurrency")
+                d.finding_id = finding_id("PT-RACE-000", rel, "<module>",
+                                          "syntax")
+                report.extend([d])
+            analyzed.append(rel)
+    return report, analyzed

@@ -1,0 +1,248 @@
+"""PT-RACE lock-discipline checks over the thread model + shared state.
+
+=========== ============================================================
+PT-RACE-001 unguarded write to shared state — no lock anywhere on the key
+PT-RACE-002 inconsistent guarding — same key sometimes under a lock,
+            sometimes not (error for an unguarded WRITE, warning for an
+            unguarded read while writes are locked)
+PT-RACE-003 lock-order inversion — a cycle in the lock-acquisition graph
+            (includes re-acquiring a non-reentrant ``Lock``)
+PT-RACE-004 check-then-act outside the guarding lock — an ``if``/``while``
+            test reads a guarded shared key without its lock and the suite
+            then mutates it (decision made on stale state)
+PT-RACE-005 leaked thread — a non-daemon ``Thread`` that can never be
+            joined (fire-and-forget ``.start()`` chain, or a module with
+            no join at all)
+=========== ============================================================
+
+Findings are ordinary :class:`~paddle_tpu.static.analysis.diagnostics.
+Diagnostic` objects (severity + ``file:line`` provenance) so they compose
+with the existing report machinery; each additionally carries a stable
+``finding_id`` (``CODE:relpath:scope:detail`` — line-number free) that the
+lint gate's baseline file keys on (docs/STATIC_ANALYSIS.md).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..analysis.diagnostics import Diagnostic, Severity
+from .shared_state import SharedKey, infer_shared_state
+from .thread_model import MAIN_ROLE, ModuleModel
+
+__all__ = ["run_checks", "finding_id"]
+
+ANALYZER = "concurrency"
+
+
+def finding_id(code: str, relpath: str, scope: str, detail: str) -> str:
+    return f"{code}:{relpath}:{scope}:{detail}"
+
+
+def _diag(code: str, severity, message: str, relpath: str, lineno: int,
+          scope: str, detail: str) -> Diagnostic:
+    d = Diagnostic(code=code, severity=Severity(severity), message=message,
+                   source=f"{relpath}:{lineno}", analyzer=ANALYZER)
+    d.finding_id = finding_id(code, relpath, scope, detail)
+    return d
+
+
+def _scope_of(key: str) -> str:
+    """Baseline scope for a state key: the owning class (``A:`` keys) or
+    the module level (``G:``/``L:`` keys)."""
+    kind, _, rest = key.partition(":")
+    if kind == "A":
+        return rest.rsplit(".", 1)[0]
+    if kind == "L":
+        return rest.rsplit(".", 1)[0]
+    return "<module>"
+
+
+def _site_list(accesses, limit=3) -> str:
+    sites = []
+    for a in accesses[:limit]:
+        sites.append(f"{a.func}:{a.lineno}")
+    more = len(accesses) - limit
+    return ", ".join(sites) + (f" (+{more} more)" if more > 0 else "")
+
+
+def _role_list(roles: Set[str]) -> str:
+    return "/".join(sorted(roles))
+
+
+# ---------------------------------------------------------------------------
+# PT-RACE-001 / 002: guarding discipline
+# ---------------------------------------------------------------------------
+
+def _check_guarding(model: ModuleModel,
+                    shared: Dict[str, SharedKey]) -> List[Diagnostic]:
+    out: List[Diagnostic] = []
+    rel = model.relpath
+    for key, sk in sorted(shared.items()):
+        scope = _scope_of(key)
+        if sk.fully_unguarded:
+            w = sk.unguarded_writes[0]
+            out.append(_diag(
+                "PT-RACE-001", Severity.ERROR,
+                f"'{sk.name}' is written from {_role_list(sk.roles)} with "
+                f"no lock anywhere (writes at {_site_list(sk.writes)}; "
+                f"touched by {', '.join(sk.funcs()[:4])})",
+                rel, w.lineno, scope, sk.name))
+            continue
+        if sk.unguarded_writes:
+            w = sk.unguarded_writes[0]
+            locks = "/".join(sorted(sk.guards))
+            out.append(_diag(
+                "PT-RACE-002", Severity.ERROR,
+                f"'{sk.name}' is guarded by {locks} elsewhere but written "
+                f"WITHOUT it at {_site_list(sk.unguarded_writes)} "
+                f"(roles: {_role_list(sk.roles)})",
+                rel, w.lineno, scope, sk.name))
+        elif sk.unguarded_reads:
+            r = sk.unguarded_reads[0]
+            locks = "/".join(sorted(sk.guards))
+            out.append(_diag(
+                "PT-RACE-002", Severity.WARNING,
+                f"'{sk.name}' writes are guarded by {locks} but it is read "
+                f"WITHOUT the lock at {_site_list(sk.unguarded_reads)} — "
+                "torn/stale read",
+                rel, r.lineno, scope, sk.name))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# PT-RACE-003: lock-order inversion
+# ---------------------------------------------------------------------------
+
+def _check_lock_order(model: ModuleModel) -> List[Diagnostic]:
+    edges: Dict[str, Set[str]] = {}
+    sites: Dict[Tuple[str, str], Tuple[str, int]] = {}
+    self_reacquire: List = []
+    for info in model.funcs.values():
+        for acq in info.acquires:
+            for held in acq.held:
+                if held == acq.lock:
+                    if not acq.reentrant:
+                        self_reacquire.append(acq)
+                    continue
+                edges.setdefault(held, set()).add(acq.lock)
+                sites.setdefault((held, acq.lock), (acq.func, acq.lineno))
+    out: List[Diagnostic] = []
+    rel = model.relpath
+    for acq in self_reacquire:
+        out.append(_diag(
+            "PT-RACE-003", Severity.ERROR,
+            f"non-reentrant lock {acq.lock} re-acquired while already held "
+            f"in {acq.func} — self-deadlock",
+            rel, acq.lineno, acq.func.split(".")[0], f"{acq.lock}-self"))
+    # cycle detection: DFS from each node (graphs here are tiny)
+    seen_cycles: Set[frozenset] = set()
+    for start in sorted(edges):
+        stack = [(start, [start])]
+        while stack:
+            node, path = stack.pop()
+            for nxt in sorted(edges.get(node, ())):
+                if nxt == start and len(path) > 1:
+                    cyc = frozenset(path)
+                    if cyc in seen_cycles:
+                        continue
+                    seen_cycles.add(cyc)
+                    order = path + [start]
+                    func, lineno = sites.get((path[-1], start),
+                                             ("<module>", 0))
+                    where = " -> ".join(order)
+                    out.append(_diag(
+                        "PT-RACE-003", Severity.ERROR,
+                        f"lock-order inversion: {where} (closing edge in "
+                        f"{func}) — concurrent holders can deadlock",
+                        rel, lineno, "<module>",
+                        "->".join(sorted(cyc))))
+                elif nxt not in path:
+                    stack.append((nxt, path + [nxt]))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# PT-RACE-004: check-then-act outside the guarding lock
+# ---------------------------------------------------------------------------
+
+def _check_toctou(model: ModuleModel,
+                  shared: Dict[str, SharedKey]) -> List[Diagnostic]:
+    out: List[Diagnostic] = []
+    rel = model.relpath
+    # direct write keys per function (for one-level call-through bodies)
+    writes_of: Dict[str, Set[str]] = {}
+    for q, info in model.funcs.items():
+        writes_of[q] = {a.key for a in info.accesses if a.kind == "write"}
+    reported: Set[str] = set()
+    for info in model.funcs.values():
+        for t in info.toctous:
+            body_writes = set(t.body_writes)
+            for callee in t.body_callees:
+                body_writes |= writes_of.get(callee, set())
+            for key, test_locks in t.test_reads:
+                sk = shared.get(key)
+                if sk is None or not sk.guards:
+                    continue                  # 001 territory (or unshared)
+                if sk.guards & test_locks:
+                    continue                  # test holds a guarding lock
+                if key not in body_writes:
+                    continue
+                fid = finding_id("PT-RACE-004", rel, _scope_of(key), sk.name)
+                if fid in reported:
+                    continue
+                reported.add(fid)
+                locks = "/".join(sorted(sk.guards))
+                out.append(_diag(
+                    "PT-RACE-004", Severity.ERROR,
+                    f"check-then-act on '{sk.name}' in {t.func}: the test "
+                    f"reads it outside {locks} and the suite then mutates "
+                    "it — the decision can be stale by the time it acts",
+                    rel, t.lineno, _scope_of(key), sk.name))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# PT-RACE-005: leaked / unjoinable threads
+# ---------------------------------------------------------------------------
+
+def _check_thread_leaks(model: ModuleModel) -> List[Diagnostic]:
+    out: List[Diagnostic] = []
+    rel = model.relpath
+    for sp in model.spawns:
+        if sp.kind != "thread" or sp.daemon:
+            continue
+        detail = sp.target or sp.target_text
+        if sp.chained_start:
+            out.append(_diag(
+                "PT-RACE-005", Severity.ERROR,
+                f"non-daemon Thread(target={sp.target_text}) is started "
+                f"without binding it ({sp.func}) — it can never be joined "
+                "and will block interpreter exit",
+                rel, sp.lineno, sp.func, detail))
+        elif not model.has_thread_join:
+            out.append(_diag(
+                "PT-RACE-005", Severity.ERROR,
+                f"non-daemon Thread(target={sp.target_text}) started in "
+                f"{sp.func} but nothing in this module ever joins a "
+                "thread — leaked thread blocks interpreter exit",
+                rel, sp.lineno, sp.func, detail))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# entry
+# ---------------------------------------------------------------------------
+
+def run_checks(model: ModuleModel,
+               shared: Optional[Dict[str, SharedKey]] = None
+               ) -> List[Diagnostic]:
+    """All PT-RACE rules over one module model, ordered by rule then line."""
+    if shared is None:
+        shared = infer_shared_state(model)
+    findings: List[Diagnostic] = []
+    findings += _check_guarding(model, shared)
+    findings += _check_lock_order(model)
+    findings += _check_toctou(model, shared)
+    findings += _check_thread_leaks(model)
+    return findings

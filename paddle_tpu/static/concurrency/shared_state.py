@@ -1,0 +1,105 @@
+"""Shared-state inference over a module's thread model.
+
+A state key (instance attribute / module global / closure variable —
+``thread_model.Access.key``) is **shared** when some access to it happens
+on a thread role and the union of roles across all its accesses is not a
+single role — i.e. two different threads, or a thread and the main path,
+can touch it concurrently. A function carrying both ``main`` and a thread
+role (a helper called from a daemon loop *and* from public methods) makes
+everything it touches shared by itself: it races with its own other
+incarnation.
+
+Happens-before exclusions applied here (the model records them):
+
+- ``__init__`` accesses (object unpublished);
+- ``prestart`` writes (lexically before the ``.start()`` in the spawning
+  function — thread start is a synchronization edge);
+- closure variables whose spawning function joins the worker after the
+  spawn (reads after ``join`` are happens-after; the model keeps the
+  key only when no such join exists).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Set
+
+from .thread_model import MAIN_ROLE, Access, ModuleModel
+
+__all__ = ["SharedKey", "infer_shared_state"]
+
+
+@dataclasses.dataclass
+class SharedKey:
+    """One shared-state candidate with its guard summary."""
+
+    key: str
+    accesses: List[Access]
+    roles: Set[str]                  # union of roles across accesses
+    guards: Set[str]                 # locks seen on >=1 guarded access
+    writes: List[Access]
+    unguarded_writes: List[Access]
+    unguarded_reads: List[Access]
+
+    @property
+    def name(self) -> str:
+        """Human-facing name: strip the key-space prefix."""
+        return self.key.split(":", 1)[1]
+
+    @property
+    def fully_unguarded(self) -> bool:
+        return not self.guards
+
+    def funcs(self) -> List[str]:
+        seen: List[str] = []
+        for a in self.accesses:
+            if a.func not in seen:
+                seen.append(a.func)
+        return seen
+
+
+def _relevant(a: Access) -> bool:
+    return not a.in_init and not a.prestart
+
+
+def infer_shared_state(model: ModuleModel) -> Dict[str, SharedKey]:
+    """Group accesses by key, decide sharedness, summarize guards."""
+    by_key: Dict[str, List[Access]] = {}
+    roles_of_func = {q: f.roles for q, f in model.funcs.items()}
+    for info in model.funcs.values():
+        for a in info.accesses:
+            by_key.setdefault(a.key, []).append(a)
+
+    out: Dict[str, SharedKey] = {}
+    for key, accesses in by_key.items():
+        live = [a for a in accesses if _relevant(a)]
+        if not live:
+            continue
+        roles: Set[str] = set()
+        for a in live:
+            roles |= roles_of_func.get(a.func, {MAIN_ROLE})
+        thread_roles = {r for r in roles if r != MAIN_ROLE}
+        if not thread_roles or len(roles) < 2:
+            continue                      # single-role: no concurrency
+        if key.startswith("L:"):
+            # closure var: the spawning function joining the worker after
+            # the spawn makes later reads happens-after — not shared
+            owner = key[2:].rsplit(".", 1)[0]
+            oinfo = model.funcs.get(owner)
+            if oinfo is not None and oinfo.join_after is not None:
+                continue
+        writes = [a for a in live if a.kind == "write"]
+        if not writes:
+            # read-only after publication (``__init__``/prestart writes
+            # are happens-before the spawn): immutable enough
+            continue
+        guards: Set[str] = set()
+        for a in live:
+            guards |= set(a.locks)
+        out[key] = SharedKey(
+            key=key, accesses=live, roles=roles, guards=guards,
+            writes=writes,
+            unguarded_writes=[a for a in writes if not a.locks],
+            unguarded_reads=[a for a in live
+                             if a.kind == "read" and not a.locks])
+    return out

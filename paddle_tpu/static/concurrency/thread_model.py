@@ -1,0 +1,910 @@
+"""Thread-model builder: which code runs on which thread, per module.
+
+The graph analyzers (``static/analysis``) see the device program; this layer
+sees the HOST program — the threaded Python that keeps serving alive
+(journaled supervisors, step watchdogs, metrics servers, heartbeat loops,
+async checkpoint writers). Everything here is pure ``ast``: no imports of
+the analyzed code, no jax, so the whole package sweeps in well under a
+second and the lint gate (tools/lint_concurrency.py) costs CI nothing.
+
+The model answers three questions for one module:
+
+1. **Where do threads start?** ``threading.Thread(target=...)``,
+   ``ThreadPoolExecutor.submit(fn, ...)``, ``atexit.register(fn)``,
+   ``socketserver``/``http.server`` handler classes (their methods run on
+   per-connection server threads), plus caller-supplied *extra roots* for
+   entry points that cross module boundaries (e.g. ``retry_call`` running
+   on a fleet ``parallel_step`` thread — the gate's ``THREAD_ROOTS``).
+2. **What runs on those threads?** Roles propagate through the intra-module
+   call graph: a spawn target seeds ``thread:<entry>``; every function a
+   thread-role function calls inherits the role. Every function that is
+   not *exclusively* a thread target also carries ``main`` (it is callable
+   from the main path), so a helper invoked from both a daemon loop and a
+   public method carries both roles — exactly the functions whose state
+   accesses can race.
+3. **Which locks guard what?** ``self.X = threading.Lock()/RLock()/
+   Condition()/Semaphore()`` and module-level equivalents are recognized as
+   locks; ``with self.X:`` (and ``.acquire()``/``.release()``) tracks the
+   held-lock set at every state access and every nested acquisition (the
+   raw material for the lock-order graph). Locks are keyed by the ROOT
+   in-module base class that the attribute belongs to, so ``Counter`` and
+   ``Histogram`` sharing ``_Instrument._lock`` unify.
+
+Happens-before edges the model understands (and therefore does not flag):
+
+- ``__init__`` writes — the object is not published yet;
+- writes lexically before a ``.start()`` call in the function that spawns
+  the thread (``prestart`` — thread start is a synchronization edge);
+- closure variables written by a worker and read only after the spawning
+  function ``join``\\ s it.
+
+See docs/STATIC_ANALYSIS.md (PT-RACE section) for the rule catalogue built
+on top of this model (shared_state.py + checks.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+__all__ = ["Access", "Acquire", "Spawn", "FuncInfo", "ModuleModel",
+           "build_module_model", "MAIN_ROLE"]
+
+MAIN_ROLE = "main"
+
+#: threading factories that produce a lock-like object (Condition counts:
+#: ``with cond:`` owns the underlying lock)
+LOCK_FACTORIES = ("Lock", "RLock", "Condition", "Semaphore",
+                  "BoundedSemaphore")
+
+#: socketserver / http.server bases whose subclasses' methods run on
+#: per-connection server threads (ThreadingTCPServer / ThreadingHTTPServer)
+HANDLER_BASES = ("BaseRequestHandler", "StreamRequestHandler",
+                 "DatagramRequestHandler", "BaseHTTPRequestHandler",
+                 "SimpleHTTPRequestHandler", "CGIHTTPRequestHandler")
+
+#: method names that mutate their receiver — ``self.attr.append(x)`` is a
+#: WRITE to ``attr`` for lock-discipline purposes
+MUTATOR_METHODS = frozenset({
+    "append", "appendleft", "extend", "extendleft", "insert", "remove",
+    "pop", "popleft", "popitem", "clear", "update", "add", "discard",
+    "setdefault", "sort", "reverse", "rotate", "put", "put_nowait",
+})
+
+#: lock-object methods (never state accesses)
+LOCK_METHODS = frozenset({"acquire", "release", "wait", "wait_for",
+                          "notify", "notify_all", "locked"})
+
+
+@dataclasses.dataclass
+class Access:
+    """One read/write of a shared-state candidate.
+
+    ``key`` forms: ``"A:<RootClass>.<attr>"`` (instance attribute),
+    ``"G:<name>"`` (module global), ``"L:<func>.<var>"`` (closure var of
+    ``func`` touched by a nested worker)."""
+
+    key: str
+    kind: str                    # "read" | "write"
+    func: str                    # qualname of the accessing function
+    lineno: int
+    locks: frozenset             # lock keys held (syntactic + caller-held)
+    in_init: bool = False
+    prestart: bool = False
+
+
+@dataclasses.dataclass
+class Acquire:
+    lock: str
+    held: frozenset              # locks already held at this acquisition
+    func: str
+    lineno: int
+    reentrant: bool = False      # RLock/Condition/Semaphore
+
+
+@dataclasses.dataclass
+class Spawn:
+    kind: str                    # "thread" | "pool" | "atexit" | "handler"
+    target: Optional[str]        # resolved in-module qualname (or None)
+    target_text: str             # source text of the target expr (reports)
+    daemon: bool
+    chained_start: bool          # Thread(...).start() — can never be joined
+    func: str                    # spawning function ("<module>" at top level)
+    lineno: int
+
+
+@dataclasses.dataclass
+class Toctou:
+    """An if/while whose test reads shared state — evaluated by checks.py
+    once guard sets are known (PT-RACE-004)."""
+
+    func: str
+    lineno: int
+    test_reads: List[Tuple[str, frozenset]]     # (key, locks at test)
+    body_writes: List[str]                      # keys written in the suite
+    body_callees: List[str]                     # self-calls inside the suite
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    qualname: str
+    cls: Optional[str]           # OWN class name (None for module funcs)
+    root_cls: Optional[str]      # root in-module base (attr/lock key space)
+    node: ast.AST
+    parent: Optional[str]        # enclosing function qualname (nested defs)
+    is_target: bool = False      # referenced as a spawn target
+    roles: Set[str] = dataclasses.field(default_factory=set)
+    accesses: List[Access] = dataclasses.field(default_factory=list)
+    acquires: List[Acquire] = dataclasses.field(default_factory=list)
+    calls: List[Tuple[str, frozenset, int]] = dataclasses.field(
+        default_factory=list)   # (callee qualname, locks held at site, line)
+    local_names: Set[str] = dataclasses.field(default_factory=set)
+    toctous: List[Toctou] = dataclasses.field(default_factory=list)
+    spawn_lines: List[int] = dataclasses.field(default_factory=list)
+    #: happens-before boundary for this function's spawns: the first
+    #: ``.start()`` after a Thread construction (falling back to the
+    #: construction itself) — writes lexically before it are pre-publication
+    prestart_line: Optional[int] = None
+    join_after: Optional[int] = None   # first .join() lineno after a spawn
+
+
+class ModuleModel:
+    """Everything the checks need to know about one module."""
+
+    def __init__(self, relpath: str):
+        self.relpath = relpath
+        self.funcs: Dict[str, FuncInfo] = {}
+        self.classes: Dict[str, List[str]] = {}       # name -> base names
+        self.class_methods: Dict[str, Set[str]] = {}  # name -> method names
+        self.lock_attrs: Dict[str, Dict[str, str]] = {}  # root cls -> {attr: factory}
+        self.module_locks: Dict[str, str] = {}           # name -> factory
+        self.mutable_globals: Set[str] = set()
+        self.spawns: List[Spawn] = []
+        self.has_thread_join: bool = False
+
+    # -- class/key helpers -------------------------------------------------
+    def root_class(self, name: Optional[str]) -> Optional[str]:
+        """Walk the in-module base chain to the top — the namespace
+        instance attributes and locks are keyed under (``Counter`` and
+        ``Histogram`` both key under ``_Instrument``)."""
+        if name is None:
+            return None
+        seen = set()
+        cur = name
+        while cur in self.classes and cur not in seen:
+            seen.add(cur)
+            nxt = next((b for b in self.classes[cur] if b in self.classes),
+                       None)
+            if nxt is None:
+                break
+            cur = nxt
+        return cur
+
+    def is_lock_attr(self, root_cls: Optional[str], attr: str) -> bool:
+        return attr in self.lock_attrs.get(root_cls or "", {})
+
+    def lock_factory(self, key: str) -> str:
+        if key.startswith("M:"):
+            return self.module_locks.get(key[2:], "Lock")
+        cls, _, attr = key.partition(".")
+        return self.lock_attrs.get(cls, {}).get(attr, "Lock")
+
+    def methods_of(self, cls: str) -> Set[str]:
+        """Method names visible on ``cls`` through the in-module MRO."""
+        out: Set[str] = set()
+        seen = set()
+        cur: Optional[str] = cls
+        while cur in self.classes and cur not in seen:
+            seen.add(cur)
+            out |= self.class_methods.get(cur, set())
+            cur = next((b for b in self.classes[cur] if b in self.classes),
+                       None)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# phase 1: module scan (classes, locks, globals, import aliases)
+# ---------------------------------------------------------------------------
+
+def _call_name(node: ast.AST) -> Optional[str]:
+    """Dotted name of a call's func: ``threading.Thread`` -> that string,
+    bare ``Thread`` -> ``"Thread"``; anything else -> None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _resolve_dotted(name: Optional[str],
+                    aliases: Dict[str, str]) -> Optional[str]:
+    """Resolve a dotted call name through the module's import aliases:
+    ``ax.register`` -> ``atexit.register`` (``import atexit as ax``),
+    ``register`` -> ``atexit.register`` (``from atexit import register``),
+    ``_threading.Thread`` -> ``threading.Thread``."""
+    if name is None:
+        return None
+    head, sep, rest = name.partition(".")
+    head = aliases.get(head, head)
+    return head + sep + rest
+
+
+def _is_thread_ctor(full: Optional[str]) -> bool:
+    return bool(full) and full.rsplit(".", 1)[-1] == "Thread"
+
+
+def _lock_factory_of(expr: ast.AST, aliases: Dict[str, str]) -> Optional[str]:
+    """Return the factory name (``Lock``/``RLock``/...) if ``expr``
+    constructs a threading lock — including guarded forms like
+    ``lock or threading.Lock()`` and ``X if c else threading.Lock()``."""
+    if isinstance(expr, ast.Call):
+        full = _resolve_dotted(_call_name(expr.func), aliases)
+        if full is None:
+            return None
+        last = full.rsplit(".", 1)[-1]
+        if last in LOCK_FACTORIES and (full == last
+                                       or full.startswith("threading.")
+                                       or full.startswith("multiprocessing.")):
+            return last
+        return None
+    if isinstance(expr, ast.BoolOp):
+        for v in expr.values:
+            f = _lock_factory_of(v, aliases)
+            if f:
+                return f
+    if isinstance(expr, ast.IfExp):
+        for v in (expr.body, expr.orelse):
+            f = _lock_factory_of(v, aliases)
+            if f:
+                return f
+    return None
+
+
+def _is_mutable_literal(expr: ast.AST) -> bool:
+    return isinstance(expr, (ast.Dict, ast.List, ast.Set, ast.DictComp,
+                             ast.ListComp, ast.SetComp)) or (
+        isinstance(expr, ast.Call)
+        and _call_name(expr.func) in ("dict", "list", "set", "collections.deque",
+                                      "deque", "defaultdict",
+                                      "collections.defaultdict",
+                                      "collections.OrderedDict",
+                                      "OrderedDict"))
+
+
+class _Phase1(ast.NodeVisitor):
+    def __init__(self, model: ModuleModel):
+        self.m = model
+        self.aliases: Dict[str, str] = {}   # imported-name -> canonical
+        self._cls_stack: List[str] = []
+        self._func_depth = 0                # module-global detection only
+        #                                     applies at depth 0
+
+    def visit_FunctionDef(self, node):
+        self._func_depth += 1
+        self.generic_visit(node)
+        self._func_depth -= 1
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Import(self, node):
+        for a in node.names:
+            self.aliases[a.asname or a.name] = a.name
+
+    def visit_ImportFrom(self, node):
+        for a in node.names:
+            # keep the module qualifier so `from atexit import register`
+            # resolves to "atexit.register", not a bare "register"
+            self.aliases[a.asname or a.name] = (
+                f"{node.module}.{a.name}" if node.module else a.name)
+
+    def visit_ClassDef(self, node):
+        bases = []
+        for b in node.bases:
+            name = _call_name(b)
+            if name:
+                bases.append(name.rsplit(".", 1)[-1])
+        self.m.classes[node.name] = bases
+        self.m.class_methods[node.name] = {
+            n.name for n in node.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        self._cls_stack.append(node.name)
+        self.generic_visit(node)
+        self._cls_stack.pop()
+
+    def visit_Assign(self, node):
+        factory = _lock_factory_of(node.value, self.aliases)
+        for t in node.targets:
+            if isinstance(t, ast.Name) and not self._cls_stack \
+                    and not self._func_depth:
+                if factory:
+                    self.m.module_locks[t.id] = factory
+                elif _is_mutable_literal(node.value):
+                    self.m.mutable_globals.add(t.id)
+            elif (isinstance(t, ast.Attribute)
+                  and isinstance(t.value, ast.Name) and t.value.id == "self"
+                  and factory and self._cls_stack):
+                root = self.m.root_class(self._cls_stack[-1])
+                self.m.lock_attrs.setdefault(root, {})[t.attr] = factory
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):
+        if node.value is None:
+            return
+        factory = _lock_factory_of(node.value, self.aliases)
+        t = node.target
+        if isinstance(t, ast.Name) and not self._cls_stack \
+                and not self._func_depth:
+            if factory:
+                self.m.module_locks[t.id] = factory
+            elif _is_mutable_literal(node.value):
+                self.m.mutable_globals.add(t.id)
+        elif (isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name)
+              and t.value.id == "self" and factory and self._cls_stack):
+            root = self.m.root_class(self._cls_stack[-1])
+            self.m.lock_attrs.setdefault(root, {})[t.attr] = factory
+        self.generic_visit(node)
+
+
+# ---------------------------------------------------------------------------
+# phase 2: per-function body walk (accesses, locks, calls, spawns)
+# ---------------------------------------------------------------------------
+
+def _looks_like_thread_join(call: ast.Call) -> bool:
+    """``x.join()`` / ``x.join(2.0)`` / ``x.join(timeout=...)`` — excludes
+    ``",".join(parts)`` / ``os.path.join(a, b)`` by receiver/arg shape."""
+    f = call.func
+    if not isinstance(f, ast.Attribute) or f.attr != "join":
+        return False
+    if isinstance(f.value, ast.Constant):       # "sep".join(...)
+        return False
+    name = _call_name(f)
+    if name and (name.startswith("os.path.") or name.startswith("posixpath.")
+                 or name.startswith("ntpath.")):
+        return False
+    if len(call.args) > 1:
+        return False
+    if call.args and not (isinstance(call.args[0], ast.Constant)
+                          and isinstance(call.args[0].value, (int, float))):
+        # a positional arg must be a literal timeout — ``sep.join(parts)``
+        # style string joins pass a non-numeric value here
+        return False
+    if any(kw.arg != "timeout" for kw in call.keywords):
+        return False
+    return True
+
+
+class _FuncWalker:
+    """Walks ONE function body, linearly per block, tracking held locks."""
+
+    def __init__(self, model: ModuleModel, info: FuncInfo,
+                 aliases: Dict[str, str],
+                 enclosing_locals: Set[str]):
+        self.m = model
+        self.info = info
+        self.aliases = aliases
+        self.enclosing_locals = enclosing_locals
+        self.global_decls: Set[str] = set()
+        self.sticky: Set[str] = set()          # .acquire()'d, not released
+        self._nested_defs: Set[str] = set()
+
+    # -- naming -------------------------------------------------------------
+    def _lock_key_of(self, expr: ast.AST) -> Optional[str]:
+        """Lock key for a ``with X:`` context or ``X.acquire()`` receiver."""
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and expr.value.id == "self":
+            root = self.info.root_cls
+            if self.m.is_lock_attr(root, expr.attr):
+                return f"{root}.{expr.attr}"
+            return None
+        if isinstance(expr, ast.Name) and expr.id in self.m.module_locks:
+            return f"M:{expr.id}"
+        return None
+
+    def _attr_key(self, attr: str) -> str:
+        return f"A:{self.info.root_cls}.{attr}"
+
+    def _resolve_call(self, func_expr: ast.AST) -> Optional[str]:
+        """Resolve an in-module callee qualname for role/guard propagation."""
+        if isinstance(func_expr, ast.Attribute) and \
+                isinstance(func_expr.value, ast.Name) and \
+                func_expr.value.id == "self" and self.info.cls:
+            if func_expr.attr in self.m.methods_of(self.info.cls):
+                # record against the class that DEFINES it (walk MRO)
+                cur = self.info.cls
+                while cur in self.m.classes:
+                    if func_expr.attr in self.m.class_methods.get(cur, ()):
+                        return f"{cur}.{func_expr.attr}"
+                    cur = next((b for b in self.m.classes[cur]
+                                if b in self.m.classes), None)
+                    if cur is None:
+                        break
+                return f"{self.info.cls}.{func_expr.attr}"
+        if isinstance(func_expr, ast.Name):
+            name = func_expr.id
+            nested = f"{self.info.qualname}.<locals>.{name}"
+            if nested in self.m.funcs or nested in self._nested_defs:
+                return nested
+            if name in self.m.funcs:
+                return name
+        return None
+
+    def _resolve_target(self, expr: ast.AST) -> Optional[str]:
+        """Resolve a callable EXPRESSION (thread target, submit arg)."""
+        return self._resolve_call(expr)
+
+    # -- access recording ----------------------------------------------------
+    def _rec(self, key: str, kind: str, lineno: int, held: frozenset):
+        self.info.accesses.append(Access(
+            key=key, kind=kind, func=self.info.qualname, lineno=lineno,
+            locks=held, in_init=self.info.qualname.endswith(".__init__"),
+            prestart=(self.info.prestart_line is not None
+                      and lineno < self.info.prestart_line)))
+
+    # -- expressions ---------------------------------------------------------
+    def expr(self, node: ast.AST, held: frozenset) -> None:
+        """Collect accesses/calls/spawns from one expression tree."""
+        if node is None:
+            return
+        if isinstance(node, ast.Call):
+            self._call(node, held)
+            return
+        if isinstance(node, ast.Attribute):
+            self._attribute(node, held)
+            return
+        if isinstance(node, ast.Subscript):
+            self._subscript(node, held)
+            return
+        if isinstance(node, ast.Name):
+            self._name(node, held)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            return                          # nested scopes handled elsewhere
+        for child in ast.iter_child_nodes(node):
+            self.expr(child, held)
+
+    def _attribute(self, node: ast.Attribute, held: frozenset) -> None:
+        if isinstance(node.value, ast.Name) and node.value.id == "self" \
+                and self.info.cls:
+            if self.m.is_lock_attr(self.info.root_cls, node.attr):
+                return                      # the lock object itself
+            kind = ("write" if isinstance(node.ctx, (ast.Store, ast.Del))
+                    else "read")
+            self._rec(self._attr_key(node.attr), kind, node.lineno, held)
+            return
+        self.expr(node.value, held)
+
+    def _subscript(self, node: ast.Subscript, held: frozenset) -> None:
+        base = node.value
+        store = isinstance(node.ctx, (ast.Store, ast.Del))
+        if isinstance(base, ast.Attribute) and \
+                isinstance(base.value, ast.Name) and base.value.id == "self" \
+                and self.info.cls:
+            self._rec(self._attr_key(base.attr),
+                      "write" if store else "read", node.lineno, held)
+        elif isinstance(base, ast.Name) and self._is_shared_name(base.id):
+            self._rec(self._name_key(base.id),
+                      "write" if store else "read", node.lineno, held)
+        else:
+            self.expr(base, held)
+        self.expr(node.slice, held)
+
+    def _is_shared_name(self, name: str) -> bool:
+        if name in ("self", "cls"):
+            return False            # attr accesses key under the class
+        if name in self.m.mutable_globals:
+            return True
+        return (self.info.parent is not None
+                and name in self.enclosing_locals
+                and name not in self.info.local_names)
+
+    def _name_key(self, name: str) -> str:
+        if name in self.m.mutable_globals:
+            return f"G:{name}"
+        return f"L:{self.info.parent}.{name}"
+
+    def _name(self, node: ast.Name, held: frozenset) -> None:
+        if isinstance(node.ctx, ast.Load):
+            if node.id in self.m.mutable_globals:
+                self._rec(f"G:{node.id}", "read", node.lineno, held)
+        elif isinstance(node.ctx, (ast.Store, ast.Del)):
+            if node.id in self.global_decls and \
+                    node.id in self.m.mutable_globals:
+                self._rec(f"G:{node.id}", "write", node.lineno, held)
+
+    def _call(self, node: ast.Call, held: frozenset) -> None:
+        f = node.func
+        handled_func = False
+        # threading.Thread(...) — spawn (alias-aware: `import threading as
+        # t`, `from threading import Thread` both resolve)
+        full = _resolve_dotted(_call_name(f), self.aliases)
+        if _is_thread_ctor(full):
+            self._spawn_thread(node, chained=False)
+            handled_func = True
+        elif isinstance(f, ast.Attribute) and f.attr == "start" and \
+                isinstance(f.value, ast.Call):
+            inner = _resolve_dotted(_call_name(f.value.func), self.aliases)
+            if _is_thread_ctor(inner):
+                self._spawn_thread(f.value, chained=True)
+                handled_func = True
+        # pool.submit(fn, ...) / atexit.register(fn, ...)
+        if isinstance(f, ast.Attribute) and f.attr == "submit" and node.args:
+            tgt = self._resolve_target(node.args[0])
+            self.m.spawns.append(Spawn(
+                "pool", tgt, ast.unparse(node.args[0]), daemon=True,
+                chained_start=False, func=self.info.qualname,
+                lineno=node.lineno))
+        if full == "atexit.register" and node.args:
+            tgt = self._resolve_target(node.args[0])
+            self.m.spawns.append(Spawn(
+                "atexit", tgt, ast.unparse(node.args[0]), daemon=True,
+                chained_start=False, func=self.info.qualname,
+                lineno=node.lineno))
+        # .join() bookkeeping (thread-leak + closure happens-after edges)
+        if _looks_like_thread_join(node):
+            self.m.has_thread_join = True
+            if self.info.spawn_lines and node.lineno > min(
+                    self.info.spawn_lines) and self.info.join_after is None:
+                self.info.join_after = node.lineno
+        # lock method calls / attr-method mutations / self-calls
+        if isinstance(f, ast.Attribute) and not handled_func:
+            recv = f.value
+            lock_key = self._lock_key_of(recv)
+            if lock_key is not None and f.attr in LOCK_METHODS:
+                if f.attr == "acquire":
+                    self.info.acquires.append(Acquire(
+                        lock_key, frozenset(held | self.sticky),
+                        self.info.qualname, node.lineno,
+                        reentrant=self.m.lock_factory(lock_key) != "Lock"))
+                    self.sticky.add(lock_key)
+                elif f.attr == "release":
+                    self.sticky.discard(lock_key)
+                handled_func = True
+            elif isinstance(recv, ast.Attribute) and \
+                    isinstance(recv.value, ast.Name) and \
+                    recv.value.id == "self" and self.info.cls and \
+                    not self.m.is_lock_attr(self.info.root_cls, recv.attr):
+                # self.A.m(...): mutation or read of attr A
+                kind = "write" if f.attr in MUTATOR_METHODS else "read"
+                self._rec(self._attr_key(recv.attr), kind, node.lineno,
+                          held)
+                handled_func = True
+            elif isinstance(recv, ast.Name) and \
+                    self._is_shared_name(recv.id):
+                kind = "write" if f.attr in MUTATOR_METHODS else "read"
+                self._rec(self._name_key(recv.id), kind, node.lineno, held)
+                handled_func = True
+            elif isinstance(recv, ast.Name) and recv.id == "self" and \
+                    self.info.cls:
+                callee = self._resolve_call(f)
+                if callee is not None:
+                    self.info.calls.append(
+                        (callee, frozenset(held | self.sticky), node.lineno))
+                else:
+                    self._rec(self._attr_key(f.attr), "read", node.lineno,
+                              held)
+                handled_func = True
+        elif isinstance(f, ast.Name) and not handled_func:
+            callee = self._resolve_call(f)
+            if callee is not None:
+                self.info.calls.append(
+                    (callee, frozenset(held | self.sticky), node.lineno))
+                handled_func = True
+        if not handled_func:
+            self.expr(f, held)
+        for a in node.args:
+            self.expr(a, held)
+        for kw in node.keywords:
+            self.expr(kw.value, held)
+
+    def _spawn_thread(self, call: ast.Call, chained: bool) -> None:
+        target = None
+        target_text = "?"
+        daemon = False
+        for kw in call.keywords:
+            if kw.arg == "target":
+                target = self._resolve_target(kw.value)
+                target_text = ast.unparse(kw.value)
+            elif kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+                daemon = bool(kw.value.value)
+        self.m.spawns.append(Spawn(
+            "thread", target, target_text, daemon=daemon,
+            chained_start=chained, func=self.info.qualname,
+            lineno=call.lineno))   # spawn_lines already filled by pre-scan
+
+    # -- statements ----------------------------------------------------------
+    def block(self, stmts: List[ast.stmt], held: frozenset) -> None:
+        for st in stmts:
+            self.stmt(st, held)
+
+    def stmt(self, node: ast.stmt, held: frozenset) -> None:
+        eff = frozenset(held | self.sticky)
+        if isinstance(node, ast.With):
+            new = set()
+            for item in node.items:
+                key = self._lock_key_of(item.context_expr)
+                if key is not None:
+                    self.info.acquires.append(Acquire(
+                        key, frozenset(eff | new), self.info.qualname,
+                        node.lineno,
+                        reentrant=self.m.lock_factory(key) != "Lock"))
+                    new.add(key)
+                else:
+                    self.expr(item.context_expr, eff)
+            self.block(node.body, frozenset(eff | new))
+        elif isinstance(node, (ast.If, ast.While)):
+            # TOCTOU candidate: remember what the test reads and what the
+            # suite writes; checks.py judges it once guard sets are known
+            pre = len(self.info.accesses)
+            self.expr(node.test, eff)
+            test_reads = [(a.key, a.locks) for a in self.info.accesses[pre:]
+                          if a.kind == "read"]
+            pre_body = len(self.info.accesses)
+            pre_calls = len(self.info.calls)
+            self.block(node.body, frozenset(held))
+            body_writes = [a.key for a in self.info.accesses[pre_body:]
+                           if a.kind == "write"]
+            body_callees = [c for c, _, _ in self.info.calls[pre_calls:]]
+            if test_reads:
+                self.info.toctous.append(Toctou(
+                    self.info.qualname, node.lineno, test_reads,
+                    body_writes, body_callees))
+            self.block(node.orelse, frozenset(held))
+        elif isinstance(node, ast.Try):
+            self.block(node.body, frozenset(held))
+            for h in node.handlers:
+                self.block(h.body, frozenset(held))
+            self.block(node.orelse, frozenset(held))
+            self.block(node.finalbody, frozenset(held))
+        elif isinstance(node, ast.For):
+            self.expr(node.iter, eff)
+            self.expr(node.target, eff)
+            self.block(node.body, frozenset(held))
+            self.block(node.orelse, frozenset(held))
+        elif isinstance(node, ast.Global):
+            self.global_decls.update(node.names)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._nested_defs.add(f"{self.info.qualname}.<locals>.{node.name}")
+        elif isinstance(node, ast.ClassDef):
+            pass                            # nested classes walked separately
+        elif isinstance(node, ast.Return):
+            self.expr(node.value, eff)
+        else:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.stmt):
+                    self.stmt(child, frozenset(held))
+                else:
+                    self.expr(child, eff)
+
+
+def _local_names(node) -> Set[str]:
+    """Names assigned anywhere in a function body (its locals), args
+    included — used to distinguish closure reads from true locals."""
+    names: Set[str] = set()
+    args = node.args
+    for a in (args.posonlyargs + args.args + args.kwonlyargs
+              + ([args.vararg] if args.vararg else [])
+              + ([args.kwarg] if args.kwarg else [])):
+        names.add(a.arg)
+    for sub in ast.walk(node):
+        if sub is not node and isinstance(
+                sub, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Store):
+            names.add(sub.id)
+        elif isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+            names.add(sub.name)
+    return names
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+def _walk_functions(model: ModuleModel, tree: ast.Module,
+                    aliases: Dict[str, str]) -> None:
+    """Register every function (any nesting) and walk its body."""
+
+    def register(node, qual, cls, parent, enclosing_locals):
+        root = model.root_class(cls)
+        info = FuncInfo(qualname=qual, cls=cls, root_cls=root, node=node,
+                        parent=parent)
+        info.local_names = _local_names(node)
+        model.funcs[qual] = info
+        walker = _FuncWalker(model, info, aliases, enclosing_locals)
+        # pre-scan for spawn/start lines so `prestart` classification works
+        # on the main walk: the happens-before boundary is the first
+        # .start() AFTER a Thread construction — writes between construct
+        # and start (publish-then-start) are still pre-publication. Nested
+        # defs are excluded (their spawns are their own).
+        constructs: List[int] = []
+        starts: List[int] = []
+
+        def prescan(n):
+            for sub in ast.iter_child_nodes(n):
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                    ast.Lambda, ast.ClassDef)):
+                    continue
+                if isinstance(sub, ast.Call):
+                    if _is_thread_ctor(
+                            _resolve_dotted(_call_name(sub.func), aliases)):
+                        constructs.append(sub.lineno)
+                    elif isinstance(sub.func, ast.Attribute) and \
+                            sub.func.attr == "start":
+                        starts.append(sub.lineno)
+                prescan(sub)
+
+        prescan(node)
+        info.spawn_lines = sorted(set(constructs))
+        if constructs:
+            after = [ln for ln in starts if ln >= min(constructs)]
+            info.prestart_line = min(after) if after else min(constructs)
+        walker.block(node.body, frozenset())
+        # recurse into nested defs/classes
+        for sub in node.body:
+            descend(sub, qual, cls, info.local_names)
+
+    def descend(node, parent_qual, cls, enclosing_locals):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if parent_qual is None:
+                qual = node.name if cls is None else f"{cls}.{node.name}"
+                register(node, qual, cls, None, enclosing_locals)
+            else:
+                qual = f"{parent_qual}.<locals>.{node.name}"
+                register(node, qual, cls, parent_qual, enclosing_locals)
+        elif isinstance(node, ast.ClassDef):
+            inner_cls = node.name
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    if parent_qual is None:
+                        register(sub, f"{inner_cls}.{sub.name}", inner_cls,
+                                 None, set())
+                    else:
+                        register(sub,
+                                 f"{parent_qual}.<locals>."
+                                 f"{inner_cls}.{sub.name}",
+                                 inner_cls, parent_qual, enclosing_locals)
+                elif isinstance(sub, ast.ClassDef):
+                    descend(sub, parent_qual, inner_cls, enclosing_locals)
+        else:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                    descend(child, parent_qual, cls, enclosing_locals)
+
+    # module-level statements: spawns (atexit.register at import time) and
+    # top-level defs
+    mod_info = FuncInfo(qualname="<module>", cls=None, root_cls=None,
+                        node=tree, parent=None)
+    model.funcs["<module>"] = mod_info
+    walker = _FuncWalker(model, mod_info, aliases, set())
+    for st in tree.body:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            descend(st, None, None, set())
+        else:
+            walker.stmt(st, frozenset())
+
+
+def _match_root(qual: str, cls: Optional[str], patterns) -> bool:
+    for p in patterns:
+        if p == qual:
+            return True
+        if p.endswith(".*") and qual.startswith(p[:-2] + "."):
+            return True
+        if p.endswith(".*") and cls == p[:-2]:
+            return True
+    return False
+
+
+def build_module_model(source: str, relpath: str = "<string>",
+                       extra_roots=()) -> ModuleModel:
+    """Parse ``source`` and build the full thread model: entry discovery,
+    role propagation, caller-held lock inheritance.
+
+    ``extra_roots``: qualname patterns (exact, or ``Class.*``) for
+    functions that run on threads started OUTSIDE this module — the
+    cross-module edges the per-module AST cannot see (the lint gate's
+    ``THREAD_ROOTS``)."""
+    tree = ast.parse(source, filename=relpath)
+    model = ModuleModel(relpath)
+    p1 = _Phase1(model)
+    p1.visit(tree)
+    _walk_functions(model, tree, p1.aliases)
+
+    # -- thread-role seeding ------------------------------------------------
+    targets: Dict[str, str] = {}            # qualname -> entry label
+    for sp in model.spawns:
+        if sp.target and sp.target in model.funcs:
+            targets.setdefault(sp.target, f"thread:{sp.target}")
+    # handler classes: every method runs on a per-connection server thread
+    # (match by FuncInfo.cls so classes nested inside functions count too)
+    handler_classes = {cls for cls, bases in model.classes.items()
+                       if any(b in HANDLER_BASES for b in bases)}
+    if handler_classes:
+        for qual, info in model.funcs.items():
+            if info.cls in handler_classes:
+                targets.setdefault(qual, f"thread:{info.cls}")
+    for qual, info in model.funcs.items():
+        if _match_root(qual, info.cls, extra_roots):
+            targets.setdefault(qual, f"thread:{qual}")
+
+    for qual, label in targets.items():
+        info = model.funcs.get(qual)
+        if info is not None:
+            info.is_target = True
+            info.roles.add(label)
+
+    # main role: everything not referenced exclusively as a thread target
+    for qual, info in model.funcs.items():
+        if not info.is_target:
+            info.roles.add(MAIN_ROLE)
+
+    # nested non-target functions inherit their definer's roles (closures
+    # run where their definer runs — or wherever the definer hands them)
+    changed = True
+    while changed:
+        changed = False
+        for qual, info in model.funcs.items():
+            if info.parent and not info.is_target:
+                parent = model.funcs.get(info.parent)
+                if parent and not parent.roles <= info.roles:
+                    info.roles |= parent.roles
+                    changed = True
+            # roles flow caller -> callee
+            for callee, _, _ in info.calls:
+                ci = model.funcs.get(callee)
+                if ci is not None and not info.roles <= ci.roles:
+                    ci.roles |= info.roles
+                    changed = True
+
+    # -- caller-held lock inheritance ----------------------------------------
+    # If EVERY in-module call site of g holds lock L (directly or itself
+    # inherited), g's accesses are effectively guarded by L — the
+    # ``_row()``-called-under-``self._lock`` pattern. Entry points
+    # (targets, roots, <module>) inherit nothing.
+    callers: Dict[str, List[Tuple[str, frozenset]]] = {}
+    for qual, info in model.funcs.items():
+        for callee, held, _ in info.calls:
+            callers.setdefault(callee, []).append((qual, held))
+    inherited: Dict[str, Optional[frozenset]] = {
+        q: None for q in model.funcs}       # None = unknown (top)
+    for q, info in model.funcs.items():
+        if info.is_target or q == "<module>" or q not in callers:
+            inherited[q] = frozenset()
+    for _ in range(len(model.funcs) + 1):
+        changed = False
+        for q in model.funcs:
+            if inherited[q] is not None and not callers.get(q):
+                continue
+            if model.funcs[q].is_target:
+                continue
+            sets = []
+            for caller, held in callers.get(q, ()):
+                ih = inherited.get(caller)
+                sets.append(held | (ih or frozenset()))
+            if not sets:
+                continue
+            new = frozenset.intersection(*[frozenset(s) for s in sets])
+            if new != inherited[q]:
+                inherited[q] = new
+                changed = True
+        if not changed:
+            break
+    for q, info in model.funcs.items():
+        extra = inherited.get(q) or frozenset()
+        if extra:
+            for a in info.accesses:
+                a.locks = frozenset(a.locks | extra)
+            for acq in info.acquires:
+                acq.held = frozenset(acq.held | extra)
+            info.toctous = [dataclasses.replace(
+                t, test_reads=[(k, frozenset(l | extra))
+                               for k, l in t.test_reads])
+                for t in info.toctous]
+    return model

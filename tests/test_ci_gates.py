@@ -59,6 +59,11 @@ def test_manifest_counts_cover_reference_parity():
         # TraceRecorder, parse_prometheus_text, and the five collector
         # adapters (engine/retry/guard/supervisor/fleet)
         "paddle.observability": 13,
+        # concurrency-lint PR (docs/STATIC_ANALYSIS.md PT-RACE section):
+        # analyze_source/file/paths, build_module_model,
+        # infer_shared_state, run_checks, finding_id, ModuleModel,
+        # SharedKey
+        "paddle.static.concurrency": 9,
     }
     for k, n in exact.items():
         assert len(m[k]) == n, (k, len(m[k]), n)
@@ -152,6 +157,38 @@ def test_graph_lint_gate_detects_seeded_defects():
         capture_output=True, text=True, env=env, cwd=ROOT, timeout=500)
     assert r2.returncode != 0
     assert "PT-SHAPE-001" in r2.stdout  # names op + code in the output
+
+
+def test_concurrency_lint_gate_package_clean():
+    """PT-RACE gate (docs/STATIC_ANALYSIS.md): the whole-package sweep must
+    exit 0 — every error-severity finding either fixed or covered by a
+    reviewed tools/concurrency_baseline.json entry WITH a justification.
+    Pure-AST (no jax, no model compiles), so this runs unmarked."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "lint_concurrency.py")],
+        capture_output=True, text=True, cwd=ROOT, timeout=200)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "CONCURRENCY LINT OK" in r.stdout, r.stdout
+    # the baseline must stay tight: a stale entry means the code was fixed
+    # but the suppression lingers — remove it
+    assert "stale baseline entry" not in r.stdout, r.stdout
+
+
+def test_concurrency_lint_gate_detects_seeded_defects():
+    """Every seeded PT-RACE class (unguarded write / inconsistent guard /
+    lock-order inversion / check-then-act / thread leak) must flip the
+    lint gate with its expected code; one end-to-end --inject run pins the
+    exit-code path itself (same posture as lint_graph's selftest)."""
+    gate = os.path.join(ROOT, "tools", "lint_concurrency.py")
+    r = subprocess.run([sys.executable, gate, "--selftest"],
+                       capture_output=True, text=True, cwd=ROOT, timeout=200)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "SELFTEST OK: 5 defect classes detected" in r.stdout, r.stdout
+    r2 = subprocess.run([sys.executable, gate, "--inject", "lock_order"],
+                        capture_output=True, text=True, cwd=ROOT,
+                        timeout=200)
+    assert r2.returncode != 0
+    assert "PT-RACE-003" in r2.stdout
 
 
 @pytest.mark.slow   # ~3min of engine/train-loop compiles across 15 classes

@@ -1,0 +1,485 @@
+"""PT-RACE concurrency analyzer unit tests (docs/STATIC_ANALYSIS.md).
+
+Everything here is pure-AST (no compiles, no threads actually started for
+the analyzer tests) so the whole module runs in well under a second — the
+full-package sweep and the seeded-defect exit-code flips live behind the
+``lint_concurrency --selftest`` CI entry in test_ci_gates.py, like
+lint_graph.
+"""
+
+import os
+import sys
+import textwrap
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _analyze(src, relpath="mod.py", **kw):
+    from paddle_tpu.static.concurrency import analyze_source
+
+    return analyze_source(textwrap.dedent(src), relpath, **kw)
+
+
+def _codes(report):
+    return sorted({d.code for d in report})
+
+
+def _model(src, relpath="mod.py", **kw):
+    from paddle_tpu.static.concurrency import build_module_model
+
+    return build_module_model(textwrap.dedent(src), relpath, **kw)
+
+
+# ---------------------------------------------------------------------------
+# thread-model: entry discovery + role propagation
+# ---------------------------------------------------------------------------
+
+class TestThreadModel:
+    def test_thread_target_and_transitive_roles(self):
+        m = _model("""
+            import threading
+
+            class A:
+                def __init__(self):
+                    threading.Thread(target=self._loop, daemon=True).start()
+
+                def _loop(self):
+                    self._helper()
+
+                def _helper(self):
+                    pass
+
+                def public(self):
+                    self._helper()
+        """)
+        loop = m.funcs["A._loop"]
+        helper = m.funcs["A._helper"]
+        public = m.funcs["A.public"]
+        assert any(r.startswith("thread:") for r in loop.roles)
+        assert "main" not in loop.roles          # referenced only as target
+        # helper runs on the thread AND from the public (main) path
+        assert any(r.startswith("thread:") for r in helper.roles)
+        assert "main" in helper.roles
+        assert public.roles == {"main"}
+
+    def test_pool_submit_and_atexit_are_entries(self):
+        m = _model("""
+            import atexit
+            import threading
+            from concurrent.futures import ThreadPoolExecutor
+
+            def _flush():
+                pass
+
+            atexit.register(_flush)
+
+            class A:
+                def __init__(self):
+                    self._pool = ThreadPoolExecutor(2)
+
+                def go(self):
+                    return self._pool.submit(self.work, 1)
+
+                def work(self, x):
+                    return x
+        """)
+        assert any(r.startswith("thread:") for r in m.funcs["_flush"].roles)
+        assert any(r.startswith("thread:") for r in m.funcs["A.work"].roles)
+
+    def test_handler_class_methods_run_on_server_threads(self):
+        m = _model("""
+            from http.server import BaseHTTPRequestHandler
+
+            class H(BaseHTTPRequestHandler):
+                def do_GET(self):
+                    pass
+        """)
+        assert any(r.startswith("thread:") for r in m.funcs["H.do_GET"].roles)
+
+    def test_extra_roots_mark_cross_module_entries(self):
+        m = _model("""
+            class A:
+                def api(self):
+                    pass
+        """, extra_roots=["A.api"])
+        assert any(r.startswith("thread:") for r in m.funcs["A.api"].roles)
+
+    def test_lock_discovery_and_held_sets(self):
+        m = _model("""
+            import threading
+
+            _G = threading.Lock()
+
+            class A:
+                def __init__(self, lock=None):
+                    self._lock = lock or threading.Lock()
+                    self._cond = threading.Condition()
+                    self.x = 0
+
+                def f(self):
+                    with self._lock:
+                        self.x = 1
+                    with _G:
+                        self.x = 2
+        """)
+        assert "A" in m.lock_attrs and "_lock" in m.lock_attrs["A"]
+        assert m.lock_attrs["A"]["_cond"] == "Condition"
+        assert "_G" in m.module_locks
+        xs = [a for a in m.funcs["A.f"].accesses if a.key == "A:A.x"]
+        assert {frozenset(a.locks) for a in xs} == {
+            frozenset({"A._lock"}), frozenset({"M:_G"})}
+
+    def test_caller_held_lock_inheritance(self):
+        """A helper only ever called under the lock is effectively guarded
+        (the SparseTable._row pattern)."""
+        rep = _analyze("""
+            import threading
+
+            class T:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._rows = {}
+                    threading.Thread(target=self._loop, daemon=True).start()
+
+                def _loop(self):
+                    while True:
+                        with self._lock:
+                            self._row(0)
+
+                def _row(self, k):
+                    if k not in self._rows:
+                        self._rows[k] = []
+                    return self._rows[k]
+
+                def put(self, k):
+                    with self._lock:
+                        self._row(k).append(1)
+        """)
+        assert not rep.errors(), rep.summary()
+
+    def test_locks_resolve_through_in_module_base_class(self):
+        """Subclasses share the base's lock/attr namespace (the
+        Counter/Histogram-under-_Instrument pattern)."""
+        rep = _analyze("""
+            import threading
+
+            class Base:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._values = {}
+                    threading.Thread(target=self._loop, daemon=True).start()
+
+                def _loop(self):
+                    while True:
+                        with self._lock:
+                            self._values["t"] = 1
+
+            class Child(Base):
+                def bump(self):
+                    with self._lock:
+                        self._values["c"] = 2
+        """)
+        assert not rep.errors(), rep.summary()
+
+    def test_prestart_writes_are_happens_before(self):
+        rep = _analyze("""
+            import threading
+
+            class A:
+                def start(self):
+                    self._job = 1          # before start(): publication
+                    self._thread = threading.Thread(target=self._loop,
+                                                    daemon=True)
+                    self._thread.start()
+
+                def _loop(self):
+                    return self._job
+        """)
+        assert not rep.errors(), rep.summary()
+
+    def test_prestart_boundary_is_start_not_construction(self):
+        """Review regression: the happens-before boundary is the first
+        ``.start()``, not the ``Thread(...)`` construction — a write
+        between construct and start is still pre-publication."""
+        rep = _analyze("""
+            import threading
+
+            class A:
+                def start(self):
+                    self._thread = threading.Thread(target=self._loop,
+                                                    daemon=True)
+                    self._job = 1          # construct..start gap: safe
+                    self._thread.start()
+
+                def _loop(self):
+                    return self._job
+        """)
+        assert not rep.errors(), rep.summary()
+
+    def test_aliased_imports_still_discover_entries(self):
+        """Review regression: `from atexit import register`, aliased
+        module imports, and `from threading import Thread` all resolve."""
+        m = _model("""
+            import atexit as ax
+            from atexit import register
+            from threading import Thread
+
+            _Q = []
+
+            def _flush():
+                _Q.append(1)
+
+            def _flush2():
+                _Q.append(2)
+
+            register(_flush)
+            ax.register(_flush2)
+
+            def fire():
+                t = Thread(target=_flush, daemon=True)
+                t.start()
+        """)
+        kinds = {(s.kind, s.target) for s in m.spawns}
+        assert ("atexit", "_flush") in kinds
+        assert ("atexit", "_flush2") in kinds
+        assert ("thread", "_flush") in kinds
+        assert any(r.startswith("thread:")
+                   for r in m.funcs["_flush"].roles)
+        assert any(r.startswith("thread:")
+                   for r in m.funcs["_flush2"].roles)
+
+
+# ---------------------------------------------------------------------------
+# rules (fixture snippets per PT-RACE class)
+# ---------------------------------------------------------------------------
+
+class TestRules:
+    def test_fixture_catalogue_matches_expected_codes(self):
+        sys.path.insert(0, os.path.join(ROOT, "tools"))
+        try:
+            from lint_concurrency import (CLEAN_FIXTURE, EXPECTED_CODE,
+                                          FIXTURES)
+        finally:
+            sys.path.pop(0)
+        for defect, src in FIXTURES.items():
+            rep = _analyze(src, f"{defect}.py")
+            assert EXPECTED_CODE[defect] in {d.code for d in rep.errors()}, \
+                (defect, rep.summary())
+        assert not _analyze(CLEAN_FIXTURE, "clean.py").errors()
+
+    def test_001_module_global_unguarded(self):
+        rep = _analyze("""
+            import threading
+
+            _STATS = {"n": 0}
+
+            def tick():
+                _STATS["n"] += 1
+
+            def snapshot():
+                return dict(_STATS)
+        """, extra_roots=["tick"])
+        assert "PT-RACE-001" in _codes(rep)
+
+    def test_002_unguarded_read_is_warning_not_error(self):
+        rep = _analyze("""
+            import threading
+
+            class A:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+                    threading.Thread(target=self._loop, daemon=True).start()
+
+                def _loop(self):
+                    while True:
+                        with self._lock:
+                            self.n += 1
+
+                def peek(self):
+                    return self.n
+        """)
+        w = [d for d in rep if d.code == "PT-RACE-002"]
+        assert w and not rep.errors(), rep.summary()
+
+    def test_003_includes_non_reentrant_self_reacquire(self):
+        rep = _analyze("""
+            import threading
+
+            class A:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+                    threading.Thread(target=self._loop, daemon=True).start()
+
+                def _loop(self):
+                    with self._lock:
+                        with self._lock:
+                            self.n += 1
+
+                def bump(self):
+                    with self._lock:
+                        self.n += 1
+        """)
+        assert "PT-RACE-003" in {d.code for d in rep.errors()}
+
+    def test_003_rlock_reacquire_is_fine(self):
+        rep = _analyze("""
+            import threading
+
+            class A:
+                def __init__(self):
+                    self._lock = threading.RLock()
+                    self.n = 0
+                    threading.Thread(target=self._loop, daemon=True).start()
+
+                def _loop(self):
+                    with self._lock:
+                        with self._lock:
+                            self.n += 1
+
+                def bump(self):
+                    with self._lock:
+                        self.n += 1
+        """)
+        assert "PT-RACE-003" not in _codes(rep), rep.summary()
+
+    def test_005_daemon_and_joined_threads_are_fine(self):
+        rep = _analyze("""
+            import threading
+
+            def work():
+                pass
+
+            def run_daemon():
+                threading.Thread(target=work, daemon=True).start()
+
+            def run_joined():
+                t = threading.Thread(target=work)
+                t.start()
+                t.join()
+        """)
+        assert "PT-RACE-005" not in _codes(rep), rep.summary()
+
+    def test_005_chained_start_always_flags(self):
+        rep = _analyze("""
+            import threading
+
+            def work():
+                pass
+
+            def fire():
+                threading.Thread(target=work).start()
+
+            def other():
+                t = threading.Thread(target=work)
+                t.start()
+                t.join()
+        """)
+        assert "PT-RACE-005" in {d.code for d in rep.errors()}
+
+    def test_string_and_path_joins_do_not_count_as_thread_joins(self):
+        m = _model("""
+            import os
+
+            def f(parts, a, b):
+                x = ",".join(parts)
+                sep = "-"
+                y = sep.join(parts)
+                return os.path.join(a, b), x, y
+        """)
+        assert not m.has_thread_join
+
+    def test_finding_ids_are_line_number_free_and_stable(self):
+        src = """
+            import threading
+
+            class P:
+                def __init__(self):
+                    self.hits = 0
+                    threading.Thread(target=self._loop, daemon=True).start()
+
+                def _loop(self):
+                    self.hits += 1
+
+                def reset(self):
+                    self.hits = 0
+        """
+        a = _analyze(src, "m.py")
+        b = _analyze("\n\n\n" + textwrap.dedent(src), "m.py")
+        ids_a = {d.finding_id for d in a.errors()}
+        ids_b = {d.finding_id for d in b.errors()}
+        assert ids_a == ids_b == {"PT-RACE-001:m.py:P:P.hits"}
+
+
+# ---------------------------------------------------------------------------
+# real-module pins: correct lock discipline must lint clean
+# ---------------------------------------------------------------------------
+
+class TestRealModules:
+    def _sweep_one(self, relpath):
+        sys.path.insert(0, os.path.join(ROOT, "tools"))
+        try:
+            from lint_concurrency import THREAD_ROOTS
+        finally:
+            sys.path.pop(0)
+        from paddle_tpu.static.concurrency import analyze_file
+
+        return analyze_file(os.path.join(ROOT, relpath), relpath=relpath,
+                            extra_roots=THREAD_ROOTS.get(relpath, ()))
+
+    def test_step_watchdog_lints_clean(self):
+        """StepWatchdog's condition-variable discipline is correct — the
+        analyzer must agree (false-positive regression pin)."""
+        rep = self._sweep_one("paddle_tpu/distributed/resilience/watchdog.py")
+        assert not rep.errors(), rep.summary()
+
+    def test_trace_recorder_lints_clean_after_lock_fix(self):
+        """The PT-RACE-001 findings on TraceRecorder's stamp-path state
+        (events/_state/_streamed/... mutated from parallel_step replica
+        threads) are fixed by the recorder lock — pinned here so the lock
+        does not silently erode."""
+        rep = self._sweep_one("paddle_tpu/observability/tracing.py")
+        assert not rep.errors(), rep.summary()
+
+    def test_retry_stats_lints_clean_after_lock_fix(self):
+        rep = self._sweep_one("paddle_tpu/distributed/resilience/retry.py")
+        assert not rep.errors(), rep.summary()
+
+    def test_metrics_registry_lints_clean_after_guard_fixes(self):
+        rep = self._sweep_one("paddle_tpu/observability/metrics.py")
+        assert not rep.errors(), rep.summary()
+
+
+# ---------------------------------------------------------------------------
+# baseline workflow
+# ---------------------------------------------------------------------------
+
+class TestBaseline:
+    def test_baseline_entries_all_have_justifications(self):
+        sys.path.insert(0, os.path.join(ROOT, "tools"))
+        try:
+            from lint_concurrency import BASELINE_PATH, load_baseline
+        finally:
+            sys.path.pop(0)
+        baseline = load_baseline(BASELINE_PATH)
+        assert baseline, "baseline file missing or empty"
+        for fid, just in baseline.items():
+            assert fid.startswith("PT-RACE-"), fid
+            assert len(just) > 20, (fid, "justification too thin")
+
+    def test_baseline_without_justification_rejected(self, tmp_path):
+        sys.path.insert(0, os.path.join(ROOT, "tools"))
+        try:
+            from lint_concurrency import load_baseline
+        finally:
+            sys.path.pop(0)
+        import json
+
+        p = tmp_path / "b.json"
+        p.write_text(json.dumps(
+            {"entries": [{"id": "PT-RACE-001:x:y:z"}]}))
+        with pytest.raises(SystemExit):
+            load_baseline(str(p))

@@ -185,6 +185,54 @@ class TestTraceRecorder:
         assert len(tr.events) == 5 and tr.dropped == 5
         assert tr.export_chrome()["otherData"]["dropped_events"] == 5
 
+    def test_concurrent_stamping_is_exact(self):
+        """PT-RACE-001 regression (tools/lint_concurrency.py): ONE recorder
+        is shared by every replica of a fleet, and under
+        ``FleetConfig(parallel_step=True)`` the stamp sites run on
+        concurrent replica threads while the driver reads exports. The
+        recorder lock must keep the bookkeeping exact: no lost events, no
+        lost streamed-token increments, one terminal per rid — unlocked
+        dict/list mutation loses updates under this exact load."""
+        import threading
+
+        tr = TraceRecorder(max_events=500_000)
+        n_threads, n_reqs, n_toks = 8, 25, 20
+        errs = []
+
+        def replica(t):
+            try:
+                for i in range(n_reqs):
+                    rid = t * 1000 + i
+                    tr.submit(rid, 4, n_toks, tags={"replica": t})
+                    tr.first_token(rid, tags={"replica": t})
+                    for k in range(1, n_toks + 1):
+                        tr.tokens(rid, k)
+                    tr.finish(rid, n_toks, tags={"replica": t})
+                    tr.export_chrome()        # driver-side read races in
+                    tr.incomplete()
+            except Exception as e:            # pragma: no cover
+                errs.append(e)
+
+        threads = [threading.Thread(target=replica, args=(t,), daemon=True)
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs, errs
+        total = n_threads * n_reqs
+        slo = tr.slo_summary()
+        assert slo["submitted"] == total
+        assert slo["tokens_streamed"] == total * n_toks
+        assert tr.incomplete() == []
+        reg = tr.registry
+        assert reg.get("pt_serving_requests_terminal_total") \
+                  .value(kind="finish") == total
+        # every lane carries exactly one terminal and the full chain
+        doc = tr.export_chrome()
+        assert len([e for e in doc["traceEvents"]
+                    if e["name"] == "finish"]) == total
+
 
 # ---------------------------------------------------------------------------
 # engine / supervisor integration. Tier-1 wall clock is at its 870 s

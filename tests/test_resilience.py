@@ -185,6 +185,47 @@ class TestRetry:
         reset_retry_stats()
         assert retry_stats()["attempts"] == 0
 
+    def test_retry_stats_concurrent_exact(self):
+        """PT-RACE-001 regression (tools/lint_concurrency.py): retry_call
+        runs concurrently — fleet parallel_step replica threads, the rpc
+        ThreadPoolExecutor and the elastic heartbeat all funnel through it
+        — so the registry's read-modify-write counters need the stats
+        lock; bare ``+=`` loses increments under exactly this load."""
+        from paddle_tpu.distributed.resilience import (reset_retry_stats,
+                                                       retry_stats)
+
+        reset_retry_stats()
+        n_threads, n_calls = 8, 150
+        pol = RetryPolicy(max_attempts=2, base_delay=0.0, jitter=0.0)
+        errs = []
+
+        def worker(t):
+            try:
+                for i in range(n_calls):
+                    # every call fails once then succeeds: 2 attempts,
+                    # 1 retry, 0 giveups — exact bookkeeping expected
+                    fn, _ = self._flaky(1)
+                    retry_call(fn, policy=pol, what=f"stress-{t % 3}",
+                               sleep=lambda s: None)
+            except Exception as e:          # pragma: no cover
+                errs.append(e)
+
+        threads = [threading.Thread(target=worker, args=(t,), daemon=True)
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs, errs
+        s = retry_stats()
+        total = n_threads * n_calls
+        assert s["calls"] == total
+        assert s["attempts"] == 2 * total
+        assert s["retries"] == total
+        assert s["giveups"] == 0
+        assert sum(s["by_what"].values()) == 2 * total
+        reset_retry_stats()
+
 
 # ---------------------------------------------------------------------------
 # TCPStore retry + fault sites
@@ -371,6 +412,37 @@ class TestCheckpointIntegrity:
             with pytest.raises(RuntimeError, match="fault injected"):
                 wait_async_save()
         wait_async_save()                          # drained: second call clean
+
+    def test_async_save_starts_inside_lock(self, tmp_path, monkeypatch):
+        """PT-RACE triage regression (tools/lint_concurrency.py): the
+        writer thread must be published to _ASYNC and STARTED inside one
+        _ASYNC_LOCK critical section — with start() outside it, a
+        concurrent wait_async_save() could pop the record between append
+        and start and join() a never-started thread (RuntimeError)."""
+        import importlib
+        import threading as _threading
+
+        # the checkpoint package re-exports the function under the same
+        # name, so fetch the MODULE (for its _ASYNC_LOCK) via importlib
+        ssd = importlib.import_module(
+            "paddle_tpu.distributed.checkpoint.save_state_dict")
+
+        started_under_lock = []
+        orig_start = _threading.Thread.start
+
+        def spying_start(self):
+            if self.name.startswith("pt-ckpt-save:"):
+                started_under_lock.append(ssd._ASYNC_LOCK.locked())
+            return orig_start(self)
+
+        monkeypatch.setattr(_threading.Thread, "start", spying_start)
+        sd, w = _sd()
+        save_state_dict(sd, str(tmp_path), async_save=True)
+        wait_async_save()
+        assert started_under_lock == [True]
+        target = {"w": Tensor(jnp.zeros(512, jnp.float32))}
+        load_state_dict(target, str(tmp_path))
+        np.testing.assert_array_equal(np.asarray(target["w"]._data), w)
 
 
 # ---------------------------------------------------------------------------

@@ -57,6 +57,43 @@ def test_parameter_server_local():
     assert v.shape == (2, 4)
 
 
+def test_parameter_server_concurrent_handlers_exact():
+    """PT-RACE-002 regression (tools/lint_concurrency.py): ParameterServer
+    methods execute on rpc handler threads — create-if-absent races and
+    unguarded table lookups must stay exact under concurrency (the table
+    lock + locked ``_table`` lookup). Every push lands exactly once."""
+    import threading
+
+    ps = ParameterServer()
+    n_threads, n_pushes = 8, 50
+    errs = []
+
+    def handler(t):
+        try:
+            for i in range(n_pushes):
+                # racing create-or-validate: same config is idempotent
+                ps.create_dense_table("w", [4], optimizer="sgd", lr=1.0)
+                ps.create_sparse_table("emb", 4, lr=1.0)
+                ps.push_dense("w", np.ones(4, np.float32))
+                ps.push_sparse("emb", [t], np.ones((1, 4), np.float32))
+                ps.stat()
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=handler, args=(t,), daemon=True)
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs
+    total = n_threads * n_pushes
+    # sgd with lr=1.0: value == -sum(grads) exactly, so a lost push shows
+    np.testing.assert_allclose(ps.pull_dense("w"),
+                               np.full(4, -float(total), np.float32))
+    assert ps.stat()["emb"]["rows"] == n_threads
+
+
 # ---------------------------------------------------------------------------
 # rpc across real processes
 # ---------------------------------------------------------------------------

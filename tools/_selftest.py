@@ -1,0 +1,77 @@
+"""Shared selftest harness for the tools/ CI gates.
+
+Every gate in this directory (lint_graph, fault_drill, scrape_metrics,
+lint_concurrency) speaks the same protocol: run a matrix of cases, print
+one ``[ok]``/``[FAIL]`` line per case, print a single pinned summary line,
+and exit non-zero iff anything failed — tests/test_ci_gates.py asserts on
+the summary strings. This module is that protocol, extracted so the
+fourth gate is a consumer, not a fourth copy.
+
+Usage::
+
+    import _selftest
+    ROOT = _selftest.bootstrap()          # repo on sys.path, CPU jax env
+
+    h = _selftest.Harness("SCRAPE")
+    h.case("inject shape_mismatch", ok, "detected PT-SHAPE-001")
+    h.fail_now("metric families missing")         # assertion-style abort
+    return h.finish("SELFTEST OK: ...", "SELFTEST FAIL: ...")
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+__all__ = ["repo_root", "bootstrap", "Harness"]
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def bootstrap(jax_cpu: bool = True) -> str:
+    """Put the repo root on ``sys.path`` and default the gates' shared
+    environment (CPU jax). Returns the root."""
+    root = repo_root()
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    if jax_cpu:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    return root
+
+
+class Harness:
+    """Case counter + the two exit styles the gates use: matrix summaries
+    (``case``/``finish``) and assertion-style aborts (``fail_now``)."""
+
+    def __init__(self, gate: str = "SELFTEST"):
+        self.gate = gate
+        self.cases = 0
+        self.failures = 0
+
+    def case(self, label: str, ok: bool, info: str = "") -> bool:
+        """One matrix entry: prints ``[ok|FAIL] <label>: <info>``."""
+        print(f"[{'ok' if ok else 'FAIL'}] {label}: {info}")
+        self.cases += 1
+        if not ok:
+            self.failures += 1
+        return ok
+
+    def note(self, msg: str) -> None:
+        print(msg)
+
+    def fail_now(self, msg: str) -> "NoReturn":    # noqa: F821
+        """Abort the whole gate with a named first failure (exit 1)."""
+        print(f"{self.gate} FAIL: {msg}")
+        sys.exit(1)
+
+    def finish(self, ok_msg: str, fail_msg: str) -> int:
+        """Print the pinned summary line and return the exit code. The
+        messages may use ``{failures}`` / ``{cases}`` placeholders."""
+        fmt = dict(failures=self.failures, cases=self.cases)
+        if self.failures:
+            print(fail_msg.format(**fmt))
+            return 1
+        print(ok_msg.format(**fmt))
+        return 0

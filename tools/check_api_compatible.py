@@ -24,6 +24,7 @@ def live_surfaces():
     jax.config.update("jax_platforms", "cpu")
     import paddle_tpu as paddle
     from paddle_tpu.inference import serving as _serving
+    from paddle_tpu.static import concurrency as _concurrency
 
     def names(mod):
         all_ = getattr(mod, "__all__", None)
@@ -34,6 +35,7 @@ def live_surfaces():
     return {
         "paddle.inference.serving": names(_serving),
         "paddle.observability": names(paddle.observability),
+        "paddle.static.concurrency": names(_concurrency),
         "paddle": names(paddle),
         "paddle.tensor_methods": sorted(
             n for n in dir(paddle.Tensor) if not n.startswith("_")),

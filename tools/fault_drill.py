@@ -35,14 +35,14 @@ import time
 # pure-Python store daemon so server-side faults (store.daemon stalls) are
 # real, not simulated; CPU jax with 8 host devices for the elastic meshes
 os.environ["PT_DISABLE_NATIVE"] = "1"
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags +
                                " --xla_force_host_platform_device_count=8")
 
-ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, ROOT)
+import _selftest  # noqa: E402
+
+ROOT = _selftest.bootstrap()
 
 
 # ---------------------------------------------------------------------------
@@ -1137,29 +1137,22 @@ def main(argv=None):
                 ap.error(f"unknown drill(s): {', '.join(unknown)}")
             selected = {k: v for k, v in selected.items()
                         if (k in names) == keep}
-        failures = 0
+        h = _selftest.Harness("FAULT DRILL")
         for name, drill in selected.items():
             ok, info = drill(recover=True)
-            print(f"[{'ok' if ok else 'FAIL'}] {name} (recovery on): {info}")
-            if not ok:
-                failures += 1
+            h.case(f"{name} (recovery on)", ok, info)
             ok2, info2 = drill(recover=False)
-            print(f"[{'ok' if not ok2 else 'FAIL'}] {name} (recovery off, "
-                  f"fault must bite): {info2}")
-            if ok2:
-                failures += 1
+            h.case(f"{name} (recovery off, fault must bite)", not ok2, info2)
         from paddle_tpu.distributed.resilience import retry_stats
 
         rs = retry_stats()
-        print(f"retry stats: {rs['calls']} calls, {rs['attempts']} attempts, "
-              f"{rs['retries']} retries, {rs['giveups']} give-ups, "
-              f"{rs['latency_s']:.2f}s cumulative latency")
-        if failures:
-            print(f"FAULT DRILL FAIL: {failures} expectation(s) violated")
-            return 1
-        print(f"FAULT DRILL OK: {len(selected)} fault classes recovered, "
-              "each flips the gate without its recovery path")
-        return 0
+        h.note(f"retry stats: {rs['calls']} calls, {rs['attempts']} "
+               f"attempts, {rs['retries']} retries, {rs['giveups']} "
+               f"give-ups, {rs['latency_s']:.2f}s cumulative latency")
+        return h.finish(
+            f"FAULT DRILL OK: {len(selected)} fault classes recovered, "
+            "each flips the gate without its recovery path",
+            "FAULT DRILL FAIL: {failures} expectation(s) violated")
 
     if not args.drill:
         print(__doc__)

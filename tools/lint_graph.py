@@ -21,13 +21,11 @@ expected diagnostic code.
 from __future__ import annotations
 
 import argparse
-import os
 import sys
 
-ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, ROOT)
+import _selftest
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+ROOT = _selftest.bootstrap()
 
 import jax  # noqa: E402
 import numpy as np  # noqa: E402
@@ -290,7 +288,8 @@ def main(argv=None):
 
 def selftest(family):
     """Every defect class must flip the gate with its expected code; the
-    clean program must not."""
+    clean program must not (harness: tools/_selftest.py)."""
+    h = _selftest.Harness("LINT")
     _, clean_report, clean_gate = lint_family(family)
     if clean_gate:
         print(f"SELFTEST FAIL: clean '{family}' has gate findings:")
@@ -298,25 +297,23 @@ def selftest(family):
             print("  " + d.format())
         return 1
     print(f"clean {family}: ok ({len(clean_report)} sub-gate finding(s))")
-    failures = []
     for defect in DEFECTS:
         # lint_family seeds (paddle.seed) before recording; the
         # unseeded_stochastic inject() un-seeds again afterwards itself
         _, report, gate = lint_family(family, defect=defect)
         code = EXPECTED_CODE[defect]
         hit = [d for d in gate if d.code == code]
-        if not hit:
-            failures.append((defect, code, [d.code for d in gate]))
-            print(f"inject {defect}: MISSED (wanted {code}, gate codes: "
-                  f"{sorted({d.code for d in gate})})")
+        if hit:
+            h.case(f"inject {defect}", True,
+                   f"detected {code} — {hit[0].message[:80]}")
         else:
-            print(f"inject {defect}: detected {code} — {hit[0].message[:80]}")
-    if failures:
-        print(f"SELFTEST FAIL: {len(failures)} defect class(es) undetected")
-        return 1
-    print(f"SELFTEST OK: {len(DEFECTS)} defect classes detected, "
-          f"clean program lints clean")
-    return 0
+            h.case(f"inject {defect}", False,
+                   f"wanted {code}, gate codes: "
+                   f"{sorted({d.code for d in gate})}")
+    return h.finish(
+        f"SELFTEST OK: {len(DEFECTS)} defect classes detected, "
+        "clean program lints clean",
+        "SELFTEST FAIL: {failures} defect class(es) undetected")
 
 
 if __name__ == "__main__":

@@ -29,8 +29,10 @@ import sys
 import tempfile
 import urllib.request
 
-ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, ROOT)
+import _selftest
+
+ROOT = _selftest.bootstrap(jax_cpu=False)   # selftest() defaults the env
+_H = _selftest.Harness("SCRAPE")
 
 #: metric families a serving deployment must expose (one representative
 #: per source collector — the full catalogue is docs/OBSERVABILITY.md)
@@ -61,9 +63,7 @@ REQUIRED_FAMILIES = (
 REQUIRED_CHAIN = ("submit", "admit", "first_token", "finish")
 
 
-def fail(msg: str) -> "NoReturn":   # noqa: F821
-    print(f"SCRAPE FAIL: {msg}")
-    sys.exit(1)
+fail = _H.fail_now                  # shared harness (tools/_selftest.py)
 
 
 def check_families(text: str, required=REQUIRED_FAMILIES) -> int:
