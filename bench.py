@@ -330,6 +330,137 @@ def bench_serving(dev, on_tpu):
           f"{slots} slots)", None)
 
 
+def bench_serving_large_batch(dev, on_tpu):
+    """Big-batch fused mega-step serving (ISSUE 10 / ROADMAP item 3):
+    128 slots, device-resident tables, packed prefill, O(active) host
+    bookkeeping — docs/SERVING.md.
+
+    - ``serving_large_batch_tokens_per_sec``: useful tok/s over a mixed
+      prompt/max_new wave at 128 slots (2x oversubscribed, shared system
+      prompt through the radix cache). SECONDARY ("higher").
+    - ``serving_step_host_share_pct``: host-side time (admit + decode
+      dispatch + prefill bookkeeping) as a share of wave wall time at 128
+      slots. The acceptance claim is SUBLINEAR growth of host us/step in
+      slot count (counter-based bookkeeping, no O(max_batch) per-step
+      scans) — an 8-slot fused engine runs the same wave shape and the
+      per-step ratio prints as a comment. SECONDARY ("lower", 5%% floor —
+      CPU tiny reads are noisy like guard_overhead_pct).
+    - ``observability_overhead_big_batch_pct``: the same 128-slot warm
+      wave fully instrumented (TraceRecorder attached, batched per-step
+      stamps — one lock acquisition per decode block, not per slot) vs
+      bare, best-of-3 interleaved. SECONDARY ("lower", 5%% floor).
+    """
+    import time as _t
+
+    import jax
+
+    from paddle_tpu.inference.serving import (ContinuousBatchingEngine,
+                                              PrefixCacheConfig, Request)
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.observability import TraceRecorder
+
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            num_hidden_layers=12, num_attention_heads=16,
+            num_key_value_heads=16, max_position_embeddings=2048,
+            dtype="bfloat16")
+        slots, prompt_len, shared_len, max_new, block, page = (
+            128, 64, 48, 64, 16, 16)
+    else:
+        cfg = LlamaConfig.tiny(num_hidden_layers=1)
+        slots, prompt_len, shared_len, max_new, block, page = (
+            128, 16, 8, 8, 4, 8)
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.default_rng(0)
+    n_req = slots * 2
+    system = rng.integers(0, cfg.vocab_size, (shared_len,)).astype(np.int32)
+    prompts = [np.concatenate([
+        system,
+        rng.integers(0, cfg.vocab_size,
+                     (prompt_len - shared_len,)).astype(np.int32)])
+        for _ in range(n_req)]
+    new_toks = [(i % 4 + 1) * max_new // 4 for i in range(n_req)]
+    useful = sum(new_toks)
+
+    def build(n_slots, tracer=None):
+        return ContinuousBatchingEngine(
+            model, max_batch=n_slots, max_len=prompt_len + max_new,
+            page_size=page, block_size=block, fused=True,
+            prefix_cache=PrefixCacheConfig(extra_blocks=n_slots),
+            tracer=tracer)
+
+    def run_wave(e, ps=None, ks=None):
+        for k in ("admit_host_s", "decode_host_s", "prefill_host_s"):
+            e.stats[k] = 0.0
+        s0 = e._step_idx
+        for p, k in zip(ps or prompts, ks or new_toks):
+            e.add_request(Request(p, max_new_tokens=k))
+        e.run_until_done(max_steps=20000)
+        return e._step_idx - s0
+
+    def timed(fn, *a):
+        t0 = _t.perf_counter()
+        fn(*a)
+        return _t.perf_counter() - t0
+
+    def host_s(e):
+        # admit_host_s already contains the prefill tick (its timer nests
+        # inside the admit window) — don't double-count prefill_host_s
+        return e.stats["admit_host_s"] + e.stats["decode_host_s"]
+
+    eng = build(slots)
+    run_wave(eng)                                  # compile + prime radix
+    dt, host, steps = float("inf"), 0.0, 1
+    for _ in range(3):                             # best-of-3, host+wall
+        t0 = _t.perf_counter()                     # from the SAME wave
+        n_steps = run_wave(eng) or 1
+        dt_w = _t.perf_counter() - t0
+        if dt_w < dt:
+            dt, host, steps = dt_w, host_s(eng), n_steps
+    share = 100.0 * host / max(dt, 1e-9)
+
+    # sublinearity reference: the SAME fused code path at 8 slots serving
+    # the same per-slot load (n_req scaled down with the slot count)
+    small = build(8)
+    sp, sk = prompts[:16], new_toks[:16]
+    run_wave(small, sp, sk)
+    run_wave(small, sp, sk)
+    small_steps = run_wave(small, sp, sk) or 1
+    small_host_us = 1e6 * host_s(small) / small_steps
+    big_host_us = 1e6 * host / steps
+    print(f"# serving big-batch host us/step: {big_host_us:.0f} at {slots} "
+          f"slots vs {small_host_us:.0f} at 8 slots -> "
+          f"{big_host_us / max(small_host_us, 1e-9):.1f}x for 16x slots "
+          f"(sublinear = counter-based bookkeeping holding)", flush=True)
+    print(f"# serving big-batch stats: packed_rows="
+          f"{eng.stats['packed_rows']} fused_updates="
+          f"{eng.stats['fused_updates']} cow={eng.stats['cow_copies']} "
+          f"compiled={eng.stats['compile_cache_entries']}", flush=True)
+    _emit("serving_large_batch_tokens_per_sec", useful / dt,
+          f"useful tok/s (fused mega-step, {slots} slots, {n_req} reqs, "
+          f"prompt {prompt_len} shared {shared_len}, max_new "
+          f"{max_new // 4}-{max_new} mixed, block {block})", None)
+    _emit("serving_step_host_share_pct", share,
+          f"% of wave wall spent host-side ({steps} steps, "
+          f"{big_host_us:.0f} us/step at {slots} slots vs "
+          f"{small_host_us:.0f} at 8)", None)
+
+    # observability at big batch: the PR 9 stamp RLock must not serialize
+    # a 128-row step — batched stamps keep this near the bare wave
+    tracer = TraceRecorder()
+    ieng = build(slots, tracer=tracer)
+    run_wave(ieng)                                 # compile + prime
+    dt_i = dt_b = float("inf")
+    for _ in range(3):
+        dt_i = min(dt_i, timed(run_wave, ieng))
+        dt_b = min(dt_b, timed(run_wave, eng))
+    pct = 100.0 * (dt_i - dt_b) / max(dt_b, 1e-9)
+    _emit("observability_overhead_big_batch_pct", max(0.0, pct),
+          f"% wave slowdown fully instrumented vs bare at {slots} slots "
+          f"(batched per-step stamps; best-of-3 interleaved)", None)
+
+
 def bench_serving_recovery(dev, on_tpu):
     """Serving resilience envelope (docs/SERVING.md): crash-recovery wall
     time and overload shed rate.
@@ -885,6 +1016,11 @@ def main():
         bench_serving(dev, on_tpu)
     except Exception as e:
         print(f"# serving bench failed: {e!r}", flush=True)
+    gc.collect()
+    try:
+        bench_serving_large_batch(dev, on_tpu)
+    except Exception as e:
+        print(f"# serving large-batch bench failed: {e!r}", flush=True)
     gc.collect()
     try:
         bench_serving_recovery(dev, on_tpu)

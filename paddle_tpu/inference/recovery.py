@@ -458,8 +458,10 @@ class ServingSupervisor:
         """Requests currently in this supervisor's engine (queued + slotted
         + mid-prefill) — the fleet router's balancing signal."""
         eng = self.engine
-        return (len(eng._queue)
-                + sum(s is not None for s in eng._slots))
+        # O(1): the engine's occupied-slot counter, not a max_batch scan —
+        # the router calls this per submit, and a 256-slot fleet would
+        # otherwise pay replicas * max_batch python work per request
+        return len(eng._queue) + eng.active_slots()
 
     def progress(self) -> tuple:
         """Progress marker for the fleet heartbeat. Changes whenever any
@@ -528,11 +530,14 @@ class ServingSupervisor:
         self.engine._drain_pending()
         self.engine._finished.clear()
         updates: List[tuple] = []
-        for rid, user in self.requests.items():
+        # iterate the LIVE twins, not every request ever submitted: this
+        # runs per step, and a long-lived supervisor accumulates finished
+        # rids in self.requests without bound (O(live) beats O(lifetime))
+        for rid, twin in list(self._live.items()):
             if rid in self._done:
                 continue
-            twin = self._live.get(rid)
-            if twin is None:
+            user = self.requests.get(rid)
+            if user is None:
                 continue
             n_user = len(user.output)
             n_twin = len(twin.output)

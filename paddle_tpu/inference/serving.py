@@ -41,6 +41,24 @@ versus the dense-cache generate it matches exactly in fp32 (CPU tests) while
 bf16-on-TPU tokens may diverge at softmax near-ties between the two attention
 kernels — the standard cross-kernel serving caveat.
 
+**Fused mega-step mode** (``fused=True``; auto at ``max_batch >= 32`` —
+docs/SERVING.md): the big-batch (128-256 slot) step loop. Block tables,
+per-slot positions, the active-row mask and the sampling state are
+DEVICE-resident and mutated only by traced scatter programs
+(``_queue_update`` -> ``_flush_updates``) — the per-step host rebuild +
+``.copy()`` upload of ``_tables_host`` is gone, which also retires the
+async-borrow hazard class (PT-TRACE-005) at the source. Decode runs as
+ONE jitted mega-step over all ``max_batch`` rows with ``jnp.where``-masked
+inactive rows (admission or completion never changes the program shape),
+sampling and the position advance stay in-graph, and prefill packs
+multiple (slot, chunk) rows into one ``paged_prefill_chunk`` call
+(``_run_pack``). Host bookkeeping is O(active): occupied slots live in a
+dict, free slots in a deque, and the per-step scans over ``max_batch``
+are gone. Token streams are byte-identical to the legacy per-slot path
+(greedy and seeded) — the fused programs run the same per-row math, and
+per-row values are independent of batch width in fp32 (the warm==cold
+argument; tests/test_serving_fused.py pins fused-vs-legacy equality).
+
 ``prefix_cache=PrefixCacheConfig(...)`` switches admission to a radix
 prefix cache over a refcounted block pool with chunked prefill
 (docs/SERVING.md): prompts sharing a system-prompt/few-shot prefix map the
@@ -132,10 +150,16 @@ class PrefixCacheConfig:
       batch, so a 2k-token admit no longer stalls every decoding slot.
     - ``extra_blocks``: pool headroom beyond the ``max_batch *
       pages_per_seq`` working set, retained for cached prefixes (0 still
-      caches — prefix SHARING itself frees blocks)."""
+      caches — prefix SHARING itself frees blocks).
+    - ``pack_rows``: fused-mode prompt-packing budget — max (slot, chunk)
+      rows per packed prefill call (default ``max(8, min(max_batch, 32))``;
+      the pack always covers at least one chunk per mid-prefill slot, so
+      this only bounds the EXTRA rows that let short prompts finish in one
+      call)."""
 
     prefill_chunk: Optional[int] = None
     extra_blocks: int = 0
+    pack_rows: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -248,6 +272,7 @@ class ContinuousBatchingEngine:
                  compile_cache_cap: int = 64,
                  shed_infeasible: bool = True,
                  brownout: Union[bool, BrownoutConfig, None] = None,
+                 fused: Optional[bool] = None,
                  tracer=None, trace_tags: Optional[Dict] = None,
                  _unsafe_overcommit: bool = False):
         self.model = model
@@ -296,6 +321,11 @@ class ContinuousBatchingEngine:
         self._ema_tok_s: Optional[float] = None
         self._sched_tokens = 0
         self._maxp = -(-max_len // page_size)
+        # fused mega-step mode (module docstring / docs/SERVING.md):
+        # device-resident tables/positions/sampling state + one jitted
+        # decode program over all rows. Auto-enabled at big batch, where
+        # per-step table uploads and O(max_batch) host scans dominate.
+        self._fused = (max_batch >= 32) if fused is None else bool(fused)
         # DRILL-ONLY knob (tools/fault_drill.py prefix_cache_exhaustion):
         # allocate past pool capacity by ripping blocks out of the radix
         # cache while live tables still map them — demonstrates the
@@ -323,10 +353,22 @@ class ContinuousBatchingEngine:
             self._jit_chunk: Dict[int, object] = {}
             self._jit_first: Dict[tuple, object] = {}
             self._cow_fn = None
+            self._jit_cow_batch: Dict[int, object] = {}
+            self._pack_rows = (max(8, min(max_batch, 32))
+                               if prefix_cache.pack_rows is None
+                               else max(1, int(prefix_cache.pack_rows)))
         else:
             self.caches = model._init_paged_caches(max_batch, max_len,
                                                    page_size)
         self._slots: List[Optional[Request]] = [None] * max_batch
+        # O(active) bookkeeping (big-batch refactor): occupied slots in a
+        # dict, free slots in a deque — per-step work is bounded by what is
+        # actually live, never by max_batch (a 256-slot engine pays those
+        # scans per token otherwise). ``_slots`` stays the authoritative
+        # slot array; these are maintained at the same chokepoints.
+        self._occupied: Dict[int, Request] = {}
+        self._free_slots: collections.deque = collections.deque(
+            range(max_batch))
         # per-slot NEXT write position (== tokens currently in the slot's cache)
         self._pos = np.zeros(max_batch, np.int32)
         # last emitted token per slot, DEVICE-resident: the decode chain never
@@ -342,6 +384,32 @@ class ContinuousBatchingEngine:
         # admission changes them (every host->device put costs a dispatch
         # through a remote runtime)
         self._samp_dev = None
+        if self._fused:
+            # device-resident per-slot step state: positions, active mask,
+            # sampling params. Admission/release mutate them ONLY through
+            # _queue_update -> _flush_updates (traced scatters applied at
+            # the next decode dispatch) — no mutable host buffer is ever
+            # handed to jnp.asarray, which retires the async-borrow hazard
+            # class (PT-TRACE-005) at the source.
+            self._dev_pos = jnp.zeros(max_batch, jnp.int32)
+            self._dev_act = jnp.zeros(max_batch, jnp.bool_)
+            self._dev_samp = (jnp.zeros(max_batch, jnp.int32),
+                              jnp.zeros(max_batch, jnp.float32),
+                              jnp.ones(max_batch, jnp.float32),
+                              jnp.zeros(max_batch, jnp.int32))
+            self._upd: Dict[int, tuple] = {}
+            self._upd_width = min(max_batch, 32)
+            self._jit_mega = None
+            self._jit_apply = None
+            if self.prefix_cache is not None:
+                # the device table starts all-parked (the legacy path
+                # builds this lazily via the dirty-flag upload; the fused
+                # path never uploads a host table at all)
+                self.caches = {"kv": self.caches["kv"],
+                               "tables": jnp.full(
+                                   (max_batch, self._maxp), self._park,
+                                   jnp.int32)}
+                self._tables_dirty = False
         self._queue: collections.deque = collections.deque()
         self._finished: Dict[int, Request] = {}
         # deadline-carrying requests currently in the system: the per-step
@@ -362,11 +430,12 @@ class ContinuousBatchingEngine:
         # telemetry, warned past ``compile_cache_cap``)
         self.stats = {"admit_host_s": 0.0, "decode_host_s": 0.0,
                       "compile_cache_entries": 0, "shed": 0,
-                      "retry_attempts": 0, "retry_giveups": 0}
+                      "retry_attempts": 0, "retry_giveups": 0,
+                      "fused_updates": 0}
         if self.prefix_cache is not None:
             self.stats.update(hit_tokens=0, miss_tokens=0, cow_copies=0,
                               evictions=0, prefill_host_s=0.0,
-                              brownouts=0, brownout_steps=0)
+                              brownouts=0, brownout_steps=0, packed_rows=0)
 
         from ..jit.api import _collect_state
 
@@ -381,7 +450,7 @@ class ContinuousBatchingEngine:
         if self.max_queue is not None and len(self._queue) >= self.max_queue:
             raise EngineSaturated(
                 f"engine queue at high-water mark ({self.max_queue} waiting, "
-                f"{sum(s is not None for s in self._slots)}/{self.max_batch} "
+                f"{len(self._occupied)}/{self.max_batch} "
                 "slots busy) — shed load or scale out")
         if len(req.prompt) + req.max_new_tokens > self.max_len:
             raise ValueError(
@@ -449,9 +518,8 @@ class ContinuousBatchingEngine:
         for r in self._queue:
             if r.priority <= req.priority:
                 backlog += r.max_new_tokens - r._n_out
-        for r in self._slots:
-            if r is not None:
-                backlog += max(0, r.max_new_tokens - r._n_out)
+        for r in self._occupied.values():   # O(active), never O(max_batch)
+            backlog += max(0, r.max_new_tokens - r._n_out)
         est = backlog / self._ema_tok_s
         if est > req.deadline_s:
             self.stats["shed"] += 1
@@ -461,7 +529,14 @@ class ContinuousBatchingEngine:
                 f"needs ~{est:.3f}s, past its {req.deadline_s:.3f}s deadline")
 
     def has_work(self) -> bool:
-        return bool(self._queue) or any(s is not None for s in self._slots)
+        return bool(self._queue) or bool(self._occupied)
+
+    def active_slots(self) -> int:
+        """Occupied slots (decoding + mid-prefill) — the O(1) counter the
+        supervisor's ``load()`` and the metrics collectors read instead of
+        scanning ``_slots`` (a 256-slot fleet pays that scan per request
+        at routing time otherwise)."""
+        return len(self._occupied)
 
     def step(self):
         """Advance active slots in ONE device program, then admit new
@@ -555,8 +630,7 @@ class ContinuousBatchingEngine:
             # then every mid-prefill slot advances by ONE chunk and newly
             # complete prompts take their first token — a long admit costs
             # each decode step one chunk of prefill, never a full prompt
-            decoding = any(r is not None and i not in self._prefill_next
-                           for i, r in enumerate(self._slots))
+            decoding = len(self._occupied) > len(self._prefill_next)
             if decoding:
                 self._decode_block()
             t0 = _time.perf_counter()
@@ -566,7 +640,7 @@ class ContinuousBatchingEngine:
             if not decoding:
                 self._decode_block()
             return
-        if not any(s is not None for s in self._slots):
+        if not self._occupied:
             t0 = _time.perf_counter()
             self._admit()
             self.stats["admit_host_s"] += _time.perf_counter() - t0
@@ -599,8 +673,9 @@ class ContinuousBatchingEngine:
                        f"{r.deadline_s:.3f}s ({r._n_out} tokens scheduled)")
             self._mark_done(r)
 
-        for i, req in enumerate(self._slots):
-            if req is not None and expired(req):
+        # O(active): walks the occupied dict, never all max_batch slots
+        for i, req in sorted(self._occupied.items()):
+            if expired(req):
                 fail(req)
                 # prefix mode: DECREFs (never frees) blocks other live
                 # tables or the radix cache still reference
@@ -622,7 +697,12 @@ class ContinuousBatchingEngine:
             self.stats["decode_host_s"] += _time.perf_counter() - t0
 
     def _decode_block_inner(self):
-        if self.prefix_cache is not None and self._tables_dirty:
+        if self._fused:
+            # device-resident state: every admission/release queued since
+            # the last block lands as ONE traced scatter program — the host
+            # never rebuilds or re-uploads a [max_batch, pages] table
+            self._flush_updates()
+        elif self.prefix_cache is not None and self._tables_dirty:
             # dynamic block tables: rows for decode-ready slots map their
             # allocated (possibly shared) pages; free and still-prefilling
             # rows point at the parking page so the scan's dummy append can
@@ -634,14 +714,13 @@ class ContinuousBatchingEngine:
             self.caches = {"kv": self.caches["kv"],
                            "tables": jnp.asarray(self._tables_host.copy())}
             self._tables_dirty = False
-        live = [(i, r) for i, r in enumerate(self._slots)
-                if r is not None and not (self.prefix_cache is not None
-                                          and i in self._prefill_next)]
+        # O(active): the decode set comes from the occupied dict (sorted for
+        # the legacy path's deterministic slot order), never a max_batch scan
+        live = [(i, r) for i, r in sorted(self._occupied.items())
+                if not (self.prefix_cache is not None
+                        and i in self._prefill_next)]
         if not live:
             return
-        active = np.zeros(self.max_batch, bool)
-        for i, _ in live:
-            active[i] = True
         # block length: never decode past a request's max_new_tokens or the
         # engine max_len (pages beyond the table would clamp-corrupt)
         cap = min(min(r.max_new_tokens - r._n_out for _, r in live),
@@ -657,74 +736,104 @@ class ContinuousBatchingEngine:
                 stretch *= 2
             n = max(n, cap if cap <= self.block_size else stretch)
         n = max(1, n)
-        # parked rows decode at position 0 over slot-local pages — harmless
-        pos_vec = jnp.asarray(np.where(active, self._pos, 1) - 1)
+        do_sample = bool(any(r.temperature > 0.0 for _, r in live))
         toks = self._last_tok
-        if self._jit_step is None:
-            from ..core import autograd_engine
-            from ..jit.api import _Swap
-
-            def run(params, toks, caches, pos_vec, seeds, temps, tops, topks,
-                    n_steps, do_sample):
-                def body(carry, _):
-                    tok, cs, pos = carry
-                    with autograd_engine.no_grad(), _Swap(self._tensors,
-                                                          params):
-                        logits, cs = self.model.paged_token_step(tok, cs, pos)
-                    if do_sample:
-                        keys = _fold_keys(seeds, pos + 1)
-                        nxt = sample_rows(logits, keys, temps, tops, topks)
-                    else:
-                        # all-greedy batches skip the sampler: its vocab-wide
-                        # argsort costs ~10 ms/token at 32k vocab (measured
-                        # 150x engine slowdown before this gate)
-                        nxt = jnp.argmax(logits, -1).astype(jnp.int32)
-                    return (nxt, cs, pos + 1), nxt
-
-                (tok, cs, _), out = jax.lax.scan(
-                    body, (toks, caches, pos_vec), None, length=n_steps)
-                return jnp.swapaxes(out, 0, 1), tok, cs
-
-            self._jit_step = jax.jit(run,
-                                     static_argnames=("n_steps", "do_sample"))
-            self._note_compiled()
-        do_sample = bool(any(self._temps[i] > 0.0 for i, _ in live))
-        if self._samp_dev is None:
-            # private snapshots: jax borrows host buffers for async
-            # transfers and these arrays mutate on admission/slot-release
-            self._samp_dev = (jnp.asarray(self._seeds.copy()),
-                              jnp.asarray(self._temps.copy()),
-                              jnp.asarray(self._tops.copy()),
-                              jnp.asarray(self._topks.copy()))
-        seeds_d, temps_d, tops_d, topks_d = self._samp_dev
         t0_tr = None if self.tracer is None else self.tracer.now()
-        out, self._last_tok, self.caches = self._jit_step(
-            self._params, toks, self.caches, pos_vec,
-            seeds_d, temps_d, tops_d, topks_d, n_steps=n,
-            do_sample=do_sample)
-        if self.tracer is not None:
-            self.tracer.decode_block(t0_tr, n, len(live),
-                                     tags=self.trace_tags)
+        if self._fused:
+            # ONE jitted mega-step over all rows: decode + sampling +
+            # position advance in-graph, inactive rows masked by the
+            # device-side act vector — admission never retraces
+            if self._jit_mega is None:
+                self._jit_mega = jax.jit(
+                    self._mega_step_fn(),
+                    static_argnames=("n_steps", "do_sample"))
+                self._note_compiled()
+            seeds_d, temps_d, tops_d, topks_d = self._dev_samp
+            out, self._last_tok, new_kv, self._dev_pos = self._jit_mega(
+                self._params, toks, self.caches["kv"],
+                self.caches["tables"], self._dev_pos, self._dev_act,
+                seeds_d, temps_d, tops_d, topks_d, n_steps=n,
+                do_sample=do_sample)
+            self.caches = {"kv": new_kv, "tables": self.caches["tables"]}
+        else:
+            active = np.zeros(self.max_batch, bool)
+            for i, _ in live:
+                active[i] = True
+            # parked rows decode at position 0 over slot-local pages —
+            # harmless
+            pos_vec = jnp.asarray(np.where(active, self._pos, 1) - 1)
+            if self._jit_step is None:
+                from ..core import autograd_engine
+                from ..jit.api import _Swap
+
+                def run(params, toks, caches, pos_vec, seeds, temps, tops,
+                        topks, n_steps, do_sample):
+                    def body(carry, _):
+                        tok, cs, pos = carry
+                        with autograd_engine.no_grad(), _Swap(self._tensors,
+                                                              params):
+                            logits, cs = self.model.paged_token_step(
+                                tok, cs, pos)
+                        if do_sample:
+                            keys = _fold_keys(seeds, pos + 1)
+                            nxt = sample_rows(logits, keys, temps, tops,
+                                              topks)
+                        else:
+                            # all-greedy batches skip the sampler: its
+                            # vocab-wide argsort costs ~10 ms/token at 32k
+                            # vocab (measured 150x engine slowdown before
+                            # this gate)
+                            nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+                        return (nxt, cs, pos + 1), nxt
+
+                    (tok, cs, _), out = jax.lax.scan(
+                        body, (toks, caches, pos_vec), None, length=n_steps)
+                    return jnp.swapaxes(out, 0, 1), tok, cs
+
+                self._jit_step = jax.jit(
+                    run, static_argnames=("n_steps", "do_sample"))
+                self._note_compiled()
+            if self._samp_dev is None:
+                # private snapshots: jax borrows host buffers for async
+                # transfers and these arrays mutate on admission/slot-release
+                self._samp_dev = (jnp.asarray(self._seeds.copy()),
+                                  jnp.asarray(self._temps.copy()),
+                                  jnp.asarray(self._tops.copy()),
+                                  jnp.asarray(self._topks.copy()))
+            seeds_d, temps_d, tops_d, topks_d = self._samp_dev
+            out, self._last_tok, self.caches = self._jit_step(
+                self._params, toks, self.caches, pos_vec,
+                seeds_d, temps_d, tops_d, topks_d, n_steps=n,
+                do_sample=do_sample)
+        t1_tr = None if self.tracer is None else self.tracer.now()
         if async_ok:
             entries = []
+            tok_marks = [] if self.tracer is not None else None
             for i, req in live:
                 took = min(n, req.max_new_tokens - req._n_out)
                 entries.append((i, req, took))
                 req._n_out += took
                 self._sched_tokens += took
-                if self.tracer is not None:
-                    self.tracer.tokens(req.rid, req._n_out, self.trace_tags)
+                if tok_marks is not None:
+                    tok_marks.append((req.rid, req._n_out))
                 self._pos[i] += took
                 if req._n_out >= req.max_new_tokens:
                     req.done = True
                     self._mark_done(req)
                     self._release_slot(i)   # slot + its pages are free again
+            if self.tracer is not None:
+                # ONE lock acquisition for the whole block's stamps — the
+                # PR 9 recorder RLock must not serialize a 256-row step
+                self.tracer.decode_block_batch(t0_tr, n, len(live),
+                                               tok_marks, t1=t1_tr,
+                                               tags=self.trace_tags)
             self._pending.append((out, entries))
             return
         # eos path: materialize (in generation order — drain older pendings
         # first so req.output stays ordered across an async->sync transition)
         self._drain_pending()
         out = np.asarray(out)
+        tok_marks = [] if self.tracer is not None else None
         for i, req in live:
             took = 0
             for j in range(n):
@@ -738,11 +847,14 @@ class ContinuousBatchingEngine:
                     break
             self._pos[i] += took
             self._sched_tokens += took
-            if self.tracer is not None:
-                self.tracer.tokens(req.rid, req._n_out, self.trace_tags)
+            if tok_marks is not None:
+                tok_marks.append((req.rid, req._n_out))
             if req.done:
                 self._mark_done(req)
                 self._release_slot(i)       # slot + its pages are free again
+        if self.tracer is not None:
+            self.tracer.decode_block_batch(t0_tr, n, len(live), tok_marks,
+                                           t1=t1_tr, tags=self.trace_tags)
 
     def run_until_done(self, max_steps: int = 100000):
         steps = 0
@@ -753,6 +865,11 @@ class ContinuousBatchingEngine:
 
     def finished(self) -> Dict[int, Request]:
         self._drain_pending()
+        if self._fused:
+            # control plane: land any queued release scatters so a drained
+            # engine's device state (act mask / parked tables) is actually
+            # drained, not pending the next decode dispatch
+            self._flush_updates()
         # retry-registry snapshot rides here (control plane), NOT in step():
         # a per-step dict copy was measurable on the decode hot path
         if self._retry_stats_fn is None:
@@ -815,7 +932,12 @@ class ContinuousBatchingEngine:
         """Free slot ``i``. Prefix mode DECREFS the slot's blocks (a shared
         prefix block stays alive while any other table or the radix cache
         references it — freeing it would corrupt the survivors) and parks
-        the slot's decode-table row."""
+        the slot's decode-table row (fused mode: via the next traced
+        scatter — freed pages may be re-mapped by the very next admission,
+        and the inactive row's dummy append must never touch them)."""
+        if self._slots[i] is not None:
+            self._occupied.pop(i, None)
+            self._free_slots.append(i)
         self._slots[i] = None
         self._pos[i] = 0
         self._temps[i] = 0.0
@@ -826,8 +948,157 @@ class ContinuousBatchingEngine:
             self._slot_blocks[i] = None
             self._slot_rows[i] = None
             self._prefill_next.pop(i, None)
-            self._tables_host[i] = self._park
-            self._tables_dirty = True
+            if self._fused:
+                # the device table (caches["tables"], scatter-updated) is
+                # authoritative in fused mode — don't maintain a host
+                # mirror that could silently drift from it
+                self._queue_update(i, None, 0, False)
+            else:
+                self._tables_host[i] = self._park
+                self._tables_dirty = True
+        elif self._fused:
+            self._queue_update(i, None, 0, False)
+
+    # -- fused mega-step machinery (module docstring / docs/SERVING.md) ----
+    def _queue_update(self, slot: int, row, pos: int, act: bool,
+                      seed: int = 0, temp: float = 0.0, top_p: float = 1.0,
+                      top_k: int = 0):
+        """Queue one slot's device-state change (activation or release).
+        The LATEST update per slot wins — a release followed by a re-admit
+        of the same slot in one step collapses to the admit — and
+        everything queued lands as ONE traced scatter program at the next
+        decode dispatch. ``row=None`` means the parking row (release) or
+        an unchanged static table (legacy-layout engines)."""
+        self._upd[slot] = (None if row is None else np.asarray(row, np.int32),
+                           int(pos), bool(act), int(seed), float(temp),
+                           float(top_p), int(top_k))
+
+    def _flush_updates(self):
+        """Apply queued slot updates to the device-resident step state in
+        bounded-width batches of ONE scatter program each. Padding entries
+        carry index ``max_batch`` — jax drops out-of-bounds scatter
+        updates, so a single compiled program serves every update count."""
+        if not self._upd:
+            return
+        items = list(self._upd.items())
+        self._upd.clear()
+        if self._jit_apply is None:
+            with_tables = self.prefix_cache is not None
+
+            def apply(tables, pos, act, seeds, temps, tops, topks, idx,
+                      urows, upos, uact, useeds, utemps, utops, utopks):
+                if with_tables:
+                    tables = tables.at[idx].set(urows)
+                return (tables, pos.at[idx].set(upos),
+                        act.at[idx].set(uact), seeds.at[idx].set(useeds),
+                        temps.at[idx].set(utemps), tops.at[idx].set(utops),
+                        topks.at[idx].set(utopks))
+
+            self._jit_apply = jax.jit(apply)
+            self._note_compiled()
+        W = self._upd_width
+        with_tables = self.prefix_cache is not None
+        for lo in range(0, len(items), W):
+            batch = items[lo:lo + W]
+            idx = np.full(W, self.max_batch, np.int32)
+            # legacy-layout engines have static slot-owned tables: the
+            # apply program ignores urows, so don't build/upload the
+            # [W, maxp] buffer at all (a 1-element dummy keeps the
+            # signature)
+            urows = (np.full((W, self._maxp), self._park, np.int32)
+                     if with_tables else np.zeros((1, 1), np.int32))
+            upos = np.zeros(W, np.int32)
+            uact = np.zeros(W, bool)
+            useeds = np.zeros(W, np.int32)
+            utemps = np.zeros(W, np.float32)
+            utops = np.ones(W, np.float32)
+            utopks = np.zeros(W, np.int32)
+            for j, (slot, (row, pos, act, seed, temp, top_p, top_k)) in \
+                    enumerate(batch):
+                idx[j] = slot
+                if with_tables and row is not None:
+                    urows[j] = row
+                upos[j] = pos
+                uact[j] = act
+                useeds[j] = seed
+                utemps[j] = temp
+                utops[j] = top_p
+                utopks[j] = top_k
+            seeds_d, temps_d, tops_d, topks_d = self._dev_samp
+            tables, self._dev_pos, self._dev_act, s, t, p, k = \
+                self._jit_apply(self.caches["tables"], self._dev_pos,
+                                self._dev_act, seeds_d, temps_d, tops_d,
+                                topks_d, idx, urows, upos, uact, useeds,
+                                utemps, utops, utopks)
+            self._dev_samp = (s, t, p, k)
+            self.caches = {"kv": self.caches["kv"], "tables": tables}
+            self.stats["fused_updates"] += len(batch)
+
+    def _mega_step_fn(self):
+        """The fused mega-step program (tools/lint_graph.py records and
+        lints this — the one program a 128-256-slot engine dispatches per
+        decode block): decode ``n_steps`` tokens for every row at per-row
+        positions, sample in-graph, and advance the device-side positions,
+        with inactive rows masked by the ``act`` vector (they step a
+        parked dummy row whose output the host ignores) — so admissions
+        and completions never change the program shape and never retrace.
+        The per-row math is IDENTICAL to the legacy ``_jit_step`` body,
+        which is what makes fused-vs-legacy token streams byte-identical."""
+        from ..core import autograd_engine
+        from ..jit.api import _Swap
+
+        def run(params, toks, kv, tables, pos, act, seeds, temps, tops,
+                topks, n_steps, do_sample):
+            caches = {"kv": kv, "tables": tables}
+            pos_vec = jnp.where(act, pos, 1) - 1
+
+            def body(carry, _):
+                tok, cs, p = carry
+                with autograd_engine.no_grad(), _Swap(self._tensors, params):
+                    logits, cs = self.model.paged_token_step(tok, cs, p)
+                if do_sample:
+                    keys = _fold_keys(seeds, p + 1)
+                    nxt = sample_rows(logits, keys, temps, tops, topks)
+                else:
+                    nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+                return (nxt, cs, p + 1), nxt
+
+            (tok, cs, _), out = jax.lax.scan(
+                body, (toks, caches, pos_vec), None, length=n_steps)
+            new_pos = jnp.where(act, pos + n_steps, pos)
+            return jnp.swapaxes(out, 0, 1), tok, cs["kv"], new_pos
+
+        return run
+
+    def _cow_copy_batch(self, pairs):
+        """All of an admission wave's COW copies in ONE device dispatch
+        (the legacy path copies per admission). Padded to a power-of-two
+        width with park->park self-copies so the compiled-program set
+        stays O(log max_batch); the sources stay pinned (incref'd by
+        ``_try_admit_prefix``) until the copy is dispatched — ``evict_lru``
+        under a later admission in the same wave must not reclaim them
+        first."""
+        from ..ops.paged_attention import copy_pages
+
+        W = 1
+        while W < len(pairs):
+            W *= 2
+        fn = self._jit_cow_batch.get(W)
+        if fn is None:
+            def run(kv, src, dst):
+                return [copy_pages(k, v, src, dst) for (k, v) in kv]
+
+            fn = self._jit_cow_batch[W] = jax.jit(run)
+            self._note_compiled()
+        src = np.full(W, self._park, np.int32)
+        dst = np.full(W, self._park, np.int32)
+        for j, (s, d) in enumerate(pairs):
+            src[j] = s
+            dst[j] = d
+        self.caches = {"kv": fn(self.caches["kv"], jnp.asarray(src),
+                                jnp.asarray(dst)),
+                       "tables": self.caches["tables"]}
+        self._alloc.decref([s for s, _ in pairs])
 
     def _pages_needed(self, prompt_len: int, max_new: int) -> int:
         return -(-(prompt_len + max_new) // self.page_size)
@@ -840,9 +1111,11 @@ class ContinuousBatchingEngine:
         past ``compile_cache_cap``. (``_jit_step`` counts as one entry; its
         n_steps variants live in jax's own jit cache.)"""
         n = len(self._jit_prefill) + (self._jit_step is not None)
+        if self._fused:
+            n += (self._jit_mega is not None) + (self._jit_apply is not None)
         if self.prefix_cache is not None:
             n += (len(self._jit_chunk) + len(self._jit_first)
-                  + (self._cow_fn is not None))
+                  + (self._cow_fn is not None) + len(self._jit_cow_batch))
         self.stats["compile_cache_entries"] = n
         if n > self.compile_cache_cap:
             import warnings
@@ -870,22 +1143,27 @@ class ContinuousBatchingEngine:
         (tools/fault_drill.py drills exactly this)."""
         from ..distributed.resilience.faults import resource_hold
 
-        free = [i for i in range(self.max_batch) if self._slots[i] is None]
-        while free and self._queue:
+        if not self._queue:
+            return
+        cow_wave = [] if self._fused else None
+        while self._free_slots and self._queue:
             req = self._queue[0]
             held = resource_hold("serving.block_pool", f"rid:{req.rid}")
             if held:
                 self._alloc.hold(held)
-            if not self._try_admit_prefix(free[0], req):
+            if not self._try_admit_prefix(self._free_slots[0], req, cow_wave):
                 # deferral = the pool could not serve the head even after
                 # LRU eviction — the brownout pressure signal
                 self._deferred_step = True
                 break
             self._queue.popleft()
-            free.pop(0)
+            self._free_slots.popleft()
+        if cow_wave:
+            self._cow_copy_batch(cow_wave)
         self.stats["evictions"] = self._radix.evictions
 
-    def _try_admit_prefix(self, slot: int, req: "Request") -> bool:
+    def _try_admit_prefix(self, slot: int, req: "Request",
+                          cow_wave=None) -> bool:
         page = self.page_size
         prompt = req.prompt
         n_full = len(prompt) // page
@@ -920,9 +1198,15 @@ class ContinuousBatchingEngine:
         cached = len(matched) * page
         if cow_src is not None:
             dst = fresh[0]
-            self._cow_copy(cow_src, dst)
+            if cow_wave is None:
+                self._cow_copy(cow_src, dst)
+                self._alloc.decref([cow_src])  # copy done — unpin the source
+            else:
+                # fused: the whole admission wave's COW copies batch into
+                # one program (_cow_copy_batch); the source stays pinned
+                # until that dispatch so eviction cannot reclaim it first
+                cow_wave.append((cow_src, dst))
             self.stats["cow_copies"] += 1
-            self._alloc.decref([cow_src])      # copy done — unpin the source
             blocks = matched + [dst] + fresh[1:]
             cached = len(prompt)
         else:
@@ -932,6 +1216,7 @@ class ContinuousBatchingEngine:
         self._slot_rows[slot] = row
         self._slot_blocks[slot] = blocks
         self._slots[slot] = req
+        self._occupied[slot] = req
         # next uncached write position; == len(prompt) means straight to
         # the first-token re-step. The slot joins the decode batch (and the
         # device-side table) only once prefill completes.
@@ -998,7 +1283,17 @@ class ContinuousBatchingEngine:
         try:
             chunkers = [(s, self._slots[s]) for s in sorted(self._prefill_next)
                         if self._prefill_next[s] < len(self._slots[s].prompt)]
-            if chunkers:
+            if chunkers and self._fused:
+                # prompt-packing prefill (_run_pack): several short prompts
+                # — and several chunks of one long prompt — advance in ONE
+                # call per step instead of one chunk per slot per step
+                self._run_pack(chunkers)
+                while self._brownout_active and any(
+                        self._prefill_next[s] < len(r.prompt)
+                        for s, r in chunkers):
+                    self._run_pack([(s, r) for s, r in chunkers
+                                    if self._prefill_next[s] < len(r.prompt)])
+            elif chunkers:
                 self._run_chunk(chunkers)
                 while self._brownout_active and any(
                         self._prefill_next[s] < len(r.prompt)
@@ -1017,18 +1312,12 @@ class ContinuousBatchingEngine:
         finally:
             self.stats["prefill_host_s"] += _time.perf_counter() - t0
 
-    def _run_chunk(self, group):
-        C = self._chunk_tokens
-        g = len(group)
-        t0_tr = None if self.tracer is None else self.tracer.now()
-        ids = np.zeros((g, C), np.int32)
-        starts = np.zeros(g, np.int32)
-        rows = np.stack([self._slot_rows[s] for s, _ in group])
-        for r, (s, req) in enumerate(group):
-            nxt = self._prefill_next[s]
-            chunk = req.prompt[nxt: nxt + C]
-            ids[r, : len(chunk)] = chunk
-            starts[r] = nxt
+    def _chunk_fn(self, g: int):
+        """The compiled prefill-chunk program for ``g`` rows — shared by
+        the legacy one-chunk-per-slot path (``_run_chunk``) and the fused
+        packed path (``_run_pack``): both dispatch the same
+        (params, ids, kv, rows, starts) program, they only lay the rows
+        out differently."""
         fn = self._jit_chunk.get(g)
         if fn is None:
             from ..core import autograd_engine
@@ -1042,6 +1331,21 @@ class ContinuousBatchingEngine:
 
             fn = self._jit_chunk[g] = jax.jit(run)
             self._note_compiled()
+        return fn
+
+    def _run_chunk(self, group):
+        C = self._chunk_tokens
+        g = len(group)
+        t0_tr = None if self.tracer is None else self.tracer.now()
+        ids = np.zeros((g, C), np.int32)
+        starts = np.zeros(g, np.int32)
+        rows = np.stack([self._slot_rows[s] for s, _ in group])
+        for r, (s, req) in enumerate(group):
+            nxt = self._prefill_next[s]
+            chunk = req.prompt[nxt: nxt + C]
+            ids[r, : len(chunk)] = chunk
+            starts[r] = nxt
+        fn = self._chunk_fn(g)
         new_kv = fn(self._params, jnp.asarray(ids), self.caches["kv"],
                     jnp.asarray(rows), jnp.asarray(starts))
         self.caches = {"kv": new_kv, "tables": self.caches["tables"]}
@@ -1055,20 +1359,92 @@ class ContinuousBatchingEngine:
                     req.rid, t0_tr, self._prefill_next[s] - nxt,
                     tags=self.trace_tags)
 
+    def _run_pack(self, group):
+        """Prompt-packing prefill (fused mode): flatten (slot, chunk)
+        pairs into the rows of ONE ``paged_prefill_chunk`` call — several
+        short prompts complete their whole prefill, and a long prompt
+        advances several chunks, in a single device program instead of
+        one chunk per slot per step.
+
+        Safe by the same absolute-position-masking argument as chunked
+        prefill (``ops.paged_prefill_attention``): every row's k/v is
+        appended before any row's attention gathers, and a query attends
+        exactly the keys at positions <= its own — so a later chunk of
+        the same prompt reads the earlier chunk's pages written IN THE
+        SAME program, bit-identical to running the chunks sequentially.
+        Rows are assigned breadth-first (one chunk per slot per pass), so
+        every mid-prefill slot advances at least one chunk per step — the
+        legacy interleaving guarantee — and ``PrefixCacheConfig.pack_rows``
+        bounds the extra rows. Row counts are bucketed to powers of two
+        with parked dummy rows, so admission-width churn at 128+ slots
+        compiles O(log max_batch) variants, not one per width."""
+        C = self._chunk_tokens
+        budget = max(len(group), self._pack_rows)
+        offs = {s: self._prefill_next[s] for s, _ in group}
+        rows = []
+        progress = True
+        while len(rows) < budget and progress:
+            progress = False
+            for s, req in group:
+                if len(rows) >= budget:
+                    break
+                if offs[s] < len(req.prompt):
+                    rows.append((s, req, offs[s]))
+                    offs[s] = min(offs[s] + C, len(req.prompt))
+                    progress = True
+        g = 1
+        while g < len(rows):
+            g *= 2
+        t0_tr = None if self.tracer is None else self.tracer.now()
+        ids = np.zeros((g, C), np.int32)
+        starts = np.zeros(g, np.int32)
+        trows = np.full((g, self._maxp), self._park, np.int32)
+        for r, (s, req, off) in enumerate(rows):
+            chunk = req.prompt[off: off + C]
+            ids[r, : len(chunk)] = chunk
+            starts[r] = off
+            trows[r] = self._slot_rows[s]
+        fn = self._chunk_fn(g)
+        new_kv = fn(self._params, jnp.asarray(ids), self.caches["kv"],
+                    jnp.asarray(trows), jnp.asarray(starts))
+        self.caches = {"kv": new_kv, "tables": self.caches["tables"]}
+        self.stats["packed_rows"] += len(rows)
+        for s, req in group:
+            nxt = self._prefill_next[s]
+            if offs[s] > nxt:
+                self._prefill_next[s] = offs[s]
+                if self.tracer is not None:
+                    self.tracer.prefill_chunk(req.rid, t0_tr, offs[s] - nxt,
+                                              tags=self.trace_tags)
+
     def _first_token(self, ready):
         """Re-step the last REAL prompt token at its true position (k/v
         rewrite into a private/COW block, logits over exactly the real
         prompt) and sample the first token — the chunked-path analogue of
         the legacy bucketed re-step; then register the prompt's full blocks
-        in the radix cache and promote the slot into the decode batch."""
+        in the radix cache and promote the slot into the decode batch
+        (fused mode: activation rides the next traced scatter, and group
+        widths are bucketed to powers of two — dummy rows re-step the
+        parking page at position 0 and scatter to slot index ``max_batch``,
+        which jax drops — so admission-wave width churn never retraces)."""
         g = len(ready)
+        if self._fused:
+            g = 1
+            while g < len(ready):
+                g *= 2
         do_sample = any(r.temperature > 0.0 for _, r in ready)
-        last = np.array([r.prompt[-1] for _, r in ready], np.int32)
-        rows = np.stack([self._slot_rows[s] for s, _ in ready])
-        ints = np.asarray([[len(r.prompt), r.seed, r.top_k, s]
-                           for s, r in ready], np.int32)
-        floats = np.asarray([[r.temperature, r.top_p] for _, r in ready],
-                            np.float32)
+        last = np.zeros(g, np.int32)
+        rows = np.full((g, self._maxp), self._park, np.int32)
+        ints = np.zeros((g, 4), np.int32)
+        ints[:, 0] = 1                       # dummy rows re-step position 0
+        ints[:, 3] = self.max_batch          # dummy scatter index: dropped
+        floats = np.zeros((g, 2), np.float32)
+        floats[:, 1] = 1.0
+        for r, (s, req) in enumerate(ready):
+            last[r] = req.prompt[-1]
+            rows[r] = self._slot_rows[s]
+            ints[r] = (len(req.prompt), req.seed, req.top_k, s)
+            floats[r] = (req.temperature, req.top_p)
         fn = self._jit_first.get((g, do_sample))
         if fn is None:
             from ..core import autograd_engine
@@ -1101,6 +1477,7 @@ class ContinuousBatchingEngine:
         any_eos = any(r.eos_token_id is not None for _, r in ready)
         firsts = np.asarray(firsts_dev) if any_eos else None
         entries = []
+        ft_marks = [] if self.tracer is not None else None
         for row, (slot, req) in enumerate(ready):
             n_full = len(req.prompt) // self.page_size
             if n_full and not self._brownout_active:
@@ -1118,16 +1495,28 @@ class ContinuousBatchingEngine:
             self._seeds[slot] = req.seed
             req._n_out += 1
             self._sched_tokens += 1
-            if self.tracer is not None:
-                self.tracer.first_token(req.rid, self.trace_tags)
-                self.tracer.tokens(req.rid, req._n_out, self.trace_tags)
+            if ft_marks is not None:
+                ft_marks.append((req.rid, req._n_out))
             self._pos[slot] = len(req.prompt) + 1
-            self._tables_host[slot] = self._slot_rows[slot]
-            self._tables_dirty = True
+            if self._fused:
+                # activation rides the next traced scatter: table row,
+                # position, active flag and sampling params in one update
+                # (no host-table mirror — the device table is authoritative)
+                self._queue_update(slot, self._slot_rows[slot],
+                                   len(req.prompt) + 1, True, req.seed,
+                                   req.temperature, req.top_p, req.top_k)
+            else:
+                self._tables_host[slot] = self._slot_rows[slot]
+                self._tables_dirty = True
             if firsts is not None:
                 req.output.append(int(firsts[row]))
             else:
                 entries.append((row, req, 1))
+        if ft_marks:
+            # one lock acquisition for the whole admission wave's
+            # first-token + token stamps (not one per slot)
+            self.tracer.first_tokens(ft_marks, tags=self.trace_tags)
+        for row, (slot, req) in enumerate(ready):
             if ((firsts is not None and req.eos_token_id is not None
                  and int(firsts[row]) == req.eos_token_id)
                     or req._n_out >= req.max_new_tokens):
@@ -1142,10 +1531,11 @@ class ContinuousBatchingEngine:
         per prompt bucket (per-request prefills pay a full host round trip
         each through a remote runtime; batching amortizes it and runs the
         prompt chunks as one device program)."""
-        free = [i for i in range(self.max_batch) if self._slots[i] is None]
+        if not self._queue:
+            return
         take = []
-        while free and self._queue:
-            take.append((free.pop(0), self._queue.popleft()))
+        while self._free_slots and self._queue:
+            take.append((self._free_slots.popleft(), self._queue.popleft()))
         if not take:
             return
         # group by (bucket, padded?): exact-length rows must take the
@@ -1171,12 +1561,14 @@ class ContinuousBatchingEngine:
             any_eos = any(r.eos_token_id is not None for _, r in grp)
             firsts = np.asarray(firsts_dev) if any_eos else None
             entries = []
+            ft_marks = [] if self.tracer is not None else None
             for row, (slot, req) in enumerate(grp):
                 self._temps[slot] = req.temperature
                 self._tops[slot] = req.top_p
                 self._topks[slot] = req.top_k
                 self._seeds[slot] = req.seed
                 self._slots[slot] = req
+                self._occupied[slot] = req
                 req._n_out += 1
                 self._sched_tokens += 1
                 if self.tracer is not None:
@@ -1185,13 +1577,22 @@ class ContinuousBatchingEngine:
                                       now - (req._enqueued_at or now),
                                       miss_tokens=len(req.prompt),
                                       tags=self.trace_tags)
-                    self.tracer.first_token(req.rid, self.trace_tags)
-                    self.tracer.tokens(req.rid, req._n_out, self.trace_tags)
+                    ft_marks.append((req.rid, req._n_out))
                 self._pos[slot] = len(req.prompt) + 1
+                if self._fused:
+                    # static slot-owned tables in legacy layout: activation
+                    # only flips act/pos/sampling via the traced scatter
+                    self._queue_update(slot, None, len(req.prompt) + 1, True,
+                                       req.seed, req.temperature, req.top_p,
+                                       req.top_k)
                 if firsts is not None:
                     req.output.append(int(firsts[row]))
                 else:
                     entries.append((row, req, 1))
+            if ft_marks:
+                # one lock acquisition for the group's first-token stamps
+                self.tracer.first_tokens(ft_marks, tags=self.trace_tags)
+            for row, (slot, req) in enumerate(grp):
                 if ((firsts is not None and req.eos_token_id is not None
                      and int(firsts[row]) == req.eos_token_id)
                         or req._n_out >= req.max_new_tokens):

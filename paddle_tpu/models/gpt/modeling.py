@@ -328,7 +328,11 @@ class GPTForCausalLM(GenerationMixin, Layer):
 
     def paged_token_step(self, toks, caches, pos_vec):
         """Continuous-batching hook (see inference/serving.py): one token per
-        slot at per-slot positions."""
+        slot at per-slot positions. Same parked-row contract as the llama
+        hook: inactive rows run at pos_vec == 0 over a parking-page table
+        (their dummy append and logits are inert), and the body stays
+        shape-static in the row count — the fused mega-step scans it over
+        all max_batch rows."""
         cfg = self.config
         posc = jnp.clip(pos_vec, 0, cfg.max_position_embeddings - 1)
         x = (jnp.take(self.gpt.wte._data, toks[:, None], axis=0)
@@ -344,7 +348,10 @@ class GPTForCausalLM(GenerationMixin, Layer):
 
     def paged_prefill_chunk(self, ids, caches, starts):
         """Serving hook (see the llama analogue): one prefill chunk per row
-        at per-row absolute offsets over cached history; returns caches."""
+        at per-row absolute offsets over cached history; returns caches.
+        Honors the packed-rows contract (``_run_pack``): rows may share
+        one sequence's table at different starts, and k/v appends land
+        before any row's attention gathers per layer."""
         ids = _raw(ids)
         b, s = ids.shape
         positions = jnp.clip(starts[:, None] + jnp.arange(s)[None, :], 0,

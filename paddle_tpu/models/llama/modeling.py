@@ -648,7 +648,15 @@ class LlamaForCausalLM(GenerationMixin, Layer):
     def paged_token_step(self, toks, caches, pos_vec):
         """Continuous-batching hook: ONE token per slot at per-slot positions.
         toks [b] int32, pos_vec [b] int32, caches from _init_paged_caches.
-        Returns (logits [b, vocab] f32, caches)."""
+        Returns (logits [b, vocab] f32, caches).
+
+        Contract the serving engine's fused mega-step leans on
+        (inference/serving.py): inactive rows arrive at pos_vec == 0 with
+        their table row pointing at a parking page — the dummy k/v append
+        must land wherever THAT table maps (never a page another row
+        shares), and the row's logits are computed but ignored. This body
+        runs inside a lax.scan over all max_batch rows; everything here
+        must stay shape-static in the row count."""
         cfg = self.config
         model = self.model
         x = jnp.take(model.embed_tokens_weight._data, toks[:, None], axis=0)
@@ -677,7 +685,16 @@ class LlamaForCausalLM(GenerationMixin, Layer):
         chunked-prefill path — inference/serving.py). ids [b, s] int32,
         starts [b] int32; returns updated caches only (the first sampled
         token comes from the subsequent paged_token_step re-step, so no
-        lm-head work here)."""
+        lm-head work here).
+
+        Packed-rows contract (the fused engine's ``_run_pack``): several
+        rows may carry the SAME sequence's table at different ``starts``
+        (multiple chunks of one prompt in one call), plus parked dummy
+        rows. Per layer, every row's k/v is appended BEFORE attention
+        gathers — so a later chunk reads an earlier chunk's pages written
+        in this very program; the absolute-position mask keeps the result
+        bit-identical to sequential chunk calls (see
+        ops.paged_prefill_attention)."""
         cfg = self.config
         model = self.model
         x = jnp.take(model.embed_tokens_weight._data, ids, axis=0)

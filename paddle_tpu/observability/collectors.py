@@ -71,7 +71,7 @@ def engine_collector(engine, **labels):
             "requests waiting for a slot").add(len(engine._queue), **labels))
         fams.append(MetricFamily(
             "pt_engine_busy_slots", "gauge").add(
-            sum(s is not None for s in engine._slots), **labels))
+            engine.active_slots(), **labels))
         fams.append(MetricFamily("pt_engine_max_batch", "gauge").add(
             engine.max_batch, **labels))
         fams.append(MetricFamily(

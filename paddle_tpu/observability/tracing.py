@@ -280,6 +280,28 @@ class TraceRecorder:
         self.span("decode_block", None, t0, t1, tags,
                   n_steps=int(n_steps), slots=int(slots))
 
+    def decode_block_batch(self, t0: float, n_steps: int, slots: int,
+                           items, t1: Optional[float] = None,
+                           tags: Optional[dict] = None) -> None:
+        """One decode block's full stamp set — the block span plus every
+        row's token progress — under a SINGLE lock acquisition (the
+        big-batch step path; per-slot locking is O(slots) contention per
+        block)."""
+        with self._lock:
+            self.decode_block(t0, n_steps, slots, t1, tags)
+            if items:
+                for rid, total in items:
+                    self.tokens(rid, total, tags)
+
+    def first_tokens(self, items, tags: Optional[dict] = None) -> None:
+        """Batched first-token stamps for an admission wave: per rid the
+        first-token instant (+TTFT) and the token progress, all under one
+        lock acquisition. ``items``: ``(rid, total)`` pairs."""
+        with self._lock:
+            for rid, total in items:
+                self.first_token(rid, tags)
+                self.tokens(rid, total, tags)
+
     def finish(self, rid: int, n_out: int, failed: bool = False,
                error: Optional[str] = None, kind: Optional[str] = None,
                tags: Optional[dict] = None) -> None:

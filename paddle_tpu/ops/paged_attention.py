@@ -267,7 +267,13 @@ def paged_prefill_attention(q, k_cache, v_cache, block_tables, chunk_starts,
     chunked or how much of it came from the prefix cache — the property the
     serving engine's warm==cold token-equality guarantee rests on (the
     engine's module docstring scopes what "same bytes" means at re-stepped
-    block-final positions). Stays an XLA gather+einsum (no Pallas
+    block-final positions). Rows are independent, so several rows may SHARE
+    one sequence's block table at different ``chunk_starts`` — the fused
+    engine's prompt-packing prefill flattens (slot, chunk) pairs into the
+    rows of one call; because every row's k/v is appended before any row's
+    gather, a later chunk reads an earlier chunk's pages written in the
+    same program, bit-identical to sequential chunk calls.
+    Stays an XLA gather+einsum (no Pallas
     kernel): prefill is projection/MLP-bound at serving chunk sizes and this
     runs once per admitted chunk, unlike the per-token decode kernel."""
     b, s, hq, d = q.shape
@@ -296,14 +302,18 @@ def paged_prefill_attention(q, k_cache, v_cache, block_tables, chunk_starts,
 
 
 def copy_pages(k_cache, v_cache, src, dst):
-    """Copy ONE page ``src`` -> ``dst`` across a (k, v) pool pair — the
-    copy-on-write primitive for shared prefix blocks. Traced-index friendly:
-    one compiled program serves every (src, dst)."""
-    src = jnp.asarray(src)
-    k_cache = jax.lax.dynamic_update_index_in_dim(
-        k_cache, jax.lax.dynamic_index_in_dim(k_cache, src, 0, False), dst, 0)
-    v_cache = jax.lax.dynamic_update_index_in_dim(
-        v_cache, jax.lax.dynamic_index_in_dim(v_cache, src, 0, False), dst, 0)
+    """Copy page(s) ``src`` -> ``dst`` across a (k, v) pool pair — the
+    copy-on-write primitive for shared prefix blocks. Traced-index
+    friendly: one compiled program serves every (src, dst). Accepts a
+    scalar pair (the legacy per-admission COW) or equal-length index
+    vectors (the fused engine batches a whole admission wave's COW copies
+    into one dispatch, padding with park->park self-copies — duplicate
+    destinations among the pads write identical bytes, so the scatter
+    stays deterministic)."""
+    src = jnp.atleast_1d(jnp.asarray(src, jnp.int32))
+    dst = jnp.atleast_1d(jnp.asarray(dst, jnp.int32))
+    k_cache = k_cache.at[dst].set(k_cache[src])
+    v_cache = v_cache.at[dst].set(v_cache[src])
     return k_cache, v_cache
 
 

@@ -150,7 +150,7 @@ def test_graph_lint_gate_detects_seeded_defects():
          "--selftest", "--family", "bert"],
         capture_output=True, text=True, env=env, cwd=ROOT, timeout=500)
     assert r.returncode == 0, r.stdout + r.stderr
-    assert "SELFTEST OK: 8 defect classes detected" in r.stdout
+    assert "SELFTEST OK: 9 defect classes detected" in r.stdout
     r2 = subprocess.run(
         [sys.executable, os.path.join(ROOT, "tools", "lint_graph.py"),
          "--inject", "shape_mismatch", "--family", "bert"],
@@ -191,15 +191,16 @@ def test_concurrency_lint_gate_detects_seeded_defects():
     assert "PT-RACE-003" in r2.stdout
 
 
-@pytest.mark.slow   # ~3min of engine/train-loop compiles across 15 classes
+@pytest.mark.slow   # ~3min of engine/train-loop compiles across 16 classes
 def test_fault_drill_matrix():
     """Resilience gate (docs/RESILIENCE.md + docs/NUMERIC_GUARD.md +
     docs/SERVING.md): the seeded fault matrix — heartbeat loss, store
     stall, shard corruption, engine saturation, serving deadline,
-    prefix-cache block-pool exhaustion, serving engine crash mid-decode,
-    serving step stall, overload shed, fleet replica kill, fleet rolling
-    drain/restart, fleet overload brownout, NaN gradient, loss spike,
-    poisoned batch — must be absorbed with recovery enabled AND flip the exit code
+    prefix-cache block-pool exhaustion, 128-slot fused big-batch
+    saturation, serving engine crash mid-decode, serving step stall,
+    overload shed, fleet replica kill, fleet rolling drain/restart, fleet
+    overload brownout, NaN gradient, loss spike, poisoned batch — must be
+    absorbed with recovery enabled AND flip the exit code
     with recovery disabled. Runs in a subprocess (the drill forces the
     pure-Python store daemon for server-side faults).
 
@@ -215,7 +216,7 @@ def test_fault_drill_matrix():
          "--selftest"],
         capture_output=True, text=True, env=env, cwd=ROOT, timeout=500)
     assert r.returncode == 0, r.stdout + r.stderr
-    assert "FAULT DRILL OK: 15 fault classes" in r.stdout, r.stdout
+    assert "FAULT DRILL OK: 16 fault classes" in r.stdout, r.stdout
 
 
 def test_fault_drill_single_drill_exit_codes():

@@ -1,0 +1,288 @@
+"""Fused mega-step serving (inference/serving.py ``fused=``, auto at
+max_batch >= 32 — docs/SERVING.md): device-resident block tables /
+positions / sampling state updated by traced scatters, ONE jitted decode
+program over all rows with masked inactive rows, prompt-packing prefill,
+and O(active) host bookkeeping.
+
+The contract under test: fused token streams are BYTE-IDENTICAL to the
+legacy per-slot step path (greedy AND seeded), at any slot count, prefix
+cache on or off, warm or cold, across COW divergence and crash replay.
+The 128-slot acceptance pin (ISSUE 10) is slow-marked; every behavior has
+a fast 8-slot pin here — tier-1 sits near its 870 s ceiling.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.resilience import FaultPlan, FaultSpec
+from paddle_tpu.inference.serving import (ContinuousBatchingEngine,
+                                          PrefixCacheConfig, Request)
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(11)
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig.tiny(num_hidden_layers=1)
+    return cfg, LlamaForCausalLM(cfg)
+
+
+@pytest.fixture(scope="module")
+def leg(model):
+    """Legacy per-slot reference engine (2 slots, prefix off)."""
+    _, m = model
+    return ContinuousBatchingEngine(m, max_batch=2, max_len=64, page_size=8,
+                                    block_size=4, fused=False)
+
+
+@pytest.fixture(scope="module")
+def fus(model):
+    """Fused engine, prefix off (8 slots — same programs the 128-slot
+    engine runs, cheaper to compile)."""
+    _, m = model
+    return ContinuousBatchingEngine(m, max_batch=8, max_len=64, page_size=8,
+                                    block_size=4, fused=True)
+
+
+@pytest.fixture(scope="module")
+def fusp(model):
+    """Fused engine with the prefix cache + packed prefill."""
+    _, m = model
+    return ContinuousBatchingEngine(
+        m, max_batch=8, max_len=64, page_size=8, block_size=4, fused=True,
+        prefix_cache=PrefixCacheConfig(prefill_chunk=16, extra_blocks=8))
+
+
+def _prompt(cfg, n, seed):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+
+
+def _wave(cfg):
+    """Mixed greedy/seeded requests; prompt 16 is a full-page multiple so
+    a warm re-serve takes the FULL-prompt-hit COW path, and prompt 40 is
+    LONGER than the fused engine's prefill_chunk (16) so the packed
+    prefill carries several chunks of one prompt in a single call — the
+    append-before-gather ordering `_run_pack` stakes bit-identity on."""
+    prompts = [_prompt(cfg, n, 300 + n) for n in (5, 16, 9, 16, 40, 3)]
+    kws = [dict(max_new_tokens=6), dict(max_new_tokens=4),
+           dict(max_new_tokens=8, temperature=0.8, seed=7, top_k=5),
+           dict(max_new_tokens=4, temperature=1.1, seed=3, top_p=0.9),
+           dict(max_new_tokens=6), dict(max_new_tokens=8)]
+    return prompts, kws
+
+
+def _serve(eng, prompts, kws, stagger=True):
+    reqs = [Request(p, **k) for p, k in zip(prompts, kws)]
+    head, tail = (reqs[:3], reqs[3:]) if stagger else (reqs, [])
+    for r in head:
+        eng.add_request(r)
+    if tail:
+        eng.step()
+        eng.step()
+        for r in tail:
+            eng.add_request(r)
+    eng.run_until_done(max_steps=500)
+    return [list(r.tokens) for r in reqs]
+
+
+def test_fused_matches_legacy_greedy_and_seeded(model, leg, fus):
+    """The core contract: fused 8-slot streams == legacy 2-slot streams,
+    byte for byte, mixed greedy + seeded sampling, staggered arrivals."""
+    cfg, _ = model
+    prompts, kws = _wave(cfg)
+    want = _serve(leg, prompts, kws)
+    got = _serve(fus, prompts, kws)
+    assert got == want
+    assert fus.stats["fused_updates"] > 0      # scatters actually ran
+    # device state drained: every row inactive, every slot free again
+    assert not np.asarray(fus._dev_act).any()
+    assert fus.active_slots() == 0 and len(fus._free_slots) == fus.max_batch
+
+
+def test_fused_prefix_warm_cold_cow_identity(model, leg, fusp):
+    """Prefix-cache fused: cold == warm == legacy. The warm wave re-serves
+    two full-page prompts, so the batched-COW path (one device dispatch
+    for the wave's copies) and the radix hits are both on the tested
+    path; the packed prefill must also have fired."""
+    cfg, _ = model
+    prompts, kws = _wave(cfg)
+    want = _serve(leg, prompts, kws)
+    cold = _serve(fusp, prompts, kws)
+    warm = _serve(fusp, prompts, kws)
+    assert cold == want and warm == want
+    assert fusp.stats["hit_tokens"] > 0
+    assert fusp.stats["cow_copies"] > 0        # full-prompt hits -> COW
+    assert fusp.stats["packed_rows"] > 0       # prompt-packing prefill ran
+    # prefix fused: every table row parked on device once drained
+    assert (np.asarray(fusp.caches["tables"]) == fusp._park).all()
+
+
+def test_fused_eos_early_exit(model, leg, fus):
+    """eos-carrying fused batches pace at block_size and stop early,
+    exactly like the legacy path (token-for-token, including the cut)."""
+    cfg, _ = model
+    p = _prompt(cfg, 7, 401)
+    out = []
+    for eng in (leg, fus):
+        r = Request(p, max_new_tokens=12, eos_token_id=3)
+        eng.add_request(r)
+        eng.run_until_done(max_steps=200)
+        out.append(list(r.tokens))
+    assert out[0] == out[1]
+
+
+def test_fused_deadline_eviction_survivor_unharmed(model, fusp):
+    """Deadline eviction in fused mode: the expired slot is failed and its
+    row parked via the update queue; the surviving stream is untouched.
+    The no-deadline fast path stays O(1) (``_n_deadlined`` gate)."""
+    cfg, _ = model
+    import time
+
+    pa, pb = _prompt(cfg, 5, 402), _prompt(cfg, 9, 403)
+    ref = Request(pa, max_new_tokens=6)
+    fusp.add_request(ref)
+    fusp.run_until_done(max_steps=200)
+    surv = Request(pa, max_new_tokens=6)
+    doomed = Request(pb, max_new_tokens=40, deadline_s=0.0005)
+    fusp.add_request(surv)
+    fusp.shed_infeasible = False    # exercise EVICTION, not submit shedding
+    try:
+        fusp.add_request(doomed)
+    finally:
+        fusp.shed_infeasible = True
+    assert fusp._n_deadlined == 1
+    fusp.step()
+    time.sleep(0.01)
+    fusp.run_until_done(max_steps=200)
+    assert doomed.failed and "deadline" in doomed.error
+    assert fusp._n_deadlined == 0
+    assert not surv.failed and list(surv.tokens) == list(ref.tokens)
+
+
+def test_fused_counters_track_occupancy(model, fus):
+    """O(active) bookkeeping invariants: occupied dict + free-slot deque +
+    has_work stay consistent with the slot array through admit/finish."""
+    cfg, _ = model
+    reqs = [Request(_prompt(cfg, 5, 500 + i), max_new_tokens=16)
+            for i in range(3)]
+    for r in reqs:
+        fus.add_request(r)
+    assert fus.has_work()
+    fus.step()
+    assert fus.active_slots() == 3
+    assert len(fus._free_slots) == fus.max_batch - 3
+    assert sorted(fus._occupied) == [i for i, s in enumerate(fus._slots)
+                                     if s is not None]
+    fus.run_until_done(max_steps=200)
+    assert not fus.has_work() and fus.active_slots() == 0
+    assert len(fus._free_slots) == fus.max_batch
+    assert all(r.done and not r.failed for r in reqs)
+
+
+def test_fused_crash_replay_bit_identical(model, tmp_path):
+    """ServingSupervisor over a FUSED engine: a ``serving.step`` kill
+    mid-wave rebuilds from the journal and the replayed streams (greedy +
+    seeded) are byte-identical to an uninterrupted fused run — the
+    device-resident state is fully reconstructible from the journal, as
+    the recovery contract requires."""
+    cfg, m = model
+    from paddle_tpu.inference.recovery import ServingSupervisor
+
+    def build():
+        return ContinuousBatchingEngine(
+            m, max_batch=4, max_len=32, page_size=8, block_size=2,
+            fused=True, prefix_cache=PrefixCacheConfig(prefill_chunk=8))
+
+    pa, pb = _prompt(cfg, 8, 601), _prompt(cfg, 6, 602)
+
+    def wave():
+        return [Request(pa, max_new_tokens=6, seed=70),
+                Request(pb, max_new_tokens=10, temperature=0.9, seed=71)]
+
+    ref_eng = build()
+    refs = wave()
+    for r in refs:
+        ref_eng.add_request(r)
+    ref_eng.run_until_done(max_steps=300)
+
+    plan = FaultPlan(seed=5, specs=[
+        FaultSpec("serving.step", "kill", at=2, count=1)])
+    sup = ServingSupervisor(build, str(tmp_path / "fused.jrnl"))
+    reqs = wave()
+    with plan:
+        for r in reqs:
+            sup.submit(r)
+        done = sup.run_until_done(max_steps=300)
+    sup.close()
+    assert plan.log, "serving.step kill never fired"
+    assert sup.recoveries == 1
+    assert set(done) == {r.rid for r in reqs}
+    for got, want in zip(reqs, refs):
+        assert got.done and not got.failed
+        assert list(got.tokens) == list(want.tokens)
+
+
+def test_tracer_batched_stamps_equal_per_slot_stamps():
+    """decode_block_batch / first_tokens / tokens_batch (one lock per
+    step) must book exactly what the per-slot calls book."""
+    from paddle_tpu.observability.tracing import TraceRecorder
+
+    a, b = TraceRecorder(), TraceRecorder()
+    for rid in (1, 2):
+        a.submit(rid, 4, 8)
+        b.submit(rid, 4, 8)
+    # per-slot stamping (legacy shape)
+    for rid in (1, 2):
+        a.first_token(rid)
+        a.tokens(rid, 1)
+    a.decode_block(a.now(), 4, 2)
+    for rid in (1, 2):
+        a.tokens(rid, 5)
+    # batched stamping (fused shape)
+    b.first_tokens([(1, 1), (2, 1)])
+    b.decode_block_batch(b.now(), 4, 2, [(1, 5), (2, 5)])
+    sa, sb = a.slo_summary(), b.slo_summary()
+    assert sa["tokens_streamed"] == sb["tokens_streamed"] == 10
+    assert sa["submitted"] == sb["submitted"] == 2
+    assert ([e["name"] for e in a.events if e["tid"] == 1]
+            == [e["name"] for e in b.events if e["tid"] == 1])
+
+
+@pytest.mark.slow   # one 128-row compile wave (~3-4 min budget class) —
+#                     the fast 8-slot pins above cover every behavior;
+#                     this is the ISSUE 10 acceptance config end-to-end
+def test_fused_128_slots_byte_identical_to_legacy(model, leg):
+    """Acceptance pin: max_batch=128 fused engine (prefix cache + packed
+    prefill + batched COW) serves a 160-request mixed wave with every
+    stream byte-identical to the legacy 8-slot-class path, cold AND warm,
+    and the engine drains clean."""
+    cfg, m = model
+    eng = ContinuousBatchingEngine(
+        m, max_batch=128, max_len=32, page_size=8, block_size=4,
+        prefix_cache=PrefixCacheConfig(prefill_chunk=8, extra_blocks=16))
+    assert eng._fused                     # auto-enabled at big batch
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(0, cfg.vocab_size,
+                            (8 + (i % 3) * 4,)).astype(np.int32)
+               for i in range(160)]
+    news = [4 + (i % 4) * 2 for i in range(160)]
+
+    def wave(e):
+        reqs = [Request(p, max_new_tokens=k)
+                for p, k in zip(prompts, news)]
+        for r in reqs:
+            e.add_request(r)
+        e.run_until_done(max_steps=2000)
+        return [list(r.tokens) for r in reqs]
+
+    cold = wave(eng)
+    warm = wave(eng)
+    want = wave(ContinuousBatchingEngine(m, max_batch=8, max_len=32,
+                                         page_size=8, block_size=4,
+                                         fused=False))
+    assert cold == want and warm == want
+    assert eng.stats["cow_copies"] > 0 and eng.stats["packed_rows"] > 0
+    assert eng.active_slots() == 0 and len(eng._free_slots) == 128

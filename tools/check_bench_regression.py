@@ -67,6 +67,17 @@ PRIMARY = "llama_pretrain_tokens_per_sec_per_chip"
 #   live endpoint) vs bare engine on the identical warm wave — same
 #   posture as guard_overhead_pct (5% floor): recording must stay
 #   host-side, buffered, and off the step path.
+# - serving_large_batch_tokens_per_sec: fused mega-step engine at 128
+#   slots on a 2x-oversubscribed mixed wave (docs/SERVING.md big-batch
+#   section) — the r06+ slot-count-scaling line; 30% tolerance.
+# - serving_step_host_share_pct: host-side share of the 128-slot wave
+#   (admit + decode dispatch + prefill bookkeeping / wall). Catches host
+#   work creeping back onto the fused step path — an O(max_batch) scan or
+#   a per-step table upload shows up here first. 5% floor (CPU tiny reads
+#   are noisy), fails past 2x of max(baseline, floor).
+# - observability_overhead_big_batch_pct: instrumented-vs-bare at 128
+#   slots — guards the BATCHED per-step stamps (one recorder lock per
+#   decode block); a per-slot lock acquisition regression shows here.
 SECONDARY = {
     "serving_p99_step_latency_ms": ("lower", 1.0, 0.0),
     "guard_overhead_pct": ("lower", 1.0, 5.0),
@@ -79,6 +90,9 @@ SECONDARY = {
     "serving_p50_time_to_first_token_ms": ("lower", 1.0, 50.0),
     "serving_p99_time_to_first_token_ms": ("lower", 1.0, 100.0),
     "observability_overhead_pct": ("lower", 1.0, 5.0),
+    "serving_large_batch_tokens_per_sec": ("higher", 0.3, 0.0),
+    "serving_step_host_share_pct": ("lower", 1.0, 5.0),
+    "observability_overhead_big_batch_pct": ("lower", 1.0, 5.0),
 }
 
 

@@ -4,8 +4,9 @@ Each drill runs a small end-to-end scenario twice: with its recovery path
 enabled (the injected fault must be absorbed) and with it disabled (the
 same fault must flip the exit code). ``--selftest`` runs the whole seeded
 matrix — heartbeat loss, store stall, checkpoint shard corruption, serving
-engine saturation, serving deadline, prefix-cache block-pool exhaustion
-(docs/SERVING.md), plus the numeric classes (NaN gradient, loss spike,
+engine saturation, serving deadline, prefix-cache block-pool exhaustion,
+128-slot fused big-batch saturation (docs/SERVING.md), plus the numeric
+classes (NaN gradient, loss spike,
 poisoned batch — docs/NUMERIC_GUARD.md) — and exits
 0 iff every fault class recovers when enabled AND fails when its recovery
 is off. For the numeric drills "recovery off" means GuardPolicy(action=
@@ -457,6 +458,99 @@ def drill_prefix_cache_exhaustion(recover: bool):
     return True, ("admission deferred at exhaustion, EngineSaturated "
                   "raised, both streams exact after blocks released "
                   f"({eng.stats['evictions']} LRU evictions)")
+
+
+def drill_big_batch_saturation(recover: bool):
+    """Seeded pool exhaustion mid-wave on the 128-slot FUSED engine
+    (docs/SERVING.md mega-step section): a 6-request wave is decoding
+    through the fused mega-step (device-resident tables, packed prefill)
+    when the block pool is exhausted under a late admission.
+
+    Recovery = the refcounted allocator DEFERS the admission (its table
+    scatter never reaches the device), the queue backs up into
+    EngineSaturated, and once the wave's blocks release the deferred
+    request is served — every survivor's stream byte-identical to
+    generate(). Without recovery (``_unsafe_overcommit``) the late request
+    is handed radix pages live tables still map; its packed prefill then
+    overwrites k/v a decoding survivor reads mid-stream — silent
+    corruption at 128 slots, exactly what the deferral exists to
+    prevent."""
+    import numpy as np
+
+    from paddle_tpu.distributed.resilience import FaultPlan, FaultSpec
+    from paddle_tpu.inference.serving import (ContinuousBatchingEngine,
+                                              EngineSaturated,
+                                              PrefixCacheConfig, Request)
+
+    cfg, m = _serving_model()
+
+    def ref(prompt, n):
+        import paddle_tpu as paddle
+
+        out = m.generate(paddle.to_tensor(np.asarray(prompt)[None]),
+                         max_new_tokens=n, temperature=0.0).numpy()[0]
+        return [int(t) for t in out]
+
+    rng = np.random.default_rng(12)
+    wave = [rng.integers(0, cfg.vocab_size, (8,)).astype(np.int32)
+            for _ in range(6)]
+    pb = rng.integers(0, cfg.vocab_size, (8,)).astype(np.int32)
+    eng = ContinuousBatchingEngine(
+        m, max_batch=128, max_len=40, page_size=8, block_size=4,
+        fused=True, prefix_cache=PrefixCacheConfig(prefill_chunk=8),
+        _unsafe_overcommit=not recover)
+    if not eng._fused:
+        return False, "engine did not take the fused mega-step path"
+    wave_reqs = [Request(p, max_new_tokens=30) for p in wave]
+    rb = Request(pb, max_new_tokens=30)
+    # the wave's 6 admissions are block-pool events 0-5; the late
+    # request's allocation is event 6 — the hold empties the free list
+    # there, and the wave's own blocks are all live (nothing evictable)
+    plan = FaultPlan(seed=9, specs=[
+        FaultSpec("serving.block_pool", "exhaust", at=6, count=1,
+                  arg=10 ** 6)])
+    saturated = deferred = False
+    with plan:
+        for r in wave_reqs:
+            eng.add_request(r)
+        eng.step()                  # wave admitted + packed prefill (0-5)
+        eng.step()                  # mega-step decoding, everyone live
+        eng.max_queue = 1           # arm the saturation probe
+        eng.add_request(rb)
+        eng.step()                  # late allocation (event 6) hits the
+        #                             emptied pool — every wave block is
+        #                             live (rc >= 1), nothing evictable
+        deferred = rb._n_out == 0 and len(eng._queue) == 1
+        if deferred:
+            try:
+                eng.add_request(Request(pb, max_new_tokens=4))
+            except EngineSaturated:
+                saturated = True
+        eng.run_until_done(max_steps=500)
+    if not plan.log:
+        return False, "exhaust fault never fired"
+    refs = [ref(p, 30) for p in wave]
+    wrong = [i for i, (r, w) in enumerate(zip(wave_reqs, refs))
+             if list(r.tokens) != w]
+    if not recover:
+        if not wrong:
+            return True, ("unexpected: overcommitted 128-slot pool left "
+                          "live tables intact")
+        return False, ("no refcounted deferral: the late admission stole "
+                       f"pages {len(wrong)}/6 decoding survivors still "
+                       "read — streams silently corrupted at 128 slots")
+    if not deferred:
+        return False, "late admission not deferred under exhaustion"
+    if not saturated:
+        return False, "backlog did not surface as EngineSaturated"
+    if wrong:
+        return False, (f"survivors {wrong} corrupted despite refcounting")
+    if list(rb.tokens) != ref(pb, 30):
+        return False, "deferred request served wrong tokens after release"
+    return True, ("128-slot fused wave: admission deferred at exhaustion, "
+                  "EngineSaturated raised, all 7 streams exact "
+                  f"(packed_rows={eng.stats['packed_rows']}, "
+                  f"fused_updates={eng.stats['fused_updates']})")
 
 
 # ---------------------------------------------------------------------------
@@ -1100,6 +1194,7 @@ DRILLS = {
     "engine_saturation": drill_engine_saturation,
     "serving_deadline": drill_serving_deadline,
     "prefix_cache_exhaustion": drill_prefix_cache_exhaustion,
+    "big_batch_saturation": drill_big_batch_saturation,
     "serving_crash": drill_serving_crash,
     "serving_stall": drill_serving_stall,
     "serving_overload_shed": drill_serving_overload_shed,

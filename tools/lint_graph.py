@@ -32,7 +32,7 @@ import numpy as np  # noqa: E402
 
 DEFECTS = ("shape_mismatch", "fp64_leak", "recompile_key",
            "unseeded_stochastic", "bad_mesh_axis", "uneven_shard",
-           "unused_param", "async_borrow")
+           "unused_param", "async_borrow", "host_sync")
 
 EXPECTED_CODE = {
     "shape_mismatch": "PT-SHAPE-001",
@@ -43,6 +43,8 @@ EXPECTED_CODE = {
     "uneven_shard": "PT-SPMD-002",
     "unused_param": "PT-GRAPH-003",
     "async_borrow": "PT-TRACE-005",
+    # warning-severity class: the selftest lints it at --fail-on warning
+    "host_sync": "PT-TRACE-004",
 }
 
 
@@ -110,12 +112,60 @@ def record_unet():
     return prog, m
 
 
+def record_serving():
+    """The fused mega-step serving program (inference/serving.py, ISSUE
+    10): the ONE device program a 128-256-slot engine dispatches per
+    decode block — decode + in-graph sampling + position advance over
+    every row, inactive rows masked. Recorded through the engine's own
+    ``_mega_step_fn`` so the linted program IS the production program
+    (params as named inputs; caches/tables/sampling state as baked
+    constants of the trace). The raw step fn also rides along as a
+    ``static_fns`` context entry, so the PT-TRACE-004 host-sync scan
+    covers the mega-step source — a ``.numpy()``/``.item()`` creeping
+    into the fused step path is exactly the per-slot host sync the
+    big-batch refactor removed."""
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.serving import (ContinuousBatchingEngine,
+                                              PrefixCacheConfig)
+    from paddle_tpu.jit.api import _collect_state
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.static.analysis import trace_to_program
+
+    paddle.seed(11)
+    cfg = LlamaConfig.tiny(num_hidden_layers=1)
+    m = LlamaForCausalLM(cfg)
+    eng = ContinuousBatchingEngine(
+        m, max_batch=8, max_len=32, page_size=8, block_size=2, fused=True,
+        prefix_cache=PrefixCacheConfig(prefill_chunk=8))
+    run = eng._mega_step_fn()
+    names, tensors = _collect_state(m)
+    param_structs = [_spec(t._data.shape, t._data.dtype) for t in tensors]
+    n_p = len(param_structs)
+    kv, tables = eng.caches["kv"], eng.caches["tables"]
+    seeds, temps, tops, topks = eng._dev_samp
+
+    def flat(*args):
+        params, (toks, pos, act) = list(args[:n_p]), args[n_p:]
+        return run(params, toks, kv, tables, pos, act, seeds, temps, tops,
+                   topks, n_steps=2, do_sample=True)
+
+    B = eng.max_batch
+    prog = trace_to_program(
+        flat, _spec((B,), np.int32), _spec((B,), np.int32),
+        _spec((B,), np.bool_), input_names=["toks", "pos", "act"],
+        param_structs=param_structs, param_names=names,
+        param_tensors=tensors)
+    prog._static_fns = [run]        # host-sync scan target (lint_family)
+    return prog, m
+
+
 FAMILIES = {
     "bert": record_bert,
     "gpt": record_gpt,
     "llama": record_llama,
     "vit": record_vit,
     "unet": record_unet,
+    "serving": record_serving,
 }
 
 
@@ -212,6 +262,17 @@ def inject(defect, prog, model, context):
             return dev
 
         context["borrow_fns"] = [dispatch_tables]
+    elif defect == "host_sync":
+        # the per-slot host sync the fused mega-step removed, reduced: a
+        # token-value read (.item()) inside the traced step fn — exactly
+        # what would drag a 256-row device program back to one host round
+        # trip per slot (PT-TRACE-004; the real mega-step source is clean)
+        def mega_step_with_sync(toks, pos):
+            n_live = int(pos.item())     # host sync inside the traced step
+            return toks[:n_live]
+
+        context["static_fns"] = (list(context.get("static_fns") or [])
+                                 + [mega_step_with_sync])
     else:
         raise SystemExit(f"unknown defect {defect!r} (choose: {DEFECTS})")
     return context
@@ -232,6 +293,9 @@ def lint_family(name, defect=None, fail_on="error"):
     context = {
         "targets": getattr(prog, "_outputs", None),
         "parameters": list(model.parameters()),
+        # recording may attach traced callables (the serving mega-step fn)
+        # for the PT-TRACE-002/004 source scans
+        "static_fns": list(getattr(prog, "_static_fns", ())),
     }
     if defect is not None:
         context = inject(defect, prog, model, context)
@@ -240,6 +304,7 @@ def lint_family(name, defect=None, fail_on="error"):
         targets=context.get("targets"),
         parameters=context.get("parameters"),
         executors=context.get("executors", ()),
+        static_fns=context.get("static_fns", ()),
         borrow_fns=context.get("borrow_fns", ()),
     )
     floor = Severity.ERROR if fail_on == "error" else Severity.WARNING
@@ -299,8 +364,12 @@ def selftest(family):
     print(f"clean {family}: ok ({len(clean_report)} sub-gate finding(s))")
     for defect in DEFECTS:
         # lint_family seeds (paddle.seed) before recording; the
-        # unseeded_stochastic inject() un-seeds again afterwards itself
-        _, report, gate = lint_family(family, defect=defect)
+        # unseeded_stochastic inject() un-seeds again afterwards itself.
+        # host_sync is a WARNING-severity class (PT-TRACE-004): it must
+        # flip the gate at --fail-on warning, the stricter operator mode
+        _, report, gate = lint_family(
+            family, defect=defect,
+            fail_on="warning" if defect == "host_sync" else "error")
         code = EXPECTED_CODE[defect]
         hit = [d for d in gate if d.code == code]
         if hit:
