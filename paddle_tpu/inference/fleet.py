@@ -65,6 +65,13 @@ class ReplicaState:
     ALIVE = "alive"
     DRAINING = "draining"
     DEAD = "dead"
+    #: drained and REMOVED from service (autoscaler scale-in): unlike DEAD
+    #: there is no work to rescue and no respawn — the slot is simply gone
+    RETIRED = "retired"
+
+
+#: states excluded from routing, stepping, load and completion accounting
+_GONE = (ReplicaState.DEAD, ReplicaState.RETIRED)
 
 
 @dataclasses.dataclass
@@ -118,6 +125,7 @@ class _Replica:
         self.journal_path = journal_path
         self.state = ReplicaState.ALIVE
         self.gen = gen
+        self.retiring = False           # drain completes into RETIRED
         self.progress = None            # supervisor progress marker
         self.last_progress_t = time.monotonic()
 
@@ -180,7 +188,9 @@ class FleetRouter:
         self.stats = {"submitted": 0, "fleet_shed": 0, "replica_deaths": 0,
                       "failovers": 0, "failover_s": 0.0,
                       "failover_requests": 0, "drains": 0, "migrated": 0,
-                      "restarts": 0, "brownouts": 0, "affinity_hits": 0}
+                      "restarts": 0, "brownouts": 0, "affinity_hits": 0,
+                      "replicas_added": 0, "replicas_retired": 0}
+        self._brownout_forced = False
         self._fault_hook = None
         self._fault_cls = None
 
@@ -269,8 +279,14 @@ class FleetRouter:
             self.stats["fleet_shed"] += 1
             if self.tracer is not None:
                 # shed before any replica saw it — the tracer books the
-                # implicit submit so the lifecycle still closes
-                self.tracer.shed(req.rid, reason="fleet brownout")
+                # implicit submit so the lifecycle still closes (tenant
+                # tag included: fleet sheds count against that tenant's
+                # attainment in the SLO monitor)
+                self.tracer.shed(
+                    req.rid,
+                    tags=({"tenant": req.tenant} if req.tenant is not None
+                          else None),
+                    reason="fleet brownout")
             raise RequestShed(
                 f"PT-FLT-003: fleet brownout — priority {req.priority} "
                 f"request rid={req.rid} shed at submit (every replica at "
@@ -350,7 +366,7 @@ class FleetRouter:
             self._fault_cls = FaultInjected
         self._step_idx += 1
         for rep in self.replicas:
-            if rep.state == ReplicaState.DEAD:
+            if rep.state in _GONE:
                 continue
             try:
                 self._fault_hook("fleet.drain",
@@ -594,6 +610,16 @@ class FleetRouter:
 
     def _finish_drain(self, rep: _Replica) -> None:
         rep.sup.close()
+        if rep.retiring:
+            # scale-in (autoscale.py): the drain migrated/finished every
+            # request — remove the replica instead of respawning it
+            rep.retiring = False
+            rep.state = ReplicaState.RETIRED
+            self.stats["replicas_retired"] += 1
+            self.events.append(
+                ("PT-FLT-005", f"replica {rep.idx} retired after drain "
+                 "(scale-in)"))
+            return
         self._respawn(rep)
         self.events.append(
             ("PT-FLT-002", f"replica {rep.idx} rebuilt and rejoined "
@@ -606,6 +632,7 @@ class FleetRouter:
         rep.sup = ServingSupervisor(self._build, rep.journal_path,
                                     **self._rep_kw(rep.idx))
         rep.state = ReplicaState.ALIVE
+        rep.retiring = False
         rep.progress = None
         rep.last_progress_t = time.monotonic()
         self.stats["restarts"] += 1
@@ -622,11 +649,68 @@ class FleetRouter:
             ("PT-FLT-002", f"replica {idx} restarted after death "
              f"(generation {rep.gen})"))
 
+    # -- autoscaling hooks (inference/autoscale.py — PT-FLT-005) ----------
+    def add_replica(self) -> int:
+        """Grow the fleet by one supervisor-wrapped replica, built through
+        the SAME factory/journal path as the originals (a scaled-up
+        replica is failover-, drain- and restart-capable from birth). The
+        new replica starts cold (empty cache, uncompiled programs) and is
+        immediately routable. Returns its index."""
+        idx = len(self.replicas)
+        gen = self._latest_gen(idx)
+        path = os.path.join(self.fleet_dir, f"replica{idx}.g{gen}.jrnl")
+        self.replicas.append(_Replica(
+            idx, ServingSupervisor(self._build, path, **self._rep_kw(idx)),
+            path, gen=gen))
+        self.stats["replicas_added"] += 1
+        self.events.append(
+            ("PT-FLT-005", f"replica {idx} added (scale-out: fleet now "
+             f"{sum(1 for r in self.replicas if r.state not in _GONE)} "
+             "serving replica(s))"))
+        return idx
+
+    def retire_replica(self, idx: int) -> bool:
+        """Scale-in: drain replica ``idx`` (still-queued work migrates to
+        survivors, in-flight slots finish in place) and REMOVE it once
+        idle instead of respawning it. Refused (returns False) for the
+        last serving replica or a replica that is not ALIVE; requires
+        ``graceful_drain`` (a hard-restart deployment has no lossless
+        scale-in path — use drain semantics or accept the loss
+        explicitly)."""
+        rep = self.replicas[idx]
+        if rep.state != ReplicaState.ALIVE or not self.graceful_drain:
+            return False
+        alive = [r for r in self.replicas
+                 if r.state == ReplicaState.ALIVE]
+        if len(alive) <= 1:
+            return False            # never retire the last replica
+        rep.retiring = True
+        self.drain(idx)
+        return True
+
+    def force_brownout(self, active: bool) -> None:
+        """Controller override of the fleet brownout (autoscale.py at max
+        replicas): while forced, the hysteretic pressure state machine is
+        suspended — the controller owns the exit as well as the entry, so
+        one pressure-free tick cannot undo a deliberate degradation."""
+        if active and not self._brownout_active:
+            self.stats["brownouts"] += 1
+            self.events.append(
+                ("PT-FLT-003", "fleet brownout FORCED (autoscaler at max "
+                 "replicas): shedding priority >= "
+                 f"{self.config.shed_priority} at submit"))
+        elif not active and self._brownout_forced:
+            self.events.append(
+                ("PT-FLT-004", "forced fleet brownout released"))
+        self._brownout_forced = bool(active)
+        self._brownout_active = bool(active)
+        self._pressure_events = self._clear_events = 0
+
     def rolling_restart(self, max_steps: int = 100000) -> None:
         """Drain + rebuild every replica, one at a time, under traffic —
         the zero-downtime update path (PT-FLT-002)."""
         for rep in list(self.replicas):
-            if rep.state == ReplicaState.DEAD:
+            if rep.state in _GONE:
                 continue
             self.drain(rep.idx)
             guard = 0
@@ -657,6 +741,8 @@ class FleetRouter:
 
     def _pressure_event(self, pressured: bool) -> None:
         cfg = self.config
+        if self._brownout_forced:
+            return          # controller-owned: force_brownout(False) exits
         if self._brownout_active:
             if pressured:
                 self._clear_events = 0
@@ -685,7 +771,7 @@ class FleetRouter:
     # -- completion --------------------------------------------------------
     def has_work(self) -> bool:
         if any(rep.sup.has_work() for rep in self.replicas
-               if rep.state != ReplicaState.DEAD):
+               if rep.state not in _GONE):
             return True
         return any(not r.done for r in self.requests.values())
 
@@ -698,7 +784,7 @@ class FleetRouter:
 
     def finished(self) -> Dict[int, Request]:
         for rep in self.replicas:
-            if rep.state != ReplicaState.DEAD:
+            if rep.state not in _GONE:
                 rep.sup.finished()
         out = {rid: r for rid, r in self.requests.items()
                if r.done and rid not in self._returned}
@@ -706,12 +792,13 @@ class FleetRouter:
         return out
 
     def load(self) -> Dict[int, int]:
-        """Per-replica load snapshot (queued + slotted), DEAD replicas
-        excluded — the observability surface the balancer itself uses."""
+        """Per-replica load snapshot (queued + slotted), DEAD/RETIRED
+        replicas excluded — the observability surface the balancer itself
+        uses."""
         return {rep.idx: rep.sup.load() for rep in self.replicas
-                if rep.state != ReplicaState.DEAD}
+                if rep.state not in _GONE}
 
     def close(self) -> None:
         for rep in self.replicas:
-            if rep.state != ReplicaState.DEAD:
+            if rep.state not in _GONE:
                 rep.sup.close()

@@ -235,14 +235,16 @@ def _admit_record(req: Request) -> dict:
             "max_new": req.max_new_tokens, "eos": req.eos_token_id,
             "temp": req.temperature, "top_p": req.top_p, "top_k": req.top_k,
             "seed": req.seed, "deadline_s": req.deadline_s,
-            "priority": req.priority}
+            "priority": req.priority, "tenant": req.tenant}
 
 
 def _request_from(rec: dict) -> Request:
     r = Request(rec["prompt"], max_new_tokens=rec["max_new"],
                 eos_token_id=rec["eos"], temperature=rec["temp"],
                 top_p=rec["top_p"], top_k=rec["top_k"], seed=rec["seed"],
-                deadline_s=rec["deadline_s"], priority=rec["priority"])
+                deadline_s=rec["deadline_s"], priority=rec["priority"],
+                # .get(): pre-observatory journals carry no tenant field
+                tenant=rec.get("tenant"))
     # twins and restart-reconstructions carry the ORIGINAL rid: the journal,
     # the engine bookkeeping and the fleet's routing table all key on it
     r.rid = rec["rid"]

@@ -97,11 +97,11 @@ from ..models.generation_utils import (fold_keys as _fold_keys,
 # here as the serving-facing API surface
 from ..ops.paged_attention import BlockAllocator, RadixPrefixCache
 
-__all__ = ["BlockAllocator", "BrownoutConfig", "ContinuousBatchingEngine",
-           "EngineSaturated", "FleetConfig", "FleetRouter",
-           "PrefixCacheConfig", "RadixPrefixCache", "ReplicaState", "Request",
-           "RequestJournal", "RequestShed", "ServingSupervisor",
-           "StepWatchdog"]
+__all__ = ["AutoscaleConfig", "BlockAllocator", "BrownoutConfig",
+           "ContinuousBatchingEngine", "EngineSaturated", "FleetConfig",
+           "FleetRouter", "PrefixCacheConfig", "RadixPrefixCache",
+           "ReplicaState", "Request", "RequestJournal", "RequestShed",
+           "SLOAutoscaler", "ServingSupervisor", "StepWatchdog"]
 
 
 def __getattr__(name):
@@ -116,6 +116,12 @@ def __getattr__(name):
         from . import fleet
 
         return getattr(fleet, name)
+    if name in ("SLOAutoscaler", "AutoscaleConfig"):
+        # the SLO-pressure autoscaler (autoscale.py) — lazy like the fleet:
+        # importing serving must not pull the control loop in
+        from . import autoscale
+
+        return getattr(autoscale, name)
     if name == "StepWatchdog":
         from ..distributed.resilience.watchdog import StepWatchdog
 
@@ -217,7 +223,8 @@ class Request:
                  temperature: float = 0.0, top_p: float = 1.0,
                  top_k: int = 0, seed: Optional[int] = None,
                  deadline_s: Optional[float] = None,
-                 priority: int = PRIORITY_NORMAL):
+                 priority: int = PRIORITY_NORMAL,
+                 tenant: Optional[str] = None):
         validate_sampling(temperature, top_p, top_k)
         Request._counter[0] += 1
         self.rid = Request._counter[0]
@@ -232,6 +239,10 @@ class Request:
         self.seed = int(seed if seed is not None else self.rid)
         self.deadline_s = None if deadline_s is None else float(deadline_s)
         self.priority = int(priority)
+        # workload tenant tag (observability/workload.py multi-tenant mix):
+        # rides the trace stamps so SLO attainment splits per tenant
+        # (observability/slo.py); journaled, so it survives failover
+        self.tenant = None if tenant is None else str(tenant)
         self.output: List[int] = []
         self.done = False
         self.failed = False
@@ -445,6 +456,17 @@ class ContinuousBatchingEngine:
         self._jit_prefill: Dict[int, object] = {}
         self._jit_step = None
 
+    def _req_tags(self, req: "Request") -> Dict:
+        """Stamp tags for per-request trace sites (submit / shed / admit —
+        the queue-wait stamp): the engine-level tags plus the request's
+        workload tenant, so SLO attainment and the queue-wait histogram
+        events split per tenant (observability/slo.py)."""
+        if req.tenant is None:
+            return self.trace_tags
+        tags = dict(self.trace_tags)
+        tags["tenant"] = req.tenant
+        return tags
+
     # ---- public API ----
     def add_request(self, req: Request) -> int:
         if self.max_queue is not None and len(self._queue) >= self.max_queue:
@@ -477,11 +499,11 @@ class ContinuousBatchingEngine:
             # request never entered the system) but BEFORE the shed check
             # (a shed is a real terminal outcome of a real submission)
             self.tracer.submit(req.rid, len(req.prompt), req.max_new_tokens,
-                               self.trace_tags)
+                               self._req_tags(req))
             try:
                 self._shed_check(req)
             except RequestShed:
-                self.tracer.shed(req.rid, self.trace_tags)
+                self.tracer.shed(req.rid, self._req_tags(req))
                 raise
         else:
             self._shed_check(req)
@@ -1228,7 +1250,7 @@ class ContinuousBatchingEngine:
             self.tracer.admit(
                 req.rid, now - (req._enqueued_at or now),
                 hit_tokens=cached, miss_tokens=len(prompt) - cached,
-                tags=self.trace_tags)
+                tags=self._req_tags(req))
         return True
 
     def _steal_blocks(self, n: int, avoid=()):
@@ -1576,7 +1598,7 @@ class ContinuousBatchingEngine:
                     self.tracer.admit(req.rid,
                                       now - (req._enqueued_at or now),
                                       miss_tokens=len(req.prompt),
-                                      tags=self.trace_tags)
+                                      tags=self._req_tags(req))
                     ft_marks.append((req.rid, req._n_out))
                 self._pos[slot] = len(req.prompt) + 1
                 if self._fused:

@@ -32,16 +32,24 @@ jax and is safe to import anywhere.
 """
 
 from .collectors import (engine_collector, fleet_collector,  # noqa: F401
-                         guard_collector, retry_collector,
-                         supervisor_collector)
+                         guard_collector, retry_collector, slo_collector,
+                         supervisor_collector, tracer_collector)
 from .metrics import (Counter, Gauge, Histogram,  # noqa: F401
                       MetricFamily, MetricsRegistry,
                       parse_prometheus_text)
 from .server import MetricsServer  # noqa: F401
+from .slo import SLOConfig, SLOMonitor  # noqa: F401
 from .tracing import TraceRecorder  # noqa: F401
+from .workload import (ReplayDriver, ScheduledArrival,  # noqa: F401
+                       TenantSpec, VirtualClock, WorkloadConfig,
+                       decode_schedule, encode_schedule,
+                       generate_schedule, schedule_digest)
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricFamily",
-           "MetricsRegistry", "MetricsServer", "TraceRecorder",
-           "engine_collector", "fleet_collector", "guard_collector",
-           "parse_prometheus_text", "retry_collector",
-           "supervisor_collector"]
+           "MetricsRegistry", "MetricsServer", "ReplayDriver",
+           "SLOConfig", "SLOMonitor", "ScheduledArrival", "TenantSpec",
+           "TraceRecorder", "VirtualClock", "WorkloadConfig",
+           "decode_schedule", "encode_schedule", "engine_collector",
+           "fleet_collector", "generate_schedule", "guard_collector",
+           "parse_prometheus_text", "retry_collector", "schedule_digest",
+           "slo_collector", "supervisor_collector", "tracer_collector"]

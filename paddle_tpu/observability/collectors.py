@@ -21,6 +21,13 @@ Adapters (register with ``MetricsRegistry.register_collector``):
 - :func:`fleet_collector` — ``FleetRouter``: router stats, per-replica
   state/load, and each alive replica's supervisor+engine families with a
   ``replica`` label.
+- :func:`tracer_collector` — ``TraceRecorder`` health:
+  ``pt_tracer_dropped_total`` / ``pt_tracer_gc_total`` — a saturated
+  trace buffer silently under-reports TTFT tails, so saturation itself
+  must be scrapeable.
+- :func:`slo_collector` — ``SLOMonitor`` (observability/slo.py):
+  windowed SLO attainment, per-tenant attainment and goodput as
+  ``pt_slo_*`` families.
 
 Nothing here imports jax or touches device state.
 """
@@ -32,7 +39,8 @@ from typing import Iterable, List, Optional
 from .metrics import MetricFamily
 
 __all__ = ["engine_collector", "fleet_collector", "guard_collector",
-           "retry_collector", "supervisor_collector"]
+           "retry_collector", "slo_collector", "supervisor_collector",
+           "tracer_collector"]
 
 
 def _stat_families(prefix: str, stats: dict, kinds: dict,
@@ -185,30 +193,125 @@ def supervisor_collector(sup, **labels):
 
 def fleet_collector(router):
     """``FleetRouter``: router-level stats, per-replica state/load gauges,
-    and every non-dead replica's supervisor+engine families labeled
-    ``replica="<idx>"``."""
+    and every serving replica's supervisor+engine families labeled
+    ``replica="<idx>"`` (DEAD and RETIRED replicas keep their state gauge
+    but report no load — a retired supervisor is closed)."""
 
     def collect() -> Iterable[MetricFamily]:
-        from ..inference.fleet import ReplicaState
+        from ..inference.fleet import _GONE, ReplicaState
 
         fams = _stat_families("pt_fleet", router.stats, {})
         fams.append(MetricFamily(
             "pt_fleet_brownout_active", "gauge").add(
             1.0 if router._brownout_active else 0.0))
-        state = MetricFamily("pt_fleet_replica_state", "gauge",
-                             "1=alive 0.5=draining 0=dead")
+        state = MetricFamily(
+            "pt_fleet_replica_state", "gauge",
+            "1=alive 0.5=draining 0=dead -1=retired (scaled in)")
         load = MetricFamily("pt_fleet_replica_load", "gauge",
                             "queued + slotted requests per replica")
         for rep in router.replicas:
             state.add({ReplicaState.ALIVE: 1.0,
-                       ReplicaState.DRAINING: 0.5}.get(rep.state, 0.0),
+                       ReplicaState.DRAINING: 0.5,
+                       ReplicaState.RETIRED: -1.0}.get(rep.state, 0.0),
                       replica=str(rep.idx))
-            if rep.state != ReplicaState.DEAD:
+            if rep.state not in _GONE:
                 load.add(rep.sup.load(), replica=str(rep.idx))
                 fams.extend(supervisor_collector(
                     rep.sup, replica=str(rep.idx))())
         fams.append(state)
         fams.append(load)
+        return fams
+
+    return collect
+
+
+def tracer_collector(tracer, **labels):
+    """``TraceRecorder`` health counters (read through the recorder's
+    ``counters()`` — one stamp-lock acquisition per scrape):
+    ``pt_tracer_dropped_total`` events refused by the bounded buffer and
+    ``pt_tracer_gc_total`` terminal request records evicted past
+    ``max_requests``. Either one moving means the recorder is saturated
+    and TTFT tails are being under-reported — alert on it, don't trust
+    the percentiles."""
+
+    def collect() -> Iterable[MetricFamily]:
+        c = tracer.counters()
+        return [
+            MetricFamily(
+                "pt_tracer_dropped_total", "counter",
+                "trace events dropped by the bounded buffer").add(
+                c["dropped"], **labels),
+            MetricFamily(
+                "pt_tracer_gc_total", "counter",
+                "terminal request records GC'd past max_requests").add(
+                c["gc"], **labels),
+            MetricFamily("pt_tracer_buffered_events", "gauge").add(
+                c["events"], **labels),
+            MetricFamily("pt_tracer_open_requests", "gauge").add(
+                c["open"], **labels),
+            MetricFamily("pt_tracer_resubmits_total", "counter").add(
+                c["resubmits"], **labels),
+        ]
+
+    return collect
+
+
+def slo_collector(monitor):
+    """``SLOMonitor`` → ``pt_slo_*`` families: cumulative
+    finished/met/good-token counters, the latest window's attainment
+    (overall, per signal, per tenant) and goodput — the scrape-side face
+    of the SLO observatory (docs/OBSERVABILITY.md)."""
+
+    def collect() -> Iterable[MetricFamily]:
+        rep = monitor.report()
+        tot = rep["totals"]
+        fams = [
+            MetricFamily("pt_slo_requests_finished_total", "counter").add(
+                tot["finished"]),
+            MetricFamily(
+                "pt_slo_requests_met_total", "counter",
+                "finished requests that met every SLO target").add(
+                tot["met"]),
+            MetricFamily(
+                "pt_slo_good_tokens_total", "counter",
+                "tokens from SLO-meeting requests (goodput numerator)").add(
+                tot["good_tokens"]),
+            MetricFamily("pt_slo_tokens_total", "counter").add(
+                tot["tokens"]),
+            MetricFamily("pt_slo_windows_total", "counter").add(
+                # the true monotonic count — rep["windows"] is a bounded
+                # deque view that plateaus at the monitor's max_windows
+                rep["windows_total"]),
+            MetricFamily(
+                "pt_slo_requests_shed_total", "counter",
+                "sheds among finished (refused at submit — never met)"
+            ).add(rep["totals"]["shed"]),
+            MetricFamily(
+                "pt_slo_target_attainment", "gauge",
+                "the configured window attainment contract").add(
+                monitor.config.target_attainment),
+        ]
+        att = MetricFamily("pt_slo_attainment", "gauge",
+                           "last window's attainment by scope")
+        goodput = MetricFamily("pt_slo_goodput_tokens_per_sec", "gauge")
+        win = rep["windows"][-1] if rep["windows"] else None
+        if win is not None:
+            if win["attainment"] is not None:
+                att.add(win["attainment"], scope="window")
+            for name, sig in win["signals"].items():
+                if sig.get("attainment") is not None:
+                    att.add(sig["attainment"], scope=f"signal:{name}")
+            for ten, row in win["by_tenant"].items():
+                if row["attainment"] is not None:
+                    att.add(row["attainment"], scope=f"tenant:{ten}")
+            if win["goodput_tokens_per_sec"] is not None:
+                goodput.add(win["goodput_tokens_per_sec"])
+        if rep["attainment"] is not None:
+            att.add(rep["attainment"], scope="total")
+        if att.samples:
+            fams.append(att)
+        if goodput.samples:
+            fams.append(goodput)
         return fams
 
     return collect

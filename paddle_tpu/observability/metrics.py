@@ -211,11 +211,39 @@ class Histogram(_Instrument):
         between its bounds. Observations past the last bound clamp to it
         (the standard Prometheus ``histogram_quantile`` posture). None when
         nothing was observed."""
+        return self.row_quantile(self.snapshot(**labels), q)
+
+    # -- windowed reads (docs/OBSERVABILITY.md "Traffic replay & SLO
+    # attainment"): a scraper that wants PER-WINDOW percentiles/attainment
+    # snapshots the row at each window boundary and works on the delta —
+    # no recorder swap, no state reset, reads under the instrument lock
+    def snapshot(self, **labels) -> Tuple[float, ...]:
+        """Immutable copy of the row for one label set:
+        ``(count per bucket..., +Inf count, sum)`` — all zeros when nothing
+        was observed yet, so ``delta`` against a pre-traffic snapshot is
+        always well-defined."""
         key = self._key(labels)
         with self._lock:
             row = self._values.get(key)
-            row = list(row) if row else None   # engine threads keep
-            #                                    observing mid-walk
+            return tuple(row) if row else (0.0,) * (len(self.buckets) + 2)
+
+    def delta(self, since: Optional[Sequence[float]], **labels
+              ) -> Tuple[float, ...]:
+        """Current row minus an earlier :meth:`snapshot` — the WINDOW'S
+        observations as a standalone row (``since=None`` means everything
+        so far). Counts are monotonic, so the subtraction is exact."""
+        cur = self.snapshot(**labels)
+        if since is None:
+            return cur
+        return tuple(c - s for c, s in zip(cur, since))
+
+    def row_count(self, row: Sequence[float]) -> int:
+        return int(sum(row[:-1]))
+
+    def row_quantile(self, row: Sequence[float], q: float
+                     ) -> Optional[float]:
+        """:meth:`quantile` over an explicit row (a snapshot or a window
+        delta) instead of the live state."""
         if not row:
             return None
         total = sum(row[:-1])
@@ -232,6 +260,28 @@ class Histogram(_Instrument):
                 return lo + (b - lo) * min(1.0, max(0.0, frac))
             lo = b
         return self.buckets[-1]    # landed in the +Inf bucket: clamp
+
+    def row_fraction_le(self, row: Sequence[float], value: float
+                        ) -> Optional[float]:
+        """Fraction of a row's observations at or below ``value`` (linear
+        interpolation inside the straddling bucket) — the per-signal SLO
+        attainment read. Observations in the +Inf bucket count as above
+        every finite value; None when the row is empty."""
+        total = sum(row[:-1])
+        if total <= 0:
+            return None
+        v = float(value)
+        cum = 0.0
+        lo = 0.0
+        for i, b in enumerate(self.buckets):
+            if v >= b:
+                cum += row[i]
+            else:
+                if v > lo and row[i] > 0:
+                    cum += row[i] * (v - lo) / (b - lo)
+                break
+            lo = b
+        return min(1.0, cum / total)
 
     def family(self) -> MetricFamily:
         fam = MetricFamily(self.name, self.kind, self.help)

@@ -94,11 +94,20 @@ class TraceRecorder:
         self._lock = threading.RLock()
         self.events: List[dict] = []
         self.dropped = 0
+        self.gc_count = 0          # terminal rids evicted past max_requests
+        # optional SLO sink (observability/slo.py SLOMonitor.attach): the
+        # per-request attainment/goodput accounting that histograms cannot
+        # carry (which REQUESTS met every target, and how many tokens they
+        # streamed). Called under self._lock from the stamp sites, behind
+        # one `is not None` check each — same discipline as the engine's
+        # tracer attachment.
+        self.slo = None
         # per-request bookkeeping (bounded: terminal rids are GC'd oldest
         # first past max_requests)
         self._submit_ts: Dict[int, float] = {}
         self._first_ts: Dict[int, float] = {}
         self._streamed: Dict[int, int] = {}    # dedup floor (journal hwm)
+        self._tenant: Dict[int, str] = {}      # rid -> workload tenant tag
         self._recovered: set = set()           # rids past mark_recovered
         self._state: Dict[int, str] = {}       # "open" | terminal name
         self._order: List[int] = []            # rid insertion order for GC
@@ -188,9 +197,10 @@ class TraceRecorder:
                 if self._state.get(rid) in TERMINALS:
                     self._order.pop(i)
                     for d in (self._submit_ts, self._first_ts,
-                              self._streamed, self._state):
+                              self._streamed, self._state, self._tenant):
                         d.pop(rid, None)
                     self._recovered.discard(rid)
+                    self.gc_count += 1
                     break
             else:
                 return   # everything open — nothing safe to drop
@@ -205,11 +215,21 @@ class TraceRecorder:
             known = rid in self._state
             reopened = self._state.get(rid) in TERMINALS
             self._track(rid)
+            tenant = (tags or {}).get("tenant")
+            if tenant is not None:
+                self._tenant[rid] = str(tenant)
             if not known:
                 self._submit_ts[rid] = self.now()
                 self._c_submitted.inc()
+                if self.slo is not None:
+                    self.slo.note_submit(rid, self._tenant.get(rid))
             else:
                 self.resubmits += 1
+                if reopened and self.slo is not None:
+                    # a terminal'd rid coming back (fleet caught one
+                    # replica's shed and routed onward): the pending shed
+                    # is cancelled — the REAL terminal gets booked
+                    self.slo.note_reopen(rid, self._tenant.get(rid))
             self.instant("submit" if not known else "resubmit", rid, tags,
                          prompt_tokens=int(prompt_tokens),
                          max_new=int(max_new), reopened=bool(reopened))
@@ -220,6 +240,10 @@ class TraceRecorder:
                 self._track(rid)         # (fleet brownout): still tracked
                 self._submit_ts[rid] = self.now()
                 self._c_submitted.inc()
+                if self.slo is not None:
+                    self.slo.note_submit(rid, (tags or {}).get("tenant"))
+            if self.slo is not None:
+                self.slo.note_terminal(rid, "shed", 0, None)
             self._terminal(rid, "shed", tags, **extra)
 
     def admit(self, rid: int, queue_wait_s: float, hit_tokens: int = 0,
@@ -230,6 +254,8 @@ class TraceRecorder:
                 # a recovered/resumed re-admission's wait is operator cost,
                 # not caller-visible queue wait — keep the SLO honest
                 self._h_qwait.observe(wait_ms)
+                if self.slo is not None:
+                    self.slo.note_queue_wait(rid, wait_ms)
             self.instant("admit", rid, tags,
                          queue_wait_ms=round(wait_ms, 3),
                          hit_tokens=int(hit_tokens),
@@ -255,6 +281,8 @@ class TraceRecorder:
             if sub is not None:
                 ttft_ms = (ts - sub) * 1e3
                 self._h_ttft.observe(ttft_ms)
+                if self.slo is not None:
+                    self.slo.note_ttft(rid, ttft_ms)
             self.instant("first_token", rid, tags,
                          **({"ttft_ms": round(ttft_ms, 3)}
                             if ttft_ms is not None else {}))
@@ -313,9 +341,13 @@ class TraceRecorder:
                     else "fail" if failed else "finish")
         with self._lock:
             first = self._first_ts.get(rid)
+            itl_ms = None
             if kind == "finish" and first is not None and n_out > 1:
-                self._h_itl.observe((self.now() - first) / (n_out - 1) * 1e3)
+                itl_ms = (self.now() - first) / (n_out - 1) * 1e3
+                self._h_itl.observe(itl_ms)
             self.tokens(rid, int(n_out), tags)
+            if self.slo is not None:
+                self.slo.note_terminal(rid, kind, int(n_out), itl_ms)
             self._terminal(rid, kind, tags, n_out=int(n_out),
                            **({"error": str(error)[:200]} if error else {}))
 
@@ -364,6 +396,18 @@ class TraceRecorder:
                   replayed=int(replayed))
 
     # -- introspection / export -------------------------------------------
+    def counters(self) -> dict:
+        """Recorder health counters, read under the stamp lock — the
+        ``tracer_collector`` source for ``pt_tracer_dropped_total`` /
+        ``pt_tracer_gc_total`` (a saturated buffer or a GC'd request set
+        silently under-reports TTFT tails; this makes saturation itself a
+        scrapeable signal)."""
+        with self._lock:
+            return {"events": len(self.events), "dropped": self.dropped,
+                    "gc": self.gc_count, "resubmits": self.resubmits,
+                    "open": sum(1 for st in self._state.values()
+                                if st == "open")}
+
     def is_open(self, rid: int) -> bool:
         """True while ``rid`` is submitted but has no terminal span yet —
         callers that might race the engine's own terminal stamp (e.g. the

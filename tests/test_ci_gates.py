@@ -52,13 +52,18 @@ def test_manifest_counts_cover_reference_parity():
         # PrefixCacheConfig, BlockAllocator, RadixPrefixCache;
         # resilient-serving PR: + ServingSupervisor, RequestJournal,
         # RequestShed, BrownoutConfig, StepWatchdog;
-        # fleet PR: + FleetRouter, FleetConfig, ReplicaState
-        "paddle.inference.serving": 14,
+        # fleet PR: + FleetRouter, FleetConfig, ReplicaState;
+        # SLO-observatory PR: + SLOAutoscaler, AutoscaleConfig
+        "paddle.inference.serving": 16,
         # observability PR (docs/OBSERVABILITY.md): MetricsRegistry +
         # Counter/Gauge/Histogram/MetricFamily, MetricsServer,
         # TraceRecorder, parse_prometheus_text, and the five collector
-        # adapters (engine/retry/guard/supervisor/fleet)
-        "paddle.observability": 13,
+        # adapters (engine/retry/guard/supervisor/fleet);
+        # SLO-observatory PR: + WorkloadConfig/TenantSpec/
+        # ScheduledArrival/VirtualClock/ReplayDriver +
+        # generate/encode/decode_schedule/schedule_digest +
+        # SLOConfig/SLOMonitor + tracer_collector/slo_collector
+        "paddle.observability": 26,
         # concurrency-lint PR (docs/STATIC_ANALYSIS.md PT-RACE section):
         # analyze_source/file/paths, build_module_model,
         # infer_shared_state, run_checks, finding_id, ModuleModel,
@@ -256,6 +261,32 @@ def test_scrape_metrics_selftest():
         capture_output=True, text=True, env=env, cwd=ROOT, timeout=300)
     assert r.returncode == 0, r.stdout + r.stderr
     assert "SCRAPE SELFTEST OK" in r.stdout, r.stdout
+
+
+@pytest.mark.slow   # two in-subprocess fleet replays (~25s incl. jax
+#                     import + per-replica engine compiles) with tier-1 at
+#                     its 870s ceiling — same posture as
+#                     test_scrape_metrics_selftest: the gated BEHAVIORS
+#                     have fast in-process pins in
+#                     tests/test_slo_observatory.py (schedule byte-
+#                     determinism, attainment math on synthetic spans,
+#                     autoscaler hysteresis on scripted series)
+def test_traffic_replay_selftest():
+    """SLO-observatory gate (docs/OBSERVABILITY.md "Traffic replay & SLO
+    attainment", beside lint_graph/fault_drill/scrape_metrics): seeded
+    open-loop schedules must be byte-identical across same-seed runs, a
+    burst replay against a live fleet must produce a schema-valid
+    attainment/goodput report with the autoscaler taking at least one
+    scale action, and the control arm (autoscaler disabled, same
+    schedule) must leave attainment below target and flip the exit
+    judgment."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "traffic_replay.py"),
+         "--selftest"],
+        capture_output=True, text=True, env=env, cwd=ROOT, timeout=500)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "TRAFFIC REPLAY SELFTEST OK" in r.stdout, r.stdout
 
 
 def test_bench_regression_gate_secondary_latency(tmp_path):

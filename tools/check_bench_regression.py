@@ -78,6 +78,18 @@ PRIMARY = "llama_pretrain_tokens_per_sec_per_chip"
 # - observability_overhead_big_batch_pct: instrumented-vs-bare at 128
 #   slots — guards the BATCHED per-step stamps (one recorder lock per
 #   decode block); a per-slot lock acquisition regression shows here.
+# - serving_slo_attainment_pct: % of finished requests meeting the TTFT
+#   target under the open-loop burst replay (docs/OBSERVABILITY.md
+#   "Traffic replay & SLO attainment") — a collapse means the serving
+#   path grew real latency or started shedding wholesale; 30% relative
+#   tolerance rides out CPU wall-clock noise.
+# - serving_goodput_tokens_per_sec: tokens/s from SLO-meeting requests
+#   only (goodput, not raw throughput — a server in queueing collapse
+#   posts throughput with ~0 goodput); "higher", 50% tolerance (wall-
+#   clock attainment is the noisiest line in the suite).
+# - serving_ttft_p99_under_burst_ms: the queueing tail the open-loop
+#   arrivals exist to expose (ROADMAP items 3/5) — 250ms floor + 2x,
+#   same posture as the closed-loop TTFT lines.
 SECONDARY = {
     "serving_p99_step_latency_ms": ("lower", 1.0, 0.0),
     "guard_overhead_pct": ("lower", 1.0, 5.0),
@@ -93,6 +105,9 @@ SECONDARY = {
     "serving_large_batch_tokens_per_sec": ("higher", 0.3, 0.0),
     "serving_step_host_share_pct": ("lower", 1.0, 5.0),
     "observability_overhead_big_batch_pct": ("lower", 1.0, 5.0),
+    "serving_slo_attainment_pct": ("higher", 0.3, 0.0),
+    "serving_goodput_tokens_per_sec": ("higher", 0.5, 0.0),
+    "serving_ttft_p99_under_burst_ms": ("lower", 1.0, 250.0),
 }
 
 

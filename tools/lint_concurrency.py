@@ -48,7 +48,7 @@ _TRACER_API = ["TraceRecorder." + m for m in (
     "submit", "shed", "admit", "prefill_chunk", "first_token", "tokens",
     "decode_block", "finish", "mark_recovered", "failover", "recovery",
     "instant", "span", "is_open", "incomplete", "lifecycle",
-    "export_chrome", "slo_summary")]
+    "export_chrome", "slo_summary", "counters")]
 
 THREAD_ROOTS = {
     # fleet parallel_step replica threads, the rpc ThreadPoolExecutor and

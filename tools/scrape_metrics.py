@@ -57,6 +57,9 @@ REQUIRED_FAMILIES = (
     # serving SLO histograms (TraceRecorder)
     "pt_serving_time_to_first_token_ms",
     "pt_serving_requests_submitted_total",
+    # tracer health (a saturated recorder under-reports TTFT tails)
+    "pt_tracer_dropped_total",
+    "pt_tracer_gc_total",
 )
 
 #: the span chain a served request must produce, in order
@@ -121,7 +124,8 @@ def selftest() -> int:
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
     from paddle_tpu.observability import (MetricsRegistry, MetricsServer,
                                           TraceRecorder, fleet_collector,
-                                          guard_collector, retry_collector)
+                                          guard_collector, retry_collector,
+                                          tracer_collector)
 
     paddle.seed(11)
     cfg = LlamaConfig.tiny(num_hidden_layers=1)
@@ -130,6 +134,7 @@ def selftest() -> int:
     tracer = TraceRecorder(registry=registry)
     registry.register_collector(retry_collector())
     registry.register_collector(guard_collector())
+    registry.register_collector(tracer_collector(tracer))
 
     def build():
         return ContinuousBatchingEngine(

@@ -1,0 +1,280 @@
+"""Traffic replay gate (docs/OBSERVABILITY.md "Traffic replay & SLO
+attainment").
+
+Modes:
+
+- ``--dump schedule.jsonl [--seed N --rate R --duration S --arrival
+  poisson|diurnal|burst]`` — generate a seeded open-loop arrival schedule
+  (observability/workload.py) and write its canonical byte encoding plus
+  print the digest: the replayable artifact (same seed ⇒ byte-identical
+  file).
+- ``--run [--autoscale/--no-autoscale]`` — in-process demo: replay a
+  seeded burst schedule against a tiny fleet on a virtual clock with the
+  SLO monitor + autoscaler attached, print the report JSON, and exit 0
+  iff the SLO contract held (recovered attainment, or brownout engaged
+  at max replicas) — the same judgment the selftest pins.
+- ``--selftest`` — CI gate (tests/test_ci_gates.py, beside lint_graph /
+  fault_drill / scrape_metrics):
+
+  1. schedule determinism: same seed ⇒ byte-identical encoding, a
+     different seed differs;
+  2. replay report schema: a tiny 1→3-replica fleet under a seeded burst
+     schedule produces a report with the windows/attainment/goodput/
+     autoscaler structure intact, the autoscaler takes at least one
+     scale action, and the exit judgment passes (attainment recovered
+     over the post-control half of the run, or brownout engaged at max
+     replicas);
+  3. control arm: the SAME schedule with the autoscaler disabled leaves
+     attainment below target and flips the exit judgment to 1 — the
+     measured difference between the arms is the autoscaler's worth.
+
+Exit code 0 on success, 1 naming the first failed check.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+import tempfile
+
+import _selftest
+
+ROOT = _selftest.bootstrap()
+_H = _selftest.Harness("TRAFFIC REPLAY")
+
+#: the selftest's seeded burst workload: ~3x a single tiny replica's
+#: virtual-clock service rate inside bursts, comfortably under three
+#: replicas' — so the control arm collapses and the scaled fleet recovers
+_SELFTEST_SEED = 17
+
+
+def _selftest_workload():
+    from paddle_tpu.observability import TenantSpec, WorkloadConfig
+
+    return WorkloadConfig(
+        seed=_SELFTEST_SEED, duration_s=10.0, rate_rps=5.0,
+        arrival="burst", burst_every_s=4.0, burst_len_s=2.0,
+        burst_multiplier=8.0, vocab_size=64,
+        prompt_mu=2.2, prompt_sigma=0.4, prompt_min=4, prompt_max=16,
+        output_mu=1.8, output_sigma=0.4, output_min=4, output_max=12,
+        tenants=(TenantSpec("chat", weight=2.0, prefix_len=8),
+                 TenantSpec("batch", weight=1.0, prefix_len=0,
+                            priority=2)))
+
+
+def _slo_config():
+    from paddle_tpu.observability import SLOConfig
+
+    # virtual-clock targets: dt_s=0.05 per fleet step, so 500 ms of TTFT
+    # is ~10 steps of queue+prefill — generous for an unloaded replica,
+    # hopeless once the backlog is a few waves deep
+    return SLOConfig(ttft_ms=500.0, inter_token_ms=None,
+                     queue_wait_ms=None, target_attainment=0.7,
+                     window_s=1.0)
+
+
+def run_replay(fleet_dir: str, autoscale_on: bool, max_replicas: int = 3,
+               model=None) -> dict:
+    """One full observatory run: seeded burst schedule → open-loop replay
+    on a virtual clock → windowed attainment → autoscaler control.
+    Deterministic on CPU (single-threaded fleet, virtual timestamps)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.autoscale import (AutoscaleConfig,
+                                                SLOAutoscaler)
+    from paddle_tpu.inference.fleet import FleetConfig, FleetRouter
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.observability import (ReplayDriver, SLOMonitor,
+                                          TraceRecorder, VirtualClock,
+                                          generate_schedule)
+
+    if model is None:
+        paddle.seed(11)
+        model = LlamaForCausalLM(LlamaConfig.tiny(num_hidden_layers=1))
+
+    def build():
+        return ContinuousBatchingEngine(
+            model, max_batch=2, max_len=32, page_size=8, block_size=2,
+            prefix_cache=True)
+
+    clock = VirtualClock()
+    tracer = TraceRecorder(clock=clock)
+    monitor = SLOMonitor(_slo_config(), tracer=tracer)
+    fleet = FleetRouter(build, fleet_dir, num_replicas=1, tracer=tracer,
+                        config=FleetConfig(brownout_depth=10 ** 9))
+    scaler = SLOAutoscaler(
+        fleet, monitor,
+        AutoscaleConfig(min_replicas=1, max_replicas=max_replicas,
+                        up_after=2, down_after=4, cooldown_windows=1),
+        tracer=tracer, enabled=autoscale_on)
+    schedule = generate_schedule(_selftest_workload())
+    driver = ReplayDriver(fleet, schedule, clock=clock, dt_s=0.05,
+                          monitor=monitor, autoscaler=scaler,
+                          max_steps=5000)
+    try:
+        report = driver.run()
+    finally:
+        fleet.close()
+    return report
+
+
+def validate_report(report: dict) -> None:
+    """Schema check: the report a dashboard/driver consumes must carry the
+    full observatory structure with sane types."""
+    for key in ("driver", "schedule", "slo", "autoscaler"):
+        if key not in report:
+            _H.fail_now(f"report missing section {key!r}")
+    drv = report["driver"]
+    for key in ("submitted", "refused", "steps", "windows"):
+        if not isinstance(drv.get(key), int):
+            _H.fail_now(f"driver.{key} not an int: {drv.get(key)!r}")
+    if not isinstance(report["schedule"].get("digest"), str):
+        _H.fail_now("schedule.digest missing")
+    slo = report["slo"]
+    wins = slo.get("windows")
+    if not isinstance(wins, list) or not wins:
+        _H.fail_now("slo.windows empty")
+    for w in wins:
+        for key in ("window", "finished", "met", "tokens", "good_tokens"):
+            if not isinstance(w.get(key), int):
+                _H.fail_now(f"window.{key} not an int: {w.get(key)!r}")
+        att = w.get("attainment")
+        if att is not None and not (0.0 <= att <= 1.0):
+            _H.fail_now(f"window attainment out of range: {att!r}")
+        if w["met"] > w["finished"]:
+            _H.fail_now("window met > finished")
+        if w["good_tokens"] > w["tokens"]:
+            _H.fail_now("window good_tokens > tokens")
+        sig = w.get("signals", {})
+        if "ttft_ms" not in sig:
+            _H.fail_now("window signals missing ttft_ms")
+    tot = slo.get("totals", {})
+    if tot.get("finished", 0) <= 0:
+        _H.fail_now("no finished requests in SLO totals")
+    asc = report["autoscaler"]
+    if not isinstance(asc.get("stats"), dict):
+        _H.fail_now("autoscaler.stats missing")
+    json.dumps(report)        # must round-trip as plain JSON
+
+
+def second_half_attainment(report: dict):
+    """Attainment over the later half of the run's windows — the
+    post-control read the exit judgment uses (the autoscaler cannot fix
+    windows that elapsed before it had evidence to act on)."""
+    wins = [w for w in report["slo"]["windows"]
+            if w["attainment"] is not None]
+    if not wins:
+        return None
+    half = wins[len(wins) // 2:]
+    fin = sum(w["finished"] for w in half)
+    met = sum(w["met"] for w in half)
+    return (met / fin) if fin else None
+
+
+def report_exit(report: dict) -> int:
+    """The SLO contract judgment: 0 when the post-control attainment meets
+    the configured target OR the controller engaged brownout at max
+    replicas (the last lever — degraded deliberately, not collapsed
+    silently); 1 otherwise."""
+    target = report["slo"]["config"]["target_attainment"]
+    att = second_half_attainment(report)
+    if att is not None and att >= target:
+        return 0
+    asc = report.get("autoscaler") or {}
+    if asc.get("stats", {}).get("brownouts", 0) >= 1:
+        return 0
+    return 1
+
+
+def selftest() -> int:
+    from paddle_tpu.observability import (WorkloadConfig, encode_schedule,
+                                          generate_schedule)
+
+    cfg = _selftest_workload()
+    enc1 = encode_schedule(generate_schedule(cfg))
+    enc2 = encode_schedule(generate_schedule(cfg))
+    other = dataclasses.replace(cfg, seed=cfg.seed + 1)
+    enc3 = encode_schedule(generate_schedule(other))
+    _H.case("schedule determinism", enc1 == enc2 and enc1 != enc3,
+            f"{len(enc1)} bytes, same seed identical, "
+            "different seed differs")
+
+    with tempfile.TemporaryDirectory() as tmp:
+        on = run_replay(os.path.join(tmp, "on"), autoscale_on=True)
+        validate_report(on)
+        stats = on["autoscaler"]["stats"]
+        acted = stats["scale_ups"] + stats["brownouts"] >= 1
+        att_on = second_half_attainment(on)
+        rc_on = report_exit(on)
+        _H.case(
+            "autoscaler arm", acted and rc_on == 0,
+            f"scale_ups={stats['scale_ups']} brownouts={stats['brownouts']} "
+            f"second-half attainment={att_on} exit={rc_on}")
+
+        off = run_replay(os.path.join(tmp, "off"), autoscale_on=False)
+        validate_report(off)
+        att_off = second_half_attainment(off)
+        target = off["slo"]["config"]["target_attainment"]
+        rc_off = report_exit(off)
+        _H.case(
+            "control arm (autoscaler off)",
+            rc_off == 1 and att_off is not None and att_off < target,
+            f"second-half attainment={att_off} < target={target} "
+            f"exit={rc_off}")
+        _H.case(
+            "same-seed replay reproduces the schedule",
+            on["schedule"]["digest"] == off["schedule"]["digest"],
+            on["schedule"]["digest"])
+    return _H.finish(
+        "TRAFFIC REPLAY SELFTEST OK: {cases} checks — schedule "
+        "byte-identity, report schema, autoscaler recovery, control-arm "
+        "attainment flip",
+        "TRAFFIC REPLAY SELFTEST FAIL: {failures}/{cases} checks failed")
+
+
+def main(argv) -> int:
+    if "--selftest" in argv:
+        return selftest()
+
+    def opt(name, default=None, cast=str):
+        for i, a in enumerate(argv):
+            if a == name and i + 1 < len(argv):
+                return cast(argv[i + 1])
+        return default
+
+    if "--dump" in argv:
+        from paddle_tpu.observability import (WorkloadConfig,
+                                              encode_schedule,
+                                              generate_schedule,
+                                              schedule_digest)
+
+        cfg = WorkloadConfig(
+            seed=opt("--seed", 0, int),
+            duration_s=opt("--duration", 10.0, float),
+            rate_rps=opt("--rate", 4.0, float),
+            arrival=opt("--arrival", "poisson"))
+        sched = generate_schedule(cfg)
+        path = opt("--dump")
+        with open(path, "wb") as f:
+            f.write(encode_schedule(sched))
+        print(f"OK: {len(sched)} arrivals -> {path} "
+              f"(digest {schedule_digest(sched)})")
+        return 0
+    if "--run" in argv:
+        with tempfile.TemporaryDirectory() as tmp:
+            report = run_replay(tmp,
+                                autoscale_on="--no-autoscale" not in argv)
+        print(json.dumps(report, indent=1))
+        rc = report_exit(report)
+        print(f"{'OK' if rc == 0 else 'FAIL'}: second-half attainment "
+              f"{second_half_attainment(report)} vs target "
+              f"{report['slo']['config']['target_attainment']}")
+        return rc
+    print(__doc__)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
