@@ -900,6 +900,148 @@ def bench_slo_burst(dev, on_tpu):
               None)
 
 
+def bench_disagg(dev, on_tpu):
+    """Disaggregated prefill/decode tiers under burst traffic
+    (docs/SERVING.md "Disaggregated tiers"; ROADMAP item 3). A/B: the
+    PR 11 bursty open-loop ``generate_schedule`` mix replayed wall-clock
+    against a UNIFIED 2-replica fleet, then against a TieredRouter with 1
+    prefill + 1 decode replica (same engine config, same device, same
+    schedule bytes) — the tier split packs prompts on the prefill replica
+    and migrates finished chains, so decode never stalls behind a long
+    prompt. Both emitted lines are SECONDARY-guarded
+    (tools/check_bench_regression.py):
+
+    - ``serving_disagg_ttft_p99_under_burst_ms`` ("lower", 250ms floor):
+      p99 TTFT of the tiered arm; the unified arm's p99 prints as a
+      comment for the A/B read.
+    - ``serving_kv_migration_time_s`` ("lower", 0.5s floor): mean
+      export -> splice wall time per migrated chain.
+    """
+    import os
+    import tempfile
+
+    from paddle_tpu.inference.disagg import TieredRouter
+    from paddle_tpu.inference.fleet import FleetConfig, FleetRouter
+    from paddle_tpu.inference.serving import (ContinuousBatchingEngine,
+                                              PrefixCacheConfig, Request)
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.observability import (ReplayDriver, TenantSpec,
+                                          TraceRecorder, WorkloadConfig,
+                                          generate_schedule)
+
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=512, intermediate_size=1408,
+            num_hidden_layers=4, num_attention_heads=8,
+            num_key_value_heads=8, max_position_embeddings=512,
+            dtype="bfloat16")
+        slots, max_len, page, block = 4, 256, 16, 8
+        wl = WorkloadConfig(
+            seed=29, duration_s=6.0, rate_rps=6.0, arrival="burst",
+            burst_every_s=3.0, burst_len_s=1.0, burst_multiplier=4.0,
+            vocab_size=cfg.vocab_size, prompt_min=16, prompt_max=48,
+            output_min=8, output_max=32,
+            tenants=(TenantSpec("chat", 2.0, prefix_len=16),
+                     TenantSpec("batch", 1.0, priority=2)))
+    else:
+        cfg = LlamaConfig.tiny(num_hidden_layers=1)
+        slots, max_len, page, block = 2, 32, 8, 2
+        wl = WorkloadConfig(
+            seed=29, duration_s=3.0, rate_rps=8.0, arrival="burst",
+            burst_every_s=1.5, burst_len_s=0.5, burst_multiplier=3.0,
+            vocab_size=cfg.vocab_size, prompt_min=4, prompt_max=16,
+            output_min=2, output_max=8,
+            tenants=(TenantSpec("chat", 2.0, prefix_len=8),
+                     TenantSpec("batch", 1.0, priority=2)))
+    model = LlamaForCausalLM(cfg)
+
+    def build():
+        return ContinuousBatchingEngine(
+            model, max_batch=slots, max_len=max_len, page_size=page,
+            block_size=block,
+            prefix_cache=PrefixCacheConfig(extra_blocks=slots))
+
+    schedule = generate_schedule(wl)
+    rng = np.random.default_rng(0)
+    warm = [Request(rng.integers(0, cfg.vocab_size,
+                                 (wl.prompt_min,)).astype(np.int32),
+                    max_new_tokens=wl.output_max, seed=950 + i)
+            for i in range(2 * slots)]
+
+    def replay(target):
+        """Warm (compile) wave closed-loop, then a FRESH recorder — and a
+        migration-stats snapshot — for the measured open-loop replay: the
+        warm-only SLO discipline, applied to TTFT *and* to
+        serving_kv_migration_time_s (the warm wave's migrations carry
+        first-call jit/dispatch cost and must not pollute the mean)."""
+        for r in warm:
+            target.submit(Request(r.prompt, max_new_tokens=r.max_new_tokens,
+                                  seed=r.seed))
+        target.run_until_done(max_steps=20000)
+        tracer = TraceRecorder()
+        target.tracer = tracer
+        for rep in target.replicas:
+            rep.sup.tracer = tracer
+            rep.sup._attach_tracer()
+        snap = {k: target.stats.get(k, 0) for k in
+                ("migrations", "migration_s", "migration_pages",
+                 "migration_bytes", "migration_deferred",
+                 "migration_refused", "migration_reprefill")}
+        driver = ReplayDriver(target, schedule, wall_clock=True,
+                              max_steps=200000)
+        driver.run()
+        return tracer, snap
+
+    with tempfile.TemporaryDirectory() as tmp:
+        unified = FleetRouter(build, os.path.join(tmp, "uni"),
+                              num_replicas=2,
+                              config=FleetConfig(brownout_depth=10 ** 9))
+        tr_uni, _ = replay(unified)
+        unified.close()
+        tiered = TieredRouter(build, build, os.path.join(tmp, "tier"),
+                              num_prefill=1, num_decode=1,
+                              config=FleetConfig(brownout_depth=10 ** 9))
+        tr_tier, snap = replay(tiered)
+        tiered.close()
+    p99_uni = tr_uni._h_ttft.quantile(0.99)
+    p99_tier = tr_tier._h_ttft.quantile(0.99)
+    # measured-window deltas only: the warm wave's migrations are compile
+    # cost, not steady-state handoff time
+    mig = tiered.stats["migrations"] - snap["migrations"]
+    mig_s = tiered.stats["migration_s"] - snap["migration_s"]
+    mig_pages = tiered.stats["migration_pages"] - snap["migration_pages"]
+    mig_bytes = tiered.stats["migration_bytes"] - snap["migration_bytes"]
+    print(f"# disagg burst A/B: {len(schedule)} arrivals; unified p99 TTFT "
+          f"{p99_uni if p99_uni is None else round(p99_uni, 1)}ms vs tiered "
+          f"{p99_tier if p99_tier is None else round(p99_tier, 1)}ms; "
+          f"{mig} chain(s) migrated in the measured window "
+          f"({mig_pages} pages, "
+          f"{tiered.stats['migration_deferred'] - snap['migration_deferred']}"
+          f" deferred step(s), "
+          f"{tiered.stats['migration_refused'] - snap['migration_refused']}"
+          f" splice refusal(s), "
+          f"{tiered.stats['migration_reprefill'] - snap['migration_reprefill']}"
+          f" re-prefills)", flush=True)
+    if p99_tier is None:
+        print("# disagg bench: no first tokens recorded — "
+              "serving_disagg_ttft_p99_under_burst_ms omitted", flush=True)
+    else:
+        _emit("serving_disagg_ttft_p99_under_burst_ms", p99_tier,
+              f"ms (p99 TTFT, open-loop burst replay on 1-prefill+"
+              f"1-decode tiers, {slots} slots each; unified 2-replica "
+              f"fleet on the same schedule: "
+              f"{p99_uni if p99_uni is None else round(p99_uni, 1)}ms)",
+              None)
+    if mig:
+        _emit("serving_kv_migration_time_s", mig_s / mig,
+              f"s (mean export->splice wall time per migrated chain, warm "
+              f"measured window only; {mig} migration(s), "
+              f"{mig_bytes} bytes moved)", None)
+    else:
+        print("# disagg bench: no chain migrated — "
+              "serving_kv_migration_time_s omitted", flush=True)
+
+
 def bench_unet(dev, on_tpu):
     """Stable-Diffusion-class UNet train step (BASELINE config #5: conv +
     cross-attention through the compiler path). One jitted
@@ -1167,6 +1309,11 @@ def main():
         bench_slo_burst(dev, on_tpu)
     except Exception as e:
         print(f"# slo burst bench failed: {e!r}", flush=True)
+    gc.collect()
+    try:
+        bench_disagg(dev, on_tpu)
+    except Exception as e:
+        print(f"# disagg bench failed: {e!r}", flush=True)
     gc.collect()
     try:
         bench_unet(dev, on_tpu)

@@ -52,6 +52,10 @@ Known sites (see docs/RESILIENCE.md for the catalogue):
 ``fleet.drain``       fleet router, top of every fleet step per replica
                       (same detail; ``kill`` = operator drain signal —
                       the rolling drain/restart drill, PT-FLT-002)
+``serving.kv_transfer``  tiered router, migrated KV-chain artifact in
+                         transit between tiers (detail = ``rid:<id>``;
+                         ``bitflip`` corrupts page bytes — the
+                         PT-SRV-007 kv_migration_corruption drill)
 ====================  =====================================================
 
 With no plan installed every hook is a cheap no-op (one global read), so

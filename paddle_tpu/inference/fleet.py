@@ -119,12 +119,13 @@ class FleetConfig:
 
 class _Replica:
     def __init__(self, idx: int, sup: ServingSupervisor, journal_path: str,
-                 gen: int = 0):
+                 gen: int = 0, tier: str = "serving"):
         self.idx = idx
         self.sup = sup
         self.journal_path = journal_path
         self.state = ReplicaState.ALIVE
         self.gen = gen
+        self.tier = tier                # "serving" | "prefill" | "decode"
         self.retiring = False           # drain completes into RETIRED
         self.progress = None            # supervisor progress marker
         self.last_progress_t = time.monotonic()
@@ -174,8 +175,9 @@ class FleetRouter:
             gen = self._latest_gen(i)
             path = os.path.join(fleet_dir, f"replica{i}.g{gen}.jrnl")
             self.replicas.append(_Replica(
-                i, ServingSupervisor(build_engine, path,
-                                     **self._rep_kw(i)), path, gen=gen))
+                i, ServingSupervisor(self._builder(i), path,
+                                     **self._rep_kw(i)), path, gen=gen,
+                tier=self.tier_of(i)))
         self.requests: Dict[int, Request] = {}
         self._assigned: Dict[int, int] = {}          # rid -> replica idx
         self._returned: Set[int] = set()
@@ -210,6 +212,17 @@ class FleetRouter:
             self.tracer.instant("request_lost", rid,
                                 tags={"replica": replica},
                                 error=(user.error or "")[:200])
+
+    def _builder(self, idx: int) -> Callable[[], ContinuousBatchingEngine]:
+        """Engine factory for replica ``idx`` — one homogeneous fleet by
+        default; the :class:`~paddle_tpu.inference.disagg.TieredRouter`
+        overrides this with per-tier factories (tier membership)."""
+        return self._build
+
+    def tier_of(self, idx: int) -> str:
+        """Tier label for replica ``idx`` (``"serving"`` in a flat fleet;
+        the TieredRouter partitions into ``"prefill"``/``"decode"``)."""
+        return "serving"
 
     def _rep_kw(self, idx: int) -> dict:
         kw = dict(self._sup_kw)
@@ -292,13 +305,17 @@ class FleetRouter:
                 f"request rid={req.rid} shed at submit (every replica at "
                 "depth); retry later or raise the priority")
 
+    def _routable(self, req: Request) -> List[_Replica]:
+        """Replicas eligible to admit a NEW submission — the whole alive
+        fleet here; the TieredRouter narrows this to the prefill tier."""
+        return [r for r in self.replicas if r.state == ReplicaState.ALIVE]
+
     def _route_order(self, req: Request):
         """Candidate replicas, best first, as ``(replica, is_warm)``:
         affinity target (bounded by ``queue_slack``), then least-loaded
         with a deterministic rid-based tie-break so equal-load replicas
         share the traffic."""
-        alive = [r for r in self.replicas
-                 if r.state == ReplicaState.ALIVE]
+        alive = self._routable(req)
         if not alive:
             return []
         loads = {r.idx: r.sup.load() for r in alive}
@@ -629,7 +646,7 @@ class FleetRouter:
         rep.gen += 1
         rep.journal_path = os.path.join(
             self.fleet_dir, f"replica{rep.idx}.g{rep.gen}.jrnl")
-        rep.sup = ServingSupervisor(self._build, rep.journal_path,
+        rep.sup = ServingSupervisor(self._builder(rep.idx), rep.journal_path,
                                     **self._rep_kw(rep.idx))
         rep.state = ReplicaState.ALIVE
         rep.retiring = False
@@ -660,8 +677,9 @@ class FleetRouter:
         gen = self._latest_gen(idx)
         path = os.path.join(self.fleet_dir, f"replica{idx}.g{gen}.jrnl")
         self.replicas.append(_Replica(
-            idx, ServingSupervisor(self._build, path, **self._rep_kw(idx)),
-            path, gen=gen))
+            idx, ServingSupervisor(self._builder(idx), path,
+                                   **self._rep_kw(idx)),
+            path, gen=gen, tier=self.tier_of(idx)))
         self.stats["replicas_added"] += 1
         self.events.append(
             ("PT-FLT-005", f"replica {idx} added (scale-out: fleet now "

@@ -22,8 +22,10 @@ Components:
   record). Records: ``admit`` (full request parameters), ``prog`` (the
   emitted-token high-water mark plus the token ids themselves, so replay
   can verify bit-identity even across a process restart), ``fin``,
-  ``migr`` (migrated to another replica — fleet drain), ``shed``,
-  ``crash``/``recovered`` markers. Appends can be BATCHED off the hot
+  ``migr`` (migrated to another replica — fleet drain), ``migr-kv``
+  (finished-prefill KV chain migrated to a decode-tier replica, with the
+  chain digest — inference/disagg.py), ``shed``, ``crash``/``recovered``
+  markers. Appends can be BATCHED off the hot
   path: ``defer`` buffers encoded records in memory and ``flush`` writes
   them in one syscall — the supervisor defers its per-step ``prog``
   records and flushes once per step, BEFORE any token is surfaced to a
@@ -196,10 +198,14 @@ class RequestJournal:
 
     @staticmethod
     def pending(records: List[dict]) -> List[dict]:
-        """Admit records with no matching terminal (``fin``/``migr``)
-        record — the ONE definition of the replay set, shared by
-        :meth:`unfinished` and the fleet's journal-backed failover."""
-        done = {r["rid"] for r in records if r["k"] in ("fin", "migr")}
+        """Admit records with no matching terminal
+        (``fin``/``migr``/``migr-kv``) record — the ONE definition of the
+        replay set, shared by :meth:`unfinished` and the fleet's
+        journal-backed failover. A ``migr-kv`` chain handoff ends this
+        journal's responsibility exactly like a drain ``migr``: replaying
+        it here while the decode tier serves it would double-serve."""
+        done = {r["rid"] for r in records
+                if r["k"] in ("fin", "migr", "migr-kv")}
         return [r for r in records
                 if r["k"] == "admit" and r["rid"] not in done]
 
@@ -488,6 +494,52 @@ class ServingSupervisor:
         if not self.engine.withdraw_queued(rid):
             return None
         self.journal.append("migr", rid=rid)
+        self._live.pop(rid, None)
+        self._verify.discard(rid)
+        self.requests.pop(rid, None)
+        return self._meta.pop(rid, None)
+
+    # -- disaggregated-tier KV migration (inference/disagg.py) -------------
+    def submit_migrated(self, req: Request, artifact: bytes, codec) -> int:
+        """Accept a migrated finished-prefill chain: splice its KV pages
+        into this supervisor's engine and resume decode at the recorded
+        position. Journals the admit + the delivered high-water mark
+        AFTER the splice lands (same ordering as :meth:`submit`): a
+        refusal — ``EngineSaturated`` on slot/pool shortfall, typed
+        ``KVChainCorrupt`` (PT-SRV-007) on a crc/digest mismatch —
+        propagates with no journal trace, so the caller can retry
+        elsewhere or fall back to re-running prefill.
+
+        The twin CONTINUES the stream in place (its output is pre-seeded
+        with the delivered tokens) — nothing regenerates, so there is no
+        PT-SRV-005 verification window. A crash AFTER this lands replays
+        from the journaled admit through the ordinary recovery path: the
+        rebuilt engine re-runs prefill and verifies the delivered prefix
+        byte-for-byte — "re-run prefill", never double-serve."""
+        meta = _admit_record(req)
+        twin = _request_from(meta)
+        twin.output = [int(t) for t in req.output]
+        twin._n_out = len(twin.output)
+        codec.import_chain(self.engine, artifact, req=twin)
+        self.journal.defer("admit", **meta)
+        if req.output:
+            self.journal.defer("prog", rid=req.rid, hwm=len(req.output),
+                               toks=[int(t) for t in req.output])
+        self.journal.flush()
+        req._n_out = len(req.output)
+        self.requests[req.rid] = req
+        self._live[req.rid] = twin
+        self._meta[req.rid] = meta
+        return req.rid
+
+    def retire_migrated(self, rid: int, digest: str) -> Optional[dict]:
+        """The KV-migration handoff's source side: journal ``migr-kv``
+        (this journal's responsibility for ``rid`` ends — failover over
+        this journal must not re-serve it) and release the ACTIVE slot
+        (pages decref'd; the chain bytes were exported first). Returns the
+        admit record, mirroring :meth:`withdraw`."""
+        self.journal.append("migr-kv", rid=rid, digest=str(digest))
+        self.engine.withdraw_active(rid)
         self._live.pop(rid, None)
         self._verify.discard(rid)
         self.requests.pop(rid, None)

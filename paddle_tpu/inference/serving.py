@@ -99,9 +99,10 @@ from ..ops.paged_attention import BlockAllocator, RadixPrefixCache
 
 __all__ = ["AutoscaleConfig", "BlockAllocator", "BrownoutConfig",
            "ContinuousBatchingEngine", "EngineSaturated", "FleetConfig",
-           "FleetRouter", "PrefixCacheConfig", "RadixPrefixCache",
-           "ReplicaState", "Request", "RequestJournal", "RequestShed",
-           "SLOAutoscaler", "ServingSupervisor", "StepWatchdog"]
+           "FleetRouter", "KVChainCodec", "KVChainCorrupt",
+           "PrefixCacheConfig", "RadixPrefixCache", "ReplicaState",
+           "Request", "RequestJournal", "RequestShed", "SLOAutoscaler",
+           "ServingSupervisor", "StepWatchdog", "TieredRouter"]
 
 
 def __getattr__(name):
@@ -116,6 +117,12 @@ def __getattr__(name):
         from . import fleet
 
         return getattr(fleet, name)
+    if name in ("KVChainCodec", "KVChainCorrupt", "TieredRouter"):
+        # disaggregated prefill/decode tiers (disagg.py) — lazy for the
+        # same reason as the fleet: it pulls recovery + fleet in
+        from . import disagg
+
+        return getattr(disagg, name)
     if name in ("SLOAutoscaler", "AutoscaleConfig"):
         # the SLO-pressure autoscaler (autoscale.py) — lazy like the fleet:
         # importing serving must not pull the control loop in
@@ -927,6 +934,103 @@ class ContinuousBatchingEngine:
                     self._n_deadlined = max(0, self._n_deadlined - 1)
                 return True
         return False
+
+    # -- disaggregated-tier hooks (inference/disagg.py — docs/SERVING.md
+    # "Disaggregated tiers") ------------------------------------------------
+    def slot_of(self, rid: int) -> Optional[int]:
+        """Slot currently serving ``rid`` (None when queued/finished) —
+        O(active), never O(max_batch)."""
+        for i, r in self._occupied.items():
+            if r.rid == rid:
+                return i
+        return None
+
+    def migration_ready(self) -> List[int]:
+        """rids whose prefill is COMPLETE (first token scheduled, slot in
+        the decode set) with decode work left — the prefill tier's
+        migration candidates. Mid-chunk slots are not exportable: their
+        cache holds a partial prompt and no sampling has happened."""
+        out = []
+        for i, r in sorted(self._occupied.items()):
+            if self.prefix_cache is not None and i in self._prefill_next:
+                continue
+            if r._n_out >= 1 and not r.done:
+                out.append(r.rid)
+        return out
+
+    def withdraw_active(self, rid: int) -> bool:
+        """Release ``rid``'s ACTIVE slot without terminal bookkeeping —
+        the KV-migration handoff (ownership moves to another engine; the
+        request is neither done nor failed here). The caller must have
+        exported the chain bytes FIRST: the decref'd pages may be
+        re-mapped by the very next admission."""
+        slot = self.slot_of(rid)
+        if slot is None:
+            return False
+        req = self._slots[slot]
+        if req.deadline_s is not None:
+            self._n_deadlined = max(0, self._n_deadlined - 1)
+        self._release_slot(slot)
+        return True
+
+    def admit_migrated(self, req: "Request", blocks: Sequence[int],
+                       pos: int, last_tok: int) -> int:
+        """Resume-at-position admission: occupy a free slot with a
+        migrated finished-prefill chain whose pages the caller
+        (:class:`~paddle_tpu.inference.disagg.KVChainCodec`) has already
+        allocated (refcount 1) and filled with the exported bytes.
+
+        Maps the table row, restores the device position and last-token
+        carry, and registers the prompt's full pages in the radix cache so
+        the migrated prefix is cache-visible to later admissions (first
+        writer wins — a duplicate chain stays private). Decode then
+        continues through the ordinary step programs: sample keys are
+        stateless (``fold_in(seed, position)``), so given the same page
+        bytes the continued stream is bit-identical to never migrating.
+        Raises :class:`EngineSaturated` when no slot is free — the caller
+        still owns ``blocks`` and must decref them."""
+        if self.prefix_cache is None:
+            raise ValueError("KV-chain splice needs a prefix-cache engine "
+                             "(dynamic block tables over the refcounted "
+                             "pool)")
+        if not self._free_slots:
+            raise EngineSaturated(
+                f"no free slot for migrated rid={req.rid} "
+                f"({len(self._occupied)}/{self.max_batch} busy)")
+        slot = self._free_slots.popleft()
+        row = np.full(self._maxp, self._park, np.int32)
+        row[: len(blocks)] = blocks
+        self._slot_rows[slot] = row
+        self._slot_blocks[slot] = list(blocks)
+        self._slots[slot] = req
+        self._occupied[slot] = req
+        req._engine = weakref.ref(self)
+        # deadline clock RESTARTS at re-admission (recovery.py semantics:
+        # a tier handoff is the operator's cost, not the request's)
+        req._enqueued_at = _time.monotonic()
+        if req.deadline_s is not None:
+            self._n_deadlined += 1
+        self._pos[slot] = int(pos)
+        self._temps[slot] = req.temperature
+        self._tops[slot] = req.top_p
+        self._topks[slot] = req.top_k
+        self._seeds[slot] = req.seed
+        self._samp_dev = None
+        # control-plane eager scatter: the decode chain reads the carry
+        # from device state, and migration happens once per request
+        self._last_tok = self._last_tok.at[slot].set(
+            jnp.int32(int(last_tok)))
+        if self._fused:
+            self._queue_update(slot, row, int(pos), True, req.seed,
+                               req.temperature, req.top_p, req.top_k)
+        else:
+            self._tables_host[slot] = row
+            self._tables_dirty = True
+        n_full = len(req.prompt) // self.page_size
+        if n_full and not self._brownout_active:
+            self._radix.insert(req.prompt[: n_full * self.page_size],
+                               list(blocks)[:n_full])
+        return slot
 
     def _drain_pending(self):
         """Materialize deferred token blocks into request outputs.

@@ -210,12 +210,16 @@ def fleet_collector(router):
         load = MetricFamily("pt_fleet_replica_load", "gauge",
                             "queued + slotted requests per replica")
         for rep in router.replicas:
+            # tier label: "serving" on a flat fleet, prefill/decode under
+            # a TieredRouter (docs/SERVING.md "Disaggregated tiers") — so
+            # dashboards can split load/state per tier
+            tier = getattr(rep, "tier", "serving")
             state.add({ReplicaState.ALIVE: 1.0,
                        ReplicaState.DRAINING: 0.5,
                        ReplicaState.RETIRED: -1.0}.get(rep.state, 0.0),
-                      replica=str(rep.idx))
+                      replica=str(rep.idx), tier=tier)
             if rep.state not in _GONE:
-                load.add(rep.sup.load(), replica=str(rep.idx))
+                load.add(rep.sup.load(), replica=str(rep.idx), tier=tier)
                 fams.extend(supervisor_collector(
                     rep.sup, replica=str(rep.idx))())
         fams.append(state)

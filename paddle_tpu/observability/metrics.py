@@ -297,6 +297,17 @@ class Histogram(_Instrument):
             fam.add(cum, suffix="_bucket", le="+Inf", **labels)
             fam.add(row[-1], suffix="_sum", **labels)
             fam.add(cum, suffix="_count", **labels)
+        if not items:
+            # a registered histogram always renders (all-zero row) — same
+            # posture as counters/gauges in _Instrument.family: dashboards
+            # keyed on the family never see it vanish, and the scrape gate
+            # can REQUIRE it before the first observation lands (e.g.
+            # pt_migration_time_ms on a fleet that has not migrated yet)
+            for b in self.buckets:
+                fam.add(0.0, suffix="_bucket", le=_fmt(b))
+            fam.add(0.0, suffix="_bucket", le="+Inf")
+            fam.add(0.0, suffix="_sum")
+            fam.add(0.0, suffix="_count")
         return fam
 
 

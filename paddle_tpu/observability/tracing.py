@@ -136,6 +136,24 @@ class TraceRecorder:
         self._c_failovers = reg.counter(
             "pt_serving_failovers_total", "requests failed over to another "
             "replica")
+        # disaggregated-tier KV migration surface (inference/disagg.py —
+        # docs/SERVING.md "Disaggregated tiers"): counters + a wall-time
+        # histogram for the prefill→decode chain handoff. REQUIRED by
+        # tools/scrape_metrics.py, so they register (and render at zero)
+        # on every recorder, migrating fleet or not.
+        self._c_migrations = reg.counter(
+            "pt_migration_total",
+            "finished-prefill KV chains migrated between serving tiers")
+        self._c_migration_pages = reg.counter(
+            "pt_migration_pages_total",
+            "KV pages moved by tier migration")
+        self._c_migration_failures = reg.counter(
+            "pt_migration_failures_total",
+            "migrations not spliced, by reason (corrupt/refused)")
+        self._h_migration = reg.histogram(
+            "pt_migration_time_ms",
+            "export -> splice wall time per migrated chain, ms",
+            buckets=DEFAULT_LATENCY_BUCKETS_MS)
 
     # -- low-level event plumbing ------------------------------------------
     def now(self) -> float:
@@ -388,6 +406,33 @@ class TraceRecorder:
         self._c_failovers.inc()
         self.instant("failover", rid, tags, from_replica=int(from_replica),
                      to_replica=int(to_replica))
+
+    def migrate(self, rid: int, from_replica: int, to_replica: int,
+                pages: int, nbytes: int, t0: float,
+                t1: Optional[float] = None,
+                tags: Optional[dict] = None) -> None:
+        """One finished-prefill KV chain handed from the prefill tier to a
+        decode replica (inference/disagg.py): a span on the request's lane
+        covering export -> splice, plus the ``pt_migration_*`` counters.
+        The request stays OPEN — migration is an edge, not a terminal."""
+        t1 = self.now() if t1 is None else t1
+        with self._lock:
+            self._c_migrations.inc()
+            self._c_migration_pages.inc(int(pages))
+            self._h_migration.observe(max(0.0, (t1 - t0) * 1e3))
+            self.span("migrate", rid, t0, t1, tags,
+                      from_replica=int(from_replica),
+                      to_replica=int(to_replica), pages=int(pages),
+                      bytes=int(nbytes))
+
+    def migration_failure(self, rid: int, reason: str,
+                          tags: Optional[dict] = None) -> None:
+        """A chain that did not splice: ``corrupt`` (PT-SRV-007 crc/digest
+        rejection — decode side re-runs prefill) or ``refused`` (pool
+        shortfall — retried elsewhere / fallen back to re-prefill)."""
+        with self._lock:
+            self._c_migration_failures.inc(reason=str(reason))
+            self.instant("migrate_failure", rid, tags, reason=str(reason))
 
     def recovery(self, t0: float, code: str, replayed: int,
                  t1: Optional[float] = None,

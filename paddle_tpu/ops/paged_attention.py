@@ -549,6 +549,35 @@ def append_paged_kv(k_cache, v_cache, k_new, v_new, block_tables, positions,
     return k_cache, v_cache
 
 
+def gather_chain_pages(kv, blocks):
+    """Host-materialize a block chain's page bytes from every layer's
+    (k, v) pool pair — the EXPORT half of KV-block migration
+    (inference/disagg.py): ``kv`` is the engine's per-layer
+    ``[(k_pages, v_pages), ...]`` list, ``blocks`` the chain's page ids in
+    block-table order. Returns ``[(k_np, v_np), ...]`` with arrays of
+    shape ``[len(blocks), kv_heads, page, head_dim]``. The np.asarray
+    readback fences any in-flight append/decode program that wrote these
+    pages, so the bytes are exactly what the next decode step would have
+    attended."""
+    import numpy as np
+
+    idx = np.asarray(blocks, np.int32)
+    return [(np.asarray(k[idx]), np.asarray(v[idx])) for k, v in kv]
+
+
+def scatter_chain_pages(kv, blocks, pages):
+    """Write exported chain bytes into freshly-allocated pool pages — the
+    IMPORT half of KV-block migration. ``pages`` is
+    :func:`gather_chain_pages` output (host arrays); each layer's pool
+    takes one eager scatter (control-plane dispatch — migration happens
+    once per request, never on the decode hot path). Returns the updated
+    per-layer ``[(k_pages, v_pages), ...]`` list."""
+    idx = jnp.asarray(blocks, jnp.int32)
+    return [(k.at[idx].set(jnp.asarray(pk, k.dtype)),
+             v.at[idx].set(jnp.asarray(pv, v.dtype)))
+            for (k, v), (pk, pv) in zip(kv, pages)]
+
+
 def gather_paged_kv(k_cache, v_cache, block_tables, max_len):
     """Dense [b, max_len, hkv, d] views of the paged cache (prefill path /
     debugging). max_len must be a multiple of page size."""

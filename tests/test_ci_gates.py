@@ -53,8 +53,10 @@ def test_manifest_counts_cover_reference_parity():
         # resilient-serving PR: + ServingSupervisor, RequestJournal,
         # RequestShed, BrownoutConfig, StepWatchdog;
         # fleet PR: + FleetRouter, FleetConfig, ReplicaState;
-        # SLO-observatory PR: + SLOAutoscaler, AutoscaleConfig
-        "paddle.inference.serving": 16,
+        # SLO-observatory PR: + SLOAutoscaler, AutoscaleConfig;
+        # disagg PR (docs/SERVING.md "Disaggregated tiers"): +
+        # KVChainCodec, KVChainCorrupt, TieredRouter
+        "paddle.inference.serving": 19,
         # observability PR (docs/OBSERVABILITY.md): MetricsRegistry +
         # Counter/Gauge/Histogram/MetricFamily, MetricsServer,
         # TraceRecorder, parse_prometheus_text, and the five collector
@@ -196,7 +198,7 @@ def test_concurrency_lint_gate_detects_seeded_defects():
     assert "PT-RACE-003" in r2.stdout
 
 
-@pytest.mark.slow   # ~3min of engine/train-loop compiles across 16 classes
+@pytest.mark.slow   # ~3min of engine/train-loop compiles across 17 classes
 def test_fault_drill_matrix():
     """Resilience gate (docs/RESILIENCE.md + docs/NUMERIC_GUARD.md +
     docs/SERVING.md): the seeded fault matrix — heartbeat loss, store
@@ -204,7 +206,8 @@ def test_fault_drill_matrix():
     prefix-cache block-pool exhaustion, 128-slot fused big-batch
     saturation, serving engine crash mid-decode, serving step stall,
     overload shed, fleet replica kill, fleet rolling drain/restart, fleet
-    overload brownout, NaN gradient, loss spike, poisoned batch — must be
+    overload brownout, KV-migration corruption (PT-SRV-007), NaN
+    gradient, loss spike, poisoned batch — must be
     absorbed with recovery enabled AND flip the exit code
     with recovery disabled. Runs in a subprocess (the drill forces the
     pure-Python store daemon for server-side faults).
@@ -221,7 +224,7 @@ def test_fault_drill_matrix():
          "--selftest"],
         capture_output=True, text=True, env=env, cwd=ROOT, timeout=500)
     assert r.returncode == 0, r.stdout + r.stderr
-    assert "FAULT DRILL OK: 16 fault classes" in r.stdout, r.stdout
+    assert "FAULT DRILL OK: 17 fault classes" in r.stdout, r.stdout
 
 
 def test_fault_drill_single_drill_exit_codes():

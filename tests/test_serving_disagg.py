@@ -1,0 +1,360 @@
+"""Disaggregated prefill/decode tiers + KV-block migration
+(inference/disagg.py — docs/SERVING.md "Disaggregated tiers").
+
+Fast in-process pins (unmarked, one tiny 1-layer engine set each): the
+codec round trip is bit-identical, corruption is a typed PT-SRV-007
+refusal, pool/slot shortfall is ``EngineSaturated`` with the destination
+untouched, ``migr-kv`` is terminal in the journal replay set, and the
+migration telemetry renders. The compile-heavy end-to-end cases —
+TieredRouter bit-identity over warm/cold radix + COW, mid-migration crash
+replay — are slow-marked (tier-1 sits near its wall-clock ceiling); the
+CI-gated ``kv_migration_corruption`` drill covers the corruption arms
+end-to-end (tools/fault_drill.py).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.disagg import (KVChainCodec, KVChainCorrupt,
+                                         TieredRouter)
+from paddle_tpu.inference.recovery import RequestJournal
+from paddle_tpu.inference.serving import (ContinuousBatchingEngine,
+                                          EngineSaturated, Request)
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(11)
+    cfg = LlamaConfig.tiny(num_hidden_layers=1)
+    return cfg, LlamaForCausalLM(cfg)
+
+
+def _build(m, **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("max_len", 32)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("block_size", 2)
+    kw.setdefault("prefix_cache", True)
+    return ContinuousBatchingEngine(m, **kw)
+
+
+@pytest.fixture(scope="module")
+def chain(model):
+    """One exported finished-prefill chain + the uninterrupted reference
+    stream, shared by the fast pins (ONE source-engine compile set)."""
+    cfg, m = model
+    rng = np.random.default_rng(5)
+    prompt = rng.integers(0, cfg.vocab_size, (8,)).astype(np.int32)
+    kw = dict(max_new_tokens=8, seed=50)
+
+    ref_eng = _build(m)
+    r_ref = Request(prompt, **kw)
+    ref_eng.add_request(r_ref)
+    ref_eng.run_until_done(max_steps=200)
+
+    src = _build(m)
+    req = Request(prompt, **kw)
+    src.add_request(req)
+    guard = 0
+    while not src.migration_ready() and guard < 50:
+        src.step()
+        guard += 1
+    art = KVChainCodec().export_chain(src, req.rid)
+    return dict(prompt=prompt, kw=kw, refs=list(r_ref.tokens),
+                artifact=art, src=src, rid=req.rid)
+
+
+class TestCodec:
+    def test_spliced_stream_bit_identical(self, model, chain):
+        """Import into a fresh engine and decode to completion: the
+        continued stream must be byte-identical to the uninterrupted
+        single-engine run — stateless sample keys + byte-identical pages
+        are the whole argument."""
+        _, m = model
+        codec = KVChainCodec()
+        hdr = codec.peek(chain["artifact"])
+        assert hdr["pos"] == len(chain["prompt"]) + len(hdr["delivered"])
+        assert hdr["delivered"] == chain["refs"][: len(hdr["delivered"])]
+        dst = _build(m)
+        req = codec.import_chain(dst, chain["artifact"])
+        # migrated prefix is cache-visible on the destination radix
+        assert len(dst._radix) >= len(chain["prompt"]) // dst.page_size
+        dst.run_until_done(max_steps=200)
+        assert list(req.tokens) == chain["refs"]
+        assert req.done and not req.failed
+
+    def test_source_unchanged_and_withdraw_active(self, model, chain):
+        """Export does not disturb the source: it decodes to the same
+        stream. withdraw_active then releases the slot + decrefs pages
+        with no terminal bookkeeping (the handoff's source half)."""
+        src = chain["src"]
+        assert chain["rid"] in src.migration_ready()
+        done = src.run_until_done(max_steps=200)
+        req = done[chain["rid"]]
+        assert list(req.output) == chain["refs"]
+        # a second request: withdraw mid-decode
+        r2 = Request(chain["prompt"], **chain["kw"])
+        src.add_request(r2)
+        guard = 0
+        while not src.migration_ready() and guard < 50:
+            src.step()
+            guard += 1
+        free_before = src._alloc.free_blocks + len(src._radix)
+        assert src.withdraw_active(r2.rid)
+        assert src.slot_of(r2.rid) is None
+        assert not r2.done and not r2.failed
+        # pages went back to free or stayed radix-cached — never leaked
+        assert src._alloc.free_blocks + len(src._radix) >= free_before
+        assert not src.withdraw_active(r2.rid)
+
+    def test_corruption_detected(self, chain):
+        codec = KVChainCodec()
+        art = chain["artifact"]
+        # flipped payload byte: per-page crc32 names the damaged page
+        bad = bytearray(art)
+        bad[-10] ^= 0xFF
+        with pytest.raises(KVChainCorrupt, match="crc32"):
+            codec.import_chain(None, bytes(bad))
+        # truncated in transit: structural refusal before any crc work
+        with pytest.raises(KVChainCorrupt, match="payload"):
+            codec.import_chain(None, art[:-7])
+        # not an artifact at all
+        with pytest.raises(KVChainCorrupt, match="magic"):
+            codec.import_chain(None, b"garbage")
+        # digest covers the whole header, not just the pages: a flipped
+        # resume position OR a flipped delivered-token id (the last-token
+        # carry decode resumes from) must refuse, never silently diverge
+        import json as _json
+
+        hdr, payload = codec._parse(art)
+        for mutate in (lambda h: h.update(pos=h["pos"] + 8),
+                       lambda h: h.update(
+                           delivered=[h["delivered"][0] + 1]
+                           + h["delivered"][1:])):
+            hdr2 = dict(hdr)
+            mutate(hdr2)
+            hj = _json.dumps(hdr2, separators=(",", ":")).encode()
+            forged = (KVChainCodec.MAGIC + (b"%08x" % len(hj)) + hj
+                      + bytes(payload))
+            with pytest.raises(KVChainCorrupt, match="digest"):
+                codec.import_chain(None, forged)
+
+    def test_shortfall_is_engine_saturated(self, model, chain):
+        """Slot or pool shortfall refuses the splice with the destination
+        untouched — the router's retry-elsewhere contract."""
+        _, m = model
+        codec = KVChainCodec()
+        dst = _build(m)
+        held = dst._alloc.hold(dst._alloc.num_blocks)
+        assert held == dst._alloc.num_blocks
+        with pytest.raises(EngineSaturated, match="shortfall"):
+            codec.import_chain(dst, chain["artifact"])
+        dst._alloc.release_held()
+        assert dst._alloc.free_blocks == dst._alloc.num_blocks
+        assert not dst._occupied and len(dst._radix) == 0
+        dst._free_slots.clear()            # every slot busy
+        with pytest.raises(EngineSaturated, match="slot"):
+            codec.import_chain(dst, chain["artifact"])
+        assert dst._alloc.free_blocks == dst._alloc.num_blocks
+
+    def test_geometry_mismatch_is_config_error(self, model, chain):
+        """A mismatched pool (different page size) is a deployment bug,
+        not transit corruption — typed apart from PT-SRV-007."""
+        _, m = model
+        dst = _build(m, page_size=16, max_len=64)
+        with pytest.raises(ValueError, match="geometry|pages"):
+            KVChainCodec().import_chain(dst, chain["artifact"])
+
+
+class TestJournalAndTelemetry:
+    def test_migr_kv_terminal_in_replay_set(self, tmp_path):
+        p = str(tmp_path / "j.jrnl")
+        j = RequestJournal(p)
+        base = dict(prompt=[1, 2], max_new=4, eos=None, temp=0.0,
+                    top_p=1.0, top_k=0, seed=1, deadline_s=None, priority=1)
+        j.append("admit", rid=1, **base)
+        j.append("admit", rid=2, **base)
+        j.append("migr-kv", rid=1, digest="ab" * 16)
+        j.close()
+        recs = RequestJournal.load(p)
+        # rid 1's chain moved to the decode tier: replaying it here would
+        # double-serve; rid 2 is still this journal's responsibility
+        assert [r["rid"] for r in RequestJournal.pending(recs)] == [2]
+
+    def test_migration_telemetry_renders(self):
+        from paddle_tpu.observability import (TraceRecorder,
+                                              parse_prometheus_text)
+
+        tracer = TraceRecorder()
+        t0 = tracer.now()
+        tracer.migrate(7, 0, 1, pages=3, nbytes=4096, t0=t0)
+        tracer.migration_failure(8, "corrupt")
+        text = tracer.registry.dump()
+        fams = parse_prometheus_text(text)
+        assert fams["pt_migration_total"].samples[0][2] == 1.0
+        assert fams["pt_migration_pages_total"].samples[0][2] == 3.0
+        assert any(lbl.get("reason") == "corrupt" and v == 1.0
+                   for _, lbl, v in
+                   fams["pt_migration_failures_total"].samples)
+        hist = fams["pt_migration_time_ms"]
+        assert any(s[0] == "_count" and s[2] >= 1 for s in hist.samples)
+        names = [e["name"] for e in tracer.events]
+        assert "migrate" in names and "migrate_failure" in names
+
+    def test_zero_state_families_still_render(self):
+        """A fresh recorder (no migration yet) must still expose the
+        pt_migration_* families — the scrape gate REQUIREs them."""
+        from paddle_tpu.observability import (TraceRecorder,
+                                              parse_prometheus_text)
+
+        fams = parse_prometheus_text(TraceRecorder().registry.dump())
+        for name in ("pt_migration_total", "pt_migration_pages_total",
+                     "pt_migration_failures_total", "pt_migration_time_ms"):
+            assert name in fams and fams[name].samples, name
+
+
+def test_prefixless_tier_refused_at_construction(model, tmp_path):
+    """A tier built without a prefix cache cannot export/splice chains —
+    refused when the router is built, not on the first finished prefill."""
+    cfg, m = model
+    with pytest.raises(ValueError, match="prefix cache"):
+        TieredRouter(lambda: _build(m, prefix_cache=False),
+                     lambda: _build(m), str(tmp_path), num_prefill=1,
+                     num_decode=1)
+
+
+def test_incompatible_decode_tier_stays_in_place(model, tmp_path):
+    """A decode tier whose pool geometry cannot hold the chain (different
+    page size) is filtered by the pre-handoff compatibility gate: the
+    candidate decodes to completion on the prefill tier — never retired
+    toward a destination that would strand it (the migr-kv handoff is
+    only journaled once a compatible target exists)."""
+    cfg, m = model
+    rng = np.random.default_rng(9)
+    p = rng.integers(0, cfg.vocab_size, (8,)).astype(np.int32)
+    tiered = TieredRouter(lambda: _build(m),
+                          lambda: _build(m, page_size=16, max_len=64),
+                          str(tmp_path), num_prefill=1, num_decode=1)
+    try:
+        req = Request(p, max_new_tokens=4, seed=12)
+        tiered.submit(req)
+        tiered.run_until_done(max_steps=500)
+    finally:
+        tiered.close()
+    assert req.done and not req.failed and len(req.tokens) == 4
+    assert tiered.stats["migrations"] == 0
+    assert tiered.stats["migration_reprefill"] == 0
+    assert tiered.stats["migration_deferred"] >= 1
+    recs = RequestJournal.load(tiered.replicas[0].journal_path)
+    assert not any(r["k"] == "migr-kv" for r in recs)
+
+
+def _wave_kwargs(cfg, n=4, shared_page=True):
+    """Mixed greedy/seeded wave; with ``shared_page`` the first prompt is
+    one full page repeated later — the repeat takes the full-prompt-hit
+    COW path on a warm radix."""
+    rng = np.random.default_rng(77)
+    pa = rng.integers(0, cfg.vocab_size, (8,)).astype(np.int32)
+    kws = [dict(prompt_ids=pa, max_new_tokens=6, seed=300)]
+    for i in range(1, n - 1):
+        p = rng.integers(0, cfg.vocab_size, (6 + i,)).astype(np.int32)
+        kw = dict(prompt_ids=p, max_new_tokens=8, seed=300 + i)
+        if i % 2 == 1:
+            kw.update(temperature=0.9)
+        kws.append(kw)
+    kws.append(dict(prompt_ids=pa,
+                    max_new_tokens=8, seed=300) if shared_page
+               else dict(prompt_ids=pa, max_new_tokens=8, seed=399))
+    return kws
+
+
+@pytest.mark.slow   # two tier engines + a reference engine compile; the
+#                     fast arm is TestCodec above (one chain, bit-identity
+#                     pinned in-process)
+def test_tiered_router_bit_identity_warm_cold_cow(model, tmp_path):
+    """End-to-end acceptance: a 1-prefill+1-decode TieredRouter serves a
+    mixed greedy/seeded wave — including a full-page repeat that takes the
+    COW path on the warm prefill radix — byte-identical to a single
+    engine, twice (cold then warm radix)."""
+    cfg, m = model
+    kws = _wave_kwargs(cfg)
+
+    def build():
+        return _build(m)
+
+    eng = build()
+    refs = []
+    for _ in range(2):                      # cold wave, then warm radix
+        reqs = [Request(**kw) for kw in kws]
+        for r in reqs:
+            eng.add_request(r)
+        eng.run_until_done(max_steps=500)
+        refs.append([list(r.tokens) for r in reqs])
+
+    tiered = TieredRouter(build, build, str(tmp_path), num_prefill=1,
+                          num_decode=1)
+    try:
+        for wave in range(2):
+            reqs = [Request(**kw) for kw in kws]
+            for r in reqs:
+                tiered.submit(r)
+            tiered.run_until_done(max_steps=2000)
+            streams = [list(r.tokens) for r in reqs]
+            assert streams == refs[wave], (wave, streams, refs[wave])
+        assert tiered.stats["migrations"] >= 2
+        assert tiered.stats["migration_pages"] >= 2
+        # the handoff is journaled: every migrated rid is terminal in the
+        # prefill replica's journal (failover there must not re-serve)
+        recs = RequestJournal.load(tiered.replicas[0].journal_path)
+        assert sum(r["k"] == "migr-kv" for r in recs) == \
+            tiered.stats["migrations"] + tiered.stats["migration_reprefill"]
+        assert not RequestJournal.pending(recs)
+    finally:
+        tiered.close()
+
+
+@pytest.mark.slow   # replica kill + failover replay recompiles; behavior
+#                     also CI-gated via the kv_migration_corruption drill
+def test_mid_migration_crash_replay(model, tmp_path):
+    """The decode replica dies AFTER chains were spliced into it: the
+    fleet's journal-backed failover re-admits them from the decode
+    journal's admit + high-water marks, re-runs prefill on the surviving
+    prefill replica, verifies the delivered prefix byte-for-byte — never
+    double-serving, streams byte-identical to an uninterrupted run."""
+    from paddle_tpu.distributed.resilience import FaultPlan, FaultSpec
+
+    cfg, m = model
+    kws = _wave_kwargs(cfg, shared_page=False)
+
+    def build():
+        return _build(m)
+
+    eng = build()
+    reqs0 = [Request(**kw) for kw in kws]
+    for r in reqs0:
+        eng.add_request(r)
+    eng.run_until_done(max_steps=500)
+    refs = [list(r.tokens) for r in reqs0]
+
+    plan = FaultPlan(seed=5, specs=[
+        FaultSpec("fleet.replica_kill", "kill", at=2, count=1,
+                  match="replica:1:")])
+    tiered = TieredRouter(build, build, str(tmp_path), num_prefill=1,
+                          num_decode=1)
+    try:
+        reqs = [Request(**kw) for kw in kws]
+        with plan:
+            for r in reqs:
+                tiered.submit(r)
+            tiered.run_until_done(max_steps=3000)
+    finally:
+        tiered.close()
+    assert plan.log, "replica kill never fired"
+    assert tiered.stats["replica_deaths"] == 1
+    assert tiered.stats["failovers"] == 1
+    assert not [r.rid for r in reqs if r.failed or not r.done]
+    streams = [list(r.tokens) for r in reqs]
+    assert streams == refs, [i for i, (s, f) in enumerate(zip(streams, refs))
+                             if s != f]

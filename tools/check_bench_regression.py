@@ -90,6 +90,15 @@ PRIMARY = "llama_pretrain_tokens_per_sec_per_chip"
 # - serving_ttft_p99_under_burst_ms: the queueing tail the open-loop
 #   arrivals exist to expose (ROADMAP items 3/5) — 250ms floor + 2x,
 #   same posture as the closed-loop TTFT lines.
+# - serving_disagg_ttft_p99_under_burst_ms: the same burst schedule served
+#   by a 1-prefill+1-decode TieredRouter (docs/SERVING.md "Disaggregated
+#   tiers") — the tail the tier split exists to protect: long prompts
+#   prefill on their own replica, decode never stalls behind them. Same
+#   250ms floor + 2x posture as the unified line.
+# - serving_kv_migration_time_s: mean export→splice wall time per migrated
+#   chain (codec serialize + crc + scatter + resume-at-position admission).
+#   0.5s floor (tiny CPU chains are sub-ms and jittery); past 2x the
+#   handoff grew real work — e.g. re-running prefill instead of splicing.
 SECONDARY = {
     "serving_p99_step_latency_ms": ("lower", 1.0, 0.0),
     "guard_overhead_pct": ("lower", 1.0, 5.0),
@@ -108,6 +117,8 @@ SECONDARY = {
     "serving_slo_attainment_pct": ("higher", 0.3, 0.0),
     "serving_goodput_tokens_per_sec": ("higher", 0.5, 0.0),
     "serving_ttft_p99_under_burst_ms": ("lower", 1.0, 250.0),
+    "serving_disagg_ttft_p99_under_burst_ms": ("lower", 1.0, 250.0),
+    "serving_kv_migration_time_s": ("lower", 1.0, 0.5),
 }
 
 

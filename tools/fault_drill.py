@@ -969,6 +969,92 @@ def drill_serving_overload_shed(recover: bool):
                   f"({eng.stats['shed']} shed), survivors byte-identical")
 
 
+def drill_kv_migration_corruption(recover: bool):
+    """One migrated KV chain's page bytes are flipped in transit between
+    the prefill and decode tiers (FaultPlan ``serving.kv_transfer``
+    bitflip — docs/SERVING.md "Disaggregated tiers"). Recovery = the
+    codec's per-page crc32 refuses the splice with a typed
+    ``KVChainCorrupt`` (PT-SRV-007) and the decode replica re-runs prefill
+    from the journaled admit — every stream byte-identical to a
+    single-replica run (greedy and seeded). Without verification
+    (``KVChainCodec(verify_crc=False)``: what a checksum-less transfer
+    does) the corrupt pages are spliced into the decode pool and the
+    migrated request's stream silently diverges."""
+    import tempfile as _tempfile
+
+    import numpy as np
+
+    from paddle_tpu.distributed.resilience import FaultPlan, FaultSpec
+    from paddle_tpu.inference.disagg import KVChainCodec, TieredRouter
+    from paddle_tpu.inference.serving import (ContinuousBatchingEngine,
+                                              Request)
+
+    cfg, m = _serving_model()
+    rng = np.random.default_rng(61)
+    kws = []
+    for i in range(4):
+        p = rng.integers(0, cfg.vocab_size, (8,)).astype(np.int32)
+        kw = dict(prompt_ids=p, max_new_tokens=8, seed=600 + i)
+        if i % 2 == 1:
+            kw.update(temperature=0.9)
+        kws.append(kw)
+
+    def build():
+        return ContinuousBatchingEngine(m, max_batch=2, max_len=32,
+                                        page_size=8, block_size=2,
+                                        prefix_cache=True)
+
+    if "disagg_refs" not in _SERVING:
+        eng = build()
+        reqs0 = [Request(**kw) for kw in kws]
+        for r in reqs0:
+            eng.add_request(r)
+        eng.run_until_done(max_steps=500)
+        _SERVING["disagg_refs"] = [list(r.tokens) for r in reqs0]
+    refs = _SERVING["disagg_refs"]
+
+    plan = FaultPlan(seed=3, specs=[
+        FaultSpec("serving.kv_transfer", "bitflip", at=0, count=1, arg=256)])
+    with _tempfile.TemporaryDirectory() as tmp:
+        tiered = TieredRouter(build, build, tmp, num_prefill=1,
+                              num_decode=1,
+                              codec=KVChainCodec(verify_crc=recover))
+        reqs = [Request(**kw) for kw in kws]
+        try:
+            with plan:
+                for r in reqs:
+                    tiered.submit(r)
+                tiered.run_until_done(max_steps=2000)
+        finally:
+            tiered.close()
+    if not plan.log:
+        return False, "serving.kv_transfer bitflip never fired"
+    streams = [list(r.tokens) for r in reqs]
+    wrong = [i for i, (s, f) in enumerate(zip(streams, refs)) if s != f]
+    if not recover:
+        if not wrong:
+            return True, ("unexpected: 256 flipped bits spliced without "
+                          "changing any stream")
+        return False, ("no chain verification: corrupt pages spliced into "
+                       f"the decode pool — stream(s) {wrong} silently "
+                       "diverged from the single-replica run")
+    if tiered.stats["migration_corrupt"] < 1:
+        return False, "corruption never detected at import"
+    codes = [c for c, _ in tiered.events]
+    if "PT-SRV-007" not in codes:
+        return False, f"no typed PT-SRV-007 rejection (events {codes})"
+    if tiered.stats["migration_reprefill"] < 1:
+        return False, "decode side never re-ran the corrupted prefill"
+    if wrong:
+        return False, (f"stream(s) {wrong} diverged despite the re-run "
+                       "(recovery broken)")
+    return True, ("PT-SRV-007: flipped page refused at import (per-page "
+                  "crc32), prefill re-run on the decode replica, all "
+                  f"{len(reqs)} streams bit-identical "
+                  f"({tiered.stats['migrations']} clean migration(s) "
+                  "alongside)")
+
+
 def _fleet_build():
     _, m = _serving_model()
     from paddle_tpu.inference.serving import ContinuousBatchingEngine
@@ -1201,6 +1287,7 @@ DRILLS = {
     "fleet_replica_kill": drill_fleet_replica_kill,
     "fleet_drain": drill_fleet_drain,
     "fleet_overload": drill_fleet_overload,
+    "kv_migration_corruption": drill_kv_migration_corruption,
     "nan_grad": drill_nan_grad,
     "loss_spike": drill_loss_spike,
     "poison_batch": drill_poison_batch,

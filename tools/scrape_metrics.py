@@ -60,6 +60,13 @@ REQUIRED_FAMILIES = (
     # tracer health (a saturated recorder under-reports TTFT tails)
     "pt_tracer_dropped_total",
     "pt_tracer_gc_total",
+    # disaggregated-tier KV migration (inference/disagg.py — counters and
+    # the wall-time histogram register on every TraceRecorder and render
+    # at zero, so a non-migrating fleet still exposes the families)
+    "pt_migration_total",
+    "pt_migration_pages_total",
+    "pt_migration_failures_total",
+    "pt_migration_time_ms",
 )
 
 #: the span chain a served request must produce, in order
