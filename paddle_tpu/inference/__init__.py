@@ -66,6 +66,16 @@ class Config:
         enable_mkldnn_bfloat16)."""
         self._bf16 = x
 
+    def enable_donate_inputs(self, x: bool = True):
+        """Donate the per-call input buffers to XLA (the weights are NOT
+        donated — they are reused every ``run``). Each ``run`` uploads
+        fresh host arrays anyway, so donation lets the runtime alias them
+        for outputs instead of holding both live. Off by default for API
+        parity; the PT-COST donation audit (docs/STATIC_ANALYSIS.md)
+        flags carry buffers, not per-call inputs, so leaving this off is
+        a memory choice, not a lint finding."""
+        self._donate_inputs = x
+
     def set_cpu_math_library_num_threads(self, n: int):
         pass
 
@@ -129,6 +139,16 @@ class Predictor:
 
         self._state = [np.asarray(unwrap(v)) for v in state.values()]
         self._call = self._exported.call
+        if config._donate_inputs and not config._bf16:
+            # honor the (previously write-only) donation knob: inputs are
+            # fresh uploads every run(), safe to donate; state is carried
+            # across calls and must NOT be (donating it would delete the
+            # weights after the first call). The bf16 path composes its
+            # own jit below (same donate_argnums).
+            exported0 = self._exported
+            self._call = jax.jit(
+                lambda state, ins: exported0.call(state, ins),
+                donate_argnums=(1,))
         if config._bf16:
             # store weights bf16 (half the HBM), upcast at the call boundary —
             # XLA folds the cast into the first consumer, so matmuls read bf16
@@ -140,13 +160,14 @@ class Predictor:
                 for a in self._state]
             exported = self._exported
 
-            @jax.jit
             def call_bf16(state, ins):
                 state = [s.astype(d) if s.dtype != d else s
                          for s, d in zip(state, orig_dtypes)]
                 return exported.call(state, ins)
 
-            self._call = call_bf16
+            self._call = jax.jit(
+                call_bf16,
+                donate_argnums=(1,) if config._donate_inputs else ())
         # input signature from the exported module: (state_list, input_tuple)
         in_avals = self._exported.in_avals
         self._n_state = len(self._state)

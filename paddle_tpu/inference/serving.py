@@ -282,6 +282,32 @@ class Request:
 
 
 class ContinuousBatchingEngine:
+    # Carry/donation declaration for the jitted hot-path programs —
+    # consumed by the jit builders below and pinned by tests
+    # (test_program_cost.py: every declared carry must be donated, and
+    # non-carries never); tools/audit_program_cost.py then audits the
+    # resulting ``donated_invars`` off the TRACED programs (PT-COST-003).
+    # The kv pools / device position vector are step-to-step carries;
+    # donating them lets XLA alias the output buffers in place of keeping
+    # two copies of the KV pool live across every decode block.
+    # ``tables`` / ``act`` / the sampling vectors are NOT carries of these
+    # programs (the mega-step returns neither) and must stay undonated.
+    # Argnums index the builders' positional args.
+    _MEGA_ARG_NAMES = ("params", "toks", "kv", "tables", "pos", "act",
+                       "seeds", "temps", "tops", "topks")
+    _MEGA_CARRIES = ("kv", "pos")
+    _MEGA_DONATE_ARGNUMS = (2, 4)
+    _CHUNK_ARG_NAMES = ("params", "ids", "kv", "rows", "starts")
+    _CHUNK_CARRIES = ("kv",)
+    _CHUNK_DONATE_ARGNUMS = (2,)
+    # first-token program: kv is the carry worth donating (the full KV
+    # pool); ``last_tok`` is also a carry but is max_batch int32s —
+    # deliberately left undonated (not worth the aliasing constraint)
+    _FIRST_ARG_NAMES = ("params", "last", "kv", "rows", "last_tok",
+                        "ints", "floats")
+    _FIRST_CARRIES = ("kv",)
+    _FIRST_DONATE_ARGNUMS = (2,)
+
     def __init__(self, model, max_batch: int = 8, max_len: int = 512,
                  page_size: int = 64, block_size: int = 8,
                  prompt_buckets: Optional[Sequence[int]] = None,
@@ -292,8 +318,14 @@ class ContinuousBatchingEngine:
                  brownout: Union[bool, BrownoutConfig, None] = None,
                  fused: Optional[bool] = None,
                  tracer=None, trace_tags: Optional[Dict] = None,
+                 donate_carry: bool = True,
                  _unsafe_overcommit: bool = False):
         self.model = model
+        # buffer donation on the carry arguments of the jitted hot-path
+        # programs (mega-step kv/pos, prefill-chunk / first-token kv).
+        # Off switch exists for the PT-COST byte-identity A/B and for
+        # debugging with retained pre-step buffers.
+        self._donate_carry = bool(donate_carry)
         # per-request trace spans (observability.TraceRecorder — docs/
         # OBSERVABILITY.md): every stamp site is host-side, behind a single
         # `is not None` check, and records into a bounded buffer — nothing
@@ -773,9 +805,7 @@ class ContinuousBatchingEngine:
             # position advance in-graph, inactive rows masked by the
             # device-side act vector — admission never retraces
             if self._jit_mega is None:
-                self._jit_mega = jax.jit(
-                    self._mega_step_fn(),
-                    static_argnames=("n_steps", "do_sample"))
+                self._jit_mega = self._build_mega_jit()
                 self._note_compiled()
             seeds_d, temps_d, tops_d, topks_d = self._dev_samp
             out, self._last_tok, new_kv, self._dev_pos = self._jit_mega(
@@ -1160,6 +1190,16 @@ class ContinuousBatchingEngine:
             self.caches = {"kv": self.caches["kv"], "tables": tables}
             self.stats["fused_updates"] += len(batch)
 
+    def _build_mega_jit(self):
+        """The jitted mega-step EXACTLY as ``step`` dispatches it —
+        donation included. tools/audit_program_cost.py traces this (pure
+        tracing, no compile) so the audited ``donated_invars`` are the
+        production program's, not a parallel declaration."""
+        donate = self._MEGA_DONATE_ARGNUMS if self._donate_carry else ()
+        return jax.jit(self._mega_step_fn(),
+                       static_argnames=("n_steps", "do_sample"),
+                       donate_argnums=donate)
+
     def _mega_step_fn(self):
         """The fused mega-step program (tools/lint_graph.py records and
         lints this — the one program a 128-256-slot engine dispatches per
@@ -1455,7 +1495,8 @@ class ContinuousBatchingEngine:
                     sub = self.model.paged_prefill_chunk(ids, sub, starts)
                 return sub["kv"]
 
-            fn = self._jit_chunk[g] = jax.jit(run)
+            donate = self._CHUNK_DONATE_ARGNUMS if self._donate_carry else ()
+            fn = self._jit_chunk[g] = jax.jit(run, donate_argnums=donate)
             self._note_compiled()
         return fn
 
@@ -1592,7 +1633,10 @@ class ContinuousBatchingEngine:
                     nxt = jnp.argmax(logits, -1).astype(jnp.int32)
                 return nxt, sub["kv"], last_tok.at[slots_].set(nxt)
 
-            fn = self._jit_first[(g, do_sample)] = jax.jit(run)
+            donate = self._FIRST_DONATE_ARGNUMS if self._donate_carry \
+                else ()
+            fn = self._jit_first[(g, do_sample)] = jax.jit(
+                run, donate_argnums=donate)
             self._note_compiled()
         firsts_dev, new_kv, self._last_tok = fn(
             self._params, jnp.asarray(last), self.caches["kv"],

@@ -32,10 +32,10 @@ from . import nn  # noqa: F401
 
 
 def __getattr__(name):
-    # PEP 562 lazy submodules: the analysis package (6 modules) and the
-    # concurrency analyzer (PT-RACE, pure-ast) load on first use, not at
-    # `import paddle_tpu` time
-    if name in ("analysis", "concurrency"):
+    # PEP 562 lazy submodules: the analysis package (6 modules), the
+    # concurrency analyzer (PT-RACE, pure-ast) and the program-cost
+    # auditor (PT-COST) load on first use, not at `import paddle_tpu` time
+    if name in ("analysis", "concurrency", "cost"):
         import importlib
 
         mod = importlib.import_module("." + name, __name__)
@@ -48,7 +48,7 @@ __all__ = [
     "program_guard", "default_main_program", "default_startup_program",
     "data", "CompiledProgram", "BuildStrategy", "ExecutionStrategy",
     "append_backward", "name_scope", "PassManager", "apply_default_passes",
-    "nn", "analysis", "concurrency",
+    "nn", "analysis", "concurrency", "cost",
 ]
 
 

@@ -89,6 +89,10 @@ def trace_to_program(fn, *input_structs, input_names: Optional[Sequence[str]] = 
             # random_* eqns replay a PRNG key BAKED into the jaxpr — they are
             # deterministic, so the trace linter must not flag them unseeded
             kernel._jaxpr_import = True
+            # back-links for the cost auditor's op-level fallback walk
+            # (static/cost — Operation has __slots__, so they ride the fn)
+            kernel._primitive = prim
+            kernel._prim_params = params
             return kernel
 
         op = Operation(len(blk.ops), prim.name, make_kernel(prim, params),
@@ -109,6 +113,10 @@ def trace_to_program(fn, *input_structs, input_names: Optional[Sequence[str]] = 
         if isinstance(o, Variable):
             outs.append(o)
     prog._outputs = outs  # liveness roots for Program.diagnose()
+    # the full ClosedJaxpr rides along for analyzers that must recurse into
+    # container primitives (scan bodies, pjit calls) and read dataflow the
+    # flattened op list cannot express — the PT-COST walker (static/cost)
+    prog._closed_jaxpr = closed
     return prog
 
 

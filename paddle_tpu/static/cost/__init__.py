@@ -1,0 +1,66 @@
+"""paddle_tpu.static.cost — static program-cost auditor (PT-COST).
+
+PR 9 (PT-RACE) made thread-safety a lint-time property of the host stack;
+this package does the same for DEVICE-PROGRAM COST. Every registered
+hot-path program (the fused serving mega-step, the packed prefill chunk,
+the hapi train step, the KV-migration scatters — tools/
+audit_program_cost.py) is imported by pure tracing
+(``static.analysis.trace_to_program`` — no XLA compile, machine
+independent) and folded into a :class:`CostManifest`: FLOPs per op family,
+HBM byte traffic + arithmetic intensity, a full dtype census, host-sync /
+scatter / gather / upcast counts, the buffer-donation audit read off the
+traced ``pjit``'s ``donated_invars``, and the slot-scaling law across a
+width pair. The manifest is baselined in tools/program_cost_baseline.json
+and enforced in CI, so a bf16 path silently widening to f32, a host sync
+creeping into the jitted step, a lost ``donate_argnums``, scatter-count
+drift, or an O(slots^2) term in the step machinery fails LINT — before any
+hardware run, in the spirit of roofline-style static cost models.
+
+Codes (docs/STATIC_ANALYSIS.md): PT-COST-001 f32 promotion of a bf16 path,
+PT-COST-002 host sync inside a jitted program (jaxpr-level sibling of
+PT-TRACE-004), PT-COST-003 undonated carry buffer, PT-COST-004
+scatter/gather contract drift, PT-COST-005 superlinear slot scaling.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..analysis.diagnostics import AnalysisPass, Diagnostic
+from .checks import (check_contract, check_donation, check_dtype_promotion,
+                     check_host_sync, check_slot_scaling)
+from .manifest import (CostManifest, HotPathSpec, compute_manifest,
+                       scaling_verdict)
+
+__all__ = [
+    "CostManifest", "HotPathSpec", "compute_manifest", "scaling_verdict",
+    "ProgramCostPass", "check_dtype_promotion", "check_host_sync",
+    "check_donation", "check_contract", "check_slot_scaling",
+]
+
+
+class ProgramCostPass(AnalysisPass):
+    """AnalysisPass form of the auditor — composes with ``run_analysis`` /
+    the ordinary PassManager beside the PR 1 analyzers. Computes the cost
+    manifest (attached as ``program._cost_manifest``) and reports the
+    program-local code classes: PT-COST-001 (promotion pattern),
+    PT-COST-002 (host sync), and — when a :class:`HotPathSpec` declares
+    carries — PT-COST-003 (donation). The cross-program classes
+    (PT-COST-004 contract drift, PT-COST-005 slot scaling) need the
+    baseline / a width pair and live in tools/audit_program_cost.py."""
+
+    name = "cost"
+
+    def __init__(self, spec: Optional[HotPathSpec] = None, suppress=()):
+        super().__init__(suppress=suppress)
+        self.spec = spec
+        self.manifest: Optional[CostManifest] = None
+
+    def analyze(self, program) -> List[Diagnostic]:
+        name = self.spec.name if self.spec is not None else "program"
+        self.manifest = compute_manifest(program, name=name, spec=self.spec)
+        findings = list(check_dtype_promotion(program, name))
+        findings += check_host_sync(program, name)
+        if self.spec is not None and self.spec.carries:
+            findings += check_donation(self.manifest)
+        return findings

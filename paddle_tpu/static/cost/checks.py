@@ -1,0 +1,207 @@
+"""PT-COST checks — diagnostics over a traced hot path's cost manifest.
+
+Five code classes (docs/STATIC_ANALYSIS.md, PT-COST section), enforced by
+tools/audit_program_cost.py against tools/program_cost_baseline.json:
+
+- PT-COST-001  unintended f32 promotion of a bf16 path: a half-precision
+               value widened by implicit promotion against a full-precision
+               SCALAR constant (the ``x * np.float32(2.0)`` weak-type
+               accident — jnp materializes it as an upcast convert feeding
+               an op with an f32 scalar literal), plus contract drift on the
+               program's total upcast-convert census.
+- PT-COST-002  host-sync / host-transfer primitive inside a jitted program
+               (callbacks, infeed/outfeed, device_put) — the jaxpr-level
+               sibling of the PT-TRACE-004 source scan.
+- PT-COST-003  a step-to-step carry buffer the jitted program does NOT
+               donate (read from the traced pjit's ``donated_invars``) —
+               every undonated carry doubles its HBM footprint and forces
+               a copy per step.
+- PT-COST-004  scatter/gather equation count exceeding the recorded
+               contract — the scatter machinery is the part of the serving
+               program that grows by accident.
+- PT-COST-005  slot-scaling law violation: program text or FLOPs growing
+               superlinearly in slot width across the traced width pair.
+
+Every diagnostic carries a line-number-free ``finding_id``
+(``CODE:program:detail``) so baseline waivers survive refactors — the
+PT-RACE baseline discipline (tools/lint_concurrency.py).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from ..analysis.diagnostics import Diagnostic, Severity
+from .flops import HOST_SYNC_PRIMS, closed_jaxpr_of, iter_eqn_costs
+from .manifest import _NARROW, _WIDE, CostManifest, scaling_verdict
+
+__all__ = ["check_dtype_promotion", "check_host_sync", "check_donation",
+           "check_contract", "check_slot_scaling"]
+
+_ANALYZER = "ProgramCostAuditor"
+
+
+def _diag(code, severity, message, program, detail, prim=None):
+    d = Diagnostic(code=code, severity=Severity(severity), message=message,
+                   op_type=prim, analyzer=_ANALYZER)
+    d.finding_id = f"{code}:{program}:{detail}"
+    return d
+
+
+def _is_scalar_wide_literal(var) -> bool:
+    """A Literal (or 0-d constant) of full-precision float dtype — the
+    poisoning operand of an accidental promotion."""
+    val = getattr(var, "val", None)
+    if val is None:
+        return False
+    aval = getattr(var, "aval", None)
+    shape = tuple(getattr(aval, "shape", ()) or ())
+    dtype = str(getattr(aval, "dtype", ""))
+    return shape == () and dtype in _WIDE
+
+
+def check_dtype_promotion(program_or_jaxpr,
+                          name: str = "program") -> List[Diagnostic]:
+    """PT-COST-001 (pattern form): find ops consuming BOTH an upcast of a
+    half-precision value AND a full-precision scalar constant — the
+    signature jnp leaves behind when a stray ``np.float32`` literal
+    promotes a bf16 path (a weak-typed python scalar would have stayed
+    bf16). Explicit ``.astype(f32)`` accumulations without a poisoning
+    scalar (matmul/softmax internals) do not match; they are counted (not
+    flagged) by the manifest's ``upcast_converts`` census and gated by
+    contract drift instead.
+
+    Known false positive (docs/STATIC_ANALYSIS.md limits): a DELIBERATE
+    upcast scaled by a python scalar (``q.astype(f32) * 0.125``) traces to
+    the identical jaxpr — promotion resolves the weak scalar to a strong
+    f32 literal, so post-trace the two are indistinguishable. Waive such
+    findings in the baseline with a justification."""
+    from .flops import _inner_jaxprs
+
+    findings: List[Diagnostic] = []
+    closed = closed_jaxpr_of(program_or_jaxpr)
+    if closed is None:
+        return findings
+
+    def scan_scope(jaxpr, scope):
+        upcast_outs = set()
+        for eqn in jaxpr.eqns:
+            prim = eqn.primitive.name
+            if prim == "convert_element_type":
+                src = eqn.invars[0]
+                s_dt = str(getattr(getattr(src, "aval", None), "dtype", ""))
+                o_dt = str(getattr(getattr(eqn.outvars[0], "aval", None),
+                                   "dtype", ""))
+                if s_dt in _NARROW and o_dt in _WIDE:
+                    upcast_outs.add(id(eqn.outvars[0]))
+                continue
+            has_upcast = any(id(v) in upcast_outs for v in eqn.invars)
+            has_scalar = any(_is_scalar_wide_literal(v) for v in eqn.invars)
+            if has_upcast and has_scalar:
+                findings.append(_diag(
+                    "PT-COST-001", Severity.ERROR,
+                    f"'{prim}'{scope or ''}: a half-precision value is "
+                    "promoted to f32 against a full-precision scalar "
+                    "constant — use a weak-typed python scalar (or cast "
+                    "the constant to the narrow dtype) to keep the bf16 "
+                    "path narrow", name, f"{prim}{scope}", prim=prim))
+            for sub, _, sfx in _inner_jaxprs(eqn):
+                scan_scope(getattr(sub, "jaxpr", sub),
+                           scope + "/" + prim + sfx)
+    scan_scope(getattr(closed, "jaxpr", closed), "")
+    return findings
+
+
+def check_host_sync(program_or_jaxpr,
+                    name: str = "program") -> List[Diagnostic]:
+    """PT-COST-002: host-sync/transfer primitives inside the traced
+    program. Cross-link: PT-TRACE-004 catches the same class in SOURCE
+    (``.item()``/``.numpy()`` before tracing chokes); this catches what
+    actually made it into the jaxpr (callbacks, infeed/outfeed,
+    device_put)."""
+    findings = []
+    for e in iter_eqn_costs(program_or_jaxpr):
+        if e.prim in HOST_SYNC_PRIMS:
+            findings.append(_diag(
+                "PT-COST-002", Severity.ERROR,
+                f"host-sync primitive '{e.prim}'{e.scope or ''} inside a "
+                "jitted hot path — every dispatch round-trips the host "
+                "(source-level sibling: PT-TRACE-004)",
+                name, f"{e.prim}{e.scope}", prim=e.prim))
+    return findings
+
+
+def check_donation(manifest: CostManifest) -> List[Diagnostic]:
+    """PT-COST-003: carries declared by the program's HotPathSpec that the
+    traced jitted callable does NOT donate (``donated_invars`` audit)."""
+    findings = []
+    for carry in (manifest.donation or {}).get("missing", ()):
+        findings.append(_diag(
+            "PT-COST-003", Severity.ERROR,
+            f"carry buffer '{carry}' is not donated by the jitted step "
+            "program — the old buffer stays live across the step, doubling "
+            "its HBM footprint (add donate_argnums for the carry)",
+            manifest.program, carry))
+    return findings
+
+
+def check_contract(manifest: CostManifest,
+                   baseline: Optional[Dict]) -> List[Diagnostic]:
+    """PT-COST-004 (+ the census drift half of PT-COST-001): static
+    equation counts exceeding the recorded per-program contract. Counts
+    may go DOWN freely (refresh the baseline to ratchet); an increase is a
+    finding until reviewed. A program with no baseline entry is itself a
+    finding — an unreviewed hot path cannot silently pass."""
+    name = manifest.program
+    if not baseline:
+        return [_diag(
+            "PT-COST-004", Severity.ERROR,
+            f"program '{name}' has no entry in the cost baseline — record "
+            "it (tools/audit_program_cost.py --write-baseline) and review "
+            "the manifest", name, "unbaselined")]
+    findings = []
+    for attr, code in (("scatter_ops", "PT-COST-004"),
+                       ("gather_ops", "PT-COST-004"),
+                       ("host_sync_eqns", "PT-COST-002"),
+                       ("upcast_converts", "PT-COST-001")):
+        have = int(getattr(manifest, attr))
+        want = baseline.get(attr)
+        if want is None:
+            continue
+        if have > int(want):
+            findings.append(_diag(
+                code, Severity.ERROR,
+                f"{attr} grew {int(want)} -> {have} vs the recorded "
+                f"contract for '{name}' — review the new "
+                f"{attr.replace('_', ' ')} (or refresh the baseline with "
+                "a justification)", name, f"{attr}-drift"))
+    # gross program-text blowup guard for single-width programs (the
+    # slot-scaling law only covers width pairs): a duplicated layer call
+    # or an unrolled python loop roughly multiplies the eqn census.
+    # Ordinary edits drift well within 1.5x and pass without a refresh.
+    base_eqns = baseline.get("num_eqns")
+    if base_eqns and manifest.num_eqns > 1.5 * int(base_eqns):
+        findings.append(_diag(
+            "PT-COST-004", Severity.ERROR,
+            f"num_eqns grew {int(base_eqns)} -> {manifest.num_eqns} "
+            f"(>1.5x) vs the recorded baseline for '{name}' — program "
+            "text blew up (duplicated subgraph / unrolled loop?); review "
+            "and refresh the baseline", name, "num_eqns-blowup"))
+    return findings
+
+
+def check_slot_scaling(manifests: Sequence[CostManifest],
+                       tol: float = 0.25) -> List[Diagnostic]:
+    """PT-COST-005: apply :func:`scaling_verdict` over the slot-width pair
+    and flag a superlinear verdict."""
+    rec = scaling_verdict(manifests, tol=tol)
+    if rec["verdict"] == "superlinear":
+        name = manifests[0].program.split("@")[0]
+        return [_diag(
+            "PT-COST-005", Severity.ERROR,
+            f"program '{name}' scales SUPERLINEARLY in slots "
+            f"(worst per-slot growth ratio {rec['worst_linear_ratio']}x "
+            f"over widths {rec['slots']}; eqns {rec['num_eqns']}, flops "
+            f"{[round(f) for f in rec['flops_total']]}) — an O(slots^2) "
+            "term in the step machinery", name, "superlinear")]
+    return []

@@ -71,6 +71,12 @@ def test_manifest_counts_cover_reference_parity():
         # infer_shared_state, run_checks, finding_id, ModuleModel,
         # SharedKey
         "paddle.static.concurrency": 9,
+        # program-cost PR (docs/STATIC_ANALYSIS.md "Program cost" PT-COST
+        # section): CostManifest, HotPathSpec, compute_manifest,
+        # scaling_verdict, ProgramCostPass, check_dtype_promotion,
+        # check_host_sync, check_donation, check_contract,
+        # check_slot_scaling
+        "paddle.static.cost": 10,
     }
     for k, n in exact.items():
         assert len(m[k]) == n, (k, len(m[k]), n)
@@ -196,6 +202,53 @@ def test_concurrency_lint_gate_detects_seeded_defects():
                         timeout=200)
     assert r2.returncode != 0
     assert "PT-RACE-003" in r2.stdout
+
+
+def test_program_cost_gate_selftest():
+    """PT-COST gate (docs/STATIC_ANALYSIS.md "Program cost", beside
+    lint_graph/lint_concurrency): every seeded defect class — f32 upcast of
+    a bf16 path, host sync inside a jitted program, lost carry donation,
+    scatter-count drift, superlinear slot scaling — must flip the audit
+    exit code with its expected PT-COST code, and the waiver discipline
+    (justified suppressions only) is pinned end-to-end. Synthetic tiny
+    fixtures, pure tracing — no model builds, no compiles."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    gate = os.path.join(ROOT, "tools", "audit_program_cost.py")
+    r = subprocess.run([sys.executable, gate, "--selftest"],
+                       capture_output=True, text=True, env=env, cwd=ROOT,
+                       timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert ("COST SELFTEST OK: 5 defect classes detected, clean fixture "
+            "audits clean, waiver discipline pinned") in r.stdout, r.stdout
+    r2 = subprocess.run([sys.executable, gate, "--inject", "lost_donation"],
+                        capture_output=True, text=True, env=env, cwd=ROOT,
+                        timeout=300)
+    assert r2.returncode != 0
+    assert "PT-COST-003" in r2.stdout
+
+
+def test_program_cost_gate_real_sweep_clean():
+    """The real hot-path sweep (ISSUE 13 acceptance): mega-step at BOTH
+    slot widths + packed prefill chunk + hapi train step + KV-migration
+    scatters must audit clean (exit 0) against the reviewed
+    tools/program_cost_baseline.json, with the mega-step manifests
+    recording the <=linear slot-scaling verdict, no stale waivers, and
+    the donated carries confirmed off the traced programs. Pure tracing
+    (~4 s of make_jaxpr, no XLA compile), so this runs unmarked."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools",
+                                      "audit_program_cost.py")],
+        capture_output=True, text=True, env=env, cwd=ROOT, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "PROGRAM COST AUDIT OK" in r.stdout, r.stdout
+    assert "stale waiver" not in r.stdout, r.stdout
+    mega_lines = [line for line in r.stdout.splitlines()
+                  if line.startswith("[manifest] mega_step@")]
+    assert len(mega_lines) == 2, r.stdout   # both slot widths audited
+    for line in mega_lines:
+        assert "scaling <=linear" in line, line
+        assert "missing []" in line, line
 
 
 @pytest.mark.slow   # ~3min of engine/train-loop compiles across 17 classes

@@ -286,3 +286,31 @@ def test_fused_128_slots_byte_identical_to_legacy(model, leg):
     assert cold == want and warm == want
     assert eng.stats["cow_copies"] > 0 and eng.stats["packed_rows"] > 0
     assert eng.active_slots() == 0 and len(eng._free_slots) == 128
+
+
+@pytest.mark.slow   # one extra prefix-engine compile wave beside the module
+#                     fixtures (tier-1 ceiling) — the fast pins are
+#                     test_program_cost.py::test_engine_declares_mega_and_
+#                     chunk_donation (declaration covers the carries) plus
+#                     EVERY fused-vs-legacy identity test above, which runs
+#                     the donated path (donate_carry defaults True) against
+#                     the undonated legacy engine
+def test_donation_off_byte_identity(model, fusp):
+    """PT-COST triage proof (docs/STATIC_ANALYSIS.md "Program cost"):
+    donating the mega-step / prefill-chunk / first-token kv carries is a
+    memory optimization only — a donate_carry=False engine serving the
+    same mixed wave (prefix cache, packed prefill, COW, warm + cold)
+    produces byte-identical streams to the donated module fixture."""
+    cfg, m = model
+    prompts, kws = _wave(cfg)
+    want_cold = _serve(fusp, prompts, kws)
+    want_warm = _serve(fusp, prompts, kws)
+    eng = ContinuousBatchingEngine(
+        m, max_batch=8, max_len=64, page_size=8, block_size=4, fused=True,
+        prefix_cache=PrefixCacheConfig(prefill_chunk=16, extra_blocks=8),
+        donate_carry=False)
+    assert eng._donate_carry is False
+    assert fusp._donate_carry is True
+    cold = _serve(eng, prompts, kws)
+    warm = _serve(eng, prompts, kws)
+    assert cold == want_cold and warm == want_warm

@@ -25,6 +25,7 @@ def live_surfaces():
     import paddle_tpu as paddle
     from paddle_tpu.inference import serving as _serving
     from paddle_tpu.static import concurrency as _concurrency
+    from paddle_tpu.static import cost as _cost
 
     def names(mod):
         all_ = getattr(mod, "__all__", None)
@@ -36,6 +37,7 @@ def live_surfaces():
         "paddle.inference.serving": names(_serving),
         "paddle.observability": names(paddle.observability),
         "paddle.static.concurrency": names(_concurrency),
+        "paddle.static.cost": names(_cost),
         "paddle": names(paddle),
         "paddle.tensor_methods": sorted(
             n for n in dir(paddle.Tensor) if not n.startswith("_")),

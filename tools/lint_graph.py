@@ -159,6 +159,28 @@ def record_serving():
     return prog, m
 
 
+def record_migration():
+    """The PR 12 KV-block migration programs (inference/disagg.py
+    KVChainCodec via ops/paged_attention.py): the per-layer page gather
+    that exports a chain plus ``scatter_chain_pages`` that imports it,
+    traced as one roundtrip so the disagg path has the same graph-lint
+    coverage as the mega-step. The linted program IS the cost auditor's
+    ``migration`` program (ONE recorder, tools/audit_program_cost.py —
+    lint coverage and cost coverage cannot silently diverge).
+    ``gather_chain_pages`` itself is DELIBERATELY host-side (its
+    np.asarray readback is the fence that orders the export behind
+    in-flight decode writes — docs/SERVING.md), so what is traced is its
+    device gather expression."""
+    import types
+
+    import audit_program_cost
+
+    prog, _ = audit_program_cost.record_migration()
+    # no Layer behind this family: the lint context needs a parameters()
+    model = types.SimpleNamespace(parameters=lambda: [])
+    return prog, model
+
+
 FAMILIES = {
     "bert": record_bert,
     "gpt": record_gpt,
@@ -166,6 +188,7 @@ FAMILIES = {
     "vit": record_vit,
     "unet": record_unet,
     "serving": record_serving,
+    "migration": record_migration,
 }
 
 
