@@ -579,6 +579,16 @@ def bench_fleet(dev, on_tpu):
       re-admit + catch-up-to-high-water-mark time (dominated by program
       recompiles on the surviving replicas' fresh admissions — the cost an
       operator eats per replica loss). SECONDARY ("lower", 2s floor).
+    - ``fleet_proc_tokens_per_sec``: the PROCESS-per-replica arm
+      (inference/procfleet): 2 spawned worker processes, each with its own
+      jax runtime/model/journal, stepped with ``parallel_step`` so replica
+      programs overlap; vs_baseline = 2-process fleet / ONE worker process
+      on the identical wave — the first scale-OUT ratio in the series (the
+      in-process fleet shares one device, so its ratio reads as router
+      overhead). Workers are pinned to host (CPU) devices: on a TPU host
+      two processes cannot share the chip, and on CPU the ratio is capped
+      by host-core weather — ≥1.5x expected on an idle ≥4-core box, lower
+      under CI contention. SECONDARY ("higher").
     """
     import os
     import tempfile
@@ -665,6 +675,68 @@ def bench_fleet(dev, on_tpu):
                   f"mid-wave replica kill; "
                   f"{fleet.stats['failover_requests']} request(s) failed "
                   f"over to 2 survivors)", None)
+
+    # -- process-per-replica arm (inference/procfleet): real scale-out ----
+    try:
+        from paddle_tpu.inference.fleet import FleetConfig as _FC
+        from paddle_tpu.inference.procfleet import (ProcFleetConfig,
+                                                    ProcFleetRouter)
+
+        # workers rebuild the CPU-sized engine in their own process with
+        # their own host device — the separate-device claim this series
+        # could never make in one process (TPU hosts pin workers to cpu:
+        # two processes cannot share the chip)
+        tiny_kw = dict(seed=0, num_hidden_layers=2, max_batch=2,
+                       max_len=32, page_size=8, block_size=4,
+                       prompt_buckets=[16])
+        proc_cfg = ProcFleetConfig(
+            factory="paddle_tpu.inference.procfleet.presets:"
+                    "tiny_llama_engine",
+            factory_kwargs=tiny_kw, env={"JAX_PLATFORMS": "cpu"})
+        rng_p = np.random.default_rng(0)
+        pprompts = [rng_p.integers(0, 256, (16,)).astype(np.int32)
+                    for _ in range(12)]
+
+        def proc_wave(target, n_new=16):
+            reqs = [Request(p, max_new_tokens=n_new, seed=500 + i)
+                    for i, p in enumerate(pprompts)]
+            for r in reqs:
+                target.submit(r)
+            target.run_until_done(max_steps=20000)
+            return reqs
+
+        with tempfile.TemporaryDirectory() as ptmp:
+            arms = {}
+            for n_proc in (1, 2):
+                pf = ProcFleetRouter(
+                    proc_cfg, os.path.join(ptmp, f"proc{n_proc}"),
+                    num_replicas=n_proc,
+                    config=_FC(brownout_depth=10 ** 9,
+                               parallel_step=n_proc > 1))
+                try:
+                    proc_wave(pf)           # compile every worker
+                    dt = float("inf")
+                    for _ in range(3):
+                        t0 = _t.perf_counter()
+                        proc_wave(pf)
+                        dt = min(dt, _t.perf_counter() - t0)
+                    arms[n_proc] = 12 * 16 / dt
+                finally:
+                    # a leaked worker (full jax runtime) would time-slice
+                    # against every later bench on small hosts
+                    pf.close()
+        ncores = os.cpu_count() or 1
+        _emit("fleet_proc_tokens_per_sec", arms[2],
+              f"useful tok/s (2 worker PROCESSES, own jax runtime/model/"
+              f"journal each, parallel_step; 1 worker process on the same "
+              f"wave: {arms[1]:.0f} tok/s — the ratio is REAL scale-out "
+              f"and needs >=2 free host cores to exceed 1: this host has "
+              f"{ncores} core(s), so "
+              f"{'the >=1.5x claim is measurable' if ncores >= 2 else 'two processes time-slice one core and the ratio reads wire overhead, not scale-out'})",
+              arms[2] / arms[1])
+    except Exception as e:  # secondary lines must never kill the primary
+        print(f"# fleet proc bench skipped: {type(e).__name__}: {e}",
+              flush=True)
 
 
 def bench_observability(dev, on_tpu):

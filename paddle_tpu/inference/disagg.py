@@ -422,6 +422,10 @@ class TieredRouter(FleetRouter):
                                         (r.idx - req.rid) % n))
 
     # -- the migration pump ------------------------------------------------
+    # LOCKSTEP NOTE: procfleet/router.py's ProcTieredRouter mirrors this
+    # pump over the wire (export_migration/import_migration replace the
+    # direct engine access) — a behavioral fix to either pump must land
+    # in BOTH.
     def step(self) -> None:
         super().step()
         self._migrate_ready()

@@ -167,17 +167,36 @@ class FleetRouter:
         self.tracer = tracer
         self._sup_kw = dict(step_budget_s=step_budget_s,
                             max_recoveries=max_recoveries, fsync=fsync)
+        # stats exist BEFORE the first _make_sup: subclasses that spawn
+        # real worker processes (inference/procfleet) count spawns there
+        self.stats = {"submitted": 0, "fleet_shed": 0, "replica_deaths": 0,
+                      "failovers": 0, "failover_s": 0.0,
+                      "failover_requests": 0, "drains": 0, "migrated": 0,
+                      "restarts": 0, "brownouts": 0, "affinity_hits": 0,
+                      "replicas_added": 0, "replicas_retired": 0}
+        self.events: List[tuple] = []                # (code, message)
         self.replicas: List[_Replica] = []
-        for i in range(num_replicas):
-            # restart over an existing fleet_dir: resume each replica's
-            # LATEST generation — rolling restarts leave g1/g2/... journals
-            # and replaying a superseded g0 would lose the newer work
-            gen = self._latest_gen(i)
-            path = os.path.join(fleet_dir, f"replica{i}.g{gen}.jrnl")
-            self.replicas.append(_Replica(
-                i, ServingSupervisor(self._builder(i), path,
-                                     **self._rep_kw(i)), path, gen=gen,
-                tier=self.tier_of(i)))
+        try:
+            for i in range(num_replicas):
+                # restart over an existing fleet_dir: resume each
+                # replica's LATEST generation — rolling restarts leave
+                # g1/g2/... journals and replaying a superseded g0 would
+                # lose the newer work
+                gen = self._latest_gen(i)
+                path = os.path.join(fleet_dir, f"replica{i}.g{gen}.jrnl")
+                self.replicas.append(_Replica(
+                    i, self._make_sup(i, path), path, gen=gen,
+                    tier=self.tier_of(i)))
+        except Exception:
+            # a replica that failed to build must not strand the ones
+            # already built (a process-replica fleet would otherwise leak
+            # live worker processes until interpreter exit)
+            for rep in self.replicas:
+                try:
+                    rep.sup.abandon()
+                except Exception:  # noqa: BLE001 — best-effort teardown
+                    pass
+            raise
         self.requests: Dict[int, Request] = {}
         self._assigned: Dict[int, int] = {}          # rid -> replica idx
         self._returned: Set[int] = set()
@@ -186,12 +205,6 @@ class FleetRouter:
         self._brownout_active = False
         self._pressure_events = 0
         self._clear_events = 0
-        self.events: List[tuple] = []                # (code, message)
-        self.stats = {"submitted": 0, "fleet_shed": 0, "replica_deaths": 0,
-                      "failovers": 0, "failover_s": 0.0,
-                      "failover_requests": 0, "drains": 0, "migrated": 0,
-                      "restarts": 0, "brownouts": 0, "affinity_hits": 0,
-                      "replicas_added": 0, "replicas_retired": 0}
         self._brownout_forced = False
         self._fault_hook = None
         self._fault_cls = None
@@ -212,6 +225,18 @@ class FleetRouter:
             self.tracer.instant("request_lost", rid,
                                 tags={"replica": replica},
                                 error=(user.error or "")[:200])
+
+    def _make_sup(self, idx: int, path: str):
+        """Build the replica-``idx`` supervisor over journal ``path`` — the
+        ONE construction point (initial fleet, ``_respawn``,
+        ``add_replica``). The process-per-replica fleet
+        (inference/procfleet) overrides this to spawn a worker process and
+        return a :class:`~paddle_tpu.inference.procfleet.ProcReplica`
+        proxy; everything else in the router consumes the same replica
+        surface (submit/step/finished/load/progress/withdraw/behind/
+        close/abandon + ``.engine`` geometry)."""
+        return ServingSupervisor(self._builder(idx), path,
+                                 **self._rep_kw(idx))
 
     def _builder(self, idx: int) -> Callable[[], ContinuousBatchingEngine]:
         """Engine factory for replica ``idx`` — one homogeneous fleet by
@@ -397,7 +422,16 @@ class FleetRouter:
         for rep in live:
             if rep.state == ReplicaState.DEAD or rep in died:
                 continue
-            sig = rep.sup.progress()
+            try:
+                sig = rep.sup.progress()
+            except Exception as e:  # noqa: BLE001 — replica death boundary
+                # a process replica can die BETWEEN its step and this
+                # probe (inference/procfleet): the probe failing is the
+                # death signal, same boundary as _step_all
+                self._mark_dead(rep, f"progress probe failed: "
+                                f"{type(e).__name__}: {e}")
+                died.append(rep)
+                continue
             if sig != rep.progress:
                 rep.progress = sig
                 rep.last_progress_t = now
@@ -538,10 +572,7 @@ class FleetRouter:
         for target in {t for t, _ in resumed}:
             rids = [rid for t, rid in resumed if t is target]
             guard = 0
-            while any(t._n_out < len(self.requests[rid].output)
-                      and not t.done
-                      for rid in rids
-                      for t in [target.sup._live.get(rid)] if t is not None):
+            while any(target.sup.behind(rid) for rid in rids):
                 target.sup.step()
                 guard += 1
                 if guard > 100000:
@@ -646,8 +677,7 @@ class FleetRouter:
         rep.gen += 1
         rep.journal_path = os.path.join(
             self.fleet_dir, f"replica{rep.idx}.g{rep.gen}.jrnl")
-        rep.sup = ServingSupervisor(self._builder(rep.idx), rep.journal_path,
-                                    **self._rep_kw(rep.idx))
+        rep.sup = self._make_sup(rep.idx, rep.journal_path)
         rep.state = ReplicaState.ALIVE
         rep.retiring = False
         rep.progress = None
@@ -677,9 +707,8 @@ class FleetRouter:
         gen = self._latest_gen(idx)
         path = os.path.join(self.fleet_dir, f"replica{idx}.g{gen}.jrnl")
         self.replicas.append(_Replica(
-            idx, ServingSupervisor(self._builder(idx), path,
-                                   **self._rep_kw(idx)),
-            path, gen=gen, tier=self.tier_of(idx)))
+            idx, self._make_sup(idx, path), path, gen=gen,
+            tier=self.tier_of(idx)))
         self.stats["replicas_added"] += 1
         self.events.append(
             ("PT-FLT-005", f"replica {idx} added (scale-out: fleet now "
@@ -788,6 +817,10 @@ class FleetRouter:
 
     # -- completion --------------------------------------------------------
     def has_work(self) -> bool:
+        # no exception guard here: every replica surface answers
+        # has_work() from local state (the process proxy serves it from
+        # reply-piggybacked caches, never the wire) — a raise is a real
+        # bug that must surface, not feed a silent busy-loop
         if any(rep.sup.has_work() for rep in self.replicas
                if rep.state not in _GONE):
             return True

@@ -1,0 +1,40 @@
+"""paddle_tpu.inference.procfleet — process-per-replica serving transport.
+
+The fleet/tiered routers and the SLO autoscaler (docs/SERVING.md,
+ROADMAP item 1) gain REAL replica isolation: each replica is a spawned
+worker process owning its own engine, device memory and journal, driven
+over a crc-framed localhost wire protocol. Replica death is process death
+(a SIGKILL'd worker's unfinished work re-admits on survivors
+byte-identically from its on-disk journal — the ``fleet_proc_kill``
+drill), and scale-out is measurable (``bench_fleet --processes`` →
+``fleet_proc_tokens_per_sec``).
+
+Modules:
+
+- :mod:`~paddle_tpu.inference.procfleet.wire` — the PT-PROC framed
+  message protocol (:class:`WireCorrupt` = PT-PROC-001).
+- :mod:`~paddle_tpu.inference.procfleet.worker` — the spawned replica
+  process (:class:`WorkerSpec`, ``worker_main``).
+- :mod:`~paddle_tpu.inference.procfleet.proxy` — the driver-side replica
+  proxy (:class:`ProcReplica`, :class:`WorkerDead` = PT-PROC-002/003).
+- :mod:`~paddle_tpu.inference.procfleet.router` —
+  :class:`ProcFleetRouter` / :class:`ProcTieredRouter` over
+  :class:`ProcFleetConfig`.
+- :mod:`~paddle_tpu.inference.procfleet.presets` — picklable worker
+  engine factories for drills/tests/benches.
+
+The wire/worker/proxy layer is pure host control plane (stdlib only);
+the router layer rides the fleet substrate. Workers pull in the heavy
+stack in their OWN process — a driver spawning N replicas pays one jax
+runtime, not N.
+"""
+
+from .proxy import ProcReplica, WorkerDead  # noqa: F401
+from .router import (ProcFleetConfig, ProcFleetRouter,  # noqa: F401
+                     ProcTieredRouter)
+from .wire import Message, WireClosed, WireCorrupt  # noqa: F401
+from .worker import WorkerSpec, worker_main  # noqa: F401
+
+__all__ = ["Message", "ProcFleetConfig", "ProcFleetRouter", "ProcReplica",
+           "ProcTieredRouter", "WireClosed", "WireCorrupt", "WorkerDead",
+           "WorkerSpec", "worker_main"]

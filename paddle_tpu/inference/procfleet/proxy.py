@@ -1,0 +1,671 @@
+"""Driver-side proxy for one replica worker process.
+
+:class:`ProcReplica` conforms to the replica surface
+:class:`~paddle_tpu.inference.fleet.FleetRouter` consumes — submit / step /
+finished / load / progress / behind / withdraw / close / abandon plus the
+``.engine`` geometry namespace — so the router, the tiered router and the
+SLO autoscaler drive a process-backed fleet through the code paths they
+already have (docs/SERVING.md "Process fleet").
+
+Failure semantics (the reason this module exists):
+
+- **Death is process death.** A worker that SIGKILLs, segfaults or raises
+  past its recovery budget surfaces here as :class:`WorkerDead`
+  (**PT-PROC-002**) out of ``step()`` — the router's existing
+  per-replica exception boundary marks the replica dead and runs its
+  JOURNAL-BACKED failover against the worker's on-disk journal (shared
+  directory, unchanged ``RequestJournal`` format). The proxy holds the
+  caller-facing ``Request`` objects, so re-admitted streams continue
+  byte-identically on survivors exactly like the in-process fleet.
+- **Timeouts are typed.** Every wire op runs under a per-op timeout; a
+  worker that stops answering is indistinguishable from a dead one and
+  raises :class:`WorkerDead` naming the op (PT-PROC-003 in the message).
+  Idempotent probes (PROGRESS / METRICS) additionally ride
+  ``retry_call`` (distributed/resilience/retry.py) so one dropped
+  datagram-worth of scheduling noise does not kill a healthy replica;
+  mutating ops (SUBMIT/STEP/WITHDRAW) are deliberately single-shot —
+  blind retry could double-apply.
+- **Heartbeats.** An optional daemon thread probes PROGRESS every
+  ``heartbeat_s`` so death is noticed between driver steps and
+  ``pt_procfleet_heartbeats_total`` moves; the router's progress-staleness
+  TTL rides the same marker it always has.
+
+Trace stamps are made DRIVER-SIDE from the token deltas (submit → admit →
+first_token → tokens → finish), on the driver's tracer and therefore on
+its clock — virtual-clock replay (observability/workload.py) and the SLO
+monitor see process replicas exactly like in-process ones.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import pickle
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from types import SimpleNamespace
+from typing import Dict, List, Optional, Set, Tuple
+
+from .wire import Message, WireClosed, WireCorrupt, recv_msg, send_msg
+from .worker import WorkerSpec
+
+__all__ = ["ProcReplica", "WorkerDead"]
+
+# every live worker Popen, so an exiting driver never leaks processes —
+# guarded: ProcReplica spawns/reaps from driver threads while atexit runs
+# on the main thread
+_LIVE_LOCK = threading.Lock()
+_LIVE_WORKERS: Set[int] = set()          # pids
+_ATEXIT_ARMED = [False]
+
+
+def _kill_leftovers() -> None:
+    with _LIVE_LOCK:
+        pids = list(_LIVE_WORKERS)
+        _LIVE_WORKERS.clear()
+    for pid in pids:
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+
+def _track_worker(pid: int) -> None:
+    with _LIVE_LOCK:
+        if not _ATEXIT_ARMED[0]:
+            atexit.register(_kill_leftovers)
+            _ATEXIT_ARMED[0] = True
+        _LIVE_WORKERS.add(pid)
+
+
+def _untrack_worker(pid: int) -> None:
+    with _LIVE_LOCK:
+        _LIVE_WORKERS.discard(pid)
+
+
+class WorkerDead(RuntimeError):
+    """PT-PROC-002: the replica worker process is gone (SIGKILL, crash,
+    fatal supervisor error) or stopped answering within the op timeout —
+    the router fails its work over from the on-disk journal."""
+
+
+def _retry_policy():
+    from ...distributed.resilience.retry import RetryPolicy
+
+    return RetryPolicy(max_attempts=2, base_delay=0.05, max_delay=0.2,
+                       retry_on=(socket.timeout,))
+
+
+class ProcReplica:
+    """One spawned worker process + its control socket, driven from the
+    fleet router's replica slot.
+
+    >>> rep = ProcReplica(WorkerSpec(factory="pkg.mod:factory",
+    ...                              journal_path=path), idx=0)
+    >>> rep.submit(req); rep.step(); rep.close()
+    """
+
+    def __init__(self, spec: WorkerSpec, idx: int = 0, tracer=None,
+                 trace_tags: Optional[dict] = None,
+                 op_timeout_s: float = 60.0, spawn_timeout_s: float = 240.0,
+                 heartbeat_s: Optional[float] = None,
+                 stats: Optional[dict] = None):
+        self.idx = int(idx)
+        self.spec = spec
+        self.tracer = tracer
+        self.trace_tags = dict(trace_tags or {})
+        self.op_timeout_s = float(op_timeout_s)
+        self.stats = stats if stats is not None else {}
+        self.requests: Dict[int, "object"] = {}   # rid -> caller Request
+        self._done: Set[int] = set()
+        self._finished: Dict[int, "object"] = {}
+        self._submit_ts: Dict[int, float] = {}
+        self._streaming: Set[int] = set()         # rids past first delta
+        self._io_lock = threading.Lock()          # one req/reply in flight
+        self._state_lock = threading.Lock()       # heartbeat-shared state
+        self._catchup: Set[int] = set()
+        self._ready: List[int] = []
+        self._last_sig: tuple = ()
+        # reply-piggybacked worker state: every change is driver-initiated
+        # (submit/step/withdraw) or rides a step reply, so these are EXACT
+        # between ops — router probes (load/progress/has_work, called per
+        # submit and per tick) cost zero extra roundtrips
+        self._load = 0
+        self._has_work = False
+        self._cap = [0, 0]              # [free slots, optimistic pages]
+        self._open: Set[int] = set()    # rids submitted, not yet terminal
+        self._seq = 0                   # request/reply matching (io_lock)
+        self._hb_count = 0
+        self._hb_stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        self.dead = False
+        self.reaped = False
+        self._fault_hook = None
+        self._fault_cls = None
+
+        listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        listener.bind(("127.0.0.1", 0))
+        listener.listen(1)
+        host, port = listener.getsockname()
+        # the worker is a PLAIN subprocess (`python -m ...worker`): no
+        # inherited interpreter state, no parent-__main__ re-execution —
+        # the spec travels as a pickle file beside the journal, env vars
+        # (JAX_PLATFORMS etc.) are applied before the child's first import
+        self._spec_path = spec.journal_path + ".spec"
+        with open(self._spec_path, "wb") as f:
+            f.write(pickle.dumps(spec))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.pathsep.join(
+            [p for p in sys.path if p]
+            + [p for p in (env.get("PYTHONPATH") or "").split(os.pathsep)
+               if p])
+        env.update({k: str(v) for k, v in (spec.env or {}).items()})
+        self.process = subprocess.Popen(
+            [sys.executable, "-m",
+             "paddle_tpu.inference.procfleet._spawn_main",
+             "--spec", self._spec_path, "--host", host,
+             "--port", str(port)],
+            env=env, stdin=subprocess.DEVNULL)
+        _track_worker(self.process.pid)
+        self.stats["proc_spawned"] = self.stats.get("proc_spawned", 0) + 1
+        try:
+            deadline = time.monotonic() + float(spawn_timeout_s)
+            # short accept slices with a child liveness poll: a worker
+            # that dies before connecting back (spec unpickle/import
+            # failure) fails the spawn NOW, not after spawn_timeout_s
+            while True:
+                if self.process.poll() is not None:
+                    raise WireClosed(
+                        f"worker exited rc={self.process.returncode} "
+                        "before connecting back")
+                listener.settimeout(
+                    min(0.5, max(0.05, deadline - time.monotonic())))
+                try:
+                    self._sock, _ = listener.accept()
+                    break
+                except socket.timeout:
+                    if time.monotonic() >= deadline:
+                        raise
+            hello = recv_msg(
+                self._sock,
+                timeout=max(0.1, deadline - time.monotonic()))
+            self._sock.settimeout(None)
+        except (socket.timeout, WireClosed, WireCorrupt) as e:
+            # no handshake ever happened: nothing to wait for — kill and
+            # reap immediately (the graceful wait is close()'s courtesy
+            # for workers that acknowledged a SHUTDOWN)
+            self.kill()
+            self._reap()
+            listener.close()
+            raise WorkerDead(
+                f"PT-PROC-002: replica {idx} worker never said HELLO "
+                f"within {spawn_timeout_s:.0f}s ({type(e).__name__}: {e})"
+            ) from e
+        finally:
+            listener.close()
+        if hello.mtype != "HELLO":
+            self.kill()
+            self._reap()
+            raise WorkerDead(
+                f"PT-PROC-002: replica {idx} opened with {hello.mtype}, "
+                "not HELLO")
+        self.worker_pid = int(hello.payload["pid"])
+        self.metrics_port = hello.payload["metrics_port"]
+        self._apply(hello.payload["state"])
+        eng = dict(hello.payload["engine"])
+        self.tier = eng.pop("tier", "serving")
+        pending = eng.pop("pending", [])
+        #: the geometry surface FleetRouter reads (page_size for prefix
+        #: chain keys, max_batch/max_queue for the brownout depth default)
+        self.engine = SimpleNamespace(**eng)
+        # worker spawned over a live journal: it replayed; we own the
+        # caller-facing reconstructions (mirrors ServingSupervisor.requests)
+        from ..recovery import _request_from
+
+        for entry in pending:
+            user = _request_from(entry["req"])
+            user.output = [int(t) for t in entry["delivered"]]
+            user._n_out = len(user.output)
+            self.requests[user.rid] = user
+        if heartbeat_s:
+            self._hb_thread = threading.Thread(
+                target=self._heartbeat_loop, args=(float(heartbeat_s),),
+                name=f"pt-procfleet-hb-{idx}", daemon=True)
+            self._hb_thread.start()
+
+    # -- wire plumbing -----------------------------------------------------
+    @property
+    def metrics_url(self) -> Optional[str]:
+        if self.metrics_port is None:
+            return None
+        return f"http://127.0.0.1:{self.metrics_port}/metrics"
+
+    def _raise_error(self, reply: Message, what: str):
+        etype = reply.payload["etype"]
+        msg = reply.payload["msg"]
+        from ..serving import EngineSaturated, RequestShed
+
+        mapped = {"EngineSaturated": EngineSaturated,
+                  "RequestShed": RequestShed, "ValueError": ValueError,
+                  "KeyError": KeyError, "WireCorrupt": WireCorrupt}
+        if etype == "KVChainCorrupt":
+            from ..disagg import KVChainCorrupt
+
+            raise KVChainCorrupt(msg)
+        cls = mapped.get(etype)
+        if cls is not None:
+            raise cls(msg)
+        # anything untyped out of a worker is replica death (a fatal
+        # supervisor error past its recovery budget reports this way)
+        self._note_dead()
+        raise WorkerDead(
+            f"PT-PROC-002: replica {self.idx} {what} failed fatally "
+            f"({etype}: {msg})")
+
+    def _roundtrip(self, msg: Message, what: str,
+                   timeout: Optional[float] = None,
+                   expect: Tuple[str, ...] = (),
+                   fatal_timeout: bool = True) -> Message:
+        timeout = self.op_timeout_s if timeout is None else timeout
+        if self.dead:
+            raise WorkerDead(
+                f"PT-PROC-002: replica {self.idx} is already dead "
+                f"({what} refused)")
+        try:
+            with self._io_lock:
+                # every request carries a sequence id the worker echoes:
+                # when a probe times out and retries, the first attempt's
+                # reply may still be in flight — replies carrying a stale
+                # seq are drained and discarded instead of desyncing the
+                # stream (a reply WITHOUT a seq matches anything: plain
+                # peers in tests, and the pre-send HELLO)
+                self._seq += 1
+                seq = self._seq
+                msg.payload["_seq"] = seq
+                send_msg(self._sock, msg)
+                deadline = time.monotonic() + timeout
+                while True:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise socket.timeout(f"{what} reply deadline")
+                    reply = recv_msg(self._sock, timeout=remaining)
+                    got = reply.payload.pop("_seq", None)
+                    if got is None or got == seq:
+                        break
+        except socket.timeout as e:
+            # a timeout with NO reply bytes consumed leaves the stream
+            # aligned — the seq drain absorbs the late reply, so an
+            # idempotent probe may retry. A timeout MID-frame leaves the
+            # position unusable: fatal regardless of the retry policy.
+            if not fatal_timeout and not getattr(e, "partial_read", False):
+                raise        # idempotent probe: retry_call owns the retry
+            self._note_dead()
+            raise WorkerDead(
+                f"PT-PROC-003: replica {self.idx} {what} timed out after "
+                f"{timeout:.1f}s — worker presumed wedged/dead") from e
+        except WireCorrupt as e:
+            # damaged frame on a live stream: the position is untrusted
+            # from here on — this connection (and so this replica) is done
+            self._note_dead()
+            raise WorkerDead(
+                f"PT-PROC-002: replica {self.idx} wire corrupt during "
+                f"{what}: {e}") from e
+        except (WireClosed, OSError) as e:
+            self._note_dead()
+            raise WorkerDead(
+                f"PT-PROC-002: replica {self.idx} worker gone during "
+                f"{what}: {e}") from e
+        if reply.mtype == "ERROR":
+            self._raise_error(reply, what)
+        if expect and reply.mtype not in expect:
+            self._note_dead()
+            raise WorkerDead(
+                f"PT-PROC-002: replica {self.idx} answered {what} with "
+                f"{reply.mtype}, wanted {expect} — protocol desync")
+        return reply
+
+    def _note_dead(self) -> None:
+        with self._state_lock:
+            self.dead = True
+
+    # -- replica surface (what FleetRouter consumes) -----------------------
+    def submit(self, req, resume: bool = False) -> int:
+        payload = {"req": _admit(req), "resume": bool(resume),
+                   "delivered": [int(t) for t in req.output] if resume
+                   else []}
+        if resume and self.tracer is not None:
+            self.tracer.mark_recovered(req.rid, len(req.output),
+                                       self._tags(req))
+        reply = self._roundtrip(Message("SUBMIT", payload), "submit",
+                                expect=("SUBMITTED",))
+        self._apply({"load": reply.payload["load"], "has_work": True})
+        req._n_out = len(req.output)
+        with self._state_lock:
+            self.requests[req.rid] = req
+            self._done.discard(req.rid)
+            self._open.add(req.rid)
+            if resume and req.output:
+                self._catchup.add(req.rid)
+            self._submit_ts[req.rid] = time.monotonic()
+        if self.tracer is not None:
+            self.tracer.submit(req.rid, len(req.prompt),
+                               req.max_new_tokens, self._tags(req))
+        return req.rid
+
+    def step(self) -> None:
+        if self._fault_hook is None:
+            from ...distributed.resilience.faults import (FaultInjected,
+                                                          maybe_inject)
+
+            self._fault_hook = maybe_inject
+            self._fault_cls = FaultInjected
+        try:
+            self._fault_hook("fleet.proc_kill",
+                             f"replica:{self.idx}:pid:{self.worker_pid}")
+        except self._fault_cls:
+            # the fault is REAL here: SIGKILL the worker process — the
+            # step below then fails on the dead socket and the router's
+            # journal-backed failover takes over (the drill's point)
+            self.kill()
+        reply = self._roundtrip(Message("STEP"), "step",
+                                expect=("TOKENS",))
+        self._apply(reply.payload)
+
+    def _apply(self, p: dict) -> None:
+        # one lock over the whole reply application: the heartbeat thread
+        # probes PROGRESS (and applies its payload) while the driver — or
+        # a parallel_step replica thread — applies STEP replies; the
+        # tracer's own lock is always taken INSIDE this one, never the
+        # reverse, so the order is acyclic
+        with self._state_lock:
+            if "behind" in p:
+                self._catchup = {int(r) for r in p["behind"]}
+            if "ready" in p:
+                self._ready = [int(r) for r in p["ready"]]
+            if "sig" in p:
+                self._last_sig = tuple(p["sig"])
+            if "load" in p:
+                self._load = int(p["load"])
+            if "has_work" in p:
+                self._has_work = bool(p["has_work"])
+            if "cap" in p:
+                self._cap = [int(c) for c in p["cap"]]
+            for up in p.get("updates", ()):
+                rid = int(up["rid"])
+                user = self.requests.get(rid)
+                if user is None:
+                    continue
+                new = [int(t) for t in up["toks"]]
+                if new:
+                    user.output.extend(new)
+                    user._n_out = len(user.output)
+                    self._stamp_progress(rid, user)
+                if up["done"] and rid not in self._done:
+                    user.done = True
+                    user.failed = bool(up["failed"])
+                    user.error = up.get("error")
+                    self._done.add(rid)
+                    self._finished[rid] = user
+                    self._catchup.discard(rid)
+                    self._open.discard(rid)
+                    self._submit_ts.pop(rid, None)
+                    self._streaming.discard(rid)
+                    if self.tracer is not None:
+                        self.tracer.finish(rid, len(user.output),
+                                           failed=user.failed,
+                                           error=user.error,
+                                           tags=self._tags(user))
+
+    def _stamp_progress(self, rid: int, user) -> None:
+        if self.tracer is None:
+            return
+        tags = self._tags(user)
+        if rid not in self._streaming:
+            self._streaming.add(rid)
+            wait = time.monotonic() - self._submit_ts.get(
+                rid, time.monotonic())
+            self.tracer.admit(rid, queue_wait_s=max(0.0, wait), tags=tags)
+            self.tracer.first_token(rid, tags=tags)
+        self.tracer.tokens(rid, len(user.output), tags=tags)
+
+    def _tags(self, user) -> dict:
+        tags = dict(self.trace_tags)
+        tags.setdefault("replica", self.idx)
+        if getattr(user, "tenant", None) is not None:
+            tags.setdefault("tenant", user.tenant)
+        return tags
+
+    def _progress_probe(self, what: str) -> dict:
+        from ...distributed.resilience.retry import RetryError, retry_call
+
+        try:
+            reply = retry_call(self._roundtrip, Message("PROGRESS"), what,
+                               expect=("PROGRESS_REPLY",),
+                               fatal_timeout=False,
+                               policy=_retry_policy(),
+                               what=f"procfleet.{what}")
+        except (socket.timeout, RetryError) as e:
+            self._note_dead()
+            raise WorkerDead(
+                f"PT-PROC-003: replica {self.idx} {what} probe kept "
+                f"timing out — worker presumed wedged/dead") from e
+        p = reply.payload
+        self._apply(p)
+        return p
+
+    def progress(self) -> tuple:
+        """The fleet heartbeat marker (mirrors
+        ``ServingSupervisor.progress``): changes whenever any worker-side
+        stream advances, a request completes, the engine rebuilds, or the
+        load changes. Served from reply-piggybacked state — the marker
+        refreshes with every STEP reply, so a worker that keeps stepping
+        without advancing any stream still trips the router's staleness
+        TTL, and one that stops answering dies on the STEP timeout."""
+        with self._state_lock:
+            return self._last_sig
+
+    def load(self) -> int:
+        with self._state_lock:
+            return self._load
+
+    def has_work(self) -> bool:
+        with self._state_lock:
+            return bool(self._open) or self._has_work
+
+    def behind(self, rid: int) -> bool:
+        with self._state_lock:
+            return rid in self._catchup
+
+    def capacity(self) -> List[int]:
+        """``[free slots, optimistic free pages]`` from the latest
+        reply — the tiered router's pre-handoff capacity gate (a chain
+        must never be retired toward a worker that cannot hold it)."""
+        with self._state_lock:
+            return list(self._cap)
+
+    def migration_ready(self) -> List[int]:
+        """rids whose prefill finished on this worker (populated from the
+        latest STEP reply) — the tiered router's migration pump input."""
+        with self._state_lock:
+            return list(self._ready)
+
+    def withdraw(self, rid: int) -> Optional[dict]:
+        reply = self._roundtrip(Message("WITHDRAW", {"rid": int(rid)}),
+                                "withdraw", expect=("WITHDRAWN",))
+        self._apply({"load": reply.payload["load"]})
+        rec = reply.payload["rec"]
+        if rec is not None:
+            with self._state_lock:
+                self.requests.pop(rid, None)
+                self._done.discard(rid)
+                self._open.discard(rid)
+                self._submit_ts.pop(rid, None)
+        return rec
+
+    def drain_mark(self) -> int:
+        """Tell the worker to refuse NEW (non-resumed) admissions — defense
+        in depth under a router drain; returns the worker's in-flight
+        load."""
+        reply = self._roundtrip(Message("DRAIN"), "drain",
+                                expect=("DRAINING",))
+        self._apply({"load": reply.payload["load"]})
+        return int(reply.payload["load"])
+
+    def metrics_text(self) -> str:
+        """The worker registry's Prometheus dump over the control socket
+        (the HTTP endpoint at :attr:`metrics_url` serves the same text)."""
+        from ...distributed.resilience.retry import RetryError, retry_call
+
+        try:
+            reply = retry_call(self._roundtrip, Message("METRICS"),
+                               "metrics", expect=("METRICS_TEXT",),
+                               fatal_timeout=False,
+                               policy=_retry_policy(),
+                               what="procfleet.metrics")
+        except (socket.timeout, RetryError) as e:
+            self._note_dead()
+            raise WorkerDead(
+                f"PT-PROC-003: replica {self.idx} metrics probe kept "
+                "timing out — worker presumed wedged/dead") from e
+        return reply.payload["text"]
+
+    def finished(self) -> Dict[int, "object"]:
+        with self._state_lock:
+            out, self._finished = self._finished, {}
+        return out
+
+    # -- tiered migration over the wire ------------------------------------
+    def export_migration(self, rid: int) -> Tuple[dict, bytes]:
+        """MIGRATE_OUT: the worker flushes, exports rid's KV chain,
+        journals ``migr-kv`` and releases the slot; returns
+        ``(header-lite, artifact bytes)``. After this returns, the rid is
+        no longer this worker's responsibility."""
+        reply = self._roundtrip(Message("MIGRATE_OUT", {"rid": int(rid)}),
+                                "migrate_out", expect=("CHAIN",))
+        # deltas the export's flush surfaced land BEFORE ownership moves:
+        # the caller's delivered prefix now equals the artifact's
+        self._apply({"updates": reply.payload["updates"]})
+        with self._state_lock:
+            self.requests.pop(rid, None)
+            self._open.discard(rid)
+            self._submit_ts.pop(rid, None)
+        return dict(reply.payload), reply.blob
+
+    def import_migration(self, user, artifact: bytes) -> int:
+        """MIGRATE_IN: splice an exported chain into this worker and
+        resume decode at the recorded position. Raises ``KVChainCorrupt``
+        / ``EngineSaturated`` exactly like the in-process splice."""
+        reply = self._roundtrip(
+            Message("MIGRATE_IN",
+                    {"req": _admit(user),
+                     "delivered": [int(t) for t in user.output]},
+                    blob=artifact),
+            "migrate_in", expect=("SPLICED",))
+        user._n_out = len(user.output)
+        with self._state_lock:
+            self.requests[user.rid] = user
+            self._done.discard(user.rid)
+            self._open.add(user.rid)
+            self._submit_ts.setdefault(user.rid, time.monotonic())
+            # the prefill side already stamped admit/first_token — a
+            # migrated stream continues, it does not re-admit
+            self._streaming.add(user.rid)
+        return int(reply.payload["rid"])
+
+    # -- lifecycle ---------------------------------------------------------
+    def _alive(self) -> bool:
+        return self.process.poll() is None
+
+    def _wait(self, timeout: float) -> bool:
+        try:
+            self.process.wait(timeout=timeout)
+            return True
+        except subprocess.TimeoutExpired:
+            return False
+
+    def kill(self) -> None:
+        """SIGKILL the worker — real process death (fault drills; also the
+        wedged-worker arm of ``abandon``)."""
+        if self._alive():
+            os.kill(self.process.pid, signal.SIGKILL)
+            self._wait(10.0)
+        self._note_dead()
+
+    def close(self) -> None:
+        """Graceful reap: SHUTDOWN (worker flushes + closes its journal),
+        wait for exit, reap. Falls back to a kill if the worker does not
+        comply in time."""
+        if self.reaped:
+            return
+        acked = False
+        if not self.dead and self._alive():
+            try:
+                self._roundtrip(Message("SHUTDOWN"), "shutdown",
+                                timeout=self.op_timeout_s, expect=("BYE",))
+                acked = True
+            except (WorkerDead, WireCorrupt):
+                pass
+        if not acked:
+            # the worker never acknowledged a shutdown: waiting for a
+            # voluntary exit is a dead 5s — kill like abandon() does
+            self.kill()
+        self._reap(force=True)
+
+    def abandon(self) -> None:
+        """Ungraceful release (router ``_mark_dead``): no SHUTDOWN, no
+        flush, no grace — SIGKILL whatever is left and reap immediately
+        (a wedged worker must not stall the fleet's failover for a
+        termination courtesy it will never answer). The on-disk journal
+        is what failover trusts, exactly like the in-process path."""
+        self.kill()
+        self._reap()
+
+    def _reap(self, force: bool = False) -> None:
+        if self.reaped:
+            return
+        self._hb_stop.set()
+        self._note_dead()
+        if self._alive() and not self._wait(5.0) and force:
+            self.process.terminate()
+            if not self._wait(5.0):
+                os.kill(self.process.pid, signal.SIGKILL)
+                self._wait(5.0)
+        _untrack_worker(self.process.pid)
+        try:
+            self._sock.close()
+        except (OSError, AttributeError):
+            pass
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=2.0)
+        try:
+            os.unlink(self._spec_path)
+        except OSError:
+            pass
+        self.reaped = True
+        self.stats["proc_reaped"] = self.stats.get("proc_reaped", 0) + 1
+
+    def heartbeat_count(self) -> int:
+        with self._state_lock:
+            return self._hb_count
+
+    def _heartbeat_loop(self, interval_s: float) -> None:
+        while not self._hb_stop.wait(interval_s):
+            if self.dead:
+                return
+            try:
+                self._progress_probe("heartbeat")
+            except Exception:  # noqa: BLE001 — probe failure = death signal
+                self._note_dead()
+                return
+            with self._state_lock:
+                self._hb_count += 1
+
+
+def _admit(req) -> dict:
+    from ..recovery import _admit_record
+
+    return _admit_record(req)

@@ -482,6 +482,19 @@ class ServingSupervisor:
         return (id(self.engine), self.engine._sched_tokens,
                 len(self._done), self.load())
 
+    def behind(self, rid: int) -> bool:
+        """True while ``rid``'s engine twin has regenerated fewer tokens
+        than the caller's delivered mark and is still running — the fleet
+        failover's catch-up condition (``_failover`` steps the survivor
+        until no resumed rid is behind). Part of the replica surface a
+        process-replica proxy (inference/procfleet) mirrors over the
+        wire."""
+        twin = self._live.get(rid)
+        user = self.requests.get(rid)
+        if twin is None or user is None:
+            return False
+        return twin._n_out < len(user.output) and not twin.done
+
     def withdraw(self, rid: int) -> Optional[dict]:
         """Pull a still-QUEUED request out of the engine (fleet drain
         migration): journals ``migr`` — this journal's responsibility for

@@ -32,7 +32,8 @@ jax and is safe to import anywhere.
 """
 
 from .collectors import (engine_collector, fleet_collector,  # noqa: F401
-                         guard_collector, retry_collector, slo_collector,
+                         guard_collector, procfleet_collector,
+                         retry_collector, slo_collector,
                          supervisor_collector, tracer_collector)
 from .metrics import (Counter, Gauge, Histogram,  # noqa: F401
                       MetricFamily, MetricsRegistry,
@@ -51,5 +52,6 @@ __all__ = ["Counter", "Gauge", "Histogram", "MetricFamily",
            "TraceRecorder", "VirtualClock", "WorkloadConfig",
            "decode_schedule", "encode_schedule", "engine_collector",
            "fleet_collector", "generate_schedule", "guard_collector",
-           "parse_prometheus_text", "retry_collector", "schedule_digest",
-           "slo_collector", "supervisor_collector", "tracer_collector"]
+           "parse_prometheus_text", "procfleet_collector",
+           "retry_collector", "schedule_digest", "slo_collector",
+           "supervisor_collector", "tracer_collector"]

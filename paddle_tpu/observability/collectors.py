@@ -28,19 +28,31 @@ Adapters (register with ``MetricsRegistry.register_collector``):
 - :func:`slo_collector` — ``SLOMonitor`` (observability/slo.py):
   windowed SLO attainment, per-tenant attainment and goodput as
   ``pt_slo_*`` families.
+- :func:`procfleet_collector` — process-per-replica fleet transport
+  (inference/procfleet): spawn/reap/heartbeat counters, workers-alive
+  gauge, and — the remote-scrape topology (docs/OBSERVABILITY.md) — every
+  live worker's OWN ``/metrics`` endpoint fetched at scrape time, its
+  families re-labeled ``replica="<idx>"`` and merged into this registry's
+  dump (``MetricsRegistry.collect`` already merges same-name families).
+  Works on any router: a fleet without process replicas renders the
+  ``pt_procfleet_*`` families at zero, so the scrape gate can REQUIRE
+  them unconditionally.
 
 Nothing here imports jax or touches device state.
 """
 
 from __future__ import annotations
 
+import contextlib
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
 from typing import Iterable, List, Optional
 
-from .metrics import MetricFamily
+from .metrics import MetricFamily, parse_prometheus_text
 
 __all__ = ["engine_collector", "fleet_collector", "guard_collector",
-           "retry_collector", "slo_collector", "supervisor_collector",
-           "tracer_collector"]
+           "procfleet_collector", "retry_collector", "slo_collector",
+           "supervisor_collector", "tracer_collector"]
 
 
 def _stat_families(prefix: str, stats: dict, kinds: dict,
@@ -224,6 +236,81 @@ def fleet_collector(router):
                     rep.sup, replica=str(rep.idx))())
         fams.append(state)
         fams.append(load)
+        return fams
+
+    return collect
+
+
+def procfleet_collector(router, scrape_workers: bool = True,
+                        timeout_s: float = 2.0):
+    """Process-fleet transport telemetry + remote worker aggregation.
+
+    ``pt_procfleet_spawned_total`` / ``pt_procfleet_reaped_total`` come
+    from the router's stats (zero on a non-process fleet);
+    ``pt_procfleet_heartbeats_total`` sums every proxy's heartbeat-probe
+    count. With ``scrape_workers`` (default), each live worker's
+    ``/metrics`` endpoint (``ProcFleetRouter.worker_metrics_urls``) is
+    fetched under ``timeout_s``, parsed, re-labeled ``replica="<idx>"``
+    and forwarded; a worker that cannot answer (dying, reaped mid-scrape)
+    is skipped and counted in ``pt_procfleet_scrape_errors`` — one dead
+    endpoint must not take the driver's scrape down."""
+
+    def collect() -> Iterable[MetricFamily]:
+        stats = getattr(router, "stats", {})
+        fams = [
+            MetricFamily("pt_procfleet_spawned_total", "counter",
+                         "replica worker processes spawned").add(
+                stats.get("proc_spawned", 0)),
+            MetricFamily("pt_procfleet_reaped_total", "counter",
+                         "replica worker processes reaped").add(
+                stats.get("proc_reaped", 0)),
+        ]
+        hb = getattr(router, "heartbeat_total", None)
+        fams.append(MetricFamily(
+            "pt_procfleet_heartbeats_total", "counter",
+            "driver-side heartbeat probes answered by workers").add(
+            hb() if callable(hb) else 0))
+        urls = {}
+        getter = getattr(router, "worker_metrics_urls", None)
+        if callable(getter):
+            urls = getter()
+        fams.append(MetricFamily(
+            "pt_procfleet_workers_alive", "gauge",
+            "live worker processes exposing a /metrics endpoint").add(
+            len(urls)))
+        errors = 0
+        if scrape_workers and urls:
+            def fetch(item):
+                idx, url = item
+                with contextlib.closing(urllib.request.urlopen(
+                        url, timeout=timeout_s)) as resp:
+                    return idx, parse_prometheus_text(
+                        resp.read().decode("utf-8"))
+
+            # fetch workers CONCURRENTLY: the scrape blocks max(worker),
+            # not sum(worker) — N dying endpoints during a rolling
+            # restart must not stack N timeouts onto one registry dump
+            with ThreadPoolExecutor(
+                    max_workers=min(8, len(urls)),
+                    thread_name_prefix="pt-procfleet-scrape") as pool:
+                futures = [pool.submit(fetch, item)
+                           for item in urls.items()]
+                for fut in futures:
+                    try:
+                        idx, worker_fams = fut.result()
+                    except Exception:   # dying worker: skip, count
+                        errors += 1
+                        continue
+                    for fam in worker_fams.values():
+                        out = MetricFamily(fam.name, fam.kind, fam.help)
+                        for suffix, labels, value in fam.samples:
+                            merged = dict(labels)
+                            merged["replica"] = str(idx)
+                            out.samples.append((suffix, merged, value))
+                        fams.append(out)
+        fams.append(MetricFamily(
+            "pt_procfleet_scrape_errors", "gauge",
+            "worker endpoints that failed this scrape").add(errors))
         return fams
 
     return collect

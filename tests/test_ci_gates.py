@@ -57,6 +57,11 @@ def test_manifest_counts_cover_reference_parity():
         # disagg PR (docs/SERVING.md "Disaggregated tiers"): +
         # KVChainCodec, KVChainCorrupt, TieredRouter
         "paddle.inference.serving": 19,
+        # procfleet PR (docs/SERVING.md "Process fleet"): the
+        # process-per-replica transport — Message, WireClosed,
+        # WireCorrupt, WorkerSpec, worker_main, ProcReplica, WorkerDead,
+        # ProcFleetConfig, ProcFleetRouter, ProcTieredRouter
+        "paddle.inference.procfleet": 10,
         # observability PR (docs/OBSERVABILITY.md): MetricsRegistry +
         # Counter/Gauge/Histogram/MetricFamily, MetricsServer,
         # TraceRecorder, parse_prometheus_text, and the five collector
@@ -64,8 +69,10 @@ def test_manifest_counts_cover_reference_parity():
         # SLO-observatory PR: + WorkloadConfig/TenantSpec/
         # ScheduledArrival/VirtualClock/ReplayDriver +
         # generate/encode/decode_schedule/schedule_digest +
-        # SLOConfig/SLOMonitor + tracer_collector/slo_collector
-        "paddle.observability": 26,
+        # SLOConfig/SLOMonitor + tracer_collector/slo_collector;
+        # procfleet PR: + procfleet_collector (worker /metrics
+        # aggregation under replica=i labels)
+        "paddle.observability": 27,
         # concurrency-lint PR (docs/STATIC_ANALYSIS.md PT-RACE section):
         # analyze_source/file/paths, build_module_model,
         # infer_shared_state, run_checks, finding_id, ModuleModel,
@@ -251,15 +258,16 @@ def test_program_cost_gate_real_sweep_clean():
         assert "missing []" in line, line
 
 
-@pytest.mark.slow   # ~3min of engine/train-loop compiles across 17 classes
+@pytest.mark.slow   # ~3min of engine/train-loop compiles across 18 classes
 def test_fault_drill_matrix():
     """Resilience gate (docs/RESILIENCE.md + docs/NUMERIC_GUARD.md +
     docs/SERVING.md): the seeded fault matrix — heartbeat loss, store
     stall, shard corruption, engine saturation, serving deadline,
     prefix-cache block-pool exhaustion, 128-slot fused big-batch
     saturation, serving engine crash mid-decode, serving step stall,
-    overload shed, fleet replica kill, fleet rolling drain/restart, fleet
-    overload brownout, KV-migration corruption (PT-SRV-007), NaN
+    overload shed, fleet replica kill, fleet worker-PROCESS SIGKILL
+    (fleet_proc_kill — inference/procfleet), fleet rolling drain/restart,
+    fleet overload brownout, KV-migration corruption (PT-SRV-007), NaN
     gradient, loss spike, poisoned batch — must be
     absorbed with recovery enabled AND flip the exit code
     with recovery disabled. Runs in a subprocess (the drill forces the
@@ -275,9 +283,9 @@ def test_fault_drill_matrix():
     r = subprocess.run(
         [sys.executable, os.path.join(ROOT, "tools", "fault_drill.py"),
          "--selftest"],
-        capture_output=True, text=True, env=env, cwd=ROOT, timeout=500)
+        capture_output=True, text=True, env=env, cwd=ROOT, timeout=560)
     assert r.returncode == 0, r.stdout + r.stderr
-    assert "FAULT DRILL OK: 17 fault classes" in r.stdout, r.stdout
+    assert "FAULT DRILL OK: 18 fault classes" in r.stdout, r.stdout
 
 
 def test_fault_drill_single_drill_exit_codes():

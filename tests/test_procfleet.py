@@ -1,0 +1,776 @@
+"""Process-per-replica serving fleet (inference/procfleet — docs/SERVING.md
+"Process fleet").
+
+Fast in-process pins (tier-1): the PT-PROC wire codec (round-trip /
+corruption / truncation / schema strictness), proxy timeout + typed-error
+mapping + idempotent-probe retry against a scripted peer (no process, no
+jax compile), worker-spec resolution, and the worker serve-loop handlers
+over a stub supervisor.
+
+Every PROCESS-SPAWNING end-to-end is slow-marked (tier-1 budget
+discipline): SIGKILL-1-of-2 journal-backed failover with byte-identical
+streams (greedy + seeded), rolling restart over processes, SLO-autoscaler
+spawn/reap, and tiered KV-chain migration over the wire. The CI-gated
+``fleet_proc_kill`` drill (tools/fault_drill.py) covers the kill class
+end-to-end as well.
+"""
+
+import os
+import pickle
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.procfleet import (Message, ProcFleetConfig,
+                                            ProcFleetRouter, ProcReplica,
+                                            ProcTieredRouter, WireClosed,
+                                            WireCorrupt, WorkerDead,
+                                            WorkerSpec)
+from paddle_tpu.inference.procfleet import wire
+from paddle_tpu.inference.procfleet.presets import (tiny_llama_engine,
+                                                    tiny_llama_prefix_engine)
+from paddle_tpu.inference.procfleet.worker import (_WorkerLoop,
+                                                   resolve_factory)
+from paddle_tpu.inference.serving import (EngineSaturated, Request,
+                                          RequestShed)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PRESETS = "paddle_tpu.inference.procfleet.presets"
+
+
+# ---------------------------------------------------------------------------
+# wire codec (fast)
+# ---------------------------------------------------------------------------
+
+class TestWireCodec:
+    def test_round_trip_every_type(self):
+        """Each message type round-trips encode->decode byte-exactly,
+        blob included."""
+        samples = {
+            "HELLO": {"pid": 7, "metrics_port": None,
+                      "journal_path": "/tmp/j", "engine": {"page_size": 8},
+                      "state": {"load": 0, "sig": [], "has_work": False}},
+            "SUBMIT": {"req": {"rid": 3}, "resume": True,
+                       "delivered": [1, 2]},
+            "SUBMITTED": {"rid": 3, "load": 1},
+            "STEP": {},
+            "TOKENS": {"updates": [], "load": 0, "sig": [1, 2, 0, 0],
+                       "behind": [], "ready": [], "cap": [0, 0], "has_work": False},
+            "WITHDRAW": {"rid": 9},
+            "WITHDRAWN": {"rec": None, "load": 0},
+            "DRAIN": {},
+            "DRAINING": {"load": 4},
+            "PROGRESS": {},
+            "PROGRESS_REPLY": {"sig": [1], "load": 2, "has_work": True,
+                               "behind": [5]},
+            "METRICS": {},
+            "METRICS_TEXT": {"text": "pt_up 1\n"},
+            "SHUTDOWN": {},
+            "BYE": {},
+            "ERROR": {"etype": "EngineSaturated", "msg": "full"},
+            "MIGRATE_OUT": {"rid": 1},
+            "CHAIN": {"rid": 1, "digest": "ab", "pages": 2, "updates": []},
+            "MIGRATE_IN": {"req": {"rid": 1}, "delivered": [4]},
+            "SPLICED": {"rid": 1},
+        }
+        assert set(samples) == set(wire.SCHEMAS)
+        for mtype, payload in samples.items():
+            blob = b"\x01\x02" * 37 if mtype in ("CHAIN", "MIGRATE_IN") \
+                else b""
+            m = Message(mtype, payload, blob)
+            assert wire.decode_bytes(wire.encode(m)) == m
+
+    def test_crc_corruption_is_typed(self):
+        b = bytearray(wire.encode(Message("SUBMITTED",
+                                          {"rid": 1, "load": 0})))
+        b[-1] ^= 0x20
+        with pytest.raises(WireCorrupt, match="PT-PROC-001.*crc32"):
+            wire.decode_bytes(bytes(b))
+
+    def test_blob_corruption_fails_crc(self):
+        b = bytearray(wire.encode(Message(
+            "MIGRATE_IN", {"req": {}, "delivered": []}, blob=b"x" * 64)))
+        b[-10] ^= 0x04
+        with pytest.raises(WireCorrupt, match="crc32"):
+            wire.decode_bytes(bytes(b))
+
+    def test_truncation_everywhere(self):
+        full = wire.encode(Message("METRICS_TEXT", {"text": "x" * 100}))
+        for cut in (3, 10, len(full) - 1):
+            with pytest.raises(WireCorrupt, match="PT-PROC-001"):
+                wire.decode_bytes(full[:cut])
+
+    def test_incremental_decode_waits_for_full_frame(self):
+        full = wire.encode(Message("STEP"))
+        assert wire.decode(full[:5]) == (None, 0)
+        msg, used = wire.decode(full + b"tail")
+        assert msg.mtype == "STEP" and used == len(full)
+
+    def test_trailing_garbage_rejected(self):
+        full = wire.encode(Message("STEP"))
+        with pytest.raises(WireCorrupt, match="trailing"):
+            wire.decode_bytes(full + b"zz")
+
+    def test_bad_magic_version_type_length(self):
+        good = wire.encode(Message("STEP"))
+        with pytest.raises(WireCorrupt, match="magic"):
+            wire.decode_bytes(b"XXXX" + good[4:])
+        bad_ver = bytearray(good)
+        bad_ver[4] = 99
+        with pytest.raises(WireCorrupt, match="version"):
+            wire.decode_bytes(bytes(bad_ver))
+        bad_type = bytearray(good)
+        bad_type[5] = 222
+        with pytest.raises(WireCorrupt, match="type id"):
+            wire.decode_bytes(bytes(bad_type))
+        import struct
+        huge = struct.pack(">4sBBIII", wire.MAGIC, wire.WIRE_VERSION, 4,
+                           2 ** 30, 2 ** 30, 0)
+        with pytest.raises(WireCorrupt, match="ceiling"):
+            wire.decode(huge)
+
+    def test_schema_strictness(self):
+        with pytest.raises(WireCorrupt, match="missing required"):
+            wire.encode(Message("SUBMIT", {"req": {}, "resume": False}))
+        with pytest.raises(WireCorrupt, match="schema wants"):
+            wire.encode(Message("SUBMITTED", {"rid": "three"}))
+        # bool is not an int on the wire
+        with pytest.raises(WireCorrupt, match="bool"):
+            wire.encode(Message("SUBMITTED", {"rid": True}))
+        with pytest.raises(WireCorrupt, match="unknown message type"):
+            wire.encode(Message("NOPE", {}))
+
+    def test_socket_send_recv_and_eof(self):
+        a, b = socket.socketpair()
+        try:
+            wire.send_msg(a, Message("DRAINING", {"load": 2}))
+            got = wire.recv_msg(b, timeout=2.0)
+            assert got.payload["load"] == 2
+            a.close()
+            with pytest.raises(WireClosed):
+                wire.recv_msg(b, timeout=2.0)
+        finally:
+            b.close()
+
+    def test_mid_frame_eof_is_death_not_damage(self):
+        a, b = socket.socketpair()
+        try:
+            frame = wire.encode(Message("METRICS_TEXT", {"text": "y" * 50}))
+            a.sendall(frame[: len(frame) - 7])
+            a.close()
+            with pytest.raises(WireClosed, match="process death"):
+                wire.recv_msg(b, timeout=2.0)
+        finally:
+            b.close()
+
+
+# ---------------------------------------------------------------------------
+# proxy behaviors against a scripted peer (fast — no process, no jax work)
+# ---------------------------------------------------------------------------
+
+def _bare_proxy(sock, op_timeout_s=0.5):
+    """A ProcReplica wired to a socketpair end instead of a spawned
+    worker — exactly the wire-facing surface, none of the process
+    lifecycle."""
+    p = ProcReplica.__new__(ProcReplica)
+    p.idx = 0
+    p.spec = None
+    p.tracer = None
+    p.trace_tags = {}
+    p.op_timeout_s = op_timeout_s
+    p.stats = {}
+    p.requests = {}
+    p._done = set()
+    p._finished = {}
+    p._submit_ts = {}
+    p._streaming = set()
+    p._io_lock = threading.Lock()
+    p._state_lock = threading.Lock()
+    p._catchup = set()
+    p._ready = []
+    p._last_sig = ()
+    p._load = 0
+    p._has_work = False
+    p._cap = [0, 0]
+    p._open = set()
+    p._seq = 0
+    p._hb_count = 0
+    p._hb_stop = threading.Event()
+    p._hb_thread = None
+    p.dead = False
+    p.reaped = False
+    p._fault_hook = None
+    p._fault_cls = None
+    p._sock = sock
+    p.worker_pid = 0
+    return p
+
+
+class _ScriptedPeer:
+    """Serves scripted replies on the other socketpair end."""
+
+    def __init__(self, replies):
+        self.sock, self.peer = socket.socketpair()
+        self.replies = list(replies)
+        self.requests = []
+        self.thread = threading.Thread(target=self._serve, daemon=True)
+        self.thread.start()
+
+    def _serve(self):
+        try:
+            while self.replies:
+                msg = wire.recv_msg(self.peer, timeout=5.0)
+                self.requests.append(msg)
+                reply = self.replies.pop(0)
+                if reply is None:
+                    continue            # swallow: the client must time out
+                wire.send_msg(self.peer, reply)
+        except (WireClosed, WireCorrupt, socket.timeout, OSError):
+            pass
+
+    def close(self):
+        self.peer.close()
+        self.sock.close()
+        self.thread.join(timeout=2.0)
+
+
+class TestProxyWireBehaviors:
+    def test_typed_errors_re_raise(self):
+        """ERROR replies map back to the exception class the router's
+        fall-through routing distinguishes."""
+        peer = _ScriptedPeer([
+            Message("ERROR", {"etype": "EngineSaturated", "msg": "full"}),
+            Message("ERROR", {"etype": "RequestShed", "msg": "infeasible"}),
+        ])
+        p = _bare_proxy(peer.sock, op_timeout_s=2.0)
+        req = Request(np.arange(4, dtype=np.int32), max_new_tokens=2)
+        with pytest.raises(EngineSaturated, match="full"):
+            p.submit(req)
+        with pytest.raises(RequestShed, match="infeasible"):
+            p.submit(req)
+        assert not p.dead          # typed refusals are not death
+        peer.close()
+
+    def test_fatal_error_and_desync_are_death(self):
+        peer = _ScriptedPeer([
+            Message("ERROR", {"etype": "RuntimeError",
+                              "msg": "worker fatal: boom"})])
+        p = _bare_proxy(peer.sock, op_timeout_s=2.0)
+        with pytest.raises(WorkerDead, match="PT-PROC-002.*boom"):
+            p.step()
+        assert p.dead
+        peer.close()
+        peer2 = _ScriptedPeer([Message("BYE", {})])
+        p2 = _bare_proxy(peer2.sock, op_timeout_s=2.0)
+        with pytest.raises(WorkerDead, match="protocol desync"):
+            p2.step()
+        peer2.close()
+
+    def test_step_timeout_is_typed_death(self):
+        peer = _ScriptedPeer([None, Message("BYE", {})])
+        p = _bare_proxy(peer.sock, op_timeout_s=0.2)
+        t0 = time.monotonic()
+        with pytest.raises(WorkerDead, match="PT-PROC-003"):
+            p.step()
+        assert time.monotonic() - t0 < 2.0
+        assert p.dead
+        with pytest.raises(WorkerDead, match="already dead"):
+            p.step()               # mutating ops are single-shot
+        peer.close()
+
+    def test_progress_probe_retries_then_succeeds(self):
+        """Idempotent probes (the heartbeat thread's path) ride
+        retry_call: one swallowed PROGRESS does not kill a healthy
+        replica — and the probe refreshes the cached marker the router's
+        ``progress()`` serves."""
+        ok = Message("PROGRESS_REPLY", {"sig": [1, 2], "load": 1,
+                                        "has_work": True, "behind": []})
+        peer = _ScriptedPeer([None, ok])
+        p = _bare_proxy(peer.sock, op_timeout_s=0.2)
+        assert p._progress_probe("heartbeat")["sig"] == [1, 2]
+        assert p.progress() == (1, 2)      # cached marker refreshed
+        assert p.load() == 1
+        assert not p.dead
+        assert len(peer.requests) == 2     # first attempt + retry
+        peer.close()
+
+    def test_stale_reply_after_timeout_is_discarded_not_desync(self):
+        """A probe that times out leaves its reply in flight; the retry
+        must DISCARD the stale (sequence-mismatched) reply and match its
+        own — and the following op must not read a leftover frame as its
+        reply (the protocol-desync failure mode)."""
+        a, b = socket.socketpair()
+        served = []
+
+        def peer():
+            try:
+                # 1st PROGRESS: answer LATE (past the client timeout)
+                m1 = wire.recv_msg(b, timeout=5.0)
+                served.append(m1.mtype)
+                time.sleep(0.45)
+                wire.send_msg(b, Message("PROGRESS_REPLY", {
+                    "sig": [1], "load": 1, "has_work": True, "behind": [],
+                    "_seq": m1.payload["_seq"]}))
+                # 2nd PROGRESS (the retry): answer promptly
+                m2 = wire.recv_msg(b, timeout=5.0)
+                served.append(m2.mtype)
+                wire.send_msg(b, Message("PROGRESS_REPLY", {
+                    "sig": [2], "load": 2, "has_work": True, "behind": [],
+                    "_seq": m2.payload["_seq"]}))
+                # the NEXT op must still pair correctly
+                m3 = wire.recv_msg(b, timeout=5.0)
+                served.append(m3.mtype)
+                wire.send_msg(b, Message("TOKENS", {
+                    "updates": [], "load": 0, "sig": [3], "behind": [],
+                    "ready": [], "cap": [0, 0], "has_work": False,
+                    "_seq": m3.payload["_seq"]}))
+            except (WireClosed, socket.timeout, OSError):
+                pass
+
+        t = threading.Thread(target=peer, daemon=True)
+        t.start()
+        p = _bare_proxy(a, op_timeout_s=0.3)
+        assert p._progress_probe("heartbeat")["sig"] == [2]
+        p.step()                       # pairs with TOKENS, not a leftover
+        assert p.progress() == (3,)
+        assert not p.dead
+        assert served == ["PROGRESS", "PROGRESS", "STEP"]
+        t.join(timeout=2.0)
+        a.close()
+        b.close()
+
+    def test_progress_probe_exhaustion_is_death(self):
+        peer = _ScriptedPeer([None, None])
+        p = _bare_proxy(peer.sock, op_timeout_s=0.2)
+        with pytest.raises(WorkerDead, match="PT-PROC-003"):
+            p._progress_probe("heartbeat")
+        assert p.dead
+        peer.close()
+
+    def test_peer_gone_mid_step_is_death(self):
+        a, b = socket.socketpair()
+        p = _bare_proxy(a, op_timeout_s=2.0)
+        b.close()
+        with pytest.raises(WorkerDead, match="PT-PROC-002"):
+            p.step()
+        a.close()
+
+    def test_token_updates_splice_and_finish(self):
+        req = Request(np.arange(4, dtype=np.int32), max_new_tokens=4)
+        peer = _ScriptedPeer([
+            Message("SUBMITTED", {"rid": int(req.rid), "load": 1}),
+            Message("TOKENS", {
+                "updates": [{"rid": int(req.rid), "toks": [5, 6],
+                             "done": False, "failed": False, "error": None,
+                             "n_out": 2}],
+                "load": 1, "sig": [1], "behind": [], "ready": [], "cap": [1, 8], "has_work": True}),
+            Message("TOKENS", {
+                "updates": [{"rid": int(req.rid), "toks": [7],
+                             "done": True, "failed": False, "error": None,
+                             "n_out": 3}],
+                "load": 0, "sig": [2], "behind": [], "ready": [], "cap": [1, 8], "has_work": True}),
+        ])
+        p = _bare_proxy(peer.sock, op_timeout_s=2.0)
+        p.submit(req)
+        p.step()
+        assert req.output == [5, 6] and not req.done
+        p.step()
+        assert req.output == [5, 6, 7] and req.done and not req.failed
+        assert p.finished() == {req.rid: req}
+        assert p.finished() == {}
+        peer.close()
+
+    def test_resume_submit_tracks_catchup(self):
+        req = Request(np.arange(4, dtype=np.int32), max_new_tokens=4)
+        req.output = [9, 9]
+        peer = _ScriptedPeer([
+            Message("SUBMITTED", {"rid": int(req.rid), "load": 1}),
+            Message("TOKENS", {"updates": [], "load": 1, "sig": [1],
+                               "behind": [int(req.rid)], "ready": [], "cap": [1, 8], "has_work": True}),
+            Message("TOKENS", {"updates": [], "load": 1, "sig": [2],
+                               "behind": [], "ready": [], "cap": [1, 8], "has_work": True}),
+        ])
+        p = _bare_proxy(peer.sock, op_timeout_s=2.0)
+        p.submit(req, resume=True)
+        assert p.behind(req.rid)       # catching up until the worker says
+        p.step()
+        assert p.behind(req.rid)
+        p.step()
+        assert not p.behind(req.rid)
+        peer.close()
+
+
+# ---------------------------------------------------------------------------
+# worker units (fast)
+# ---------------------------------------------------------------------------
+
+class TestWorkerSpec:
+    def test_resolve_string_reference(self):
+        spec = WorkerSpec(factory=f"{PRESETS}:tiny_llama_engine",
+                          journal_path="/tmp/j",
+                          factory_kwargs={"max_batch": 3})
+        build = resolve_factory(spec)
+        assert callable(build)
+
+    def test_resolve_callable_reference(self):
+        spec = WorkerSpec(factory=tiny_llama_engine, journal_path="/tmp/j")
+        assert callable(resolve_factory(spec))
+
+    def test_bad_references_raise(self):
+        with pytest.raises(ValueError, match="module:qualname"):
+            resolve_factory(WorkerSpec(factory="nocolon",
+                                       journal_path="/tmp/j"))
+        with pytest.raises(TypeError, match="not callable"):
+            resolve_factory(WorkerSpec(factory=f"{PRESETS}:__doc__",
+                                       journal_path="/tmp/j"))
+
+    def test_spec_pickles(self):
+        spec = WorkerSpec(factory=f"{PRESETS}:tiny_llama_engine",
+                          journal_path="/x", sup_kwargs={"fsync": False},
+                          env={"JAX_PLATFORMS": "cpu"}, tier="decode")
+        again = pickle.loads(pickle.dumps(spec))
+        assert again == spec
+
+
+class _StubSup:
+    """Minimal supervisor surface for serve-loop handler units."""
+
+    def __init__(self):
+        self.requests = {}
+        self._live = {}
+        self._verify = set()
+        self.submitted = []
+
+        class _Eng:
+            prefix_cache = None
+        self.engine = _Eng()
+
+    def submit(self, req, resume=False):
+        self.submitted.append((req, resume))
+        self.requests[req.rid] = req
+        return req.rid
+
+    def load(self):
+        return len(self.requests)
+
+    def progress(self):
+        return (1, 2, 3, self.load())
+
+    def has_work(self):
+        return bool(self.requests)
+
+    def behind(self, rid):
+        return False
+
+    def withdraw(self, rid):
+        rec = {"rid": rid} if rid in self.requests else None
+        self.requests.pop(rid, None)
+        return rec
+
+    def step(self):
+        pass
+
+
+class TestWorkerLoop:
+    def _meta(self, req):
+        from paddle_tpu.inference.recovery import _admit_record
+
+        return _admit_record(req)
+
+    def test_submit_and_updates(self):
+        loop = _WorkerLoop(_StubSup())
+        req = Request(np.arange(4, dtype=np.int32), max_new_tokens=2)
+        reply = loop.handle(Message(
+            "SUBMIT", {"req": self._meta(req), "resume": False,
+                       "delivered": []}))
+        assert reply.mtype == "SUBMITTED"
+        assert reply.payload["rid"] == req.rid
+        user, resume = loop.sup.submitted[0]
+        assert not resume and list(user.prompt) == list(req.prompt)
+        # stream some tokens, then finish
+        user.output.extend([4, 5])
+        reply = loop.handle(Message("STEP"))
+        assert reply.mtype == "TOKENS"
+        (up,) = reply.payload["updates"]
+        assert up["toks"] == [4, 5] and not up["done"]
+        user.output.append(6)
+        user.done = True
+        (up,) = loop.handle(Message("STEP")).payload["updates"]
+        assert up["toks"] == [6] and up["done"] and not up["failed"]
+        # a finished rid is not re-reported
+        assert loop.handle(Message("STEP")).payload["updates"] == []
+
+    def test_resume_submit_dedups_delivered(self):
+        loop = _WorkerLoop(_StubSup())
+        req = Request(np.arange(4, dtype=np.int32), max_new_tokens=4)
+        loop.handle(Message("SUBMIT", {"req": self._meta(req),
+                                       "resume": True,
+                                       "delivered": [7, 8]}))
+        user, resume = loop.sup.submitted[0]
+        assert resume and user.output == [7, 8]
+        # worker only wires tokens PAST the delivered mark
+        user.output.append(9)
+        (up,) = loop.handle(Message("STEP")).payload["updates"]
+        assert up["toks"] == [9]
+
+    def test_drain_refuses_new_but_not_resumed(self):
+        loop = _WorkerLoop(_StubSup())
+        assert loop.handle(Message("DRAIN")).mtype == "DRAINING"
+        req = Request(np.arange(4, dtype=np.int32), max_new_tokens=2)
+        reply = loop.handle(Message(
+            "SUBMIT", {"req": self._meta(req), "resume": False,
+                       "delivered": []}))
+        assert reply.mtype == "ERROR"
+        assert reply.payload["etype"] == "EngineSaturated"
+        reply = loop.handle(Message(
+            "SUBMIT", {"req": self._meta(req), "resume": True,
+                       "delivered": []}))
+        assert reply.mtype == "SUBMITTED"
+
+    def test_withdraw_progress_metrics_unknown(self):
+        loop = _WorkerLoop(_StubSup())
+        req = Request(np.arange(4, dtype=np.int32), max_new_tokens=2)
+        loop.handle(Message("SUBMIT", {"req": self._meta(req),
+                                       "resume": False, "delivered": []}))
+        reply = loop.handle(Message("WITHDRAW", {"rid": int(req.rid)}))
+        assert reply.payload["rec"]["rid"] == req.rid
+        reply = loop.handle(Message("WITHDRAW", {"rid": 10 ** 6}))
+        assert reply.payload["rec"] is None
+        reply = loop.handle(Message("PROGRESS"))
+        assert reply.mtype == "PROGRESS_REPLY"
+        assert reply.payload["sig"] == [1, 2, 3, 0]
+        assert loop.handle(Message("METRICS")).payload["text"] == ""
+        reply = loop.handle(Message("TOKENS", {
+            "updates": [], "load": 0, "sig": [], "behind": [],
+            "ready": [], "cap": [1, 8], "has_work": True}))
+        assert reply.mtype == "ERROR"       # not a request the worker serves
+
+
+# ---------------------------------------------------------------------------
+# process-spawning end-to-ends (slow)
+# ---------------------------------------------------------------------------
+
+def _wave_kwargs(cfg_vocab=256, n=6):
+    rng = np.random.default_rng(41)
+    kws = []
+    for i in range(n):
+        p = rng.integers(0, cfg_vocab, (6,)).astype(np.int32)
+        kw = dict(prompt_ids=p, max_new_tokens=8, seed=200 + i)
+        if i % 3 == 2:
+            kw.update(temperature=0.9)
+        kws.append(kw)
+    return kws
+
+
+@pytest.fixture(scope="module")
+def refs():
+    """Uninterrupted single-engine reference streams (greedy + seeded) —
+    any process placement/failover must reproduce them exactly."""
+    eng = tiny_llama_engine()
+    reqs = [Request(**kw) for kw in _wave_kwargs()]
+    for r in reqs:
+        eng.add_request(r)
+    eng.run_until_done(max_steps=500)
+    return [list(r.tokens) for r in reqs]
+
+
+def _proc_cfg(prefix=False, **extra):
+    fn = "tiny_llama_prefix_engine" if prefix else "tiny_llama_engine"
+    return ProcFleetConfig(factory=f"{PRESETS}:{fn}",
+                           env={"JAX_PLATFORMS": "cpu"}, **extra)
+
+
+@pytest.mark.slow   # spawns real worker processes (jax import + compile
+#                     per worker); the CI-gated fleet_proc_kill drill
+#                     covers the kill class end-to-end too
+class TestProcKill:
+    def test_sigkill_one_of_two_byte_identical(self, tmp_path, refs):
+        """A real SIGKILL mid-decode: the dead WORKER PROCESS's journal
+        feeds re-admission on the survivor; every stream byte-identical
+        to the uninterrupted run (PT-FLT-001 over PT-PROC transport)."""
+        from paddle_tpu.distributed.resilience import FaultPlan, FaultSpec
+
+        plan = FaultPlan(seed=5, specs=[
+            FaultSpec("fleet.proc_kill", "kill", at=2, count=1,
+                      match="replica:0:")])
+        fleet = ProcFleetRouter(_proc_cfg(), str(tmp_path), num_replicas=2)
+        pid0 = fleet.replicas[0].sup.worker_pid
+        reqs = [Request(**kw) for kw in _wave_kwargs()]
+        try:
+            with plan:
+                for r in reqs:
+                    fleet.submit(r)
+                fleet.run_until_done(max_steps=500)
+        finally:
+            fleet.close()
+        assert plan.log, "fleet.proc_kill never fired"
+        assert fleet.stats["replica_deaths"] == 1
+        with pytest.raises(ProcessLookupError):
+            os.kill(pid0, 0)            # the process is REALLY gone
+        assert not [r.rid for r in reqs if r.failed or not r.done]
+        assert [list(r.output) for r in reqs] == refs
+        assert fleet.stats["failover_requests"] >= 1
+
+    def test_no_failover_control_arm_loses_streams(self, tmp_path):
+        from paddle_tpu.distributed.resilience import FaultPlan, FaultSpec
+
+        plan = FaultPlan(seed=5, specs=[
+            FaultSpec("fleet.proc_kill", "kill", at=2, count=1,
+                      match="replica:0:")])
+        fleet = ProcFleetRouter(_proc_cfg(), str(tmp_path), num_replicas=2,
+                                failover=False)
+        reqs = [Request(**kw) for kw in _wave_kwargs()]
+        try:
+            with plan:
+                for r in reqs:
+                    fleet.submit(r)
+                fleet.run_until_done(max_steps=500)
+        finally:
+            fleet.close()
+        lost = [r for r in reqs if r.failed]
+        assert lost, "SIGKILL with failover off lost nothing?"
+        assert all("PT-FLT-001" in (r.error or "") for r in lost)
+
+
+@pytest.mark.slow   # spawns 2 workers + respawns both across the restart
+class TestProcLifecycle:
+    def test_rolling_restart_over_processes(self, tmp_path, refs):
+        fleet = ProcFleetRouter(_proc_cfg(), str(tmp_path), num_replicas=2)
+        pids0 = [rep.sup.worker_pid for rep in fleet.replicas]
+        reqs = [Request(**kw) for kw in _wave_kwargs()]
+        try:
+            for r in reqs:
+                fleet.submit(r)
+            fleet.step()
+            fleet.rolling_restart(max_steps=500)
+            fleet.run_until_done(max_steps=500)
+        finally:
+            fleet.close()
+        assert [list(r.output) for r in reqs] == refs
+        assert fleet.stats["restarts"] >= 2
+        pids1 = [rep.sup.worker_pid for rep in fleet.replicas]
+        assert set(pids0).isdisjoint(pids1)     # fresh processes
+        assert fleet.stats["proc_spawned"] == 4
+        assert fleet.stats["proc_reaped"] == 4
+
+    def test_autoscaler_spawns_and_reaps_processes(self, tmp_path):
+        """SLOAutoscaler runs UNCHANGED over process replicas: attainment
+        shortfall spawns a worker process, sustained headroom drains and
+        reaps one."""
+        from paddle_tpu.inference.autoscale import (AutoscaleConfig,
+                                                    SLOAutoscaler)
+
+        class _Mon:
+            class config:
+                target_attainment = 0.9
+
+            def __init__(self):
+                self.window = None
+
+            def last_window(self):
+                return self.window
+
+        fleet = ProcFleetRouter(_proc_cfg(), str(tmp_path), num_replicas=1)
+        mon = _Mon()
+        scaler = SLOAutoscaler(fleet, mon, AutoscaleConfig(
+            min_replicas=1, max_replicas=2, up_after=1, down_after=1,
+            cooldown_windows=0))
+        try:
+            mon.window = {"window": 0, "attainment": 0.5, "finished": 8}
+            assert scaler.tick() == "scale_up"
+            assert len(fleet.replicas) == 2
+            new = fleet.replicas[1].sup
+            assert isinstance(new, ProcReplica) and new.worker_pid > 0
+            assert fleet.stats["proc_spawned"] == 2
+            # route through the scaled-up worker to prove it serves
+            reqs = [Request(**kw) for kw in _wave_kwargs(n=4)]
+            for r in reqs:
+                fleet.submit(r)
+            fleet.run_until_done(max_steps=500)
+            assert all(r.done and not r.failed for r in reqs)
+            mon.window = {"window": 1, "attainment": 1.0, "finished": 8}
+            assert scaler.tick() == "scale_down"
+            guard = 0
+            from paddle_tpu.inference.fleet import ReplicaState
+            while (fleet.replicas[1].state != ReplicaState.RETIRED
+                   and guard < 200):
+                fleet.step()
+                guard += 1
+            assert fleet.replicas[1].state == ReplicaState.RETIRED
+            assert new.reaped
+            with pytest.raises(ProcessLookupError):
+                os.kill(new.worker_pid, 0)
+        finally:
+            fleet.close()
+
+
+@pytest.mark.slow   # spawns one worker process
+class TestProcScrape:
+    def test_driver_aggregates_worker_metrics(self, tmp_path):
+        """The remote-scrape topology (docs/OBSERVABILITY.md): the driver
+        registry's procfleet_collector fetches each worker's OWN /metrics
+        endpoint and merges its families under replica=i labels."""
+        from paddle_tpu.observability import (MetricsRegistry,
+                                              parse_prometheus_text,
+                                              procfleet_collector)
+
+        fleet = ProcFleetRouter(_proc_cfg(), str(tmp_path), num_replicas=1)
+        try:
+            reqs = [Request(**kw) for kw in _wave_kwargs(n=2)]
+            for r in reqs:
+                fleet.submit(r)
+            fleet.run_until_done(max_steps=500)
+            registry = MetricsRegistry()
+            registry.register_collector(procfleet_collector(fleet))
+            fams = parse_prometheus_text(registry.dump())
+            assert fams["pt_procfleet_spawned_total"].samples[0][2] == 1.0
+            assert fams["pt_procfleet_workers_alive"].samples[0][2] == 1.0
+            # worker-side engine families forwarded with the replica label
+            eng = fams["pt_engine_scheduled_tokens_total"]
+            assert any(s[1].get("replica") == "0" and s[2] > 0
+                       for s in eng.samples)
+            up = fams["pt_procfleet_worker_up"]
+            assert any(s[2] == 1.0 for s in up.samples)
+        finally:
+            fleet.close()
+        # post-reap: the same collector reports zero live workers and the
+        # scrape keeps answering (dead endpoints are skipped, not fatal)
+        fams = parse_prometheus_text(registry.dump())
+        assert fams["pt_procfleet_workers_alive"].samples[0][2] == 0.0
+        assert fams["pt_procfleet_reaped_total"].samples[0][2] == 1.0
+
+
+@pytest.mark.slow   # spawns a 1-prefill + 1-decode process pair
+class TestProcTiered:
+    def test_wire_migration_byte_identical(self, tmp_path):
+        eng = tiny_llama_prefix_engine()
+        kws = _wave_kwargs(n=4)
+        reqs = [Request(**kw) for kw in kws]
+        for r in reqs:
+            eng.add_request(r)
+        eng.run_until_done(max_steps=500)
+        refs = [list(r.tokens) for r in reqs]
+
+        tiered = ProcTieredRouter(_proc_cfg(prefix=True),
+                                  _proc_cfg(prefix=True), str(tmp_path),
+                                  num_prefill=1, num_decode=1)
+        reqs2 = [Request(**kw) for kw in kws]
+        try:
+            for r in reqs2:
+                tiered.submit(r)
+            tiered.run_until_done(max_steps=500)
+        finally:
+            tiered.close()
+        assert [list(r.output) for r in reqs2] == refs
+        assert tiered.stats["migrations"] >= 1
+        assert tiered.stats["migration_bytes"] > 0
+        # handoff journaled on both sides: prefill journal carries migr-kv
+        from paddle_tpu.inference.recovery import RequestJournal
+
+        recs = RequestJournal.load(tiered.replicas[0].journal_path)
+        assert any(r["k"] == "migr-kv" for r in recs)

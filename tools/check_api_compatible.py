@@ -23,6 +23,7 @@ def live_surfaces():
 
     jax.config.update("jax_platforms", "cpu")
     import paddle_tpu as paddle
+    from paddle_tpu.inference import procfleet as _procfleet
     from paddle_tpu.inference import serving as _serving
     from paddle_tpu.static import concurrency as _concurrency
     from paddle_tpu.static import cost as _cost
@@ -34,6 +35,7 @@ def live_surfaces():
         return sorted(n for n in dir(mod) if not n.startswith("_"))
 
     return {
+        "paddle.inference.procfleet": names(_procfleet),
         "paddle.inference.serving": names(_serving),
         "paddle.observability": names(paddle.observability),
         "paddle.static.concurrency": names(_concurrency),

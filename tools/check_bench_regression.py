@@ -108,6 +108,11 @@ SECONDARY = {
     "serving_shed_rate": ("higher", 0.5, 0.0),
     "fleet_tokens_per_sec": ("higher", 0.3, 0.0),
     "fleet_failover_time_s": ("lower", 1.0, 2.0),
+    # process-per-replica scale-out (inference/procfleet): 2 worker
+    # processes vs 1 on the same wave; wide tolerance — the ratio rides
+    # host-core availability (CPU weather), the guard only catches a
+    # collapse back toward serialized stepping
+    "fleet_proc_tokens_per_sec": ("higher", 0.5, 0.0),
     "serving_p50_time_to_first_token_ms": ("lower", 1.0, 50.0),
     "serving_p99_time_to_first_token_ms": ("lower", 1.0, 100.0),
     "observability_overhead_pct": ("lower", 1.0, 5.0),

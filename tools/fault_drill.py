@@ -1146,6 +1146,72 @@ def drill_fleet_replica_kill(recover: bool):
                   f"{len(reqs)} streams bit-identical (greedy + seeded)")
 
 
+def drill_fleet_proc_kill(recover: bool):
+    """One of two replica WORKER PROCESSES takes a real SIGKILL mid-decode
+    (the ``fleet.proc_kill`` site fires inside the driver-side proxy,
+    which kills the actual pid — inference/procfleet). Recovery = the
+    router reads the dead PROCESS's on-disk journal, re-admits its
+    unfinished requests on the surviving worker process and catches them
+    up to the delivered high-water marks — every stream byte-identical to
+    an uninterrupted run (PT-FLT-001 over the PT-PROC transport). Without
+    failover the dead process's in-flight requests are lost."""
+    from paddle_tpu.distributed.resilience import FaultPlan, FaultSpec
+    from paddle_tpu.inference.procfleet import (ProcFleetConfig,
+                                                ProcFleetRouter)
+    from paddle_tpu.inference.serving import Request
+
+    refs = _fleet_refs()
+    plan = FaultPlan(seed=5, specs=[
+        FaultSpec("fleet.proc_kill", "kill", at=2, count=1,
+                  match="replica:0:")])
+    # the worker factory rebuilds the drill's model in the child with the
+    # SAME seed (_serving_model seeds 11): byte-identity across processes
+    # needs bit-identical weights per replica
+    proc = ProcFleetConfig(
+        factory="paddle_tpu.inference.procfleet.presets:tiny_llama_engine",
+        factory_kwargs={"seed": 11}, env={"JAX_PLATFORMS": "cpu"})
+    with tempfile.TemporaryDirectory() as tmp:
+        fleet = ProcFleetRouter(proc, tmp, num_replicas=2,
+                                failover=recover)
+        pid0 = fleet.replicas[0].sup.worker_pid
+        reqs = [Request(**kw) for kw in _fleet_wave_kwargs()]
+        try:
+            with plan:
+                for r in reqs:
+                    fleet.submit(r)
+                fleet.run_until_done(max_steps=500)
+        finally:
+            fleet.close()
+    if not plan.log:
+        return False, "fleet.proc_kill never fired"
+    try:
+        os.kill(pid0, 0)
+        return False, f"worker pid {pid0} survived its SIGKILL"
+    except ProcessLookupError:
+        pass
+    if fleet.stats["replica_deaths"] != 1:
+        return False, (f"expected exactly one process death, saw "
+                       f"{fleet.stats['replica_deaths']}")
+    lost = [r.rid for r in reqs if r.failed or not r.done]
+    if not recover:
+        if not lost:
+            return True, "unexpected: process death lost nothing"
+        return False, (f"no failover: worker process 0 was SIGKILL'd and "
+                       f"lost {len(lost)} in-flight request(s) {lost}")
+    if lost:
+        return False, f"failover left request(s) {lost} failed/unfinished"
+    streams = [list(r.tokens) for r in reqs]
+    if streams != refs:
+        bad = [i for i, (s, f) in enumerate(zip(streams, refs)) if s != f]
+        return False, (f"failed-over stream(s) {bad} diverged from the "
+                       "uninterrupted run")
+    return True, (f"PT-PROC/PT-FLT-001: worker process {pid0} SIGKILL'd "
+                  f"mid-decode, {fleet.stats['failover_requests']} "
+                  "journaled request(s) re-admitted on the surviving "
+                  f"process in {fleet.stats['failover_s']:.2f}s, all "
+                  f"{len(reqs)} streams bit-identical (greedy + seeded)")
+
+
 def drill_fleet_drain(recover: bool):
     """Rolling restart of every replica under traffic (the ``fleet.drain``
     site drives the same path when planned). Recovery = graceful drain:
@@ -1285,6 +1351,7 @@ DRILLS = {
     "serving_stall": drill_serving_stall,
     "serving_overload_shed": drill_serving_overload_shed,
     "fleet_replica_kill": drill_fleet_replica_kill,
+    "fleet_proc_kill": drill_fleet_proc_kill,
     "fleet_drain": drill_fleet_drain,
     "fleet_overload": drill_fleet_overload,
     "kv_migration_corruption": drill_kv_migration_corruption,

@@ -79,6 +79,16 @@ THREAD_ROOTS = {
     # main path
     "paddle_tpu/distributed/communication/store.py": [
         "TCPStore.add", "TCPStore.get"],
+    # procfleet (docs/SERVING.md "Process fleet"): the proxy's heartbeat
+    # thread and the fleet's parallel_step replica threads both drive the
+    # wire helpers, and parallel_step threads enter the proxy through its
+    # public replica surface (step/submit/progress/load run concurrently
+    # with the driver reading finished()/metrics)
+    "paddle_tpu/inference/procfleet/wire.py": ["send_msg", "recv_msg"],
+    "paddle_tpu/inference/procfleet/proxy.py": [
+        "ProcReplica.step", "ProcReplica.submit", "ProcReplica.progress",
+        "ProcReplica.load", "ProcReplica.has_work", "ProcReplica.behind",
+        "ProcReplica.heartbeat_count"],
 }
 
 

@@ -67,6 +67,16 @@ REQUIRED_FAMILIES = (
     "pt_migration_pages_total",
     "pt_migration_failures_total",
     "pt_migration_time_ms",
+    # process-per-replica fleet transport (inference/procfleet — the
+    # procfleet_collector renders spawn/reap/heartbeat at zero on an
+    # in-process fleet, so the families are REQUIRED unconditionally;
+    # on a ProcFleetRouter it additionally fetches every live worker's
+    # own /metrics endpoint and merges its families under replica=i
+    # labels — docs/OBSERVABILITY.md remote-scrape topology)
+    "pt_procfleet_spawned_total",
+    "pt_procfleet_reaped_total",
+    "pt_procfleet_heartbeats_total",
+    "pt_procfleet_workers_alive",
 )
 
 #: the span chain a served request must produce, in order
@@ -131,8 +141,9 @@ def selftest() -> int:
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
     from paddle_tpu.observability import (MetricsRegistry, MetricsServer,
                                           TraceRecorder, fleet_collector,
-                                          guard_collector, retry_collector,
-                                          tracer_collector)
+                                          guard_collector,
+                                          procfleet_collector,
+                                          retry_collector, tracer_collector)
 
     paddle.seed(11)
     cfg = LlamaConfig.tiny(num_hidden_layers=1)
@@ -153,6 +164,7 @@ def selftest() -> int:
         fleet = FleetRouter(build, tmp, num_replicas=1, tracer=tracer,
                             config=FleetConfig(brownout_depth=10 ** 9))
         registry.register_collector(fleet_collector(fleet))
+        registry.register_collector(procfleet_collector(fleet))
         server = MetricsServer(registry, port=0)
         reqs = [Request(rng.integers(0, cfg.vocab_size, (8,))
                         .astype(np.int32), max_new_tokens=4, seed=100 + i)
