@@ -1114,6 +1114,129 @@ def bench_disagg(dev, on_tpu):
               "serving_kv_migration_time_s omitted", flush=True)
 
 
+def bench_speculative(dev, on_tpu):
+    """Speculative multi-token decoding + int8 paged-KV A/B (docs/
+    SERVING.md "Speculative decode" / "int8 KV cache"; ROADMAP item 2).
+    All three lines SECONDARY-guarded (tools/check_bench_regression.py):
+
+    - ``serving_spec_tokens_per_sec`` ("higher"): useful tok/s with the
+      speculative verify mega-step on, over a repetitive (drafter-
+      friendly) greedy wave; the spec-off twin runs the SAME wave and
+      prints as a comment — the A/B read. Streams are asserted
+      byte-identical before any timing is believed.
+    - ``serving_spec_acceptance_rate`` ("higher"): accepted / proposed
+      draft tokens over the timed waves.
+    - ``serving_int8_kv_slots_headroom`` ("higher"): pool blocks
+      affordable at EQUAL bytes when the pool is int8 (pages + scales)
+      instead of the parameter dtype — the slots / radix-reach multiplier
+      of the block format (~2x at bf16, ~4x at f32). Computed from the
+      live pools' actual array bytes, and the int8 engine runs the wave
+      to prove the format serves end to end.
+    """
+    import time as _t
+
+    from paddle_tpu.inference.serving import (ContinuousBatchingEngine,
+                                              PrefixCacheConfig, Request,
+                                              SpecConfig)
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            num_hidden_layers=12, num_attention_heads=16,
+            num_key_value_heads=16, max_position_embeddings=2048,
+            dtype="bfloat16")
+        slots, motif_len, reps, max_new, page, k = 8, 8, 8, 64, 16, 4
+    else:
+        cfg = LlamaConfig.tiny(num_hidden_layers=1)
+        slots, motif_len, reps, max_new, page, k = 4, 4, 6, 24, 8, 4
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.default_rng(0)
+    prompt_len = motif_len * reps
+    # repetitive prompts (shared motif per request): the self-speculative
+    # n-gram drafter's target workload — few-shot / template serving
+    prompts = [np.tile(rng.integers(0, cfg.vocab_size,
+                                    (motif_len,)).astype(np.int32), reps)
+               for _ in range(2 * slots)]
+    new_toks = [(i % 4 + 1) * max_new // 4 for i in range(len(prompts))]
+    useful = sum(new_toks)
+    max_len = prompt_len + max_new
+
+    def build(**kw):
+        return ContinuousBatchingEngine(
+            model, max_batch=slots, max_len=max_len, page_size=page,
+            block_size=4, fused=True,
+            prefix_cache=PrefixCacheConfig(extra_blocks=slots), **kw)
+
+    def run_wave(e):
+        reqs = [Request(p, max_new_tokens=n)
+                for p, n in zip(prompts, new_toks)]
+        for r in reqs:
+            e.add_request(r)
+        e.run_until_done(max_steps=40000)
+        return [list(r.tokens) for r in reqs]
+
+    def timed(fn, *a):
+        t0 = _t.perf_counter()
+        fn(*a)
+        return _t.perf_counter() - t0
+
+    base = build()
+    spec = build(speculative=SpecConfig(k=k))
+    ref_streams = run_wave(base)               # compile + prime radix
+    spec_streams = run_wave(spec)
+    if spec_streams != ref_streams:
+        print("# bench_speculative: SPEC STREAMS DIVERGED from the "
+              "non-speculative engine — timings withheld", flush=True)
+        return
+    p0, a0 = spec.stats["spec_proposed"], spec.stats["spec_accepted"]
+    dt_base = dt_spec = float("inf")
+    for _ in range(3):                         # best-of-3, interleaved
+        dt_base = min(dt_base, timed(run_wave, base))
+        dt_spec = min(dt_spec, timed(run_wave, spec))
+    proposed = spec.stats["spec_proposed"] - p0
+    accepted = spec.stats["spec_accepted"] - a0
+    acc_rate = accepted / max(1, proposed)
+    print(f"# speculative A/B: spec-off {useful / dt_base:.0f} useful "
+          f"tok/s vs spec-on {useful / dt_spec:.0f} (k={k}, "
+          f"{spec.stats['spec_steps']} verify dispatches, streams "
+          f"byte-identical)", flush=True)
+    _emit("serving_spec_tokens_per_sec", useful / dt_spec,
+          f"useful tok/s (speculative k={k} verify mega-step, {slots} "
+          f"slots, repetitive prompt {prompt_len}, max_new "
+          f"{max_new // 4}-{max_new}; spec-off twin on the same wave: "
+          f"{useful / dt_base:.0f} tok/s)",
+          (useful / dt_spec) / max(useful / dt_base, 1e-9))
+    _emit("serving_spec_acceptance_rate", acc_rate,
+          f"accepted/proposed draft tokens (timed waves: {accepted}/"
+          f"{proposed}, n-gram drafter over prompt+generated ids)", None)
+
+    # int8 arm: blocks affordable at equal bytes, from the live pools
+    i8 = build(kv_cache="int8")
+    i8_streams = run_wave(i8)                  # the format serves end to end
+    served = all(len(s) == n for s, n in zip(i8_streams, new_toks))
+    det = ("full wave served" if served
+           else "WAVE TRUNCATED — int8 serving path broken")
+
+    def pool_bytes(e):
+        total = 0
+        for kp, vp in e.caches["kv"]:
+            for side in (kp, vp):
+                data = getattr(side, "data", side)
+                total += data.size * data.dtype.itemsize
+                scale = getattr(side, "scale", None)
+                if scale is not None:
+                    total += scale.size * scale.dtype.itemsize
+        return total
+
+    blocks = i8._kv_quant_blocks or i8.caches["kv"][0][0].shape[0]
+    headroom = pool_bytes(base) / max(1, pool_bytes(i8))
+    _emit("serving_int8_kv_slots_headroom", headroom,
+          f"x pool blocks at equal bytes (int8 pages + per-block scales "
+          f"vs {cfg.dtype} pool, {blocks} blocks/layer-side; {det})",
+          None)
+
+
 def bench_unet(dev, on_tpu):
     """Stable-Diffusion-class UNet train step (BASELINE config #5: conv +
     cross-attention through the compiler path). One jitted
@@ -1386,6 +1509,11 @@ def main():
         bench_disagg(dev, on_tpu)
     except Exception as e:
         print(f"# disagg bench failed: {e!r}", flush=True)
+    gc.collect()
+    try:
+        bench_speculative(dev, on_tpu)
+    except Exception as e:
+        print(f"# speculative bench failed: {e!r}", flush=True)
     gc.collect()
     try:
         bench_unet(dev, on_tpu)

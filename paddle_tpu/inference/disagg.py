@@ -80,7 +80,8 @@ from typing import Callable, List, Optional, Set
 
 import numpy as np
 
-from ..ops.paged_attention import gather_chain_pages, scatter_chain_pages
+from ..ops.paged_attention import (gather_chain_pages, gather_chain_scales,
+                                   scatter_chain_pages)
 from .fleet import FleetRouter, ReplicaState, _Replica
 from .recovery import _admit_record, _request_from
 from .serving import ContinuousBatchingEngine, EngineSaturated, Request
@@ -150,6 +151,11 @@ class KVChainCodec:
         n_written = -(-n_cached // page)
         kv = engine.caches["kv"]
         pages = gather_chain_pages(kv, blocks[:n_written])
+        # int8 block format: the payload is the RAW int8 page bytes (crc
+        # covers them exactly as stored); the per-block dequant scales ride
+        # the header, integrity-protected by the chain digest like every
+        # other header field
+        scales = gather_chain_scales(kv, blocks[:n_written])
         kvh, _, hd = pages[0][0].shape[1:]
         dtype = np.asarray(pages[0][0]).dtype
         # serialize each side ONCE; the per-page crcs are computed over
@@ -174,6 +180,9 @@ class KVChainCodec:
                    page_size=page, layers=len(kv), kvh=int(kvh),
                    hd=int(hd), dtype=str(dtype), n_blocks=len(blocks),
                    n_written=n_written, page_crc=page_crc)
+        if scales is not None:
+            hdr["kv_scales"] = [[np.asarray(s, np.float32).tolist()
+                                 for s in pair] for pair in scales]
         # the chain digest covers the CANONICAL header (digest-excluded) +
         # every payload byte: a transit flip anywhere — a delivered token
         # id, the seed, a sampling knob, a page — is a PT-SRV-007
@@ -316,6 +325,20 @@ class KVChainCodec:
         if not engine._free_slots:
             raise EngineSaturated(
                 f"no free slot on splice target for rid={hdr['rid']}")
+        scales = None
+        if hdr["dtype"] == "int8":
+            # validated BEFORE any allocator state moves: a structurally
+            # damaged scale table refuses the splice with the engine
+            # untouched, like every other PT-SRV-007 path
+            raw = hdr.get("kv_scales")
+            if (not isinstance(raw, list) or len(raw) != hdr["layers"]
+                    or any(len(pair) != 2 for pair in raw)):
+                raise KVChainCorrupt(
+                    "PT-SRV-007: int8 chain without a per-layer "
+                    "kv_scales table — the block format needs its dequant "
+                    "scales to travel with the page bytes")
+            scales = [tuple(np.asarray(s, np.float32) for s in pair)
+                      for pair in raw]
         blocks = engine._alloc.alloc(hdr["n_blocks"],
                                      evict=engine._radix.evict_lru)
         if blocks is None:
@@ -326,7 +349,8 @@ class KVChainCodec:
         try:
             engine.caches = {
                 "kv": scatter_chain_pages(kv, blocks[:hdr["n_written"]],
-                                          self._unpack(hdr, payload)),
+                                          self._unpack(hdr, payload),
+                                          scales=scales),
                 "tables": engine.caches["tables"]}
             if req is None:
                 req = _request_from(hdr)

@@ -59,6 +59,22 @@ are gone. Token streams are byte-identical to the legacy per-slot path
 per-row values are independent of batch width in fp32 (the warm==cold
 argument; tests/test_serving_fused.py pins fused-vs-legacy equality).
 
+**Speculative multi-token decoding** (``speculative=SpecConfig(...)``,
+fused mode — docs/SERVING.md "Speculative decode"): each decode dispatch
+emits 1..K+1 tokens per row — a device-resident n-gram drafter proposes K
+tokens from a per-slot history ring, one K+1-wide ``paged_verify_step``
+scores every position (``ops.paged_verify_attention`` append-then-gather),
+and in-graph greedy exact-match acceptance keeps the longest correct
+prefix plus one bonus token. Greedy output is byte-identical to the
+non-speculative mega-step; sampling blocks keep the legacy path.
+
+**int8 KV block format** (``kv_cache=KVCacheConfig(dtype="int8")`` —
+docs/SERVING.md "int8 KV cache"): pools become
+``ops.paged_attention.QuantizedKVPool`` — int8 pages with per-(page, head)
+absmax scales, quantize-on-append / dequantize-in-gather — halving (bf16)
+to quartering (f32) pool bytes, and composing with COW, the radix prefix
+cache and ``KVChainCodec`` migration (PTKV1 carries dtype + scales).
+
 ``prefix_cache=PrefixCacheConfig(...)`` switches admission to a radix
 prefix cache over a refcounted block pool with chunked prefill
 (docs/SERVING.md): prompts sharing a system-prompt/few-shot prefix map the
@@ -99,10 +115,10 @@ from ..ops.paged_attention import BlockAllocator, RadixPrefixCache
 
 __all__ = ["AutoscaleConfig", "BlockAllocator", "BrownoutConfig",
            "ContinuousBatchingEngine", "EngineSaturated", "FleetConfig",
-           "FleetRouter", "KVChainCodec", "KVChainCorrupt",
+           "FleetRouter", "KVCacheConfig", "KVChainCodec", "KVChainCorrupt",
            "PrefixCacheConfig", "RadixPrefixCache", "ReplicaState",
            "Request", "RequestJournal", "RequestShed", "SLOAutoscaler",
-           "ServingSupervisor", "StepWatchdog", "TieredRouter"]
+           "ServingSupervisor", "SpecConfig", "StepWatchdog", "TieredRouter"]
 
 
 def __getattr__(name):
@@ -173,6 +189,130 @@ class PrefixCacheConfig:
     prefill_chunk: Optional[int] = None
     extra_blocks: int = 0
     pack_rows: Optional[int] = None
+
+
+@dataclasses.dataclass
+class SpecConfig:
+    """Knobs for speculative multi-token decoding inside the fused
+    mega-step (``ContinuousBatchingEngine(speculative=...)`` —
+    docs/SERVING.md "Speculative decode").
+
+    - ``k``: draft tokens proposed (and verified) per dispatch — each spec
+      dispatch can emit 1..k+1 tokens per row (accepted prefix + one bonus
+      from the verify logits).
+    - ``ngram``: match length of the device-resident prompt-lookup
+      drafter — the row's last ``ngram`` tokens are searched in its
+      history ring; the continuation after the most recent match becomes
+      the draft.
+    - ``history``: per-slot device ring-buffer length (tokens) the drafter
+      searches — generated + prompt ids, seeded from the prompt at
+      activation.
+    - ``_unsafe_accept_all``: DRILL-ONLY (tools/fault_drill.py
+      ``spec_decode_divergence`` control arm): skip the argmax
+      verification and trust every draft — demonstrates the silent greedy
+      divergence the in-graph verify exists to prevent. Never enable.
+
+    Greedy (temperature==0) output is byte-identical to the
+    non-speculative mega-step — drafts only change how many tokens a
+    dispatch emits, never which tokens. Blocks containing sampling rows
+    (temperature>0) keep the legacy sampled mega-step.
+
+    Composition with ``KVCacheConfig(dtype="int8")``: rejected drafts'
+    appends feed the int8 blocks' monotone absmax scales, so a spec+int8
+    engine's streams may differ from a NON-spec int8 engine's in the last
+    quantization bit (int8 is lossy either way). What still holds — and
+    is pinned by tests — is full determinism: identical spec+int8
+    engines, warm/cold re-admissions and crash replay reproduce the same
+    bytes (drafts are a deterministic function of the stream, so so is
+    the rejected-append garbage)."""
+
+    k: int = 4
+    ngram: int = 2
+    history: int = 64
+    _unsafe_accept_all: bool = False
+
+
+@dataclasses.dataclass
+class KVCacheConfig:
+    """Paged-KV pool storage format
+    (``ContinuousBatchingEngine(kv_cache=...)`` — docs/SERVING.md "int8 KV
+    cache"). ``dtype="int8"`` switches every pool to the int8 block
+    format (``ops.paged_attention.QuantizedKVPool``): int8 pages with
+    per-(page, head) absmax scales, quantize-on-append /
+    dequantize-in-gather — pool bytes drop ~itemsize-fold (bf16 halves),
+    doubling effective slots and radix prefix-cache reach at equal memory.
+    Composes with COW (scales copy with the page), the radix prefix cache,
+    and ``KVChainCodec`` migration (the PTKV1 artifact carries dtype +
+    scales, crc over the int8 bytes)."""
+
+    dtype: Optional[str] = None
+
+    def __post_init__(self):
+        if self.dtype not in (None, "param", "int8"):
+            raise ValueError(f"unsupported KV cache dtype {self.dtype!r} "
+                             "(supported: None/'param', 'int8')")
+
+
+def ngram_draft(hist, hlen, last_tok, k: int, n: int):
+    """Device-resident prompt-lookup drafter (no draft model, no host
+    sync): propose ``k`` draft tokens per row from its history ring.
+
+    ``hist`` [B, H] int32 ring buffer of emitted tokens (token with global
+    index g lives at slot g % H), ``hlen`` [B] tokens written so far,
+    ``last_tok`` [B] the newest token (not yet in the ring — it enters on
+    the next spec step, so the effective sequence is
+    ``hist-window ++ last_tok``). The row's last ``n`` tokens are matched
+    against every earlier window; the ``k`` tokens following the MOST
+    RECENT match become the draft. No match (or under ``n`` tokens of
+    history) falls back to repeating ``last_tok`` — drafts never affect
+    WHICH tokens are emitted (greedy verify is exact), only how many per
+    dispatch, so the fallback costs acceptance, never correctness."""
+    H = hist.shape[1]
+    g = hlen[:, None] - H + jnp.arange(H)[None, :]      # global idx per slot
+    lin = jnp.take_along_axis(hist, jnp.mod(g, H), axis=1)
+    lin = jnp.concatenate([lin, last_tok[:, None]], axis=1)     # [B, H+1]
+    L = H + 1
+    tail = lin[:, L - n:]                               # the current n-gram
+    J = L - n                                           # candidate starts
+    win_idx = jnp.arange(J)[:, None] + jnp.arange(n)[None, :]
+    wins = lin[:, win_idx]                              # [B, J, n]
+    match = jnp.all(wins == tail[:, None, :], axis=-1)  # [B, J]
+    valid = (g >= 0)[:, :J]          # J = L - n <= H: start-slot validity
+    jv = jnp.where(match & valid, jnp.arange(J)[None, :], -1)
+    jbest = jnp.max(jv, axis=1)
+    has = (jbest >= 0) & (hlen >= n)
+    cont = jnp.clip(jbest[:, None] + n + jnp.arange(k)[None, :], 0, L - 1)
+    drafts = jnp.take_along_axis(lin, cont, axis=1)
+    return jnp.where(has[:, None], drafts,
+                     last_tok[:, None]).astype(jnp.int32)
+
+
+def spec_accept(drafts, targets, caps):
+    """Pure accept/reject math of greedy speculative decoding (in-graph;
+    host-testable without a model — tests/test_serving_spec.py).
+
+    ``drafts`` [B, K] proposed tokens, ``targets`` [B, K+1] the greedy
+    (argmax) token per verify-window position — ``targets[:, i]`` is what
+    the model emits AFTER window position i, so draft i is correct iff
+    ``drafts[:, i] == targets[:, i]`` and every earlier draft was.
+    ``caps`` [B] >= 0 bounds per-row emission (max_new / max_len budget;
+    0 masks a row out entirely).
+
+    Returns ``(out [B, K+1], emit [B], n_acc [B])``: the emitted tokens
+    are ``out[:, :emit]`` — the accepted draft prefix plus ONE bonus token
+    (the model's own next token after the last accepted position), which
+    is exactly the non-speculative greedy stream."""
+    B, K = drafts.shape
+    match = drafts == targets[:, :K]
+    acc = jnp.cumprod(match.astype(jnp.int32), axis=1)
+    n_acc = jnp.sum(acc, axis=1)                        # [B] 0..K
+    emit = jnp.minimum(n_acc + 1, jnp.maximum(caps, 0))
+    bonus = jnp.take_along_axis(targets, n_acc[:, None], axis=1)
+    padded = jnp.concatenate([drafts, bonus], axis=1)   # [B, K+1]
+    out = jnp.where(jnp.arange(K + 1)[None, :] < n_acc[:, None],
+                    padded, bonus)
+    return (out.astype(jnp.int32), emit.astype(jnp.int32),
+            n_acc.astype(jnp.int32))
 
 
 @dataclasses.dataclass
@@ -307,6 +447,14 @@ class ContinuousBatchingEngine:
                         "ints", "floats")
     _FIRST_CARRIES = ("kv",)
     _FIRST_DONATE_ARGNUMS = (2,)
+    # speculative verify mega-step (docs/SERVING.md "Speculative decode"):
+    # kv pools, positions and the drafter's history ring/length are all
+    # step-to-step carries; tables/act/caps are read-only inputs the host
+    # keeps live across the call and must stay undonated.
+    _SPEC_ARG_NAMES = ("params", "toks", "kv", "tables", "pos", "act",
+                       "hist", "hlen", "caps")
+    _SPEC_CARRIES = ("kv", "pos", "hist", "hlen")
+    _SPEC_DONATE_ARGNUMS = (2, 4, 6, 7)
 
     def __init__(self, model, max_batch: int = 8, max_len: int = 512,
                  page_size: int = 64, block_size: int = 8,
@@ -317,6 +465,8 @@ class ContinuousBatchingEngine:
                  shed_infeasible: bool = True,
                  brownout: Union[bool, BrownoutConfig, None] = None,
                  fused: Optional[bool] = None,
+                 speculative: Union[bool, SpecConfig, None] = None,
+                 kv_cache: Union[str, KVCacheConfig, None] = None,
                  tracer=None, trace_tags: Optional[Dict] = None,
                  donate_carry: bool = True,
                  _unsafe_overcommit: bool = False):
@@ -376,6 +526,37 @@ class ContinuousBatchingEngine:
         # decode program over all rows. Auto-enabled at big batch, where
         # per-step table uploads and O(max_batch) host scans dominate.
         self._fused = (max_batch >= 32) if fused is None else bool(fused)
+        # speculative multi-token decoding (docs/SERVING.md "Speculative
+        # decode"): a device-resident n-gram drafter + one K-wide verify
+        # program per dispatch, greedy-exact. Fused-mode only — the spec
+        # program IS a mega-step variant over the device-resident state.
+        if speculative is True:
+            speculative = SpecConfig()
+        elif not speculative:
+            speculative = None
+        self._spec = speculative
+        if self._spec is not None:
+            if not self._fused:
+                raise ValueError(
+                    "speculative decoding needs the fused mega-step "
+                    "(fused=True) — the drafter/verify state is "
+                    "device-resident")
+            if self._spec.k < 1 or self._spec.ngram < 1:
+                raise ValueError("SpecConfig.k and .ngram must be >= 1")
+            if self._spec.history < self._spec.ngram + self._spec.k:
+                raise ValueError(
+                    f"SpecConfig.history {self._spec.history} too short for "
+                    f"ngram {self._spec.ngram} + k {self._spec.k}")
+        # opt-in int8 paged-KV block format (docs/SERVING.md "int8 KV
+        # cache"): pools become QuantizedKVPool (int8 pages + per-block
+        # absmax scales) — every engine program and the migration codec
+        # handle the format transparently.
+        if isinstance(kv_cache, str):
+            kv_cache = KVCacheConfig(dtype=kv_cache)
+        elif kv_cache is None:
+            kv_cache = KVCacheConfig()
+        self.kv_cache = kv_cache
+        self._kv_dtype = kv_cache.dtype if kv_cache.dtype == "int8" else None
         # DRILL-ONLY knob (tools/fault_drill.py prefix_cache_exhaustion):
         # allocate past pool capacity by ripping blocks out of the radix
         # cache while live tables still map them — demonstrates the
@@ -390,7 +571,8 @@ class ContinuousBatchingEngine:
             # write their dummy token into a dedicated parking page, never
             # into a block another request may share
             self.caches = model._init_paged_caches(
-                max_batch, max_len, page_size, num_blocks=n_blocks + 1)
+                max_batch, max_len, page_size, num_blocks=n_blocks + 1,
+                kv_dtype=self._kv_dtype)
             self._park = n_blocks
             self._alloc = BlockAllocator(n_blocks)
             self._radix = RadixPrefixCache(page_size, self._alloc)
@@ -409,7 +591,8 @@ class ContinuousBatchingEngine:
                                else max(1, int(prefix_cache.pack_rows)))
         else:
             self.caches = model._init_paged_caches(max_batch, max_len,
-                                                   page_size)
+                                                   page_size,
+                                                   kv_dtype=self._kv_dtype)
         self._slots: List[Optional[Request]] = [None] * max_batch
         # O(active) bookkeeping (big-batch refactor): occupied slots in a
         # dict, free slots in a deque — per-step work is bounded by what is
@@ -451,6 +634,14 @@ class ContinuousBatchingEngine:
             self._upd_width = min(max_batch, 32)
             self._jit_mega = None
             self._jit_apply = None
+            if self._spec is not None:
+                # drafter state: per-slot history ring + written count —
+                # device-resident like pos/act, mutated only by the spec
+                # program and the activation scatters (_flush_updates)
+                self._dev_hist = jnp.zeros(
+                    (max_batch, self._spec.history), jnp.int32)
+                self._dev_hlen = jnp.zeros(max_batch, jnp.int32)
+                self._jit_spec = None
             if self.prefix_cache is not None:
                 # the device table starts all-parked (the legacy path
                 # builds this lazily via the dirty-flag upload; the fused
@@ -481,7 +672,19 @@ class ContinuousBatchingEngine:
         self.stats = {"admit_host_s": 0.0, "decode_host_s": 0.0,
                       "compile_cache_entries": 0, "shed": 0,
                       "retry_attempts": 0, "retry_giveups": 0,
-                      "fused_updates": 0}
+                      "fused_updates": 0,
+                      # speculative decode counters (zero when spec off) —
+                      # exported as pt_spec_proposed/accepted_total + the
+                      # acceptance-rate gauge by the engine collector
+                      "spec_proposed": 0, "spec_accepted": 0,
+                      "spec_steps": 0}
+        # int8 block-format occupancy gauge (pt_kv_quant_blocks): pool
+        # pages held in quantized form — 0 on fp engines
+        self._kv_quant_blocks = (int(self.caches["kv"][0][0].shape[0])
+                                 if self._kv_dtype == "int8" else 0)
+        # int8 allocation hygiene (_reset_quant_blocks): one compiled
+        # reset-scatter per power-of-two width
+        self._jit_qreset: Dict[int, object] = {}
         if self.prefix_cache is not None:
             self.stats.update(hit_tokens=0, miss_tokens=0, cow_copies=0,
                               evictions=0, prefill_host_s=0.0,
@@ -782,6 +985,16 @@ class ContinuousBatchingEngine:
                         and i in self._prefill_next)]
         if not live:
             return
+        if (self._spec is not None
+                and not any(r.temperature > 0.0 for _, r in live)
+                and all(self.max_len - int(self._pos[i]) >= self._spec.k
+                        for i, _ in live)):
+            # all-greedy block with verify-window headroom on every row
+            # (the K+1 window writes k/v at positions pos-1 .. pos-1+K):
+            # one speculative dispatch replaces the scan block. Sampling
+            # rows keep the legacy sampled mega-step; rows at the max_len
+            # boundary finish on ordinary blocks.
+            return self._decode_spec_block(live)
         # block length: never decode past a request's max_new_tokens or the
         # engine max_len (pages beyond the table would clamp-corrupt)
         cap = min(min(r.max_new_tokens - r._n_out for _, r in live),
@@ -883,9 +1096,10 @@ class ContinuousBatchingEngine:
             if self.tracer is not None:
                 # ONE lock acquisition for the whole block's stamps — the
                 # PR 9 recorder RLock must not serialize a 256-row step
-                self.tracer.decode_block_batch(t0_tr, n, len(live),
-                                               tok_marks, t1=t1_tr,
-                                               tags=self.trace_tags)
+                self.tracer.decode_block_batch(
+                    t0_tr, n, len(live), tok_marks, t1=t1_tr,
+                    tags=self.trace_tags,
+                    tokens=sum(e[2] for e in entries))
             self._pending.append((out, entries))
             return
         # eos path: materialize (in generation order — drain older pendings
@@ -893,6 +1107,7 @@ class ContinuousBatchingEngine:
         self._drain_pending()
         out = np.asarray(out)
         tok_marks = [] if self.tracer is not None else None
+        block_tokens = 0
         for i, req in live:
             took = 0
             for j in range(n):
@@ -906,6 +1121,7 @@ class ContinuousBatchingEngine:
                     break
             self._pos[i] += took
             self._sched_tokens += took
+            block_tokens += took
             if tok_marks is not None:
                 tok_marks.append((req.rid, req._n_out))
             if req.done:
@@ -913,7 +1129,8 @@ class ContinuousBatchingEngine:
                 self._release_slot(i)       # slot + its pages are free again
         if self.tracer is not None:
             self.tracer.decode_block_batch(t0_tr, n, len(live), tok_marks,
-                                           t1=t1_tr, tags=self.trace_tags)
+                                           t1=t1_tr, tags=self.trace_tags,
+                                           tokens=block_tokens)
 
     def run_until_done(self, max_steps: int = 100000):
         steps = 0
@@ -1028,6 +1245,13 @@ class ContinuousBatchingEngine:
                 f"no free slot for migrated rid={req.rid} "
                 f"({len(self._occupied)}/{self.max_batch} busy)")
         slot = self._free_slots.popleft()
+        # int8 block hygiene: the chain's WRITTEN prefix was scattered
+        # wholesale (bytes + scales) by the codec; the tail blocks the
+        # chain will decode into are recycled allocations and need their
+        # stale scales cleared
+        if self._kv_dtype == "int8":
+            n_written = max(0, -(-(int(pos) - 1) // self.page_size))
+            self._reset_quant_blocks(list(blocks)[n_written:])
         row = np.full(self._maxp, self._park, np.int32)
         row[: len(blocks)] = blocks
         self._slot_rows[slot] = row
@@ -1051,8 +1275,14 @@ class ContinuousBatchingEngine:
         self._last_tok = self._last_tok.at[slot].set(
             jnp.int32(int(last_tok)))
         if self._fused:
+            # spec engines re-seed the drafter ring with prompt + delivered
+            # tokens (minus the last-token carry restored above) so the
+            # migrated stream drafts from its full history
             self._queue_update(slot, row, int(pos), True, req.seed,
-                               req.temperature, req.top_p, req.top_k)
+                               req.temperature, req.top_p, req.top_k,
+                               hist=(self._spec_seed(req.prompt,
+                                                     extra=req.output[:-1])
+                                     if self._spec is not None else None))
         else:
             self._tables_host[slot] = row
             self._tables_dirty = True
@@ -1118,16 +1348,18 @@ class ContinuousBatchingEngine:
     # -- fused mega-step machinery (module docstring / docs/SERVING.md) ----
     def _queue_update(self, slot: int, row, pos: int, act: bool,
                       seed: int = 0, temp: float = 0.0, top_p: float = 1.0,
-                      top_k: int = 0):
+                      top_k: int = 0, hist=None):
         """Queue one slot's device-state change (activation or release).
         The LATEST update per slot wins — a release followed by a re-admit
         of the same slot in one step collapses to the admit — and
         everything queued lands as ONE traced scatter program at the next
         decode dispatch. ``row=None`` means the parking row (release) or
-        an unchanged static table (legacy-layout engines)."""
+        an unchanged static table (legacy-layout engines). ``hist`` (spec
+        engines) is the slot's drafter seed ``(ring_row, hlen)`` — None
+        resets the ring (release / non-spec engines ignore it)."""
         self._upd[slot] = (None if row is None else np.asarray(row, np.int32),
                            int(pos), bool(act), int(seed), float(temp),
-                           float(top_p), int(top_k))
+                           float(top_p), int(top_k), hist)
 
     def _flush_updates(self):
         """Apply queued slot updates to the device-resident step state in
@@ -1138,42 +1370,53 @@ class ContinuousBatchingEngine:
             return
         items = list(self._upd.items())
         self._upd.clear()
+        with_spec = self._spec is not None
         if self._jit_apply is None:
             with_tables = self.prefix_cache is not None
 
-            def apply(tables, pos, act, seeds, temps, tops, topks, idx,
-                      urows, upos, uact, useeds, utemps, utops, utopks):
+            def apply(tables, pos, act, seeds, temps, tops, topks, hist,
+                      hlen, idx, urows, upos, uact, useeds, utemps, utops,
+                      utopks, uhist, uhlen):
                 if with_tables:
                     tables = tables.at[idx].set(urows)
+                if with_spec:
+                    hist = hist.at[idx].set(uhist)
+                    hlen = hlen.at[idx].set(uhlen)
                 return (tables, pos.at[idx].set(upos),
                         act.at[idx].set(uact), seeds.at[idx].set(useeds),
                         temps.at[idx].set(utemps), tops.at[idx].set(utops),
-                        topks.at[idx].set(utopks))
+                        topks.at[idx].set(utopks), hist, hlen)
 
             self._jit_apply = jax.jit(apply)
             self._note_compiled()
         W = self._upd_width
         with_tables = self.prefix_cache is not None
+        H = self._spec.history if with_spec else 1
         for lo in range(0, len(items), W):
             batch = items[lo:lo + W]
             idx = np.full(W, self.max_batch, np.int32)
             # legacy-layout engines have static slot-owned tables: the
             # apply program ignores urows, so don't build/upload the
             # [W, maxp] buffer at all (a 1-element dummy keeps the
-            # signature)
+            # signature); same for the drafter ring on non-spec engines
             urows = (np.full((W, self._maxp), self._park, np.int32)
                      if with_tables else np.zeros((1, 1), np.int32))
+            uhist = (np.zeros((W, H), np.int32) if with_spec
+                     else np.zeros((1, 1), np.int32))
+            uhlen = np.zeros(W if with_spec else 1, np.int32)
             upos = np.zeros(W, np.int32)
             uact = np.zeros(W, bool)
             useeds = np.zeros(W, np.int32)
             utemps = np.zeros(W, np.float32)
             utops = np.ones(W, np.float32)
             utopks = np.zeros(W, np.int32)
-            for j, (slot, (row, pos, act, seed, temp, top_p, top_k)) in \
-                    enumerate(batch):
+            for j, (slot, (row, pos, act, seed, temp, top_p, top_k,
+                           hist_seed)) in enumerate(batch):
                 idx[j] = slot
                 if with_tables and row is not None:
                     urows[j] = row
+                if with_spec and hist_seed is not None:
+                    uhist[j], uhlen[j] = hist_seed
                 upos[j] = pos
                 uact[j] = act
                 useeds[j] = seed
@@ -1181,12 +1424,18 @@ class ContinuousBatchingEngine:
                 utops[j] = top_p
                 utopks[j] = top_k
             seeds_d, temps_d, tops_d, topks_d = self._dev_samp
-            tables, self._dev_pos, self._dev_act, s, t, p, k = \
-                self._jit_apply(self.caches["tables"], self._dev_pos,
-                                self._dev_act, seeds_d, temps_d, tops_d,
-                                topks_d, idx, urows, upos, uact, useeds,
-                                utemps, utops, utopks)
+            hist_d = self._dev_hist if with_spec else jnp.zeros((1, 1),
+                                                                jnp.int32)
+            hlen_d = self._dev_hlen if with_spec else jnp.zeros(1, jnp.int32)
+            tables, self._dev_pos, self._dev_act, s, t, p, k, hist_d, \
+                hlen_d = self._jit_apply(
+                    self.caches["tables"], self._dev_pos, self._dev_act,
+                    seeds_d, temps_d, tops_d, topks_d, hist_d, hlen_d, idx,
+                    urows, upos, uact, useeds, utemps, utops, utopks,
+                    uhist, uhlen)
             self._dev_samp = (s, t, p, k)
+            if with_spec:
+                self._dev_hist, self._dev_hlen = hist_d, hlen_d
             self.caches = {"kv": self.caches["kv"], "tables": tables}
             self.stats["fused_updates"] += len(batch)
 
@@ -1236,6 +1485,219 @@ class ContinuousBatchingEngine:
 
         return run
 
+    # -- speculative multi-token decoding (docs/SERVING.md) ----------------
+    def _build_spec_jit(self):
+        """The jitted speculative verify mega-step EXACTLY as dispatched —
+        donation included (kv / pos / drafter ring+length are the carries;
+        tools/audit_program_cost.py traces this, PT-COST-003 audits the
+        ``donated_invars``)."""
+        donate = self._SPEC_DONATE_ARGNUMS if self._donate_carry else ()
+        return jax.jit(self._spec_step_fn(), donate_argnums=donate)
+
+    def _spec_step_fn(self):
+        """ONE speculative dispatch over all rows (draft -> verify ->
+        accept/rollback, all in-graph):
+
+        1. DRAFT: the device-resident prompt-lookup drafter
+           (:func:`ngram_draft`) proposes K tokens per row from its
+           history ring — no draft model, no host sync.
+        2. VERIFY: the K+1 window [last_token, drafts] runs through the
+           model's ``paged_verify_step`` (append-then-gather +
+           absolute-position masking — ``ops.paged_verify_attention``),
+           scoring every position in one pass.
+        3. ACCEPT: greedy exact-match accept/reject
+           (:func:`spec_accept`) keeps the longest draft prefix whose
+           tokens equal the verify argmaxes, plus ONE bonus token — the
+           emitted stream is byte-identical to the non-speculative
+           mega-step. Rejected appends need no scatter rollback: the
+           per-row position only advances over accepted tokens, so
+           rejected k/v sits beyond the attended window and is
+           overwritten as decode proceeds (the engine's standard
+           pad-append invariant). Inactive rows are masked (emit 0) by
+           the same act-vector idiom as the mega-step — churn never
+           retraces."""
+        from ..core import autograd_engine
+        from ..jit.api import _Swap
+
+        spec = self._spec
+        K, N, H = spec.k, spec.ngram, spec.history
+        accept_all = spec._unsafe_accept_all
+
+        def run(params, toks, kv, tables, pos, act, hist, hlen, caps):
+            pos_vec = jnp.where(act, pos, 1) - 1
+            drafts = ngram_draft(hist, hlen, toks, K, N)
+            window = jnp.concatenate([toks[:, None], drafts], axis=1)
+            caches = {"kv": kv, "tables": tables}
+            with autograd_engine.no_grad(), _Swap(self._tensors, params):
+                logits, caches = self.model.paged_verify_step(
+                    window, caches, pos_vec)
+            targets = jnp.argmax(logits, -1).astype(jnp.int32)
+            if accept_all:
+                # DRILL-ONLY control arm (spec_decode_divergence): trust
+                # every draft — the verification this path skips is what
+                # keeps greedy streams byte-identical
+                targets = jnp.concatenate([drafts, targets[:, K:]], axis=1)
+            out, emit, _ = spec_accept(drafts, targets,
+                                       jnp.where(act, caps, 0))
+            emit = jnp.where(act, emit, 0)
+            last = jnp.take_along_axis(
+                out, jnp.clip(emit - 1, 0, K)[:, None], axis=1)[:, 0]
+            last = jnp.where(emit > 0, last, toks)
+            # ring append: the OLD last token plus all emitted-but-newest
+            # tokens enter the ring; the newest rides the last-token carry
+            vals = jnp.concatenate([toks[:, None], out[:, :K]], axis=1)
+            j = jnp.arange(K + 1)[None, :]
+            widx = jnp.where(j < emit[:, None],
+                             (hlen[:, None] + j) % H, H)   # H: dropped
+            hist = hist.at[jnp.arange(hist.shape[0])[:, None],
+                           widx].set(vals)
+            hlen = hlen + emit
+            new_pos = jnp.where(act, pos + emit, pos)
+            return out, emit, last, caches["kv"], new_pos, hist, hlen
+
+        return run
+
+    def _decode_spec_block(self, live):
+        """Dispatch one speculative verify step and book its variable
+        per-row emission. Unlike the deterministic-schedule scan path,
+        acceptance is data-dependent — the per-row emit counts (a [B]
+        int32 vector) are read back synchronously per dispatch; the token
+        matrix itself stays a deferred readback (``_drain_pending``)
+        unless an eos-carrying row needs the values."""
+        spec = self._spec
+        K = spec.k
+        caps = np.zeros(self.max_batch, np.int32)
+        for i, r in live:
+            caps[i] = min(r.max_new_tokens - r._n_out,
+                          self.max_len - int(self._pos[i]))
+        t0_tr = None if self.tracer is None else self.tracer.now()
+        if self._jit_spec is None:
+            self._jit_spec = self._build_spec_jit()
+            self._note_compiled()
+        (out_dev, emit_dev, self._last_tok, new_kv, self._dev_pos,
+         self._dev_hist, self._dev_hlen) = self._jit_spec(
+            self._params, self._last_tok, self.caches["kv"],
+            self.caches["tables"], self._dev_pos, self._dev_act,
+            self._dev_hist, self._dev_hlen, jnp.asarray(caps))
+        self.caches = {"kv": new_kv, "tables": self.caches["tables"]}
+        emit = np.asarray(emit_dev)         # the one sync read ([B] int32)
+        # proposal counter derives from the already-synced emit vector —
+        # never a second device readback per dispatch (a remote runtime
+        # charges a full round trip each); the ACCEPTED counter is
+        # credited per row below from the post-eos/cap delivered count, so
+        # acceptance telemetry tracks delivered-token truth
+        self.stats["spec_proposed"] += K * len(live)
+        self.stats["spec_steps"] += 1
+        t1_tr = None if self.tracer is None else self.tracer.now()
+        any_eos = any(r.eos_token_id is not None for _, r in live)
+        out = None
+        if any_eos:
+            # materialize in generation order (drain older pendings first)
+            self._drain_pending()
+            out = np.asarray(out_dev)
+        entries = []
+        tok_marks = [] if self.tracer is not None else None
+        total = 0
+        for i, req in live:
+            took = int(emit[i])
+            if out is not None:
+                used = 0
+                for jj in range(took):
+                    tok = int(out[i, jj])
+                    req.output.append(tok)
+                    req._n_out += 1
+                    used = jj + 1
+                    if (req.eos_token_id is not None
+                            and tok == req.eos_token_id):
+                        req.done = True
+                        break
+                took = used
+            else:
+                entries.append((i, req, took))
+                req._n_out += took
+            # accepted drafts among DELIVERED tokens (eos/cap truncation
+            # included): every delivered token past the first of a
+            # dispatch is an accepted draft
+            self.stats["spec_accepted"] += max(0, took - 1)
+            self._pos[i] += took
+            self._sched_tokens += took
+            total += took
+            if tok_marks is not None:
+                tok_marks.append((req.rid, req._n_out))
+            if req._n_out >= req.max_new_tokens:
+                req.done = True
+            if req.done:
+                self._mark_done(req)
+                self._release_slot(i)
+        if self.tracer is not None:
+            # tokens-per-step rides the block span: at K>1 a dispatch
+            # emits a variable token count, and the SLO inter-token math
+            # must see real progress, not dispatch counts
+            self.tracer.decode_block_batch(t0_tr, K + 1, len(live),
+                                           tok_marks, t1=t1_tr,
+                                           tags=self.trace_tags,
+                                           tokens=total)
+        if entries:
+            self._pending.append((out_dev, entries))
+
+    def _reset_quant_blocks(self, blocks):
+        """int8 allocation hygiene: zero the page bytes AND the per-block
+        absmax scales of freshly-allocated blocks. A recycled page keeps
+        its previous occupant's scale, and quantize-on-append grows scales
+        monotonically (scatter-max) — without this reset a new request's
+        first tokens would quantize under the STALE (possibly much larger)
+        scale, so a warm re-admission through recycled pages would emit
+        different bytes than its cold run: the warm==cold guarantee would
+        silently narrow to never-recycled pools. Eager control-plane
+        dispatch (once per admission, never on the decode hot path),
+        padded to power-of-two widths with an out-of-range index jax
+        drops — compiled programs stay O(log pool)."""
+        if self._kv_dtype != "int8" or not len(blocks):
+            return
+        from ..ops.paged_attention import QuantizedKVPool
+
+        W = 1
+        while W < len(blocks):
+            W *= 2
+        fn = self._jit_qreset.get(W)
+        if fn is None:
+            def run(kv, idx):
+                out = []
+                for k, v in kv:
+                    out.append((
+                        QuantizedKVPool(k.data.at[idx].set(0),
+                                        k.scale.at[idx].set(0.0)),
+                        QuantizedKVPool(v.data.at[idx].set(0),
+                                        v.scale.at[idx].set(0.0))))
+                return out
+
+            fn = self._jit_qreset[W] = jax.jit(run)
+            self._note_compiled()
+        npages = int(self.caches["kv"][0][0].shape[0])
+        idx = np.full(W, npages, np.int32)     # pad: out of range, dropped
+        idx[:len(blocks)] = blocks
+        self.caches = {"kv": fn(self.caches["kv"], jnp.asarray(idx)),
+                       "tables": self.caches["tables"]}
+
+    def _spec_seed(self, prompt, extra=()):
+        """Drafter seed for a slot activation: the last ``history`` tokens
+        of prompt (+ already-delivered tokens on migration), laid out in
+        ring order — token with global index g at slot g % H — so the spec
+        program's ring arithmetic continues seamlessly. The CURRENT last
+        token stays out (it rides the device last-token carry and enters
+        the ring on the next spec step)."""
+        H = self._spec.history
+        toks = np.asarray(prompt, np.int32).reshape(-1)
+        if len(extra):
+            toks = np.concatenate(
+                [toks, np.asarray(extra, np.int32).reshape(-1)])
+        hlen = len(toks)
+        row = np.zeros(H, np.int32)
+        tail = toks[max(0, hlen - H):]
+        if len(tail):
+            row[np.arange(hlen - len(tail), hlen) % H] = tail
+        return row, hlen
+
     def _cow_copy_batch(self, pairs):
         """All of an admission wave's COW copies in ONE device dispatch
         (the legacy path copies per admission). Padded to a power-of-two
@@ -1276,9 +1738,12 @@ class ContinuousBatchingEngine:
         workload compiles without bound. Track the entry count and warn
         past ``compile_cache_cap``. (``_jit_step`` counts as one entry; its
         n_steps variants live in jax's own jit cache.)"""
-        n = len(self._jit_prefill) + (self._jit_step is not None)
+        n = (len(self._jit_prefill) + len(self._jit_qreset)
+             + (self._jit_step is not None))
         if self._fused:
             n += (self._jit_mega is not None) + (self._jit_apply is not None)
+            if self._spec is not None:
+                n += self._jit_spec is not None
         if self.prefix_cache is not None:
             n += (len(self._jit_chunk) + len(self._jit_first)
                   + (self._cow_fn is not None) + len(self._jit_cow_batch))
@@ -1361,6 +1826,11 @@ class ContinuousBatchingEngine:
         if fresh is None:
             self._alloc.decref(pinned)
             return False                       # pool exhausted — defer
+        # int8 block hygiene BEFORE any write (incl. the COW copy below,
+        # which overwrites its dst wholesale anyway): recycled pages must
+        # not leak their previous occupant's absmax scale into this
+        # request's quantization
+        self._reset_quant_blocks(fresh)
         cached = len(matched) * page
         if cow_src is not None:
             dst = fresh[0]
@@ -1478,6 +1948,26 @@ class ContinuousBatchingEngine:
         finally:
             self.stats["prefill_host_s"] += _time.perf_counter() - t0
 
+    def _prefill_row(self, s: int, req: "Request"):
+        """Table row handed to the prefill-chunk program: the slot's REAL
+        prompt pages, with everything beyond them (the decode-headroom
+        blocks) parked. A chunk's pad tail (ids right-padded to the chunk
+        width) scatters k/v at positions past the prompt — with the full
+        row those bytes land in the slot's future decode blocks. Harmless
+        under fp (masked, then overwritten) but corrosive under int8: the
+        pad garbage feeds the blocks' scatter-max absmax scales, which are
+        MONOTONE — a cold admission's decode blocks would quantize under
+        pad-inflated scales while a warm full-prompt hit (no prefill, no
+        pads) would not, silently breaking warm==cold byte-identity.
+        Parking the pad extent keeps decode blocks byte-virgin on every
+        admission path. Pads inside the final partially-filled prompt page
+        still land there (same bytes on every path: pad k/v depends only
+        on the pad token id and its absolute position)."""
+        row = np.full(self._maxp, self._park, np.int32)
+        n_real = -(-len(req.prompt) // self.page_size)
+        row[:n_real] = self._slot_rows[s][:n_real]
+        return row
+
     def _chunk_fn(self, g: int):
         """The compiled prefill-chunk program for ``g`` rows — shared by
         the legacy one-chunk-per-slot path (``_run_chunk``) and the fused
@@ -1506,7 +1996,7 @@ class ContinuousBatchingEngine:
         t0_tr = None if self.tracer is None else self.tracer.now()
         ids = np.zeros((g, C), np.int32)
         starts = np.zeros(g, np.int32)
-        rows = np.stack([self._slot_rows[s] for s, _ in group])
+        rows = np.stack([self._prefill_row(s, req) for s, req in group])
         for r, (s, req) in enumerate(group):
             nxt = self._prefill_next[s]
             chunk = req.prompt[nxt: nxt + C]
@@ -1570,7 +2060,7 @@ class ContinuousBatchingEngine:
             chunk = req.prompt[off: off + C]
             ids[r, : len(chunk)] = chunk
             starts[r] = off
-            trows[r] = self._slot_rows[s]
+            trows[r] = self._prefill_row(s, req)
         fn = self._chunk_fn(g)
         new_kv = fn(self._params, jnp.asarray(ids), self.caches["kv"],
                     jnp.asarray(trows), jnp.asarray(starts))
@@ -1670,11 +2160,16 @@ class ContinuousBatchingEngine:
             self._pos[slot] = len(req.prompt) + 1
             if self._fused:
                 # activation rides the next traced scatter: table row,
-                # position, active flag and sampling params in one update
-                # (no host-table mirror — the device table is authoritative)
+                # position, active flag, sampling params — and on spec
+                # engines the drafter ring seeded with the prompt — in one
+                # update (no host-table mirror — the device table is
+                # authoritative)
                 self._queue_update(slot, self._slot_rows[slot],
                                    len(req.prompt) + 1, True, req.seed,
-                                   req.temperature, req.top_p, req.top_k)
+                                   req.temperature, req.top_p, req.top_k,
+                                   hist=(self._spec_seed(req.prompt)
+                                         if self._spec is not None
+                                         else None))
             else:
                 self._tables_host[slot] = self._slot_rows[slot]
                 self._tables_dirty = True
@@ -1708,6 +2203,13 @@ class ContinuousBatchingEngine:
             take.append((self._free_slots.popleft(), self._queue.popleft()))
         if not take:
             return
+        if self._kv_dtype == "int8":
+            # legacy layout: slot i statically owns pages [i*maxp,
+            # (i+1)*maxp) — reset the admitted slots' pages so recycled
+            # scales never shape the new prompts' quantization
+            self._reset_quant_blocks([s * self._maxp + j
+                                      for s, _ in take
+                                      for j in range(self._maxp)])
         # group by (bucket, padded?): exact-length rows must take the
         # no-restep program — their first token then comes from the SAME
         # prefill-chunk logits generate(cache_impl='paged') computes, keeping
@@ -1751,10 +2253,14 @@ class ContinuousBatchingEngine:
                 self._pos[slot] = len(req.prompt) + 1
                 if self._fused:
                     # static slot-owned tables in legacy layout: activation
-                    # only flips act/pos/sampling via the traced scatter
+                    # only flips act/pos/sampling (+ the spec drafter seed)
+                    # via the traced scatter
                     self._queue_update(slot, None, len(req.prompt) + 1, True,
                                        req.seed, req.temperature, req.top_p,
-                                       req.top_k)
+                                       req.top_k,
+                                       hist=(self._spec_seed(req.prompt)
+                                             if self._spec is not None
+                                             else None))
                 if firsts is not None:
                     req.output.append(int(firsts[row]))
                 else:

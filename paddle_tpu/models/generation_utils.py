@@ -140,12 +140,16 @@ class GenerationMixin:
         cache[key] = (prefill, block)
         return prefill, block
 
-    def _init_paged_caches(self, b, max_len, page_size=64, num_blocks=None):
+    def _init_paged_caches(self, b, max_len, page_size=64, num_blocks=None,
+                           kv_dtype=None):
         """Paged-KV pools (serving layout, ops/paged_attention.py): per-layer
         page pools + a shared block table with pages statically assigned per
         sequence. ``num_blocks`` overrides the pool size (>= b * pages_per_
         seq) for engines that manage pages dynamically — prefix caching
         needs headroom for retained cache blocks plus a parking page.
+        ``kv_dtype="int8"`` builds pools in the int8 block format
+        (``QuantizedKVPool``: int8 pages + per-(page, head) absmax scales,
+        quantize-on-append / dequantize-in-gather — serving.KVCacheConfig).
         Families with a different cache layout override this."""
         cfg = self.config
         kvh = getattr(cfg, "num_key_value_heads", cfg.num_attention_heads)
@@ -157,9 +161,22 @@ class GenerationMixin:
             raise ValueError(f"num_blocks {npages} < {b * maxp} — the pool "
                              "cannot back every slot's table")
         tables = jnp.arange(b * maxp, dtype=jnp.int32).reshape(b, maxp)
-        kv = [(jnp.zeros((npages, kvh, page_size, hd), dtype),
-               jnp.zeros((npages, kvh, page_size, hd), dtype))
-              for _ in range(cfg.num_hidden_layers)]
+        if kv_dtype == "int8":
+            from ..ops.paged_attention import QuantizedKVPool
+
+            def pool():
+                return QuantizedKVPool(
+                    jnp.zeros((npages, kvh, page_size, hd), jnp.int8),
+                    jnp.zeros((npages, kvh), jnp.float32))
+
+            kv = [(pool(), pool()) for _ in range(cfg.num_hidden_layers)]
+        elif kv_dtype not in (None, "param"):
+            raise ValueError(f"unsupported kv_dtype {kv_dtype!r} "
+                             "(supported: None/'param', 'int8')")
+        else:
+            kv = [(jnp.zeros((npages, kvh, page_size, hd), dtype),
+                   jnp.zeros((npages, kvh, page_size, hd), dtype))
+                  for _ in range(cfg.num_hidden_layers)]
         return {"kv": kv, "tables": tables}
 
     def generate(self, input_ids, max_new_tokens: int = 32,

@@ -365,6 +365,27 @@ class GPTForCausalLM(GenerationMixin, Layer):
             new_kv.append((kp, vp))
         return {"kv": new_kv, "tables": tables}
 
+    def paged_verify_step(self, toks, caches, pos_vec):
+        """Speculative-decode VERIFY hook (llama analogue — see
+        ``LlamaForCausalLM.paged_verify_step``): one K+1-token window per
+        row at absolute positions ``pos_vec[b] + i`` through the chunk
+        machinery, with logits over EVERY window position for the
+        engine's in-graph accept/reject. Parked rows are inert."""
+        ids = _raw(toks)
+        b, s = ids.shape
+        positions = jnp.clip(pos_vec[:, None] + jnp.arange(s)[None, :], 0,
+                             self.config.max_position_embeddings - 1)
+        x = (jnp.take(self.gpt.wte._data, ids, axis=0)
+             + self.gpt.wpe._data[positions])
+        tables = caches["tables"]
+        new_kv = []
+        for layer, (kp, vp) in zip(self.gpt.layers, caches["kv"]):
+            x, kp, vp = layer.paged_prefill_chunk(x, kp, vp, tables, pos_vec)
+            new_kv.append((kp, vp))
+        hidden = _raw(self.gpt.ln_f(x))
+        logits = jnp.matmul(hidden, self.gpt.wte._data.T)
+        return logits.astype(jnp.float32), {"kv": new_kv, "tables": tables}
+
     def _decode_chunk(self, ids, caches, pos, pad_bias, pos_offset):
         ids = _raw(ids)
         b, s = ids.shape

@@ -715,6 +715,48 @@ class LlamaForCausalLM(GenerationMixin, Layer):
             new_kv.append((kp, vp))
         return {"kv": new_kv, "tables": tables}
 
+    def paged_verify_step(self, toks, caches, pos_vec):
+        """Speculative-decode VERIFY hook (inference/serving.py spec
+        mega-step): score a K+1-token window per row in ONE pass.
+
+        ``toks`` [b, s] int32 — per row the window
+        ``[last_token, draft_1..draft_K]`` at absolute positions
+        ``pos_vec[b] + i``; returns (logits [b, s, vocab] f32, caches) with
+        the window's k/v appended. The body is the K-wide sibling of
+        ``paged_token_step``: same embed/rope/layer math run through the
+        chunk machinery (``paged_prefill_chunk`` layers over
+        ``ops.paged_verify_attention``'s append-then-gather +
+        absolute-position masking), plus the lm head over EVERY window
+        position — so position i's logits match what a sequential
+        ``paged_token_step`` at that position would compute given the same
+        cache bytes (the greedy byte-identity the engine's in-graph
+        accept/reject rests on). Honors the parked-row contract: inactive
+        rows arrive at pos_vec == 0 over a parking-page table; their
+        appends and logits are inert."""
+        cfg = self.config
+        model = self.model
+        ids = toks
+        x = jnp.take(model.embed_tokens_weight._data, ids, axis=0)
+        tables = caches["tables"]
+        page = caches["kv"][0][0].shape[2]
+        max_len = tables.shape[1] * page
+        cos_full, sin_full = _rope_cos_sin(max_len, cfg.head_dim,
+                                           cfg.rope_theta, x.dtype)
+        s = ids.shape[1]
+        positions = jnp.clip(pos_vec[:, None] + jnp.arange(s)[None, :],
+                             0, max_len - 1)
+        cos = cos_full[positions]
+        sin = sin_full[positions]
+        new_kv = []
+        for layer, (kp, vp) in zip(model.layers, caches["kv"]):
+            x, kp, vp = layer.paged_prefill_chunk(x, cos, sin, kp, vp,
+                                                  tables, pos_vec)
+            new_kv.append((kp, vp))
+        hidden = model.norm(x)
+        hidden = hidden._data if isinstance(hidden, Tensor) else hidden
+        logits = self.logits(hidden)
+        return logits.astype(jnp.float32), {"kv": new_kv, "tables": tables}
+
     def remat_policy(self):
         """Engine hook: the jax.checkpoint policy for this model's blocks."""
         return remat_policy_of(self.config)

@@ -72,8 +72,13 @@ _ENGINE_GAUGE_KEYS = {"compile_cache_entries"}
 # stats-dict keys NOT exported from engine.stats: "evictions" is a lagging
 # copy of radix.evictions (synced only at admit/brownout time) and the
 # collector already exports the live value as pt_radix_evictions_total —
-# two families for one quantity that disagree mid-flight is worse than one
-_ENGINE_SKIP_KEYS = {"evictions"}
+# two families for one quantity that disagree mid-flight is worse than one.
+# The spec proposed/accepted counters export under their REQUIRED
+# pt_spec_* names below, not as a second pt_engine_* copy; spec_steps has
+# no pt_spec_* twin and stays in the auto-exported pt_engine_* set (the
+# verify-dispatch count is what shows spec degrading to 1-token
+# dispatches).
+_ENGINE_SKIP_KEYS = {"evictions", "spec_proposed", "spec_accepted"}
 
 
 def engine_collector(engine, **labels):
@@ -125,6 +130,27 @@ def engine_collector(engine, **labels):
         fams.append(MetricFamily(
             "pt_engine_brownout_active", "gauge").add(
             1.0 if engine._brownout_active else 0.0, **labels))
+        # speculative decode + int8 KV block format (docs/SERVING.md):
+        # REQUIRED families (tools/scrape_metrics.py --selftest), rendered
+        # at zero on non-spec / fp engines so dashboards never lose them
+        prop = float(engine.stats.get("spec_proposed", 0))
+        acc = float(engine.stats.get("spec_accepted", 0))
+        fams.append(MetricFamily(
+            "pt_spec_proposed_total", "counter",
+            "draft tokens proposed by the speculative decoder").add(
+            prop, **labels))
+        fams.append(MetricFamily(
+            "pt_spec_accepted_total", "counter",
+            "draft tokens accepted by the in-graph verify").add(
+            acc, **labels))
+        fams.append(MetricFamily(
+            "pt_spec_acceptance_rate", "gauge",
+            "accepted / proposed draft tokens (lifetime)").add(
+            acc / prop if prop > 0 else 0.0, **labels))
+        fams.append(MetricFamily(
+            "pt_kv_quant_blocks", "gauge",
+            "pool pages held in the int8 KV block format").add(
+            float(getattr(engine, "_kv_quant_blocks", 0)), **labels))
         return fams
 
     return collect

@@ -320,21 +320,28 @@ class TraceRecorder:
 
     def decode_block(self, t0: float, n_steps: int, slots: int,
                      t1: Optional[float] = None,
-                     tags: Optional[dict] = None) -> None:
+                     tags: Optional[dict] = None,
+                     tokens: Optional[int] = None) -> None:
         """Engine-lane span for one fused decode dispatch (tid 0 — block
-        work is batched across requests, so it has no single rid)."""
+        work is batched across requests, so it has no single rid).
+        ``tokens`` carries the block's REAL emitted-token count: under
+        speculative decoding a dispatch emits a variable 1..K+1 tokens per
+        row, so TTFT/inter-token SLO math must read token progress off the
+        span, never infer it from n_steps x slots."""
+        extra = {} if tokens is None else {"tokens": int(tokens)}
         self.span("decode_block", None, t0, t1, tags,
-                  n_steps=int(n_steps), slots=int(slots))
+                  n_steps=int(n_steps), slots=int(slots), **extra)
 
     def decode_block_batch(self, t0: float, n_steps: int, slots: int,
                            items, t1: Optional[float] = None,
-                           tags: Optional[dict] = None) -> None:
+                           tags: Optional[dict] = None,
+                           tokens: Optional[int] = None) -> None:
         """One decode block's full stamp set — the block span plus every
         row's token progress — under a SINGLE lock acquisition (the
         big-batch step path; per-slot locking is O(slots) contention per
         block)."""
         with self._lock:
-            self.decode_block(t0, n_steps, slots, t1, tags)
+            self.decode_block(t0, n_steps, slots, t1, tags, tokens=tokens)
             if items:
                 for rid, total in items:
                     self.tokens(rid, total, tags)

@@ -35,6 +35,105 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
+#: int8 KV block format: symmetric absmax quantization, q = round(x / step)
+#: with step = scale / KV_QMAX — the same scale convention as
+#: quantization.PerChannelAbsmaxObserver / ConvertedLinear (scale == absmax,
+#: qmax = 2^(bits-1) - 1), applied per (page, kv_head) block.
+KV_QMAX = 127
+
+
+# ---------------------------------------------------------------------------
+# int8 paged-KV block format (opt-in — serving.KVCacheConfig(dtype="int8"))
+# ---------------------------------------------------------------------------
+
+@jax.tree_util.register_pytree_node_class
+class QuantizedKVPool:
+    """One side (k or v) of a paged-KV pool in the int8 block format.
+
+    ``data`` [num_pages, kv_heads, page, head_dim] int8 and ``scale``
+    [num_pages, kv_heads] float32 — one absmax scale per (page, kv_head)
+    block, living beside the pool (reusing the
+    ``quantization.PerChannelAbsmaxObserver`` convention: scale == absmax,
+    stored value = round(x / (scale / KV_QMAX))). Registered as a jax
+    pytree, so it flows through jit/scan carries and ``donate_argnums``
+    exactly like the plain array it replaces; ``.shape``/``.dtype``
+    delegate to ``data`` so pool-geometry probes (page size, head counts,
+    codec compatibility checks) keep working unchanged.
+
+    Write paths quantize on append (:func:`append_paged_kv`): the block
+    scale is grown by scatter-max with the incoming tokens' absmax and
+    already-stored values are REquantized under the grown scale (one
+    elementwise pass over the pool — ratio is 1.0 for untouched blocks, so
+    their stored bytes are bit-stable through ``round``). Read paths
+    dequantize in the gather (:func:`paged_decode_attention` /
+    :func:`paged_prefill_attention` / :func:`paged_verify_attention`), so
+    attention math stays fp32. Pool bytes drop ~itemsize-fold (bf16 -> int8
+    halves them), doubling effective slots and radix prefix-cache reach at
+    equal memory. The Pallas decode kernel does not yet carry the dequant
+    (int8 routes to the XLA reference path — open TPU-kernel work)."""
+
+    __slots__ = ("data", "scale")
+
+    def __init__(self, data, scale):
+        self.data = data
+        self.scale = scale
+
+    def tree_flatten(self):
+        return (self.data, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def shape(self):
+        return self.data.shape
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    def __repr__(self):
+        return (f"QuantizedKVPool(shape={tuple(self.data.shape)}, "
+                f"dtype={self.data.dtype})")
+
+
+def kv_absmax(x):
+    """Per-(token, kv_head) absmax of new k/v rows ``x`` [n, kv_heads, d] —
+    the head_dim reduction of ``PerChannelAbsmaxObserver`` math, feeding
+    the per-block scatter-max on append."""
+    return jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+
+
+def quantize_kv(x, scale):
+    """Symmetric int8 quantization of ``x`` with per-channel ``scale``
+    (broadcast against ``x``): round(x / (scale / KV_QMAX)) clipped to
+    +-KV_QMAX. ``scale == 0`` blocks hold only zeros by construction (a
+    scale is the absmax of everything ever written)."""
+    step = scale.astype(jnp.float32) / KV_QMAX
+    safe = jnp.where(step > 0, step, 1.0)
+    q = jnp.round(x.astype(jnp.float32) / safe)
+    return jnp.clip(q, -KV_QMAX, KV_QMAX).astype(jnp.int8)
+
+
+def dequantize_kv(q, scale):
+    """Inverse of :func:`quantize_kv` (fp32): q * (scale / KV_QMAX).
+    Per-block dequant error is bounded by step/2 = scale / (2 * KV_QMAX)
+    per quantization event; requant-on-grow events compound boundedly
+    (tests pin the end-to-end bound)."""
+    return q.astype(jnp.float32) * (scale.astype(jnp.float32) / KV_QMAX)
+
+
+def _gather_pages(cache, tables):
+    """Dense page gather with dequantize-on-gather for int8 pools:
+    returns [*tables.shape, kv_heads, page, d] — fp32 when quantized,
+    the pool dtype otherwise."""
+    if isinstance(cache, QuantizedKVPool):
+        pages = cache.data[tables].astype(jnp.float32)
+        s = cache.scale[tables]                       # [..., kv_heads]
+        return pages * (s[..., None, None] / KV_QMAX)
+    return cache[tables]
+
 
 # ---------------------------------------------------------------------------
 # XLA reference (tests + CPU fallback)
@@ -51,8 +150,10 @@ def paged_decode_reference(q, k_cache, v_cache, block_tables, context_lens,
     max_pages = block_tables.shape[1]
     safe_tables = jnp.maximum(block_tables, 0)
     # [b, max_pages, hkv, page, d] -> [b, hkv, L, d]
-    kg = jnp.swapaxes(k_cache[safe_tables], 2, 3).reshape(b, max_pages * page, hkv, d)
-    vg = jnp.swapaxes(v_cache[safe_tables], 2, 3).reshape(b, max_pages * page, hkv, d)
+    kg = jnp.swapaxes(_gather_pages(k_cache, safe_tables),
+                      2, 3).reshape(b, max_pages * page, hkv, d)
+    vg = jnp.swapaxes(_gather_pages(v_cache, safe_tables),
+                      2, 3).reshape(b, max_pages * page, hkv, d)
     kg = jnp.swapaxes(kg, 1, 2)
     vg = jnp.swapaxes(vg, 1, 2)
     qf = q.reshape(b, hkv, group, d).astype(jnp.float32)
@@ -184,6 +285,12 @@ def paged_decode_attention(q, k_cache, v_cache, block_tables, context_lens,
     group = hq // hkv
     if scale is None:
         scale = d ** -0.5
+    if isinstance(k_cache, QuantizedKVPool):
+        # int8 block format: the Pallas kernel does not carry the
+        # per-block dequant yet — route to the dense-gather reference,
+        # which dequantizes in the gather (open TPU-kernel work)
+        return paged_decode_reference(q, k_cache, v_cache, block_tables,
+                                      context_lens, scale)
     # Mosaic page-DMA slicing needs a 128-aligned trailing dim and a
     # sublane-aligned page dim — 8 sublanes at 4-byte, 16 at 2-byte, 32 at
     # 1-byte (int8 KV cache); other shapes take the dense-gather fallback
@@ -284,8 +391,10 @@ def paged_prefill_attention(q, k_cache, v_cache, block_tables, chunk_starts,
     max_pages = block_tables.shape[1]
     L = max_pages * page
     safe_tables = jnp.maximum(block_tables, 0)
-    kg = jnp.swapaxes(k_cache[safe_tables], 2, 3).reshape(b, L, hkv, d)
-    vg = jnp.swapaxes(v_cache[safe_tables], 2, 3).reshape(b, L, hkv, d)
+    kg = jnp.swapaxes(_gather_pages(k_cache, safe_tables),
+                      2, 3).reshape(b, L, hkv, d)
+    vg = jnp.swapaxes(_gather_pages(v_cache, safe_tables),
+                      2, 3).reshape(b, L, hkv, d)
     kg = jnp.swapaxes(kg, 1, 2).astype(jnp.float32)      # [b, hkv, L, d]
     vg = jnp.swapaxes(vg, 1, 2).astype(jnp.float32)
     qf = q.reshape(b, s, hkv, group, d).astype(jnp.float32)
@@ -301,6 +410,38 @@ def paged_prefill_attention(q, k_cache, v_cache, block_tables, chunk_starts,
     return out.astype(q.dtype)
 
 
+def paged_verify_attention(q, k_cache, v_cache, block_tables, row_starts,
+                           scale=None):
+    """Speculative-decode VERIFY attention: score a K+1-token draft window
+    per row in ONE pass (inference/serving.py speculative mega-step).
+
+    q: [b, s, hq, d] — queries for the window [last_token, draft_1..draft_K]
+    whose rows sit at per-row absolute offsets ``row_starts[b] + i`` inside
+    already-partially-filled paged caches. The window's own k/v must
+    already be appended (append-then-gather), exactly the
+    :func:`paged_prefill_attention` machinery — which is what this
+    delegates to: the absolute-position mask means window position i
+    attends the cached prefix plus drafts 0..i, so the logits at position
+    i are IDENTICAL (same gather extent, same masked softmax) to what a
+    sequential ``paged_token_step`` at that position would compute given
+    the same cache bytes — the greedy byte-identity guarantee of
+    speculative decoding rests here. Rejected drafts' appended k/v needs
+    no explicit rollback: positions past the accepted prefix sit beyond
+    the advanced context length, are never attended, and are overwritten
+    as decode proceeds (the engine's standard pad-append invariant).
+    int8 pools dequantize in the gather like every other read path.
+
+    NOTE this is a NAMED THIN DELEGATION: the production verify program
+    (``paged_verify_step`` -> layer ``paged_prefill_chunk``) dispatches
+    the shared :func:`paged_prefill_attention` body directly — verify and
+    chunk prefill are deliberately ONE implementation, which is what the
+    byte-identity argument above rests on. Behavioral changes belong in
+    that shared body; changing only this wrapper changes tests, not
+    serving."""
+    return paged_prefill_attention(q, k_cache, v_cache, block_tables,
+                                   row_starts, scale)
+
+
 def copy_pages(k_cache, v_cache, src, dst):
     """Copy page(s) ``src`` -> ``dst`` across a (k, v) pool pair — the
     copy-on-write primitive for shared prefix blocks. Traced-index
@@ -309,9 +450,16 @@ def copy_pages(k_cache, v_cache, src, dst):
     vectors (the fused engine batches a whole admission wave's COW copies
     into one dispatch, padding with park->park self-copies — duplicate
     destinations among the pads write identical bytes, so the scatter
-    stays deterministic)."""
+    stays deterministic). int8 pools copy the per-block scales alongside
+    the page bytes — a COW copy must carry the whole block format, or the
+    private copy would dequantize under the wrong scale."""
     src = jnp.atleast_1d(jnp.asarray(src, jnp.int32))
     dst = jnp.atleast_1d(jnp.asarray(dst, jnp.int32))
+    if isinstance(k_cache, QuantizedKVPool):
+        return (QuantizedKVPool(k_cache.data.at[dst].set(k_cache.data[src]),
+                                k_cache.scale.at[dst].set(k_cache.scale[src])),
+                QuantizedKVPool(v_cache.data.at[dst].set(v_cache.data[src]),
+                                v_cache.scale.at[dst].set(v_cache.scale[src])))
     k_cache = k_cache.at[dst].set(k_cache[src])
     v_cache = v_cache.at[dst].set(v_cache[src])
     return k_cache, v_cache
@@ -544,9 +692,41 @@ def append_paged_kv(k_cache, v_cache, k_new, v_new, block_tables, positions,
         seq_ids = jnp.arange(n_tokens, dtype=jnp.int32)
     page_idx = block_tables[seq_ids, positions // page]      # [n]
     offs = positions % page                                   # [n]
+    if isinstance(k_cache, QuantizedKVPool):
+        return (_append_quantized(k_cache, k_new, page_idx, offs),
+                _append_quantized(v_cache, v_new, page_idx, offs))
     k_cache = k_cache.at[page_idx, :, offs, :].set(k_new)
     v_cache = v_cache.at[page_idx, :, offs, :].set(v_new)
     return k_cache, v_cache
+
+
+def _append_quantized(pool: QuantizedKVPool, x_new, page_idx, offs):
+    """Quantize-on-append into the int8 block format (one pool side).
+
+    1. Scatter-MAX the per-(page, head) scales with the incoming tokens'
+       absmax — correct under duplicate page indices (several tokens of a
+       prefill chunk landing in one page), unlike a gather/rewrite.
+    2. REquantize already-stored values of grown blocks: one elementwise
+       pass over the pool at ratio old_scale/new_scale — the ratio is
+       exactly 1.0 everywhere a block did not grow, and round(q * 1.0)
+       reproduces q bit-for-bit for every int8 value, so untouched blocks
+       are byte-stable. (XLA fuses this into a single pool pass; pushing
+       the rescale into a page-local kernel is the open TPU-side work.)
+    3. Write the new tokens quantized under the grown scale — the same
+       scatter shape as the fp path, so duplicate semantics (parking-page
+       dummies) are unchanged.
+    """
+    s_tok = kv_absmax(x_new)                                  # [n, h]
+    new_scale = pool.scale.at[page_idx].max(s_tok)            # [P, h]
+    ratio = jnp.where(new_scale > 0,
+                      pool.scale / jnp.where(new_scale > 0, new_scale, 1.0),
+                      1.0)
+    data = jnp.clip(jnp.round(pool.data.astype(jnp.float32)
+                              * ratio[:, :, None, None]),
+                    -KV_QMAX, KV_QMAX)
+    q_new = quantize_kv(x_new, new_scale[page_idx][:, :, None])
+    data = data.astype(jnp.int8).at[page_idx, :, offs, :].set(q_new)
+    return QuantizedKVPool(data, new_scale)
 
 
 def gather_chain_pages(kv, blocks):
@@ -558,34 +738,74 @@ def gather_chain_pages(kv, blocks):
     shape ``[len(blocks), kv_heads, page, head_dim]``. The np.asarray
     readback fences any in-flight append/decode program that wrote these
     pages, so the bytes are exactly what the next decode step would have
-    attended."""
+    attended. int8 pools export their RAW int8 page bytes (the dequant
+    scales travel separately — :func:`gather_chain_scales`), so the wire
+    artifact's crc covers the quantized bytes exactly as stored."""
     import numpy as np
 
     idx = np.asarray(blocks, np.int32)
-    return [(np.asarray(k[idx]), np.asarray(v[idx])) for k, v in kv]
+    out = []
+    for k, v in kv:
+        if isinstance(k, QuantizedKVPool):
+            out.append((np.asarray(k.data[idx]), np.asarray(v.data[idx])))
+        else:
+            out.append((np.asarray(k[idx]), np.asarray(v[idx])))
+    return out
 
 
-def scatter_chain_pages(kv, blocks, pages):
+def gather_chain_scales(kv, blocks):
+    """Per-layer (k_scales, v_scales) host arrays for a chain's blocks
+    ([len(blocks), kv_heads] f32 each) — the scale half of an int8 chain
+    export. Returns None for fp pools (no scales in the block format)."""
+    import numpy as np
+
+    if not kv or not isinstance(kv[0][0], QuantizedKVPool):
+        return None
+    idx = np.asarray(blocks, np.int32)
+    return [(np.asarray(k.scale[idx]), np.asarray(v.scale[idx]))
+            for k, v in kv]
+
+
+def scatter_chain_pages(kv, blocks, pages, scales=None):
     """Write exported chain bytes into freshly-allocated pool pages — the
     IMPORT half of KV-block migration. ``pages`` is
     :func:`gather_chain_pages` output (host arrays); each layer's pool
     takes one eager scatter (control-plane dispatch — migration happens
-    once per request, never on the decode hot path). Returns the updated
-    per-layer ``[(k_pages, v_pages), ...]`` list."""
+    once per request, never on the decode hot path). int8 pools take the
+    per-block ``scales`` (from :func:`gather_chain_scales` or the PTKV1
+    header) alongside the raw int8 bytes. Returns the updated per-layer
+    ``[(k_pages, v_pages), ...]`` list."""
     idx = jnp.asarray(blocks, jnp.int32)
-    return [(k.at[idx].set(jnp.asarray(pk, k.dtype)),
-             v.at[idx].set(jnp.asarray(pv, v.dtype)))
-            for (k, v), (pk, pv) in zip(kv, pages)]
+    out = []
+    for li, ((k, v), (pk, pv)) in enumerate(zip(kv, pages)):
+        if isinstance(k, QuantizedKVPool):
+            if scales is None:
+                raise ValueError("int8 pool import needs per-block scales")
+            ks, vs = scales[li]
+            out.append((
+                QuantizedKVPool(
+                    k.data.at[idx].set(jnp.asarray(pk, jnp.int8)),
+                    k.scale.at[idx].set(jnp.asarray(ks, jnp.float32))),
+                QuantizedKVPool(
+                    v.data.at[idx].set(jnp.asarray(pv, jnp.int8)),
+                    v.scale.at[idx].set(jnp.asarray(vs, jnp.float32)))))
+        else:
+            out.append((k.at[idx].set(jnp.asarray(pk, k.dtype)),
+                        v.at[idx].set(jnp.asarray(pv, v.dtype))))
+    return out
 
 
 def gather_paged_kv(k_cache, v_cache, block_tables, max_len):
     """Dense [b, max_len, hkv, d] views of the paged cache (prefill path /
-    debugging). max_len must be a multiple of page size."""
+    debugging; int8 pools come back dequantized fp32). max_len must be a
+    multiple of page size."""
     b = block_tables.shape[0]
     page = k_cache.shape[2]
     hkv, d = k_cache.shape[1], k_cache.shape[3]
     n = max_len // page
     tables = jnp.maximum(block_tables[:, :n], 0)
-    kg = jnp.swapaxes(k_cache[tables], 2, 3).reshape(b, max_len, hkv, d)
-    vg = jnp.swapaxes(v_cache[tables], 2, 3).reshape(b, max_len, hkv, d)
+    kg = jnp.swapaxes(_gather_pages(k_cache, tables),
+                      2, 3).reshape(b, max_len, hkv, d)
+    vg = jnp.swapaxes(_gather_pages(v_cache, tables),
+                      2, 3).reshape(b, max_len, hkv, d)
     return kg, vg

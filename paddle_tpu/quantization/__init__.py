@@ -24,11 +24,20 @@ from ..core.op_registry import apply_fn
 from ..core.tensor import Tensor, unwrap
 from ..nn.layer.layers import Layer
 from .. import nn
+# int8 paged-KV block format (docs/SERVING.md "int8 KV cache"): the serving
+# pools' quantized layout — int8 pages + per-(page, head) absmax scales,
+# same scale convention as PerChannelAbsmaxObserver / ConvertedLinear
+# (scale == absmax, qmax = 2^(bits-1) - 1). Lives beside the paged kernels
+# (ops/paged_attention.py) and is re-exported here as the quantization-
+# facing API surface; opt in via serving.KVCacheConfig(dtype="int8").
+from ..ops.paged_attention import (KV_QMAX, QuantizedKVPool,  # noqa: F401
+                                   dequantize_kv, kv_absmax, quantize_kv)
 
 __all__ = [
     "AbsmaxObserver", "MovingAverageAbsmaxObserver", "PerChannelAbsmaxObserver",
     "QuantConfig", "QAT", "PTQ", "QuantedLinear", "ConvertedLinear",
     "fake_quant",
+    "KV_QMAX", "QuantizedKVPool", "quantize_kv", "dequantize_kv", "kv_absmax",
 ]
 
 

@@ -55,8 +55,15 @@ def test_manifest_counts_cover_reference_parity():
         # fleet PR: + FleetRouter, FleetConfig, ReplicaState;
         # SLO-observatory PR: + SLOAutoscaler, AutoscaleConfig;
         # disagg PR (docs/SERVING.md "Disaggregated tiers"): +
-        # KVChainCodec, KVChainCorrupt, TieredRouter
-        "paddle.inference.serving": 19,
+        # KVChainCodec, KVChainCorrupt, TieredRouter;
+        # speculative-decode PR (docs/SERVING.md "Speculative decode" /
+        # "int8 KV cache"): + SpecConfig, KVCacheConfig
+        "paddle.inference.serving": 21,
+        # speculative-decode PR: the quantization surface gains the int8
+        # paged-KV block format — QuantizedKVPool, quantize_kv,
+        # dequantize_kv, kv_absmax, KV_QMAX (beside the frozen QAT/PTQ
+        # observer/driver surface)
+        "paddle.quantization": 14,
         # procfleet PR (docs/SERVING.md "Process fleet"): the
         # process-per-replica transport — Message, WireClosed,
         # WireCorrupt, WorkerSpec, worker_main, ProcReplica, WorkerDead,
@@ -256,9 +263,17 @@ def test_program_cost_gate_real_sweep_clean():
     for line in mega_lines:
         assert "scaling <=linear" in line, line
         assert "missing []" in line, line
+    # the speculative verify mega-step rides the same sweep: both widths,
+    # <=linear, every declared carry (kv/pos/hist/hlen) donated
+    spec_lines = [line for line in r.stdout.splitlines()
+                  if line.startswith("[manifest] spec_verify@")]
+    assert len(spec_lines) == 2, r.stdout
+    for line in spec_lines:
+        assert "scaling <=linear" in line, line
+        assert "missing []" in line, line
 
 
-@pytest.mark.slow   # ~3min of engine/train-loop compiles across 18 classes
+@pytest.mark.slow   # ~3min of engine/train-loop compiles across 19 classes
 def test_fault_drill_matrix():
     """Resilience gate (docs/RESILIENCE.md + docs/NUMERIC_GUARD.md +
     docs/SERVING.md): the seeded fault matrix — heartbeat loss, store
@@ -267,7 +282,9 @@ def test_fault_drill_matrix():
     saturation, serving engine crash mid-decode, serving step stall,
     overload shed, fleet replica kill, fleet worker-PROCESS SIGKILL
     (fleet_proc_kill — inference/procfleet), fleet rolling drain/restart,
-    fleet overload brownout, KV-migration corruption (PT-SRV-007), NaN
+    fleet overload brownout, KV-migration corruption (PT-SRV-007, int8
+    chains included), speculative-decode divergence (accept-all control
+    arm vs in-graph verify), NaN
     gradient, loss spike, poisoned batch — must be
     absorbed with recovery enabled AND flip the exit code
     with recovery disabled. Runs in a subprocess (the drill forces the
@@ -285,7 +302,7 @@ def test_fault_drill_matrix():
          "--selftest"],
         capture_output=True, text=True, env=env, cwd=ROOT, timeout=560)
     assert r.returncode == 0, r.stdout + r.stderr
-    assert "FAULT DRILL OK: 18 fault classes" in r.stdout, r.stdout
+    assert "FAULT DRILL OK: 19 fault classes" in r.stdout, r.stdout
 
 
 def test_fault_drill_single_drill_exit_codes():

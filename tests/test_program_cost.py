@@ -252,6 +252,9 @@ def test_engine_declares_mega_and_chunk_donation():
     for carry in E._FIRST_CARRIES:
         idx = E._FIRST_ARG_NAMES.index(carry)
         assert idx in E._FIRST_DONATE_ARGNUMS, (carry, idx)
+    for carry in E._SPEC_CARRIES:
+        idx = E._SPEC_ARG_NAMES.index(carry)
+        assert idx in E._SPEC_DONATE_ARGNUMS, (carry, idx)
     # tables/act/sampling state are NOT carries of the mega program and
     # must never be donated (the engine keeps them live across the call);
     # the first-token program reads rows/last_tok across the call likewise
@@ -259,6 +262,9 @@ def test_engine_declares_mega_and_chunk_donation():
         assert E._MEGA_ARG_NAMES.index(name) not in E._MEGA_DONATE_ARGNUMS
     for name in ("rows", "last_tok", "ints", "floats"):
         assert E._FIRST_ARG_NAMES.index(name) not in E._FIRST_DONATE_ARGNUMS
+    # the spec program reads tables/act/caps across the call — undonated
+    for name in ("tables", "act", "caps"):
+        assert E._SPEC_ARG_NAMES.index(name) not in E._SPEC_DONATE_ARGNUMS
 
 
 # ---------------------------------------------------------------------------
@@ -376,12 +382,25 @@ def test_real_baseline_is_reviewed_and_covers_the_registry():
     finally:
         sys.path.pop(0)
     programs, waivers = gate.load_baseline()
-    assert {"mega_step@8", "mega_step@32", "prefill_chunk", "train_step",
+    assert {"mega_step@8", "mega_step@32", "spec_verify@8",
+            "spec_verify@32", "prefill_chunk", "train_step",
             "migration"} <= set(programs)
     for w in (8, 32):
         rec = programs[f"mega_step@{w}"]
         assert rec["scaling"]["verdict"] == "<=linear", rec["scaling"]
         assert rec["donation"]["missing"] == []
+        assert rec["host_sync_eqns"] == 0
+    for w in (8, 32):
+        # the speculative verify mega-step: <=linear in slots, EVERY
+        # declared carry donated — kv, pos AND the drafter ring/length —
+        # and no host-sync primitive inside the jitted program (the
+        # engine's per-dispatch emit readback is host-side by design,
+        # outside the program)
+        rec = programs[f"spec_verify@{w}"]
+        assert rec["scaling"]["verdict"] == "<=linear", rec["scaling"]
+        assert rec["donation"]["missing"] == []
+        assert set(rec["donation"]["donated"]) == {"kv", "pos", "hist",
+                                                   "hlen"}
         assert rec["host_sync_eqns"] == 0
     assert programs["train_step"]["donation"]["missing"] == []
     assert programs["migration"]["donation"]["missing"] == ["kv"]
@@ -391,3 +410,5 @@ def test_real_baseline_is_reviewed_and_covers_the_registry():
     # PT-COST-005 rests on
     assert programs["mega_step@8"]["num_eqns"] == \
         programs["mega_step@32"]["num_eqns"]
+    assert programs["spec_verify@8"]["num_eqns"] == \
+        programs["spec_verify@32"]["num_eqns"]

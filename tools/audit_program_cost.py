@@ -128,6 +128,69 @@ def record_mega_step(slots: int):
     return prog, spec
 
 
+def record_spec_verify(slots: int):
+    """The speculative verify mega-step (docs/SERVING.md "Speculative
+    decode") EXACTLY as the engine dispatches it: traced through
+    ``_build_spec_jit()`` so the audited ``donated_invars`` cover the real
+    carry set — kv pools, positions AND the drafter's history ring/length.
+    Traced at both SCALING_WIDTHS for the <=linear slot law; the in-graph
+    draft -> K-wide verify -> accept/rollback scatters are census-pinned
+    by the baseline contract (PT-COST-004)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.serving import (ContinuousBatchingEngine,
+                                              PrefixCacheConfig, SpecConfig)
+    from paddle_tpu.jit.api import _collect_state
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.static.analysis import trace_to_program
+    from paddle_tpu.static.cost import HotPathSpec
+
+    paddle.seed(11)
+    cfg = LlamaConfig.tiny(num_hidden_layers=1)
+    m = LlamaForCausalLM(cfg)
+    eng = ContinuousBatchingEngine(
+        m, max_batch=slots, max_len=32, page_size=8, block_size=2,
+        fused=True, speculative=SpecConfig(k=3, ngram=2, history=16),
+        prefix_cache=PrefixCacheConfig(prefill_chunk=8))
+    jf = eng._build_spec_jit()
+    names, tensors = _collect_state(m)
+    param_structs = [_spec(t._data.shape, t._data.dtype) for t in tensors]
+    n_p = len(param_structs)
+    kv = eng.caches["kv"]
+    L = len(kv)
+    B, maxp, H = eng.max_batch, eng._maxp, eng._spec.history
+
+    def flat(*args):
+        params, i = list(args[:n_p]), n_p
+        toks = args[i]
+        i += 1
+        kvl = [(args[i + 2 * l], args[i + 2 * l + 1]) for l in range(L)]
+        i += 2 * L
+        tables, pos, act, hist, hlen, caps = args[i:i + 6]
+        return jf(params, toks, kvl, tables, pos, act, hist, hlen, caps)
+
+    kv_specs = [_spec(a.shape, a.dtype) for pair in kv for a in pair]
+    kv_names = [f"kv{l}_{t}" for l in range(L) for t in ("k", "v")]
+    ins = ([_spec((B,), np.int32)] + kv_specs +
+           [_spec((B, maxp), np.int32), _spec((B,), np.int32),
+            _spec((B,), np.bool_), _spec((B, H), np.int32),
+            _spec((B,), np.int32), _spec((B,), np.int32)])
+    in_names = (["toks"] + kv_names +
+                ["tables", "pos", "act", "hist", "hlen", "caps"])
+    prog = trace_to_program(flat, *ins, input_names=in_names,
+                            param_structs=param_structs, param_names=names,
+                            param_tensors=tensors)
+    kv_lo = n_p + 1
+    kv_hi = kv_lo + 2 * L
+    spec = HotPathSpec(
+        f"spec_verify@{slots}", slots=slots,
+        carries={"kv": (kv_lo, kv_hi), "pos": (kv_hi + 1, kv_hi + 2),
+                 "hist": (kv_hi + 3, kv_hi + 4),
+                 "hlen": (kv_hi + 4, kv_hi + 5)},
+        notes="speculative verify mega-step (serving.py), k=3 draft + "
+              "bonus, n-gram drafter in-graph")
+    return prog, spec
+
+
 def record_prefill_chunk():
     """The packed prefill-chunk program (``_chunk_fn`` — shared by the
     legacy chunked path and the fused ``_run_pack``), at a 4-row bucket."""
@@ -260,6 +323,7 @@ def record_all(only=None):
     out = {}
     for slots in SCALING_WIDTHS:
         out[f"mega_step@{slots}"] = lambda s=slots: record_mega_step(s)
+        out[f"spec_verify@{slots}"] = lambda s=slots: record_spec_verify(s)
     out["prefill_chunk"] = record_prefill_chunk
     out["train_step"] = record_train_step
     out["migration"] = record_migration

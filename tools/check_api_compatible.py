@@ -38,6 +38,7 @@ def live_surfaces():
         "paddle.inference.procfleet": names(_procfleet),
         "paddle.inference.serving": names(_serving),
         "paddle.observability": names(paddle.observability),
+        "paddle.quantization": names(paddle.quantization),
         "paddle.static.concurrency": names(_concurrency),
         "paddle.static.cost": names(_cost),
         "paddle": names(paddle),

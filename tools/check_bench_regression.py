@@ -124,6 +124,15 @@ SECONDARY = {
     "serving_ttft_p99_under_burst_ms": ("lower", 1.0, 250.0),
     "serving_disagg_ttft_p99_under_burst_ms": ("lower", 1.0, 250.0),
     "serving_kv_migration_time_s": ("lower", 1.0, 0.5),
+    # speculative decode + int8 KV (docs/SERVING.md "Speculative decode" /
+    # "int8 KV cache", bench_speculative): spec tok/s is a throughput line
+    # like its siblings; the acceptance rate guards the drafter (a rate
+    # collapse silently degrades spec to 1-token dispatches with verify
+    # overhead); the int8 headroom is near-deterministic geometry (pool
+    # bytes ratio) — a drop means the block format grew overhead
+    "serving_spec_tokens_per_sec": ("higher", 0.5, 0.0),
+    "serving_spec_acceptance_rate": ("higher", 0.3, 0.0),
+    "serving_int8_kv_slots_headroom": ("higher", 0.2, 0.0),
 }
 
 

@@ -5,7 +5,8 @@ enabled (the injected fault must be absorbed) and with it disabled (the
 same fault must flip the exit code). ``--selftest`` runs the whole seeded
 matrix — heartbeat loss, store stall, checkpoint shard corruption, serving
 engine saturation, serving deadline, prefix-cache block-pool exhaustion,
-128-slot fused big-batch saturation (docs/SERVING.md), plus the numeric
+128-slot fused big-batch saturation (docs/SERVING.md), speculative-decode
+divergence (verification disabled — accept-all), plus the numeric
 classes (NaN gradient, loss spike,
 poisoned batch — docs/NUMERIC_GUARD.md) — and exits
 0 iff every fault class recovers when enabled AND fails when its recovery
@@ -1048,11 +1049,107 @@ def drill_kv_migration_corruption(recover: bool):
     if wrong:
         return False, (f"stream(s) {wrong} diverged despite the re-run "
                        "(recovery broken)")
+    # int8 block-format arm: a bitflip in the QUANTIZED page bytes of a
+    # PTKV1 chain must still raise the typed PT-SRV-007 (the per-page crc
+    # covers the int8 bytes exactly as stored; the dequant scales ride the
+    # digest-protected header)
+    from paddle_tpu.inference.disagg import KVChainCorrupt, KVChainCodec
+
+    src = ContinuousBatchingEngine(m, max_batch=2, max_len=32, page_size=8,
+                                   block_size=2, prefix_cache=True,
+                                   kv_cache="int8")
+    req8 = Request(rng.integers(0, cfg.vocab_size, (8,)).astype(np.int32),
+                   max_new_tokens=16)
+    src.add_request(req8)
+    src.step()
+    codec = KVChainCodec()
+    art = codec.export_chain(src, req8.rid)
+    flipped = bytearray(art)
+    flipped[-5] ^= 0x20                      # a quantized payload byte
+    dst = ContinuousBatchingEngine(m, max_batch=2, max_len=32, page_size=8,
+                                   block_size=2, prefix_cache=True,
+                                   kv_cache="int8")
+    try:
+        codec.import_chain(dst, bytes(flipped))
+        return False, ("int8 chain: flipped quantized byte spliced "
+                       "without a PT-SRV-007 rejection")
+    except KVChainCorrupt:
+        pass
+    src.withdraw_active(req8.rid)
+    twin = codec.import_chain(dst, art)      # clean splice must still work
+    dst.run_until_done(max_steps=200)
+    if len(twin.tokens) != 16:
+        return False, ("int8 chain: clean splice did not resume decode "
+                       f"({len(twin.tokens)}/16 tokens)")
     return True, ("PT-SRV-007: flipped page refused at import (per-page "
                   "crc32), prefill re-run on the decode replica, all "
                   f"{len(reqs)} streams bit-identical "
                   f"({tiered.stats['migrations']} clean migration(s) "
-                  "alongside)")
+                  "alongside); int8 chain bitflip equally refused and the "
+                  "clean int8 splice resumed decode")
+
+
+def drill_spec_decode_divergence(recover: bool):
+    """Speculative multi-token decoding with its in-graph verification
+    DISABLED (docs/SERVING.md "Speculative decode"). Recovery = the
+    normal draft -> verify -> accept/rollback pipeline: greedy streams are
+    byte-identical to the non-speculative mega-step (drafts only change
+    how many tokens a dispatch emits, never which), with acceptance > 0 on
+    the repetitive workload. Without verification
+    (``SpecConfig(_unsafe_accept_all=True)``: what trusting a drafter
+    blindly does) every draft is emitted as-is and the greedy streams
+    silently diverge — the failure mode the verify program exists to
+    prevent."""
+    import numpy as np
+
+    from paddle_tpu.inference.serving import (ContinuousBatchingEngine,
+                                              Request, SpecConfig)
+
+    cfg, m = _serving_model()
+    rng = np.random.default_rng(73)
+    motif = rng.integers(0, cfg.vocab_size, (4,)).astype(np.int32)
+    prompts = [np.tile(motif, 6),                       # repetitive: the
+               np.tile(motif, 6),                       # drafter's food
+               rng.integers(0, cfg.vocab_size, (9,)).astype(np.int32),
+               rng.integers(0, cfg.vocab_size, (14,)).astype(np.int32)]
+    new_toks = [24, 16, 12, 12]
+
+    def wave(eng):
+        reqs = [Request(p, max_new_tokens=k)
+                for p, k in zip(prompts, new_toks)]
+        for r in reqs:
+            eng.add_request(r)
+        eng.run_until_done(max_steps=800)
+        return [list(r.tokens) for r in reqs]
+
+    if "spec_refs" not in _SERVING:
+        _SERVING["spec_refs"] = wave(ContinuousBatchingEngine(
+            m, max_batch=4, max_len=64, page_size=8, block_size=2,
+            fused=True))
+    refs = _SERVING["spec_refs"]
+    spec = SpecConfig(k=3, _unsafe_accept_all=not recover)
+    eng = ContinuousBatchingEngine(m, max_batch=4, max_len=64, page_size=8,
+                                   block_size=2, fused=True,
+                                   speculative=spec)
+    streams = wave(eng)
+    wrong = [i for i, (s, f) in enumerate(zip(streams, refs)) if s != f]
+    if not recover:
+        if not wrong:
+            return True, ("unexpected: accept-all emitted every draft yet "
+                          "no stream diverged")
+        return False, ("verification disabled (accept-all): draft tokens "
+                       f"streamed unchecked — stream(s) {wrong} silently "
+                       "diverged from the non-speculative mega-step")
+    if wrong:
+        return False, (f"stream(s) {wrong} diverged WITH verification on "
+                       "(greedy byte-identity broken)")
+    if eng.stats["spec_accepted"] < 1:
+        return False, ("no draft accepted on the repetitive workload — "
+                       "the drafter/verify pipeline is not speculating")
+    return True, ("greedy streams byte-identical to the non-speculative "
+                  f"mega-step with {eng.stats['spec_accepted']}/"
+                  f"{eng.stats['spec_proposed']} drafts accepted over "
+                  f"{eng.stats['spec_steps']} verify dispatches")
 
 
 def _fleet_build():
@@ -1355,6 +1452,7 @@ DRILLS = {
     "fleet_drain": drill_fleet_drain,
     "fleet_overload": drill_fleet_overload,
     "kv_migration_corruption": drill_kv_migration_corruption,
+    "spec_decode_divergence": drill_spec_decode_divergence,
     "nan_grad": drill_nan_grad,
     "loss_spike": drill_loss_spike,
     "poison_batch": drill_poison_batch,

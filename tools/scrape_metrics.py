@@ -77,6 +77,13 @@ REQUIRED_FAMILIES = (
     "pt_procfleet_reaped_total",
     "pt_procfleet_heartbeats_total",
     "pt_procfleet_workers_alive",
+    # speculative decode + int8 KV block format (docs/SERVING.md): the
+    # engine collector renders these at zero on non-spec / fp engines, so
+    # the families are REQUIRED unconditionally
+    "pt_spec_proposed_total",
+    "pt_spec_accepted_total",
+    "pt_spec_acceptance_rate",
+    "pt_kv_quant_blocks",
 )
 
 #: the span chain a served request must produce, in order
