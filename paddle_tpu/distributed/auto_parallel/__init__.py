@@ -14,6 +14,7 @@ from .api import (  # noqa: F401
     to_static,
     unshard_dtensor,
 )
+from .comm_programs import moe_combine_comm, train_step_comm  # noqa: F401
 from .engine import Engine, ShardedTrainer  # noqa: F401
 from .logical_sharding import (  # noqa: F401
     DEFAULT_RULES,

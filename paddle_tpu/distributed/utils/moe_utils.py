@@ -31,6 +31,15 @@ def _axis_bound(axis_name) -> bool:
         return False
 
 
+def _axis_size(axis_name) -> int:
+    """Static size of a bound mesh axis. ``lax.axis_size`` only exists on
+    newer jax; on older versions ``lax.psum(1, axis)`` constant-folds to
+    the same static int at trace time."""
+    if hasattr(lax, "axis_size"):
+        return int(lax.axis_size(axis_name))
+    return int(lax.psum(1, axis_name))
+
+
 def global_scatter(x, local_count=None, global_count=None, group=None,
                    axis_name: str = "ep"):
     """Send token slices to their expert's rank (reference moe_utils.py:32).
@@ -41,7 +50,7 @@ def global_scatter(x, local_count=None, global_count=None, group=None,
     """
     x = _raw(x)
     if _axis_bound(axis_name):
-        n = lax.axis_size(axis_name)
+        n = _axis_size(axis_name)
         parts = x.reshape((n, x.shape[0] // n) + x.shape[1:])
         return lax.all_to_all(parts, axis_name, 0, 0, tiled=False).reshape(x.shape)
     return x

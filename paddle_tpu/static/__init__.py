@@ -33,9 +33,10 @@ from . import nn  # noqa: F401
 
 def __getattr__(name):
     # PEP 562 lazy submodules: the analysis package (6 modules), the
-    # concurrency analyzer (PT-RACE, pure-ast) and the program-cost
-    # auditor (PT-COST) load on first use, not at `import paddle_tpu` time
-    if name in ("analysis", "concurrency", "cost"):
+    # concurrency analyzer (PT-RACE, pure-ast), the program-cost auditor
+    # (PT-COST) and the collective-communication auditor (PT-COMM) load
+    # on first use, not at `import paddle_tpu` time
+    if name in ("analysis", "concurrency", "cost", "comm"):
         import importlib
 
         mod = importlib.import_module("." + name, __name__)
@@ -48,7 +49,7 @@ __all__ = [
     "program_guard", "default_main_program", "default_startup_program",
     "data", "CompiledProgram", "BuildStrategy", "ExecutionStrategy",
     "append_backward", "name_scope", "PassManager", "apply_default_passes",
-    "nn", "analysis", "concurrency", "cost",
+    "nn", "analysis", "concurrency", "cost", "comm",
 ]
 
 

@@ -8,6 +8,9 @@ each validate their inputs; GSPMD gives us propagation but not validation).
 
 Codes: PT-SPMD-001 (invalid placement/axis, error), PT-SPMD-002 (uneven
 shard, error), PT-SPMD-003 (conflicting shardings reaching one op, error).
+Every diagnostic carries a line-number-free ``finding_id``
+(``CODE:scope:detail``, scope = tensor/op names) — the PT-RACE/PT-COST
+baseline scheme, so waivers survive unrelated edits.
 
 Placements and meshes are duck-typed (``is_shard()/get_dim()`` /
 ``ndim/shape/dim_names``) so this module never imports the distributed
@@ -26,12 +29,24 @@ from .diagnostics import AnalysisPass, Diagnostic, Severity
 __all__ = ["SpmdConsistencyChecker", "check_placements", "check_axis_names"]
 
 
-def _diag(code, msg, op=None, analyzer="spmd_consistency_checker"):
-    return Diagnostic(code, Severity.ERROR, msg,
-                      op_type=getattr(op, "type", None),
-                      op_idx=getattr(op, "idx", None),
-                      source=getattr(op, "src", None),
-                      analyzer=analyzer)
+def _fid(code: str, scope: str, detail: str) -> str:
+    """Line-number-free finding id (``CODE:scope:detail``) — the PT-RACE/
+    PT-COST baseline scheme: ids survive unrelated edits because they
+    name WHAT is wrong where (tensor/op names), never source positions
+    (``op_idx``/``source`` stay on the Diagnostic for display only)."""
+    scope = (scope or "?").replace("'", "").replace('"', "")
+    return f"{code}:{scope.replace(' ', '_')}:{detail}"
+
+
+def _diag(code, msg, op=None, analyzer="spmd_consistency_checker",
+          scope="?", detail="?"):
+    d = Diagnostic(code, Severity.ERROR, msg,
+                   op_type=getattr(op, "type", None),
+                   op_idx=getattr(op, "idx", None),
+                   source=getattr(op, "src", None),
+                   analyzer=analyzer)
+    d.finding_id = _fid(code, scope, detail)
+    return d
 
 
 def check_placements(shape: Sequence[int], mesh, placements,
@@ -56,7 +71,8 @@ def check_placements(shape: Sequence[int], mesh, placements,
             "PT-SPMD-001",
             f"{where}: {len(placements)} placement(s) for a {len(mesh_shape)}"
             f"-axis mesh {names} — the extras are silently dropped at "
-            f"lowering; give at most one placement per mesh axis"))
+            f"lowering; give at most one placement per mesh axis",
+            scope=where, detail="placement-count"))
         # still validate the overlapping prefix below
 
     shard_factor = {}  # tensor dim -> product of mesh-axis sizes sharding it
@@ -70,7 +86,8 @@ def check_placements(shape: Sequence[int], mesh, placements,
                 f"{where}: Shard(dim={d}) on mesh axis '{names[axis]}' is "
                 f"out of range for a rank-{ndim} tensor (shape "
                 f"{list(shape)}) — placements_to_spec would silently wrap "
-                f"it to dim {d % ndim if ndim else 0}"))
+                f"it to dim {d % ndim if ndim else 0}",
+                scope=where, detail=f"shard-dim:{d}:{names[axis]}"))
             continue
         d = d % ndim
         shard_factor[d] = shard_factor.get(d, 1) * int(mesh_shape[axis])
@@ -83,7 +100,8 @@ def check_placements(shape: Sequence[int], mesh, placements,
                 "PT-SPMD-002",
                 f"{where}: dim {d} of size {size} does not divide evenly "
                 f"over {factor} shards (mesh {dict(zip(names, mesh_shape))})"
-                f" — pad to a multiple of {factor} or reshard"))
+                f" — pad to a multiple of {factor} or reshard",
+                scope=where, detail=f"uneven:dim{d}:x{factor}"))
     return out
 
 
@@ -101,7 +119,8 @@ def check_axis_names(mesh, axis_names: Sequence[Optional[str]],
                 out.append(_diag(
                     "PT-SPMD-001",
                     f"{where}: axis '{a}' does not exist on the mesh "
-                    f"(axes: {sorted(known)})"))
+                    f"(axes: {sorted(known)})",
+                    scope=where, detail=f"unknown-axis:{a}"))
     return out
 
 
@@ -156,11 +175,14 @@ class SpmdConsistencyChecker(AnalysisPass):
                     and np.array_equal(np.asarray(mesh.mesh),
                                        np.asarray(mesh0.mesh)))
             if not same:
-                out.append(self.diag(
+                d = self.diag(
                     "PT-SPMD-003", Severity.ERROR,
                     f"inputs '{name0}' and '{name}' reach this op on "
                     f"DIFFERENT meshes ({mesh0} vs {mesh}) — reshard one "
-                    f"side before combining", op=op))
+                    f"side before combining", op=op)
+                d.finding_id = _fid("PT-SPMD-003", op.type,
+                                    f"mesh-conflict:{name0}:{name}")
+                out.append(d)
         # same-shape inputs that disagree on placements: often legitimate
         # (row/col tensor parallelism shards matmul operands differently), but
         # GSPMD will silently reshard one side — surface it as a WARNING so
@@ -171,12 +193,15 @@ class SpmdConsistencyChecker(AnalysisPass):
             if key in by_shape:
                 pname, pplace = by_shape[key]
                 if pplace != placements:
-                    out.append(self.diag(
+                    d = self.diag(
                         "PT-SPMD-003", Severity.WARNING,
                         f"same-shape inputs '{pname}' and '{name}' carry "
                         f"conflicting shardings {pplace} vs {placements} — "
                         f"GSPMD will reshard one side; if unintended, align "
-                        f"them explicitly (reshard) before this op", op=op))
+                        f"them explicitly (reshard) before this op", op=op)
+                    d.finding_id = _fid("PT-SPMD-003", op.type,
+                                        f"divergent:{pname}:{name}")
+                    out.append(d)
             else:
                 by_shape[key] = (name, placements)
         return out

@@ -1,0 +1,77 @@
+"""paddle_tpu.static.comm — static collective-communication auditor
+(PT-COMM).
+
+PT-COST made device-program cost a lint-time property; this package does
+the same for COLLECTIVE COMMUNICATION, the axis ROADMAP item 1 (mesh-
+sharded serving) lives or dies on. Every registered mesh-sharded program
+(tools/audit_collectives.py: the per-MULTICHIP-shape train-step contract
+programs, the ring-attention and MoE dispatch/combine spmd-rule
+programs, and the single-device serving programs under an explicit
+``unsharded`` contract) is imported by pure tracing — shard_map under a
+symbolic ``jax.sharding.AbstractMesh``, NO XLA compile, no devices —
+and folded into a :class:`CommManifest`: a census of every collective
+primitive with axis attribution and ring-algorithm per-dispatch wire
+bytes computed from mesh axis sizes and operand dtypes, multiplied
+through scan bodies, plus the mesh-scaling law across a width pair. The
+manifest is baselined in tools/collective_baseline.json and enforced in
+CI, so an accidental replication, a collective re-gathered every scan
+step, an O(mesh^2) term in the collective plan, an all_gather where a
+reduce_scatter contract halves the bytes, or silent contract drift
+fails LINT — before any multi-chip run.
+
+Codes (docs/STATIC_ANALYSIS.md): PT-COMM-001 accidental replication,
+PT-COMM-002 loop-invariant collective in a scan/while body, PT-COMM-003
+superlinear comm scaling with mesh size, PT-COMM-004 all_gather+reduce
+where reduce_scatter halves bytes, PT-COMM-005 contract drift /
+unbaselined / broken unsharded contract.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..analysis.diagnostics import AnalysisPass, Diagnostic
+from .checks import (check_comm_contract, check_gather_reduce,
+                     check_loop_invariant_collectives, check_mesh_scaling,
+                     check_replication)
+from .collectives import (COLLECTIVE_PRIMS, CollectiveInfo, iter_collectives,
+                          wire_bytes)
+from .manifest import (CommManifest, CommPathSpec, compute_comm_manifest,
+                       mesh_scaling_verdict)
+from .mesh import abstract_mesh, mesh_axis_sizes, mesh_spec
+
+__all__ = [
+    "COLLECTIVE_PRIMS", "CollectiveInfo", "CollectiveCommPass",
+    "CommManifest", "CommPathSpec", "abstract_mesh", "check_comm_contract",
+    "check_gather_reduce", "check_loop_invariant_collectives",
+    "check_mesh_scaling", "check_replication", "compute_comm_manifest",
+    "iter_collectives", "mesh_axis_sizes", "mesh_spec",
+    "mesh_scaling_verdict", "wire_bytes",
+]
+
+
+class CollectiveCommPass(AnalysisPass):
+    """AnalysisPass form of the auditor — composes with ``run_analysis``
+    / the ordinary PassManager beside the PR 1 analyzers. Computes the
+    comm manifest (attached as ``program._comm_manifest``) and reports
+    the program-local code classes: PT-COMM-001 (replication),
+    PT-COMM-002 (loop-invariant collective), PT-COMM-004
+    (gather+reduce). The cross-program classes (PT-COMM-003 mesh
+    scaling, PT-COMM-005 contract drift) need a width pair / the
+    baseline and live in tools/audit_collectives.py."""
+
+    name = "comm"
+
+    def __init__(self, spec: Optional[CommPathSpec] = None, suppress=()):
+        super().__init__(suppress=suppress)
+        self.spec = spec
+        self.manifest: Optional[CommManifest] = None
+
+    def analyze(self, program) -> List[Diagnostic]:
+        name = self.spec.name if self.spec is not None else "program"
+        self.manifest = compute_comm_manifest(program, name=name,
+                                              spec=self.spec)
+        findings = list(check_replication(program, name))
+        findings += check_loop_invariant_collectives(program, name)
+        findings += check_gather_reduce(program, name)
+        return findings

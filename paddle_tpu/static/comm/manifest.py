@@ -1,0 +1,180 @@
+"""Comm manifest — the per-program record the PT-COMM gate baselines.
+
+``compute_comm_manifest`` folds the collective walk (collectives.py)
+into one JSON-able :class:`CommManifest`: a static census of collective
+equations per (normalized) primitive, per-mesh-axis dispatch and wire-
+byte totals (execution multipliers applied — a collective in a scan
+body of length L counts L times), the loop-invariant count, and — once
+:func:`mesh_scaling_verdict` has seen the same program family at two
+mesh widths — the mesh-scaling law record.
+
+Counts come in two flavors, same convention as PT-COST:
+
+- ``collective_eqns`` / ``collectives`` are STATIC equation counts
+  (scan bodies count once) — they measure *program text*, the thing
+  that explodes when a python loop over mesh size unrolls.
+- ``comm_bytes`` / ``dispatches`` apply the multipliers — they measure
+  *wire traffic per program dispatch*.
+
+The mesh-scaling law differs from PT-COST's slot law in one deliberate
+way: ring collectives move ``(n-1)``-shaped volumes, which between
+small widths grow FASTER than proportionally (2 -> 4 devices triples
+``n-1``) while staying asymptotically linear. The law therefore allows
+per-step growth up to ``max(n_b/n_a, (n_b-1)/(n_a-1))`` before calling
+a family superlinear — an O(n^2) term still fails it.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from .collectives import iter_collectives
+
+__all__ = ["CommManifest", "CommPathSpec", "compute_comm_manifest",
+           "mesh_scaling_verdict"]
+
+#: per-program detail rows kept in the manifest (census stays bounded)
+_MAX_DETAILS = 64
+
+
+@dataclass
+class CommPathSpec:
+    """Reviewed registration of one mesh-sharded program
+    (tools/audit_collectives.py): the symbolic mesh it is traced under,
+    its width for the mesh-scaling law (``name@width`` families), and —
+    for the single-device serving programs — the explicit ``unsharded``
+    contract the sharding PR (ROADMAP item 1) must flip."""
+
+    name: str
+    mesh: Dict[str, int] = field(default_factory=dict)
+    width: Optional[int] = None
+    unsharded: bool = False
+    notes: str = ""
+
+
+@dataclass
+class CommManifest:
+    program: str
+    mesh: Dict[str, int] = field(default_factory=dict)
+    width: Optional[int] = None
+    unsharded: bool = False
+    collective_eqns: int = 0                  # static, containers recursed
+    collectives: Dict[str, int] = field(default_factory=dict)  # per prim
+    per_axis: Dict[str, Dict[str, float]] = field(default_factory=dict)
+    dispatches: float = 0.0                   # multipliers applied
+    comm_bytes: float = 0.0                   # wire bytes, mult applied
+    payload_bytes: float = 0.0                # operand bytes, mult applied
+    loop_invariant_eqns: int = 0
+    details: List[Dict] = field(default_factory=list)
+    scaling: Optional[Dict] = None
+
+    def to_dict(self) -> Dict:
+        return {
+            "program": self.program, "mesh": dict(self.mesh),
+            "width": self.width, "unsharded": self.unsharded,
+            "collective_eqns": self.collective_eqns,
+            "collectives": dict(self.collectives),
+            "per_axis": {k: dict(v) for k, v in self.per_axis.items()},
+            "dispatches": self.dispatches, "comm_bytes": self.comm_bytes,
+            "payload_bytes": self.payload_bytes,
+            "loop_invariant_eqns": self.loop_invariant_eqns,
+            "details": [dict(d) for d in self.details],
+            "scaling": self.scaling,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "CommManifest":
+        m = cls(program=d.get("program", "?"))
+        for k, v in d.items():
+            if hasattr(m, k):
+                setattr(m, k, v)
+        return m
+
+
+def compute_comm_manifest(program_or_jaxpr, name: str = "program",
+                          spec: Optional[CommPathSpec] = None
+                          ) -> CommManifest:
+    """Fold the collective walk into one manifest. Pure tracing
+    arithmetic — no XLA compile, no device dispatch. When the argument
+    is a traced Program import, the manifest is also attached as
+    ``program._comm_manifest``."""
+    m = CommManifest(program=name,
+                     mesh=dict(spec.mesh) if spec is not None else {},
+                     width=spec.width if spec is not None else None,
+                     unsharded=spec.unsharded if spec is not None else False)
+    for c in iter_collectives(program_or_jaxpr,
+                              mesh=spec.mesh if spec is not None else None):
+        m.collective_eqns += 1
+        m.collectives[c.prim] = m.collectives.get(c.prim, 0) + 1
+        m.dispatches += float(c.mult)
+        m.comm_bytes += c.total_wire_bytes
+        m.payload_bytes += c.payload_bytes * c.mult
+        if c.loop_invariant:
+            m.loop_invariant_eqns += 1
+        for a in c.axes:
+            slot = m.per_axis.setdefault(
+                a, {"eqns": 0, "dispatches": 0.0, "bytes": 0.0})
+            slot["eqns"] += 1
+            slot["dispatches"] += float(c.mult)
+            slot["bytes"] += c.total_wire_bytes
+        for a, s in c.axis_sizes.items():
+            m.mesh.setdefault(a, s)
+        if len(m.details) < _MAX_DETAILS:
+            m.details.append({
+                "prim": c.prim, "axes": list(c.axes), "group": c.group_size,
+                "scope": c.scope, "mult": c.mult,
+                "wire_bytes": c.bytes_wire,
+                "loop_invariant": c.loop_invariant})
+    if hasattr(program_or_jaxpr, "global_block"):
+        program_or_jaxpr._comm_manifest = m
+    return m
+
+
+def world_size(mesh: Dict[str, int]) -> int:
+    n = 1
+    for v in mesh.values():
+        n *= max(int(v), 1)
+    return n
+
+
+def mesh_scaling_verdict(manifests: Sequence[CommManifest],
+                         tol: float = 0.25) -> Dict:
+    """The mesh-scaling law (PT-COMM-003): the SAME program family traced
+    at ascending mesh widths must keep wire bytes and collective count
+    within the ring envelope — per step ``a -> b`` the allowed growth is
+    ``max(w_b/w_a, (w_b-1)/(w_a-1))`` (module docstring): ring volumes
+    are (n-1)-shaped and legal; an O(n^2) term (a python loop over mesh
+    size emitting a collective per rank, an all-gather whose payload
+    itself grows with n) fails. The verdict is recorded onto every
+    participating manifest."""
+    ms = sorted(manifests, key=lambda m: (m.width or 0))
+    widths = [m.width for m in ms]
+    if len(ms) < 2 or any(w is None or w <= 0 for w in widths):
+        raise ValueError("mesh scaling law needs >=2 manifests with widths")
+    verdict, worst = "<=ring", 0.0
+    for a, b in zip(ms, ms[1:]):
+        grow = b.width / a.width
+        if a.width > 1:
+            grow = max(grow, (b.width - 1.0) / (a.width - 1.0))
+        for attr in ("comm_bytes", "collective_eqns"):
+            va, vb = float(getattr(a, attr)), float(getattr(b, attr))
+            if va <= 0:
+                if vb > 0:          # comm appears from nothing with width
+                    worst = max(worst, float("inf"))
+                    verdict = "superlinear"
+                continue
+            ratio = (vb / va) / grow    # 1.0 == exactly the ring envelope
+            worst = max(worst, ratio)
+            if ratio > 1.0 + tol:
+                verdict = "superlinear"
+    rec = {"widths": widths,
+           "comm_bytes": [m.comm_bytes for m in ms],
+           "collective_eqns": [m.collective_eqns for m in ms],
+           "verdict": verdict,
+           "worst_ring_ratio": (round(worst, 4)
+                                if worst != float("inf") else "inf"),
+           "tol": tol}
+    for m in ms:
+        m.scaling = rec
+    return rec

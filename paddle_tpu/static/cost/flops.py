@@ -37,6 +37,7 @@ FAMILIES = ("dot", "conv", "elementwise", "reduce", "sort", "rng",
 #: container primitives — cost lives in their inner jaxprs
 _CONTAINER_KEYS = {
     "scan": ("jaxpr",),
+    "shard_map": ("jaxpr",),
     "while": ("cond_jaxpr", "body_jaxpr"),
     "cond": ("branches",),
     "pjit": ("jaxpr",),

@@ -415,6 +415,53 @@ class TestSpmdChecker:
             t.placements = [Shard(0)]
         assert not SpmdConsistencyChecker().analyze(main)
 
+    def test_finding_ids_are_stable_and_line_number_free(self):
+        """Every SPMD diagnostic carries a ``CODE:scope:detail`` finding
+        id (the PT-RACE/PT-COST baseline scheme): the same defect must
+        keep the same id no matter WHERE in the program it sits — ids
+        name what is wrong, never source positions — while distinct
+        defects get distinct ids."""
+        from paddle_tpu.distributed.auto_parallel import (ProcessMesh,
+                                                          Replicate, Shard)
+
+        def build(n_padding_ops):
+            """The same mesh-conflict defect after n unrelated ops."""
+            main = static.Program()
+            with program_guard(main):
+                for i in range(n_padding_ops):   # shift op indices around
+                    static.data(f"pad{i}", [2], "float32") * 2.0
+                a = static.data("a", [8, 4], "float32")
+                b = static.data("b", [8, 4], "float32")
+                a + b
+            a.process_mesh = ProcessMesh(shape=[2], dim_names=["dp"])
+            a.placements = [Shard(0)]
+            b.process_mesh = ProcessMesh(shape=[4], dim_names=["mp"])
+            b.placements = [Replicate()]
+            return [d for d in SpmdConsistencyChecker().analyze(main)
+                    if d.code == "PT-SPMD-003"]
+
+        ids0 = sorted(d.finding_id for d in build(0))
+        ids5 = sorted(d.finding_id for d in build(5))
+        assert ids0 and ids0 == ids5         # position-independent
+        assert "PT-SPMD-003:add:mesh-conflict:a:b" in ids0
+        for fid in ids0:                     # never a source position
+            assert ":line" not in fid and ".py" not in fid
+
+        # check_placements details are defect-shaped, not positional,
+        # and distinct defect classes never collide
+        mesh = ProcessMesh(shape=[2, 4], dim_names=["dp", "mp"])
+        bad_dim = check_placements((8, 6), mesh, [Shard(5), Replicate()],
+                                   where="input 'w'")
+        uneven = check_placements((8, 6), mesh, [Replicate(), Shard(1)],
+                                  where="input 'w'")
+        assert bad_dim[0].finding_id == "PT-SPMD-001:input_w:shard-dim:5:dp"
+        assert uneven[0].finding_id == "PT-SPMD-002:input_w:uneven:dim1:x4"
+        assert bad_dim[0].finding_id != uneven[0].finding_id
+        # identical defect described twice -> identical id (baselinable)
+        again = check_placements((8, 6), mesh, [Shard(5), Replicate()],
+                                 where="input 'w'")
+        assert again[0].finding_id == bad_dim[0].finding_id
+
 
 # ---------------------------------------------------------------------------
 # graph health / Program.diagnose

@@ -25,6 +25,7 @@ def live_surfaces():
     import paddle_tpu as paddle
     from paddle_tpu.inference import procfleet as _procfleet
     from paddle_tpu.inference import serving as _serving
+    from paddle_tpu.static import comm as _comm
     from paddle_tpu.static import concurrency as _concurrency
     from paddle_tpu.static import cost as _cost
 
@@ -39,6 +40,7 @@ def live_surfaces():
         "paddle.inference.serving": names(_serving),
         "paddle.observability": names(paddle.observability),
         "paddle.quantization": names(paddle.quantization),
+        "paddle.static.comm": names(_comm),
         "paddle.static.concurrency": names(_concurrency),
         "paddle.static.cost": names(_cost),
         "paddle": names(paddle),
