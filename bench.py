@@ -17,6 +17,7 @@ attention (causal halves it).
 
 from __future__ import annotations
 
+import contextlib
 import json
 import time
 
@@ -1114,6 +1115,105 @@ def bench_disagg(dev, on_tpu):
               "serving_kv_migration_time_s omitted", flush=True)
 
 
+def bench_serving_migration_under_loss(dev, on_tpu):
+    """KV-migration tail under seeded wire loss (docs/SERVING.md
+    "Transport seam"; ISSUE 17). A/B on a loopback-transport
+    ProcTieredRouter (1 prefill + 2 decode, workers are threads in this
+    process): the same request wave runs once on a CLEAN chaos-wrapped
+    wire, then under a seeded FaultPlan that DROPS one MIGRATE_IN frame
+    and BITFLIPS the KV payload of another (re-framed, so only the
+    end-to-end per-page crc32 catches it) — the drill pair
+    ``net_flaky_migration`` proves byte-identity; this line prices it.
+    Recovery (payload-sized timeout -> hedged re-splice under a stable
+    idempotence key, typed KVChainCorrupt refusal -> retry elsewhere)
+    stays ON in both arms so the delta is injected loss, not feature
+    overhead. Emits ``serving_migration_under_loss_p99_s``: p99
+    export -> splice wall time per migrated chain in the lossy arm
+    (clean-arm p99 prints as a comment for the A/B read), SECONDARY-
+    guarded with a floor sized to the hedge timeout so CPU weather
+    cannot flap it."""
+    import tempfile
+
+    from paddle_tpu.distributed.resilience import FaultPlan, FaultSpec
+    from paddle_tpu.inference.procfleet import (ProcFleetConfig,
+                                                ProcTieredRouter)
+    from paddle_tpu.inference.serving import Request
+    from paddle_tpu.models import LlamaConfig
+
+    vocab = LlamaConfig.tiny().vocab_size
+    op_timeout_s = 5.0
+
+    def cfg():
+        return ProcFleetConfig(
+            factory="paddle_tpu.inference.procfleet.presets:"
+                    "tiny_llama_prefix_engine",
+            factory_kwargs={"seed": 11}, transport="loopback",
+            chaos=True, op_timeout_s=op_timeout_s, hedge=True,
+            verify_crc=True)
+
+    def wave(seed0):
+        rng = np.random.default_rng(47)
+        return [Request(rng.integers(0, vocab, (6,)).astype(np.int32),
+                        max_new_tokens=8, seed=seed0 + i)
+                for i in range(8)]
+
+    def run(tiered, reqs, plan=None):
+        """One wave to completion; returns the migration samples it
+        added (per-chain export -> splice wall time, hedge wait
+        included)."""
+        n0 = len(tiered.migration_samples)
+        ctx = plan if plan is not None else contextlib.nullcontext()
+        with ctx:
+            for r in reqs:
+                tiered.submit(r)
+            tiered.run_until_done(max_steps=800)
+        if any(r.failed or not r.done for r in reqs):
+            raise RuntimeError("migration-under-loss wave lost requests")
+        return list(tiered.migration_samples[n0:])
+
+    def arm(plan=None):
+        """Fresh router per arm (engines re-pay jit compile — the warm
+        wave eats it so the measured wave prices steady-state handoff,
+        and the faulted wave never times out on a compile)."""
+        with tempfile.TemporaryDirectory() as tmp:
+            tiered = ProcTieredRouter(cfg(), cfg(), tmp,
+                                      num_prefill=1, num_decode=2)
+            try:
+                run(tiered, wave(970))                      # warm/compile
+                samples = run(tiered, wave(990), plan=plan)
+                return samples, dict(tiered.stats)
+            finally:
+                tiered.close()
+
+    clean, _ = arm()
+    plan = FaultPlan(seed=7, specs=[
+        FaultSpec("net.send", "drop", at=1, count=1, match="MIGRATE_IN"),
+        FaultSpec("net.send", "bitflip", at=4, count=1, arg=64,
+                  match="MIGRATE_IN")])
+    lossy, stats = arm(plan)
+    fired = sorted(a for (_, _, a) in plan.log)
+    p99_clean = float(np.percentile(clean, 99)) if clean else None
+    print(f"# migration-under-loss A/B: clean wire "
+          f"{len(clean)} migration(s) p99 "
+          f"{None if p99_clean is None else round(p99_clean, 3)}s; lossy "
+          f"wire {len(lossy)} migration(s), faults fired {fired}, "
+          f"{stats['migration_hedges']} hedge(s), "
+          f"{stats['migration_corrupt']} typed refusal(s), "
+          f"{stats['migration_reprefill']} reprefill(s)", flush=True)
+    if not lossy or len(fired) < 2:
+        print("# migration-under-loss bench: faulted wave migrated "
+              "nothing (or faults never fired) — "
+              "serving_migration_under_loss_p99_s omitted", flush=True)
+        return
+    _emit("serving_migration_under_loss_p99_s",
+          float(np.percentile(lossy, 99)),
+          f"s (p99 export->splice per migrated chain with a seeded "
+          f"MIGRATE_IN drop + CRC-valid bitflip on the wire, hedged "
+          f"recovery on; clean-wire p99 "
+          f"{None if p99_clean is None else round(p99_clean, 3)}s)",
+          None)
+
+
 def bench_speculative(dev, on_tpu):
     """Speculative multi-token decoding + int8 paged-KV A/B (docs/
     SERVING.md "Speculative decode" / "int8 KV cache"; ROADMAP item 2).
@@ -1509,6 +1609,11 @@ def main():
         bench_disagg(dev, on_tpu)
     except Exception as e:
         print(f"# disagg bench failed: {e!r}", flush=True)
+    gc.collect()
+    try:
+        bench_serving_migration_under_loss(dev, on_tpu)
+    except Exception as e:
+        print(f"# migration-under-loss bench failed: {e!r}", flush=True)
     gc.collect()
     try:
         bench_speculative(dev, on_tpu)

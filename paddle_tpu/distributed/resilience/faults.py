@@ -56,6 +56,18 @@ Known sites (see docs/RESILIENCE.md for the catalogue):
                          transit between tiers (detail = ``rid:<id>``;
                          ``bitflip`` corrupts page bytes — the
                          PT-SRV-007 kv_migration_corruption drill)
+``net.connect``       ChaosTransport, before a transport connect
+                      (detail = ``peer``; ``drop``/``kill`` refuse it)
+``net.send``          ChaosTransport, frame about to ship (detail =
+                      ``peer:MSGTYPE``; ``drop`` loses the frame,
+                      ``duplicate`` delivers it twice, ``torn`` ships a
+                      prefix, ``bitflip`` flips payload bits UNDER the
+                      frame crc, ``blackhole`` swallows every later
+                      frame to that peer — the net_flaky_migration drill)
+``net.recv``          ChaosTransport, before a frame is awaited (detail
+                      = ``peer``; same actions on the receive side —
+                      ``stall`` holds the receive, the net_slow_peer
+                      drill)
 ====================  =====================================================
 
 With no plan installed every hook is a cheap no-op (one global read), so
@@ -72,7 +84,7 @@ from typing import List, Optional, Sequence
 
 __all__ = ["FaultSpec", "FaultPlan", "FaultInjected", "maybe_inject",
            "corrupt", "active_plan", "numeric_inject_code", "poison_arrays",
-           "resource_hold"]
+           "resource_hold", "wire_faults"]
 
 
 class FaultInjected(ConnectionError):
@@ -87,7 +99,7 @@ class FaultSpec:
     (-1 = every event from ``at`` on)."""
 
     site: str
-    action: str            # kill | stall | delay | error | bitflip | truncate | garbage
+    action: str            # kill | stall | delay | error | bitflip | truncate | garbage | drop | duplicate | torn | blackhole
     at: int = 0
     count: int = 1
     arg: float = 0.0       # seconds (stall/delay) or bytes/bits (data faults)
@@ -97,9 +109,11 @@ class FaultSpec:
     _DATA = ("bitflip", "truncate", "garbage")
     _NUMERIC = ("nan_grad", "loss_spike", "poison_batch")
     _RESOURCE = ("exhaust",)
+    _NET = ("drop", "duplicate", "torn", "blackhole")
 
     def __post_init__(self):
-        known = self._CONTROL + self._DATA + self._NUMERIC + self._RESOURCE
+        known = (self._CONTROL + self._DATA + self._NUMERIC
+                 + self._RESOURCE + self._NET)
         if self.action not in known:
             raise ValueError(
                 f"unknown fault action {self.action!r} (choose: {known})")
@@ -214,6 +228,19 @@ def corrupt(site: str, detail: str, data: bytes) -> bytes:
         elif s.action == "error":
             raise RuntimeError(f"fault injected: error at {site} ({detail})")
     return data
+
+
+def wire_faults(site: str, detail: str = "") -> List[FaultSpec]:
+    """Transport hook (``net.connect``/``net.send``/``net.recv``): return
+    the specs due at this wire event. The ChaosTransport interprets the
+    actions itself — several (``drop``, ``duplicate``, ``torn``,
+    ``blackhole``) need frame-level context a byte hook cannot express
+    (suppress a send, re-deliver, ship a prefix, poison a peer). No plan
+    -> empty list (one global read)."""
+    plan = _ACTIVE
+    if plan is None:
+        return []
+    return plan.fire(site, detail)
 
 
 def resource_hold(site: str, detail: str = "") -> int:

@@ -413,7 +413,12 @@ class TieredRouter(FleetRouter):
         self.stats.update(migrations=0, migration_s=0.0, migration_pages=0,
                           migration_bytes=0, migration_corrupt=0,
                           migration_deferred=0, migration_refused=0,
-                          migration_reprefill=0)
+                          migration_reprefill=0, migration_hedges=0)
+        #: per-migration wall-clock seconds, newest-last, capped — the
+        #: ``serving_migration_under_loss`` bench reads p99 from here
+        #: (hedges never fire in-process: no wire, no timeouts — the key
+        #: exists so collectors read both pumps uniformly)
+        self.migration_samples: List[float] = []
         self._corrupt_hook = None
 
     # -- tier membership (fleet.py hooks) ----------------------------------
@@ -551,15 +556,18 @@ class TieredRouter(FleetRouter):
                 placed = rep
                 break
             except KVChainCorrupt as e:
-                # PT-SRV-007: damage is not target-specific — stop trying
-                # to splice these bytes anywhere
+                # PT-SRV-007 takes the same retry-elsewhere arm as a
+                # refusal (UNIFIED policy, mirrored in the proc pump where
+                # wire-transit damage really is per-hop); in-process the
+                # bytes are shared so later targets will refuse them too,
+                # ending in the reprefill fallback below either way
                 corrupt_art = True
                 self.stats["migration_corrupt"] += 1
                 self.events.append(("PT-SRV-007", str(e)))
                 if self.tracer is not None:
                     self.tracer.migration_failure(
-                        rid, "corrupt", tags={"replica": src.idx})
-                break
+                        rid, "corrupt", tags={"replica": rep.idx})
+                continue
             except (EngineSaturated, ValueError):
                 # saturated at import (the pre-check's pool estimate was
                 # optimistic) — or a geometry refusal the pre-check
@@ -613,6 +621,8 @@ class TieredRouter(FleetRouter):
         dt = time.monotonic() - t0
         self.stats["migrations"] += 1
         self.stats["migration_s"] += dt
+        self.migration_samples.append(dt)
+        del self.migration_samples[:-512]
         self.stats["migration_pages"] += int(hdr["n_written"])
         self.stats["migration_bytes"] += len(art)
         self.events.append(

@@ -13,10 +13,18 @@ Modules:
 
 - :mod:`~paddle_tpu.inference.procfleet.wire` — the PT-PROC framed
   message protocol (:class:`WireCorrupt` = PT-PROC-001).
+- :mod:`~paddle_tpu.inference.procfleet.transport` — the pluggable
+  frame transport seam (:class:`TcpTransport`,
+  :class:`LoopbackTransport` for in-process thread workers, and the
+  fault-injecting :class:`ChaosTransport` driven by the ``net.*``
+  FaultPlan sites — docs/RESILIENCE.md).
 - :mod:`~paddle_tpu.inference.procfleet.worker` — the spawned replica
-  process (:class:`WorkerSpec`, ``worker_main``).
+  process (:class:`WorkerSpec`, ``worker_main``) and its loopback
+  thread twin (``worker_thread_main``).
 - :mod:`~paddle_tpu.inference.procfleet.proxy` — the driver-side replica
-  proxy (:class:`ProcReplica`, :class:`WorkerDead` = PT-PROC-002/003).
+  proxy (:class:`ProcReplica`, :class:`WorkerDead` = PT-PROC-002/003,
+  the per-peer :class:`CircuitBreaker` raising :class:`BreakerOpen` =
+  PT-PROC-004).
 - :mod:`~paddle_tpu.inference.procfleet.router` —
   :class:`ProcFleetRouter` / :class:`ProcTieredRouter` over
   :class:`ProcFleetConfig`.
@@ -29,12 +37,18 @@ stack in their OWN process — a driver spawning N replicas pays one jax
 runtime, not N.
 """
 
-from .proxy import ProcReplica, WorkerDead  # noqa: F401
+from .proxy import (BreakerOpen, CircuitBreaker, ProcReplica,  # noqa: F401
+                    WorkerDead)
 from .router import (ProcFleetConfig, ProcFleetRouter,  # noqa: F401
                      ProcTieredRouter)
+from .transport import (ChaosTransport, LoopbackTransport,  # noqa: F401
+                        TcpTransport, Transport, loopback_pair)
 from .wire import Message, WireClosed, WireCorrupt  # noqa: F401
-from .worker import WorkerSpec, worker_main  # noqa: F401
+from .worker import WorkerSpec, worker_main, worker_thread_main  # noqa: F401
 
-__all__ = ["Message", "ProcFleetConfig", "ProcFleetRouter", "ProcReplica",
-           "ProcTieredRouter", "WireClosed", "WireCorrupt", "WorkerDead",
-           "WorkerSpec", "worker_main"]
+__all__ = ["BreakerOpen", "ChaosTransport", "CircuitBreaker",
+           "LoopbackTransport", "Message", "ProcFleetConfig",
+           "ProcFleetRouter", "ProcReplica", "ProcTieredRouter",
+           "TcpTransport", "Transport", "WireClosed", "WireCorrupt",
+           "WorkerDead", "WorkerSpec", "loopback_pair", "worker_main",
+           "worker_thread_main"]

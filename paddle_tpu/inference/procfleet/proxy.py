@@ -50,10 +50,12 @@ import time
 from types import SimpleNamespace
 from typing import Dict, List, Optional, Set, Tuple
 
-from .wire import Message, WireClosed, WireCorrupt, recv_msg, send_msg
-from .worker import WorkerSpec
+from .transport import ChaosTransport, TcpTransport, Transport, \
+    loopback_pair
+from .wire import Message, WireClosed, WireCorrupt
+from .worker import WorkerSpec, worker_thread_main
 
-__all__ = ["ProcReplica", "WorkerDead"]
+__all__ = ["ProcReplica", "WorkerDead", "BreakerOpen", "CircuitBreaker"]
 
 # every live worker Popen, so an exiting driver never leaks processes —
 # guarded: ProcReplica spawns/reaps from driver threads while atexit runs
@@ -93,6 +95,76 @@ class WorkerDead(RuntimeError):
     the router fails its work over from the on-disk journal."""
 
 
+class BreakerOpen(RuntimeError):
+    """PT-PROC-004: this replica's circuit breaker is OPEN — the peer is
+    slow-but-alive (consecutive failures or a latency EMA past budget),
+    so ops fail FAST and the router routes around it. Deliberately not
+    :class:`WorkerDead`: nothing is failed over, no journal is replayed —
+    the worker keeps its in-flight state and rejoins when a HALF_OPEN
+    probe (riding the piggybacked PROGRESS tick) comes back healthy."""
+
+
+class CircuitBreaker:
+    """Per-peer CLOSED -> OPEN -> HALF_OPEN breaker driven from
+    ``_roundtrip`` outcomes (docs/SERVING.md "Transport seam").
+
+    Two trip conditions, both about slow-but-ALIVE peers (death has its
+    own path): ``fail_threshold`` consecutive retryable failures, or a
+    latency EMA above ``latency_s``. While OPEN every non-probe op
+    raises :class:`BreakerOpen` without touching the wire; after
+    ``cooldown_s`` the state is HALF_OPEN and exactly the idempotent
+    PROGRESS/METRICS probes pass — one healthy (fast) probe closes the
+    breaker, a failed or still-slow one reopens it. All methods are
+    called under the proxy's ``_state_lock``."""
+
+    def __init__(self, fail_threshold: int = 3,
+                 latency_s: Optional[float] = None,
+                 cooldown_s: float = 5.0, ema_alpha: float = 0.4):
+        self.fail_threshold = int(fail_threshold)
+        self.latency_s = None if latency_s is None else float(latency_s)
+        self.cooldown_s = float(cooldown_s)
+        self.ema_alpha = float(ema_alpha)
+        self.state = "closed"
+        self.ema_s = 0.0
+        self.fails = 0
+        self.trips = 0
+        self._opened_at = 0.0
+
+    def allow(self, probe: bool) -> bool:
+        if self.state == "closed":
+            return True
+        if self.state == "open":
+            if time.monotonic() - self._opened_at < self.cooldown_s:
+                return False
+            self.state = "half_open"
+        return probe                     # HALF_OPEN: probes only
+
+    def _trip(self) -> None:
+        if self.state != "open":
+            self.state = "open"
+            self.trips += 1
+        self._opened_at = time.monotonic()
+
+    def record(self, ok: bool, dt_s: float) -> None:
+        if not ok:
+            self.fails += 1
+            if self.state == "half_open" or self.fails >= self.fail_threshold:
+                self._trip()
+            return
+        self.fails = 0
+        a = self.ema_alpha
+        self.ema_s = dt_s if self.ema_s == 0.0 else \
+            a * dt_s + (1.0 - a) * self.ema_s
+        slow = self.latency_s is not None and self.ema_s > self.latency_s
+        if self.state == "half_open":
+            if slow:
+                self._trip()             # answered, but still past budget
+            else:
+                self.state = "closed"
+        elif self.state == "closed" and slow:
+            self._trip()
+
+
 def _retry_policy():
     from ...distributed.resilience.retry import RetryPolicy
 
@@ -113,12 +185,26 @@ class ProcReplica:
                  trace_tags: Optional[dict] = None,
                  op_timeout_s: float = 60.0, spawn_timeout_s: float = 240.0,
                  heartbeat_s: Optional[float] = None,
-                 stats: Optional[dict] = None):
+                 stats: Optional[dict] = None,
+                 transport: str = "tcp", chaos: bool = False,
+                 breaker: Optional[dict] = None,
+                 migrate_bw_bytes_per_s: float = 32.0 * 1024 * 1024):
+        if transport not in ("tcp", "loopback"):
+            raise ValueError(
+                f"unknown transport {transport!r} (tcp | loopback)")
         self.idx = int(idx)
         self.spec = spec
         self.tracer = tracer
         self.trace_tags = dict(trace_tags or {})
         self.op_timeout_s = float(op_timeout_s)
+        # MIGRATE_IN/OUT deadlines scale with payload bytes over this
+        # assumed bandwidth: a legitimately big int8 chain must not read
+        # as a wedged worker (or trip the breaker) under the flat budget
+        self._migrate_bw = float(migrate_bw_bytes_per_s)
+        self._breaker = None if breaker is None else CircuitBreaker(
+            **dict(breaker))
+        self.transport_retries = 0      # retryable timeouts, this peer
+        self._idem_counter = 0
         self.stats = stats if stats is not None else {}
         self.requests: Dict[int, "object"] = {}   # rid -> caller Request
         self._done: Set[int] = set()
@@ -147,66 +233,102 @@ class ProcReplica:
         self._fault_hook = None
         self._fault_cls = None
 
-        listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        listener.bind(("127.0.0.1", 0))
-        listener.listen(1)
-        host, port = listener.getsockname()
-        # the worker is a PLAIN subprocess (`python -m ...worker`): no
-        # inherited interpreter state, no parent-__main__ re-execution —
-        # the spec travels as a pickle file beside the journal, env vars
-        # (JAX_PLATFORMS etc.) are applied before the child's first import
-        self._spec_path = spec.journal_path + ".spec"
-        with open(self._spec_path, "wb") as f:
-            f.write(pickle.dumps(spec))
-        env = dict(os.environ)
-        env["PYTHONPATH"] = os.pathsep.join(
-            [p for p in sys.path if p]
-            + [p for p in (env.get("PYTHONPATH") or "").split(os.pathsep)
-               if p])
-        env.update({k: str(v) for k, v in (spec.env or {}).items()})
-        self.process = subprocess.Popen(
-            [sys.executable, "-m",
-             "paddle_tpu.inference.procfleet._spawn_main",
-             "--spec", self._spec_path, "--host", host,
-             "--port", str(port)],
-            env=env, stdin=subprocess.DEVNULL)
-        _track_worker(self.process.pid)
-        self.stats["proc_spawned"] = self.stats.get("proc_spawned", 0) + 1
+        self.process = None
+        self._worker_thread: Optional[threading.Thread] = None
+        self._spec_path = None
+        deadline = time.monotonic() + float(spawn_timeout_s)
+        if transport == "loopback":
+            # in-process worker on a thread over a queue-pair transport:
+            # same supervisor/journal/serve loop, no process spawn and no
+            # cold jit — the fast arm for tests and chaos drills. "Process
+            # death" is the transport closing; failover reads the journal
+            # identically.
+            drv_tr, wrk_tr = loopback_pair(
+                a="driver", b=f"replica:{idx}:loopback")
+            base = drv_tr
+            self._worker_thread = threading.Thread(
+                target=worker_thread_main, args=(spec, wrk_tr),
+                name=f"pt-procfleet-worker-{idx}", daemon=True)
+            self._worker_thread.start()
+            self.stats["proc_spawned"] = \
+                self.stats.get("proc_spawned", 0) + 1
+        else:
+            listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            listener.bind(("127.0.0.1", 0))
+            listener.listen(1)
+            host, port = listener.getsockname()
+            # the worker is a PLAIN subprocess (`python -m ...worker`): no
+            # inherited interpreter state, no parent-__main__ re-execution —
+            # the spec travels as a pickle file beside the journal, env vars
+            # (JAX_PLATFORMS etc.) are applied before the child's first
+            # import
+            self._spec_path = spec.journal_path + ".spec"
+            with open(self._spec_path, "wb") as f:
+                f.write(pickle.dumps(spec))
+            env = dict(os.environ)
+            env["PYTHONPATH"] = os.pathsep.join(
+                [p for p in sys.path if p]
+                + [p for p in (env.get("PYTHONPATH") or "").split(os.pathsep)
+                   if p])
+            env.update({k: str(v) for k, v in (spec.env or {}).items()})
+            self.process = subprocess.Popen(
+                [sys.executable, "-m",
+                 "paddle_tpu.inference.procfleet._spawn_main",
+                 "--spec", self._spec_path, "--host", host,
+                 "--port", str(port)],
+                env=env, stdin=subprocess.DEVNULL)
+            _track_worker(self.process.pid)
+            self.stats["proc_spawned"] = \
+                self.stats.get("proc_spawned", 0) + 1
+            try:
+                # short accept slices with a child liveness poll: a worker
+                # that dies before connecting back (spec unpickle/import
+                # failure) fails the spawn NOW, not after spawn_timeout_s
+                while True:
+                    if self.process.poll() is not None:
+                        raise WireClosed(
+                            f"worker exited rc={self.process.returncode} "
+                            "before connecting back")
+                    listener.settimeout(
+                        min(0.5, max(0.05, deadline - time.monotonic())))
+                    try:
+                        conn, _ = listener.accept()
+                        break
+                    except socket.timeout:
+                        if time.monotonic() >= deadline:
+                            raise
+            except (socket.timeout, WireClosed) as e:
+                self.kill()
+                self._reap()
+                listener.close()
+                raise WorkerDead(
+                    f"PT-PROC-002: replica {idx} worker never connected "
+                    f"back within {spawn_timeout_s:.0f}s "
+                    f"({type(e).__name__}: {e})") from e
+            finally:
+                listener.close()
+            base = TcpTransport(sock=conn)
+        #: stable peer address for chaos matching, retry-stat tags and the
+        #: breaker-state metric — ``replica:<i>@<transport endpoint>``
+        self.peer = f"replica:{idx}@{base.peer}"
+        self._tr: Transport = (ChaosTransport(base, peer=self.peer)
+                               if chaos else base)
         try:
-            deadline = time.monotonic() + float(spawn_timeout_s)
-            # short accept slices with a child liveness poll: a worker
-            # that dies before connecting back (spec unpickle/import
-            # failure) fails the spawn NOW, not after spawn_timeout_s
-            while True:
-                if self.process.poll() is not None:
-                    raise WireClosed(
-                        f"worker exited rc={self.process.returncode} "
-                        "before connecting back")
-                listener.settimeout(
-                    min(0.5, max(0.05, deadline - time.monotonic())))
-                try:
-                    self._sock, _ = listener.accept()
-                    break
-                except socket.timeout:
-                    if time.monotonic() >= deadline:
-                        raise
-            hello = recv_msg(
-                self._sock,
+            self._tr.connect()
+            hello = self._tr.recv_frame(
                 timeout=max(0.1, deadline - time.monotonic()))
-            self._sock.settimeout(None)
-        except (socket.timeout, WireClosed, WireCorrupt) as e:
+            if isinstance(base, TcpTransport):
+                base.sock.settimeout(None)
+        except (socket.timeout, ConnectionError, WireCorrupt) as e:
             # no handshake ever happened: nothing to wait for — kill and
             # reap immediately (the graceful wait is close()'s courtesy
             # for workers that acknowledged a SHUTDOWN)
             self.kill()
             self._reap()
-            listener.close()
             raise WorkerDead(
                 f"PT-PROC-002: replica {idx} worker never said HELLO "
                 f"within {spawn_timeout_s:.0f}s ({type(e).__name__}: {e})"
             ) from e
-        finally:
-            listener.close()
         if hello.mtype != "HELLO":
             self.kill()
             self._reap()
@@ -266,15 +388,31 @@ class ProcReplica:
             f"PT-PROC-002: replica {self.idx} {what} failed fatally "
             f"({etype}: {msg})")
 
+    def _record(self, ok: bool, dt_s: float) -> None:
+        if self._breaker is None:
+            return
+        with self._state_lock:
+            self._breaker.record(ok, dt_s)
+
     def _roundtrip(self, msg: Message, what: str,
                    timeout: Optional[float] = None,
                    expect: Tuple[str, ...] = (),
-                   fatal_timeout: bool = True) -> Message:
+                   fatal_timeout: bool = True,
+                   probe: bool = False) -> Message:
         timeout = self.op_timeout_s if timeout is None else timeout
         if self.dead:
             raise WorkerDead(
                 f"PT-PROC-002: replica {self.idx} is already dead "
                 f"({what} refused)")
+        if self._breaker is not None:
+            with self._state_lock:
+                allowed = self._breaker.allow(probe)
+            if not allowed:
+                raise BreakerOpen(
+                    f"PT-PROC-004: replica {self.idx} breaker is "
+                    f"{self._breaker.state} — {what} routed around "
+                    "(peer slow, not dead)")
+        t0 = time.monotonic()
         try:
             with self._io_lock:
                 # every request carries a sequence id the worker echoes:
@@ -286,23 +424,27 @@ class ProcReplica:
                 self._seq += 1
                 seq = self._seq
                 msg.payload["_seq"] = seq
-                send_msg(self._sock, msg)
+                self._tr.send_frame(msg)
                 deadline = time.monotonic() + timeout
                 while True:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
                         raise socket.timeout(f"{what} reply deadline")
-                    reply = recv_msg(self._sock, timeout=remaining)
+                    reply = self._tr.recv_frame(timeout=remaining)
                     got = reply.payload.pop("_seq", None)
                     if got is None or got == seq:
                         break
         except socket.timeout as e:
+            self._record(False, time.monotonic() - t0)
             # a timeout with NO reply bytes consumed leaves the stream
             # aligned — the seq drain absorbs the late reply, so an
-            # idempotent probe may retry. A timeout MID-frame leaves the
-            # position unusable: fatal regardless of the retry policy.
+            # idempotent probe (or a hedged migration) may retry. A
+            # timeout MID-frame leaves the position unusable: fatal
+            # regardless of the retry policy.
             if not fatal_timeout and not getattr(e, "partial_read", False):
-                raise        # idempotent probe: retry_call owns the retry
+                with self._state_lock:
+                    self.transport_retries += 1
+                raise        # retryable: retry_call / the hedge owns it
             self._note_dead()
             raise WorkerDead(
                 f"PT-PROC-003: replica {self.idx} {what} timed out after "
@@ -319,6 +461,9 @@ class ProcReplica:
             raise WorkerDead(
                 f"PT-PROC-002: replica {self.idx} worker gone during "
                 f"{what}: {e}") from e
+        # the worker ANSWERED — even an ERROR reply means the peer is
+        # alive and timely; only wire-level outcomes feed the breaker
+        self._record(True, time.monotonic() - t0)
         if reply.mtype == "ERROR":
             self._raise_error(reply, what)
         if expect and reply.mtype not in expect:
@@ -334,14 +479,29 @@ class ProcReplica:
 
     # -- replica surface (what FleetRouter consumes) -----------------------
     def submit(self, req, resume: bool = False) -> int:
+        # idempotence key: unique per LOGICAL admission (a later,
+        # legitimate re-admit of the same rid gets a fresh key), constant
+        # across duplicate deliveries of this one frame — a chaos-doubled
+        # SUBMIT answers from the worker's idem cache instead of
+        # double-admitting
+        with self._state_lock:
+            self._idem_counter += 1
+            idem = f"sub:{self.idx}:{self._idem_counter}"
         payload = {"req": _admit(req), "resume": bool(resume),
                    "delivered": [int(t) for t in req.output] if resume
-                   else []}
+                   else [], "idem": idem}
         if resume and self.tracer is not None:
             self.tracer.mark_recovered(req.rid, len(req.output),
                                        self._tags(req))
-        reply = self._roundtrip(Message("SUBMIT", payload), "submit",
-                                expect=("SUBMITTED",))
+        try:
+            reply = self._roundtrip(Message("SUBMIT", payload), "submit",
+                                    expect=("SUBMITTED",))
+        except BreakerOpen as e:
+            # to the router an OPEN breaker is indistinguishable from a
+            # full engine: same typed refusal, same route-elsewhere
+            from ..serving import EngineSaturated
+
+            raise EngineSaturated(str(e)) from e
         self._apply({"load": reply.payload["load"], "has_work": True})
         req._n_out = len(req.output)
         with self._state_lock:
@@ -371,8 +531,14 @@ class ProcReplica:
             # step below then fails on the dead socket and the router's
             # journal-backed failover takes over (the drill's point)
             self.kill()
-        reply = self._roundtrip(Message("STEP"), "step",
-                                expect=("TOKENS",))
+        try:
+            reply = self._roundtrip(Message("STEP"), "step",
+                                    expect=("TOKENS",))
+        except BreakerOpen:
+            # skip the tick: the worker keeps its in-flight state and the
+            # streams resume when a HALF_OPEN probe closes the breaker —
+            # deliberately NOT death, nothing fails over
+            return
         self._apply(reply.payload)
 
     def _apply(self, p: dict) -> None:
@@ -443,11 +609,13 @@ class ProcReplica:
         from ...distributed.resilience.retry import RetryError, retry_call
 
         try:
+            # stats tagged BY PEER: `scrape_metrics` / RetryStats then
+            # show which replica's wire is flaky, not just that one is
             reply = retry_call(self._roundtrip, Message("PROGRESS"), what,
                                expect=("PROGRESS_REPLY",),
-                               fatal_timeout=False,
+                               fatal_timeout=False, probe=True,
                                policy=_retry_policy(),
-                               what=f"procfleet.{what}")
+                               what=f"procfleet.{what}@{self.peer}")
         except (socket.timeout, RetryError) as e:
             self._note_dead()
             raise WorkerDead(
@@ -523,9 +691,11 @@ class ProcReplica:
         try:
             reply = retry_call(self._roundtrip, Message("METRICS"),
                                "metrics", expect=("METRICS_TEXT",),
-                               fatal_timeout=False,
+                               fatal_timeout=False, probe=True,
                                policy=_retry_policy(),
-                               what="procfleet.metrics")
+                               what=f"procfleet.metrics@{self.peer}")
+        except BreakerOpen:
+            return ""        # scrape must not break over a tripped peer
         except (socket.timeout, RetryError) as e:
             self._note_dead()
             raise WorkerDead(
@@ -539,13 +709,36 @@ class ProcReplica:
         return out
 
     # -- tiered migration over the wire ------------------------------------
+    def _migration_timeout(self, nbytes: int) -> float:
+        """Per-op deadline SIZED TO THE PAYLOAD: the flat budget plus the
+        wire time those bytes take at the assumed bandwidth — a large int8
+        chain must not read as a wedged worker under a flat timeout, and a
+        small one must not get a big chain's slack."""
+        return self.op_timeout_s + float(max(0, nbytes)) / self._migrate_bw
+
+    def _chain_bytes_bound(self) -> int:
+        """Upper bound on any exported chain's size, from the HELLO
+        geometry (layers x K/V x heads x page x head_dim x itemsize x max
+        pages); 0 when the worker has no paged pool (flat timeout)."""
+        eng = self.engine
+        layers = getattr(eng, "layers", None)
+        if layers is None:
+            return 0
+        dtype = str(getattr(eng, "dtype", ""))
+        itemsize = 1 if "int8" in dtype else \
+            2 if ("bfloat16" in dtype or "float16" in dtype) else 4
+        return (int(layers) * 2 * int(eng.kvh) * int(eng.page_size)
+                * int(eng.hd) * itemsize * int(eng.maxp))
+
     def export_migration(self, rid: int) -> Tuple[dict, bytes]:
         """MIGRATE_OUT: the worker flushes, exports rid's KV chain,
         journals ``migr-kv`` and releases the slot; returns
         ``(header-lite, artifact bytes)``. After this returns, the rid is
         no longer this worker's responsibility."""
-        reply = self._roundtrip(Message("MIGRATE_OUT", {"rid": int(rid)}),
-                                "migrate_out", expect=("CHAIN",))
+        reply = self._roundtrip(
+            Message("MIGRATE_OUT", {"rid": int(rid)}), "migrate_out",
+            timeout=self._migration_timeout(self._chain_bytes_bound()),
+            expect=("CHAIN",))
         # deltas the export's flush surfaced land BEFORE ownership moves:
         # the caller's delivered prefix now equals the artifact's
         self._apply({"updates": reply.payload["updates"]})
@@ -555,16 +748,27 @@ class ProcReplica:
             self._submit_ts.pop(rid, None)
         return dict(reply.payload), reply.blob
 
-    def import_migration(self, user, artifact: bytes) -> int:
+    def import_migration(self, user, artifact: bytes,
+                         idem: Optional[str] = None) -> int:
         """MIGRATE_IN: splice an exported chain into this worker and
         resume decode at the recorded position. Raises ``KVChainCorrupt``
-        / ``EngineSaturated`` exactly like the in-process splice."""
+        / ``EngineSaturated`` exactly like the in-process splice.
+
+        The timeout is sized to ``len(artifact)`` and is NOT fatal: a
+        clean deadline (no reply bytes consumed) raises ``socket.timeout``
+        with the replica alive so the router can HEDGE the splice onto
+        another worker — the seq drain absorbs this attempt's late
+        SPLICED, and ``idem`` (stable across attempts at one target) keeps
+        a chaos-duplicated frame from double-splicing."""
+        payload = {"req": _admit(user),
+                   "delivered": [int(t) for t in user.output]}
+        if idem is not None:
+            payload["idem"] = str(idem)
         reply = self._roundtrip(
-            Message("MIGRATE_IN",
-                    {"req": _admit(user),
-                     "delivered": [int(t) for t in user.output]},
-                    blob=artifact),
-            "migrate_in", expect=("SPLICED",))
+            Message("MIGRATE_IN", payload, blob=artifact),
+            "migrate_in",
+            timeout=self._migration_timeout(len(artifact)),
+            expect=("SPLICED",), fatal_timeout=False)
         user._n_out = len(user.output)
         with self._state_lock:
             self.requests[user.rid] = user
@@ -576,11 +780,40 @@ class ProcReplica:
             self._streaming.add(user.rid)
         return int(reply.payload["rid"])
 
+    def migrate_cancel(self, rid: int, digest: str) -> bool:
+        """Roll back a hedge-loser's splice: if ``rid`` is still live on
+        this worker from a MIGRATE_IN carrying ``digest``, the worker
+        retires it (journal ``migr-kv``, pages decref'd — its allocator
+        ends where it started). Returns whether anything was rolled
+        back. Best-effort at call sites: the WINNER is already placed."""
+        reply = self._roundtrip(
+            Message("MIGRATE_CANCEL",
+                    {"rid": int(rid), "digest": str(digest)}),
+            "migrate_cancel", expect=("CANCELLED",))
+        return bool(reply.payload["rolled_back"])
+
+    def breaker_state(self) -> str:
+        """``closed`` / ``open`` / ``half_open`` (``closed`` when no
+        breaker is configured) — the ``pt_transport_breaker_state``
+        gauge and the router's hedge-target filter read this."""
+        with self._state_lock:
+            return "closed" if self._breaker is None else \
+                self._breaker.state
+
     # -- lifecycle ---------------------------------------------------------
     def _alive(self) -> bool:
+        if self.process is None:
+            t = self._worker_thread
+            return t is not None and t.is_alive()
         return self.process.poll() is None
 
     def _wait(self, timeout: float) -> bool:
+        if self.process is None:
+            t = self._worker_thread
+            if t is None:
+                return True
+            t.join(timeout=timeout)
+            return not t.is_alive()
         try:
             self.process.wait(timeout=timeout)
             return True
@@ -589,7 +822,18 @@ class ProcReplica:
 
     def kill(self) -> None:
         """SIGKILL the worker — real process death (fault drills; also the
-        wedged-worker arm of ``abandon``)."""
+        wedged-worker arm of ``abandon``). In loopback mode the kill is
+        slamming the transport shut: the worker thread's serve loop reads
+        WireClosed, abandons (no flush) and exits — failover reads the
+        journal identically to a killed process."""
+        if self.process is None:
+            try:
+                self._tr.close()
+            except (OSError, AttributeError):
+                pass
+            self._wait(5.0)
+            self._note_dead()
+            return
         if self._alive():
             os.kill(self.process.pid, signal.SIGKILL)
             self._wait(10.0)
@@ -607,8 +851,8 @@ class ProcReplica:
                 self._roundtrip(Message("SHUTDOWN"), "shutdown",
                                 timeout=self.op_timeout_s, expect=("BYE",))
                 acked = True
-            except (WorkerDead, WireCorrupt):
-                pass
+            except (WorkerDead, WireCorrupt, BreakerOpen):
+                pass    # an OPEN breaker at teardown falls back to kill
         if not acked:
             # the worker never acknowledged a shutdown: waiting for a
             # voluntary exit is a dead 5s — kill like abandon() does
@@ -629,22 +873,28 @@ class ProcReplica:
             return
         self._hb_stop.set()
         self._note_dead()
-        if self._alive() and not self._wait(5.0) and force:
-            self.process.terminate()
-            if not self._wait(5.0):
-                os.kill(self.process.pid, signal.SIGKILL)
-                self._wait(5.0)
-        _untrack_worker(self.process.pid)
+        if self.process is not None:
+            if self._alive() and not self._wait(5.0) and force:
+                self.process.terminate()
+                if not self._wait(5.0):
+                    os.kill(self.process.pid, signal.SIGKILL)
+                    self._wait(5.0)
+            _untrack_worker(self.process.pid)
         try:
-            self._sock.close()
+            self._tr.close()
         except (OSError, AttributeError):
             pass
+        if self.process is None:
+            # thread-worker: the transport close above IS the kill; give
+            # the serve loop a beat to unwind
+            self._wait(5.0)
         if self._hb_thread is not None:
             self._hb_thread.join(timeout=2.0)
-        try:
-            os.unlink(self._spec_path)
-        except OSError:
-            pass
+        if self._spec_path is not None:
+            try:
+                os.unlink(self._spec_path)
+            except OSError:
+                pass
         self.reaped = True
         self.stats["proc_reaped"] = self.stats.get("proc_reaped", 0) + 1
 
@@ -658,6 +908,8 @@ class ProcReplica:
                 return
             try:
                 self._progress_probe("heartbeat")
+            except BreakerOpen:
+                continue     # cooling down: routed around, not dead
             except Exception:  # noqa: BLE001 — probe failure = death signal
                 self._note_dead()
                 return

@@ -29,6 +29,7 @@ RDMA/ICI path slots in behind the same codec).
 from __future__ import annotations
 
 import dataclasses
+import socket
 import time
 from typing import Callable, Dict, List, Optional, Union
 
@@ -60,6 +61,26 @@ class ProcFleetConfig:
     - ``metrics_port``: 0 = each worker binds an ephemeral ``/metrics``
       port (reported in HELLO, aggregated under ``replica=i`` labels by
       ``procfleet_collector``); None disables worker endpoints.
+    - ``transport``: ``"tcp"`` (default, real worker processes) or
+      ``"loopback"`` — worker threads over an in-process queue-pair
+      transport: same supervisor/journal/serve loop, no process spawn, no
+      cold jit (the fast arm for tests and chaos drills; ``env`` is NOT
+      applied and workers bind no metrics port).
+    - ``chaos``: wrap every replica transport in a
+      :class:`~.transport.ChaosTransport` — the active ``FaultPlan``'s
+      ``net.connect``/``net.send``/``net.recv`` specs inject drops,
+      stalls, duplicate delivery, torn frames, payload bitflips and
+      per-peer blackholes (docs/RESILIENCE.md).
+    - ``breaker``: per-replica circuit-breaker kwargs (see
+      :class:`~.proxy.CircuitBreaker`: fail_threshold, latency_s,
+      cooldown_s, ema_alpha), or None for no breaker.
+    - ``migrate_bw_bytes_per_s``: assumed wire bandwidth sizing the
+      MIGRATE_IN/OUT per-op deadlines to the payload bytes.
+    - ``hedge``: race a timed-out MIGRATE_IN against the next decode
+      replica (False = retry the same target only).
+    - ``verify_crc``: worker-side per-page crc verification on chain
+      import — ``False`` is the fault drills' control arm (silent
+      corruption instead of a typed PT-SRV-007).
     """
 
     factory: Union[str, Callable]
@@ -70,6 +91,12 @@ class ProcFleetConfig:
     spawn_timeout_s: float = 300.0
     heartbeat_s: Optional[float] = None
     metrics_port: Optional[int] = 0
+    transport: str = "tcp"
+    chaos: bool = False
+    breaker: Optional[dict] = None
+    migrate_bw_bytes_per_s: float = 32.0 * 1024 * 1024
+    hedge: bool = True
+    verify_crc: bool = True
 
 
 class ProcFleetRouter(FleetRouter):
@@ -110,6 +137,7 @@ class ProcFleetRouter(FleetRouter):
                     sup_kwargs=dict(cfg.sup_kwargs),
                     env=dict(cfg.env),
                     metrics_port=cfg.metrics_port,
+                    verify_crc=cfg.verify_crc,
                     tier=self.tier_of(idx))
 
     def _make_sup(self, idx: int, path: str) -> ProcReplica:
@@ -120,7 +148,10 @@ class ProcFleetRouter(FleetRouter):
             spec, idx=idx, tracer=self.tracer, trace_tags=tags,
             op_timeout_s=cfg.op_timeout_s,
             spawn_timeout_s=cfg.spawn_timeout_s,
-            heartbeat_s=cfg.heartbeat_s, stats=self.stats)
+            heartbeat_s=cfg.heartbeat_s, stats=self.stats,
+            transport=cfg.transport, chaos=cfg.chaos,
+            breaker=cfg.breaker,
+            migrate_bw_bytes_per_s=cfg.migrate_bw_bytes_per_s)
 
     def drain(self, idx: int) -> None:
         """Router drain + a worker-side DRAIN mark (the worker refuses new
@@ -161,9 +192,13 @@ class ProcTieredRouter(ProcFleetRouter):
     the prefill worker (its journal's ``migr-kv`` keeps the rid out of its
     replay set), the artifact crosses the wire, MIGRATE_IN splices it into
     the least-loaded decode worker which verifies per-page crc32 + chain
-    digest before a byte touches its pool. Refusals fall back exactly like
-    the in-process tiered router: try the next decode worker, else re-run
-    prefill under resume semantics on a survivor."""
+    digest before a byte touches its pool. Refusal, typed corruption and
+    import TIMEOUT all take ONE retry-elsewhere policy (the driver still
+    holds the clean artifact — wire-transit damage is per-hop): try the
+    next decode worker, with the timeout arm HEDGING onto the
+    next-least-loaded replica and rolling the loser back via
+    MIGRATE_CANCEL; exhausted, re-run prefill under resume semantics on a
+    survivor."""
 
     def __init__(self, prefill_config: ProcFleetConfig,
                  decode_config: ProcFleetConfig, fleet_dir: str,
@@ -191,19 +226,23 @@ class ProcTieredRouter(ProcFleetRouter):
         self.stats.update(migrations=0, migration_s=0.0, migration_pages=0,
                           migration_bytes=0, migration_corrupt=0,
                           migration_deferred=0, migration_refused=0,
-                          migration_reprefill=0)
+                          migration_reprefill=0, migration_hedges=0)
+        #: per-migration wall-clock seconds, newest-last, capped — the
+        #: ``serving_migration_under_loss`` bench reads p99 from here
+        self.migration_samples: List[float] = []
+        self._hedge = bool(decode_config.hedge)
         self._corrupt_hook = None
 
     def tier_of(self, idx: int) -> str:
         return "prefill" if idx < self._num_prefill else "decode"
 
-    def _spec_kwargs(self, idx: int) -> dict:
-        cfg = (self._prefill_cfg if idx < self._num_prefill
-               else self._decode_cfg)
-        return dict(factory=cfg.factory,
-                    factory_kwargs=dict(cfg.factory_kwargs),
-                    sup_kwargs=dict(cfg.sup_kwargs), env=dict(cfg.env),
-                    metrics_port=cfg.metrics_port, tier=self.tier_of(idx))
+    def _cfg_for(self, idx: int) -> ProcFleetConfig:
+        # the tier's OWN config drives both the worker spec and the
+        # proxy's transport knobs — a slow decode build gets decode's
+        # spawn budget, and the drills' verify_crc/chaos/breaker arms
+        # land on the tier they target
+        return (self._prefill_cfg if idx < self._num_prefill
+                else self._decode_cfg)
 
     def _routable(self, req):
         alive = super()._routable(req)
@@ -231,9 +270,14 @@ class ProcTieredRouter(ProcFleetRouter):
         self._migrate_ready()
 
     def _decode_targets(self, rid: int) -> List:
+        # an OPEN breaker filters the replica out of the candidate list —
+        # a slow peer must not eat a migration's whole deadline before
+        # the hedge even starts (all breakers open -> deferred: the rid
+        # keeps decoding on the prefill tier and retries next step)
         alive = [r for r in self.replicas
                  if r.state == ReplicaState.ALIVE and r.tier == "decode"
-                 and not r.sup.dead]
+                 and not r.sup.dead
+                 and r.sup.breaker_state() != "open"]
         n = max(1, len(alive))
         return sorted(alive, key=lambda r: (r.sup.load(),
                                             (r.idx - rid) % n))
@@ -338,19 +382,60 @@ class ProcTieredRouter(ProcFleetRouter):
         from ..disagg import KVChainCorrupt
         from ..serving import EngineSaturated
 
-        for rep in targets:
+        # one idempotence key per LOGICAL migration, stable across every
+        # attempt and every target: a chaos-duplicated MIGRATE_IN answers
+        # from the worker's idem cache instead of double-splicing, and the
+        # no-hedge-target resend below dedups against a splice that DID
+        # land before the reply was lost
+        idem = f"mig:{rid}:{hdr['digest'][:16]}"
+        # UNIFIED retry-elsewhere policy: a refusal (EngineSaturated /
+        # geometry ValueError), a typed corruption (wire-transit damage is
+        # per-hop — this driver still holds the artifact it exported) and
+        # a clean import TIMEOUT all mean "this target didn't take it, the
+        # chain is intact here": try the next-least-loaded decode replica.
+        # Only the timeout arm is a HEDGE — the laggard may still splice
+        # late, so the loser is rolled back below.
+        timed_out: List = []
+        queue = list(targets)
+        i = 0
+        while i < len(queue):
+            rep = queue[i]
+            i += 1
             try:
-                rep.sup.import_migration(user, art)
+                rep.sup.import_migration(user, art, idem=idem)
                 placed = rep
                 break
+            except socket.timeout:
+                timed_out.append(rep)
+                if not self._hedge and queue.count(rep) < 2:
+                    # hedging disabled: retry the SAME replica once under
+                    # the SAME idem key before considering anyone else
+                    queue.insert(i, rep)
+                    continue
+                if i < len(queue):
+                    if self._hedge:
+                        # race the next-least-loaded candidate while this
+                        # one lags
+                        self.stats["migration_hedges"] += 1
+                        if self.tracer is not None:
+                            self.tracer.migration_failure(
+                                rid, "hedged", tags={"replica": rep.idx})
+                    continue
+                if queue.count(rep) < 2:
+                    # no hedge target left: resend to the SAME replica
+                    # under the SAME idem key — if the first splice landed
+                    # and only the reply was lost, the worker answers
+                    # SPLICED from its idem cache
+                    queue.append(rep)
+                continue
             except KVChainCorrupt as e:
                 corrupt_art = True
                 self.stats["migration_corrupt"] += 1
                 self.events.append(("PT-SRV-007", str(e)))
                 if self.tracer is not None:
                     self.tracer.migration_failure(
-                        rid, "corrupt", tags={"replica": src.idx})
-                break
+                        rid, "corrupt", tags={"replica": rep.idx})
+                continue
             except (EngineSaturated, ValueError):
                 self.stats["migration_refused"] += 1
                 if self.tracer is not None:
@@ -370,6 +455,23 @@ class ProcTieredRouter(ProcFleetRouter):
                 if self._assigned.get(rid, src.idx) != src.idx:
                     return True     # its failover already re-placed it
                 continue
+        # hedge losers: any replica whose import timed out but is NOT the
+        # winner may splice late — roll it back (journal migr-kv, pages
+        # decref'd, allocator untouched) so the chain is live exactly
+        # once. Best-effort: a loser that died or is still wedged keeps
+        # its idem entry, and the rid is purged from its cache either way
+        # when the cancel does land.
+        for rep in timed_out:
+            if rep is placed or rep.sup.dead:
+                continue
+            try:
+                if rep.sup.migrate_cancel(rid, hdr["digest"]):
+                    self.events.append(
+                        ("PT-TIER-001",
+                         f"rid={rid} hedge loser on replica {rep.idx} "
+                         "rolled back (late splice retired)"))
+            except Exception:  # noqa: BLE001 — winner already placed
+                pass
         if placed is None:
             alive = self._decode_targets(rid)
             target = (alive[0] if alive
@@ -393,6 +495,8 @@ class ProcTieredRouter(ProcFleetRouter):
         dt = time.monotonic() - t0
         self.stats["migrations"] += 1
         self.stats["migration_s"] += dt
+        self.migration_samples.append(dt)
+        del self.migration_samples[:-512]
         self.stats["migration_pages"] += int(hdr["pages"])
         self.stats["migration_bytes"] += len(art)
         self.events.append(

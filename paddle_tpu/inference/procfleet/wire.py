@@ -88,6 +88,8 @@ MSG_TYPES = {
     "MIGRATE_IN": 18,   # splice a migrated chain (artifact in the blob)
     "SPLICED": 19,
     "PROGRESS_REPLY": 20,   # PROGRESS answered with state
+    "MIGRATE_CANCEL": 21,   # roll back a hedge-loser's spliced chain
+    "CANCELLED": 22,
 }
 _TYPE_NAMES = {v: k for k, v in MSG_TYPES.items()}
 
@@ -102,6 +104,10 @@ SCHEMAS: Dict[str, Dict[str, tuple]] = {
     # step reply, so reply-piggybacked state is exact between ops
     "HELLO": {"pid": (int,), "metrics_port": (int, _OPT),
               "journal_path": (str,), "engine": (dict,), "state": (dict,)},
+    # SUBMIT/MIGRATE_IN may additionally carry ``idem`` (a str idempotence
+    # key, riding like the ``_seq`` stamp outside the required set): a
+    # retried or chaos-duplicated delivery with a key the worker already
+    # served is answered from its dedup cache, never served twice
     "SUBMIT": {"req": (dict,), "resume": (bool,), "delivered": (list,)},
     "SUBMITTED": {"rid": (int,), "load": (int,)},
     "STEP": {},
@@ -128,6 +134,11 @@ SCHEMAS: Dict[str, Dict[str, tuple]] = {
     "SPLICED": {"rid": (int,)},
     "PROGRESS_REPLY": {"sig": (list,), "load": (int,),
                        "has_work": (bool,), "behind": (list,)},
+    # hedged migration's loser side: if ``rid`` is still live from a
+    # MIGRATE_IN with this chain digest, retire it (journal ``migr-kv``,
+    # pages decref'd — allocator back where it started)
+    "MIGRATE_CANCEL": {"rid": (int,), "digest": (str,)},
+    "CANCELLED": {"rid": (int,), "rolled_back": (bool,)},
 }
 
 

@@ -27,6 +27,7 @@ takes the same path.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import importlib
 import os
@@ -35,9 +36,17 @@ import socket
 import sys
 from typing import Callable, Dict, List, Optional, Union
 
-from .wire import Message, WireClosed, WireCorrupt, recv_msg, send_msg
+from .transport import TcpTransport, Transport
+from .wire import Message, WireClosed, WireCorrupt
 
-__all__ = ["WorkerSpec", "resolve_factory", "worker_main"]
+__all__ = ["WorkerSpec", "resolve_factory", "worker_main",
+           "worker_thread_main"]
+
+#: idempotence-key dedup depth: a duplicated/retried delivery arrives
+#: within one op window of the original, so a small bounded cache is the
+#: whole contract (the JOURNAL carries single-serve across crashes; this
+#: carries it across the wire)
+_IDEM_CACHE = 128
 
 
 @dataclasses.dataclass
@@ -67,6 +76,10 @@ class WorkerSpec:
     metrics_port: Optional[int] = 0
     env: dict = dataclasses.field(default_factory=dict)
     tier: str = "serving"
+    #: worker-side KV-chain verification (KVChainCodec(verify_crc=...)).
+    #: False is the net_flaky_migration drill's control arm: what a
+    #: checksum-less transfer does to bitflipped migration bytes
+    verify_crc: bool = True
 
 
 def resolve_factory(spec: WorkerSpec) -> Callable:
@@ -110,15 +123,22 @@ class _WorkerLoop:
     """The serve loop, factored for testability (handlers take/return
     Messages; ``worker_main`` owns the socket + process lifecycle)."""
 
-    def __init__(self, sup, registry=None):
+    def __init__(self, sup, registry=None, verify_crc: bool = True):
         self.sup = sup
         self.registry = registry
         self.draining = False
+        self.verify_crc = bool(verify_crc)
         # rid -> tokens already wired, for OPEN rids only: entries are
         # pruned when the done update ships (or the rid withdraws /
         # migrates out), so the per-step scan is O(live), not O(lifetime)
         # — same discipline recovery.py's _sync_progress documents
         self._sent: Dict[int, int] = {}
+        # idempotence keys already served -> their success reply. A
+        # duplicated or retried SUBMIT/MIGRATE_IN is answered from here
+        # without touching the supervisor: at-most-once ADMISSION per key
+        # (the reply's piggybacked load may be stale; admission may not)
+        self._idem: "collections.OrderedDict[str, Message]" = \
+            collections.OrderedDict()
         self._codec = None
 
     # -- per-type handlers -------------------------------------------------
@@ -140,10 +160,31 @@ class _WorkerLoop:
             return Message("ERROR", {"etype": type(e).__name__,
                                      "msg": str(e)})
 
+    def _idem_hit(self, msg: Message) -> Optional[Message]:
+        key = msg.payload.get("idem")
+        cached = None if key is None else self._idem.get(key)
+        if cached is None:
+            return None
+        # a fresh copy: the serve loop stamps each reply with ITS
+        # request's _seq, and the cache must stay seq-free
+        return Message(cached.mtype, dict(cached.payload), cached.blob)
+
+    def _idem_store(self, msg: Message, reply: Message) -> None:
+        key = msg.payload.get("idem")
+        if key is None:
+            return
+        self._idem[key] = Message(reply.mtype, dict(reply.payload),
+                                  reply.blob)
+        while len(self._idem) > _IDEM_CACHE:
+            self._idem.popitem(last=False)
+
     def _on_submit(self, msg: Message) -> Message:
         from ..recovery import _request_from
         from ..serving import EngineSaturated
 
+        dup = self._idem_hit(msg)
+        if dup is not None:
+            return dup
         if self.draining and not msg.payload["resume"]:
             raise EngineSaturated(
                 "worker is draining — new admissions refused (resumed/"
@@ -155,8 +196,10 @@ class _WorkerLoop:
             user._n_out = len(delivered)
         self.sup.submit(user, resume=bool(msg.payload["resume"]))
         self._sent[user.rid] = len(delivered)
-        return Message("SUBMITTED", {"rid": int(user.rid),
-                                     "load": int(self.sup.load())})
+        reply = Message("SUBMITTED", {"rid": int(user.rid),
+                                      "load": int(self.sup.load())})
+        self._idem_store(msg, reply)
+        return reply
 
     def _updates(self) -> List[dict]:
         ups = []
@@ -239,7 +282,7 @@ class _WorkerLoop:
         if self._codec is None:
             from ..disagg import KVChainCodec
 
-            self._codec = KVChainCodec()
+            self._codec = KVChainCodec(verify_crc=self.verify_crc)
         return self._codec
 
     def _on_migrate_out(self, msg: Message) -> Message:
@@ -269,6 +312,9 @@ class _WorkerLoop:
         from ..disagg import KVChainCorrupt
         from ..recovery import _request_from
 
+        dup = self._idem_hit(msg)
+        if dup is not None:
+            return dup
         user = _request_from(msg.payload["req"])
         delivered = [int(t) for t in msg.payload["delivered"]]
         user.output = list(delivered)
@@ -279,7 +325,96 @@ class _WorkerLoop:
             return Message("ERROR", {"etype": "KVChainCorrupt",
                                      "msg": str(e)})
         self._sent[user.rid] = len(delivered)
-        return Message("SPLICED", {"rid": int(user.rid)})
+        reply = Message("SPLICED", {"rid": int(user.rid)})
+        self._idem_store(msg, reply)
+        return reply
+
+    def _on_migrate_cancel(self, msg: Message) -> Message:
+        """Hedged migration's loser side: the driver placed this rid's
+        chain elsewhere first. If the MIGRATE_IN actually landed here
+        (the race's ambiguous outcome), retire it — journal ``migr-kv``,
+        ACTIVE slot released, pages decref'd: the allocator is exactly
+        where it was before the splice. Idempotent: an rid that never
+        landed (or already left) rolls back nothing."""
+        rid = int(msg.payload["rid"])
+        twin = self.sup._live.get(rid)
+        rolled = False
+        if twin is not None and not twin.done:
+            self.sup.retire_migrated(rid, str(msg.payload["digest"]))
+            self._sent.pop(rid, None)
+            rolled = True
+        # the key that admitted it must not answer a later duplicate
+        # with SPLICED for work this worker no longer owns
+        for key in [k for k, v in self._idem.items()
+                    if v.payload.get("rid") == rid]:
+            self._idem.pop(key, None)
+        return Message("CANCELLED", {"rid": rid,
+                                     "rolled_back": rolled})
+
+
+def _hello_msg(spec: WorkerSpec, sup, loop: _WorkerLoop,
+               metrics_port: Optional[int]) -> Message:
+    """The HELLO frame, including journal-restart pending work (a worker
+    (re)started over a live journal replays it in the supervisor
+    constructor): the reconstructed admits + delivered marks let the
+    driver-side proxy own the caller-facing objects."""
+    from ..recovery import _admit_record
+
+    pending = []
+    for rid, user in sup.requests.items():
+        loop._sent[rid] = len(user.output)
+        pending.append({"req": _admit_record(user),
+                        "delivered": [int(t) for t in user.output]})
+    return Message("HELLO", {
+        "pid": int(os.getpid()), "metrics_port": metrics_port,
+        "journal_path": str(spec.journal_path),
+        "engine": dict(_engine_hello(sup.engine), tier=str(spec.tier),
+                       pending=pending),
+        "state": {"load": int(sup.load()),
+                  "sig": list(sup.progress()),
+                  "has_work": bool(sup.has_work()),
+                  "cap": loop._capacity()}})
+
+
+def _serve(tr: Transport, sup, loop: _WorkerLoop) -> int:
+    """The message loop over any transport. Returns the worker's exit
+    code: 0 = clean SHUTDOWN, 2 = driver gone / stream damaged, 3 =
+    fatal handler failure (replica death). Codes 2/3 abandon the
+    supervisor — no journal flush beyond what the flush barrier already
+    guaranteed, exactly the recovery contract failover replays."""
+    while True:
+        try:
+            msg = tr.recv_frame()
+        except (WireClosed, WireCorrupt):
+            # driver gone (or stream damaged — same retreat)
+            sup.abandon()
+            return 2
+        if msg.mtype == "SHUTDOWN":
+            sup.close()
+            bye = Message("BYE", {})
+            if "_seq" in msg.payload:
+                bye.payload["_seq"] = msg.payload["_seq"]
+            tr.send_frame(bye)
+            return 0
+        try:
+            reply = loop.handle(msg)
+        except Exception as e:  # noqa: BLE001 — replica death boundary
+            # a step crash past the recovery budget (or any unexpected
+            # handler failure): this replica is DEAD — tell the driver
+            # why if the pipe still works, then exit without flushing
+            try:
+                tr.send_frame(Message(
+                    "ERROR", {"etype": type(e).__name__,
+                              "msg": f"worker fatal: {e}"}))
+            except (WireClosed, WireCorrupt, OSError):
+                pass
+            sup.abandon()
+            return 3
+        # echo the request's sequence id: a driver that timed out and
+        # retried matches replies to attempts and discards stale ones
+        if "_seq" in msg.payload:
+            reply.payload["_seq"] = msg.payload["_seq"]
+        tr.send_frame(reply)
 
 
 def worker_main(spec_bytes: bytes, host: str, port: int) -> None:
@@ -302,9 +437,10 @@ def worker_main(spec_bytes: bytes, host: str, port: int) -> None:
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     sock = socket.create_connection((host, int(port)), timeout=30)
     sock.settimeout(None)
+    tr = TcpTransport(sock=sock)
     server = None
     try:
-        from ..recovery import ServingSupervisor, _admit_record
+        from ..recovery import ServingSupervisor
         from paddle_tpu.observability import (MetricsRegistry, MetricsServer,
                                               retry_collector,
                                               supervisor_collector)
@@ -322,68 +458,51 @@ def worker_main(spec_bytes: bytes, host: str, port: int) -> None:
         if spec.metrics_port is not None:
             server = MetricsServer(registry, port=int(spec.metrics_port))
             metrics_port = server.port
-        loop = _WorkerLoop(sup, registry)
-        # journal-restart pending work (a worker spawned over a live
-        # journal replays it in the supervisor constructor): hand the
-        # driver the reconstructed admits + delivered marks so its proxy
-        # can own the caller-facing objects
-        pending = []
-        for rid, user in sup.requests.items():
-            loop._sent[rid] = len(user.output)
-            pending.append({"req": _admit_record(user),
-                            "delivered": [int(t) for t in user.output]})
-        send_msg(sock, Message("HELLO", {
-            "pid": int(os.getpid()), "metrics_port": metrics_port,
-            "journal_path": str(spec.journal_path),
-            "engine": dict(_engine_hello(sup.engine), tier=str(spec.tier),
-                           pending=pending),
-            "state": {"load": int(sup.load()),
-                      "sig": list(sup.progress()),
-                      "has_work": bool(sup.has_work()),
-                      "cap": loop._capacity()}}))
-        while True:
-            try:
-                msg = recv_msg(sock)
-            except (WireClosed, WireCorrupt):
-                # driver gone (or stream damaged — same retreat): release
-                # without flushing; the flush barrier already covered
-                # everything any caller saw
-                sup.abandon()
-                os._exit(2)
-            if msg.mtype == "SHUTDOWN":
-                sup.close()
-                bye = Message("BYE", {})
-                if "_seq" in msg.payload:
-                    bye.payload["_seq"] = msg.payload["_seq"]
-                send_msg(sock, bye)
-                break
-            try:
-                reply = loop.handle(msg)
-            except Exception as e:  # noqa: BLE001 — replica death boundary
-                # a step crash past the recovery budget (or any unexpected
-                # handler failure): this replica is DEAD — tell the driver
-                # why if the pipe still works, then exit without flushing
-                try:
-                    send_msg(sock, Message(
-                        "ERROR", {"etype": type(e).__name__,
-                                  "msg": f"worker fatal: {e}"}))
-                except (WireClosed, WireCorrupt, OSError):
-                    pass
-                sup.abandon()
-                os._exit(3)
-            # echo the request's sequence id: a driver that timed out and
-            # retried matches replies to attempts and discards stale ones
-            if "_seq" in msg.payload:
-                reply.payload["_seq"] = msg.payload["_seq"]
-            send_msg(sock, reply)
+        loop = _WorkerLoop(sup, registry, verify_crc=spec.verify_crc)
+        tr.send_frame(_hello_msg(spec, sup, loop, metrics_port))
+        code = _serve(tr, sup, loop)
+        if code != 0:
+            os._exit(code)
     finally:
         if server is not None:
             server.close()
-        try:
-            sock.close()
-        except OSError:
-            pass
+        tr.close()
     sys.exit(0)
+
+
+def worker_thread_main(spec: WorkerSpec, tr: Transport) -> None:
+    """Loopback twin of :func:`worker_main`: the same supervisor, journal
+    format, HELLO and serve loop, over an in-process
+    :class:`~.transport.LoopbackTransport` on this thread — the fast arm
+    for tests/drills that would otherwise pay a process spawn + cold jit
+    per case. Differences are exactly the process boundary: ``spec.env``
+    is NOT applied (one shared interpreter), there is no per-worker
+    metrics server (the driver's registry already sees this process),
+    and "process death" is the transport closing, which failover reads
+    through the journal identically. Thread-safety: the supervisor,
+    engine and journal are touched only from this thread — the serve
+    loop is single-threaded by design, same as the process worker."""
+    try:
+        from ..recovery import ServingSupervisor
+
+        build = resolve_factory(spec)
+        sup = ServingSupervisor(build, spec.journal_path,
+                                **dict(spec.sup_kwargs))
+        loop = _WorkerLoop(sup, None, verify_crc=spec.verify_crc)
+        tr.send_frame(_hello_msg(spec, sup, loop, None))
+        _serve(tr, sup, loop)
+    except (WireClosed, WireCorrupt):
+        pass                    # driver closed while we were replying
+    except Exception as e:  # noqa: BLE001 — replica death boundary
+        # construction failed (bad factory, journal IO): tell the driver
+        # like the process worker's fatal path would
+        try:
+            tr.send_frame(Message("ERROR", {
+                "etype": type(e).__name__, "msg": f"worker fatal: {e}"}))
+        except Exception:       # noqa: BLE001 — already dying
+            pass
+    finally:
+        tr.close()
 
 
 def _cli(argv: Optional[List[str]] = None) -> None:

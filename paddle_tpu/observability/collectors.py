@@ -274,7 +274,11 @@ def procfleet_collector(router, scrape_workers: bool = True,
     ``pt_procfleet_spawned_total`` / ``pt_procfleet_reaped_total`` come
     from the router's stats (zero on a non-process fleet);
     ``pt_procfleet_heartbeats_total`` sums every proxy's heartbeat-probe
-    count. With ``scrape_workers`` (default), each live worker's
+    count. The transport seam adds ``pt_transport_retries`` (retryable
+    wire timeouts summed across replica proxies), ``pt_transport_hedges``
+    (migrations raced onto a second decode replica) and
+    ``pt_transport_breaker_state`` (per-replica gauge, 0=closed 1=open
+    2=half_open) — all zero over an in-process fleet. With ``scrape_workers`` (default), each live worker's
     ``/metrics`` endpoint (``ProcFleetRouter.worker_metrics_urls``) is
     fetched under ``timeout_s``, parsed, re-labeled ``replica="<idx>"``
     and forwarded; a worker that cannot answer (dying, reaped mid-scrape)
@@ -296,6 +300,31 @@ def procfleet_collector(router, scrape_workers: bool = True,
             "pt_procfleet_heartbeats_total", "counter",
             "driver-side heartbeat probes answered by workers").add(
             hb() if callable(hb) else 0))
+        # transport-seam families (docs/SERVING.md "Transport seam") —
+        # every read getattr-defaulted, so an IN-PROCESS fleet renders
+        # them at zero (`scrape_metrics --selftest` runs exactly that)
+        retries = 0
+        breaker = MetricFamily(
+            "pt_transport_breaker_state", "gauge",
+            "per-replica circuit breaker (0=closed 1=open 2=half_open)")
+        b_order = {"closed": 0, "open": 1, "half_open": 2}
+        for rep in getattr(router, "replicas", ()):
+            sup = getattr(rep, "sup", None)
+            retries += int(getattr(sup, "transport_retries", 0) or 0)
+            state_fn = getattr(sup, "breaker_state", None)
+            state = state_fn() if callable(state_fn) else "closed"
+            breaker.add(b_order.get(state, 0),
+                        replica=str(getattr(rep, "idx", "?")))
+        fams.append(MetricFamily(
+            "pt_transport_retries", "counter",
+            "retryable wire timeouts across replica transports "
+            "(non-fatal: the probe retried or the migration hedged)").add(
+            retries))
+        fams.append(MetricFamily(
+            "pt_transport_hedges", "counter",
+            "timed-out KV migrations raced onto another decode replica"
+            ).add(stats.get("migration_hedges", 0)))
+        fams.append(breaker)
         urls = {}
         getter = getattr(router, "worker_metrics_urls", None)
         if callable(getter):

@@ -25,11 +25,13 @@ import numpy as np
 import pytest
 
 import paddle_tpu as paddle
-from paddle_tpu.inference.procfleet import (Message, ProcFleetConfig,
+from paddle_tpu.inference.procfleet import (ChaosTransport, CircuitBreaker,
+                                            Message, ProcFleetConfig,
                                             ProcFleetRouter, ProcReplica,
-                                            ProcTieredRouter, WireClosed,
-                                            WireCorrupt, WorkerDead,
-                                            WorkerSpec)
+                                            ProcTieredRouter, TcpTransport,
+                                            WireClosed, WireCorrupt,
+                                            WorkerDead, WorkerSpec,
+                                            loopback_pair)
 from paddle_tpu.inference.procfleet import wire
 from paddle_tpu.inference.procfleet.presets import (tiny_llama_engine,
                                                     tiny_llama_prefix_engine)
@@ -77,6 +79,8 @@ class TestWireCodec:
             "CHAIN": {"rid": 1, "digest": "ab", "pages": 2, "updates": []},
             "MIGRATE_IN": {"req": {"rid": 1}, "delivered": [4]},
             "SPLICED": {"rid": 1},
+            "MIGRATE_CANCEL": {"rid": 1, "digest": "ab"},
+            "CANCELLED": {"rid": 1, "rolled_back": True},
         }
         assert set(samples) == set(wire.SCHEMAS)
         for mtype, payload in samples.items():
@@ -173,7 +177,7 @@ class TestWireCodec:
 # proxy behaviors against a scripted peer (fast — no process, no jax work)
 # ---------------------------------------------------------------------------
 
-def _bare_proxy(sock, op_timeout_s=0.5):
+def _bare_proxy(sock, op_timeout_s=0.5, breaker=None):
     """A ProcReplica wired to a socketpair end instead of a spawned
     worker — exactly the wire-facing surface, none of the process
     lifecycle."""
@@ -183,6 +187,10 @@ def _bare_proxy(sock, op_timeout_s=0.5):
     p.tracer = None
     p.trace_tags = {}
     p.op_timeout_s = op_timeout_s
+    p._migrate_bw = 32.0 * 1024 * 1024
+    p._breaker = breaker
+    p.transport_retries = 0
+    p._idem_counter = 0
     p.stats = {}
     p.requests = {}
     p._done = set()
@@ -206,7 +214,12 @@ def _bare_proxy(sock, op_timeout_s=0.5):
     p.reaped = False
     p._fault_hook = None
     p._fault_cls = None
-    p._sock = sock
+    p.process = None
+    p._worker_thread = None
+    p._spec_path = None
+    tr = TcpTransport(sock=sock)
+    p.peer = f"replica:0@{tr.peer}"
+    p._tr = tr
     p.worker_pid = 0
     return p
 
@@ -552,6 +565,302 @@ class TestWorkerLoop:
 
 
 # ---------------------------------------------------------------------------
+# transport seam: frame fuzz, chaos actions, breaker, idempotence (fast)
+# ---------------------------------------------------------------------------
+
+class TestFrameFuzz:
+    """The codec's chunk-reassembly contract under ARBITRARY recv
+    boundaries: frames reassemble byte-exactly from any split/coalesce
+    pattern, and a torn prefix is always a typed outcome (wait, timeout
+    with ``partial_read``, ``WireCorrupt`` or ``WireClosed``) — never a
+    hang and never a silently short frame."""
+
+    def _frames(self, rng, n=24):
+        msgs = []
+        for i in range(n):
+            pick = i % 4
+            if pick == 0:
+                msgs.append(Message("STEP"))
+            elif pick == 1:
+                msgs.append(Message(
+                    "METRICS_TEXT", {"text": "x" * rng.randrange(0, 200)}))
+            elif pick == 2:
+                msgs.append(Message(
+                    "MIGRATE_IN", {"req": {"rid": i}, "delivered": []},
+                    blob=bytes(rng.getrandbits(8)
+                               for _ in range(rng.randrange(0, 300)))))
+            else:
+                msgs.append(Message("SUBMITTED", {"rid": i, "load": i % 5}))
+        return msgs
+
+    def test_randomized_chunk_boundaries_reassemble(self):
+        import random
+        rng = random.Random(0xF00D)
+        msgs = self._frames(rng)
+        stream = b"".join(wire.encode(m) for m in msgs)
+        drv, wrk = loopback_pair()
+        # split AND coalesce: 1..13-byte chunks cross frame boundaries
+        # freely, so one delivery may end a frame and start the next
+        i = 0
+        while i < len(stream):
+            n = rng.randrange(1, 14)
+            wrk.send_bytes(stream[i:i + n])
+            i += n
+        got = [drv.recv_frame(timeout=5.0) for _ in msgs]
+        assert got == msgs
+
+    def test_every_torn_prefix_is_typed_never_short(self):
+        msgs = [Message("STEP"),
+                Message("CHAIN", {"rid": 1, "digest": "ab", "pages": 1,
+                                  "updates": []}, blob=b"p" * 40)]
+        for m in msgs:
+            full = wire.encode(m)
+            for cut in range(1, len(full)):
+                # a prefix NEVER yields a message: the incremental decoder
+                # waits for more bytes, the one-shot decoder raises typed
+                assert wire.decode(full[:cut]) == (None, 0)
+                with pytest.raises(WireCorrupt, match="PT-PROC-001"):
+                    wire.decode_bytes(full[:cut])
+
+    def test_torn_prefix_then_close_is_wireclosed(self):
+        drv, wrk = loopback_pair()
+        full = wire.encode(Message("STEP"))
+        wrk.send_bytes(full[: len(full) - 3])
+        wrk.close()
+        with pytest.raises(WireClosed, match="mid-frame"):
+            drv.recv_frame(timeout=2.0)
+
+    def test_torn_prefix_timeout_flags_partial_read(self):
+        drv, wrk = loopback_pair()
+        full = wire.encode(Message("STEP"))
+        wrk.send_bytes(full[:7])
+        t0 = time.monotonic()
+        with pytest.raises(socket.timeout) as ei:
+            drv.recv_frame(timeout=0.05)
+        assert time.monotonic() - t0 < 2.0          # typed, not a hang
+        assert ei.value.partial_read is True
+        # a clean (zero-byte) deadline reports an aligned stream
+        drv2, _ = loopback_pair()
+        with pytest.raises(socket.timeout) as ei2:
+            drv2.recv_frame(timeout=0.05)
+        assert ei2.value.partial_read is False
+
+    def test_torn_prefix_misaligns_next_frame_into_typed_corrupt(self):
+        drv, wrk = loopback_pair()
+        full = wire.encode(Message("STEP"))
+        wrk.send_bytes(full[: len(full) - 3])
+        wrk.send_bytes(full)            # a healthy frame behind the tear
+        with pytest.raises(WireCorrupt):
+            drv.recv_frame(timeout=2.0)
+
+
+class TestChaosTransport:
+    """The seeded ``net.*`` action catalogue over a loopback pair — each
+    action's frame-level semantics, deterministically."""
+
+    def _pair(self):
+        drv, wrk = loopback_pair(a="driver", b="replica:0")
+        return ChaosTransport(drv, peer="replica:0"), wrk
+
+    def test_drop_then_duplicate(self):
+        from paddle_tpu.distributed.resilience import FaultPlan, FaultSpec
+        chaos, wrk = self._pair()
+        plan = FaultPlan(seed=3, specs=[
+            FaultSpec("net.send", "drop", at=0, count=1, match="STEP"),
+            FaultSpec("net.send", "duplicate", at=1, count=1,
+                      match="STEP")])
+        with plan:
+            chaos.send_frame(Message("STEP"))      # gone
+            chaos.send_frame(Message("STEP"))      # delivered twice
+        assert wrk.recv_frame(timeout=2.0).mtype == "STEP"
+        assert wrk.recv_frame(timeout=2.0).mtype == "STEP"
+        with pytest.raises(socket.timeout):
+            wrk.recv_frame(timeout=0.05)
+        assert plan.log and {a for (_, _, a) in plan.log} == \
+            {"drop", "duplicate"}
+
+    def test_torn_send_is_typed_corrupt_at_receiver(self):
+        from paddle_tpu.distributed.resilience import FaultPlan, FaultSpec
+        chaos, wrk = self._pair()
+        plan = FaultPlan(seed=3, specs=[
+            FaultSpec("net.send", "torn", at=0, count=1, match="STEP")])
+        with plan:
+            chaos.send_frame(Message("STEP"))
+            chaos.send_frame(Message("STEP"))      # healthy, behind tear
+        with pytest.raises(WireCorrupt):
+            wrk.recv_frame(timeout=2.0)
+
+    def test_bitflip_damages_blob_under_valid_frame_crc(self):
+        from paddle_tpu.distributed.resilience import FaultPlan, FaultSpec
+        chaos, wrk = self._pair()
+        blob = b"\x00" * 64
+        plan = FaultPlan(seed=3, specs=[
+            FaultSpec("net.send", "bitflip", at=0, count=1, arg=4,
+                      match="MIGRATE_IN")])
+        with plan:
+            chaos.send_frame(Message(
+                "MIGRATE_IN", {"req": {}, "delivered": []}, blob=blob))
+        got = wrk.recv_frame(timeout=2.0)   # frame crc VALID end to end
+        assert got.mtype == "MIGRATE_IN"
+        assert got.blob != blob             # payload silently damaged —
+        assert len(got.blob) == len(blob)   # only e2e checks can catch it
+
+    def test_blackhole_swallows_all_subsequent_sends(self):
+        from paddle_tpu.distributed.resilience import FaultPlan, FaultSpec
+        chaos, wrk = self._pair()
+        plan = FaultPlan(seed=3, specs=[
+            FaultSpec("net.send", "blackhole", at=0, count=1)])
+        with plan:
+            chaos.send_frame(Message("STEP"))
+        chaos.send_frame(Message("STEP"))   # sticky: no plan needed
+        with pytest.raises(socket.timeout):
+            wrk.recv_frame(timeout=0.05)
+
+    def test_recv_drop_consumes_frame_and_stays_aligned(self):
+        from paddle_tpu.distributed.resilience import FaultPlan, FaultSpec
+        chaos, wrk = self._pair()
+        wrk.send_frame(Message("SUBMITTED", {"rid": 1, "load": 0}))
+        wrk.send_frame(Message("SUBMITTED", {"rid": 2, "load": 0}))
+        plan = FaultPlan(seed=3, specs=[
+            FaultSpec("net.recv", "drop", at=0, count=1)])
+        with plan:
+            with pytest.raises(socket.timeout) as ei:
+                chaos.recv_frame(timeout=2.0)
+        # the dropped frame was CONSUMED: the stream stays aligned and
+        # the next recv pairs with the next frame, not a leftover
+        assert ei.value.partial_read is False
+        assert chaos.recv_frame(timeout=2.0).payload["rid"] == 2
+
+
+class TestCircuitBreaker:
+    def test_consecutive_failures_trip_and_cooldown_gates(self):
+        p = CircuitBreaker(fail_threshold=2, cooldown_s=60.0)
+        assert p.state == "closed" and p.allow(False)
+        p.record(False, 0.1)
+        assert p.state == "closed"          # one failure is noise
+        p.record(False, 0.1)
+        assert p.state == "open" and p.trips == 1
+        assert not p.allow(False)
+        assert not p.allow(True)            # cooling down: even probes wait
+        p._opened_at -= 61.0                # cooldown elapses
+        assert not p.allow(False)           # HALF_OPEN: probes only
+        assert p.state == "half_open"
+        assert p.allow(True)
+        p.record(True, 0.01)                # one healthy probe closes it
+        assert p.state == "closed" and p.allow(False)
+
+    def test_half_open_failure_reopens(self):
+        p = CircuitBreaker(fail_threshold=3, cooldown_s=0.0)
+        for _ in range(3):
+            p.record(False, 0.1)
+        assert p.state == "open"
+        assert p.allow(True)                # cooldown 0: straight to probe
+        p.record(False, 0.1)
+        assert p.state == "open" and p.trips == 2
+
+    def test_latency_ema_trips_slow_but_alive(self):
+        p = CircuitBreaker(fail_threshold=99, latency_s=0.05,
+                           cooldown_s=0.0, ema_alpha=1.0)
+        p.record(True, 0.01)
+        assert p.state == "closed"
+        p.record(True, 0.5)                 # answered, but past budget
+        assert p.state == "open" and p.trips == 1
+        assert p.allow(True)
+        p.record(True, 0.5)                 # probe answered, STILL slow
+        assert p.state == "open" and p.trips == 2
+        assert p.allow(True)
+        p.record(True, 0.001)               # healthy probe closes
+        assert p.state == "closed"
+
+
+class TestProxyBreaker:
+    def test_open_breaker_routes_around_without_wire_io(self):
+        peer = _ScriptedPeer([])            # must receive NOTHING
+        br = CircuitBreaker(fail_threshold=1, cooldown_s=60.0)
+        p = _bare_proxy(peer.sock, op_timeout_s=2.0, breaker=br)
+        br._trip()
+        assert p.breaker_state() == "open"
+        req = Request(np.arange(4, dtype=np.int32), max_new_tokens=2)
+        with pytest.raises(EngineSaturated, match="PT-PROC-004"):
+            p.submit(req)                   # typed refusal, like a full
+        p.step()                            # engine; step skips the tick
+        assert p.metrics_text() == ""       # scrape degrades, not breaks
+        assert not p.dead                   # deliberately NOT death
+        assert peer.requests == []
+        peer.close()
+
+    def test_half_open_probe_closes_breaker(self):
+        ok = Message("PROGRESS_REPLY", {"sig": [7], "load": 0,
+                                        "has_work": False, "behind": []})
+        peer = _ScriptedPeer([ok])
+        br = CircuitBreaker(fail_threshold=1, cooldown_s=0.0)
+        p = _bare_proxy(peer.sock, op_timeout_s=2.0, breaker=br)
+        br._trip()
+        assert p._progress_probe("heartbeat")["sig"] == [7]
+        assert p.breaker_state() == "closed"
+        assert not p.dead
+        peer.close()
+
+    def test_retryable_timeouts_counted_per_peer(self):
+        ok = Message("PROGRESS_REPLY", {"sig": [1], "load": 0,
+                                        "has_work": False, "behind": []})
+        peer = _ScriptedPeer([None, ok])
+        p = _bare_proxy(peer.sock, op_timeout_s=0.2)
+        p._progress_probe("heartbeat")
+        assert p.transport_retries == 1     # pt_transport_retries source
+        peer.close()
+
+
+class TestWorkerIdempotence:
+    def _meta(self, req):
+        from paddle_tpu.inference.recovery import _admit_record
+
+        return _admit_record(req)
+
+    def test_duplicate_submit_served_from_idem_cache(self):
+        loop = _WorkerLoop(_StubSup())
+        req = Request(np.arange(4, dtype=np.int32), max_new_tokens=2)
+        m = Message("SUBMIT", {"req": self._meta(req), "resume": False,
+                               "delivered": [], "idem": "sub:0:1"})
+        r1 = loop.handle(m)
+        r2 = loop.handle(Message("SUBMIT", dict(m.payload)))
+        assert r1.mtype == r2.mtype == "SUBMITTED"
+        assert r1.payload["rid"] == r2.payload["rid"]
+        assert len(loop.sup.submitted) == 1     # admitted ONCE
+        # a fresh key is a fresh logical admission (a legitimate later
+        # re-admit of the same rid must not be deduplicated away)
+        loop.handle(Message("SUBMIT", dict(m.payload, idem="sub:0:2")))
+        assert len(loop.sup.submitted) == 2
+
+    def test_cancel_unknown_rid_rolls_back_nothing(self):
+        loop = _WorkerLoop(_StubSup())
+        reply = loop.handle(Message("MIGRATE_CANCEL",
+                                    {"rid": 42, "digest": "ab"}))
+        assert reply.mtype == "CANCELLED"
+        assert reply.payload["rolled_back"] is False
+
+    def test_cancel_live_rid_retires_and_purges_idem(self):
+        sup = _StubSup()
+        retired = []
+        sup.retire_migrated = lambda rid, digest: (
+            retired.append((rid, digest)), sup._live.pop(rid, None))
+        twin = Request(np.arange(4, dtype=np.int32), max_new_tokens=2)
+        sup._live[twin.rid] = twin
+        loop = _WorkerLoop(sup)
+        loop._idem["mig:k"] = Message("SPLICED", {"rid": int(twin.rid)})
+        loop._sent[twin.rid] = 0
+        reply = loop.handle(Message(
+            "MIGRATE_CANCEL", {"rid": int(twin.rid), "digest": "dg"}))
+        assert reply.payload["rolled_back"] is True
+        assert retired == [(twin.rid, "dg")]
+        assert "mig:k" not in loop._idem    # a late duplicate must not
+        #                                     answer SPLICED for lost work
+        reply = loop.handle(Message(       # cancel is idempotent
+            "MIGRATE_CANCEL", {"rid": int(twin.rid), "digest": "dg"}))
+        assert reply.payload["rolled_back"] is False
+
+
+# ---------------------------------------------------------------------------
 # process-spawning end-to-ends (slow)
 # ---------------------------------------------------------------------------
 
@@ -774,3 +1083,51 @@ class TestProcTiered:
 
         recs = RequestJournal.load(tiered.replicas[0].journal_path)
         assert any(r["k"] == "migr-kv" for r in recs)
+
+
+@pytest.mark.slow   # compiles the tiny prefix engine (loopback: worker
+#                     threads, no process spawn — the shared jit cache
+#                     makes the three runs pay one compile)
+class TestLoopbackChaosByteIdentity:
+    """The tentpole contract end to end: a tiered loopback fleet under a
+    seeded chaos plan (dropped + bitflipped MIGRATE_IN frames) produces
+    streams byte-identical to the fault-free run — idempotent resends,
+    typed-corruption retry-elsewhere and hedging are exercised through
+    the REAL routers, not scripted peers."""
+
+    def _cfg(self):
+        return ProcFleetConfig(
+            factory=f"{PRESETS}:tiny_llama_prefix_engine",
+            transport="loopback", chaos=True, op_timeout_s=5.0)
+
+    def _run(self, path, kws, plan=None):
+        tiered = ProcTieredRouter(self._cfg(), self._cfg(), path,
+                                  num_prefill=1, num_decode=2)
+        reqs = [Request(**kw) for kw in kws]
+        try:
+            if plan is not None:
+                plan.install()
+            for r in reqs:
+                tiered.submit(r)
+            tiered.run_until_done(max_steps=500)
+        finally:
+            if plan is not None:
+                plan.uninstall()
+            tiered.close()
+        assert all(r.done and not r.failed for r in reqs)
+        return [list(r.output) for r in reqs], dict(tiered.stats)
+
+    def test_seeded_chaos_streams_equal_fault_free_run(self, tmp_path):
+        from paddle_tpu.distributed.resilience import FaultPlan, FaultSpec
+
+        kws = _wave_kwargs(n=4)
+        refs, clean_stats = self._run(str(tmp_path / "clean"), kws)
+        assert clean_stats["migrations"] >= 1
+        plan = FaultPlan(seed=7, specs=[
+            FaultSpec("net.send", "drop", at=0, count=1,
+                      match="MIGRATE_IN"),
+            FaultSpec("net.send", "bitflip", at=1, count=1, arg=64,
+                      match="MIGRATE_IN")])
+        outs, stats = self._run(str(tmp_path / "chaos"), kws, plan)
+        assert plan.log, "no net.send fault ever fired"
+        assert outs == refs       # byte-identical under seeded chaos

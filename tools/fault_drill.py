@@ -1309,6 +1309,286 @@ def drill_fleet_proc_kill(recover: bool):
                   f"{len(reqs)} streams bit-identical (greedy + seeded)")
 
 
+# ---------------------------------------------------------------------------
+# drills: the transport seam — flaky wire under KV migration, slow peer
+# ---------------------------------------------------------------------------
+
+def _net_cfg(factory="tiny_llama_engine", fkw=None, **kw):
+    """Loopback-transport fleet config for the net.* drills (workers are
+    threads in THIS process — the chaos plan and the drill share one
+    interpreter, and there is no process spawn in the latency budget)."""
+    from paddle_tpu.inference.procfleet import ProcFleetConfig
+
+    return ProcFleetConfig(
+        factory=f"paddle_tpu.inference.procfleet.presets:{factory}",
+        factory_kwargs={"seed": 11, **(fkw or {})},
+        transport="loopback", **kw)
+
+
+def _net_flat_refs():
+    """Fault-free loopback FLAT fleet run (cached). Doubles as the jit
+    warmup for the armed runs — loopback workers compile in this very
+    process, and a cold compile under a tight chaos op-timeout would
+    read as a wedged peer — and pins the loopback placement
+    byte-identical to the single-engine reference streams."""
+    if "net_flat" not in _SERVING:
+        from paddle_tpu.inference.procfleet import ProcFleetRouter
+        from paddle_tpu.inference.serving import Request
+
+        refs = _fleet_refs()
+        with tempfile.TemporaryDirectory() as tmp:
+            fleet = ProcFleetRouter(_net_cfg(), tmp, num_replicas=2)
+            reqs = [Request(**kw) for kw in _fleet_wave_kwargs()]
+            try:
+                for r in reqs:
+                    fleet.submit(r)
+                fleet.run_until_done(max_steps=500)
+            finally:
+                fleet.close()
+        streams = [list(r.tokens) for r in reqs]
+        if any(r.failed or not r.done for r in reqs) or streams != refs:
+            raise RuntimeError("clean loopback fleet run did not reproduce "
+                               "the reference streams")
+        _SERVING["net_flat"] = refs
+    return _SERVING["net_flat"]
+
+
+def _net_tiered_refs():
+    """Fault-free loopback TIERED run (cached): warms the prefill ->
+    decode migration path (export/import/splice programs) on top of the
+    flat warmup and pins it byte-identical to the same reference."""
+    if "net_tiered" not in _SERVING:
+        from paddle_tpu.inference.procfleet import ProcTieredRouter
+        from paddle_tpu.inference.serving import Request
+
+        refs = _net_flat_refs()
+        with tempfile.TemporaryDirectory() as tmp:
+            tiered = ProcTieredRouter(
+                _net_cfg("tiny_llama_prefix_engine"),
+                _net_cfg("tiny_llama_prefix_engine"),
+                tmp, num_prefill=1, num_decode=2)
+            reqs = [Request(**kw) for kw in _fleet_wave_kwargs()]
+            try:
+                for r in reqs:
+                    tiered.submit(r)
+                tiered.run_until_done(max_steps=500)
+            finally:
+                tiered.close()
+        streams = [list(r.tokens) for r in reqs]
+        if any(r.failed or not r.done for r in reqs) or streams != refs:
+            raise RuntimeError("clean tiered loopback run did not reproduce "
+                               "the reference streams")
+        if tiered.stats["migrations"] < 1:
+            raise RuntimeError("clean tiered run never migrated")
+        _SERVING["net_tiered"] = refs
+    return _SERVING["net_tiered"]
+
+
+def drill_net_flaky_migration(recover: bool):
+    """The wire goes flaky exactly under KV migration: a seeded plan
+    DROPS one MIGRATE_IN frame outright and BITFLIPS the KV payload of
+    another on ``net.send`` (the chaos transport re-frames after the
+    flip, so the frame CRC is VALID over the damaged bytes — only the
+    end-to-end per-page chain crc32 can catch it). Recovery = the
+    transport seam absorbs both: the dropped splice times out CLEANLY
+    (peer alive — no kill) and is hedged onto the next-least-loaded
+    decode replica under a stable idempotence key, the bitflipped one is
+    refused typed (KVChainCorrupt -> retry elsewhere / reprefill
+    fallback) — every stream byte-identical to the fault-free run. The
+    control arm is a checksum-less transport (``verify_crc=False``)
+    with hedging off: the damaged pages splice silently and the
+    migrated streams diverge."""
+    from paddle_tpu.distributed.resilience import FaultPlan, FaultSpec
+    from paddle_tpu.inference.procfleet import ProcTieredRouter
+    from paddle_tpu.inference.serving import Request
+
+    refs = _net_tiered_refs()
+    plan = FaultPlan(seed=7, specs=[
+        FaultSpec("net.send", "drop", at=0, count=1, match="MIGRATE_IN"),
+        FaultSpec("net.send", "bitflip", at=1, count=1, arg=64,
+                  match="MIGRATE_IN")])
+
+    def cfg():
+        return _net_cfg("tiny_llama_prefix_engine", chaos=True,
+                        op_timeout_s=5.0, hedge=recover,
+                        verify_crc=recover)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        tiered = ProcTieredRouter(cfg(), cfg(), tmp,
+                                  num_prefill=1, num_decode=2)
+        reqs = [Request(**kw) for kw in _fleet_wave_kwargs()]
+        try:
+            with plan:
+                for r in reqs:
+                    tiered.submit(r)
+                tiered.run_until_done(max_steps=800)
+        finally:
+            tiered.close()
+    fired = sorted({a for (_, _, a) in plan.log})
+    if "drop" not in fired or "bitflip" not in fired:
+        return False, f"net.send faults never fully fired (fired: {fired})"
+    lost = [r.rid for r in reqs if r.failed or not r.done]
+    streams = [list(r.tokens) for r in reqs]
+    wrong = [i for i, (s, f) in enumerate(zip(streams, refs)) if s != f]
+    s = tiered.stats
+    if not recover:
+        if s["migration_corrupt"]:
+            return False, ("control arm still detected the flip "
+                           "(verify_crc=False was not honored)")
+        if lost:
+            return False, (f"control arm lost request(s) {lost} — "
+                           "expected SILENT corruption, not failure")
+        if not wrong:
+            return True, ("unexpected: checksum-less splice of flipped KV "
+                          "pages changed no stream")
+        return False, ("no chain verify + no hedging: damaged migration "
+                       f"bytes spliced silently — stream(s) {wrong} "
+                       "diverged from the fault-free run")
+    if lost:
+        return False, f"request(s) {lost} failed/unfinished under net faults"
+    retries = sum(getattr(rep.sup, "transport_retries", 0)
+                  for rep in tiered.replicas)
+    recovered = s["migration_hedges"] + s["migration_corrupt"] + retries
+    if recovered < 1:
+        return False, (f"faults fired but no transport recovery engaged "
+                       f"(stats {s})")
+    if wrong:
+        return False, (f"stream(s) {wrong} diverged despite typed refusal "
+                       "+ hedged re-splice")
+    return True, ("dropped + bitflipped MIGRATE_IN absorbed: "
+                  f"{s['migration_hedges']} hedge(s), "
+                  f"{s['migration_corrupt']} typed refusal(s), "
+                  f"{s['migration_reprefill']} reprefill(s), "
+                  f"{retries} clean timeout retry(s) — all {len(reqs)} "
+                  "streams byte-identical to the fault-free run")
+
+
+def drill_net_slow_peer(recover: bool):
+    """One replica's wire turns SLOW-but-alive: a seeded plan stalls its
+    next few replies (``net.recv`` stall — latency, not death; every
+    reply still arrives, so kill-detection must NOT fire). Recovery =
+    the per-peer circuit breaker: the first stalled reply blows the
+    latency-EMA budget and trips CLOSED -> OPEN, the driver routes
+    around the peer (typed BreakerOpen: submits fall through to
+    survivors, step ticks are skipped) while HALF_OPEN probes riding the
+    heartbeat re-test it off the driver path; once the weather passes a
+    fast probe closes the breaker and the peer's streams finish —
+    driver steps stay inside the latency budget and every stream is
+    byte-identical. The control arm has no breaker: every stalled reply
+    is eaten inline and driver step latency blows past the budget —
+    the fleet-wide tail-latency incident the breaker exists to
+    contain."""
+    import time
+
+    from paddle_tpu.distributed.resilience import FaultPlan, FaultSpec
+    from paddle_tpu.inference.procfleet import ProcFleetRouter
+    from paddle_tpu.inference.serving import Request
+
+    refs = _net_flat_refs()
+    # loopback workers + heartbeats + driver share one interpreter, and
+    # GIL/dispatch contention makes even fault-free ops take ~1-2s here:
+    # the stall must TOWER over that baseline or the drill measures noise
+    budget_s, stall_s = 3.0, 4.0
+    plan = FaultPlan(seed=9, specs=[
+        FaultSpec("net.recv", "stall", at=0, count=3, arg=stall_s,
+                  match="replica:0@")])
+    kw = dict(chaos=True, op_timeout_s=10.0)
+    if recover:
+        kw.update(heartbeat_s=0.5,
+                  breaker={"fail_threshold": 99, "latency_s": 2.5,
+                           "cooldown_s": 1.0, "ema_alpha": 1.0})
+    with tempfile.TemporaryDirectory() as tmp:
+        # max_batch=1: exactly one prefill and one decode program shape,
+        # so every compile lands in the pre-roll — a mid-measurement
+        # batch-shape recompile would read as a stalled driver step
+        # (byte-identity is batch-invariant, so the refs still hold)
+        fleet = ProcFleetRouter(_net_cfg(fkw={"max_batch": 1}, **kw), tmp,
+                                num_replicas=2)
+        rep0 = fleet.replicas[0].sup
+        reqs = [Request(**wkw) for wkw in _fleet_wave_kwargs()]
+        slow, worst = 0, 0.0
+        try:
+            for r in reqs:
+                fleet.submit(r)
+            # un-measured pre-roll: each armed fleet builds FRESH engines,
+            # and their first steps pay jit compile (seconds) — latency the
+            # drill must not confuse with the injected stalls. Roll until
+            # EVERY replica's streams are advancing (compiles done on both
+            # — a compile-slow step legitimately trips the breaker, which
+            # then hides the un-compiled peer from the driver) and the
+            # breaker has closed again.
+            deadline = time.monotonic() + 120.0
+            prev = [None] * len(fleet.replicas)
+            adv = [0] * len(fleet.replicas)
+            sampled = [r for r, wkw in zip(reqs, _fleet_wave_kwargs())
+                       if wkw.get("temperature")]
+            while time.monotonic() < deadline:
+                fleet.step()
+                # throttle: loopback workers and heartbeat probes share
+                # this interpreter — a hot driver spin starves them on the
+                # GIL and inflates EVERY op into breaker-budget territory,
+                # burying the injected stalls in noise
+                time.sleep(0.005)
+                for i, rep in enumerate(fleet.replicas):
+                    sig = rep.sup.progress()
+                    if sig != prev[i]:
+                        prev[i] = sig
+                        adv[i] += 1
+                # the sampled-decode program is a SECOND shape that only
+                # compiles once a temperature>0 request reaches decode —
+                # the pre-roll must cover it too
+                if (min(adv) >= 4
+                        and all(len(r.tokens) >= 1 for r in sampled)
+                        and (not recover
+                             or rep0.breaker_state() == "closed")):
+                    break
+            trips0 = rep0._breaker.trips if recover else 0
+            with plan:
+                while (any(not (r.done or r.failed) for r in reqs)
+                       and time.monotonic() < deadline):
+                    t0 = time.perf_counter()
+                    fleet.step()
+                    dt = time.perf_counter() - t0
+                    worst = max(worst, dt)
+                    slow += dt > budget_s
+                    time.sleep(0.005)       # same GIL throttle, untimed
+            trips = rep0._breaker.trips - trips0 if recover else 0
+            state = rep0.breaker_state() if recover else "off"
+        finally:
+            fleet.close()
+    stalls = sum(1 for (_, _, a) in plan.log if a == "stall")
+    if not stalls:
+        return False, "net.recv stall never fired"
+    lost = [r.rid for r in reqs if r.failed or not r.done]
+    if lost:
+        return False, f"request(s) {lost} failed/unfinished under stalls"
+    if fleet.stats["replica_deaths"]:
+        return False, ("slow-but-alive peer was declared DEAD "
+                       f"({fleet.stats['replica_deaths']} death(s)) — "
+                       "latency must not be misread as a kill")
+    streams = [list(r.tokens) for r in reqs]
+    wrong = [i for i, (s, f) in enumerate(zip(streams, refs)) if s != f]
+    if wrong:
+        return False, f"stream(s) {wrong} diverged under stall injection"
+    if not recover:
+        if slow < 2:
+            return True, ("unexpected: stalls absorbed without a breaker "
+                          f"(worst step {worst:.2f}s)")
+        return False, (f"no circuit breaker: {slow} driver step(s) blew "
+                       f"past the {budget_s:.1f}s budget (worst "
+                       f"{worst:.2f}s) eating stalled replies inline")
+    if trips < 1:
+        return False, "stalls never tripped the breaker"
+    if slow > 1:
+        return False, (f"breaker failed to insulate the driver: {slow} "
+                       f"step(s) over budget (worst {worst:.2f}s)")
+    return True, (f"slow peer contained: breaker tripped {trips}x (final "
+                  f"state {state}), {stalls} stall(s) injected and at most "
+                  f"one eaten inline before the trip ({slow} driver "
+                  f"step(s) over budget, worst {worst:.2f}s), 0 replica "
+                  f"deaths, all {len(reqs)} streams byte-identical")
+
+
 def drill_fleet_drain(recover: bool):
     """Rolling restart of every replica under traffic (the ``fleet.drain``
     site drives the same path when planned). Recovery = graceful drain:
@@ -1449,6 +1729,8 @@ DRILLS = {
     "serving_overload_shed": drill_serving_overload_shed,
     "fleet_replica_kill": drill_fleet_replica_kill,
     "fleet_proc_kill": drill_fleet_proc_kill,
+    "net_flaky_migration": drill_net_flaky_migration,
+    "net_slow_peer": drill_net_slow_peer,
     "fleet_drain": drill_fleet_drain,
     "fleet_overload": drill_fleet_overload,
     "kv_migration_corruption": drill_kv_migration_corruption,

@@ -89,6 +89,15 @@ THREAD_ROOTS = {
         "ProcReplica.step", "ProcReplica.submit", "ProcReplica.progress",
         "ProcReplica.load", "ProcReplica.has_work", "ProcReplica.behind",
         "ProcReplica.heartbeat_count"],
+    # the transport seam (docs/SERVING.md "Transport seam"): frame IO is
+    # driven from the heartbeat thread and parallel_step replica threads
+    # (serialized per proxy by its _io_lock), and the loopback worker is
+    # a daemon THREAD whose entry point replaces the spawned process
+    "paddle_tpu/inference/procfleet/transport.py": [
+        "TcpTransport.send_frame", "TcpTransport.recv_frame",
+        "LoopbackTransport.send_frame", "LoopbackTransport.recv_frame",
+        "ChaosTransport.send_frame", "ChaosTransport.recv_frame"],
+    "paddle_tpu/inference/procfleet/worker.py": ["worker_thread_main"],
 }
 
 

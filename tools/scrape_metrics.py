@@ -77,6 +77,12 @@ REQUIRED_FAMILIES = (
     "pt_procfleet_reaped_total",
     "pt_procfleet_heartbeats_total",
     "pt_procfleet_workers_alive",
+    # transport seam (procfleet/transport.py): retryable wire timeouts,
+    # hedged KV migrations and the per-replica breaker gauge — rendered
+    # at zero over an in-process fleet like the families above
+    "pt_transport_retries",
+    "pt_transport_hedges",
+    "pt_transport_breaker_state",
     # speculative decode + int8 KV block format (docs/SERVING.md): the
     # engine collector renders these at zero on non-spec / fp engines, so
     # the families are REQUIRED unconditionally
