@@ -564,6 +564,67 @@ def bench_serving_recovery(dev, on_tpu):
           f"{sum(r.done and not r.failed for r in live)} served)", None)
 
 
+def bench_checkpoint_publish(dev, on_tpu):
+    """Checkpoint publish wall time (docs/RESILIENCE.md "Checkpoint
+    lifecycle"): digest-verify the manifest, map the checkpoint's params
+    into the live serving model in place, and hot-swap a warm 2-replica
+    fleet via rolling restart. Dominated by the rebuilt replicas' program
+    recompiles — exactly the cost an operator eats per weight push.
+    SECONDARY-guarded ("lower", 2s floor) by
+    tools/check_bench_regression.py."""
+    import os
+    import tempfile
+
+    from paddle_tpu.distributed.checkpoint import save_state_dict
+    from paddle_tpu.distributed.checkpoint.latest import commit_latest
+    from paddle_tpu.distributed.resilience.lifecycle import \
+        CheckpointPublisher
+    from paddle_tpu.inference.fleet import FleetRouter
+    from paddle_tpu.inference.serving import (ContinuousBatchingEngine,
+                                              Request)
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=512, intermediate_size=1408,
+            num_hidden_layers=4, num_attention_heads=8,
+            num_key_value_heads=8, max_position_embeddings=512,
+            dtype="bfloat16")
+        slots, max_len, page, block, n_req, max_new = 4, 256, 16, 8, 8, 48
+    else:
+        cfg = LlamaConfig.tiny()
+        slots, max_len, page, block, n_req, max_new = 2, 32, 8, 2, 4, 8
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size, (page,)).astype(np.int32)
+               for _ in range(n_req)]
+
+    def build():
+        return ContinuousBatchingEngine(
+            model, max_batch=slots, max_len=max_len, page_size=page,
+            block_size=block)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        ckpt = os.path.join(tmp, "ckpt")
+        step = 100
+        save_state_dict({"model": model.state_dict()},
+                        os.path.join(ckpt, f"step_{step:08d}"))
+        commit_latest(ckpt, step, 1)
+        fleet = FleetRouter(build, os.path.join(tmp, "fleet"),
+                            num_replicas=2)
+        reqs = [Request(p, max_new_tokens=max_new, seed=10 + i)
+                for i, p in enumerate(prompts)]
+        for r in reqs:                          # warm every replica first:
+            fleet.submit(r)                     # the swap cost measured is
+        fleet.run_until_done(max_steps=5000)    # rebuild, not cold compile
+        pub = CheckpointPublisher(ckpt).publish(model, fleet)
+        fleet.close()
+    _emit("checkpoint_publish_time_s", pub["time_s"],
+          f"s (digest-verify {pub['shards']} shard(s) + in-place load of "
+          f"{pub['params']} params + rolling hot-swap of 2 warm replicas, "
+          f"gen {pub['generation']}; recompile-dominated)", None)
+
+
 def bench_fleet(dev, on_tpu):
     """Fleet serving envelope (docs/SERVING.md fleet section): 3-replica
     FleetRouter aggregate throughput and journal-backed failover time.
@@ -1589,6 +1650,11 @@ def main():
         bench_serving_recovery(dev, on_tpu)
     except Exception as e:
         print(f"# serving recovery bench failed: {e!r}", flush=True)
+    gc.collect()
+    try:
+        bench_checkpoint_publish(dev, on_tpu)
+    except Exception as e:
+        print(f"# checkpoint publish bench failed: {e!r}", flush=True)
     gc.collect()
     try:
         bench_fleet(dev, on_tpu)

@@ -13,11 +13,13 @@ from ..framework.numeric_guard import (  # noqa: F401
 )
 from .checkpoint import (  # noqa: F401
     CheckpointCorruptionError,
+    StaleGenerationError,
     load_state_dict,
     save_state_dict,
     wait_async_save,
 )
 from .resilience import NumericWatchdog  # noqa: F401
+from .resilience.lifecycle import CheckpointPublisher  # noqa: F401
 from .auto_parallel import (  # noqa: F401
     Partial,
     Placement,

@@ -2,9 +2,13 @@
 reshard-on-load (reference: python/paddle/distributed/checkpoint/), plus
 integrity: atomic shard writes, per-shard checksums verified at load
 (CheckpointCorruptionError names the bad shard), replica recovery, and
-async saves flushed by wait_async_save (docs/RESILIENCE.md)."""
+async saves flushed by wait_async_save, and a generation-fenced LATEST
+resume pointer so stale writers can never rewind a job (latest.py,
+docs/RESILIENCE.md)."""
 
 from .integrity import CheckpointCorruptionError  # noqa: F401
+from .latest import (StaleGenerationError, claim_generation,  # noqa: F401
+                     commit_latest, read_latest)
 from .load_state_dict import get_state_dict_shapes, load_state_dict  # noqa: F401
 from .metadata import ChunkRecord, Metadata, TensorMetadata  # noqa: F401
 from .save_state_dict import save_state_dict, wait_async_save  # noqa: F401

@@ -1,0 +1,106 @@
+"""Generation-fenced LATEST resume pointer.
+
+``LATEST`` names the newest durable checkpoint step. Before this module it
+was a bare integer, which left two seams open (docs/RESILIENCE.md
+"Checkpoint lifecycle"):
+
+1. **Stale writer** — after an elastic shrink (or an operator restart) the
+   OLD trainer process may still be alive and mid-save. Its late
+   ``commit()`` would clobber the new trainer's pointer with an older
+   checkpoint, silently rewinding the job.
+2. **Torn async flush** — the pointer must only ever move after
+   ``wait_async_save`` proves every shard durable; the fence makes that
+   ordering an invariant of the commit primitive itself, not a property of
+   one caller.
+
+The fix is a monotonic **generation token** carried inside LATEST
+(``"<step> <generation>"``). Every writer claims a generation strictly
+above the committed one (:func:`claim_generation`); :func:`commit_latest`
+refuses — typed :class:`StaleGenerationError`, PT-CKPT-005 — any commit
+whose token is below the generation already on disk. The file itself moves
+via the same tempfile + ``os.replace`` as every shard, so the pointer is
+atomic: readers see the old (step, generation) pair or the new one, never
+a torn mix.
+
+Back-compat: a bare-integer LATEST from an older run parses as generation
+0, so any fenced writer (generation >= 1) supersedes it.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional, Tuple
+
+from .integrity import atomic_write_bytes
+
+__all__ = ["LATEST_FILE", "StaleGenerationError", "read_latest",
+           "latest_generation", "claim_generation", "commit_latest"]
+
+LATEST_FILE = "LATEST"
+
+# read-check-replace below must be one critical section per process: the
+# async-save committer and a concurrent publisher share this path
+# (PT-RACE discipline, tools/lint_concurrency.py)
+_LATEST_LOCK = threading.Lock()
+
+
+class StaleGenerationError(RuntimeError):
+    """A writer holding an outdated generation token tried to move LATEST.
+
+    Attributes: ``path`` (checkpoint root), ``committed`` (generation on
+    disk), ``attempted`` (the stale writer's token). Code PT-CKPT-005.
+    """
+
+    code = "PT-CKPT-005"
+
+    def __init__(self, path: str, committed: int, attempted: int):
+        self.path = path
+        self.committed = committed
+        self.attempted = attempted
+        super().__init__(
+            f"PT-CKPT-005: stale checkpoint writer fenced in {path}: "
+            f"generation {attempted} < committed generation {committed} "
+            f"(a newer trainer/publisher owns this directory)")
+
+
+def read_latest(ckpt_dir: str) -> Optional[Tuple[int, int]]:
+    """Parse LATEST into ``(step, generation)``; ``None`` when missing or
+    unparsable. Legacy bare-int pointers read as generation 0."""
+    try:
+        with open(os.path.join(ckpt_dir, LATEST_FILE)) as f:
+            fields = f.read().split()
+        step = int(fields[0])
+        gen = int(fields[1]) if len(fields) > 1 else 0
+        return step, gen
+    except (OSError, ValueError, IndexError):
+        return None
+
+
+def latest_generation(ckpt_dir: str) -> int:
+    """The committed generation (0 when no fenced LATEST exists yet)."""
+    rec = read_latest(ckpt_dir)
+    return rec[1] if rec is not None else 0
+
+
+def claim_generation(ckpt_dir: str) -> int:
+    """Claim a generation token strictly above everything committed — what
+    a new trainer (or publisher taking ownership) calls once at startup.
+    Any writer still holding an older token is fenced from then on."""
+    with _LATEST_LOCK:
+        return latest_generation(ckpt_dir) + 1
+
+
+def commit_latest(ckpt_dir: str, step: int, generation: int) -> None:
+    """Atomically move the resume pointer to ``step`` under ``generation``.
+
+    Raises :class:`StaleGenerationError` when the on-disk generation is
+    already above ``generation`` — the caller is a zombie writer and must
+    not publish. Equal generations commit freely (the same writer moves
+    its own pointer forward across saves)."""
+    with _LATEST_LOCK:
+        committed = latest_generation(ckpt_dir)
+        if int(generation) < committed:
+            raise StaleGenerationError(ckpt_dir, committed, int(generation))
+        atomic_write_bytes(os.path.join(ckpt_dir, LATEST_FILE),
+                           f"{int(step)} {int(generation)}".encode())

@@ -66,13 +66,29 @@ class _ChunkReader:
                 "data file missing (torn save?)")
         except CheckpointCorruptionError as e:
             primary_err = e
-        # primary bad: a verifying replica recovers the load
+        # primary bad: a verifying replica recovers the load. The replica
+        # is held to the SAME digest record as the primary — a corrupt
+        # fallback must never load silently.
         try:
             verify_shard_file(primary + REPLICA_SUFFIX, rec, self.path,
                               fname + REPLICA_SUFFIX)
             return primary + REPLICA_SUFFIX
-        except (FileNotFoundError, CheckpointCorruptionError):
-            raise primary_err from None
+        except FileNotFoundError:
+            if not os.path.exists(primary + REPLICA_SUFFIX):
+                # no replica was ever written: the primary's error stands
+                raise primary_err from None
+            replica_reason = "replica vanished mid-verify"
+        except CheckpointCorruptionError as re_err:
+            replica_reason = f"{re_err.code}: {re_err.reason}"
+        # BOTH copies failed: name each copy and its failure, so the
+        # operator knows this checkpoint is unrecoverable (not merely that
+        # the primary was bad and a replica might have saved it)
+        raise CheckpointCorruptionError(
+            primary_err.code, self.path, fname,
+            f"primary and replica both failed verification — "
+            f"primary: {primary_err.reason}; "
+            f"replica ({fname + REPLICA_SUFFIX}): {replica_reason}"
+        ) from None
 
     def _open(self, fname: str):
         if fname not in self._files:

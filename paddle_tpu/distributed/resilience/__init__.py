@@ -12,6 +12,7 @@ which pulls in the auto-parallel Engine stack — loads lazily.
 """
 
 from .faults import (  # noqa: F401
+    ComposedFaultPlan,
     FaultInjected,
     FaultPlan,
     FaultSpec,
@@ -35,18 +36,22 @@ from .retry import (  # noqa: F401
 from .watchdog import NumericWatchdog, StepWatchdog  # noqa: F401
 
 __all__ = [
-    "FaultInjected", "FaultPlan", "FaultSpec", "active_plan", "corrupt",
+    "ComposedFaultPlan", "FaultInjected", "FaultPlan", "FaultSpec",
+    "active_plan", "corrupt",
     "maybe_inject", "numeric_inject_code", "poison_arrays",
     "DEFAULT_POLICY", "RetryError", "RetryPolicy",
     "retries_disabled", "retry_call", "retry_stats", "reset_retry_stats",
     "ResilientTrainer", "NumericWatchdog", "StepWatchdog",
     "CheckpointCorruptionError", "EngineSaturated",
+    "CheckpointPublisher", "StaleGenerationError", "lifecycle_stats",
+    "reset_lifecycle_stats", "set_lifecycle_phase",
 ]
 
 
 def __getattr__(name):
-    # lazy: these pull in jax / the Engine stack, which would cycle with
-    # distributed/__init__ if imported eagerly here
+    # lazy: these pull in jax / the Engine stack (or sit beside modules
+    # that do), which would cycle with distributed/__init__ if imported
+    # eagerly here
     if name == "ResilientTrainer":
         from .trainer import ResilientTrainer
 
@@ -55,8 +60,17 @@ def __getattr__(name):
         from ..checkpoint.integrity import CheckpointCorruptionError
 
         return CheckpointCorruptionError
+    if name == "StaleGenerationError":
+        from ..checkpoint.latest import StaleGenerationError
+
+        return StaleGenerationError
     if name == "EngineSaturated":
         from ...inference.serving import EngineSaturated
 
         return EngineSaturated
+    if name in ("CheckpointPublisher", "lifecycle_stats",
+                "reset_lifecycle_stats", "set_lifecycle_phase"):
+        from . import lifecycle
+
+        return getattr(lifecycle, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

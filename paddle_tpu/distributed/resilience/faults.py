@@ -82,9 +82,9 @@ import threading
 import time
 from typing import List, Optional, Sequence
 
-__all__ = ["FaultSpec", "FaultPlan", "FaultInjected", "maybe_inject",
-           "corrupt", "active_plan", "numeric_inject_code", "poison_arrays",
-           "resource_hold", "wire_faults"]
+__all__ = ["FaultSpec", "FaultPlan", "ComposedFaultPlan", "FaultInjected",
+           "maybe_inject", "corrupt", "active_plan", "numeric_inject_code",
+           "poison_arrays", "resource_hold", "wire_faults"]
 
 
 class FaultInjected(ConnectionError):
@@ -157,6 +157,23 @@ class FaultPlan:
                     self.log.append((site, detail, s.action))
         return due
 
+    def rng_for(self, spec: FaultSpec) -> random.Random:
+        """The RNG a data hook draws from when ``spec`` fires. The base
+        plan shares one seeded stream across every spec — fine for one
+        site at a time, but concurrent sites would interleave draws in
+        thread-scheduling order. :class:`ComposedFaultPlan` overrides this
+        with per-spec derived streams."""
+        return self.rng
+
+    def fired(self) -> dict:
+        """``{site: times fired}`` snapshot of the log (drill assertions
+        use it to prove every scheduled site actually fired)."""
+        with self._lock:
+            out = {}
+            for site, _detail, _action in self.log:
+                out[site] = out.get(site, 0) + 1
+            return out
+
     # -- lifecycle ---------------------------------------------------------
     def install(self) -> "FaultPlan":
         global _ACTIVE
@@ -173,6 +190,43 @@ class FaultPlan:
 
     def __exit__(self, *exc) -> None:
         self.uninstall()
+
+
+class ComposedFaultPlan(FaultPlan):
+    """One seeded plan scheduling MULTIPLE fault sites concurrently — the
+    chaos arm of the lifecycle drill (store stall + heartbeat loss +
+    shard-write damage + replica kill in one run).
+
+    The base plan is already correct for concurrent *control* faults (the
+    per-spec counters advance under the plan lock), but its *data* faults
+    share one RNG stream: two sites corrupting bytes from different
+    threads would interleave their draws in scheduler order and the
+    injected damage would differ run to run. Here every spec gets its own
+    stream derived from ``(seed, spec index, site, action)`` — each site's
+    events are serialized by the site itself (one writer thread per shard
+    file, one heartbeat loop per node), so per-spec draws replay in event
+    order and the same composed plan over the same event streams injects
+    byte-identical faults regardless of cross-site thread interleaving.
+
+    >>> plan = ComposedFaultPlan(seed=7, specs=[
+    ...     FaultSpec("store.client", "stall", at=2, arg=0.2),
+    ...     FaultSpec("elastic.heartbeat", "kill", at=3, count=-1,
+    ...               match="nodeB"),
+    ...     FaultSpec("checkpoint.shard", "bitflip", arg=4),
+    ...     FaultSpec("fleet.replica_kill", "kill", at=5, count=1)])
+    >>> with plan:
+    ...     ...                       # all four sites armed at once
+    >>> plan.fired()                  # {site: count} — prove composition
+    """
+
+    def __init__(self, seed: int = 0, specs: Sequence[FaultSpec] = ()):
+        super().__init__(seed, specs)
+        self._spec_rngs = {
+            id(s): random.Random(f"{self.seed}:{i}:{s.site}:{s.action}")
+            for i, s in enumerate(self.specs)}
+
+    def rng_for(self, spec: FaultSpec) -> random.Random:
+        return self._spec_rngs.get(id(spec), self.rng)
 
 
 _ACTIVE: Optional[FaultPlan] = None
@@ -211,15 +265,17 @@ def corrupt(site: str, detail: str, data: bytes) -> bytes:
         elif s.action == "bitflip":
             buf = bytearray(data)
             nbits = int(s.arg) or 1
+            rng = plan.rng_for(s)
             # flip bits in the middle half of the payload: past container
             # headers, before trailing indexes — the silent-corruption zone
             lo, hi = len(buf) // 4, max(len(buf) // 4 + 1, (3 * len(buf)) // 4)
             for _ in range(nbits):
-                pos = plan.rng.randrange(lo, hi)
-                buf[pos] ^= 1 << plan.rng.randrange(8)
+                pos = rng.randrange(lo, hi)
+                buf[pos] ^= 1 << rng.randrange(8)
             data = bytes(buf)
         elif s.action == "garbage":
-            data = bytes(plan.rng.getrandbits(8) for _ in range(len(data)))
+            data = bytes(plan.rng_for(s).getrandbits(8)
+                         for _ in range(len(data)))
         elif s.action in ("stall", "delay"):
             time.sleep(s.arg)
         elif s.action == "kill":
@@ -305,7 +361,8 @@ def poison_arrays(detail, arrays):
         flat = a.reshape(-1)
         for s in due:
             n = int(s.arg) or max(1, flat.size // 100)
+            rng = plan.rng_for(s)
             for _ in range(n):
-                flat[plan.rng.randrange(flat.size)] = np.nan
+                flat[rng.randrange(flat.size)] = np.nan
         out.append(a)
     return tuple(out)

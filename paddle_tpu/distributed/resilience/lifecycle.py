@@ -1,0 +1,198 @@
+"""Checkpoint lifecycle — the train → serve handoff (docs/RESILIENCE.md
+"Checkpoint lifecycle").
+
+The checkpoint is the one artifact that crosses every subsystem boundary:
+the optimizer writes it (``ResilientTrainer`` + async ``save_state_dict``),
+an elastic resume reshards it onto the surviving mesh, and — with this
+module — a :class:`CheckpointPublisher` hands it to the serving fleet.
+Publishing is three fenced moves:
+
+1. **verify** — every shard file named by the ``0.metadata`` manifest is
+   digest-checked (crc32 + sha256 + size, replica fallback included) before
+   a single byte reaches a model. A checkpoint that cannot prove itself is
+   refused with the same typed ``PT-CKPT`` errors the loader raises.
+2. **load** — trained params map into the SERVING model's weight pytree in
+   place (``load_state_dict`` on ``{"model": model.state_dict()}``: the
+   optimizer's m/v/step stay behind, the live ``Tensor`` objects every
+   engine was built around are filled under their current shardings).
+3. **swap** — ``fleet.rolling_restart()`` drains and rebuilds one replica
+   at a time; each rebuilt engine snapshots the (now updated) weights at
+   construction, so traffic never sees a half-updated replica and the
+   swapped fleet is bit-equal to a cold fleet built from the published
+   checkpoint.
+
+Publishes are **generation-fenced on both sides**: the trainer's LATEST
+commit carries a monotonic token (``checkpoint/latest.py``, PT-CKPT-005),
+and the publisher refuses to publish a generation below the one it already
+served — a zombie pre-shrink writer can neither rewind the resume pointer
+nor roll the fleet back to its stale weights.
+
+Module-level lifecycle stats feed ``pt_checkpoint_generation`` /
+``pt_checkpoint_publish_total`` / ``pt_checkpoint_publish_failures`` /
+``pt_lifecycle_phase`` via ``observability.checkpoint_collector`` (REQUIRED
+in ``tools/scrape_metrics.py --selftest``); the full drill arc lives in
+``tools/fault_drill.py --drill lifecycle_e2e``.
+
+This module imports nothing heavy at import time (the collector touches it
+from scrape threads); jax-facing work is deferred into the methods.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+__all__ = ["CheckpointPublisher", "LIFECYCLE_PHASES", "lifecycle_stats",
+           "reset_lifecycle_stats", "set_lifecycle_phase"]
+
+#: the drill's state machine (docs/RESILIENCE.md lifecycle section)
+LIFECYCLE_PHASES = ("idle", "train", "checkpoint", "shrink", "resume",
+                    "publish", "serve")
+
+# shared by trainer threads, the publisher and scrape threads (PT-RACE)
+_STATS_LOCK = threading.Lock()
+_STATS = {
+    "generation": 0,          # newest generation successfully published
+    "publish_total": 0,
+    "publish_failures": 0,
+    "phase": "idle",
+}
+
+
+def lifecycle_stats() -> Dict[str, object]:
+    """Snapshot for the checkpoint collector (zero-state renders at 0)."""
+    with _STATS_LOCK:
+        return dict(_STATS)
+
+
+def reset_lifecycle_stats() -> None:
+    with _STATS_LOCK:
+        _STATS.update(generation=0, publish_total=0, publish_failures=0,
+                      phase="idle")
+
+
+def set_lifecycle_phase(phase: str) -> None:
+    """Advance the drill's phase marker (``pt_lifecycle_phase``)."""
+    if phase not in LIFECYCLE_PHASES:
+        raise ValueError(f"unknown lifecycle phase {phase!r} "
+                         f"(one of {LIFECYCLE_PHASES})")
+    with _STATS_LOCK:
+        _STATS["phase"] = phase
+
+
+class CheckpointPublisher:
+    """Hand a training checkpoint to a serving fleet, fenced and verified.
+
+    Args:
+        ckpt_dir: the ``ResilientTrainer`` checkpoint root (``step_<n>/``
+            dirs plus the generation-bearing ``LATEST`` pointer).
+        tracer: optional :class:`~paddle_tpu.observability.TraceRecorder`;
+            every publish lands as a ``publish`` span (step, generation,
+            shard count, outcome).
+
+    The publisher is itself a fence: :meth:`publish` refuses (typed
+    :class:`StaleGenerationError`) any checkpoint whose generation is below
+    the newest one this publisher already served, so a late call from a
+    zombie trainer cannot roll live weights backwards.
+    """
+
+    def __init__(self, ckpt_dir: str, *, tracer=None):
+        self.ckpt_dir = str(ckpt_dir)
+        self.tracer = tracer
+        self._lock = threading.Lock()
+        self._published: Optional[Tuple[int, int]] = None   # (step, gen)
+
+    # -- pointer ----------------------------------------------------------
+    def latest(self) -> Optional[Tuple[int, int]]:
+        """The committed ``(step, generation)`` resume pointer, or None."""
+        from ..checkpoint.latest import read_latest
+
+        return read_latest(self.ckpt_dir)
+
+    def step_dir(self, step: int) -> str:
+        return os.path.join(self.ckpt_dir, f"step_{step:08d}")
+
+    # -- the three moves --------------------------------------------------
+    def verify(self, step: int) -> int:
+        """Digest-check every shard the manifest names (replica fallback
+        honored). Returns the number of verified shard files; raises the
+        loader's typed ``PT-CKPT`` errors on damage, ``FileNotFoundError``
+        on a missing manifest (torn/absent checkpoint)."""
+        import json
+
+        from ..checkpoint.load_state_dict import _ChunkReader
+
+        path = self.step_dir(step)
+        with open(os.path.join(path, "0.metadata")) as f:
+            files = json.load(f).get("files") or {}
+        reader = _ChunkReader(path, files=files, verify=True)
+        for fname in sorted(files):
+            reader._verified_path(fname)
+        return len(files)
+
+    def load_weights(self, model, step: int) -> int:
+        """Map the checkpoint's trained params into ``model`` IN PLACE
+        (the serving engines' weight pytree — m/v/step are not touched).
+        Returns the number of parameter tensors filled."""
+        from ..checkpoint import load_state_dict
+
+        target = {"model": model.state_dict()}
+        load_state_dict(target, self.step_dir(step))
+        return len(target["model"])
+
+    def publish(self, model, fleet=None, *, step: Optional[int] = None,
+                verify: bool = True) -> Dict[str, object]:
+        """Verify → load → hot-swap. ``step=None`` publishes the committed
+        LATEST pointer. ``fleet`` (a ``FleetRouter`` or anything with
+        ``rolling_restart()``) is swapped replica-by-replica under
+        traffic; ``fleet=None`` just updates the model (callers owning
+        their own engines rebuild them).
+
+        Returns ``{"step", "generation", "shards", "params", "time_s"}``.
+        On any failure the fleet keeps its previous weights* and
+        ``publish_failures`` is incremented. (*verification happens before
+        the in-place load touches the model, so a corrupt checkpoint is
+        refused with the serving weights intact.)
+        """
+        from ..checkpoint.latest import StaleGenerationError
+
+        t0 = time.monotonic()
+        if step is None:
+            rec = self.latest()
+            if rec is None:
+                raise FileNotFoundError(
+                    f"no committed LATEST pointer in {self.ckpt_dir}")
+            step, gen = rec
+        else:
+            rec = self.latest()
+            gen = rec[1] if rec is not None and rec[0] == step else 0
+        set_lifecycle_phase("publish")
+        try:
+            with self._lock:
+                if (self._published is not None
+                        and gen < self._published[1]):
+                    raise StaleGenerationError(
+                        self.ckpt_dir, self._published[1], gen)
+            shards = self.verify(step) if verify else 0
+            params = self.load_weights(model, step)
+            if fleet is not None:
+                fleet.rolling_restart()
+            with self._lock:
+                self._published = (step, gen)
+        except BaseException:
+            with _STATS_LOCK:
+                _STATS["publish_failures"] += 1
+            if self.tracer is not None:
+                self.tracer.publish(t0, step, gen, 0, ok=False)
+            raise
+        with _STATS_LOCK:
+            _STATS["publish_total"] += 1
+            _STATS["generation"] = max(_STATS["generation"], gen)
+        set_lifecycle_phase("serve")
+        dt = time.monotonic() - t0
+        if self.tracer is not None:
+            self.tracer.publish(t0, step, gen, shards, ok=True)
+        return {"step": step, "generation": gen, "shards": shards,
+                "params": params, "time_s": dt}

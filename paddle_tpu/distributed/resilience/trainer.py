@@ -8,7 +8,11 @@ that contract end to end on the TPU-native stack:
   checksum-verified shards via distributed.checkpoint; periodic saves go
   through ``async_save`` and are *committed* — the LATEST pointer flipped —
   only after ``wait_async_save`` proves the shards are durable, so a crash
-  mid-save can never tear the resume point);
+  mid-save can never tear the resume point. The pointer itself is
+  generation-fenced (``checkpoint/latest.py``): each trainer claims a
+  monotonic token at construction, so a zombie writer from before an
+  elastic shrink gets a typed :class:`StaleGenerationError` instead of
+  rewinding the job);
 - watches an :class:`~paddle_tpu.distributed.fleet.elastic.ElasticManager`
   for scale events. A peer loss detected cleanly triggers save → rebuild
   the Engine over the surviving nodes (the caller's ``build_engine``
@@ -40,8 +44,6 @@ import shutil
 from typing import Callable, List, Optional
 
 __all__ = ["ResilientTrainer"]
-
-_LATEST = "LATEST"
 
 
 class ResilientTrainer:
@@ -79,6 +81,12 @@ class ResilientTrainer:
         self.rollback_at: List[int] = []
         self._pending_commit: Optional[int] = None
         os.makedirs(self.ckpt_dir, exist_ok=True)
+        # fence token: strictly above whatever is committed, so a zombie
+        # trainer from before a shrink/restart can never move LATEST
+        # backwards (PT-CKPT-005, checkpoint/latest.py)
+        from ..checkpoint.latest import claim_generation
+
+        self.generation = claim_generation(self.ckpt_dir)
 
     # -- checkpoint bookkeeping -------------------------------------------
     def _step_dir(self, step: int) -> str:
@@ -95,17 +103,15 @@ class ResilientTrainer:
         return sorted(out)
 
     def _write_latest(self, step: int) -> None:
-        from ..checkpoint.integrity import atomic_write_bytes
+        from ..checkpoint.latest import commit_latest
 
-        atomic_write_bytes(os.path.join(self.ckpt_dir, _LATEST),
-                           str(step).encode())
+        commit_latest(self.ckpt_dir, step, self.generation)
 
     def latest_step(self) -> Optional[int]:
-        try:
-            with open(os.path.join(self.ckpt_dir, _LATEST)) as f:
-                return int(f.read().strip())
-        except (OSError, ValueError):
-            return None
+        from ..checkpoint.latest import read_latest
+
+        rec = read_latest(self.ckpt_dir)
+        return rec[0] if rec is not None else None
 
     def save(self, engine, step: int, sync: bool = False) -> None:
         """Checkpoint the engine at ``step``. Async saves are committed (the
@@ -124,14 +130,18 @@ class ResilientTrainer:
             self._gc()
 
     def commit(self) -> None:
-        """Flush any in-flight async save and move the LATEST pointer."""
+        """Flush any in-flight async save and move the LATEST pointer. The
+        pending step is dropped BEFORE the flush: if ``wait_async_save``
+        raises (a shard writer died mid-flush), the pointer move is
+        abandoned for good — a later commit must not find the queue
+        drained and flip LATEST to the torn step."""
         if self._pending_commit is None:
             return
         from ..checkpoint import wait_async_save
 
+        step, self._pending_commit = self._pending_commit, None
         wait_async_save()
-        self._write_latest(self._pending_commit)
-        self._pending_commit = None
+        self._write_latest(step)
         self._gc()
 
     def _gc(self) -> None:

@@ -31,10 +31,11 @@ step path — guarded by the ``observability_overhead_pct`` bench line
 jax and is safe to import anywhere.
 """
 
-from .collectors import (engine_collector, fleet_collector,  # noqa: F401
-                         guard_collector, procfleet_collector,
-                         retry_collector, slo_collector,
-                         supervisor_collector, tracer_collector)
+from .collectors import (checkpoint_collector, engine_collector,  # noqa: F401
+                         fleet_collector, guard_collector,
+                         procfleet_collector, retry_collector,
+                         slo_collector, supervisor_collector,
+                         tracer_collector)
 from .metrics import (Counter, Gauge, Histogram,  # noqa: F401
                       MetricFamily, MetricsRegistry,
                       parse_prometheus_text)
@@ -50,6 +51,7 @@ __all__ = ["Counter", "Gauge", "Histogram", "MetricFamily",
            "MetricsRegistry", "MetricsServer", "ReplayDriver",
            "SLOConfig", "SLOMonitor", "ScheduledArrival", "TenantSpec",
            "TraceRecorder", "VirtualClock", "WorkloadConfig",
+           "checkpoint_collector",
            "decode_schedule", "encode_schedule", "engine_collector",
            "fleet_collector", "generate_schedule", "guard_collector",
            "parse_prometheus_text", "procfleet_collector",

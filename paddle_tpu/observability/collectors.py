@@ -28,6 +28,11 @@ Adapters (register with ``MetricsRegistry.register_collector``):
 - :func:`slo_collector` — ``SLOMonitor`` (observability/slo.py):
   windowed SLO attainment, per-tenant attainment and goodput as
   ``pt_slo_*`` families.
+- :func:`checkpoint_collector` — the checkpoint lifecycle
+  (distributed/resilience/lifecycle.py): published generation, publish
+  totals/failures, and the train→serve phase gauge. Renders at
+  zero/``idle`` with no publisher constructed, so the scrape gate
+  REQUIREs the families unconditionally.
 - :func:`procfleet_collector` — process-per-replica fleet transport
   (inference/procfleet): spawn/reap/heartbeat counters, workers-alive
   gauge, and — the remote-scrape topology (docs/OBSERVABILITY.md) — every
@@ -50,9 +55,9 @@ from typing import Iterable, List, Optional
 
 from .metrics import MetricFamily, parse_prometheus_text
 
-__all__ = ["engine_collector", "fleet_collector", "guard_collector",
-           "procfleet_collector", "retry_collector", "slo_collector",
-           "supervisor_collector", "tracer_collector"]
+__all__ = ["checkpoint_collector", "engine_collector", "fleet_collector",
+           "guard_collector", "procfleet_collector", "retry_collector",
+           "slo_collector", "supervisor_collector", "tracer_collector"]
 
 
 def _stat_families(prefix: str, stats: dict, kinds: dict,
@@ -398,6 +403,59 @@ def tracer_collector(tracer, **labels):
             MetricFamily("pt_tracer_resubmits_total", "counter").add(
                 c["resubmits"], **labels),
         ]
+
+    return collect
+
+
+def checkpoint_collector(stats_fn=None):
+    """Checkpoint-lifecycle families (docs/RESILIENCE.md "Checkpoint
+    lifecycle"): ``pt_checkpoint_generation`` (the newest generation
+    published to serving), ``pt_checkpoint_publish_total`` /
+    ``pt_checkpoint_publish_failures`` (CheckpointPublisher outcomes) and
+    ``pt_lifecycle_phase`` (one 0/1 gauge per phase of the
+    train→checkpoint→shrink→resume→publish→serve arc; exactly one sample
+    is 1). Reads the module-level stats in
+    ``distributed.resilience.lifecycle`` — imported lazily at SCRAPE time
+    so registering this collector keeps observability jax-free; pass
+    ``stats_fn`` to scrape a different source (tests). With no publisher
+    constructed yet every family renders at zero / phase ``idle``, so the
+    scrape gate can REQUIRE them unconditionally."""
+
+    def collect() -> Iterable[MetricFamily]:
+        if stats_fn is not None:
+            stats = stats_fn()
+            phases = None
+        else:
+            from ..distributed.resilience.lifecycle import (LIFECYCLE_PHASES,
+                                                            lifecycle_stats)
+
+            stats = lifecycle_stats()
+            phases = LIFECYCLE_PHASES
+        if phases is None:
+            phases = ("idle", "train", "checkpoint", "shrink", "resume",
+                      "publish", "serve")
+        fams = [
+            MetricFamily(
+                "pt_checkpoint_generation", "gauge",
+                "newest checkpoint generation published to serving").add(
+                stats.get("generation", 0)),
+            MetricFamily(
+                "pt_checkpoint_publish_total", "counter",
+                "checkpoints handed to the serving fleet").add(
+                stats.get("publish_total", 0)),
+            MetricFamily(
+                "pt_checkpoint_publish_failures", "counter",
+                "publishes refused (corrupt manifest, stale generation, "
+                "swap failure)").add(stats.get("publish_failures", 0)),
+        ]
+        phase = MetricFamily(
+            "pt_lifecycle_phase", "gauge",
+            "current phase of the train->serve lifecycle (1 = active)")
+        current = stats.get("phase", "idle")
+        for p in phases:
+            phase.add(1.0 if p == current else 0.0, phase=p)
+        fams.append(phase)
+        return fams
 
     return collect
 

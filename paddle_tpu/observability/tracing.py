@@ -447,6 +447,24 @@ class TraceRecorder:
         self.span("recovery", None, t0, t1, tags, code=code,
                   replayed=int(replayed))
 
+    def publish(self, t0: float, step: int, generation: int, shards: int,
+                ok: bool = True, t1: Optional[float] = None,
+                tags: Optional[dict] = None) -> None:
+        """A checkpoint handed from training to serving (CheckpointPublisher,
+        docs/RESILIENCE.md lifecycle): manifest verify -> in-place weight
+        load -> rolling fleet swap, one span covering the whole handoff."""
+        self.span("publish", None, t0, t1, tags, step=int(step),
+                  generation=int(generation), shards=int(shards),
+                  ok=bool(ok))
+
+    def resume(self, t0: float, step: int, world: int,
+               t1: Optional[float] = None,
+               tags: Optional[dict] = None) -> None:
+        """An elastic resume: checkpoint reloaded (reshard-on-load) onto
+        the surviving mesh at the recorded step."""
+        self.span("resume", None, t0, t1, tags, step=int(step),
+                  world=int(world))
+
     # -- introspection / export -------------------------------------------
     def counters(self) -> dict:
         """Recorder health counters, read under the stamp lock — the

@@ -38,11 +38,15 @@ def test_manifest_counts_cover_reference_parity():
         "paddle.nn.functional": 156,
         "paddle.linalg": 46,
         "paddle.tensor_methods": 359,
-        "paddle.distributed": 74,    # resilience PR: + resilience module,
+        "paddle.distributed": 76,    # resilience PR: + resilience module,
                                      # CheckpointCorruptionError, wait_async_save;
                                      # numeric-guard PR: + GuardPolicy,
                                      # NumericWatchdog, NumericAnomalyError,
-                                     # BadBatchRecorder
+                                     # BadBatchRecorder;
+                                     # lifecycle PR (docs/RESILIENCE.md
+                                     # "Checkpoint lifecycle"): +
+                                     # CheckpointPublisher,
+                                     # StaleGenerationError
         "paddle.optimizer": 17,
         "paddle.incubate.nn.functional": 23,
         "paddle.geometric": 11,
@@ -81,8 +85,10 @@ def test_manifest_counts_cover_reference_parity():
         # generate/encode/decode_schedule/schedule_digest +
         # SLOConfig/SLOMonitor + tracer_collector/slo_collector;
         # procfleet PR: + procfleet_collector (worker /metrics
-        # aggregation under replica=i labels)
-        "paddle.observability": 27,
+        # aggregation under replica=i labels);
+        # lifecycle PR: + checkpoint_collector (generation/publish
+        # counters + the pt_lifecycle_phase gauge)
+        "paddle.observability": 28,
         # concurrency-lint PR (docs/STATIC_ANALYSIS.md PT-RACE section):
         # analyze_source/file/paths, build_module_model,
         # infer_shared_state, run_checks, finding_id, ModuleModel,
@@ -346,7 +352,7 @@ def test_collective_comm_gate_real_sweep_clean():
         assert line and "unsharded, 0 collective eqn(s)" in line[0], r.stdout
 
 
-@pytest.mark.slow   # ~5min of engine/train-loop compiles across 21 classes
+@pytest.mark.slow   # ~6min of engine/train-loop compiles across 23 classes
 def test_fault_drill_matrix():
     """Resilience gate (docs/RESILIENCE.md + docs/NUMERIC_GUARD.md +
     docs/SERVING.md): the seeded fault matrix — heartbeat loss, store
@@ -361,7 +367,12 @@ def test_fault_drill_matrix():
     per-peer circuit breaker (net_slow_peer), KV-migration corruption
     (PT-SRV-007, int8 chains included), speculative-decode divergence
     (accept-all control arm vs in-graph verify), NaN
-    gradient, loss spike, poisoned batch — must be
+    gradient, loss spike, poisoned batch, a composed three-site chaos
+    plan (store stall + bitflipped shard + replica kill off ONE seed,
+    byte-identical damage across runs), and the full checkpoint-lifecycle
+    arc (train → async checkpoint → elastic 8→4 shrink → resume → verify
+    → generation-fenced publish → byte-identical serving,
+    lifecycle_e2e) — must be
     absorbed with recovery enabled AND flip the exit code
     with recovery disabled. Runs in a subprocess (the drill forces the
     pure-Python store daemon for server-side faults).
@@ -378,7 +389,7 @@ def test_fault_drill_matrix():
          "--selftest"],
         capture_output=True, text=True, env=env, cwd=ROOT, timeout=840)
     assert r.returncode == 0, r.stdout + r.stderr
-    assert "FAULT DRILL OK: 21 fault classes" in r.stdout, r.stdout
+    assert "FAULT DRILL OK: 23 fault classes" in r.stdout, r.stdout
 
 
 def test_fault_drill_single_drill_exit_codes():

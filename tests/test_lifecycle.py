@@ -1,0 +1,456 @@
+"""Checkpoint-lifecycle tests: the generation-fenced LATEST pointer
+(checkpoint/latest.py, PT-CKPT-005), the async-save commit fence on
+ResilientTrainer (a kill mid-flush can never publish a torn resume point),
+dual-failure replica naming, ComposedFaultPlan determinism, the exact-step
+bit-equal reshard-resume pin, and CheckpointPublisher's verify → load →
+swap handoff with its lifecycle stats/spans.
+
+The full chaos-tested arc (train → async checkpoint → elastic shrink →
+resume → publish → serve under a composed three-site plan) runs in
+tools/fault_drill.py --drill lifecycle_e2e, gated by tests/test_ci_gates.py;
+these are the fast deterministic pins behind it (docs/RESILIENCE.md
+"Checkpoint lifecycle").
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed.checkpoint import (
+    CheckpointCorruptionError,
+    StaleGenerationError,
+    claim_generation,
+    commit_latest,
+    load_state_dict,
+    read_latest,
+    save_state_dict,
+)
+from paddle_tpu.distributed.checkpoint.latest import latest_generation
+from paddle_tpu.distributed.resilience import (
+    ComposedFaultPlan,
+    FaultPlan,
+    FaultSpec,
+    ResilientTrainer,
+    corrupt,
+    maybe_inject,
+)
+from paddle_tpu.distributed.resilience.lifecycle import (
+    LIFECYCLE_PHASES,
+    CheckpointPublisher,
+    lifecycle_stats,
+    reset_lifecycle_stats,
+    set_lifecycle_phase,
+)
+
+
+# ---------------------------------------------------------------------------
+# generation-fenced LATEST pointer
+# ---------------------------------------------------------------------------
+
+class TestGenerationFence:
+    def test_commit_and_read_roundtrip(self, tmp_path):
+        d = str(tmp_path)
+        assert read_latest(d) is None
+        assert latest_generation(d) == 0
+        commit_latest(d, 5, 1)
+        assert read_latest(d) == (5, 1)
+        assert latest_generation(d) == 1
+
+    def test_stale_writer_fenced_pt_ckpt_005(self, tmp_path):
+        d = str(tmp_path)
+        commit_latest(d, 10, 3)
+        with pytest.raises(StaleGenerationError) as ei:
+            commit_latest(d, 12, 2)       # newer step, OLDER generation
+        assert ei.value.code == "PT-CKPT-005"
+        assert ei.value.committed == 3 and ei.value.attempted == 2
+        assert ei.value.path == d
+        # the fence held: the pointer never moved
+        assert read_latest(d) == (10, 3)
+
+    def test_same_generation_moves_its_own_pointer(self, tmp_path):
+        d = str(tmp_path)
+        commit_latest(d, 2, 2)
+        commit_latest(d, 4, 2)            # same writer, later save
+        assert read_latest(d) == (4, 2)
+
+    def test_legacy_bare_int_reads_as_generation_zero(self, tmp_path):
+        (tmp_path / "LATEST").write_text("7")
+        d = str(tmp_path)
+        assert read_latest(d) == (7, 0)
+        # any fenced writer supersedes a legacy pointer
+        assert claim_generation(d) == 1
+        commit_latest(d, 9, 1)
+        assert read_latest(d) == (9, 1)
+
+    def test_claim_generation_is_strictly_increasing(self, tmp_path):
+        d = str(tmp_path)
+        g1 = claim_generation(d)
+        commit_latest(d, 1, g1)
+        g2 = claim_generation(d)
+        assert g2 == g1 + 1
+        commit_latest(d, 3, g2)
+        with pytest.raises(StaleGenerationError):
+            commit_latest(d, 5, g1)       # the old claimant is now fenced
+
+
+# ---------------------------------------------------------------------------
+# toy engine fixtures (mirrors tests/test_resilience.py conventions)
+# ---------------------------------------------------------------------------
+
+def _toy_build(alive, d=8):
+    from jax.sharding import Mesh
+    from paddle_tpu.distributed.auto_parallel import Engine
+    from paddle_tpu.nn.layer.layers import Layer
+
+    class Toy(Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = paddle.nn.Linear(d, d)
+
+        def loss_fn(self, x, y):
+            out = self.fc(Tensor(x))
+            diff = out._data - y
+            return (diff * diff).mean()
+
+    n = 8 if len(alive) >= 2 else 4
+    mesh = Mesh(np.array(jax.devices()[:n]), ("dp",))
+    paddle.seed(0)
+    return Engine(Toy(), mesh, lr=0.05, clip_norm=None)
+
+
+def _data_fn(step, b=8, d=8):
+    rng = np.random.default_rng(1000 + step)
+    return (rng.standard_normal((b, d)).astype(np.float32),
+            rng.standard_normal((b, d)).astype(np.float32))
+
+
+def _leaves(tree, prefix=""):
+    """Flatten a state dict to {path: np.ndarray} for bit-equality pins."""
+    out = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_leaves(v, f"{prefix}/{k}"))
+        return out
+    arr = tree._data if hasattr(tree, "_data") else tree
+    out[prefix] = np.asarray(arr)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# trainer commit fence — kill mid-flush can never publish a torn LATEST
+# ---------------------------------------------------------------------------
+
+class TestTrainerCommitFence:
+    def test_kill_mid_flush_leaves_previous_latest_loadable(self, tmp_path):
+        d = str(tmp_path)
+        t = ResilientTrainer(_toy_build, d, save_every=2, async_save=True)
+        eng = _toy_build(["a", "b"])
+        for s in range(2):
+            eng.step(*eng.shard_batch(*_data_fn(s)))
+        t.save(eng, 2, sync=True)         # durable baseline: LATEST = step 2
+        assert read_latest(d) == (2, t.generation)
+        with FaultPlan(specs=[FaultSpec("checkpoint.shard", "error")]):
+            t.save(eng, 4)                # async flush dies on the writer
+            with pytest.raises(RuntimeError, match="fault injected"):
+                t.commit()
+        # the torn run is invisible: pointer still names the durable step,
+        # and a LATER commit must not resurrect the abandoned move
+        assert read_latest(d) == (2, t.generation)
+        t.commit()
+        assert read_latest(d) == (2, t.generation)
+        # a fresh trainer resumes from the durable checkpoint
+        t2 = ResilientTrainer(_toy_build, d, save_every=2)
+        eng2 = _toy_build(["solo"])
+        assert t2.resume(eng2) == 2
+
+    def test_zombie_trainer_commit_is_fenced(self, tmp_path):
+        """The stale-writer drill: a pre-shrink trainer still holding an
+        old generation token must get PT-CKPT-005, not rewind the job."""
+        d = str(tmp_path)
+        old = ResilientTrainer(_toy_build, d, save_every=2, async_save=False)
+        eng = _toy_build(["a", "b"])
+        old.save(eng, 2, sync=True)
+        # a NEW trainer takes ownership (post-shrink restart) and commits
+        new = ResilientTrainer(_toy_build, d, save_every=2, async_save=False)
+        assert new.generation == old.generation + 1
+        new.save(eng, 4, sync=True)
+        assert read_latest(d) == (4, new.generation)
+        # the zombie's late save is refused and the pointer holds
+        with pytest.raises(StaleGenerationError):
+            old.save(eng, 6, sync=True)
+        assert read_latest(d) == (4, new.generation)
+
+
+# ---------------------------------------------------------------------------
+# replica fallback — dual failure names BOTH copies
+# ---------------------------------------------------------------------------
+
+class TestReplicaDualFailure:
+    def _flip(self, path):
+        blob = bytearray(path.read_bytes())
+        blob[len(blob) // 2] ^= 0xFF
+        path.write_bytes(bytes(blob))
+
+    def test_both_copies_corrupt_names_each(self, tmp_path):
+        sd = {"w": Tensor(jnp.arange(512, dtype=jnp.float32))}
+        save_state_dict(sd, str(tmp_path), replica=True)
+        self._flip(tmp_path / "0_0.distcp")
+        self._flip(tmp_path / "0_0.distcp.replica")
+        target = {"w": Tensor(jnp.zeros(512, jnp.float32))}
+        with pytest.raises(CheckpointCorruptionError) as ei:
+            load_state_dict(target, str(tmp_path))
+        msg = str(ei.value)
+        assert "primary and replica both failed" in msg
+        assert "0_0.distcp.replica" in msg
+        # and neither copy loaded: the target is untouched
+        np.testing.assert_array_equal(np.asarray(target["w"]._data),
+                                      np.zeros(512, np.float32))
+
+
+# ---------------------------------------------------------------------------
+# ComposedFaultPlan — per-spec RNG streams, interleaving-proof
+# ---------------------------------------------------------------------------
+
+class TestComposedFaultPlan:
+    PAYLOAD = bytes(range(256)) * 16
+
+    def _damage(self, order, cls=ComposedFaultPlan):
+        plan = cls(seed=5, specs=[
+            FaultSpec("site.a", "bitflip", arg=8),
+            FaultSpec("site.b", "bitflip", arg=8)])
+        out = {}
+        with plan:
+            for site in order:
+                out[site] = corrupt(site, "f", self.PAYLOAD)
+        return out
+
+    def test_per_site_damage_is_order_independent(self):
+        d1 = self._damage(["site.a", "site.b"])
+        d2 = self._damage(["site.b", "site.a"])
+        assert d1 == d2                     # byte-identical per site
+        assert d1["site.a"] != self.PAYLOAD
+        assert d1["site.a"] != d1["site.b"]  # streams are per-spec, not shared
+
+    def test_base_plan_shares_one_stream(self):
+        """The contrast that motivates the subclass: the base plan's single
+        RNG makes damage depend on cross-site call order."""
+        d1 = self._damage(["site.a", "site.b"], cls=FaultPlan)
+        d2 = self._damage(["site.b", "site.a"], cls=FaultPlan)
+        assert d1["site.a"] != d2["site.a"]
+
+    def test_threaded_damage_is_deterministic(self):
+        """PT-RACE posture: each site's events are serialized by its own
+        thread, so concurrent sites replay byte-identically run to run."""
+        def run():
+            plan = ComposedFaultPlan(seed=9, specs=[
+                FaultSpec("site.a", "bitflip", count=3, arg=4),
+                FaultSpec("site.b", "garbage", count=3)])
+            out = {"site.a": [], "site.b": []}
+
+            def loop(site):
+                for _ in range(3):
+                    out[site].append(corrupt(site, "f", self.PAYLOAD))
+
+            with plan:
+                ts = [threading.Thread(target=loop, args=(s,))
+                      for s in out]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join()
+            return out
+
+        r1, r2 = run(), run()
+        assert r1 == r2
+        assert len(set(r1["site.a"])) == 3  # successive draws differ
+
+    def test_fired_counts_every_site(self):
+        plan = ComposedFaultPlan(seed=1, specs=[
+            FaultSpec("x", "stall", at=0, count=2, arg=0.0),
+            FaultSpec("y", "bitflip", at=0, count=1, arg=1)])
+        with plan:
+            maybe_inject("x")
+            maybe_inject("x")
+            corrupt("y", "f", b"\x00" * 64)
+        assert plan.fired() == {"x": 2, "y": 1}
+
+    def test_rng_for_is_stable_per_spec(self):
+        specs = [FaultSpec("x", "bitflip"), FaultSpec("y", "garbage")]
+        plan = ComposedFaultPlan(seed=3, specs=specs)
+        assert plan.rng_for(specs[0]) is plan.rng_for(specs[0])
+        assert plan.rng_for(specs[0]) is not plan.rng_for(specs[1])
+        base = FaultPlan(seed=3, specs=specs)
+        assert base.rng_for(specs[0]) is base.rng
+
+
+# ---------------------------------------------------------------------------
+# exact-step bit-equal reshard resume (fast pin behind the slow drill)
+# ---------------------------------------------------------------------------
+
+class TestExactReshardResume:
+    def test_shrink_resume_exact_step_bit_equal_state(self, tmp_path):
+        """dp8 → dp4 shrink resumes at EXACTLY the recorded step with
+        bit-equal params AND optimizer moments — the deterministic pin
+        behind the lifecycle_e2e drill's elastic leg (reshard-on-load must
+        be a pure re-placement, never a recompute)."""
+        d = str(tmp_path)
+        t1 = ResilientTrainer(_toy_build, d, save_every=3, async_save=False)
+        out = t1.fit(_data_fn, 3)          # final sync save at step 3
+        ref = _leaves(out["engine"].state_dict())
+
+        t2 = ResilientTrainer(lambda alive: _toy_build(["solo"]), d,
+                              save_every=3)
+        eng2 = _toy_build(["solo"])        # dp4 survivors' mesh
+        assert t2.resume(eng2) == 3        # the exact recorded step
+        got = _leaves(eng2.state_dict())
+        assert set(got) == set(ref)
+        for path in sorted(ref):
+            np.testing.assert_array_equal(got[path], ref[path], err_msg=path)
+
+
+# ---------------------------------------------------------------------------
+# CheckpointPublisher — verify → load → swap, fenced and observable
+# ---------------------------------------------------------------------------
+
+def _trained_ckpt(tmp_path, steps=2):
+    t = ResilientTrainer(_toy_build, str(tmp_path), save_every=1,
+                         async_save=False)
+    out = t.fit(_data_fn, steps)
+    return t, out["engine"]
+
+
+class TestCheckpointPublisher:
+    def test_publish_fills_model_bit_equal(self, tmp_path):
+        reset_lifecycle_stats()
+        t, eng = _trained_ckpt(tmp_path)
+        pub_model = _toy_build(["solo"]).model   # fresh (re-seeded) weights
+        publisher = CheckpointPublisher(str(tmp_path))
+        pub = publisher.publish(pub_model)
+        assert pub["step"] == 2 and pub["generation"] == t.generation
+        assert pub["shards"] >= 1 and pub["params"] >= 1
+        ref = _leaves(eng.state_dict()["model"])
+        got = _leaves(pub_model.state_dict())
+        assert set(got) == set(ref)
+        for path in sorted(ref):
+            np.testing.assert_array_equal(got[path], ref[path], err_msg=path)
+        stats = lifecycle_stats()
+        assert stats["publish_total"] == 1
+        assert stats["generation"] == t.generation
+        assert stats["phase"] == "serve"
+
+    def test_corrupt_checkpoint_refused_weights_intact(self, tmp_path):
+        reset_lifecycle_stats()
+        t, _ = _trained_ckpt(tmp_path)
+        shard = tmp_path / "step_00000002" / "0_0.distcp"
+        blob = bytearray(shard.read_bytes())
+        blob[len(blob) // 2] ^= 0xFF
+        shard.write_bytes(bytes(blob))
+        pub_model = _toy_build(["solo"]).model
+        before = _leaves(pub_model.state_dict())
+        publisher = CheckpointPublisher(str(tmp_path))
+        with pytest.raises(CheckpointCorruptionError):
+            publisher.publish(pub_model)
+        # verification runs BEFORE the in-place load: serving weights held
+        after = _leaves(pub_model.state_dict())
+        for path in sorted(before):
+            np.testing.assert_array_equal(after[path], before[path])
+        stats = lifecycle_stats()
+        assert stats["publish_failures"] == 1 and stats["publish_total"] == 0
+
+    def test_publisher_fences_generation_rollback(self, tmp_path):
+        """After serving generation g, a request to publish an unfenced
+        older step (generation 0 — e.g. a zombie writer's leftovers) is
+        refused; a same-generation republish is allowed."""
+        t, _ = _trained_ckpt(tmp_path)     # step dirs 1 and 2, LATEST (2, g)
+        pub_model = _toy_build(["solo"]).model
+        publisher = CheckpointPublisher(str(tmp_path))
+        pub = publisher.publish(pub_model)
+        assert pub["generation"] >= 1
+        with pytest.raises(StaleGenerationError) as ei:
+            publisher.publish(pub_model, step=1)
+        assert ei.value.code == "PT-CKPT-005"
+        pub2 = publisher.publish(pub_model)   # same weights, same generation
+        assert pub2["generation"] == pub["generation"]
+
+    def test_publish_and_resume_emit_tracer_spans(self, tmp_path):
+        from paddle_tpu.observability import TraceRecorder
+
+        t, _ = _trained_ckpt(tmp_path)
+        tr = TraceRecorder()
+        publisher = CheckpointPublisher(str(tmp_path), tracer=tr)
+        pub_model = _toy_build(["solo"]).model
+        publisher.publish(pub_model)
+        spans = [e for e in tr.events if e["name"] == "publish"]
+        assert len(spans) == 1
+        args = spans[0]["args"]
+        assert args["step"] == 2 and args["ok"] is True
+        assert args["generation"] == t.generation and args["shards"] >= 1
+        # failure spans carry ok=False (the scrape side of publish_failures)
+        shard = tmp_path / "step_00000002" / "0_0.distcp"
+        blob = bytearray(shard.read_bytes())
+        blob[len(blob) // 2] ^= 0xFF
+        shard.write_bytes(bytes(blob))
+        with pytest.raises(CheckpointCorruptionError):
+            publisher.publish(pub_model)
+        spans = [e for e in tr.events if e["name"] == "publish"]
+        assert spans[-1]["args"]["ok"] is False
+        # the resume span helper stamps step + surviving world size
+        tr.resume(tr.now(), step=3, world=4)
+        res = [e for e in tr.events if e["name"] == "resume"]
+        assert res and res[0]["args"] == {"step": 3, "world": 4}
+
+
+# ---------------------------------------------------------------------------
+# lifecycle stats + checkpoint collector
+# ---------------------------------------------------------------------------
+
+class TestLifecycleObservability:
+    def _families(self, collect):
+        return {f.name: f for f in collect()}
+
+    def test_phase_gauge_validates_and_is_one_hot(self):
+        from paddle_tpu.observability.collectors import checkpoint_collector
+
+        reset_lifecycle_stats()
+        with pytest.raises(ValueError, match="unknown lifecycle phase"):
+            set_lifecycle_phase("reticulating")
+        for phase in LIFECYCLE_PHASES:
+            set_lifecycle_phase(phase)
+            fams = self._families(checkpoint_collector())
+            samples = fams["pt_lifecycle_phase"].samples
+            hot = [lbl["phase"] for _s, lbl, v in samples if v == 1.0]
+            assert hot == [phase]
+            assert sum(v for _s, _l, v in samples) == 1.0
+        reset_lifecycle_stats()
+        assert lifecycle_stats()["phase"] == "idle"
+
+    def test_zero_state_renders_required_families(self):
+        """With no publisher ever constructed the collector must still
+        render every family (they are REQUIRED unconditionally in
+        tools/scrape_metrics.py --selftest)."""
+        from paddle_tpu.observability.collectors import checkpoint_collector
+
+        reset_lifecycle_stats()
+        fams = self._families(checkpoint_collector())
+        assert fams["pt_checkpoint_generation"].samples[0][2] == 0.0
+        assert fams["pt_checkpoint_publish_total"].samples[0][2] == 0.0
+        assert fams["pt_checkpoint_publish_failures"].samples[0][2] == 0.0
+
+    def test_stats_fn_injection(self):
+        from paddle_tpu.observability.collectors import checkpoint_collector
+
+        fams = self._families(checkpoint_collector(lambda: {
+            "generation": 3, "publish_total": 2, "publish_failures": 1,
+            "phase": "publish"}))
+        assert fams["pt_checkpoint_generation"].samples[0][2] == 3.0
+        assert fams["pt_checkpoint_publish_total"].samples[0][2] == 2.0
+        assert fams["pt_checkpoint_publish_failures"].samples[0][2] == 1.0
+        hot = [lbl["phase"] for _s, lbl, v
+               in fams["pt_lifecycle_phase"].samples if v == 1.0]
+        assert hot == ["publish"]

@@ -6,9 +6,12 @@ same fault must flip the exit code). ``--selftest`` runs the whole seeded
 matrix — heartbeat loss, store stall, checkpoint shard corruption, serving
 engine saturation, serving deadline, prefix-cache block-pool exhaustion,
 128-slot fused big-batch saturation (docs/SERVING.md), speculative-decode
-divergence (verification disabled — accept-all), plus the numeric
+divergence (verification disabled — accept-all), the numeric
 classes (NaN gradient, loss spike,
-poisoned batch — docs/NUMERIC_GUARD.md) — and exits
+poisoned batch — docs/NUMERIC_GUARD.md), a composed multi-site chaos plan
+(three subsystems faulted concurrently off ONE seed), and the full
+checkpoint-lifecycle arc (train → async checkpoint → elastic shrink →
+resume → publish-to-serving, docs/RESILIENCE.md) — and exits
 0 iff every fault class recovers when enabled AND fails when its recovery
 is off. For the numeric drills "recovery off" means GuardPolicy(action=
 "warn"): detection stays on but the anomalous update is applied — exactly
@@ -1716,6 +1719,344 @@ def drill_fleet_overload(recover: bool):
                   "exited hysteretically")
 
 
+# ---------------------------------------------------------------------------
+# drills: composed multi-site chaos + the full checkpoint-lifecycle arc
+# ---------------------------------------------------------------------------
+
+def drill_composed_chaos(recover: bool):
+    """One seeded ComposedFaultPlan arms THREE fault sites at once against
+    three subsystems running in parallel threads: the store daemon stalls
+    past the client op deadline, a checkpoint shard is bitflipped on
+    write, and a serving replica is killed mid-traffic. Recovery = each
+    subsystem's own path absorbs its fault (PT-RETRY rides the stall,
+    digest verification falls back to the replica copy, the fleet replays
+    the dead replica's journal) — and the plan's per-spec RNG streams keep
+    the injected damage byte-identical across runs no matter how the
+    threads interleave. With recovery off (retries disabled, no replica
+    copy, no failover) the same plan must bite."""
+    import numpy as np
+
+    from paddle_tpu.distributed.checkpoint import (load_state_dict,
+                                                   save_state_dict)
+    from paddle_tpu.distributed.communication.store import TCPStore
+    from paddle_tpu.distributed.resilience import (ComposedFaultPlan,
+                                                   FaultSpec)
+    from paddle_tpu.inference.fleet import FleetRouter
+    from paddle_tpu.inference.serving import Request
+
+    refs = _fleet_refs()
+    w = np.arange(2048, dtype=np.float32)
+    SITES = ("store.daemon", "checkpoint.shard", "fleet.replica_kill")
+
+    def make_plan():
+        return ComposedFaultPlan(seed=13, specs=[
+            FaultSpec("store.daemon", "stall", at=2, count=1, arg=1.2),
+            FaultSpec("checkpoint.shard", "bitflip", at=0, count=1, arg=4),
+            FaultSpec("fleet.replica_kill", "kill", at=2, count=1,
+                      match="replica:0:")])
+
+    def shard_bytes(ckpt):
+        with open(os.path.join(ckpt, "0_0.distcp"), "rb") as f:
+            return f.read()
+
+    prev = os.environ.get("PT_RETRY_DISABLE")
+    if not recover:
+        os.environ["PT_RETRY_DISABLE"] = "1"
+    failures = []
+    try:
+        with tempfile.TemporaryDirectory() as tmp:
+            plan = make_plan()
+            store = TCPStore("127.0.0.1", 0, is_master=True, world_size=1,
+                             timeout=10.0, op_timeout=0.4)
+            ckpt = os.path.join(tmp, "ckpt")
+
+            def store_loop():
+                try:
+                    for i in range(6):
+                        store.set(f"k{i}", str(i).encode())
+                        if store.get(f"k{i}", wait=False) != str(i).encode():
+                            failures.append(f"store: k{i} read back wrong")
+                            return
+                except Exception as e:
+                    failures.append(f"store: {type(e).__name__}: {e}")
+
+            def ckpt_loop():
+                try:
+                    save_state_dict({"w": w}, ckpt, replica=recover)
+                    target = {"w": np.zeros_like(w)}
+                    load_state_dict(target, ckpt)
+                    if not np.array_equal(np.asarray(target["w"]), w):
+                        failures.append("ckpt: replica returned wrong data")
+                except Exception as e:
+                    failures.append(f"ckpt: {type(e).__name__}: {e}")
+
+            fleet = FleetRouter(_fleet_build, os.path.join(tmp, "fleet"),
+                                num_replicas=3, failover=recover)
+            reqs = [Request(**kw) for kw in _fleet_wave_kwargs()]
+            threads = [threading.Thread(target=fn, daemon=True)
+                       for fn in (store_loop, ckpt_loop)]
+            try:
+                with plan:
+                    for t in threads:
+                        t.start()
+                    for r in reqs:
+                        fleet.submit(r)
+                    fleet.run_until_done(max_steps=500)
+                    for t in threads:
+                        t.join(timeout=60.0)
+            finally:
+                fleet.close()
+                store.close()
+            if any(t.is_alive() for t in threads):
+                return False, "chaos thread(s) wedged past the join deadline"
+            lost = [r.rid for r in reqs if r.failed or not r.done]
+            if lost:
+                failures.append(f"fleet: request(s) {lost} failed/unfinished")
+            elif [list(r.tokens) for r in reqs] != refs:
+                failures.append("fleet: streams diverged from the "
+                                "uninterrupted reference")
+            fired = plan.fired()
+            damaged = shard_bytes(ckpt)
+    finally:
+        if prev is None:
+            os.environ.pop("PT_RETRY_DISABLE", None)
+        else:
+            os.environ["PT_RETRY_DISABLE"] = prev
+    if not recover:
+        if not failures:
+            return True, "unexpected: composed chaos bit nothing"
+        return False, "recovery off: " + "; ".join(failures[:3])
+    missing = [s for s in SITES if not fired.get(s)]
+    if missing:
+        return False, f"composed plan never fired site(s) {missing}"
+    if failures:
+        return False, "; ".join(failures[:3])
+    # determinism across interleavings: a FRESH plan with the same seed
+    # must damage the shard byte-identically even though run 1 had three
+    # sites' threads racing (per-spec RNG streams, not one shared stream)
+    with tempfile.TemporaryDirectory() as tmp2:
+        replay = os.path.join(tmp2, "ckpt")
+        with make_plan():
+            save_state_dict({"w": w}, replay, replica=True)
+        if shard_bytes(replay) != damaged:
+            return False, ("per-spec RNG streams broke: the same seed "
+                           "damaged the shard differently across runs")
+    return True, (f"3 sites fired concurrently ({fired}), every recovery "
+                  "path held, shard damage byte-identical across runs")
+
+
+def drill_lifecycle_e2e(recover: bool):
+    """The whole checkpoint lifecycle as ONE drill (docs/RESILIENCE.md
+    "Checkpoint lifecycle"): train the tiny serving llama under a numeric
+    guard with async checkpoints → an injected heartbeat loss kills the
+    peer node and shrinks the mesh 8→4 devices → elastic resume over the
+    survivors from the recorded checkpoint → train to completion →
+    CheckpointPublisher digest-verifies the manifest and hot-swaps a live
+    2-replica fleet via generation-fenced rolling restart → the swapped
+    fleet serves byte-identically to a COLD engine built from the trained
+    weights, and a second same-weights publish leaves every stream
+    untouched. A ComposedFaultPlan arms three sites across the arc (store
+    daemon stall, heartbeat kill, replica kill mid-wave). Control arm: no
+    elastic manager, no failover — the same plan must flip the exit
+    code."""
+    import numpy as np
+    import jax
+    from jax.sharding import Mesh
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.auto_parallel import Engine
+    from paddle_tpu.distributed.communication.store import TCPStore
+    from paddle_tpu.distributed.fleet.elastic import ElasticManager
+    from paddle_tpu.distributed.resilience import (ComposedFaultPlan,
+                                                   FaultSpec,
+                                                   ResilientTrainer)
+    from paddle_tpu.distributed.resilience.lifecycle import (
+        CheckpointPublisher, lifecycle_stats, reset_lifecycle_stats,
+        set_lifecycle_phase)
+    from paddle_tpu.framework.numeric_guard import GuardPolicy
+    from paddle_tpu.inference.fleet import FleetRouter
+    from paddle_tpu.inference.serving import (ContinuousBatchingEngine,
+                                              Request)
+    from paddle_tpu.models import LlamaForCausalLM
+
+    cfg, _ = _serving_model()       # config only — models are drill-local
+    B, S, STEPS = 8, 8, 6
+
+    def _arr(v):
+        return np.asarray(v._data if hasattr(v, "_data") else v)
+
+    def data_fn(step):
+        rng = np.random.default_rng(5000 + step)
+        ids = rng.integers(0, cfg.vocab_size, (B, S)).astype(np.int32)
+        return ids, ids                 # self-supervised LM (shifted CE)
+
+    def build(alive):
+        n = 8 if len(alive) >= 2 else 4
+        mesh = Mesh(np.array(jax.devices()[:n]), ("dp",))
+        paddle.seed(11)
+        return Engine(LlamaForCausalLM(cfg), mesh, lr=1e-3, clip_norm=None,
+                      guard=GuardPolicy(action="skip_step", warmup_steps=3,
+                                        spike_factor=50.0))
+
+    def serve_wave(fleet):
+        reqs = [Request(**kw) for kw in _fleet_wave_kwargs()]
+        for r in reqs:
+            fleet.submit(r)
+        fleet.run_until_done(max_steps=500)
+        lost = [r.rid for r in reqs if r.failed or not r.done]
+        return [list(r.tokens) for r in reqs], lost
+
+    reset_lifecycle_stats()
+    with tempfile.TemporaryDirectory() as tmp:
+        store = TCPStore("127.0.0.1", 0, is_master=True, world_size=1,
+                         timeout=20.0)
+        store_b = TCPStore("127.0.0.1", store.port, world_size=1,
+                           timeout=20.0)
+        plan = ComposedFaultPlan(seed=17, specs=[
+            FaultSpec("store.daemon", "stall", at=4, count=1, arg=0.8),
+            FaultSpec("elastic.heartbeat", "kill", at=3, count=-1,
+                      match="nodeB"),
+            FaultSpec("fleet.replica_kill", "kill", at=2, count=1,
+                      match="replica:0:")])
+        mgr_b = ElasticManager(store_b, "drill", "nodeB",
+                               expected=["nodeA", "nodeB"],
+                               heartbeat_interval=0.1, ttl=0.45)
+        mgr_a = ElasticManager(store, "drill", "nodeA",
+                               expected=["nodeA", "nodeB"],
+                               heartbeat_interval=0.1, ttl=0.45) \
+            if recover else None
+        b_stop = threading.Event()
+
+        def node_b_loop():
+            i = 0
+            while not b_stop.is_set():
+                if mgr_b._thread is None or not mgr_b._thread.is_alive():
+                    return              # heartbeat killed -> node is dead
+                if i >= 3:
+                    mgr_b.stop()        # deterministic death backstop
+                    return
+                try:
+                    store_b.barrier(f"lcs{i}", world_size=2, timeout=3.0)
+                except Exception:
+                    return
+                i += 1
+
+        def coop_data_fn(step):
+            ws = len(mgr_a.expected) if mgr_a is not None else 2
+            if ws > 1:
+                store.barrier(f"lcs{step}", world_size=ws, timeout=1.5)
+            time.sleep(0.05)
+            return data_fn(step)
+
+        ckpt_dir = os.path.join(tmp, "job")
+        plan.install()
+        try:
+            mgr_b.start()
+            if mgr_a is not None:
+                mgr_a.start()
+            threading.Thread(target=node_b_loop, daemon=True).start()
+            set_lifecycle_phase("train")
+            trainer = ResilientTrainer(build, ckpt_dir, elastic=mgr_a,
+                                       save_every=2)
+            try:
+                out = trainer.fit(coop_data_fn, STEPS)
+            except Exception as e:
+                return (False,
+                        f"arc died in training: {type(e).__name__}: {e}")
+            finally:
+                b_stop.set()
+                if mgr_a is not None:
+                    mgr_a.stop()
+                mgr_b.stop()
+
+            if out["restarts"] < 1:
+                return False, "peer loss never shrank the mesh"
+            if not out["resumed_at"]:
+                return False, "mesh shrank without an elastic resume"
+            if out["final_step"] != STEPS:
+                return False, f"train stopped at {out['final_step']}/{STEPS}"
+
+            # publish: verify manifest -> load trained weights into the
+            # live serving model -> generation-fenced rolling hot-swap
+            paddle.seed(11)
+            serve_model = LlamaForCausalLM(cfg)
+            probe = sorted(serve_model.state_dict())[0]
+            before = np.array(_arr(serve_model.state_dict()[probe]),
+                              copy=True)
+
+            def build_serve():
+                return ContinuousBatchingEngine(serve_model, max_batch=2,
+                                                max_len=32, page_size=8,
+                                                block_size=2)
+
+            publisher = CheckpointPublisher(ckpt_dir)
+            fleet = FleetRouter(build_serve, os.path.join(tmp, "fleet"),
+                                num_replicas=2, failover=recover)
+            try:
+                warm, lost0 = serve_wave(fleet)  # traffic on init weights
+                if lost0:
+                    return False, f"pre-publish wave lost request(s) {lost0}"
+                pub = publisher.publish(serve_model, fleet)
+                swapped, lost1 = serve_wave(fleet)
+                pub2 = publisher.publish(serve_model, fleet)  # same weights
+                again, lost2 = serve_wave(fleet)
+            finally:
+                fleet.close()
+
+            if lost1 or lost2:
+                return False, (f"post-publish wave lost request(s) "
+                               f"{lost1 or lost2}")
+            if pub["generation"] < 1 or pub["shards"] < 1 or pub["params"] < 1:
+                return False, f"publish record looks torn: {pub}"
+            if pub2["generation"] != pub["generation"]:
+                return False, "same-weights republish changed generation"
+            if np.array_equal(before,
+                              _arr(serve_model.state_dict()[probe])):
+                return False, "publish did not change the serving weights"
+
+            # byte-identity contract: the hot-swapped fleet == a COLD
+            # engine built from the published checkpoint; a same-weights
+            # swap changes nothing
+            cold_model = LlamaForCausalLM(cfg)
+            publisher.load_weights(cold_model, pub["step"])
+            cold = ContinuousBatchingEngine(cold_model, max_batch=2,
+                                            max_len=32, page_size=8,
+                                            block_size=2)
+            cold_reqs = [Request(**kw) for kw in _fleet_wave_kwargs()]
+            for r in cold_reqs:
+                cold.add_request(r)
+            cold.run_until_done(max_steps=500)
+            cold_refs = [list(r.tokens) for r in cold_reqs]
+        finally:
+            plan.uninstall()
+            store_b.close()
+            store.close()
+
+    if swapped != cold_refs:
+        bad = [i for i, (s, c) in enumerate(zip(swapped, cold_refs))
+               if s != c]
+        return False, (f"hot-swapped stream(s) {bad} diverged from a cold "
+                       "engine on the published weights")
+    if again != swapped:
+        return False, ("same-weights swap changed served streams "
+                       "(before/after byte-identity broken)")
+    fired = plan.fired()
+    missing = [s for s in ("store.daemon", "elastic.heartbeat",
+                           "fleet.replica_kill") if not fired.get(s)]
+    if missing:
+        return False, f"composed plan never fired site(s) {missing}"
+    stats = lifecycle_stats()
+    if (stats["publish_total"] != 2
+            or stats["generation"] != pub["generation"]
+            or stats["phase"] != "serve"):
+        return False, f"lifecycle stats out of step: {stats}"
+    return True, (f"8->4 shrink resumed at step {out['resumed_at'][0]}, "
+                  f"published gen {pub['generation']} ({pub['shards']} "
+                  f"shard(s), {pub['params']} params), hot-swap == cold "
+                  f"engine, same-weights swap byte-stable, 3 chaos sites "
+                  f"fired {fired}")
+
+
 DRILLS = {
     "heartbeat": drill_heartbeat,
     "store_stall": drill_store_stall,
@@ -1738,6 +2079,8 @@ DRILLS = {
     "nan_grad": drill_nan_grad,
     "loss_spike": drill_loss_spike,
     "poison_batch": drill_poison_batch,
+    "composed_chaos": drill_composed_chaos,
+    "lifecycle_e2e": drill_lifecycle_e2e,
 }
 
 

@@ -47,8 +47,9 @@ BASELINE_PATH = os.path.join(ROOT, "tools", "concurrency_baseline.json")
 _TRACER_API = ["TraceRecorder." + m for m in (
     "submit", "shed", "admit", "prefill_chunk", "first_token", "tokens",
     "decode_block", "finish", "mark_recovered", "failover", "migrate",
-    "migration_failure", "recovery", "instant", "span", "is_open",
-    "incomplete", "lifecycle", "export_chrome", "slo_summary", "counters")]
+    "migration_failure", "recovery", "publish", "resume", "instant",
+    "span", "is_open", "incomplete", "lifecycle", "export_chrome",
+    "slo_summary", "counters")]
 
 THREAD_ROOTS = {
     # fleet parallel_step replica threads, the rpc ThreadPoolExecutor and

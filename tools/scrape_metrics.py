@@ -90,6 +90,14 @@ REQUIRED_FAMILIES = (
     "pt_spec_accepted_total",
     "pt_spec_acceptance_rate",
     "pt_kv_quant_blocks",
+    # checkpoint lifecycle (distributed/resilience/lifecycle.py — the
+    # checkpoint_collector renders generation/publish counters at zero and
+    # the phase gauge at "idle" with no publisher constructed, so the
+    # families are REQUIRED unconditionally)
+    "pt_checkpoint_generation",
+    "pt_checkpoint_publish_total",
+    "pt_checkpoint_publish_failures",
+    "pt_lifecycle_phase",
 )
 
 #: the span chain a served request must produce, in order
@@ -153,8 +161,8 @@ def selftest() -> int:
                                               Request)
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
     from paddle_tpu.observability import (MetricsRegistry, MetricsServer,
-                                          TraceRecorder, fleet_collector,
-                                          guard_collector,
+                                          TraceRecorder, checkpoint_collector,
+                                          fleet_collector, guard_collector,
                                           procfleet_collector,
                                           retry_collector, tracer_collector)
 
@@ -166,6 +174,7 @@ def selftest() -> int:
     registry.register_collector(retry_collector())
     registry.register_collector(guard_collector())
     registry.register_collector(tracer_collector(tracer))
+    registry.register_collector(checkpoint_collector())
 
     def build():
         return ContinuousBatchingEngine(
