@@ -1,0 +1,73 @@
+"""Column-parallel tensor-parallel context for the SERVING hot paths.
+
+The training side shards with GSPMD (logical_sharding.py: annotate params,
+pick a mesh, let XLA insert collectives). The serving engine cannot use
+that recipe: its identity contract — greedy token streams bit-equal to the
+single-device engine — rules out any collective that REDUCES across shards
+(a psum reassociates the contraction sum, which moves the last ulp, which
+can flip an argmax near a tie). So the serving mesh path is built from
+``shard_map`` with a single discipline:
+
+    every tp-sharded weight is split along its OUTPUT dimension, so each
+    output element is computed WHOLE on exactly one device with the full
+    contraction in its original order; the only collectives are
+    ``all_gather``s of disjoint shards — pure data movement, bit-exact.
+
+Concretely (llama): q/k/v projections shard along heads, gate/up along
+mlp, lm_head along vocab; o_proj/down_proj/embedding/norms stay
+replicated, and the sharded activations are all-gathered right before the
+weights that contract over them. KV pools shard along kv_heads to match
+the k/v projections, so paged appends and decode attention are
+shard-local — the pool is never resharded between steps.
+
+This module is the trace-time channel telling model code it is INSIDE such
+a shard_map region and which mesh axis to gather over. Layers call
+:func:`gather_output_shards` at the three gather sites (attention output,
+mlp activation, logits); outside a serving shard context it is a no-op, so
+the same model code serves the single-device engine, GSPMD training, and
+the sharded serving programs.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional
+
+__all__ = ["serving_tp_axis", "serving_shard_axis", "gather_output_shards"]
+
+_state = threading.local()
+
+
+def serving_tp_axis() -> Optional[str]:
+    """The mesh axis name of the enclosing serving shard_map region, or
+    None when tracing/running outside one (the common, unsharded case)."""
+    return getattr(_state, "axis", None)
+
+
+@contextlib.contextmanager
+def serving_shard_axis(axis: Optional[str]):
+    """Mark the dynamic extent of a serving shard_map body. The engine
+    wraps each sharded hot-path program's trace in this; model code reads
+    it through :func:`serving_tp_axis` / :func:`gather_output_shards`."""
+    prev = serving_tp_axis()
+    _state.axis = axis
+    try:
+        yield
+    finally:
+        _state.axis = prev
+
+
+def gather_output_shards(x):
+    """All-gather ``x``'s LAST dim across the serving tp axis (tiled), or
+    return ``x`` unchanged outside a serving shard context.
+
+    The callee computed ``x`` column-sharded — each element whole on one
+    device — so the gather is an exact concatenation: the full array is
+    bit-identical to what a single device would have computed."""
+    axis = serving_tp_axis()
+    if axis is None:
+        return x
+    import jax
+
+    return jax.lax.all_gather(x, axis, axis=x.ndim - 1, tiled=True)

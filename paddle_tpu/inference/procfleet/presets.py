@@ -14,7 +14,8 @@ foundation of the byte-identical-failover guarantee across processes.
 
 from __future__ import annotations
 
-__all__ = ["tiny_llama_engine", "tiny_llama_prefix_engine"]
+__all__ = ["tiny_llama_engine", "tiny_llama_mesh_engine",
+           "tiny_llama_prefix_engine"]
 
 
 def tiny_llama_engine(seed: int = 13, num_hidden_layers: int = 1,
@@ -40,4 +41,15 @@ def tiny_llama_prefix_engine(**kw):
     """The prefix-cache variant (KV-chain migration needs dynamic block
     tables on both tiers — inference/disagg.py)."""
     kw.setdefault("prefix_cache", True)
+    return tiny_llama_engine(**kw)
+
+
+def tiny_llama_mesh_engine(**kw):
+    """Fused + prefix-cache variant for mesh-sharded workers: sharded
+    serving requires the fused engine with a prefix cache, and the worker
+    injects ``mesh=MeshConfig(tp, devices=<its group>)`` on top of these
+    kwargs (``WorkerSpec.mesh`` — docs/SERVING.md "Sharded serving")."""
+    kw.setdefault("prefix_cache", True)
+    kw.setdefault("fused", True)
+    kw.setdefault("max_batch", 4)
     return tiny_llama_engine(**kw)

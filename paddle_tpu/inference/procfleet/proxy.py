@@ -341,6 +341,10 @@ class ProcReplica:
         eng = dict(hello.payload["engine"])
         self.tier = eng.pop("tier", "serving")
         pending = eng.pop("pending", [])
+        # in-replica mesh width (1 = unsharded worker; pre-mesh workers
+        # omit the field) — read by the fleet collector's per-device-group
+        # telemetry and by scale-out accounting (bench fleet ratio)
+        eng.setdefault("mesh_tp", 1)
         #: the geometry surface FleetRouter reads (page_size for prefix
         #: chain keys, max_batch/max_queue for the brownout depth default)
         self.engine = SimpleNamespace(**eng)

@@ -81,6 +81,16 @@ class ProcFleetConfig:
     - ``verify_crc``: worker-side per-page crc verification on chain
       import — ``False`` is the fault drills' control arm (silent
       corruption instead of a typed PT-SRV-007).
+    - ``mesh``: in-replica tensor-parallel width (None/1 = unsharded
+      workers). Each replica serves from its OWN device group, so fleet
+      scale-out composes with in-replica sharding (docs/SERVING.md
+      "Sharded serving"): spawned (tcp) workers own a fresh runtime and
+      bind its first ``mesh`` devices (cpu platforms force that many XLA
+      host devices before backend init); loopback worker threads share
+      THIS process's runtime, so the driver hands replica ``i`` the
+      disjoint device slice ``[i*mesh, (i+1)*mesh)`` (wrapping modulo
+      the available groups). Requires a factory whose engine accepts
+      ``mesh=`` (the presets pass it through).
     """
 
     factory: Union[str, Callable]
@@ -97,6 +107,7 @@ class ProcFleetConfig:
     migrate_bw_bytes_per_s: float = 32.0 * 1024 * 1024
     hedge: bool = True
     verify_crc: bool = True
+    mesh: Optional[int] = None
 
 
 class ProcFleetRouter(FleetRouter):
@@ -132,12 +143,26 @@ class ProcFleetRouter(FleetRouter):
 
     def _spec_kwargs(self, idx: int) -> dict:
         cfg = self._cfg_for(idx)
+        mesh = (int(cfg.mesh) if cfg.mesh and int(cfg.mesh) > 1 else None)
+        group = None
+        if mesh is not None and cfg.transport == "loopback":
+            # loopback worker threads share THIS process's jax runtime:
+            # hand each replica a disjoint device-group slice by index
+            # (wrapping modulo the available groups — overlapping groups
+            # on small hosts share devices, they never miscompute)
+            import jax
+
+            n_groups = max(1, len(jax.devices()) // mesh)
+            gi = idx % n_groups
+            group = tuple(range(gi * mesh, (gi + 1) * mesh))
         return dict(factory=cfg.factory,
                     factory_kwargs=dict(cfg.factory_kwargs),
                     sup_kwargs=dict(cfg.sup_kwargs),
                     env=dict(cfg.env),
                     metrics_port=cfg.metrics_port,
                     verify_crc=cfg.verify_crc,
+                    mesh=mesh,
+                    device_group=group,
                     tier=self.tier_of(idx))
 
     def _make_sup(self, idx: int, path: str) -> ProcReplica:

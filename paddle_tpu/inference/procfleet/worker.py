@@ -34,7 +34,7 @@ import os
 import pickle
 import socket
 import sys
-from typing import Callable, Dict, List, Optional, Union
+from typing import Callable, Dict, List, Optional, Tuple, Union
 
 from .transport import TcpTransport, Transport
 from .wire import Message, WireClosed, WireCorrupt
@@ -67,6 +67,17 @@ class WorkerSpec:
     - ``env``: extra environment applied before heavy imports
       (e.g. ``JAX_PLATFORMS=cpu`` to pin workers to host devices).
     - ``tier``: informational tag echoed in telemetry.
+    - ``mesh``: in-replica tensor-parallel width — the worker builds its
+      engine with ``MeshConfig(tp=mesh)`` over its own device group, so
+      fleet scale-out composes with in-replica sharding (docs/SERVING.md
+      "Sharded serving"). Spawned workers own a fresh runtime: on cpu
+      platforms the worker forces ``mesh`` XLA host devices before the
+      backend initializes; accelerator platforms bind their visible
+      devices.
+    - ``device_group``: explicit device indices (into the worker
+      runtime's ``jax.devices()``) for the mesh — loopback worker
+      threads share ONE process runtime, so the driver hands each
+      replica a disjoint slice; None = the first ``mesh`` devices.
     """
 
     factory: Union[str, Callable]
@@ -76,6 +87,8 @@ class WorkerSpec:
     metrics_port: Optional[int] = 0
     env: dict = dataclasses.field(default_factory=dict)
     tier: str = "serving"
+    mesh: Optional[int] = None
+    device_group: Optional[Tuple[int, ...]] = None
     #: worker-side KV-chain verification (KVChainCodec(verify_crc=...)).
     #: False is the net_flaky_migration drill's control arm: what a
     #: checksum-less transfer does to bitflipped migration bytes
@@ -96,6 +109,24 @@ def resolve_factory(spec: WorkerSpec) -> Callable:
     if not callable(fac):
         raise TypeError(f"worker factory {fac!r} is not callable")
     kwargs = dict(spec.factory_kwargs)
+    if spec.mesh:
+        # bind this replica's device group and shard the engine over it
+        # (MeshConfig is built HERE, in the worker runtime — device
+        # handles don't pickle across the spawn boundary)
+        import jax
+
+        from ..serving import MeshConfig
+
+        tp = int(spec.mesh)
+        devs = jax.devices()
+        idxs = (list(spec.device_group) if spec.device_group is not None
+                else list(range(min(tp, len(devs)))))
+        if len(idxs) < tp or any(int(i) >= len(devs) for i in idxs):
+            raise ValueError(
+                f"worker mesh tp={tp} wants device group {idxs} but this "
+                f"runtime has {len(devs)} devices")
+        kwargs["mesh"] = MeshConfig(
+            tp=tp, devices=[devs[int(i)] for i in idxs])
     return lambda: fac(**kwargs)
 
 
@@ -109,7 +140,11 @@ def _engine_hello(engine) -> dict:
            "max_queue": (None if engine.max_queue is None
                          else int(engine.max_queue)),
            "max_len": int(engine.max_len),
-           "prefix_cache": engine.prefix_cache is not None}
+           "prefix_cache": engine.prefix_cache is not None,
+           # in-replica mesh width (1 = unsharded): the proxy mirrors it,
+           # the fleet collector labels per-device-group telemetry by it
+           "mesh_tp": (int(engine.mesh.tp)
+                       if getattr(engine, "mesh", None) is not None else 1)}
     if engine.prefix_cache is not None:
         kv = engine.caches["kv"]
         kvh, page, hd = (int(d) for d in kv[0][0].shape[1:])
@@ -427,6 +462,17 @@ def worker_main(spec_bytes: bytes, host: str, port: int) -> None:
     spec: WorkerSpec = pickle.loads(spec_bytes)
     for k, v in (spec.env or {}).items():
         os.environ[k] = str(v)
+    if spec.mesh and int(spec.mesh) > 1 and spec.device_group is None:
+        # mesh-sharded replica on host (cpu) devices: this fresh runtime
+        # must expose tp devices, and XLA reads the flag at backend init
+        # — force it BEFORE anything touches jax. Accelerator platforms
+        # (no cpu pin) bind their own visible devices instead.
+        if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    f"{flags} --xla_force_host_platform_device_count="
+                    f"{int(spec.mesh)}").strip()
     if os.environ.get("JAX_PLATFORMS"):
         # axon TPU containers force-set jax_platforms programmatically,
         # overriding the env var — override it back before any backend

@@ -116,7 +116,8 @@ from ..ops.paged_attention import BlockAllocator, RadixPrefixCache
 __all__ = ["AutoscaleConfig", "BlockAllocator", "BrownoutConfig",
            "ContinuousBatchingEngine", "EngineSaturated", "FleetConfig",
            "FleetRouter", "KVCacheConfig", "KVChainCodec", "KVChainCorrupt",
-           "PrefixCacheConfig", "RadixPrefixCache", "ReplicaState",
+           "MeshConfig", "PrefixCacheConfig", "RadixPrefixCache",
+           "ReplicaState",
            "Request", "RequestJournal", "RequestShed", "SLOAutoscaler",
            "ServingSupervisor", "SpecConfig", "StepWatchdog", "TieredRouter"]
 
@@ -251,6 +252,48 @@ class KVCacheConfig:
         if self.dtype not in (None, "param", "int8"):
             raise ValueError(f"unsupported KV cache dtype {self.dtype!r} "
                              "(supported: None/'param', 'int8')")
+
+
+@dataclasses.dataclass
+class MeshConfig:
+    """Mesh-sharded serving (``ContinuousBatchingEngine(mesh=...)`` —
+    docs/SERVING.md "Sharded serving").
+
+    ``tp`` devices run every hot-path program (fused mega-step, packed
+    prefill chunk, speculative verify, first-token re-step) under
+    ``shard_map``: weights are column-sharded along their OUTPUT dim
+    (q/k/v along heads, gate/up along mlp, an untied lm_head along
+    vocab), the paged KV pools shard along kv_heads to match the k/v
+    projections, and the only collectives are ``all_gather``s of
+    DISJOINT shards — pure data movement. Every output element is
+    computed whole on exactly one device with its contraction in the
+    original order, so greedy streams are byte-identical to the
+    1-device engine at any ``tp`` (the serving identity contract; a
+    psum-style partial-sum reduction would reassociate and is
+    impossible by construction in this layout). In-replica ``tp``
+    composes with procfleet scale-out: each worker binds its own device
+    group (``ProcFleetConfig.mesh``).
+
+    - ``tp``: tensor-parallel width (devices per engine replica).
+    - ``devices``: explicit device list (length >= tp; default
+      ``jax.devices()[:tp]``) — procfleet workers pass their group.
+    - ``abstract``: build a symbolic ``jax.sharding.AbstractMesh``
+      instead of binding real devices — tracing/audit only (PT-COMM /
+      PT-COST record the sharded programs' contracts on a 1-device
+      host this way); actually dispatching on an abstract engine fails
+      by construction.
+
+    Requires the fused engine with a prefix cache, and a model that
+    opts in via the ``tp_serving = True`` marker (llama; GPT's fused
+    interleaved qkv projection cannot be column-sharded)."""
+
+    tp: int = 1
+    devices: Optional[Sequence] = None
+    abstract: bool = False
+
+    def __post_init__(self):
+        if int(self.tp) < 1:
+            raise ValueError(f"MeshConfig.tp must be >= 1, got {self.tp}")
 
 
 def ngram_draft(hist, hlen, last_tok, k: int, n: int):
@@ -467,6 +510,7 @@ class ContinuousBatchingEngine:
                  fused: Optional[bool] = None,
                  speculative: Union[bool, SpecConfig, None] = None,
                  kv_cache: Union[str, KVCacheConfig, None] = None,
+                 mesh: Union[int, "MeshConfig", None] = None,
                  tracer=None, trace_tags: Optional[Dict] = None,
                  donate_carry: bool = True,
                  _unsafe_overcommit: bool = False):
@@ -557,6 +601,45 @@ class ContinuousBatchingEngine:
             kv_cache = KVCacheConfig()
         self.kv_cache = kv_cache
         self._kv_dtype = kv_cache.dtype if kv_cache.dtype == "int8" else None
+        # mesh-sharded serving (docs/SERVING.md "Sharded serving"): every
+        # hot-path program becomes jit(shard_map(...)) over a tp axis with
+        # column-parallel weights and kv_heads-sharded pools. The gathers
+        # concatenate disjoint shards — no reduction ever crosses a shard
+        # boundary — so greedy streams stay byte-identical to the 1-device
+        # engine (param specs + placement happen at the end of the ctor,
+        # once the param list exists).
+        if isinstance(mesh, int):
+            mesh = MeshConfig(tp=mesh)
+        self.mesh = mesh
+        self._mesh = None
+        self._mesh_axis = None
+        if mesh is not None:
+            if not self._fused or prefix_cache is None:
+                raise ValueError(
+                    "mesh-sharded serving needs the fused engine with a "
+                    "prefix cache (fused=True, prefix_cache=...) — the "
+                    "legacy step/prefill programs stay single-device")
+            if not getattr(model, "tp_serving", False):
+                raise ValueError(
+                    f"{type(model).__name__} does not support tensor-"
+                    "parallel serving (no tp_serving marker): its weights "
+                    "must be column-shardable along heads/mlp/vocab")
+            self._mesh_axis = "tp"
+            tp = int(mesh.tp)
+            if mesh.abstract:
+                from ..static.comm.mesh import abstract_mesh
+
+                self._mesh = abstract_mesh({self._mesh_axis: tp})
+            else:
+                devs = (list(mesh.devices) if mesh.devices is not None
+                        else jax.devices()[:tp])
+                if len(devs) < tp:
+                    raise ValueError(
+                        f"MeshConfig.tp={tp} needs {tp} devices, got "
+                        f"{len(devs)} — on CPU hosts raise "
+                        "--xla_force_host_platform_device_count")
+                self._mesh = jax.sharding.Mesh(np.asarray(devs[:tp]),
+                                               (self._mesh_axis,))
         # DRILL-ONLY knob (tools/fault_drill.py prefix_cache_exhaustion):
         # allocate past pool capacity by ripping blocks out of the radix
         # cache while live tables still map them — demonstrates the
@@ -677,7 +760,17 @@ class ContinuousBatchingEngine:
                       # exported as pt_spec_proposed/accepted_total + the
                       # acceptance-rate gauge by the engine collector
                       "spec_proposed": 0, "spec_accepted": 0,
-                      "spec_steps": 0}
+                      "spec_steps": 0,
+                      # mesh-sharded serving telemetry (zero on unsharded
+                      # engines — the collector renders the families
+                      # unconditionally so dashboards never lose them):
+                      # accumulated per-device collective wire bytes of
+                      # every sharded dispatch + sharded decode dispatches
+                      "mesh_collective_bytes": 0.0, "mesh_decode_steps": 0}
+        # per-program collective census (label -> per-dispatch wire bytes),
+        # filled lazily as each sharded program first dispatches — feeds
+        # the serving collector and mirrors the PT-COMM contract entries
+        self._mesh_programs: Dict[str, float] = {}
         # int8 block-format occupancy gauge (pt_kv_quant_blocks): pool
         # pages held in quantized form — 0 on fp engines
         self._kv_quant_blocks = (int(self.caches["kv"][0][0].shape[0])
@@ -697,6 +790,24 @@ class ContinuousBatchingEngine:
         self._tensors = tensors
         self._jit_prefill: Dict[int, object] = {}
         self._jit_step = None
+        # mesh placement (real meshes: one device_put pass; abstract
+        # meshes: specs only — the audit path never touches devices).
+        # Head-granularity check first: a column shard must hold WHOLE
+        # heads (the kv pools shard along kv_heads; a mid-head split
+        # would break the per-shard [.., heads, head_dim] reshape).
+        self._param_specs = None
+        if self._mesh is not None:
+            cfg = getattr(model, "config", None)
+            tp = int(self.mesh.tp)
+            for f in ("num_attention_heads", "num_key_value_heads"):
+                n = getattr(cfg, f, None)
+                if n is not None and int(n) % tp:
+                    raise ValueError(
+                        f"{f}={n} not divisible by mesh tp={tp} — shards "
+                        "must hold whole heads (KV pools shard kv_heads)")
+            self._param_specs = [self._tp_param_spec(t) for t in tensors]
+            if not self.mesh.abstract:
+                self._place_on_mesh()
 
     def _req_tags(self, req: "Request") -> Dict:
         """Stamp tags for per-request trace sites (submit / shed / admit —
@@ -1439,12 +1550,182 @@ class ContinuousBatchingEngine:
             self.caches = {"kv": self.caches["kv"], "tables": tables}
             self.stats["fused_updates"] += len(batch)
 
+    # -- mesh-sharded serving (docs/SERVING.md "Sharded serving") ----------
+    def _tp_param_spec(self, t):
+        """Column-parallel placement rule for ONE parameter: a 2-dim
+        weight whose LAST logical axis is an output-feature axis (heads /
+        mlp / vocab) shards that axis across tp; everything else —
+        o_proj/down_proj (output axis "embed"), the embedding, norms —
+        replicates. Splitting only output dims is what keeps every output
+        element's contraction whole on one device (the identity
+        contract); the matching all_gathers live in the model layers
+        (distributed.auto_parallel.serving_sharding)."""
+        from jax.sharding import PartitionSpec as P
+
+        axes = getattr(t, "logical_axes", None) or ()
+        data = t._data
+        if data.ndim == 2 and axes and axes[-1] in ("heads", "mlp",
+                                                    "vocab"):
+            tp = int(self.mesh.tp)
+            if data.shape[-1] % tp:
+                raise ValueError(
+                    f"param {axes} shape {tuple(data.shape)}: output dim "
+                    f"{data.shape[-1]} not divisible by mesh tp={tp}")
+            return P(None, self._mesh_axis)
+        return P()
+
+    def _kv_spec(self):
+        """ONE PartitionSpec prefix covering EVERY kv-pool leaf: pools
+        are [pages, kv_heads, page, head_dim] (the int8 format adds
+        [pages, kv_heads] absmax scales) — all shard axis 1, the
+        kv_heads axis, matching the column-sharded k/v projections.
+        Appends, decode gathers, COW page copies, quant resets and the
+        int8 scatter-max scales are then shard-local forever: no decode
+        step ever reshards the pool, and per-(page, head) quantization
+        partitions EXACTLY across head shards."""
+        from jax.sharding import PartitionSpec as P
+
+        return P(None, self._mesh_axis)
+
+    def _arg_specs(self, kinds):
+        from jax.sharding import PartitionSpec as P
+
+        out = []
+        for k in kinds:
+            if k == "params":
+                out.append(self._param_specs)
+            elif k == "kv":
+                out.append(self._kv_spec())
+            else:
+                out.append(P())
+        return tuple(out)
+
+    def _place_on_mesh(self):
+        """One-time initial reshard: params column-sharded, kv pools
+        sharded along kv_heads, block tables + device-resident step
+        state replicated. After this no hot-path dispatch moves resident
+        bytes between placements — the per-step collectives are exactly
+        the activation all_gathers the census records. Stamped as one
+        "reshard" tracer span (the only reshard boundary the engine
+        has)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        t0 = None if self.tracer is None else self.tracer.now()
+        mesh = self._mesh
+        rep = NamedSharding(mesh, P())
+        kv_sh = NamedSharding(mesh, self._kv_spec())
+        put = jax.device_put
+        self._params = [put(p, NamedSharding(mesh, s))
+                        for p, s in zip(self._params, self._param_specs)]
+        kv = jax.tree_util.tree_map(lambda x: put(x, kv_sh),
+                                    self.caches["kv"])
+        self.caches = {"kv": kv, "tables": put(self.caches["tables"], rep)}
+        self._last_tok = put(self._last_tok, rep)
+        self._dev_pos = put(self._dev_pos, rep)
+        self._dev_act = put(self._dev_act, rep)
+        self._dev_samp = tuple(put(x, rep) for x in self._dev_samp)
+        if self._spec is not None:
+            self._dev_hist = put(self._dev_hist, rep)
+            self._dev_hlen = put(self._dev_hlen, rep)
+        if self.tracer is not None:
+            self.tracer.span("reshard", None, t0, tags=self.trace_tags,
+                             tp=int(self.mesh.tp))
+
+    def _mesh_census(self, name, key, fn, args):
+        """Per-dispatch collective wire bytes of a freshly built sharded
+        program: ONE extra trace (``make_jaxpr`` — no XLA compile, and
+        BEFORE the first real call, so donation has not consumed any
+        input buffer), censused by the PT-COMM walker. Recorded per
+        program for the serving collector; failures degrade to 0.0 —
+        the census is telemetry, never load-bearing."""
+        label = name if not key else name + "@" + ",".join(map(str, key))
+        total = 0.0
+        try:
+            from ..static.comm.collectives import iter_collectives
+
+            jaxpr = jax.make_jaxpr(fn)(*args)
+            for c in iter_collectives(jaxpr):
+                total += c.total_wire_bytes
+        except Exception:
+            total = 0.0
+        self._mesh_programs[label] = total
+        if self.tracer is not None:
+            self.tracer.instant("mesh_census", None, self.trace_tags,
+                                program=label, wire_bytes=total)
+        return total
+
+    def _mesh_jit(self, run, in_kinds, out_kinds, donate, static_names=(),
+                  name="program", count_stat=None):
+        """jit(shard_map(run)) under the engine's placement contract:
+        ``in_kinds``/``out_kinds`` name each argument/output "params"
+        (per-param column specs), "kv" (kv_heads-sharded pool tree) or
+        anything else (replicated); ``out_kinds`` may be the bare string
+        "kv" for programs returning the pool tree alone. The body is
+        traced inside :func:`serving_shard_axis`, the trace-time channel
+        telling model layers to all_gather their column-sharded outputs.
+
+        Returns a dispatcher callable. Statics (the mega-step's
+        ``n_steps``/``do_sample``) select a cached
+        ``jit(shard_map(partial(run, **statics)))`` — shard_map has no
+        static-argument support, and baking them per variant keeps the
+        ``donated_invars`` visible on the traced pjit equation exactly
+        where PT-COST-003 audits them. First dispatch per variant runs
+        the collective census once; every dispatch then accumulates the
+        per-dispatch wire bytes into ``stats['mesh_collective_bytes']``."""
+        from functools import partial
+
+        from ..distributed.auto_parallel.serving_sharding import \
+            serving_shard_axis
+        from ..framework.jax_compat import shard_map
+
+        axis = self._mesh_axis
+        in_specs = self._arg_specs(in_kinds)
+        out_specs = (self._kv_spec() if out_kinds == "kv"
+                     else self._arg_specs(out_kinds))
+
+        def build(**statics):
+            fn = partial(run, **statics) if statics else run
+
+            def body(*args):
+                with serving_shard_axis(axis):
+                    return fn(*args)
+
+            sm = shard_map(body, mesh=self._mesh, in_specs=in_specs,
+                           out_specs=out_specs, check_vma=False)
+            return jax.jit(sm, donate_argnums=donate)
+
+        cache = {}
+
+        def dispatch(*args, **statics):
+            key = tuple(statics[n] for n in static_names)
+            ent = cache.get(key)
+            if ent is None:
+                fn = build(**statics)
+                ent = cache[key] = (fn,
+                                    self._mesh_census(name, key, fn, args))
+            fn, per_dispatch = ent
+            self.stats["mesh_collective_bytes"] += per_dispatch
+            if count_stat is not None:
+                self.stats[count_stat] += 1
+            return fn(*args)
+
+        return dispatch
+
     def _build_mega_jit(self):
         """The jitted mega-step EXACTLY as ``step`` dispatches it —
         donation included. tools/audit_program_cost.py traces this (pure
         tracing, no compile) so the audited ``donated_invars`` are the
-        production program's, not a parallel declaration."""
+        production program's, not a parallel declaration. Mesh engines
+        get the same program as jit(shard_map(...)) behind a
+        static-variant dispatcher (``_mesh_jit``) — byte-identical
+        output, per-shard compute."""
         donate = self._MEGA_DONATE_ARGNUMS if self._donate_carry else ()
+        if self._mesh is not None:
+            return self._mesh_jit(
+                self._mega_step_fn(), self._MEGA_ARG_NAMES,
+                ("rep", "rep", "kv", "rep"), donate,
+                static_names=("n_steps", "do_sample"), name="mega_step",
+                count_stat="mesh_decode_steps")
         return jax.jit(self._mega_step_fn(),
                        static_argnames=("n_steps", "do_sample"),
                        donate_argnums=donate)
@@ -1492,6 +1773,11 @@ class ContinuousBatchingEngine:
         tools/audit_program_cost.py traces this, PT-COST-003 audits the
         ``donated_invars``)."""
         donate = self._SPEC_DONATE_ARGNUMS if self._donate_carry else ()
+        if self._mesh is not None:
+            return self._mesh_jit(
+                self._spec_step_fn(), self._SPEC_ARG_NAMES,
+                ("rep", "rep", "rep", "kv", "rep", "rep", "rep"), donate,
+                name="spec_verify", count_stat="mesh_decode_steps")
         return jax.jit(self._spec_step_fn(), donate_argnums=donate)
 
     def _spec_step_fn(self):
@@ -1986,7 +2272,12 @@ class ContinuousBatchingEngine:
                 return sub["kv"]
 
             donate = self._CHUNK_DONATE_ARGNUMS if self._donate_carry else ()
-            fn = self._jit_chunk[g] = jax.jit(run, donate_argnums=donate)
+            if self._mesh is not None:
+                fn = self._mesh_jit(run, self._CHUNK_ARG_NAMES, "kv",
+                                    donate, name=f"prefill_chunk@{g}")
+            else:
+                fn = jax.jit(run, donate_argnums=donate)
+            self._jit_chunk[g] = fn
             self._note_compiled()
         return fn
 
@@ -2125,8 +2416,13 @@ class ContinuousBatchingEngine:
 
             donate = self._FIRST_DONATE_ARGNUMS if self._donate_carry \
                 else ()
-            fn = self._jit_first[(g, do_sample)] = jax.jit(
-                run, donate_argnums=donate)
+            if self._mesh is not None:
+                fn = self._mesh_jit(run, self._FIRST_ARG_NAMES,
+                                    ("rep", "kv", "rep"), donate,
+                                    name=f"first_token@{g}")
+            else:
+                fn = jax.jit(run, donate_argnums=donate)
+            self._jit_first[(g, do_sample)] = fn
             self._note_compiled()
         firsts_dev, new_kv, self._last_tok = fn(
             self._params, jnp.asarray(last), self.caches["kv"],

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 
 from ...core.tensor import Tensor
 from ...distributed.auto_parallel.logical_sharding import annotate, constrain, current_mesh
+from ...distributed.auto_parallel.serving_sharding import gather_output_shards
 from ...nn import functional as F
 from ...nn import initializer as I
 from ...nn.layer.layers import Layer, LayerList
@@ -259,7 +260,13 @@ class LlamaAttention(Layer):
         cos/sin [b, s, d] gathered per row. The chunk's k/v scatter into the
         pages first, then attention gathers the FULL table extent with an
         absolute-position causal mask — see paged_prefill_attention for the
-        bit-identity-across-chunkings argument."""
+        bit-identity-across-chunkings argument.
+
+        Head counts come off the weight/pool shapes (not config), so the
+        same body serves a tp shard inside the engine's serving shard_map
+        (LOCAL heads + local kv pages per device — all math head-local);
+        the attention output is all-gathered before the replicated o_proj
+        (serving_sharding.py's column-parallel identity discipline)."""
         from ...ops.paged_attention import (append_paged_kv,
                                             paged_prefill_attention)
 
@@ -268,9 +275,10 @@ class LlamaAttention(Layer):
         hd = self.config.head_dim
         page = k_pages.shape[2]
         max_len = tables.shape[1] * page
-        q = jnp.matmul(x, self.q_proj_weight._data).reshape(b, s, self.num_heads, hd)
-        k = jnp.matmul(x, self.k_proj_weight._data).reshape(b, s, self.num_kv_heads, hd)
-        v = jnp.matmul(x, self.v_proj_weight._data).reshape(b, s, self.num_kv_heads, hd)
+        q = jnp.matmul(x, self.q_proj_weight._data).reshape(b, s, -1, hd)
+        k = jnp.matmul(x, self.k_proj_weight._data).reshape(b, s, -1, hd)
+        v = jnp.matmul(x, self.v_proj_weight._data).reshape(b, s, -1, hd)
+        nkv = k.shape[2]
         q, k = apply_rotary_pos_emb(q, k, cos, sin)
         seq_ids = jnp.repeat(jnp.arange(b, dtype=jnp.int32), s)
         # pad rows of a final chunk land past the prompt; clipping keeps the
@@ -279,31 +287,33 @@ class LlamaAttention(Layer):
         positions = jnp.clip(starts[:, None] + jnp.arange(s, dtype=jnp.int32),
                              0, max_len - 1).reshape(-1)
         k_pages, v_pages = append_paged_kv(
-            k_pages, v_pages, k.reshape(b * s, self.num_kv_heads, hd),
-            v.reshape(b * s, self.num_kv_heads, hd), tables, positions,
+            k_pages, v_pages, k.reshape(b * s, nkv, hd),
+            v.reshape(b * s, nkv, hd), tables, positions,
             seq_ids)
         out = paged_prefill_attention(q, k_pages, v_pages, tables, starts)
-        out = out.reshape(b, s, self.num_heads * hd)
+        out = gather_output_shards(out.reshape(b, s, -1))
         return jnp.matmul(out, self.o_proj_weight._data), k_pages, v_pages
 
     def paged_token_step(self, x, cos, sin, k_pages, v_pages, tables, pos_vec):
         """ONE token per row at PER-ROW positions (continuous batching:
         every slot is at a different decode offset). x: [b, 1, h];
-        cos/sin [b, 1, d] gathered per row; pos_vec [b] int32."""
+        cos/sin [b, 1, d] gathered per row; pos_vec [b] int32. Head counts
+        come off the weight shapes so a tp shard (local heads, local kv
+        pages) runs the same body; see paged_prefill_chunk."""
         from ...ops.paged_attention import append_paged_kv, paged_decode_attention
 
         x = x._data if isinstance(x, Tensor) else x
         b = x.shape[0]
         hd = self.config.head_dim
-        q = jnp.matmul(x, self.q_proj_weight._data).reshape(b, 1, self.num_heads, hd)
-        k = jnp.matmul(x, self.k_proj_weight._data).reshape(b, 1, self.num_kv_heads, hd)
-        v = jnp.matmul(x, self.v_proj_weight._data).reshape(b, 1, self.num_kv_heads, hd)
+        q = jnp.matmul(x, self.q_proj_weight._data).reshape(b, 1, -1, hd)
+        k = jnp.matmul(x, self.k_proj_weight._data).reshape(b, 1, -1, hd)
+        v = jnp.matmul(x, self.v_proj_weight._data).reshape(b, 1, -1, hd)
         q, k = apply_rotary_pos_emb(q, k, cos, sin)
         k_pages, v_pages = append_paged_kv(
             k_pages, v_pages, k[:, 0], v[:, 0], tables, pos_vec)
         out = paged_decode_attention(q[:, 0], k_pages, v_pages, tables,
                                      pos_vec + 1)
-        out = out.reshape(b, 1, self.num_heads * hd)
+        out = gather_output_shards(out.reshape(b, 1, -1))
         return jnp.matmul(out, self.o_proj_weight._data), k_pages, v_pages
 
 
@@ -376,6 +386,10 @@ class LlamaMLP(Layer):
         act = constrain(act, "batch", "seq", "mlp")
         # named for the 'flash_mlp' remat policy (saveable, not saved by default)
         act = checkpoint_name(act, "mlp_act")
+        # serving tp shard: gate/up are column-sharded, so the activation is
+        # mlp-sharded — gather it whole before the replicated down_proj
+        # (no-op outside a serving shard_map; see serving_sharding.py)
+        act = gather_output_shards(act)
         out = jnp.matmul(act, self.down_proj_weight._data)
         return constrain(out, "batch", "seq", "embed")
 
@@ -615,6 +629,14 @@ def _decode_model_paged(model: "LlamaModel", ids, caches, pos):
 
 
 class LlamaForCausalLM(GenerationMixin, Layer):
+    #: serving-mesh opt-in (inference/serving.py MeshConfig): the paged
+    #: hooks derive head counts from weight shapes and gather
+    #: column-sharded outputs, so they run correctly as tp shards inside
+    #: the engine's shard_map. Models whose paged hooks slice fused or
+    #: interleaved projections (gpt's qkv) must NOT set this — a column
+    #: shard of the fused weight would mix q/k/v.
+    tp_serving = True
+
     def __init__(self, config: LlamaConfig):
         super().__init__()
         self.config = config
@@ -635,6 +657,12 @@ class LlamaForCausalLM(GenerationMixin, Layer):
 
     def logits(self, hidden):
         out = jnp.matmul(hidden, self._lm_head_w())
+        if self.lm_head_weight is not None:
+            # serving tp shard: an UNTIED lm_head is vocab-column-sharded, so
+            # gather the full-vocab logits before sampling/argmax (no-op
+            # outside a serving shard_map; tied heads ride the replicated
+            # embedding and are already full-width)
+            out = gather_output_shards(out)
         return constrain(out, "batch", "seq", "vocab")
 
     def forward(self, input_ids, labels=None, attn_bias=None):

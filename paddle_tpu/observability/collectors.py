@@ -82,8 +82,10 @@ _ENGINE_GAUGE_KEYS = {"compile_cache_entries"}
 # pt_spec_* names below, not as a second pt_engine_* copy; spec_steps has
 # no pt_spec_* twin and stays in the auto-exported pt_engine_* set (the
 # verify-dispatch count is what shows spec degrading to 1-token
-# dispatches).
-_ENGINE_SKIP_KEYS = {"evictions", "spec_proposed", "spec_accepted"}
+# dispatches). The mesh counters export under their REQUIRED
+# pt_serving_* names below.
+_ENGINE_SKIP_KEYS = {"evictions", "spec_proposed", "spec_accepted",
+                     "mesh_collective_bytes", "mesh_decode_steps"}
 
 
 def engine_collector(engine, **labels):
@@ -156,6 +158,25 @@ def engine_collector(engine, **labels):
             "pt_kv_quant_blocks", "gauge",
             "pool pages held in the int8 KV block format").add(
             float(getattr(engine, "_kv_quant_blocks", 0)), **labels))
+        # mesh-sharded serving (docs/SERVING.md "Sharded serving"):
+        # REQUIRED families, rendered on unsharded engines too (tp=1,
+        # zero collective bytes) so dashboards keyed on the gauge see
+        # every replica of a mixed fleet
+        mesh = getattr(engine, "mesh", None)
+        fams.append(MetricFamily(
+            "pt_serving_mesh_shape", "gauge",
+            "tp width of the engine's serving mesh (1 == unsharded)").add(
+            float(mesh.tp) if mesh is not None else 1.0, **labels))
+        fams.append(MetricFamily(
+            "pt_serving_collective_bytes_total", "counter",
+            "wire bytes moved by serving collectives, per device group "
+            "(traced census x dispatches)").add(
+            float(engine.stats.get("mesh_collective_bytes", 0.0)),
+            **labels))
+        fams.append(MetricFamily(
+            "pt_serving_mesh_decode_steps_total", "counter",
+            "sharded decode/verify program dispatches").add(
+            float(engine.stats.get("mesh_decode_steps", 0)), **labels))
         return fams
 
     return collect

@@ -201,10 +201,12 @@ def check_mesh_scaling(manifests: Sequence[CommManifest],
 def check_comm_contract(manifest: CommManifest,
                         baseline: Optional[Dict]) -> List[Diagnostic]:
     """PT-COMM-005: the baseline contract. A program declaring
-    ``unsharded: true`` must trace zero collectives (ROADMAP item 1's
-    sharding PR flips the declaration together with the baseline); an
-    unbaselined program is itself a finding; per-primitive counts and
-    total wire bytes may only grow through a reviewed refresh."""
+    ``unsharded: true`` must trace zero collectives; a program whose
+    baseline records a mesh census must NOT silently revert to unsharded
+    (or lose a recorded collective primitive) — sharding regressions gate
+    exactly like sharding drift; an unbaselined program is itself a
+    finding; per-primitive counts and total wire bytes may only change
+    through a reviewed refresh."""
     name = manifest.program
     findings: List[Diagnostic] = []
     unsharded = manifest.unsharded or bool((baseline or {}).get("unsharded"))
@@ -224,6 +226,25 @@ def check_comm_contract(manifest: CommManifest,
             f"review the manifest", name, "unbaselined"))
         return findings
     base_counts = baseline.get("collectives", {}) or {}
+    base_mesh = baseline.get("mesh") or {}
+    if base_mesh and manifest.unsharded:
+        findings.append(_diag(
+            "PT-COMM-005", Severity.ERROR,
+            f"program '{name}' reverted to the unsharded contract but its "
+            f"baseline records a mesh census "
+            f"({'x'.join(f'{k}{v}' for k, v in sorted(base_mesh.items()))},"
+            f" {dict(base_counts)}) — the program silently LOST its "
+            f"sharding; restore it or refresh the baseline with a "
+            f"justification", name, "lost-sharding"))
+    for prim, want in sorted(base_counts.items()):
+        if int(want) and not manifest.collectives.get(prim, 0):
+            findings.append(_diag(
+                "PT-COMM-005", Severity.ERROR,
+                f"'{name}' traces zero '{prim}' collective(s) but its "
+                f"recorded contract expects {int(want)} — the collective "
+                f"plan silently dropped a primitive; review and refresh "
+                f"the baseline", name, f"lost-collective:{prim}",
+                prim=prim))
     for prim, have in sorted(manifest.collectives.items()):
         want = base_counts.get(prim)
         if want is None:

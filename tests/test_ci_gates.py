@@ -61,8 +61,10 @@ def test_manifest_counts_cover_reference_parity():
         # disagg PR (docs/SERVING.md "Disaggregated tiers"): +
         # KVChainCodec, KVChainCorrupt, TieredRouter;
         # speculative-decode PR (docs/SERVING.md "Speculative decode" /
-        # "int8 KV cache"): + SpecConfig, KVCacheConfig
-        "paddle.inference.serving": 21,
+        # "int8 KV cache"): + SpecConfig, KVCacheConfig;
+        # sharded-serving PR (docs/SERVING.md "Sharded serving"):
+        # + MeshConfig
+        "paddle.inference.serving": 22,
         # speculative-decode PR: the quantization surface gains the int8
         # paged-KV block format — QuantizedKVPool, quantize_kv,
         # dequantize_kv, kv_absmax, KV_QMAX (beside the frozen QAT/PTQ
@@ -306,7 +308,7 @@ def test_collective_comm_gate_selftest():
                        capture_output=True, text=True, env=env, cwd=ROOT,
                        timeout=300)
     assert r.returncode == 0, r.stdout + r.stderr
-    assert ("COMM SELFTEST OK: 5 defect classes detected, clean fixture "
+    assert ("COMM SELFTEST OK: 6 defect classes detected, clean fixture "
             "audits clean, waiver discipline pinned") in r.stdout, r.stdout
     assert "xla_compiles=0" in r.stdout, r.stdout
     r2 = subprocess.run([sys.executable, gate, "--inject", "loop_regather"],
@@ -314,6 +316,14 @@ def test_collective_comm_gate_selftest():
                         timeout=300)
     assert r2.returncode != 0
     assert "PT-COMM-002" in r2.stdout
+    # the sharding-regression arm: a serving program silently reverting
+    # to unsharded must gate against its recorded tp census
+    r3 = subprocess.run([sys.executable, gate, "--inject",
+                         "serving_unsharded"],
+                        capture_output=True, text=True, env=env, cwd=ROOT,
+                        timeout=300)
+    assert r3.returncode != 0
+    assert "lost-sharding" in r3.stdout, r3.stdout
 
 
 def test_collective_comm_gate_real_sweep_clean():
@@ -321,7 +331,8 @@ def test_collective_comm_gate_real_sweep_clean():
     contract program at all five recorded MULTICHIP mesh shapes, the
     ring-attention / MoE-combine / tp-train scaling families at two mesh
     widths each (every family verdict <=ring), and the three serving
-    programs under the explicit unsharded contract must audit clean
+    programs under the tp2-sharded column-parallel contract (all_gather
+    only — docs/SERVING.md "Sharded serving") must audit clean
     (exit 0) against the reviewed tools/collective_baseline.json with no
     stale waivers — and the WHOLE gate (trace, census, scaling law,
     baseline check) must run with zero XLA compiles: everything is
@@ -349,7 +360,9 @@ def test_collective_comm_gate_real_sweep_clean():
     for name in ("mega_step@8", "spec_verify@8", "prefill_chunk"):
         line = [ln for ln in r.stdout.splitlines()
                 if ln.startswith(f"[manifest] {name}:")]
-        assert line and "unsharded, 0 collective eqn(s)" in line[0], r.stdout
+        assert line and "mesh tp2" in line[0], r.stdout
+        # column-parallel identity contract: the census is all_gather-only
+        assert "all_gather" in line[0] and "psum" not in line[0], line[0]
 
 
 @pytest.mark.slow   # ~6min of engine/train-loop compiles across 23 classes

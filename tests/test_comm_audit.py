@@ -528,9 +528,13 @@ def test_committed_comm_baseline_loads_and_covers_registry():
         name = f"mesh_train_step@{key}"
         assert name in programs, name
         assert programs[name]["collective_eqns"] > 0, name
+    # serving programs carry the per-mesh tp contract (column-parallel:
+    # all_gather-only — a psum appearing here would break byte-identity)
     for name in ("mega_step@8", "spec_verify@8", "prefill_chunk"):
-        assert programs[name]["unsharded"] is True
-        assert programs[name]["collective_eqns"] == 0
+        assert programs[name]["unsharded"] is False
+        assert programs[name]["mesh"] == {"tp": 2}
+        assert programs[name]["collective_eqns"] > 0
+        assert set(programs[name]["collectives"]) == {"all_gather"}
     for fam in ("flash_ring", "moe_combine", "tp_train"):
         for w in gate.SCALING_WIDTHS:
             assert programs[f"{fam}@{w}"]["scaling"]["verdict"] == "<=ring"

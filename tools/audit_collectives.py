@@ -56,7 +56,7 @@ import jax  # noqa: E402
 import numpy as np  # noqa: E402
 
 DEFECTS = ("replicated_param", "loop_regather", "superlinear_comm",
-           "gather_reduce", "contract_drift")
+           "gather_reduce", "contract_drift", "serving_unsharded")
 
 EXPECTED_CODE = {
     "replicated_param": "PT-COMM-001",
@@ -64,6 +64,7 @@ EXPECTED_CODE = {
     "superlinear_comm": "PT-COMM-003",
     "gather_reduce": "PT-COMM-004",
     "contract_drift": "PT-COMM-005",
+    "serving_unsharded": "PT-COMM-005",
 }
 
 #: the recorded MULTICHIP_r01–r05 dryrun mesh shapes (size-1 axes kept
@@ -236,10 +237,13 @@ def _compile_free_setup():
 
 
 def record_unsharded(which: str):
-    """The single-device serving programs, re-recorded from
-    audit_program_cost's registry under the EXPLICIT unsharded contract:
-    zero collectives today; ROADMAP item 1's sharding PR must flip
-    ``unsharded`` (spec + baseline) together with its sharding."""
+    """The single-device serving programs under the EXPLICIT unsharded
+    contract. Since the sharding PR flipped the registry to
+    :func:`record_sharded`, this recorder exists for the
+    ``serving_unsharded`` defect arm: it is exactly what a serving
+    program looks like after silently LOSING its sharding, and auditing
+    it against the sharded baseline must flip the gate (PT-COMM-005
+    ``lost-sharding``)."""
     import audit_program_cost as apc
     from paddle_tpu.static.comm import CommPathSpec
 
@@ -250,8 +254,28 @@ def record_unsharded(which: str):
         prog, cost_spec = rec()
     spec = CommPathSpec(which, unsharded=True,
                         notes="single-device serving program "
-                              f"({cost_spec.notes}) — unsharded contract, "
-                              "to flip with ROADMAP item 1")
+                              f"({cost_spec.notes}) — unsharded contract")
+    return prog, spec
+
+
+def record_sharded(which: str, tp: int = 2):
+    """The mesh-sharded serving programs, re-recorded from
+    audit_program_cost's registry over an ABSTRACT tp mesh (no devices,
+    no compiles — docs/SERVING.md "Sharded serving"). Column-parallel
+    identity contract: every collective is an all_gather of disjoint
+    output shards, so the census must stay psum-free."""
+    import audit_program_cost as apc
+    from paddle_tpu.static.comm import CommPathSpec
+
+    rec = {"mega_step@8": lambda: apc.record_mega_step(8, mesh=tp),
+           "spec_verify@8": lambda: apc.record_spec_verify(8, mesh=tp),
+           "prefill_chunk": lambda: apc.record_prefill_chunk(mesh=tp)}[which]
+    with _compile_free_setup():
+        prog, cost_spec = rec()
+    spec = CommPathSpec(which, mesh={"tp": tp}, width=tp,
+                        notes=f"tp{tp}-sharded serving program "
+                              f"({cost_spec.notes}) — column-parallel, "
+                              "all_gather-only by construction")
     return prog, spec
 
 
@@ -264,7 +288,7 @@ def record_all(only=None):
         out[f"flash_ring@{w}"] = lambda s=w: record_flash_ring(s)
         out[f"moe_combine@{w}"] = lambda s=w: record_moe_combine(s)
     for name in ("mega_step@8", "spec_verify@8", "prefill_chunk"):
-        out[name] = lambda n=name: record_unsharded(n)
+        out[name] = lambda n=name: record_sharded(n)
     if only:
         if only not in out:
             raise SystemExit(f"unknown program {only!r} "
@@ -303,10 +327,11 @@ def write_baseline(manifests, waivers, path: str = BASELINE_PATH):
             "PT-COMM manifests + reviewed waivers",
             "(docs/STATIC_ANALYSIS.md, tools/audit_collectives.py).",
             "Counts and wire bytes are CONTRACTS: collectives may only",
-            "grow through a reviewed refresh. The serving programs carry",
-            "'unsharded': true — ROADMAP item 1's sharding PR flips that",
-            "flag together with its sharding change. Every waiver needs",
-            "a justification; stale waivers are reported by the gate.",
+            "change through a reviewed refresh. The serving programs",
+            "record their tp-sharded collective census (column-parallel,",
+            "all_gather-only); a program that silently reverts to",
+            "unsharded gates as PT-COMM-005 lost-sharding. Every waiver",
+            "needs a justification; stale waivers are reported.",
         ],
         "programs": {k: m.to_dict() for k, m in sorted(manifests.items())},
         "waivers": [{"id": fid, "justification": waivers[fid]}
@@ -464,7 +489,23 @@ def inject(defect, base_programs):
         return _fixture_pair(gather_reduce=True)
     if defect == "contract_drift":
         return _fixture_pair(extra_psum=True)
+    if defect == "serving_unsharded":
+        # a serving program that silently LOST its sharding: the engine
+        # dispatches the single-device program while the baseline records
+        # the tp-sharded all_gather census (audit against _serving_base())
+        return {"mega_step@8": record_unsharded("mega_step@8")}
     raise SystemExit(f"unknown defect {defect!r} (choose: {DEFECTS})")
+
+
+def _serving_base():
+    """The REAL sharded mega-step census, recorded as the baseline the
+    ``serving_unsharded`` defect arm is audited against — the one defect
+    class that needs a production program, not a synthetic fixture."""
+    from paddle_tpu.static.comm import compute_comm_manifest
+
+    prog, spec = record_sharded("mega_step@8")
+    man = compute_comm_manifest(prog, name="mega_step@8", spec=spec)
+    return {"mega_step@8": man.to_dict()}
 
 
 def selftest():
@@ -478,7 +519,9 @@ def selftest():
     h.case("clean fixture", rc == 0, f"rc={rc}, {len(gate)} gate finding(s)")
     for defect in DEFECTS:
         want = EXPECTED_CODE[defect]
-        rc, _, gate = audit(inject(defect, base), base, waivers={})
+        b = dict(base, **_serving_base()) \
+            if defect == "serving_unsharded" else base
+        rc, _, gate = audit(inject(defect, b), b, waivers={})
         hit = [d for d in gate if d.code == want]
         if rc == 1 and hit:
             h.case(f"inject {defect}", True,
@@ -535,6 +578,8 @@ def main(argv=None):
         rc = selftest()
     elif args.inject:
         base = _fixture_baseline()
+        if args.inject == "serving_unsharded":
+            base = dict(base, **_serving_base())
         rc, _, _ = audit(inject(args.inject, base), base, waivers={})
     else:
         base_programs, waivers = ({}, {}) if args.no_baseline \

@@ -70,14 +70,19 @@ def _spec(shape, dtype):
 # hot-path recorders — each returns (Program, HotPathSpec)
 # ---------------------------------------------------------------------------
 
-def record_mega_step(slots: int):
+def record_mega_step(slots: int, mesh: int = 0):
     """The fused decode mega-step EXACTLY as the engine dispatches it:
     traced through ``_build_mega_jit()`` (donation included, so the audited
     ``donated_invars`` are the production program's), every buffer — params,
-    kv pools, tables, device step state, sampling vectors — a named input."""
+    kv pools, tables, device step state, sampling vectors — a named input.
+
+    ``mesh=N`` traces the tp-sharded shard_map variant over an ABSTRACT
+    tp mesh (no devices needed — docs/SERVING.md "Sharded serving"), so the
+    manifest covers the column-parallel program the sharded engine really
+    dispatches, all_gathers included."""
     import paddle_tpu as paddle
     from paddle_tpu.inference.serving import (ContinuousBatchingEngine,
-                                              PrefixCacheConfig)
+                                              MeshConfig, PrefixCacheConfig)
     from paddle_tpu.jit.api import _collect_state
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
     from paddle_tpu.static.analysis import trace_to_program
@@ -88,7 +93,8 @@ def record_mega_step(slots: int):
     m = LlamaForCausalLM(cfg)
     eng = ContinuousBatchingEngine(
         m, max_batch=slots, max_len=32, page_size=8, block_size=2,
-        fused=True, prefix_cache=PrefixCacheConfig(prefill_chunk=8))
+        fused=True, prefix_cache=PrefixCacheConfig(prefill_chunk=8),
+        mesh=MeshConfig(tp=mesh, abstract=True) if mesh else None)
     jf = eng._build_mega_jit()
     names, tensors = _collect_state(m)
     param_structs = [_spec(t._data.shape, t._data.dtype) for t in tensors]
@@ -121,14 +127,16 @@ def record_mega_step(slots: int):
                             param_tensors=tensors)
     kv_lo = n_p + 1
     kv_hi = kv_lo + 2 * L
+    fam = f"mega_step_tp{mesh}" if mesh else "mega_step"
     spec = HotPathSpec(
-        f"mega_step@{slots}", slots=slots,
+        f"{fam}@{slots}", slots=slots,
         carries={"kv": (kv_lo, kv_hi), "pos": (kv_hi + 1, kv_hi + 2)},
-        notes="fused decode mega-step (serving.py), n_steps=2, sampled")
+        notes="fused decode mega-step (serving.py), n_steps=2, sampled" +
+              (f", column-parallel tp={mesh} shard_map" if mesh else ""))
     return prog, spec
 
 
-def record_spec_verify(slots: int):
+def record_spec_verify(slots: int, mesh: int = 0):
     """The speculative verify mega-step (docs/SERVING.md "Speculative
     decode") EXACTLY as the engine dispatches it: traced through
     ``_build_spec_jit()`` so the audited ``donated_invars`` cover the real
@@ -138,7 +146,8 @@ def record_spec_verify(slots: int):
     by the baseline contract (PT-COST-004)."""
     import paddle_tpu as paddle
     from paddle_tpu.inference.serving import (ContinuousBatchingEngine,
-                                              PrefixCacheConfig, SpecConfig)
+                                              MeshConfig, PrefixCacheConfig,
+                                              SpecConfig)
     from paddle_tpu.jit.api import _collect_state
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
     from paddle_tpu.static.analysis import trace_to_program
@@ -150,7 +159,8 @@ def record_spec_verify(slots: int):
     eng = ContinuousBatchingEngine(
         m, max_batch=slots, max_len=32, page_size=8, block_size=2,
         fused=True, speculative=SpecConfig(k=3, ngram=2, history=16),
-        prefix_cache=PrefixCacheConfig(prefill_chunk=8))
+        prefix_cache=PrefixCacheConfig(prefill_chunk=8),
+        mesh=MeshConfig(tp=mesh, abstract=True) if mesh else None)
     jf = eng._build_spec_jit()
     names, tensors = _collect_state(m)
     param_structs = [_spec(t._data.shape, t._data.dtype) for t in tensors]
@@ -181,22 +191,24 @@ def record_spec_verify(slots: int):
                             param_tensors=tensors)
     kv_lo = n_p + 1
     kv_hi = kv_lo + 2 * L
+    fam = f"spec_verify_tp{mesh}" if mesh else "spec_verify"
     spec = HotPathSpec(
-        f"spec_verify@{slots}", slots=slots,
+        f"{fam}@{slots}", slots=slots,
         carries={"kv": (kv_lo, kv_hi), "pos": (kv_hi + 1, kv_hi + 2),
                  "hist": (kv_hi + 3, kv_hi + 4),
                  "hlen": (kv_hi + 4, kv_hi + 5)},
         notes="speculative verify mega-step (serving.py), k=3 draft + "
-              "bonus, n-gram drafter in-graph")
+              "bonus, n-gram drafter in-graph" +
+              (f", column-parallel tp={mesh} shard_map" if mesh else ""))
     return prog, spec
 
 
-def record_prefill_chunk():
+def record_prefill_chunk(mesh: int = 0):
     """The packed prefill-chunk program (``_chunk_fn`` — shared by the
     legacy chunked path and the fused ``_run_pack``), at a 4-row bucket."""
     import paddle_tpu as paddle
     from paddle_tpu.inference.serving import (ContinuousBatchingEngine,
-                                              PrefixCacheConfig)
+                                              MeshConfig, PrefixCacheConfig)
     from paddle_tpu.jit.api import _collect_state
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
     from paddle_tpu.static.analysis import trace_to_program
@@ -207,7 +219,8 @@ def record_prefill_chunk():
     m = LlamaForCausalLM(cfg)
     eng = ContinuousBatchingEngine(
         m, max_batch=8, max_len=32, page_size=8, block_size=2, fused=True,
-        prefix_cache=PrefixCacheConfig(prefill_chunk=8))
+        prefix_cache=PrefixCacheConfig(prefill_chunk=8),
+        mesh=MeshConfig(tp=mesh, abstract=True) if mesh else None)
     g, C = 4, eng._chunk_tokens
     jf = eng._chunk_fn(g)
     names, tensors = _collect_state(m)
@@ -235,8 +248,10 @@ def record_prefill_chunk():
         param_tensors=tensors)
     kv_lo = n_p + 1
     spec = HotPathSpec(
-        "prefill_chunk", carries={"kv": (kv_lo, kv_lo + 2 * L)},
-        notes="packed prefill chunk (_chunk_fn g=4), chunk=8 tokens")
+        f"prefill_chunk_tp{mesh}" if mesh else "prefill_chunk",
+        carries={"kv": (kv_lo, kv_lo + 2 * L)},
+        notes="packed prefill chunk (_chunk_fn g=4), chunk=8 tokens" +
+              (f", column-parallel tp={mesh} shard_map" if mesh else ""))
     return prog, spec
 
 
@@ -325,6 +340,11 @@ def record_all(only=None):
         out[f"mega_step@{slots}"] = lambda s=slots: record_mega_step(s)
         out[f"spec_verify@{slots}"] = lambda s=slots: record_spec_verify(s)
     out["prefill_chunk"] = record_prefill_chunk
+    # mesh-sharded serving variants (abstract tp=2 mesh; one width — the
+    # slot-scaling law is carried by the unsharded family above)
+    out["mega_step_tp2@8"] = lambda: record_mega_step(8, mesh=2)
+    out["spec_verify_tp2@8"] = lambda: record_spec_verify(8, mesh=2)
+    out["prefill_chunk_tp2"] = lambda: record_prefill_chunk(mesh=2)
     out["train_step"] = record_train_step
     out["migration"] = record_migration
     if only:

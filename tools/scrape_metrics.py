@@ -90,6 +90,12 @@ REQUIRED_FAMILIES = (
     "pt_spec_accepted_total",
     "pt_spec_acceptance_rate",
     "pt_kv_quant_blocks",
+    # mesh-sharded serving (docs/SERVING.md "Sharded serving"): the
+    # engine collector renders tp width 1 / zero collective bytes on
+    # unsharded engines, so the families are REQUIRED unconditionally
+    "pt_serving_mesh_shape",
+    "pt_serving_collective_bytes_total",
+    "pt_serving_mesh_decode_steps_total",
     # checkpoint lifecycle (distributed/resilience/lifecycle.py — the
     # checkpoint_collector renders generation/publish counters at zero and
     # the phase gauge at "idle" with no publisher constructed, so the
