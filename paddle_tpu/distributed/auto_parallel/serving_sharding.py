@@ -34,7 +34,8 @@ import contextlib
 import threading
 from typing import Optional
 
-__all__ = ["serving_tp_axis", "serving_shard_axis", "gather_output_shards"]
+__all__ = ["serving_tp_axis", "serving_shard_axis", "gather_output_shards",
+           "harvest_param_shards", "adopt_resharded_params"]
 
 _state = threading.local()
 
@@ -71,3 +72,55 @@ def gather_output_shards(x):
     import jax
 
     return jax.lax.all_gather(x, axis, axis=x.ndim - 1, tiled=True)
+
+
+# ---------------------------------------------------------------------------
+# elastic reshard plan (MeshDegraded / PT-SRV-008 — docs/RESILIENCE.md
+# "Elastic serving mesh")
+# ---------------------------------------------------------------------------
+
+def harvest_param_shards(engine):
+    """Gather a (possibly degraded) engine's weights host-side, ONCE.
+
+    Because every tp-sharded weight is column-parallel — disjoint shards
+    along the output dim, no partial sums — gathering is an exact
+    concatenation: the host arrays are bit-identical to the unsharded
+    weights regardless of the width they were serving at. This is the
+    first half of the elastic degrade reshard plan: harvest at the old
+    width, rebuild the engine at the surviving width, then
+    :func:`adopt_resharded_params` re-splits the SAME bytes along the
+    SAME output dims.
+
+    Returns a list of host (numpy) arrays in ``engine._params`` order."""
+    import numpy as np
+
+    return [np.asarray(p) for p in engine._params]
+
+
+def adopt_resharded_params(engine, host_params):
+    """Re-slab harvested weights onto a rebuilt engine's mesh.
+
+    ``host_params`` must be :func:`harvest_param_shards` output from an
+    engine built over the same model (same param order and shapes). Each
+    array is re-placed per the NEW engine's per-param specs — column
+    shards along the same output dims at the surviving tp width, or plain
+    committed arrays when the rebuild fell back to unsharded. Returns the
+    engine (weights swapped in place)."""
+    import jax
+    import jax.numpy as jnp
+
+    if len(host_params) != len(engine._params):
+        raise ValueError(
+            f"reshard plan mismatch: {len(host_params)} harvested param(s) "
+            f"vs {len(engine._params)} in the rebuilt engine — the degrade "
+            f"rebuild must reuse the same model")
+    mesh = getattr(engine, "_mesh", None)
+    if mesh is None:
+        engine._params = [jnp.asarray(p) for p in host_params]
+        return engine
+    from jax.sharding import NamedSharding
+
+    engine._params = [
+        jax.device_put(p, NamedSharding(mesh, s))
+        for p, s in zip(host_params, engine._param_specs)]
+    return engine

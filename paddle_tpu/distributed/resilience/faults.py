@@ -68,6 +68,12 @@ Known sites (see docs/RESILIENCE.md for the catalogue):
                       = ``peer``; same actions on the receive side —
                       ``stall`` holds the receive, the net_slow_peer
                       drill)
+``device.loss``       serving engine, same event stream as
+                      ``serving.step`` (detail = ``step:<n>``; ``lose``
+                      removes ``arg`` devices from the engine's tp mesh
+                      — the engine raises ``MeshDegraded``/PT-SRV-008
+                      and the elastic supervisor reshards to the widest
+                      surviving width, the mesh_device_loss drill)
 ====================  =====================================================
 
 With no plan installed every hook is a cheap no-op (one global read), so
@@ -84,7 +90,7 @@ from typing import List, Optional, Sequence
 
 __all__ = ["FaultSpec", "FaultPlan", "ComposedFaultPlan", "FaultInjected",
            "maybe_inject", "corrupt", "active_plan", "numeric_inject_code",
-           "poison_arrays", "resource_hold", "wire_faults"]
+           "poison_arrays", "resource_hold", "wire_faults", "device_loss"]
 
 
 class FaultInjected(ConnectionError):
@@ -110,10 +116,11 @@ class FaultSpec:
     _NUMERIC = ("nan_grad", "loss_spike", "poison_batch")
     _RESOURCE = ("exhaust",)
     _NET = ("drop", "duplicate", "torn", "blackhole")
+    _DEVICE = ("lose",)
 
     def __post_init__(self):
         known = (self._CONTROL + self._DATA + self._NUMERIC
-                 + self._RESOURCE + self._NET)
+                 + self._RESOURCE + self._NET + self._DEVICE)
         if self.action not in known:
             raise ValueError(
                 f"unknown fault action {self.action!r} (choose: {known})")
@@ -310,6 +317,24 @@ def resource_hold(site: str, detail: str = "") -> int:
     total = 0
     for s in plan.fire(site, detail):
         if s.action == "exhaust":
+            total += max(0, int(s.arg))
+    return total
+
+
+def device_loss(detail: str = "") -> int:
+    """Device-loss hook: number of mesh devices the due ``lose`` specs
+    remove from the engine's tp device group at this event — seeded,
+    step-indexed device failure (``device.loss`` site, consulted at the
+    top of every serving engine step alongside ``serving.step``). The
+    sharded engine turns a non-zero return into :class:`MeshDegraded`
+    (PT-SRV-008) so the elastic ServingSupervisor can reshard-and-resume
+    at the widest surviving width. No plan -> 0 (one global read)."""
+    plan = _ACTIVE
+    if plan is None:
+        return 0
+    total = 0
+    for s in plan.fire("device.loss", detail):
+        if s.action == "lose":
             total += max(0, int(s.arg))
     return total
 

@@ -343,7 +343,14 @@ class FleetRouter:
         alive = self._routable(req)
         if not alive:
             return []
-        loads = {r.idx: r.sup.load() for r in alive}
+        # capacity-weighted load: a process replica whose mesh shrank
+        # under an elastic degrade (ProcReplica.capacity_weight < 1)
+        # reads proportionally busier, so new work drifts toward
+        # full-width survivors — no failover, no churn, just weighting
+        loads = {
+            r.idx: r.sup.load()
+            / max(getattr(r.sup, "capacity_weight", lambda: 1.0)(), 1e-6)
+            for r in alive}
         n = len(alive)
         order = sorted(alive, key=lambda r: (loads[r.idx],
                                              (r.idx - req.rid) % n))

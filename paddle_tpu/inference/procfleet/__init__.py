@@ -37,8 +37,8 @@ stack in their OWN process — a driver spawning N replicas pays one jax
 runtime, not N.
 """
 
-from .proxy import (BreakerOpen, CircuitBreaker, ProcReplica,  # noqa: F401
-                    WorkerDead)
+from .proxy import (BreakerOpen, CircuitBreaker, MeshMismatch,  # noqa: F401
+                    ProcReplica, WorkerDead)
 from .router import (ProcFleetConfig, ProcFleetRouter,  # noqa: F401
                      ProcTieredRouter)
 from .transport import (ChaosTransport, LoopbackTransport,  # noqa: F401
@@ -47,7 +47,7 @@ from .wire import Message, WireClosed, WireCorrupt  # noqa: F401
 from .worker import WorkerSpec, worker_main, worker_thread_main  # noqa: F401
 
 __all__ = ["BreakerOpen", "ChaosTransport", "CircuitBreaker",
-           "LoopbackTransport", "Message", "ProcFleetConfig",
+           "LoopbackTransport", "Message", "MeshMismatch", "ProcFleetConfig",
            "ProcFleetRouter", "ProcReplica", "ProcTieredRouter",
            "TcpTransport", "Transport", "WireClosed", "WireCorrupt",
            "WorkerDead", "WorkerSpec", "loopback_pair", "worker_main",

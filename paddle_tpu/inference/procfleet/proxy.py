@@ -55,7 +55,8 @@ from .transport import ChaosTransport, TcpTransport, Transport, \
 from .wire import Message, WireClosed, WireCorrupt
 from .worker import WorkerSpec, worker_thread_main
 
-__all__ = ["ProcReplica", "WorkerDead", "BreakerOpen", "CircuitBreaker"]
+__all__ = ["ProcReplica", "WorkerDead", "BreakerOpen", "CircuitBreaker",
+           "MeshMismatch"]
 
 # every live worker Popen, so an exiting driver never leaks processes —
 # guarded: ProcReplica spawns/reaps from driver threads while atexit runs
@@ -93,6 +94,16 @@ class WorkerDead(RuntimeError):
     """PT-PROC-002: the replica worker process is gone (SIGKILL, crash,
     fatal supervisor error) or stopped answering within the op timeout —
     the router fails its work over from the on-disk journal."""
+
+
+class MeshMismatch(RuntimeError):
+    """PT-PROC-005: the worker's HELLO reported an engine mesh width that
+    contradicts the spec the driver spawned it with (``WorkerSpec.mesh``)
+    — a preset/config skew that would otherwise serve silently at the
+    wrong width (wrong capacity weighting, wrong device-group accounting,
+    a PT-COMM contract recorded at a width the fleet never asked for).
+    Raised at spawn, before the replica joins the fleet; the worker is
+    killed and reaped."""
 
 
 class BreakerOpen(RuntimeError):
@@ -345,6 +356,22 @@ class ProcReplica:
         # omit the field) — read by the fleet collector's per-device-group
         # telemetry and by scale-out accounting (bench fleet ratio)
         eng.setdefault("mesh_tp", 1)
+        # the HELLO width is the worker's GROUND TRUTH — it must match
+        # what the driver asked for. A preset whose factory_kwargs carry
+        # their own mesh while spec.mesh says otherwise would serve
+        # silently at the wrong width; refuse it at spawn (PT-PROC-005).
+        want_tp = int(spec.mesh or 1)
+        if int(eng["mesh_tp"]) != want_tp:
+            self.kill()
+            self._reap()
+            raise MeshMismatch(
+                f"PT-PROC-005: replica {idx} worker HELLO reports engine "
+                f"mesh_tp={int(eng['mesh_tp'])} but WorkerSpec.mesh asked "
+                f"for tp={want_tp} — preset/config skew; fix the factory "
+                f"kwargs or the fleet mesh before serving")
+        #: the spec'd width, for capacity weighting after an elastic
+        #: degrade (engine.mesh_tp then reports the SURVIVING width)
+        self._spec_tp = want_tp
         #: the geometry surface FleetRouter reads (page_size for prefix
         #: chain keys, max_batch/max_queue for the brownout depth default)
         self.engine = SimpleNamespace(**eng)
@@ -564,6 +591,16 @@ class ProcReplica:
                 self._has_work = bool(p["has_work"])
             if "cap" in p:
                 self._cap = [int(c) for c in p["cap"]]
+            if "mesh_tp" in p:
+                # the worker's elastic degrade "re-HELLO": its engine
+                # resharded to a narrower surviving width and it kept
+                # serving — mirror the new width (capacity weighting,
+                # telemetry) instead of treating the replica as dead
+                new_tp = int(p["mesh_tp"])
+                if new_tp != int(getattr(self.engine, "mesh_tp", 1)):
+                    self.engine.mesh_tp = new_tp
+                    self.stats["proc_mesh_degrades"] = \
+                        self.stats.get("proc_mesh_degrades", 0) + 1
             for up in p.get("updates", ()):
                 rid = int(up["rid"])
                 user = self.requests.get(rid)
@@ -658,6 +695,17 @@ class ProcReplica:
         must never be retired toward a worker that cannot hold it)."""
         with self._state_lock:
             return list(self._cap)
+
+    def capacity_weight(self) -> float:
+        """Relative serving capacity vs the width this replica was
+        spawned at: 1.0 until an elastic mesh degrade, then
+        ``surviving_tp / spec_tp`` — the fleet router divides load by it
+        so a shrunken replica reads proportionally busier and new work
+        drifts toward full-width survivors WITHOUT failover churn
+        (docs/RESILIENCE.md "Elastic serving mesh")."""
+        with self._state_lock:
+            tp = int(getattr(self.engine, "mesh_tp", 1))
+        return max(tp, 1) / max(self._spec_tp, 1)
 
     def migration_ready(self) -> List[int]:
         """rids whose prefill finished on this worker (populated from the
@@ -900,7 +948,11 @@ class ProcReplica:
             except OSError:
                 pass
         self.reaped = True
-        self.stats["proc_reaped"] = self.stats.get("proc_reaped", 0) + 1
+        with self._state_lock:
+            # stats is shared with the heartbeat/step threads via _apply's
+            # mesh_tp re-HELLO bump, which runs under this lock too
+            self.stats["proc_reaped"] = \
+                self.stats.get("proc_reaped", 0) + 1
 
     def heartbeat_count(self) -> int:
         with self._state_lock:

@@ -127,6 +127,23 @@ def resolve_factory(spec: WorkerSpec) -> Callable:
                 f"runtime has {len(devs)} devices")
         kwargs["mesh"] = MeshConfig(
             tp=tp, devices=[devs[int(i)] for i in idxs])
+
+        def build(mesh_tp: Optional[int] = tp):
+            # width-aware factory: the elastic supervisor's PT-SRV-008
+            # degrade rebuilds at the widest SURVIVING width — a prefix
+            # of this worker's device group — or unsharded (mesh_tp
+            # None) when no narrower width divides the head counts
+            # (docs/RESILIENCE.md "Elastic serving mesh")
+            kw = dict(kwargs)
+            if mesh_tp is None:
+                kw["mesh"] = None
+            elif int(mesh_tp) != tp:
+                kw["mesh"] = MeshConfig(
+                    tp=int(mesh_tp),
+                    devices=[devs[int(i)] for i in idxs[:int(mesh_tp)]])
+            return fac(**kw)
+
+        return build
     return lambda: fac(**kwargs)
 
 
@@ -175,6 +192,17 @@ class _WorkerLoop:
         self._idem: "collections.OrderedDict[str, Message]" = \
             collections.OrderedDict()
         self._codec = None
+        # last mesh width reported to the driver: an elastic PT-SRV-008
+        # degrade shrinks the engine's mesh IN PLACE (the worker absorbs
+        # it and keeps serving) — the next TOKENS reply piggybacks the
+        # new width, a "re-HELLO" without a reconnect, so the router
+        # re-weights capacity instead of declaring the worker dead
+        self._last_mesh_tp = self._engine_mesh_tp()
+
+    def _engine_mesh_tp(self) -> int:
+        eng = self.sup.engine
+        return (int(eng.mesh.tp)
+                if getattr(eng, "mesh", None) is not None else 1)
 
     # -- per-type handlers -------------------------------------------------
     def handle(self, msg: Message) -> Message:
@@ -281,11 +309,16 @@ class _WorkerLoop:
 
     def _on_step(self, msg: Message) -> Message:
         self.sup.step()
-        return Message("TOKENS", {
+        payload = {
             "updates": self._updates(), "load": int(self.sup.load()),
             "sig": list(self.sup.progress()), "behind": self._behind(),
             "ready": self._ready(), "cap": self._capacity(),
-            "has_work": bool(self.sup.has_work())})
+            "has_work": bool(self.sup.has_work())}
+        tp = self._engine_mesh_tp()
+        if tp != self._last_mesh_tp:
+            self._last_mesh_tp = tp
+            payload["mesh_tp"] = tp
+        return Message("TOKENS", payload)
 
     def _on_progress(self, msg: Message) -> Message:
         return Message("PROGRESS_REPLY", {

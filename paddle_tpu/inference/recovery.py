@@ -62,6 +62,10 @@ PT-SRV-003 request shed at submit (``RequestShed`` — serving.py)
 PT-SRV-004 journal corruption (:class:`JournalCorrupt` names the record)
 PT-SRV-005 replay divergence: recovered prefix != delivered prefix
 PT-SRV-006 brownout entered/exited (engine stats — serving.py)
+PT-SRV-008 mesh degraded (:class:`MeshDegraded` — device-group loss):
+           engine resharded to the widest surviving tp width, requests
+           replayed bit-identically (docs/RESILIENCE.md "Elastic
+           serving mesh")
 ========== ==============================================================
 """
 
@@ -73,7 +77,8 @@ import time
 import zlib
 from typing import Callable, Dict, List, Optional, Set
 
-from .serving import ContinuousBatchingEngine, Request, RequestShed
+from .serving import (ContinuousBatchingEngine, MeshDegraded, Request,
+                      RequestShed)
 
 __all__ = ["JournalCorrupt", "RequestJournal", "ServingSupervisor"]
 
@@ -292,7 +297,7 @@ class ServingSupervisor:
                  journal_path: str, step_budget_s: Optional[float] = None,
                  max_recoveries: int = 2, watchdog_grace_steps: int = 4,
                  fsync: bool = False, tracer=None,
-                 trace_tags: Optional[dict] = None):
+                 trace_tags: Optional[dict] = None, elastic: bool = True):
         from ..distributed.resilience.watchdog import StepWatchdog
 
         self._build = build_engine
@@ -323,8 +328,14 @@ class ServingSupervisor:
         self.max_recoveries = int(max_recoveries)
         self.watchdog = (StepWatchdog(step_budget_s)
                          if step_budget_s is not None else None)
+        # elastic=False is the mesh-degrade CONTROL arm: a MeshDegraded
+        # out of the engine escapes instead of resharding, and every
+        # in-flight request is lost with the device group
+        self.elastic = bool(elastic)
+        self._build_mesh_aware: Optional[bool] = None
         self.stats = {"shed": 0, "recoveries": 0, "recovery_s": 0.0,
-                      "replayed_requests": 0}
+                      "replayed_requests": 0, "mesh_reshards": 0,
+                      "mesh_degraded": 0}
         self.engine = build_engine()
         self._attach_tracer()
         # rids are assigned by a PER-PROCESS counter; a restart over an
@@ -419,6 +430,19 @@ class ServingSupervisor:
             if armed:
                 self.watchdog.disarm()
             raise
+        except MeshDegraded as e:
+            # device-group loss is DISTINCT from an engine crash: the
+            # journal is intact and the surviving devices can still serve
+            # — reshard to the widest surviving width and replay, instead
+            # of rebuilding at a width that no longer exists. elastic=False
+            # (or an exhausted budget, or a factory that cannot build
+            # narrower) lets it escape: the control arm, requests lost.
+            if armed:
+                self.watchdog.disarm()
+            if not self.elastic or self.recoveries >= self.max_recoveries:
+                raise
+            self._degrade(e)
+            return
         except Exception as e:  # engine state is untrusted from here on
             if armed:
                 self.watchdog.disarm()
@@ -672,7 +696,71 @@ class ServingSupervisor:
                 self._live.pop(rid, None)
                 self._verify.discard(rid)
 
-    def _recover(self, code: str, msg: str, rebuild: bool = True) -> None:
+    def _degrade(self, e: MeshDegraded) -> None:
+        """PT-SRV-008 reshard-and-resume (docs/RESILIENCE.md "Elastic
+        serving mesh"): pick the widest surviving tp width that still
+        divides BOTH head counts (falling to unsharded when none does),
+        harvest the degraded engine's column shards host-side ONCE,
+        rebuild through the width-aware factory, re-split the same bytes
+        along the same output dims, and replay every unfinished journaled
+        request — streams stay bit-equal to an uninterrupted run because
+        the reshard moves bytes, never values."""
+        from ..distributed.auto_parallel.serving_sharding import (
+            adopt_resharded_params, harvest_param_shards)
+
+        if self._build_mesh_aware is None:
+            import inspect
+
+            try:
+                params = inspect.signature(self._build).parameters
+                self._build_mesh_aware = (
+                    "mesh_tp" in params
+                    or any(p.kind is inspect.Parameter.VAR_KEYWORD
+                           for p in params.values()))
+            except (TypeError, ValueError):
+                self._build_mesh_aware = False
+        if not self._build_mesh_aware:
+            # the factory cannot build at a different width — the degrade
+            # is unservable; let the typed signal escape to the operator
+            raise e
+        eng = self.engine
+        old_tp = (int(eng.mesh.tp)
+                  if getattr(eng, "mesh", None) is not None else 1)
+        cfg = eng.model.config
+        heads = [int(getattr(cfg, f)) for f in
+                 ("num_attention_heads", "num_key_value_heads")
+                 if getattr(cfg, f, None) is not None]
+        new_tp: Optional[int] = None
+        for w in range(max(0, int(e.survivors)), 1, -1):
+            if all(h % w == 0 for h in heads):
+                new_tp = w
+                break
+        # the old shards are an exact partition of the full weights —
+        # gather them host-side once, BEFORE the degraded engine goes away
+        host = harvest_param_shards(eng)
+        builder = (lambda: adopt_resharded_params(
+            self._build(mesh_tp=new_tp), host))
+        self.stats["mesh_reshards"] += 1
+        self.stats["mesh_degraded"] = 1
+        t0_tr = None if self.tracer is None else self.tracer.now()
+        self._recover(
+            "PT-SRV-008",
+            f"mesh degraded: lost {e.lost} device(s) from tp={old_tp} — "
+            + (f"resharding to tp={new_tp}" if new_tp is not None else
+               f"{e.survivors} survivor(s) divide no head count — "
+               "falling back to unsharded"),
+            builder=builder)
+        if self.tracer is not None:
+            # ok=False on fall-to-unsharded: the service survived but the
+            # replica lost its sharding entirely — dashboards must see it
+            self.tracer.span("mesh_degrade", None, t0_tr,
+                             tags=self.trace_tags,
+                             ok=new_tp is not None, old_tp=old_tp,
+                             new_tp=int(new_tp or 1), lost=int(e.lost))
+
+    def _recover(self, code: str, msg: str, rebuild: bool = True,
+                 builder: Optional[Callable[
+                     [], ContinuousBatchingEngine]] = None) -> None:
         """Rebuild the engine and replay every unfinished journaled request
         on it: fresh block pool, empty radix cache, deadline clocks reset.
         Blocks until each replay has caught up to its delivered high-water
@@ -686,7 +774,7 @@ class ServingSupervisor:
         self.events.append((code, msg))
         if rebuild:
             self.journal.append("crash", code=code, msg=msg)
-            self.engine = self._build()
+            self.engine = (builder or self._build)()
             self._attach_tracer()
         replaying: List[int] = []
         # backpressure and feasibility shedding were already charged at the
@@ -740,7 +828,7 @@ class ServingSupervisor:
                     raise
                 self._recover(
                     code, f"engine crashed again during replay "
-                    f"({type(e).__name__}: {e})")
+                    f"({type(e).__name__}: {e})", builder=builder)
                 return
             guard += 1
             if guard > 100000:

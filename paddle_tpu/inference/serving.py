@@ -116,7 +116,8 @@ from ..ops.paged_attention import BlockAllocator, RadixPrefixCache
 __all__ = ["AutoscaleConfig", "BlockAllocator", "BrownoutConfig",
            "ContinuousBatchingEngine", "EngineSaturated", "FleetConfig",
            "FleetRouter", "KVCacheConfig", "KVChainCodec", "KVChainCorrupt",
-           "MeshConfig", "PrefixCacheConfig", "RadixPrefixCache",
+           "MeshConfig", "MeshDegraded", "PrefixCacheConfig",
+           "RadixPrefixCache",
            "ReplicaState",
            "Request", "RequestJournal", "RequestShed", "SLOAutoscaler",
            "ServingSupervisor", "SpecConfig", "StepWatchdog", "TieredRouter"]
@@ -151,6 +152,23 @@ def __getattr__(name):
 
         return StepWatchdog
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+class MeshDegraded(RuntimeError):
+    """PT-SRV-008: the engine's tp device group lost devices mid-serve
+    (the seeded ``device.loss`` fault site, or a real runtime device
+    failure surfaced by the caller). Carries ``lost`` (devices gone) and
+    ``survivors`` (devices still usable); the elastic
+    :class:`ServingSupervisor` catches it, reshards the engine to the
+    widest surviving tp width that still divides both head counts
+    (falling to unsharded when none does), and re-admits every
+    unfinished request from the journal byte-identically
+    (docs/RESILIENCE.md "Elastic serving mesh")."""
+
+    def __init__(self, msg: str, lost: int = 0, survivors: int = 1):
+        super().__init__(msg)
+        self.lost = int(lost)
+        self.survivors = max(0, int(survivors))
 
 
 class EngineSaturated(RuntimeError):
@@ -745,6 +763,7 @@ class ContinuousBatchingEngine:
         # off the per-step path; the lazy-import discipline is preserved —
         # nothing resilience-side loads until the engine actually steps)
         self._fault_hook = None
+        self._device_loss_hook = None
         self._retry_stats_fn = None
         # host-side accounting: admission vs decode dispatch time (the
         # admission-stall share is stats["admit_host_s"] / wall) plus the
@@ -940,17 +959,30 @@ class ContinuousBatchingEngine:
         (admit_host_s / decode_host_s) so the admission share is measurable
         at any workload."""
         if self._fault_hook is None:
-            from ..distributed.resilience.faults import maybe_inject
+            from ..distributed.resilience.faults import (device_loss,
+                                                         maybe_inject)
 
             self._fault_hook = maybe_inject
+            self._device_loss_hook = device_loss
         self._step_idx += 1
         # injection sites (docs/RESILIENCE.md): `serving.stall` sleeps the
         # step past its wall-clock budget (StepWatchdog / PT-SRV-002);
         # `serving.step` kills the engine mid-wave (ServingSupervisor
-        # rebuild-from-journal / PT-SRV-001). One global read each when no
-        # plan is installed.
+        # rebuild-from-journal / PT-SRV-001); `device.loss` removes devices
+        # from the tp mesh (MeshDegraded / PT-SRV-008 — the elastic
+        # reshard-and-resume drill). One global read each when no plan is
+        # installed.
         self._fault_hook("serving.stall", f"step:{self._step_idx}")
         self._fault_hook("serving.step", f"step:{self._step_idx}")
+        lost = self._device_loss_hook(f"step:{self._step_idx}")
+        if lost > 0 and self._mesh is not None and not self.mesh.abstract:
+            tp = int(self.mesh.tp)
+            survivors = max(0, tp - lost)
+            raise MeshDegraded(
+                f"PT-SRV-008: tp={tp} device group lost {lost} device(s) "
+                f"at step {self._step_idx} ({survivors} surviving) — "
+                f"engine must reshard to a narrower mesh",
+                lost=lost, survivors=survivors)
         t0 = _time.perf_counter()
         sched0 = self._sched_tokens
         self._deferred_step = False

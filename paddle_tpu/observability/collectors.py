@@ -242,13 +242,34 @@ def guard_collector(watchdog=None):
     return collect
 
 
+# supervisor stats NOT auto-exported as pt_supervisor_*: the elastic
+# mesh-degrade pair exports under its REQUIRED pt_serving_* names below
+# (reshard total + degraded gauge — docs/RESILIENCE.md "Elastic serving
+# mesh"), and a second pt_supervisor_* copy of each would just split
+# dashboards across two names for one quantity.
+_SUPERVISOR_SKIP_KEYS = {"mesh_reshards", "mesh_degraded"}
+
+
 def supervisor_collector(sup, **labels):
     """``ServingSupervisor`` stats + its CURRENT engine's families (read
     through ``sup.engine`` at scrape time — a rebuild swaps the engine out
     from under any collector that captured it directly)."""
 
     def collect() -> Iterable[MetricFamily]:
-        fams = _stat_families("pt_supervisor", sup.stats, {}, **labels)
+        fams = _stat_families(
+            "pt_supervisor",
+            {k: v for k, v in sup.stats.items()
+             if k not in _SUPERVISOR_SKIP_KEYS}, {}, **labels)
+        stats = sup.stats
+        fams.append(MetricFamily(
+            "pt_serving_mesh_reshards_total", "counter",
+            "elastic PT-SRV-008 mesh-degrade reshards absorbed").add(
+            float(stats.get("mesh_reshards", 0)), **labels))
+        fams.append(MetricFamily(
+            "pt_serving_mesh_degraded", "gauge",
+            "1 = this supervisor's engine is serving below its spawned "
+            "mesh width (degraded)").add(
+            float(stats.get("mesh_degraded", 0)), **labels))
         fams.extend(engine_collector(sup.engine, **labels)())
         return fams
 

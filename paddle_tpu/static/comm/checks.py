@@ -236,6 +236,24 @@ def check_comm_contract(manifest: CommManifest,
             f" {dict(base_counts)}) — the program silently LOST its "
             f"sharding; restore it or refresh the baseline with a "
             f"justification", name, "lost-sharding"))
+    # elastic degrade exemption (docs/RESILIENCE.md "Elastic serving
+    # mesh"): a baseline may record `degrade_widths` — the narrower tp
+    # widths its PT-SRV-008 reshard path legitimately serves at. A
+    # STILL-SHARDED manifest at a recorded degrade width is a planned
+    # partial shrink: its per-primitive counts and wire bytes scale with
+    # the width, so the count/drift/bytes gates below would misfire.
+    # Losing sharding ENTIRELY is never exempt — that already gated as
+    # lost-sharding above.
+    if not manifest.unsharded:
+        degrade_widths = {int(w) for w in
+                          (baseline.get("degrade_widths") or ())}
+        width = int(manifest.width
+                    or (manifest.mesh or {}).get("tp") or 0)
+        base_width = int(baseline.get("width")
+                         or (base_mesh or {}).get("tp") or 0)
+        if (degrade_widths and width and base_width
+                and width != base_width and width in degrade_widths):
+            return findings
     for prim, want in sorted(base_counts.items()):
         if int(want) and not manifest.collectives.get(prim, 0):
             findings.append(_diag(

@@ -365,7 +365,7 @@ def test_collective_comm_gate_real_sweep_clean():
         assert "all_gather" in line[0] and "psum" not in line[0], line[0]
 
 
-@pytest.mark.slow   # ~6min of engine/train-loop compiles across 23 classes
+@pytest.mark.slow   # ~6min of engine/train-loop compiles across 24 classes
 def test_fault_drill_matrix():
     """Resilience gate (docs/RESILIENCE.md + docs/NUMERIC_GUARD.md +
     docs/SERVING.md): the seeded fault matrix — heartbeat loss, store
@@ -402,7 +402,7 @@ def test_fault_drill_matrix():
          "--selftest"],
         capture_output=True, text=True, env=env, cwd=ROOT, timeout=840)
     assert r.returncode == 0, r.stdout + r.stderr
-    assert "FAULT DRILL OK: 23 fault classes" in r.stdout, r.stdout
+    assert "FAULT DRILL OK: 24 fault classes" in r.stdout, r.stdout
 
 
 def test_fault_drill_single_drill_exit_codes():

@@ -174,6 +174,9 @@ for step in range(start, total):
 """
 
 
+@pytest.mark.slow   # subprocess relaunch pays a fresh jax import + compile
+#                     (~11s); elastic resume keeps fast in-process coverage in
+#                     test_lifecycle plus the tier-2 lifecycle_e2e drill
 def test_elastic_relaunch_resumes_from_checkpoint(tmp_path):
     """End-to-end elastic drill (round 5, VERDICT item 6): a worker dies
     mid-train, the elastic controller detects the fault, relaunches the

@@ -57,6 +57,9 @@ def test_reduce_lr_on_plateau():
     assert opt.get_lr() == 0.5  # plateaued -> halved
 
 
+@pytest.mark.slow   # full llama SP-vs-dense compile pair (~18s, tier-1 870s
+#                     budget); the sp unit tests in this file keep the
+#                     scatter/gather and linear-vs-dense contracts fast
 def test_llama_megatron_sp_matches_dense(mesh8):
     """cfg.sequence_parallel shards the residual stream over tp (Megatron-SP);
     training must match the non-SP model exactly (same seed/data)."""

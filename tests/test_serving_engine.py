@@ -69,6 +69,9 @@ def test_engine_rejects_oversized_request(model):
         eng.add_request(Request(np.zeros(10, np.int32), max_new_tokens=10))
 
 
+@pytest.mark.slow   # the gpt arm is its own engine compile wave (~12s) — the
+#                     llama arm above keeps the engine-vs-generate identity
+#                     fast (tier-1 870s budget, same posture as the fused A/Bs)
 def test_continuous_batching_gpt(model):
     from paddle_tpu.models.gpt.modeling import GPTConfig, GPTForCausalLM
 

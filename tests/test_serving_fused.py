@@ -182,6 +182,10 @@ def test_fused_counters_track_occupancy(model, fus):
     assert all(r.done and not r.failed for r in reqs)
 
 
+@pytest.mark.slow   # crash + rebuild = a second fused compile wave (~23s);
+#                     replay-determinism keeps fast coverage via
+#                     test_serving_recovery's journal-restart test (same
+#                     posture as PR 5's crash-recovery slow-mark)
 def test_fused_crash_replay_bit_identical(model, tmp_path):
     """ServingSupervisor over a FUSED engine: a ``serving.step`` kill
     mid-wave rebuilds from the journal and the replayed streams (greedy +

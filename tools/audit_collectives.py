@@ -322,6 +322,18 @@ def load_baseline(path: str = BASELINE_PATH):
 
 
 def write_baseline(manifests, waivers, path: str = BASELINE_PATH):
+    # `degrade_widths` is a REVIEWED annotation, not a traced fact —
+    # CommManifest.to_dict() cannot produce it, so a refresh must carry
+    # it over from the prior baseline or the elastic-degrade exemption
+    # (docs/RESILIENCE.md "Elastic serving mesh") silently disappears
+    prior, _ = load_baseline(path)
+    programs = {}
+    for k, m in sorted(manifests.items()):
+        rec = m.to_dict()
+        widths = (prior.get(k) or {}).get("degrade_widths")
+        if widths:
+            rec["degrade_widths"] = [int(w) for w in widths]
+        programs[k] = rec
     doc = {
         "_comment": [
             "PT-COMM manifests + reviewed waivers",
@@ -332,8 +344,14 @@ def write_baseline(manifests, waivers, path: str = BASELINE_PATH):
             "all_gather-only); a program that silently reverts to",
             "unsharded gates as PT-COMM-005 lost-sharding. Every waiver",
             "needs a justification; stale waivers are reported.",
+            "Serving entries may record `degrade_widths`: the narrower",
+            "tp widths the elastic PT-SRV-008 reshard path legitimately",
+            "serves at — a still-sharded manifest at a recorded degrade",
+            "width passes the count/drift/bytes gates (its census scales",
+            "with the width); losing sharding entirely still gates as",
+            "lost-sharding. Preserved across --write-baseline refreshes.",
         ],
-        "programs": {k: m.to_dict() for k, m in sorted(manifests.items())},
+        "programs": programs,
         "waivers": [{"id": fid, "justification": waivers[fid]}
                     for fid in sorted(waivers)],
     }

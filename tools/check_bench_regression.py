@@ -113,6 +113,14 @@ SECONDARY = {
     "serving_prefix_hit_rate": ("higher", 0.2, 0.0),
     "serving_prefill_tokens_per_sec": ("higher", 0.3, 0.0),
     "serving_recovery_time_s": ("lower", 1.0, 2.0),
+    # elastic mesh degrade (docs/RESILIENCE.md "Elastic serving mesh"):
+    # harvest + rebuild at the surviving width + replay-to-hwm after a
+    # device.loss fault — same posture as serving_recovery_time_s (2s
+    # floor, the reshard is recompile-dominated on the narrower engine);
+    # past 2x the degrade path grew real work (e.g. harvesting per
+    # replayed request instead of once, or replay re-running delivered
+    # prompts)
+    "serving_mesh_degrade_time_s": ("lower", 1.0, 2.0),
     "serving_shed_rate": ("higher", 0.5, 0.0),
     "fleet_tokens_per_sec": ("higher", 0.3, 0.0),
     "fleet_failover_time_s": ("lower", 1.0, 2.0),

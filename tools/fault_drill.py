@@ -828,6 +828,126 @@ def drill_serving_crash(recover: bool):
                       "bit-identical (incl. COW + seeded sampling)")
 
 
+def _mesh_model():
+    """tp=4-capable tiny llama (4 kv heads so both tp=4 and the degraded
+    tp=2 divide the head counts) — separate from ``_serving_model`` whose
+    2 kv heads cap it at tp=2."""
+    if "mesh_model" not in _SERVING:
+        import paddle_tpu as paddle
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+        paddle.seed(11)
+        cfg = LlamaConfig.tiny(num_hidden_layers=1, num_key_value_heads=4)
+        _SERVING["mesh_model"] = (cfg, LlamaForCausalLM(cfg))
+    return _SERVING["mesh_model"]
+
+
+def _mesh_wave():
+    """Greedy full-page prompt + long seeded sampled request — the
+    byte-identity claim must survive the reshard in BOTH decode modes."""
+    import numpy as np
+
+    cfg, _ = _mesh_model()
+    rng = np.random.default_rng(21)
+    pa = rng.integers(0, cfg.vocab_size, (8,)).astype(np.int32)
+    pb = rng.integers(0, cfg.vocab_size, (6,)).astype(np.int32)
+    return [
+        dict(prompt_ids=pa, max_new_tokens=6, seed=40),
+        dict(prompt_ids=pb, max_new_tokens=10, temperature=0.9, seed=71),
+    ]
+
+
+def _mesh_build(mesh_tp=4):
+    """Width-aware factory: the elastic supervisor rebuilds through it at
+    the surviving width (mesh_tp=None = fall back to unsharded)."""
+    _, m = _mesh_model()
+    from paddle_tpu.inference.serving import (ContinuousBatchingEngine,
+                                              MeshConfig, PrefixCacheConfig)
+
+    mesh = None if mesh_tp is None else MeshConfig(tp=int(mesh_tp))
+    return ContinuousBatchingEngine(
+        m, max_batch=2, max_len=32, page_size=8, block_size=2, fused=True,
+        prefix_cache=PrefixCacheConfig(extra_blocks=4), mesh=mesh)
+
+
+def _mesh_refs():
+    """Uninterrupted tp=4 supervisor reference streams (cached)."""
+    if "mesh_refs" not in _SERVING:
+        from paddle_tpu.inference.serving import Request, ServingSupervisor
+
+        with tempfile.TemporaryDirectory() as tmp:
+            sup = ServingSupervisor(_mesh_build,
+                                    os.path.join(tmp, "ref.jrnl"))
+            reqs = [Request(**kw) for kw in _mesh_wave()]
+            for r in reqs:
+                sup.submit(r)
+            sup.run_until_done(max_steps=500)
+            sup.close()
+        _SERVING["mesh_refs"] = [list(r.tokens) for r in reqs]
+    return _SERVING["mesh_refs"]
+
+
+def drill_mesh_device_loss(recover: bool):
+    """A tp=4 engine loses 2 of its devices mid-decode (FaultPlan
+    ``device.loss`` -> MeshDegraded / PT-SRV-008). Recovery = the elastic
+    ServingSupervisor harvests the column shards host-side, rebuilds at
+    the widest surviving width (tp=2), re-splits the same bytes, and
+    replays every journaled request — streams BIT-IDENTICAL to the
+    uninterrupted tp=4 run (greedy + seeded; the column-parallel
+    all_gather-only contract makes the widths interchangeable). Without
+    the degrade path (elastic=False) the typed signal escapes and every
+    in-flight request is lost with the device group."""
+    from paddle_tpu.distributed.resilience import FaultPlan, FaultSpec
+    from paddle_tpu.inference.serving import Request, ServingSupervisor
+
+    refs = _mesh_refs()
+    # at=1: the SECOND engine step — step 1 admits + prefills, so the loss
+    # lands with both requests mid-decode (the fused engine runs each wave
+    # to its next completion event, so the whole drill is only ~3 steps)
+    plan = FaultPlan(seed=5, specs=[
+        FaultSpec("device.loss", "lose", at=1, count=1, arg=2)])
+    with tempfile.TemporaryDirectory() as tmp:
+        sup = ServingSupervisor(_mesh_build, os.path.join(tmp, "j.jrnl"),
+                                elastic=recover)
+        reqs = [Request(**kw) for kw in _mesh_wave()]
+        try:
+            with plan:
+                for r in reqs:
+                    sup.submit(r)
+                sup.run_until_done(max_steps=500)
+        except Exception as e:
+            if recover:
+                return False, f"supervisor did not absorb the degrade: {e!r}"
+            lost = [r.rid for r in reqs if not r.done]
+            if not lost:
+                return True, "unexpected: degrade raised but no request lost"
+            return False, (f"no elastic degrade path: losing 2 devices lost "
+                           f"{len(lost)} in-flight request(s) {lost}")
+        finally:
+            sup.close()
+        if not plan.log:
+            return False, "device.loss never fired"
+        if not recover:
+            return True, "unexpected: degrade absorbed with elastic off"
+        if sup.stats["mesh_reshards"] < 1:
+            return False, "device loss never triggered a reshard"
+        tp = (int(sup.engine.mesh.tp)
+              if getattr(sup.engine, "mesh", None) is not None else 1)
+        if tp != 2:
+            return False, (f"expected the widest surviving width tp=2, "
+                           f"engine is at tp={tp}")
+        streams = [list(r.tokens) for r in reqs]
+        if streams != refs:
+            bad = [i for i, (s, f) in enumerate(zip(streams, refs)) if s != f]
+            return False, (f"resharded stream(s) {bad} diverged from the "
+                           "uninterrupted tp=4 run")
+        return True, (f"PT-SRV-008: lost 2/4 devices at {plan.log[0][1]}, "
+                      f"resharded tp=4->2 + replayed "
+                      f"{sup.stats['replayed_requests']} request(s) in "
+                      f"{sup.stats['recovery_s']:.2f}s, streams "
+                      "bit-identical (greedy + seeded)")
+
+
 def drill_serving_stall(recover: bool):
     """One engine step hangs (FaultPlan ``serving.stall``). Recovery = the
     threaded StepWatchdog flags PT-SRV-002 while the step is stuck and the
@@ -2066,6 +2186,7 @@ DRILLS = {
     "prefix_cache_exhaustion": drill_prefix_cache_exhaustion,
     "big_batch_saturation": drill_big_batch_saturation,
     "serving_crash": drill_serving_crash,
+    "mesh_device_loss": drill_mesh_device_loss,
     "serving_stall": drill_serving_stall,
     "serving_overload_shed": drill_serving_overload_shed,
     "fleet_replica_kill": drill_fleet_replica_kill,

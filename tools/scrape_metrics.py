@@ -96,6 +96,11 @@ REQUIRED_FAMILIES = (
     "pt_serving_mesh_shape",
     "pt_serving_collective_bytes_total",
     "pt_serving_mesh_decode_steps_total",
+    # elastic mesh degrade (docs/RESILIENCE.md "Elastic serving mesh"):
+    # the supervisor collector renders both at zero on never-degraded
+    # supervisors, so the families are REQUIRED unconditionally
+    "pt_serving_mesh_reshards_total",
+    "pt_serving_mesh_degraded",
     # checkpoint lifecycle (distributed/resilience/lifecycle.py — the
     # checkpoint_collector renders generation/publish counters at zero and
     # the phase gauge at "idle" with no publisher constructed, so the
